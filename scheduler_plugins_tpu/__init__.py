@@ -1,0 +1,31 @@
+"""scheduler_plugins_tpu — a TPU-native batched cluster-scheduling framework.
+
+Brand-new framework with the capabilities of kubernetes-sigs/scheduler-plugins
+(gang scheduling, elastic quota + quota-aware preemption, allocatable/load/NUMA/
+network-aware scoring, preemption toleration, syscall-aware spreading, CRD
+controllers), re-designed TPU-first:
+
+- Cluster state is a set of dense integer tensors (pods x resources,
+  nodes x resources, nodes x NUMA-zones x resources, ...) instead of an object
+  graph; see `scheduler_plugins_tpu.state.snapshot`.
+- The per-pod x per-node Filter/Score hot loop of the reference
+  (upstream kube-scheduler driving plugin callbacks per node) becomes batched
+  tensor math under `jax.jit`: Filter is a (P, N) boolean reduction, Score is a
+  (P, N) integer matrix, gang/quota admission are segment reductions.
+- Placement itself is a `lax.scan` over the pod queue (bit-faithful to the
+  one-pod-at-a-time reference semantics) or an optional faster wave mode.
+- Multi-chip scaling shards the node axis over a `jax.sharding.Mesh`
+  (see `scheduler_plugins_tpu.parallel`).
+
+All resource quantities are int64 in the reference's own units (CPU in
+millicores, memory in bytes) so placement decisions can be bit-identical with
+the Go implementation.
+"""
+
+import jax
+
+# Quota/score math must be int64 (memory is in *bytes*; allocatable-score
+# weights go up to 1<<20) — see /root/reference/pkg/noderesources/resource_allocation.go:36.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""Typed host-side API surface: resource units, cluster objects (pods, nodes,
+PodGroups, ElasticQuotas, NodeResourceTopologies, AppGroups, NetworkTopologies)
+and plugin configuration args with defaults/validation — the equivalent of the
+reference's `apis/` tree (CRDs in apis/scheduling/v1alpha1, plugin args in
+apis/config)."""
+
+from scheduler_plugins_tpu.api.resources import (  # noqa: F401
+    CANONICAL,
+    CPU,
+    EPHEMERAL_STORAGE,
+    MEMORY,
+    PODS,
+    ResourceIndex,
+)

@@ -1,0 +1,352 @@
+"""Host-side cluster object model.
+
+Plain dataclasses standing in for the k8s API objects the reference consumes:
+Pod/Node plus the CRDs it defines or depends on — PodGroup and ElasticQuota
+(/root/reference/apis/scheduling/v1alpha1/types.go:35-198), NodeResourceTopology
+zones (external noderesourcetopology-api), AppGroup + NetworkTopology (diktyo
+APIs), and seccomp profiles (SySched). These objects live on the host; the
+snapshot builder (`state.snapshot`) lowers them to dense tensors.
+
+Derived-request semantics follow the reference exactly:
+- effective request = max(sum of app containers (+ sidecars), rolling init max)
+  + overhead — /root/reference/pkg/util/resource.go:51-85.
+- QoS class derivation mirrors upstream `v1qos.GetPodQOS`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+from scheduler_plugins_tpu.api.resources import (
+    CPU,
+    MEMORY,
+    add_quantities,
+    max_quantities,
+)
+
+# Label that joins a pod to its PodGroup
+# (/root/reference/apis/scheduling/v1alpha1/types.go: PodGroupLabel).
+POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+# Well-known topology labels used by the network-aware plugins.
+REGION_LABEL = "topology.kubernetes.io/region"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+# AppGroup membership labels (diktyo appgroup-api).
+APP_GROUP_LABEL = "app-group.scheduling.x-k8s.io"
+WORKLOAD_SELECTOR_LABEL = "app"
+
+DEFAULT_SCHEDULER_NAME = "tpu-scheduler"
+
+
+class QOSClass(enum.IntEnum):
+    """Ordered so that the QOSSort queue comparator can compare numerically:
+    Guaranteed > Burstable > BestEffort
+    (/root/reference/pkg/qos/queue_sort.go:46-81)."""
+
+    BEST_EFFORT = 0
+    BURSTABLE = 1
+    GUARANTEED = 2
+
+
+class PodPhase(enum.StrEnum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Container:
+    name: str = "c"
+    requests: Mapping[str, int] = field(default_factory=dict)
+    limits: Mapping[str, int] = field(default_factory=dict)
+    #: init containers with restartPolicy=Always are sidecars
+    #: (/root/reference/pkg/util/sidecar.go:25-27).
+    restart_policy_always: bool = False
+    #: Seccomp profile reference (namespace/name of a SeccompProfile CR) for
+    #: the SySched plugin; None means unconfined.
+    seccomp_profile: Optional[str] = None
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    overhead: Mapping[str, int] = field(default_factory=dict)
+    priority: int = 0
+    labels: Mapping[str, str] = field(default_factory=dict)
+    annotations: Mapping[str, str] = field(default_factory=dict)
+    node_name: Optional[str] = None
+    #: Node the scheduler has nominated this pod for after preemption.
+    nominated_node_name: Optional[str] = None
+    phase: PodPhase = PodPhase.PENDING
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    creation_ms: int = 0
+    #: non-None marks a terminating pod (deletionTimestamp set).
+    deletion_ms: Optional[int] = None
+    scheduling_gated: bool = False
+    #: PriorityClass name, consumed by PreemptionToleration policy lookup.
+    priority_class_name: str = ""
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+    # -- derived ---------------------------------------------------------
+
+    def pod_group(self) -> str:
+        return self.labels.get(POD_GROUP_LABEL, "")
+
+    def app_group(self) -> str:
+        return self.labels.get(APP_GROUP_LABEL, "")
+
+    def workload_selector(self) -> str:
+        return self.labels.get(WORKLOAD_SELECTOR_LABEL, "")
+
+    @property
+    def terminating(self) -> bool:
+        return self.deletion_ms is not None
+
+    def effective_request(self) -> dict[str, int]:
+        """Effective pod request: per resource,
+        max(sum of app containers, max over init containers) + overhead —
+        exactly /root/reference/pkg/util/resource.go:45-85
+        (GetPodEffectiveRequest; init containers are a plain per-resource max,
+        with no sidecar special-casing).
+        """
+        resources: dict[str, int] = {}
+        for c in self.containers:
+            resources = add_quantities(resources, c.requests)
+
+        init_max: dict[str, int] = {}
+        for ic in self.init_containers:
+            init_max = max_quantities(init_max, ic.requests)
+        resources = max_quantities(resources, init_max)
+
+        return add_quantities(resources, self.overhead)
+
+    def qos_class(self) -> QOSClass:
+        """Mirror of upstream `v1qos.GetPodQOS` (cpu/memory only):
+        BestEffort when no container names any cpu/memory request or limit;
+        Guaranteed when every container has cpu+memory limits AND the
+        aggregate request sum equals the aggregate limit sum per resource
+        (absent requests are fine); Burstable otherwise.
+        """
+        all_containers = list(self.containers) + list(self.init_containers)
+        requests: dict[str, int] = {}
+        limits: dict[str, int] = {}
+        guaranteed = bool(all_containers)
+        for c in all_containers:
+            limits_found = set()
+            for res in (CPU, MEMORY):
+                if c.requests.get(res, 0):
+                    requests[res] = requests.get(res, 0) + c.requests[res]
+                if c.limits.get(res, 0):
+                    limits_found.add(res)
+                    limits[res] = limits.get(res, 0) + c.limits[res]
+            if limits_found != {CPU, MEMORY}:
+                guaranteed = False
+        if not requests and not limits:
+            return QOSClass.BEST_EFFORT
+        for res, req_sum in requests.items():
+            if limits.get(res) != req_sum:
+                guaranteed = False
+        return QOSClass.GUARANTEED if guaranteed else QOSClass.BURSTABLE
+
+
+@dataclass
+class Node:
+    name: str
+    allocatable: Mapping[str, int] = field(default_factory=dict)
+    capacity: Mapping[str, int] = field(default_factory=dict)
+    labels: Mapping[str, str] = field(default_factory=dict)
+    unschedulable: bool = False
+
+    def __post_init__(self):
+        if not self.capacity:
+            self.capacity = dict(self.allocatable)
+
+    @property
+    def region(self) -> str:
+        return self.labels.get(REGION_LABEL, "")
+
+    @property
+    def zone(self) -> str:
+        return self.labels.get(ZONE_LABEL, "")
+
+
+# ---------------------------------------------------------------------------
+# CRDs defined by the reference (apis/scheduling/v1alpha1/types.go)
+# ---------------------------------------------------------------------------
+
+
+class PodGroupPhase(enum.StrEnum):
+    """PodGroup status phase machine
+    (/root/reference/apis/scheduling/v1alpha1/types.go:120-150)."""
+
+    PENDING = "Pending"
+    PRE_SCHEDULING = "PreScheduling"
+    SCHEDULING = "Scheduling"
+    SCHEDULED = "Scheduled"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    FINISHED = "Finished"
+    FAILED = "Failed"
+
+
+@dataclass
+class PodGroup:
+    name: str
+    namespace: str = "default"
+    min_member: int = 1
+    #: Guaranteed whole-gang resource demand; enables the cluster-capacity
+    #: pre-check (/root/reference/pkg/coscheduling/core/core.go:286-305).
+    min_resources: Mapping[str, int] = field(default_factory=dict)
+    schedule_timeout_seconds: Optional[int] = None
+    creation_ms: int = 0
+    # status
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    occupied_by: str = ""
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    schedule_start_ms: int = 0
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ElasticQuota:
+    """Per-namespace elastic quota: `min` is guaranteed, `max` is the cap
+    (/root/reference/apis/scheduling/v1alpha1/types.go:35-83)."""
+
+    name: str
+    namespace: str = "default"
+    min: Mapping[str, int] = field(default_factory=dict)
+    max: Mapping[str, int] = field(default_factory=dict)
+    # status
+    used: Mapping[str, int] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# NodeResourceTopology (external noderesourcetopology-api)
+# ---------------------------------------------------------------------------
+
+
+class TopologyManagerPolicy(enum.IntEnum):
+    """Integer codes for the kubelet topology-manager policy mirrored from NRT
+    attributes (/root/reference/pkg/noderesourcetopology/nodeconfig/topologymanager.go)."""
+
+    NONE = 0
+    BEST_EFFORT = 1
+    RESTRICTED = 2
+    SINGLE_NUMA_NODE = 3
+
+
+class TopologyManagerScope(enum.IntEnum):
+    CONTAINER = 0
+    POD = 1
+
+
+@dataclass
+class NUMAZone:
+    numa_id: int
+    #: available = allocatable minus used, as published by the node agent.
+    available: Mapping[str, int] = field(default_factory=dict)
+    #: allocatable per zone (defaults to available when agent omits it).
+    allocatable: Mapping[str, int] = field(default_factory=dict)
+    #: SLIT-style distance to other zones, keyed by numa_id.
+    costs: Mapping[int, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.allocatable:
+            self.allocatable = dict(self.available)
+
+
+@dataclass
+class NodeResourceTopology:
+    node_name: str
+    zones: list[NUMAZone] = field(default_factory=list)
+    policy: TopologyManagerPolicy = TopologyManagerPolicy.NONE
+    scope: TopologyManagerScope = TopologyManagerScope.CONTAINER
+    max_numa_nodes: int = 8
+    #: pod fingerprint stamped by the node agent, validated by the
+    #: over-reserve cache resync (/root/reference/pkg/noderesourcetopology/cache/overreserve.go:276-348).
+    pod_fingerprint: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Network-aware CRDs (diktyo appgroup-api / networktopology-api)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AppGroupDependency:
+    workload_selector: str
+    max_network_cost: int = 0
+
+
+@dataclass
+class AppGroupWorkload:
+    selector: str
+    dependencies: list[AppGroupDependency] = field(default_factory=list)
+
+
+@dataclass
+class AppGroup:
+    name: str
+    namespace: str = "default"
+    workloads: list[AppGroupWorkload] = field(default_factory=list)
+    #: status.TopologyOrder — workload selector -> topological index, used by
+    #: the TopologicalSort queue comparator
+    #: (/root/reference/pkg/networkaware/topologicalsort/topologicalsort.go:102-132).
+    topology_order: Mapping[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class NetworkTopology:
+    """Origin->destination costs per topology key (region/zone) per weights
+    profile (/root/reference/pkg/networkaware/networkoverhead/networkoverhead.go:448-638)."""
+
+    name: str = "nt-default"
+    namespace: str = "default"
+    #: weightsName -> topologyKey("region"|"zone") -> (origin, dest) -> cost
+    weights: Mapping[str, Mapping[str, Mapping[tuple[str, str], int]]] = field(
+        default_factory=dict
+    )
+
+
+# ---------------------------------------------------------------------------
+# SySched / seccomp
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SeccompProfile:
+    """Syscall allow-list referenced by pod security context / annotations
+    (/root/reference/pkg/sysched/sysched.go:124-210)."""
+
+    name: str
+    namespace: str = "default"
+    syscalls: frozenset[str] = frozenset()
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class PriorityClass:
+    """PriorityClass with the preemption-toleration annotations
+    (/root/reference/pkg/preemptiontoleration/policy.go)."""
+
+    name: str
+    value: int = 0
+    annotations: Mapping[str, str] = field(default_factory=dict)

@@ -1,0 +1,32 @@
+"""Pure-JAX scheduling kernels.
+
+Each module recasts one of the reference's per-pod x per-node Go hot loops
+(SURVEY.md §3 "hot loops ranked for TPU offload") as batched tensor math:
+
+- fit.py          resource-fit Filter: (P,R) vs (N,R) -> (P,N) bool
+- allocatable.py  NodeResourcesAllocatable weighted score + min-max normalize
+- normalize.py    shared score-normalization transforms
+- trimaran.py     load-aware score curves (TLP / LVRB / LROC / Peaks)
+- numa.py         NUMA bitmask fitting + per-zone scoring strategies
+- network.py      AppGroup dependency cost/violation accumulation
+- gang.py         PodGroup quorum + whole-cluster capacity checks
+- quota.py        ElasticQuota min/max aggregate checks
+- assign.py       greedy one-pod-at-a-time placement (lax.scan)
+"""
+
+from scheduler_plugins_tpu.api.resources import (
+    CANONICAL,
+    CPU,
+    EPHEMERAL_STORAGE,
+    MEMORY,
+    PODS,
+)
+
+# canonical slots on the resource axis, derived from the single source of truth
+CPU_I = CANONICAL.index(CPU)
+MEMORY_I = CANONICAL.index(MEMORY)
+EPHEMERAL_I = CANONICAL.index(EPHEMERAL_STORAGE)
+PODS_I = CANONICAL.index(PODS)
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0

@@ -1,0 +1,43 @@
+"""NodeResourcesAllocatable score (Score-only plugin).
+
+Reference behavior (/root/reference/pkg/noderesources/allocatable.go:117-168,
+resource_allocation.go:49-100): per node,
+
+    nodeScore = ( sum_r sign * allocatable_r * weight_r ) / sum_r weight_r
+
+with sign = -1 for Least mode, +1 for Most, Go integer division (truncates
+toward zero — scores are negative in Least mode), then min-max normalized to
+[0, 100]. Default weights: cpu(milli) 1<<20, memory(bytes) 1
+(resource_allocation.go:36). The score depends only on node allocatables, so
+the whole (P, N) matrix is one broadcast row per cycle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+from scheduler_plugins_tpu.utils.intmath import go_div
+
+MODE_LEAST = -1
+MODE_MOST = 1
+
+
+def allocatable_scores(alloc, weights, mode_sign=MODE_LEAST):
+    """(N, R) allocatable x (R,) weights -> (N,) raw scores (pre-normalize)."""
+    alloc = jnp.asarray(alloc)
+    weights = jnp.asarray(weights, dtype=jnp.int64)
+    weight_sum = jnp.maximum(weights.sum(), 1)
+    node_score = (mode_sign * alloc * weights[None, :]).sum(axis=-1)
+    return go_div(node_score, weight_sum)
+
+
+def allocatable_score_matrix(alloc, weights, mode_sign, feasible):
+    """Full plugin output: (P, N) normalized scores given (P, N) feasibility.
+
+    Normalization runs per pod over that pod's feasible nodes, mirroring the
+    framework calling NormalizeScore on each pod's NodeScoreList.
+    """
+    raw = allocatable_scores(alloc, weights, mode_sign)  # (N,)
+    per_pod = jnp.broadcast_to(raw[None, :], feasible.shape)
+    return minmax_normalize(per_pod, feasible)

@@ -1,0 +1,111 @@
+"""Placement: turn per-pod feasibility + scores into node assignments.
+
+Two modes:
+
+- `greedy_assign` — bit-faithful to the reference's one-pod-at-a-time cycle:
+  a `lax.scan` over the pod queue where each step filters/scores against the
+  *current* free capacity and commits the winner before the next pod runs
+  (SURVEY.md §7 "sequential semantics"). Tie-break: lowest node index (the
+  upstream framework randomizes among equals; we pin determinism instead).
+
+- `wave_assign` — the TPU-throughput mode: scores are computed for the whole
+  batch at once, pods pick their argmax node, conflicts are resolved by queue
+  order within the wave via a much shorter scan over *waves*. Placements can
+  differ from sequential mode when a wave overcommits a node; the caller
+  chooses the trade-off.
+
+Both return assignment = (P,) int32 node index, -1 for unschedulable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.ops.fit import pod_fit_demand
+
+#: signature: (free (N,R), pod_index int32) -> (feasible (N,) bool, score (N,) int64)
+StepFn = Callable
+
+
+def _pick(feasible, scores):
+    """argmax score among feasible nodes, lowest index on ties; -1 if none."""
+    masked = jnp.where(feasible, scores, jnp.int64(-(2**62)))
+    best = jnp.argmax(masked)
+    return jnp.where(feasible.any(), best.astype(jnp.int32), jnp.int32(-1))
+
+
+@partial(jax.jit, static_argnames=("step_fn",))
+def greedy_assign(step_fn: StepFn, req, pod_mask, free0):
+    """Sequential greedy placement.
+
+    step_fn computes this pod's (feasible, scores) against current free
+    capacity; the scan then commits `req` (with the pod-count slot set to 1)
+    to the chosen node.
+    """
+    demand = pod_fit_demand(req)  # (P, R)
+    P = req.shape[0]
+
+    def body(free, p):
+        feasible, scores = step_fn(free, p)
+        choice = _pick(feasible & pod_mask[p], scores)
+        delta = jnp.where(
+            (jnp.arange(free.shape[0]) == choice)[:, None], demand[p], 0
+        )
+        return free + jnp.where(choice >= 0, -delta, 0), choice
+
+    free, assignment = jax.lax.scan(body, free0, jnp.arange(P))
+    return assignment, free
+
+
+@partial(jax.jit, static_argnames=("batch_fn", "max_waves"))
+def wave_assign(batch_fn, req, pod_mask, free0, max_waves: int = 8):
+    """Wave-parallel placement.
+
+    batch_fn: (free (N,R), active (P,) bool) -> (feasible (P,N), scores (P,N)).
+    Per wave every still-unassigned pod picks its argmax node; within a wave,
+    pods that chose the same node are admitted in queue order while the node's
+    capacity lasts (an exclusive running sum per node), the rest retry next
+    wave.
+    """
+    P, R = req.shape
+    demand = pod_fit_demand(req)
+
+    def wave(carry, _):
+        free, assignment = carry
+        active = (assignment == -1) & pod_mask
+        feasible, scores = batch_fn(free, active)
+        feasible &= active[:, None]
+        masked = jnp.where(feasible, scores, jnp.int64(-(2**62)))
+        choice = jnp.where(
+            feasible.any(axis=1), jnp.argmax(masked, axis=1).astype(jnp.int32), -1
+        )
+        # queue-order admission: pod p wins iff node still fits after all
+        # earlier winners of the same wave on the same node. Unrolled over the
+        # small static R axis to keep peak memory at (P, N), not (P, N, R).
+        onehot = (choice[:, None] == jnp.arange(free.shape[0])[None, :]) & (
+            choice[:, None] >= 0
+        )  # (P, N)
+        fits_after = jnp.ones_like(onehot)
+        for r in range(R):
+            prefix_r = jnp.cumsum(onehot * demand[:, r][:, None], axis=0)
+            fits_after &= prefix_r <= free[None, :, r]
+        admitted = (choice >= 0) & jnp.take_along_axis(
+            fits_after, jnp.maximum(choice, 0)[:, None], axis=1
+        ).squeeze(1)
+        new_assignment = jnp.where(admitted, choice, assignment)
+        winners = onehot & admitted[:, None]  # (P, N)
+        # per-resource masked sums (int64 matmul is unsupported on TPU)
+        used = jnp.stack(
+            [(winners * demand[:, r][:, None]).sum(axis=0) for r in range(R)],
+            axis=-1,
+        )  # (N, R)
+        return (free - used, new_assignment), admitted.sum()
+
+    (free, assignment), _ = jax.lax.scan(
+        wave, (free0, jnp.full(P, -1, jnp.int32)), None, length=max_waves
+    )
+    return assignment, free

@@ -1,0 +1,49 @@
+"""Resource-fit Filter as one batched comparison.
+
+Replaces the upstream NodeResourcesFit plugin body that the reference relies on
+(invoked per pod x per node by the scheduling framework; see SURVEY.md §3.2
+"Filter -> (upstream NodeResourcesFit etc., per node xN) <-HOT LOOP"): a pod
+fits a node iff for every resource `requested + podRequest <= allocatable`,
+plus the pod-count slot where each pod counts 1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.ops import PODS_I
+
+
+def free_capacity(alloc, requested):
+    """(N, R) leftover allocatable."""
+    return alloc - requested
+
+
+def pod_fit_demand(req):
+    """Pod demand vector(s) for fitting: the raw effective request with the
+    pod-count slot set to 1 (each pod occupies one pod slot)."""
+    req = jnp.asarray(req)
+    return req.at[..., PODS_I].set(1)
+
+
+def fits(req, free, pod_mask=None, node_mask=None):
+    """(P, R) requests vs (N, R) free capacity -> (P, N) feasibility.
+
+    `free` must already account for assigned pods (alloc - requested).
+    """
+    demand = pod_fit_demand(req)
+    ok = jnp.all(demand[:, None, :] <= free[None, :, :], axis=-1)
+    if pod_mask is not None:
+        ok &= pod_mask[:, None]
+    if node_mask is not None:
+        ok &= node_mask[None, :]
+    return ok
+
+
+def fits_one(req, free, node_mask=None):
+    """(R,) single-pod request vs (N, R) free -> (N,) feasibility (scan body)."""
+    demand = pod_fit_demand(req)
+    ok = jnp.all(demand[None, :] <= free, axis=-1)
+    if node_mask is not None:
+        ok &= node_mask
+    return ok

@@ -1,0 +1,71 @@
+"""Score normalization transforms shared across plugins.
+
+Each mirrors a specific reference normalizer bit-for-bit (integer division
+truncation included):
+- `minmax_normalize`  — NodeResourcesAllocatable.NormalizeScore
+  (/root/reference/pkg/noderesources/allocatable.go:143-168)
+- `default_normalize` — upstream helper.DefaultNormalizeScore used by SySched
+  and PodState (reverse=True flavors)
+- `peaks_normalize`   — Peaks.NormalizeScore inversion
+  (/root/reference/pkg/trimaran/peaks/peaks.go:152-168)
+
+All operate row-wise on (..., N) score arrays with an (..., N) validity mask
+(the mask plays the role of "which nodes made it into the NodeScoreList").
+Entries outside the mask are returned as 0.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.ops import MAX_NODE_SCORE, MIN_NODE_SCORE
+from scheduler_plugins_tpu.utils.intmath import masked_max, masked_min
+
+
+def minmax_normalize(scores, mask):
+    """((score - lowest) * 100 / oldRange) + MinNodeScore; all-MinNodeScore when
+    every score is equal (allocatable.go:155-166). Division is exact Go int
+    division (operands are non-negative here, so `//` matches)."""
+    scores = jnp.asarray(scores)
+    lo = masked_min(scores, mask, axis=-1, keepdims=True)
+    hi = masked_max(scores, mask, axis=-1, keepdims=True)
+    old_range = hi - lo
+    new_range = MAX_NODE_SCORE - MIN_NODE_SCORE
+    out = jnp.where(
+        old_range == 0,
+        MIN_NODE_SCORE,
+        (scores - lo) * new_range // jnp.maximum(old_range, 1) + MIN_NODE_SCORE,
+    )
+    return jnp.where(mask, out, 0)
+
+
+def default_normalize(scores, mask, reverse=False):
+    """Upstream helper.DefaultNormalizeScore: scale by max to [0,100]; when the
+    max is 0, scores become 0 (or all 100 when reversed)."""
+    scores = jnp.asarray(scores)
+    max_count = masked_max(scores, mask, axis=-1, keepdims=True)
+    max_count = jnp.maximum(max_count, 0)
+    scaled = scores * MAX_NODE_SCORE // jnp.maximum(max_count, 1)
+    out = jnp.where(max_count == 0, 0, scaled)
+    if reverse:
+        out = MAX_NODE_SCORE - out
+    return jnp.where(mask, out, 0)
+
+
+def peaks_normalize(scores, mask):
+    """Peaks inverted min-max: lowest power-jump wins (peaks.go:152-168).
+    The float multiply + int64 truncation of the Go code is preserved."""
+    scores = jnp.asarray(scores)
+    lo = masked_min(scores, mask, axis=-1, keepdims=True)
+    hi = masked_max(scores, mask, axis=-1, keepdims=True)
+    all_zero = (lo == 0) & (hi == 0)
+    norm = jnp.where(
+        hi != lo,
+        jnp.trunc(
+            MAX_NODE_SCORE * (scores - lo).astype(jnp.float64)
+            / jnp.maximum(hi - lo, 1).astype(jnp.float64)
+        ),
+        (scores - lo).astype(jnp.float64),
+    ).astype(jnp.int64)
+    out = jnp.where(all_zero, scores, MAX_NODE_SCORE - norm)
+    return jnp.where(mask, out, 0)

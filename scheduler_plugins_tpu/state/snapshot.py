@@ -1,0 +1,612 @@
+"""Dense-tensor cluster snapshot.
+
+The reference walks an object graph per pod x per node (NodeInfo lists,
+informer caches). Here the whole scheduling problem is lowered once per cycle
+into a pytree of dense int64/float64 arrays with static (bucketed) shapes:
+
+- nodes:   (N, R) allocatable / requested / non-zero-requested, region/zone
+           codes, per-node pod-state counters.
+- pods:    (P, R) effective requests for the *pending batch*, priority, QoS,
+           namespace / gang / app-group codes, queue-sort keys.
+- gangs:   (G,) PodGroup min-member / membership counts, (G, R) MinResources.
+- quota:   (Q, R) ElasticQuota min/max/used indexed by namespace code.
+- metrics: (N,) load-watcher utilisation mu/sigma percentages.
+- numa:    (N, Z, R) per-zone availability + topology-manager config codes.
+
+Name<->code mappings and resource-axis metadata live in `SnapshotMeta`, which
+is host-only and deliberately NOT part of the pytree, so jit sees only arrays
+(changing names never retriggers compilation; changing bucket sizes does).
+
+Quantities are int64 in reference units (SURVEY.md §7) — bit-identical
+placement needs integer compares, e.g.
+/root/reference/pkg/capacityscheduling/elasticquota.go:189-221.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+from flax import struct
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    ElasticQuota,
+    Node,
+    NodeResourceTopology,
+    Pod,
+    PodGroup,
+)
+from scheduler_plugins_tpu.api.resources import (
+    CPU,
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    MEMORY,
+    PODS,
+    ResourceIndex,
+)
+from scheduler_plugins_tpu.utils.intmath import bucket_size
+
+I64 = np.int64
+I32 = np.int32
+F64 = np.float64
+
+
+@struct.dataclass
+class NodeState:
+    alloc: np.ndarray  # (N, R) int64 allocatable
+    requested: np.ndarray  # (N, R) int64 sum of assigned pods' requests
+    nonzero_requested: np.ndarray  # (N, R) int64 with upstream non-zero defaults
+    mask: np.ndarray  # (N,) bool — real, schedulable node
+    region: np.ndarray  # (N,) int32 region code (-1 unset)
+    zone: np.ndarray  # (N,) int32 zone code (-1 unset)
+    pod_count: np.ndarray  # (N,) int32 assigned pods
+    terminating: np.ndarray  # (N,) int32 terminating pods (PodState score)
+    nominated: np.ndarray  # (N,) int32 nominated pods (PodState score)
+
+
+@struct.dataclass
+class PodState:
+    req: np.ndarray  # (P, R) int64 effective request (pods slot = 0)
+    priority: np.ndarray  # (P,) int64
+    ns: np.ndarray  # (P,) int32 namespace code
+    gang: np.ndarray  # (P,) int32 gang code (-1 = not in a PodGroup)
+    qos: np.ndarray  # (P,) int32 QOSClass
+    mask: np.ndarray  # (P,) bool
+    creation_ms: np.ndarray  # (P,) int64 queue-sort timestamp
+    gated: np.ndarray  # (P,) bool SchedulingGated
+
+
+@struct.dataclass
+class GangState:
+    """PodGroup bookkeeping (/root/reference/pkg/coscheduling/core/core.go)."""
+
+    min_member: np.ndarray  # (G,) int32
+    total_members: np.ndarray  # (G,) int32 siblings known cluster-wide
+    assigned: np.ndarray  # (G,) int32 already bound/running members
+    min_resources: np.ndarray  # (G, R) int64 whole-gang demand
+    has_min_resources: np.ndarray  # (G,) bool
+    creation_ms: np.ndarray  # (G,) int64 (failure-time override applied)
+    backed_off: np.ndarray  # (G,) bool recently rejected
+    mask: np.ndarray  # (G,) bool
+
+
+@struct.dataclass
+class QuotaState:
+    """ElasticQuota arrays indexed by namespace code
+    (/root/reference/pkg/capacityscheduling/elasticquota.go:34-87)."""
+
+    min: np.ndarray  # (Q, R) int64
+    max: np.ndarray  # (Q, R) int64
+    used: np.ndarray  # (Q, R) int64
+    has_quota: np.ndarray  # (Q,) bool namespace has an EQ
+
+
+@struct.dataclass
+class MetricsState:
+    """Load-watcher node metrics in percent of capacity
+    (/root/reference/pkg/trimaran/collector.go, resourcestats.go:33-107)."""
+
+    cpu_avg: np.ndarray  # (N,) float64 %
+    cpu_std: np.ndarray  # (N,) float64 %
+    mem_avg: np.ndarray  # (N,) float64 %
+    mem_std: np.ndarray  # (N,) float64 %
+    cpu_valid: np.ndarray  # (N,) bool
+    mem_valid: np.ndarray  # (N,) bool
+    #: predicted-but-unreported CPU millis per node (ScheduledPodsCache
+    #: compensation, /root/reference/pkg/trimaran/handler.go:47-171)
+    missing_cpu_millis: np.ndarray  # (N,) int64
+
+
+@struct.dataclass
+class NumaState:
+    """Per-node NUMA zones from NodeResourceTopology CRs
+    (/root/reference/pkg/noderesourcetopology/numaresources.go:32-103)."""
+
+    available: np.ndarray  # (N, Z, R) int64
+    allocatable: np.ndarray  # (N, Z, R) int64
+    zone_mask: np.ndarray  # (N, Z) bool
+    #: per-resource "zone reports this resource" mask — NUMA affinity only
+    #: applies to reported resources (numaresources.go:105-135)
+    reported: np.ndarray  # (N, Z, R) bool
+    policy: np.ndarray  # (N,) int32 TopologyManagerPolicy
+    scope: np.ndarray  # (N,) int32 TopologyManagerScope
+    distances: np.ndarray  # (N, Z, Z) int32 SLIT costs (default 10)
+    has_nrt: np.ndarray  # (N,) bool
+
+
+@struct.dataclass
+class ClusterSnapshot:
+    nodes: NodeState
+    pods: PodState
+    gangs: Optional[GangState] = None
+    quota: Optional[QuotaState] = None
+    metrics: Optional[MetricsState] = None
+    numa: Optional[NumaState] = None
+    network: Optional["NetworkState"] = None
+
+    @property
+    def num_nodes(self) -> int:
+        return self.nodes.alloc.shape[0]
+
+    @property
+    def num_pods(self) -> int:
+        return self.pods.req.shape[0]
+
+    @property
+    def num_resources(self) -> int:
+        return self.nodes.alloc.shape[1]
+
+
+@struct.dataclass
+class NetworkState:
+    """AppGroup dependency + topology cost tensors
+    (/root/reference/pkg/networkaware/networkoverhead/networkoverhead.go:448-638).
+
+    Costs between a candidate node and an already-placed dependency pod depend
+    only on (region, zone) codes, so placed pods aggregate into per-zone /
+    per-region counts and cost lookup is a small dense gather instead of a
+    per-pod map search.
+    """
+
+    dep_workload: np.ndarray  # (P, D) int32 workload code (-1 pad)
+    dep_max_cost: np.ndarray  # (P, D) int64
+    dep_mask: np.ndarray  # (P, D) bool
+    placed_node: np.ndarray  # (W, N) int32 placed dep pods per node
+    placed_zone: np.ndarray  # (W, ZC) int32 placed dep pods per zone code
+    placed_region: np.ndarray  # (W, RC) int32 placed dep pods per region code
+    placed_unlocated: np.ndarray  # (W,) int32 placed pods on nodes without region+zone
+    zone_cost: np.ndarray  # (ZC, ZC) int64 origin-zone -> dest-zone cost (-1 missing)
+    region_cost: np.ndarray  # (RC, RC) int64 origin-region -> dest-region cost (-1 missing)
+    same_zone_pairs: np.ndarray  # (ZC, ZC) bool — same-zone indicator
+    same_region_pairs: np.ndarray  # (RC, RC) bool
+
+
+@dataclass
+class SnapshotMeta:
+    """Host-only name<->code mappings for one snapshot."""
+
+    index: ResourceIndex
+    node_names: list[str] = field(default_factory=list)
+    pod_names: list[str] = field(default_factory=list)  # pending batch, queue order
+    namespaces: list[str] = field(default_factory=list)
+    gang_names: list[str] = field(default_factory=list)
+    regions: list[str] = field(default_factory=list)
+    zones: list[str] = field(default_factory=list)
+    workloads: list[str] = field(default_factory=list)
+
+    def node_id(self, name: str) -> int:
+        return self.node_names.index(name)
+
+    def ns_id(self, name: str) -> int:
+        return self.namespaces.index(name)
+
+
+class _Interner:
+    """O(1) name -> stable-code interning over a shared list."""
+
+    def __init__(self, table: list[str]):
+        self.table = table
+        self.pos = {name: i for i, name in enumerate(table)}
+
+    def code(self, name: str) -> int:
+        i = self.pos.get(name)
+        if i is None:
+            i = len(self.table)
+            self.table.append(name)
+            self.pos[name] = i
+        return i
+
+    def get(self, name: str) -> int:
+        """Code for `name`, or -1 if never interned."""
+        return self.pos.get(name, -1)
+
+
+def nonzero_request(req: np.ndarray, index: ResourceIndex) -> np.ndarray:
+    """Apply the upstream non-zero defaults used for scoring accounting:
+    pods without cpu/memory requests are charged 100m / 200Mi."""
+    out = req.copy()
+    cpu_i = index.position(CPU)
+    mem_i = index.position(MEMORY)
+    if out[cpu_i] == 0:
+        out[cpu_i] = DEFAULT_MILLI_CPU_REQUEST
+    if out[mem_i] == 0:
+        out[mem_i] = DEFAULT_MEMORY_REQUEST
+    return out
+
+
+def build_snapshot(
+    nodes: Sequence[Node],
+    pending_pods: Sequence[Pod],
+    assigned_pods: Sequence[Pod] = (),
+    pod_groups: Sequence[PodGroup] = (),
+    quotas: Sequence[ElasticQuota] = (),
+    nrts: Sequence[NodeResourceTopology] = (),
+    app_groups: Sequence[AppGroup] = (),
+    node_metrics: Optional[dict] = None,
+    extra_resources: Sequence[str] = (),
+    pad_nodes: Optional[int] = None,
+    pad_pods: Optional[int] = None,
+    backed_off_gangs: Sequence[str] = (),
+) -> tuple[ClusterSnapshot, SnapshotMeta]:
+    """Lower host objects into a `ClusterSnapshot`.
+
+    `pending_pods` become the pod batch (in the given order — queue order is
+    decided by the framework before calling this). `assigned_pods` only
+    contribute to node usage / gang+quota accounting.
+    """
+    index = ResourceIndex.union(
+        {r: 0 for r in extra_resources},
+        *[n.allocatable for n in nodes],
+        *[pg.min_resources for pg in pod_groups],
+        *[q.min for q in quotas],
+        *[q.max for q in quotas],
+        *[p.effective_request() for p in list(pending_pods) + list(assigned_pods)],
+        *[z.available for t in nrts for z in t.zones],
+        *[z.allocatable for t in nrts for z in t.zones],
+    )
+    R = len(index)
+    N = pad_nodes or bucket_size(max(len(nodes), 1))
+    P = pad_pods or bucket_size(max(len(pending_pods), 1))
+
+    meta = SnapshotMeta(index=index)
+    meta.node_names = [n.name for n in nodes]
+    meta.pod_names = [p.uid for p in pending_pods]
+    regions_in = _Interner(meta.regions)
+    zones_in = _Interner(meta.zones)
+    ns_in = _Interner(meta.namespaces)
+    gangs_in = _Interner(meta.gang_names)
+
+    # --- nodes ---------------------------------------------------------
+    alloc = np.zeros((N, R), I64)
+    requested = np.zeros((N, R), I64)
+    nonzero_req = np.zeros((N, R), I64)
+    node_mask = np.zeros(N, bool)
+    region = np.full(N, -1, I32)
+    zone = np.full(N, -1, I32)
+    pod_count = np.zeros(N, I32)
+    terminating = np.zeros(N, I32)
+    nominated = np.zeros(N, I32)
+
+    node_pos = {}
+    for i, node in enumerate(nodes):
+        node_pos[node.name] = i
+        alloc[i] = index.encode(node.allocatable)
+        node_mask[i] = not node.unschedulable
+        if node.region:
+            region[i] = regions_in.code(node.region)
+        if node.zone:
+            zone[i] = zones_in.code(node.zone)
+
+    for pod in assigned_pods:
+        target = pod.nominated_node_name if pod.node_name is None else pod.node_name
+        if target is None or target not in node_pos:
+            continue
+        i = node_pos[target]
+        if pod.node_name is None:
+            nominated[i] += 1
+            continue
+        req = index.encode(pod.effective_request())
+        requested[i] += req
+        nonzero_req[i] += nonzero_request(req, index)
+        pod_count[i] += 1
+        if pod.terminating:
+            terminating[i] += 1
+
+    # the "pods" resource is accounted as a count, not a request sum
+    pods_i = index.position(PODS)
+    requested[:, pods_i] = pod_count
+    nonzero_req[:, pods_i] = pod_count
+
+    node_state = NodeState(
+        alloc=alloc,
+        requested=requested,
+        nonzero_requested=nonzero_req,
+        mask=node_mask,
+        region=region,
+        zone=zone,
+        pod_count=pod_count,
+        terminating=terminating,
+        nominated=nominated,
+    )
+
+    # --- gangs ---------------------------------------------------------
+    gang_pos = {}
+    for pg in pod_groups:
+        gang_pos[pg.full_name] = gangs_in.code(pg.full_name)
+    G = max(len(gang_pos), 1)
+    gang_min = np.ones(G, I32)
+    gang_total = np.zeros(G, I32)
+    gang_assigned = np.zeros(G, I32)
+    gang_minres = np.zeros((G, R), I64)
+    gang_has_minres = np.zeros(G, bool)
+    gang_created = np.zeros(G, I64)
+    gang_backoff = np.zeros(G, bool)
+    gang_mask = np.zeros(G, bool)
+    for pg in pod_groups:
+        g = gang_pos[pg.full_name]
+        gang_mask[g] = True
+        gang_min[g] = pg.min_member
+        gang_created[g] = pg.creation_ms
+        gang_backoff[g] = pg.full_name in backed_off_gangs
+        if pg.min_resources:
+            gang_minres[g] = index.encode(pg.min_resources)
+            gang_has_minres[g] = True
+
+    def _gang_of(pod: Pod) -> int:
+        name = pod.pod_group()
+        if not name:
+            return -1
+        return gang_pos.get(f"{pod.namespace}/{name}", -1)
+
+    for pod in list(pending_pods) + list(assigned_pods):
+        g = _gang_of(pod)
+        if g >= 0:
+            gang_total[g] += 1
+            if pod.node_name is not None:
+                gang_assigned[g] += 1
+
+    gang_state = (
+        GangState(
+            min_member=gang_min,
+            total_members=gang_total,
+            assigned=gang_assigned,
+            min_resources=gang_minres,
+            has_min_resources=gang_has_minres,
+            creation_ms=gang_created,
+            backed_off=gang_backoff,
+            mask=gang_mask,
+        )
+        if pod_groups
+        else None
+    )
+
+    # --- pods (pending batch) -----------------------------------------
+    preq = np.zeros((P, R), I64)
+    ppriority = np.zeros(P, I64)
+    pns = np.zeros(P, I32)
+    pgang = np.full(P, -1, I32)
+    pqos = np.zeros(P, I32)
+    pmask = np.zeros(P, bool)
+    pcreated = np.zeros(P, I64)
+    pgated = np.zeros(P, bool)
+    for i, pod in enumerate(pending_pods):
+        preq[i] = index.encode(pod.effective_request())
+        ppriority[i] = pod.priority
+        pns[i] = ns_in.code(pod.namespace)
+        pgang[i] = _gang_of(pod)
+        pqos[i] = int(pod.qos_class())
+        pmask[i] = True
+        pcreated[i] = pod.creation_ms
+        pgated[i] = pod.scheduling_gated
+    pod_state = PodState(
+        req=preq,
+        priority=ppriority,
+        ns=pns,
+        gang=pgang,
+        qos=pqos,
+        mask=pmask,
+        creation_ms=pcreated,
+        gated=pgated,
+    )
+
+    # --- quota ---------------------------------------------------------
+    quota_state = None
+    if quotas:
+        for q in quotas:
+            ns_in.code(q.namespace)
+        for pod in assigned_pods:
+            ns_in.code(pod.namespace)
+        Q = max(len(meta.namespaces), 1)
+        qmin = np.zeros((Q, R), I64)
+        qmax = np.full((Q, R), np.iinfo(I64).max, I64)
+        qused = np.zeros((Q, R), I64)
+        qhas = np.zeros(Q, bool)
+        for q in quotas:
+            nsi = ns_in.get(q.namespace)
+            qhas[nsi] = True
+            qmin[nsi] = index.encode(q.min)
+            # absent resources in Max are unbounded (UpperBound semantics,
+            # /root/reference/pkg/capacityscheduling/elasticquota.go:96-120)
+            qmax[nsi] = index.encode(q.max, default=np.iinfo(I64).max)
+        for pod in assigned_pods:
+            if pod.node_name is None:
+                continue
+            nsi = ns_in.get(pod.namespace)
+            if qhas[nsi]:
+                qused[nsi] += index.encode(pod.effective_request())
+        quota_state = QuotaState(min=qmin, max=qmax, used=qused, has_quota=qhas)
+
+    # --- metrics --------------------------------------------------------
+    metrics_state = None
+    if node_metrics is not None:
+        cpu_avg = np.zeros(N, F64)
+        cpu_std = np.zeros(N, F64)
+        mem_avg = np.zeros(N, F64)
+        mem_std = np.zeros(N, F64)
+        cpu_valid = np.zeros(N, bool)
+        mem_valid = np.zeros(N, bool)
+        missing = np.zeros(N, I64)
+        for name, m in node_metrics.items():
+            if name not in node_pos:
+                continue
+            i = node_pos[name]
+            if "cpu_avg" in m:
+                cpu_avg[i] = m["cpu_avg"]
+                cpu_valid[i] = True
+            cpu_std[i] = m.get("cpu_std", 0.0)
+            if "mem_avg" in m:
+                mem_avg[i] = m["mem_avg"]
+                mem_valid[i] = True
+            mem_std[i] = m.get("mem_std", 0.0)
+            missing[i] = m.get("missing_cpu_millis", 0)
+        metrics_state = MetricsState(
+            cpu_avg=cpu_avg,
+            cpu_std=cpu_std,
+            mem_avg=mem_avg,
+            mem_std=mem_std,
+            cpu_valid=cpu_valid,
+            mem_valid=mem_valid,
+            missing_cpu_millis=missing,
+        )
+
+    # --- numa -----------------------------------------------------------
+    numa_state = None
+    if nrts:
+        # zone axis is indexed by NUMA id (zones lists may arrive unordered;
+        # costs are keyed by numa_id, so both axes must share the id space)
+        Z = max(
+            max((z.numa_id + 1 for t in nrts for z in t.zones), default=1), 1
+        )
+        z_avail = np.zeros((N, Z, R), I64)
+        z_alloc = np.zeros((N, Z, R), I64)
+        z_mask = np.zeros((N, Z), bool)
+        z_reported = np.zeros((N, Z, R), bool)
+        policy = np.zeros(N, I32)
+        scope = np.zeros(N, I32)
+        distances = np.full((N, Z, Z), 10, I32)
+        has_nrt = np.zeros(N, bool)
+        for t in nrts:
+            if t.node_name not in node_pos:
+                continue
+            i = node_pos[t.node_name]
+            has_nrt[i] = True
+            policy[i] = int(t.policy)
+            scope[i] = int(t.scope)
+            for zinfo in t.zones:
+                z = zinfo.numa_id
+                z_mask[i, z] = True
+                z_avail[i, z] = index.encode(zinfo.available)
+                z_alloc[i, z] = index.encode(zinfo.allocatable)
+                for rname in zinfo.available:
+                    z_reported[i, z, index.position(rname)] = True
+                for other, cost in zinfo.costs.items():
+                    if other < Z:
+                        distances[i, z, other] = cost
+        numa_state = NumaState(
+            available=z_avail,
+            allocatable=z_alloc,
+            zone_mask=z_mask,
+            reported=z_reported,
+            policy=policy,
+            scope=scope,
+            distances=distances,
+            has_nrt=has_nrt,
+        )
+
+    snapshot = ClusterSnapshot(
+        nodes=node_state,
+        pods=pod_state,
+        gangs=gang_state,
+        quota=quota_state,
+        metrics=metrics_state,
+        numa=numa_state,
+        network=_build_network(
+            app_groups, pending_pods, assigned_pods, node_pos, region, zone, meta, P
+        )
+        if app_groups
+        else None,
+    )
+    # hand jit-ready device arrays to callers (numpy is build-time only;
+    # tracer indexing inside lax.scan requires jax arrays)
+    import jax
+    import jax.numpy as jnp
+
+    snapshot = jax.tree.map(jnp.asarray, snapshot)
+    return snapshot, meta
+
+
+def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zone, meta, P):
+    """Lower AppGroup dependencies + placed-pod locations into NetworkState.
+    Cost matrices are attached later by the NetworkOverhead plugin config
+    (they come from the NetworkTopology CR, not the AppGroup)."""
+    # intern workload selectors
+    workloads_in = _Interner(meta.workloads)
+    dep_lists = {}  # workload code -> [(dep workload code, max cost)]
+    for ag in app_groups:
+        for w in ag.workloads:
+            wc = workloads_in.code(f"{ag.namespace}/{w.selector}")
+            dep_lists[wc] = [
+                (workloads_in.code(f"{ag.namespace}/{d.workload_selector}"), d.max_network_cost)
+                for d in w.dependencies
+            ]
+    W = max(len(meta.workloads), 1)
+    D = max(max((len(v) for v in dep_lists.values()), default=1), 1)
+    ZC = max(len(meta.zones), 1)
+    RC = max(len(meta.regions), 1)
+    N = region.shape[0]
+
+    dep_workload = np.full((P, D), -1, I32)
+    dep_max_cost = np.zeros((P, D), I64)
+    dep_mask = np.zeros((P, D), bool)
+    for i, pod in enumerate(pending_pods):
+        sel = pod.workload_selector()
+        key = f"{pod.namespace}/{sel}"
+        wc = workloads_in.get(key) if sel else -1
+        if wc < 0:
+            continue
+        deps = dep_lists.get(wc, [])
+        for d, (dw, mc) in enumerate(deps):
+            dep_workload[i, d] = dw
+            dep_max_cost[i, d] = mc
+            dep_mask[i, d] = True
+
+    placed_node = np.zeros((W, N), I32)
+    placed_zone = np.zeros((W, ZC), I32)
+    placed_region = np.zeros((W, RC), I32)
+    placed_unlocated = np.zeros(W, I32)
+    for pod in assigned_pods:
+        sel = pod.workload_selector()
+        if not sel or pod.node_name not in node_pos:
+            continue
+        key = f"{pod.namespace}/{sel}"
+        wc = workloads_in.get(key)
+        if wc < 0:
+            continue
+        ni = node_pos[pod.node_name]
+        placed_node[wc, ni] += 1
+        r, z = region[ni], zone[ni]
+        if r < 0 and z < 0:
+            placed_unlocated[wc] += 1
+        else:
+            if z >= 0:
+                placed_zone[wc, z] += 1
+            if r >= 0:
+                placed_region[wc, r] += 1
+
+    eye_z = np.eye(ZC, dtype=bool)
+    eye_r = np.eye(RC, dtype=bool)
+    return NetworkState(
+        dep_workload=dep_workload,
+        dep_max_cost=dep_max_cost,
+        dep_mask=dep_mask,
+        placed_node=placed_node,
+        placed_zone=placed_zone,
+        placed_region=placed_region,
+        placed_unlocated=placed_unlocated,
+        zone_cost=np.full((ZC, ZC), -1, I64),
+        region_cost=np.full((RC, RC), -1, I64),
+        same_zone_pairs=eye_z,
+        same_region_pairs=eye_r,
+    )

@@ -1,0 +1,8 @@
+"""Shared helpers: Go-faithful integer math, masked reductions, padding."""
+
+from scheduler_plugins_tpu.utils.intmath import (  # noqa: F401
+    go_div,
+    masked_max,
+    masked_min,
+    round_half_away,
+)

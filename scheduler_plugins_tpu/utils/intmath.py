@@ -1,0 +1,64 @@
+"""Go-faithful integer/float math primitives.
+
+Bit-identical placement requires matching Go's arithmetic conventions exactly
+(SURVEY.md §7 "hard parts"):
+- Go integer division truncates toward zero; Python/JAX `//` floors. Matters
+  whenever a score can be negative (e.g. Least-mode allocatable scores,
+  /root/reference/pkg/noderesources/allocatable.go:126).
+- Go `math.Round` rounds half away from zero; `jnp.round` rounds half-to-even.
+- Masked min/max must mirror the "iterate the score list" loops
+  (e.g. /root/reference/pkg/noderesources/allocatable.go:143-157).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def go_div(a, b):
+    """Integer division truncating toward zero (Go semantics), b > 0."""
+    a = jnp.asarray(a)
+    q = jnp.abs(a) // b
+    return jnp.where(a < 0, -q, q).astype(a.dtype)
+
+
+def round_half_away(x):
+    """Go `math.Round`: round half away from zero, as int64."""
+    x = jnp.asarray(x)
+    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
+
+
+_I64_MAX = jnp.int64(2**63 - 1)
+_I64_MIN = jnp.int64(-(2**63))
+
+
+def masked_min(scores, mask, axis=-1, keepdims=False):
+    """Min over `mask`-selected entries; int64 max where mask is empty
+    (mirrors `lowest := math.MaxInt64` loop initialisation)."""
+    return jnp.min(jnp.where(mask, scores, _I64_MAX), axis=axis, keepdims=keepdims)
+
+
+def masked_max(scores, mask, axis=-1, keepdims=False):
+    """Max over `mask`-selected entries; int64 min where mask is empty."""
+    return jnp.max(jnp.where(mask, scores, _I64_MIN), axis=axis, keepdims=keepdims)
+
+
+def pad_axis(arr, target: int, axis: int = 0, fill=0):
+    """Pad `arr` along `axis` to length `target` with `fill` (numpy or jnp)."""
+    length = arr.shape[axis]
+    if length == target:
+        return arr
+    if length > target:
+        raise ValueError(f"cannot pad axis of length {length} down to {target}")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - length)
+    return jnp.pad(arr, widths, constant_values=fill)
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket for static-shape padding (SURVEY.md §7:
+    dynamic pod/node counts vs XLA static shapes)."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size

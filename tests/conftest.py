@@ -1,0 +1,21 @@
+"""Test bootstrap: force an 8-device virtual CPU platform so multi-chip
+sharding tests run anywhere (the real TPU bench path is exercised by bench.py,
+not the unit suite).
+
+Note: the environment may pre-register an accelerator backend and pin
+`jax_platforms` via config (which wins over env vars), so we override the
+config after import, before any backend is initialized.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

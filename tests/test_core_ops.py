@@ -1,0 +1,176 @@
+"""Core-op decision tables: fit, allocatable score, normalizers, greedy/wave
+assignment. These are the JAX golden tests mirroring the reference unit-test
+style (SURVEY.md §4 implication (a))."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.ops.allocatable import (
+    MODE_LEAST,
+    MODE_MOST,
+    allocatable_score_matrix,
+    allocatable_scores,
+)
+from scheduler_plugins_tpu.ops.assign import greedy_assign, wave_assign
+from scheduler_plugins_tpu.ops.fit import fits, free_capacity
+from scheduler_plugins_tpu.ops.normalize import (
+    default_normalize,
+    minmax_normalize,
+    peaks_normalize,
+)
+
+# resource axis: cpu, memory, ephemeral, pods
+def vec(cpu=0, mem=0, eph=0, pods=0):
+    return [cpu, mem, eph, pods]
+
+
+class TestFit:
+    def test_basic_fit_matrix(self):
+        alloc = jnp.array([vec(1000, 100, pods=10), vec(500, 100, pods=10)], jnp.int64)
+        requested = jnp.array([vec(800, 0), vec(0, 0)], jnp.int64)
+        free = free_capacity(alloc, requested)
+        req = jnp.array([vec(300, 50), vec(100, 50)], jnp.int64)
+        ok = fits(req, free)
+        # pod0 (300 cpu) doesn't fit node0 (200 free), fits node1
+        assert ok.tolist() == [[False, True], [True, True]]
+
+    def test_pod_slot_counts_one(self):
+        alloc = jnp.array([vec(1000, 100, pods=1)], jnp.int64)
+        requested = jnp.array([vec(0, 0, pods=1)], jnp.int64)  # node full on pods
+        free = free_capacity(alloc, requested)
+        ok = fits(jnp.array([vec(1, 1)], jnp.int64), free)
+        assert not bool(ok[0, 0])
+
+    def test_masks(self):
+        alloc = jnp.ones((2, 4), jnp.int64) * 1000
+        free = alloc
+        req = jnp.ones((2, 4), jnp.int64)
+        ok = fits(req, free, pod_mask=jnp.array([True, False]),
+                  node_mask=jnp.array([False, True]))
+        assert ok.tolist() == [[False, True], [False, False]]
+
+
+class TestAllocatable:
+    # weights: cpu 1<<20, mem 1 — resource_allocation.go:36
+    weights = jnp.array([1 << 20, 1, 0, 0], jnp.int64)
+
+    def test_least_mode_prefers_smaller_node(self):
+        alloc = jnp.array([vec(4000, 8 << 30), vec(2000, 4 << 30)], jnp.int64)
+        raw = allocatable_scores(alloc, self.weights, MODE_LEAST)
+        assert raw[1] > raw[0]  # less allocatable -> higher (less negative)
+
+    def test_exact_weighted_division(self):
+        # nodeScore = (-1*cpu*2^20 + -1*mem*1) / (2^20+1), Go trunc division
+        alloc = jnp.array([vec(1000, 500)], jnp.int64)
+        raw = allocatable_scores(alloc, self.weights, MODE_LEAST)
+        expected = -((1000 * (1 << 20) + 500) // ((1 << 20) + 1))
+        assert int(raw[0]) == expected
+
+    def test_most_mode_matrix_normalized(self):
+        alloc = jnp.array(
+            [vec(4000, 8 << 30), vec(2000, 4 << 30), vec(1000, 2 << 30)], jnp.int64
+        )
+        feasible = jnp.ones((2, 3), bool)
+        m = allocatable_score_matrix(alloc, self.weights, MODE_MOST, feasible)
+        assert m.shape == (2, 3)
+        assert m[0].tolist() == [100, 33, 0]  # min-max over row
+
+    def test_single_feasible_node_scores_zero_range(self):
+        alloc = jnp.array([vec(4000, 8 << 30), vec(2000, 4 << 30)], jnp.int64)
+        feasible = jnp.array([[True, False]])
+        m = allocatable_score_matrix(alloc, self.weights, MODE_LEAST, feasible)
+        assert int(m[0, 0]) == 0  # oldRange==0 -> MinNodeScore
+
+
+class TestNormalizers:
+    def test_minmax(self):
+        s = jnp.array([[10, 20, 30]], jnp.int64)
+        out = minmax_normalize(s, jnp.ones((1, 3), bool))
+        assert out.tolist() == [[0, 50, 100]]
+
+    def test_minmax_respects_mask(self):
+        s = jnp.array([[10, 20, 99999]], jnp.int64)
+        out = minmax_normalize(s, jnp.array([[True, True, False]]))
+        assert out.tolist() == [[0, 100, 0]]
+
+    def test_default_normalize_reverse(self):
+        s = jnp.array([[0, 5, 10]], jnp.int64)
+        out = default_normalize(s, jnp.ones((1, 3), bool), reverse=True)
+        assert out.tolist() == [[100, 50, 0]]
+
+    def test_default_normalize_zero_max(self):
+        s = jnp.zeros((1, 3), jnp.int64)
+        assert default_normalize(s, jnp.ones((1, 3), bool)).tolist() == [[0, 0, 0]]
+        assert default_normalize(
+            s, jnp.ones((1, 3), bool), reverse=True
+        ).tolist() == [[100, 100, 100]]
+
+    def test_peaks_inverts(self):
+        s = jnp.array([[5, 10, 15]], jnp.int64)
+        out = peaks_normalize(s, jnp.ones((1, 3), bool))
+        assert out.tolist() == [[100, 50, 0]]
+
+    def test_peaks_all_zero_passthrough(self):
+        s = jnp.zeros((1, 2), jnp.int64)
+        out = peaks_normalize(s, jnp.ones((1, 2), bool))
+        assert out.tolist() == [[0, 0]]
+
+
+def simple_step_fn(req, node_mask):
+    """Filter = fit, Score = remaining cpu (most-free-cpu wins)."""
+
+    def step(free, p):
+        from scheduler_plugins_tpu.ops.fit import fits_one
+
+        feasible = fits_one(req[p], free, node_mask)
+        return feasible, free[:, 0]
+
+    return step
+
+
+class TestAssign:
+    def test_greedy_sequential_updates_capacity(self):
+        # 2 nodes x 1000 cpu; 3 pods x 600 -> n0, n1, unschedulable
+        free0 = jnp.array([vec(1000, 10, 0, 10), vec(1000, 10, 0, 10)], jnp.int64)
+        req = jnp.array([vec(600, 1)] * 3, jnp.int64)
+        mask = jnp.ones(3, bool)
+        step = simple_step_fn(req, jnp.ones(2, bool))
+        assignment, free = greedy_assign(step, req, mask, free0)
+        assert assignment.tolist() == [0, 1, -1]
+        assert free[0, 0] == 400 and free[1, 0] == 400
+
+    def test_greedy_tiebreak_lowest_index(self):
+        free0 = jnp.full((3, 4), 1000, jnp.int64)
+        req = jnp.array([vec(100, 1)], jnp.int64)
+        step = simple_step_fn(req, jnp.ones(3, bool))
+        assignment, _ = greedy_assign(step, req, jnp.ones(1, bool), free0)
+        assert int(assignment[0]) == 0
+
+    def test_wave_matches_greedy_on_spread(self):
+        free0 = jnp.array([vec(1000, 10, 0, 10), vec(900, 10, 0, 10)], jnp.int64)
+        req = jnp.array([vec(600, 1), vec(600, 1)], jnp.int64)
+
+        def batch_fn(free, active):
+            ok = jnp.all(
+                req.at[:, 3].set(1)[:, None, :] <= free[None, :, :], axis=-1
+            )
+            scores = jnp.broadcast_to(free[None, :, 0], ok.shape)
+            return ok, scores
+
+        assignment, free = wave_assign(batch_fn, req, jnp.ones(2, bool), free0)
+        assert assignment.tolist() == [0, 1]
+
+    def test_wave_queue_order_conflict_resolution(self):
+        # one node, capacity for exactly one pod: queue head wins, second
+        # becomes unschedulable (no capacity anywhere)
+        free0 = jnp.array([vec(700, 10, 0, 10)], jnp.int64)
+        req = jnp.array([vec(600, 1), vec(600, 1)], jnp.int64)
+
+        def batch_fn(free, active):
+            ok = jnp.all(
+                req.at[:, 3].set(1)[:, None, :] <= free[None, :, :], axis=-1
+            )
+            return ok, jnp.zeros(ok.shape, jnp.int64)
+
+        assignment, _ = wave_assign(batch_fn, req, jnp.ones(2, bool), free0)
+        assert assignment.tolist() == [0, -1]

@@ -1,0 +1,164 @@
+"""Foundation tests: resource encoding, pod-derived quantities, integer math,
+snapshot lowering."""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    Pod,
+    PodGroup,
+    QOSClass,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS, ResourceIndex
+from scheduler_plugins_tpu.state.snapshot import build_snapshot
+from scheduler_plugins_tpu.utils.intmath import go_div, round_half_away
+
+
+def mkpod(name, cpu=0, mem=0, node=None, **kw):
+    requests = {}
+    if cpu:
+        requests[CPU] = cpu
+    if mem:
+        requests[MEMORY] = mem
+    return Pod(name=name, containers=[Container(requests=requests)], node_name=node, **kw)
+
+
+class TestResourceIndex:
+    def test_canonical_order_is_fixed(self):
+        idx = ResourceIndex(["nvidia.com/gpu"])
+        assert idx.names[:4] == (CPU, MEMORY, "ephemeral-storage", PODS)
+        assert idx.position("nvidia.com/gpu") == 4
+
+    def test_encode_decode_roundtrip(self):
+        idx = ResourceIndex(["nvidia.com/gpu"])
+        vec = idx.encode({CPU: 4000, "nvidia.com/gpu": 2})
+        assert vec.dtype == np.int64
+        assert idx.decode(vec) == {CPU: 4000, "nvidia.com/gpu": 2}
+
+    def test_unknown_resource_raises(self):
+        with pytest.raises(KeyError):
+            ResourceIndex().encode({"bogus": 1})
+
+    def test_union(self):
+        idx = ResourceIndex.union({CPU: 1}, {"hugepages-2Mi": 5})
+        assert "hugepages-2Mi" in idx
+
+
+class TestPodDerived:
+    def test_effective_request_max_of_init_and_main(self):
+        # /root/reference/pkg/util/resource.go:51-85 semantics
+        pod = Pod(
+            name="p",
+            containers=[
+                Container(requests={CPU: 100}),
+                Container(requests={CPU: 200}),
+            ],
+            init_containers=[Container(requests={CPU: 500})],
+            overhead={CPU: 10},
+        )
+        assert pod.effective_request()[CPU] == 510  # max(300, 500) + 10
+
+    def test_init_containers_are_plain_max(self):
+        # reference GetPodEffectiveRequest has no sidecar special-casing:
+        # init demand is a plain per-resource max (resource.go:55-62)
+        pod = Pod(
+            name="p",
+            containers=[Container(requests={CPU: 100})],
+            init_containers=[
+                Container(requests={CPU: 50}, restart_policy_always=True),
+                Container(requests={CPU: 400}),
+            ],
+        )
+        assert pod.effective_request()[CPU] == 400
+
+    def test_qos_guaranteed_is_aggregate(self):
+        # upstream GetPodQOS compares aggregate request/limit sums: A(req 100,
+        # lim 110) + B(req 110, lim 100) sums to 210==210 -> Guaranteed
+        pod = Pod(
+            name="p",
+            containers=[
+                Container(requests={CPU: 100, MEMORY: 10}, limits={CPU: 110, MEMORY: 10}),
+                Container(requests={CPU: 110, MEMORY: 10}, limits={CPU: 100, MEMORY: 10}),
+            ],
+        )
+        assert pod.qos_class() == QOSClass.GUARANTEED
+
+    def test_qos_missing_limit_not_guaranteed(self):
+        pod = Pod(
+            name="p",
+            containers=[Container(requests={CPU: 100}, limits={CPU: 100})],
+        )
+        assert pod.qos_class() == QOSClass.BURSTABLE  # no memory limit
+
+    def test_qos_classes(self):
+        best_effort = Pod(name="b", containers=[Container()])
+        assert best_effort.qos_class() == QOSClass.BEST_EFFORT
+        burstable = mkpod("u", cpu=100)
+        assert burstable.qos_class() == QOSClass.BURSTABLE
+        guaranteed = Pod(
+            name="g",
+            containers=[
+                Container(requests={CPU: 100, MEMORY: 10}, limits={CPU: 100, MEMORY: 10})
+            ],
+        )
+        assert guaranteed.qos_class() == QOSClass.GUARANTEED
+
+
+class TestIntMath:
+    def test_go_div_truncates_toward_zero(self):
+        assert int(go_div(np.int64(-7), np.int64(2))) == -3  # Python // gives -4
+        assert int(go_div(np.int64(7), np.int64(2))) == 3
+
+    def test_round_half_away(self):
+        assert int(round_half_away(0.5)) == 1
+        assert int(round_half_away(-0.5)) == -1
+        assert int(round_half_away(2.4)) == 2
+
+
+class TestSnapshot:
+    def test_basic_shapes_and_padding(self):
+        nodes = [Node(name=f"n{i}", allocatable={CPU: 4000, MEMORY: 8 << 30, PODS: 110}) for i in range(3)]
+        pods = [mkpod(f"p{i}", cpu=100, mem=1 << 20) for i in range(5)]
+        snap, meta = build_snapshot(nodes, pods)
+        assert snap.num_nodes == 8  # bucketed
+        assert snap.num_pods == 8
+        assert snap.nodes.mask.sum() == 3
+        assert snap.pods.mask.sum() == 5
+        assert meta.node_names == ["n0", "n1", "n2"]
+
+    def test_assigned_pods_accumulate_into_requested(self):
+        nodes = [Node(name="n0", allocatable={CPU: 4000, MEMORY: 8 << 30, PODS: 110})]
+        assigned = [mkpod("a1", cpu=300, mem=1 << 20, node="n0"),
+                    mkpod("a2", cpu=200, mem=1 << 20, node="n0")]
+        snap, meta = build_snapshot(nodes, [mkpod("p0", cpu=1)], assigned_pods=assigned)
+        i = meta.index.position(CPU)
+        assert snap.nodes.requested[0, i] == 500
+        assert snap.nodes.pod_count[0] == 2
+        # pods-slot carries the count
+        assert snap.nodes.requested[0, meta.index.position(PODS)] == 2
+
+    def test_gang_membership_counts(self):
+        from scheduler_plugins_tpu.api.objects import POD_GROUP_LABEL
+
+        nodes = [Node(name="n0", allocatable={CPU: 1000})]
+        pg = PodGroup(name="g", namespace="ns", min_member=3)
+        members = [
+            Pod(
+                name=f"m{i}",
+                namespace="ns",
+                containers=[Container(requests={CPU: 10})],
+                labels={POD_GROUP_LABEL: "g"},
+                node_name="n0" if i == 0 else None,
+            )
+            for i in range(3)
+        ]
+        snap, meta = build_snapshot(
+            nodes, members[1:], assigned_pods=members[:1], pod_groups=[pg]
+        )
+        assert snap.gangs is not None
+        assert snap.gangs.total_members[0] == 3
+        assert snap.gangs.assigned[0] == 1
+        assert snap.gangs.min_member[0] == 3
+        assert snap.pods.gang[0] == 0 and snap.pods.gang[1] == 0
