@@ -1,0 +1,156 @@
+"""One full scheduling cycle: queue -> snapshot -> jitted solve -> apply.
+
+Host-side application of the solve result reproduces the reference's Permit /
+PostFilter machinery (/root/reference/pkg/coscheduling/coscheduling.go:162-274):
+
+- assigned & quorum met        -> bind immediately (Permit Success); also
+  releases previously-waiting siblings (IterateOverWaitingPods...Allow).
+- assigned & quorum unmet      -> reserve (Permit Wait) with the gang deadline
+  = PodGroup.ScheduleTimeoutSeconds or the plugin's PermitWaitingTimeSeconds.
+- unschedulable gang member    -> PostFilter: if the gang can still reach
+  quorum within the reject-percentage slack, let the rest retry; otherwise
+  reject the whole gang — release reservations, record failure time (queue
+  demotion), back off the group.
+- expired gang deadline        -> same whole-gang rejection path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from scheduler_plugins_tpu.framework.runtime import Scheduler, now_ms as _now_ms
+from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+
+@dataclass
+class CycleReport:
+    bound: dict[str, str] = field(default_factory=dict)  # uid -> node
+    reserved: dict[str, str] = field(default_factory=dict)
+    failed: list[str] = field(default_factory=list)
+    rejected_gangs: list[str] = field(default_factory=list)
+    expired_gangs: list[str] = field(default_factory=list)
+
+
+def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) -> CycleReport:
+    if now is None:
+        now = _now_ms()
+    report = CycleReport()
+    cosched = next(
+        (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)), None
+    )
+
+    _expire_gangs(cluster, now, report)
+
+    pending = cluster.pending_pods()
+    if not pending:
+        return report
+    pending = scheduler.sort_pending(pending, cluster)
+
+    snap, meta = cluster.snapshot(pending, now_ms=now)
+    scheduler.prepare(meta)
+    result = scheduler.solve(snap)
+
+    assignment = np.asarray(result.assignment)
+    admitted = np.asarray(result.admitted)
+    wait = np.asarray(result.wait)
+
+    failed_by_gang: dict[str, list[str]] = {}
+    for i, pod in enumerate(pending):
+        node_idx = int(assignment[i])
+        pg = cluster.pod_group_of(pod)
+        if node_idx < 0 or not admitted[i]:
+            report.failed.append(pod.uid)
+            if pg is not None:
+                failed_by_gang.setdefault(pg.full_name, []).append(pod.uid)
+            continue
+        node_name = meta.node_names[node_idx]
+        if wait[i]:
+            cluster.reserve(pod.uid, node_name)
+            report.reserved[pod.uid] = node_name
+            if pg is not None and pg.full_name not in cluster.gang_deadline_ms:
+                timeout_s = pg.schedule_timeout_seconds
+                if timeout_s is None and cosched is not None:
+                    timeout_s = cosched.permit_waiting_seconds
+                cluster.gang_deadline_ms[pg.full_name] = now + 1000 * (timeout_s or 0)
+        else:
+            cluster.bind(pod.uid, node_name)
+            report.bound[pod.uid] = node_name
+
+    # Permit Allow fan-out: quorum reached this cycle releases waiting siblings
+    for pg in list(cluster.pod_groups.values()):
+        _maybe_release_gang(cluster, pg, report)
+
+    # PostFilter: whole-gang rejection (coscheduling.go:160-209)
+    for gang_name in failed_by_gang:
+        pg = cluster.pod_groups.get(gang_name)
+        if pg is None:
+            continue
+        members = cluster.gang_members(pg)
+        assigned = sum(
+            1 for p in members if p.node_name is not None or p.uid in cluster.reserved
+        )
+        if assigned >= pg.min_member:
+            continue  # quorum already met; stragglers can retry freely
+        # tolerate a small quorum gap: (MinMember - assigned)/MinMember
+        # <= rejectPercentage (coscheduling.go:180-185)
+        reject_pct = cosched.reject_percentage if cosched else 10
+        gap = (pg.min_member - assigned) / max(pg.min_member, 1)
+        if gap <= reject_pct / 100:
+            continue  # a subsequent pod may still complete the quorum
+        _reject_gang(cluster, pg, now, report, cosched, len(members))
+
+    return report
+
+
+def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport):
+    reserved = cluster.gang_reservations(pg)
+    if not reserved:
+        return
+    bound = sum(
+        1
+        for p in cluster.gang_members(pg)
+        if p.node_name is not None
+    )
+    if bound + len(reserved) >= pg.min_member:
+        for uid in reserved:
+            node = cluster.reserved[uid]
+            cluster.bind(uid, node)
+            report.bound[uid] = node
+            report.reserved.pop(uid, None)
+        cluster.gang_deadline_ms.pop(pg.full_name, None)
+
+
+def _reject_gang(cluster: Cluster, pg, now: int, report: CycleReport, cosched, member_count: int):
+    """Reject every waiting sibling, record failure time, back off the group
+    (coscheduling.go:188-209, core.go:174-192). Backoff applies only when the
+    gang has at least MinMember sibling pods (coscheduling.go:196-204) —
+    an incomplete gang must retry as soon as its members appear."""
+    for uid in cluster.gang_reservations(pg):
+        cluster.release_reservation(uid)
+        report.reserved.pop(uid, None)
+    cluster.gang_deadline_ms.pop(pg.full_name, None)
+    cluster.gang_last_failure_ms[pg.full_name] = now
+    backoff_s = cosched.pod_group_backoff_seconds if cosched else 0
+    if backoff_s > 0 and member_count >= pg.min_member:
+        cluster.gang_backoff_until_ms[pg.full_name] = now + 1000 * backoff_s
+    report.rejected_gangs.append(pg.full_name)
+
+
+def _expire_gangs(cluster: Cluster, now: int, report: CycleReport):
+    """Permit timeout: waiting gangs past their deadline are rejected
+    (the upstream waitingPods timer firing Reject)."""
+    for gang_name, deadline in list(cluster.gang_deadline_ms.items()):
+        if now < deadline:
+            continue
+        pg = cluster.pod_groups.get(gang_name)
+        if pg is None:
+            cluster.gang_deadline_ms.pop(gang_name, None)
+            continue
+        for uid in cluster.gang_reservations(pg):
+            cluster.release_reservation(uid)
+        cluster.gang_deadline_ms.pop(gang_name, None)
+        cluster.gang_last_failure_ms[gang_name] = now
+        report.expired_gangs.append(gang_name)

@@ -1,0 +1,86 @@
+"""Plugin trait layer — the tensor equivalent of the `fwk.*Plugin` interfaces.
+
+The reference's extension points receive (pod, nodeInfo) pairs one at a time
+(/root/reference/pkg/coscheduling/coscheduling.go:49-55 asserts the interface
+set per plugin). Here each extension point is a masked tensor transformation
+evaluated inside the jitted solve:
+
+- `admit`       PreFilter verdict for one pod: scalar bool (reject before the
+                node sweep).
+- `filter`      (N,) node feasibility for one pod.
+- `score`       (N,) raw int64 node scores for one pod.
+- `normalize`   per-pod transform of the raw scores over feasible nodes.
+- `commit`      Reserve: fold the chosen placement into the SolverState carried
+                through the scan (quota usage, gang counts, NUMA deductions).
+- `queue_key`   host-side QueueSort key for a Pod object (lower sorts first).
+
+All tensor methods run under jit and must be pure; `prepare(meta)` is called
+once per snapshot layout so plugins can bake resource-axis-aligned constants
+(e.g. the allocatable weight vector).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+from scheduler_plugins_tpu.state.snapshot import ClusterSnapshot, SnapshotMeta
+
+
+@struct.dataclass
+class SolverState:
+    """Mutable-across-pods solver state, carried through the assignment scan.
+
+    `free` mirrors NodeInfo leftover capacity; `eq_used` mirrors the
+    ElasticQuotaInfos usage map; `gang_scheduled` counts members placed in
+    this cycle (assumed, pre-bind) per gang.
+    """
+
+    free: jnp.ndarray  # (N, R) int64
+    eq_used: Optional[jnp.ndarray] = None  # (Q, R) int64
+    gang_scheduled: Optional[jnp.ndarray] = None  # (G,) int32
+    #: (G, R) demand placed by each gang earlier in this scan — added back in
+    #: the MinResources cluster check (the gang's own pods don't count
+    #: against it, core.go:433-467)
+    gang_inflight: Optional[jnp.ndarray] = None
+
+
+class Plugin:
+    """Base plugin: every method is optional; `None` means "not implemented
+    at this extension point" and costs nothing in the fused solve."""
+
+    name: str = "Plugin"
+    #: score weight, the framework multiplies normalized scores by this
+    #: (upstream plugin weights in the profile config).
+    weight: int = 1
+
+    def prepare(self, meta: SnapshotMeta) -> None:
+        """Bake per-snapshot-layout constants (resource weights, arg vectors)."""
+
+    # --- host-side -------------------------------------------------------
+    def queue_key(self, pod, cluster):  # pragma: no cover - trivial default
+        """QueueSort key component for `pod`; tuples compare lexicographically."""
+        return None
+
+    # --- jitted ----------------------------------------------------------
+    def admit(self, state: SolverState, snap: ClusterSnapshot, p):
+        """PreFilter: scalar bool verdict for pod index `p` (tracer)."""
+        return None
+
+    def filter(self, state: SolverState, snap: ClusterSnapshot, p):
+        """Filter: (N,) bool feasibility for pod `p` against current state."""
+        return None
+
+    def score(self, state: SolverState, snap: ClusterSnapshot, p):
+        """Score: (N,) int64 raw scores for pod `p`."""
+        return None
+
+    def normalize(self, scores, feasible):
+        """NormalizeScore: transform (N,) raw scores over the feasible mask."""
+        return scores
+
+    def commit(self, state: SolverState, snap: ClusterSnapshot, p, choice):
+        """Reserve: fold `choice` (node index or -1) into the carried state."""
+        return state

@@ -1,0 +1,179 @@
+"""The scheduling cycle: fuse enabled plugins into one jitted batched solve.
+
+Reference dataflow per pending pod (SURVEY.md §1): QueueSort -> PreFilter ->
+Filter(xnodes) -> PreScore -> Score(xnodes) -> Normalize -> Reserve -> Permit.
+Here the whole pending batch runs as a single `lax.scan` whose body evaluates
+every enabled plugin's tensor contribution for one pod against the carried
+SolverState (free capacity, quota usage, gang counts), then commits the chosen
+node before the next pod — preserving the reference's one-pod-at-a-time
+semantics while keeping each step fully vectorized over nodes.
+
+Permit is evaluated after the scan as a segment reduction over gangs
+(quorum = assigned-before + scheduled-this-cycle >= MinMember), mirroring
+/root/reference/pkg/coscheduling/core/core.go:308-345; the host shell
+(`Scheduler.schedule`) then binds, parks, or rejects.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from scheduler_plugins_tpu.framework.plugin import Plugin, SolverState
+from scheduler_plugins_tpu.ops.fit import fits_one, free_capacity, pod_fit_demand
+from scheduler_plugins_tpu.state.snapshot import ClusterSnapshot, SnapshotMeta
+
+
+@struct.dataclass
+class SolveResult:
+    assignment: jnp.ndarray  # (P,) int32 node index, -1 unschedulable
+    admitted: jnp.ndarray  # (P,) bool PreFilter verdict
+    wait: jnp.ndarray  # (P,) bool Permit said Wait (gang quorum unmet)
+    state: SolverState  # final carried state
+
+
+@dataclass
+class Profile:
+    """An enabled-plugin set, the equivalent of one KubeSchedulerConfiguration
+    profile (SURVEY.md §5 config system)."""
+
+    plugins: Sequence[Plugin] = field(default_factory=list)
+    #: queue-sort plugin; None selects the first enabled plugin that overrides
+    #: `queue_key` (a profile enables exactly one QueueSort upstream), falling
+    #: back to upstream PrioritySort semantics
+    queue_sort: Optional[Plugin] = None
+    name: str = "tpu-scheduler"
+
+    def __post_init__(self):
+        if self.queue_sort is None:
+            for plugin in self.plugins:
+                if type(plugin).queue_key is not Plugin.queue_key:
+                    self.queue_sort = plugin
+                    break
+
+
+class Scheduler:
+    """Host shell around the jitted solve.
+
+    Owns nothing but the profile; cluster state comes in as a snapshot and
+    decisions go back to the caller (the `state.cluster.Cluster` store drives
+    bind/park/reject)."""
+
+    def __init__(self, profile: Profile):
+        self.profile = profile
+        self._solve_cache = {}
+
+    # -- queue ----------------------------------------------------------
+    def sort_pending(self, pods, cluster=None):
+        """QueueSort: order the pending list with the profile's comparator
+        (default: upstream PrioritySort — priority desc, then queue time)."""
+        qs = self.profile.queue_sort
+
+        def key(pod):
+            if qs is not None:
+                k = qs.queue_key(pod, cluster)
+                if k is not None:
+                    return k
+            return (-pod.priority, pod.creation_ms, f"{pod.namespace}/{pod.name}")
+
+        return sorted(pods, key=key)
+
+    # -- solve ----------------------------------------------------------
+    def prepare(self, meta: SnapshotMeta):
+        for plugin in self.profile.plugins:
+            plugin.prepare(meta)
+
+    def _make_solve(self):
+        plugins = tuple(self.profile.plugins)
+
+        def step(carry, p, snap: ClusterSnapshot):
+            state = carry
+            # PreFilter
+            ok = snap.pods.mask[p] & ~snap.pods.gated[p]
+            for plugin in plugins:
+                verdict = plugin.admit(state, snap, p)
+                if verdict is not None:
+                    ok &= verdict
+            # Filter: built-in resource fit + plugin filters
+            feasible = fits_one(snap.pods.req[p], state.free, snap.nodes.mask)
+            for plugin in plugins:
+                mask = plugin.filter(state, snap, p)
+                if mask is not None:
+                    feasible &= mask
+            feasible &= ok
+            # Score + Normalize, weighted sum
+            total = jnp.zeros(state.free.shape[0], jnp.int64)
+            for plugin in plugins:
+                raw = plugin.score(state, snap, p)
+                if raw is not None:
+                    total = total + plugin.weight * plugin.normalize(raw, feasible)
+            # select: argmax score among feasible, lowest index tie-break
+            masked = jnp.where(feasible, total, jnp.int64(-(2**62)))
+            choice = jnp.where(
+                feasible.any(), jnp.argmax(masked).astype(jnp.int32), jnp.int32(-1)
+            )
+            # built-in Reserve: commit capacity
+            demand = pod_fit_demand(snap.pods.req[p])
+            onehot = (jnp.arange(state.free.shape[0]) == choice)[:, None]
+            state = state.replace(
+                free=state.free - jnp.where(choice >= 0, onehot * demand[None, :], 0)
+            )
+            for plugin in plugins:
+                state = plugin.commit(state, snap, p, choice)
+            return state, (choice, ok)
+
+        def solve(snap: ClusterSnapshot, state0: SolverState) -> SolveResult:
+            P = snap.num_pods
+            state, (assignment, admitted) = jax.lax.scan(
+                lambda c, p: step(c, p, snap), state0, jnp.arange(P)
+            )
+            wait = jnp.zeros(P, bool)
+            if snap.gangs is not None and state.gang_scheduled is not None:
+                # Permit quorum: previously-assigned + this cycle's placements
+                total_per_gang = snap.gangs.assigned + state.gang_scheduled
+                quorum = total_per_gang >= snap.gangs.min_member
+                gang = snap.pods.gang
+                in_gang = gang >= 0
+                pod_quorum = jnp.where(
+                    in_gang, quorum[jnp.maximum(gang, 0)], True
+                )
+                wait = (assignment >= 0) & ~pod_quorum
+            return SolveResult(
+                assignment=assignment, admitted=admitted, wait=wait, state=state
+            )
+
+        return jax.jit(solve)
+
+    def solve(self, snap: ClusterSnapshot, state0: Optional[SolverState] = None):
+        """Run the fused plugin pipeline over the snapshot's pending batch."""
+        if state0 is None:
+            state0 = self.initial_state(snap)
+        key = "solve"
+        if key not in self._solve_cache:
+            self._solve_cache[key] = self._make_solve()
+        return self._solve_cache[key](snap, state0)
+
+    def initial_state(self, snap: ClusterSnapshot) -> SolverState:
+        free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+        eq_used = snap.quota.used if snap.quota is not None else None
+        gang_sched = None
+        gang_inflight = None
+        if snap.gangs is not None:
+            G = snap.gangs.min_member.shape[0]
+            gang_sched = jnp.zeros(G, jnp.int32)
+            gang_inflight = jnp.zeros((G, snap.num_resources), jnp.int64)
+        return SolverState(
+            free=free,
+            eq_used=eq_used,
+            gang_scheduled=gang_sched,
+            gang_inflight=gang_inflight,
+        )
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)

@@ -1,0 +1,70 @@
+"""PodGroup (gang) admission math.
+
+Reference PreFilter (/root/reference/pkg/coscheduling/core/core.go:243-305):
+reject a gang member when (a) the group was recently backed off, (b) fewer
+siblings exist cluster-wide than MinMember, (c) too many siblings are
+SchedulingGated to ever reach quorum, or (d) MinResources (with the pods slot
+set to MinMember, core.go:295-297) exceeds whole-cluster free capacity
+(`CheckClusterResource`, core.go:404-426).
+
+The cluster sweep (d) subtracts each node's RAW leftover (alloc - requested,
+possibly negative — no clamping, core.go:406-426) from the demand vector with
+the gang's own pods added back (getNodeResource, core.go:433-467). Raw
+subtraction makes the check separable per resource:
+
+    demand_r <= sum_n free_nr + (own assigned members' demand)_r
+                + (own in-cycle placements' demand)_r
+
+The pre-cycle own-member term is `gangs.cluster_slack` (snapshot builder);
+the in-cycle term is `SolverState.gang_inflight`, accumulated by the
+Coscheduling commit as members place during the scan (standing in for the
+reference's permittedPG memoization, core.go:286-288).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cluster_free_total(free):
+    """(R,) whole-cluster leftover: raw per-node sums, negatives included
+    (core.go:406-426 subtracts unclamped leftovers from the demand)."""
+    return jnp.sum(free, axis=0)
+
+
+def gang_admit(gangs, state_free, gang_id, inflight=None):
+    """Scalar admission verdict for one gang-member pod.
+
+    gangs: GangState arrays; state_free: (N, R) current free capacity;
+    gang_id: scalar gang code (-1 = not in a gang -> always pass);
+    inflight: optional (G, R) demand committed by this gang earlier in the
+    scan (added back, since the gang's own pods don't count against it).
+    """
+    in_gang = gang_id >= 0
+    g = jnp.maximum(gang_id, 0)
+    enough_members = gangs.total_members[g] >= gangs.min_member[g]
+    not_backed_off = ~gangs.backed_off[g]
+    # gated siblings can never reach quorum (core.go:268-277)
+    reachable = gangs.total_members[g] - gangs.gated[g] >= gangs.min_member[g]
+    capacity = cluster_free_total(state_free) + gangs.cluster_slack[g]
+    if inflight is not None:
+        capacity = capacity + inflight[g]
+    fits_cluster = jnp.all(gangs.min_resources[g] <= capacity)
+    minres_ok = ~gangs.has_min_resources[g] | fits_cluster
+    verdict = enough_members & not_backed_off & reachable & minres_ok
+    return jnp.where(in_gang, verdict, True)
+
+
+def gang_commit(gang_scheduled, gang_id, placed):
+    """Count an in-cycle placement toward the gang's quorum."""
+    g = jnp.maximum(gang_id, 0)
+    return gang_scheduled.at[g].add(
+        jnp.where(placed & (gang_id >= 0), 1, 0).astype(gang_scheduled.dtype)
+    )
+
+
+def gang_inflight_commit(gang_inflight, gang_id, demand, placed):
+    """Fold a placed member's demand into its gang's in-cycle add-back."""
+    g = jnp.maximum(gang_id, 0)
+    add = jnp.where(placed & (gang_id >= 0), demand, 0)
+    return gang_inflight.at[g].add(add)

@@ -1,0 +1,42 @@
+"""ElasticQuota admission math.
+
+Reference PreFilter rejects (/root/reference/pkg/capacityscheduling/
+capacity_scheduling.go:208-282, comparators elasticquota.go:96-221):
+
+1. `usedOverMaxWith`: own-namespace used + request exceeds Max in any
+   resource (absent Max entries are unbounded — the snapshot builder encodes
+   them as int64 max).
+2. `aggregatedUsedOverMinWith`: sum of used over ALL ElasticQuotas + request
+   exceeds the sum of Min in any resource (the cluster's guaranteed pool is
+   exhausted; absent Min entries are 0).
+
+The nominated-pod aggregates the reference folds in (lines 228-263) are the
+preemption-nomination feedback loop; they are added by the preemption engine
+once nominations exist in the snapshot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def quota_admit(eq_used, eq_min, eq_max, has_quota, ns, req):
+    """Scalar admission verdict for one pod.
+
+    eq_used/eq_min/eq_max: (Q, R); has_quota: (Q,); ns: scalar namespace code;
+    req: (R,) pod effective request. Pods in namespaces without an EQ pass
+    (capacity_scheduling.go:218-224).
+    """
+    used_ns = eq_used[ns]
+    over_max = jnp.any(used_ns + req > eq_max[ns])
+    agg_used = jnp.sum(jnp.where(has_quota[:, None], eq_used, 0), axis=0)
+    agg_min = jnp.sum(jnp.where(has_quota[:, None], eq_min, 0), axis=0)
+    over_min = jnp.any(agg_used + req > agg_min)
+    return jnp.where(has_quota[ns], ~(over_max | over_min), True)
+
+
+def quota_commit(eq_used, has_quota, ns, req, placed):
+    """Reserve: add `req` to the namespace's usage when the pod placed
+    (capacity_scheduling.go:350-368)."""
+    add = jnp.where(placed & has_quota[ns], req, 0)
+    return eq_used.at[ns].add(add)

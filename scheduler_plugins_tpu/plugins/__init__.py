@@ -1,0 +1,18 @@
+"""The plugin suite — TPU-native re-designs of every plugin the reference
+ships in its scheduler binary (/root/reference/cmd/scheduler/main.go:50-67):
+
+Coscheduling, CapacityScheduling, NodeResourcesAllocatable,
+NodeResourceTopologyMatch, TargetLoadPacking, LoadVariationRiskBalancing,
+LowRiskOverCommitment, Peaks, NetworkOverhead, TopologicalSort,
+PreemptionToleration, SySched, PodState, QOSSort.
+"""
+
+from scheduler_plugins_tpu.plugins.capacityscheduling import (  # noqa: F401
+    CapacityScheduling,
+)
+from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling  # noqa: F401
+from scheduler_plugins_tpu.plugins.noderesources import (  # noqa: F401
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.plugins.podstate import PodState  # noqa: F401
+from scheduler_plugins_tpu.plugins.qos import QOSSort  # noqa: F401

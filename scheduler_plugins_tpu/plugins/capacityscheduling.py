@@ -1,0 +1,46 @@
+"""CapacityScheduling — per-namespace elastic quota enforcement.
+
+Reference: /root/reference/pkg/capacityscheduling (PreFilter with AddPod/
+RemovePod extensions, quota-aware preemption PostFilter, Reserve/Unreserve —
+capacity_scheduling.go:101-105).
+
+TPU mapping: the EQ snapshot becomes the (Q, R) `eq_used` array carried
+through the solve; PreFilter's two rejects (over own Max, aggregate over
+cluster Min) are `ops.quota.quota_admit`; Reserve is `quota_commit` on the
+scan carry. Quota-aware preemption is provided by the preemption engine
+(plugins/preemption.py) using the same borrow rules.
+"""
+
+from __future__ import annotations
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.quota import quota_admit, quota_commit
+
+
+class CapacityScheduling(Plugin):
+    name = "CapacityScheduling"
+
+    def admit(self, state, snap, p):
+        if snap.quota is None or state.eq_used is None:
+            return None
+        return quota_admit(
+            state.eq_used,
+            snap.quota.min,
+            snap.quota.max,
+            snap.quota.has_quota,
+            snap.pods.ns[p],
+            snap.pods.req[p],
+        )
+
+    def commit(self, state, snap, p, choice):
+        if snap.quota is None or state.eq_used is None:
+            return state
+        return state.replace(
+            eq_used=quota_commit(
+                state.eq_used,
+                snap.quota.has_quota,
+                snap.pods.ns[p],
+                snap.pods.req[p],
+                choice >= 0,
+            )
+        )

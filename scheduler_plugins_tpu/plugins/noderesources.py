@@ -1,0 +1,59 @@
+"""NodeResourcesAllocatable — Score-only plugin favoring nodes with the least
+(or most) total allocatable, weighted per resource.
+
+Reference: /root/reference/pkg/noderesources/allocatable.go:42-168,
+resource_allocation.go:30-48. Score depends only on node allocatables, so the
+raw vector is computed once per snapshot layout and broadcast per pod; the
+min-max normalization runs over each pod's feasible set.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.allocatable import (
+    MODE_LEAST,
+    MODE_MOST,
+    allocatable_scores,
+)
+from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+
+#: default weights: a millicore weighs as much as 1 MiB
+#: (resource_allocation.go:36)
+DEFAULT_RESOURCES = ((CPU, 1 << 20), (MEMORY, 1))
+
+
+class NodeResourcesAllocatable(Plugin):
+    name = "NodeResourcesAllocatable"
+
+    def __init__(
+        self,
+        resources: Sequence[tuple[str, int]] = DEFAULT_RESOURCES,
+        mode: str = "Least",
+    ):
+        if mode not in ("Least", "Most"):
+            raise ValueError(f"invalid mode {mode!r}")  # validation_pluginargs.go:60-75
+        for _, weight in resources:
+            if weight <= 0:
+                raise ValueError("resource weight must be positive")
+        self.resources = tuple(resources)
+        self.mode_sign = MODE_LEAST if mode == "Least" else MODE_MOST
+        self._weights: Optional[jnp.ndarray] = None
+
+    def prepare(self, meta):
+        w = np.zeros(len(meta.index), np.int64)
+        for name, weight in self.resources:
+            if name in meta.index:
+                w[meta.index.position(name)] = weight
+        self._weights = jnp.asarray(w)
+
+    def score(self, state, snap, p):
+        return allocatable_scores(snap.nodes.alloc, self._weights, self.mode_sign)
+
+    def normalize(self, scores, feasible):
+        return minmax_normalize(scores, feasible)

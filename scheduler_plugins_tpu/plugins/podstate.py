@@ -1,0 +1,22 @@
+"""PodState — Score-only plugin favoring nodes that are freeing capacity.
+
+Reference: /root/reference/pkg/podstate/pod_state.go:40-90 —
+score = #terminating pods − #nominated pods per node, then the same min-max
+normalization as Allocatable. Terminating/nominated counts are snapshot
+columns, so the score matrix is one subtraction.
+"""
+
+from __future__ import annotations
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+
+
+class PodState(Plugin):
+    name = "PodState"
+
+    def score(self, state, snap, p):
+        return (snap.nodes.terminating - snap.nodes.nominated).astype("int64")
+
+    def normalize(self, scores, feasible):
+        return minmax_normalize(scores, feasible)

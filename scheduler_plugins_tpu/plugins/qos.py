@@ -1,0 +1,18 @@
+"""QOSSort — QueueSort plugin: priority desc, then QoS class
+(Guaranteed > Burstable > BestEffort), then queue timestamp.
+
+Reference: /root/reference/pkg/qos/queue_sort.go:42-84.
+"""
+
+from __future__ import annotations
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+
+
+class QOSSort(Plugin):
+    name = "QOSSort"
+
+    def queue_key(self, pod, cluster):
+        # tuples sort ascending: negate priority and QoS precedence
+        return (-pod.priority, -int(pod.qos_class()), pod.creation_ms,
+                f"{pod.namespace}/{pod.name}")

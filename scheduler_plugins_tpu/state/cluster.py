@@ -1,0 +1,167 @@
+"""Mutable host-side cluster store.
+
+The event-driven shell the reference builds out of client-go informers +
+plugin-local caches (SURVEY.md §1 dataflow): object upserts/deletes come in,
+snapshots go out. Also owns the scheduling-runtime bookkeeping that must not
+live on-device: Permit reservations (waiting pods), gang deadlines, backoff and
+failure times (/root/reference/pkg/coscheduling/core/core.go:134-192).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    ElasticQuota,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PriorityClass,
+    SeccompProfile,
+)
+from scheduler_plugins_tpu.state.snapshot import build_snapshot
+
+
+@dataclass
+class Cluster:
+    nodes: dict[str, Node] = field(default_factory=dict)
+    pods: dict[str, Pod] = field(default_factory=dict)  # keyed by uid
+    pod_groups: dict[str, PodGroup] = field(default_factory=dict)  # ns/name
+    quotas: dict[str, ElasticQuota] = field(default_factory=dict)  # namespace
+    nrts: dict[str, NodeResourceTopology] = field(default_factory=dict)
+    app_groups: dict[str, AppGroup] = field(default_factory=dict)
+    network_topologies: dict[str, NetworkTopology] = field(default_factory=dict)
+    seccomp_profiles: dict[str, SeccompProfile] = field(default_factory=dict)
+    priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
+    node_metrics: Optional[dict] = None
+
+    # scheduling-runtime bookkeeping (host-only)
+    reserved: dict[str, str] = field(default_factory=dict)  # uid -> node
+    gang_deadline_ms: dict[str, int] = field(default_factory=dict)
+    gang_backoff_until_ms: dict[str, int] = field(default_factory=dict)
+    gang_last_failure_ms: dict[str, int] = field(default_factory=dict)
+
+    # -- upserts ---------------------------------------------------------
+    def add_node(self, node: Node):
+        self.nodes[node.name] = node
+
+    def remove_node(self, name: str):
+        self.nodes.pop(name, None)
+
+    def add_pod(self, pod: Pod):
+        self.pods[pod.uid] = pod
+
+    def remove_pod(self, uid: str):
+        self.reserved.pop(uid, None)
+        self.pods.pop(uid, None)
+
+    def add_pod_group(self, pg: PodGroup):
+        self.pod_groups[pg.full_name] = pg
+
+    def add_quota(self, eq: ElasticQuota):
+        self.quotas[eq.namespace] = eq
+
+    def add_nrt(self, nrt: NodeResourceTopology):
+        self.nrts[nrt.node_name] = nrt
+
+    def add_app_group(self, ag: AppGroup):
+        self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
+
+    # -- derived ---------------------------------------------------------
+    def pod_group_of(self, pod: Pod) -> Optional[PodGroup]:
+        name = pod.pod_group()
+        if not name:
+            return None
+        return self.pod_groups.get(f"{pod.namespace}/{name}")
+
+    def gang_sort_time(self, pg: PodGroup) -> int:
+        """Queue-sort timestamp for a gang: last schedule-failure time when
+        set (defeats head-of-line blocking, core.go:365-384), else creation."""
+        return self.gang_last_failure_ms.get(pg.full_name, pg.creation_ms)
+
+    def gang_members(self, pg: PodGroup) -> list[Pod]:
+        return [
+            p
+            for p in self.pods.values()
+            if p.namespace == pg.namespace
+            and p.pod_group() == pg.name
+        ]
+
+    def pending_pods(self) -> list[Pod]:
+        """Schedulable queue: gated pods stay out (upstream keeps them off
+        activeQ entirely — they are neither attempted nor reported failed)."""
+        return [
+            p
+            for p in self.pods.values()
+            if p.node_name is None
+            and p.uid not in self.reserved
+            and p.phase == PodPhase.PENDING
+            and not p.terminating
+            and not p.scheduling_gated
+        ]
+
+    def gated_pods(self) -> list[Pod]:
+        return [
+            p
+            for p in self.pods.values()
+            if p.node_name is None and p.scheduling_gated and not p.terminating
+        ]
+
+    # -- binding / reservations -----------------------------------------
+    def bind(self, uid: str, node_name: str):
+        self.reserved.pop(uid, None)
+        self.pods[uid].node_name = node_name
+
+    def reserve(self, uid: str, node_name: str):
+        """Permit said Wait: hold the placement without binding."""
+        self.reserved[uid] = node_name
+
+    def release_reservation(self, uid: str):
+        self.reserved.pop(uid, None)
+
+    def gang_reservations(self, pg: PodGroup) -> list[str]:
+        return [
+            uid
+            for uid, _ in self.reserved.items()
+            if (p := self.pods.get(uid)) is not None
+            and p.namespace == pg.namespace
+            and p.pod_group() == pg.name
+        ]
+
+    # -- snapshot --------------------------------------------------------
+    def snapshot(self, pending: list[Pod], now_ms: int = 0, **kwargs):
+        """Lower current state for the solver. Reserved (permit-waiting) pods
+        count as assigned to their reserved node — they hold capacity and
+        quorum exactly like the reference's waiting pods in assignedPodsByPG."""
+        assigned = [p for p in self.pods.values() if p.node_name is not None]
+        for uid, node in self.reserved.items():
+            pod = self.pods.get(uid)
+            if pod is not None and pod.node_name is None:
+                import copy
+
+                held = copy.copy(pod)
+                held.node_name = node
+                assigned.append(held)
+        backed_off = [
+            name
+            for name, until in self.gang_backoff_until_ms.items()
+            if until > now_ms
+        ]
+        return build_snapshot(
+            list(self.nodes.values()),
+            pending,
+            assigned_pods=assigned,
+            pod_groups=list(self.pod_groups.values()),
+            quotas=list(self.quotas.values()),
+            nrts=list(self.nrts.values()),
+            app_groups=list(self.app_groups.values()),
+            node_metrics=self.node_metrics,
+            backed_off_gangs=backed_off,
+            extra_pods=self.gated_pods(),
+            **kwargs,
+        )

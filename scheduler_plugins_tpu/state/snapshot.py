@@ -85,10 +85,15 @@ class GangState:
     min_member: np.ndarray  # (G,) int32
     total_members: np.ndarray  # (G,) int32 siblings known cluster-wide
     assigned: np.ndarray  # (G,) int32 already bound/running members
+    gated: np.ndarray  # (G,) int32 SchedulingGated siblings
     min_resources: np.ndarray  # (G, R) int64 whole-gang demand
     has_min_resources: np.ndarray  # (G,) bool
     creation_ms: np.ndarray  # (G,) int64 (failure-time override applied)
     backed_off: np.ndarray  # (G,) bool recently rejected
+    #: (G, R) extra whole-cluster capacity visible to this gang's
+    #: CheckClusterResource because its own assigned pods are added back
+    #: (core.go:433-467 getNodeResource removes the gang's pods)
+    cluster_slack: np.ndarray  # (G, R) int64
     mask: np.ndarray  # (G,) bool
 
 
@@ -249,12 +254,15 @@ def build_snapshot(
     pad_nodes: Optional[int] = None,
     pad_pods: Optional[int] = None,
     backed_off_gangs: Sequence[str] = (),
+    extra_pods: Sequence[Pod] = (),
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
     `pending_pods` become the pod batch (in the given order — queue order is
     decided by the framework before calling this). `assigned_pods` only
-    contribute to node usage / gang+quota accounting.
+    contribute to node usage / gang+quota accounting. `extra_pods` are pods
+    that are neither schedulable nor assigned (e.g. SchedulingGated) but still
+    count toward gang membership and gated-quorum accounting.
     """
     index = ResourceIndex.union(
         {r: 0 for r in extra_resources},
@@ -360,22 +368,42 @@ def build_snapshot(
             return -1
         return gang_pos.get(f"{pod.namespace}/{name}", -1)
 
-    for pod in list(pending_pods) + list(assigned_pods):
+    # MinResources demand includes a pods slot of MinMember
+    # (core.go:295-297 injects minResources[pods] = MinMember)
+    for pg in pod_groups:
+        g = gang_pos[pg.full_name]
+        if gang_has_minres[g]:
+            gang_minres[g, pods_i] = pg.min_member
+
+    gang_gated = np.zeros(G, I32)
+    # cluster_slack[g] = total demand of already-assigned members, added back
+    # in the cluster sweep (getNodeResource removes the gang's own pods,
+    # core.go:433-467; raw sums make the correction a plain total)
+    gang_slack = np.zeros((G, R), I64)
+    for pod in list(pending_pods) + list(assigned_pods) + list(extra_pods):
         g = _gang_of(pod)
         if g >= 0:
             gang_total[g] += 1
             if pod.node_name is not None:
                 gang_assigned[g] += 1
+                if pod.node_name in node_pos:
+                    vec = index.encode(pod.effective_request())
+                    vec[pods_i] = 1
+                    gang_slack[g] += vec
+            elif pod.scheduling_gated:
+                gang_gated[g] += 1
 
     gang_state = (
         GangState(
             min_member=gang_min,
             total_members=gang_total,
             assigned=gang_assigned,
+            gated=gang_gated,
             min_resources=gang_minres,
             has_min_resources=gang_has_minres,
             creation_ms=gang_created,
             backed_off=gang_backoff,
+            cluster_slack=gang_slack,
             mask=gang_mask,
         )
         if pod_groups

@@ -1,0 +1,270 @@
+"""Framework runtime tests: full cycles through Scheduler + Cluster, mirroring
+the reference's integration scenarios (gang success/wait/timeout/backoff —
+test/integration/coscheduling_test.go; quota enforcement —
+capacity_scheduling_test.go) against an in-process fake cluster."""
+
+import pytest
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    ElasticQuota,
+    Node,
+    Pod,
+    PodGroup,
+    POD_GROUP_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import (
+    CapacityScheduling,
+    Coscheduling,
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+
+def mknode(name, cpu=10_000, mem=32 << 30, pods=110, **kw):
+    return Node(name=name, allocatable={CPU: cpu, MEMORY: mem, PODS: pods}, **kw)
+
+
+def mkpod(name, cpu=100, mem=1 << 20, ns="default", gang=None, **kw):
+    labels = dict(kw.pop("labels", {}))
+    if gang:
+        labels[POD_GROUP_LABEL] = gang
+    return Pod(
+        name=name,
+        namespace=ns,
+        containers=[Container(requests={CPU: cpu, MEMORY: mem})],
+        labels=labels,
+        **kw,
+    )
+
+
+def default_scheduler(*extra):
+    return Scheduler(
+        Profile(plugins=[NodeResourcesAllocatable(), *extra])
+    )
+
+
+class TestBasicCycle:
+    def test_binds_pending_pods(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_node(mknode("n1", cpu=2000))
+        for i in range(3):
+            cluster.add_pod(mkpod(f"p{i}", cpu=500))
+        report = run_cycle(default_scheduler(), cluster, now=1000)
+        assert len(report.bound) == 3
+        assert not report.failed
+        # Least-allocatable packs the small node first
+        assert report.bound["default/p0"] == "n1"
+
+    def test_priority_orders_queue(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=600, pods=10))
+        cluster.add_pod(mkpod("low", cpu=500, priority=1, creation_ms=1))
+        cluster.add_pod(mkpod("high", cpu=500, priority=10, creation_ms=2))
+        report = run_cycle(default_scheduler(), cluster, now=1000)
+        assert "default/high" in report.bound
+        assert "default/low" in report.failed
+
+    def test_unschedulable_pod_reported(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=100))
+        cluster.add_pod(mkpod("huge", cpu=99_000))
+        report = run_cycle(default_scheduler(), cluster, now=1000)
+        assert report.failed == ["default/huge"]
+
+
+class TestCoscheduling:
+    def gang_cluster(self, min_member=3, members=3, cpu_each=1000, node_cpu=10_000):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=node_cpu))
+        cluster.add_pod_group(
+            PodGroup(name="g", namespace="default", min_member=min_member)
+        )
+        for i in range(members):
+            cluster.add_pod(mkpod(f"m{i}", cpu=cpu_each, gang="g", creation_ms=i))
+        return cluster
+
+    def scheduler(self, **kw):
+        return default_scheduler(Coscheduling(**kw))
+
+    def test_full_gang_binds_together(self):
+        cluster = self.gang_cluster()
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert len(report.bound) == 3
+        assert not report.reserved
+
+    def test_undersized_gang_rejected_in_prefilter(self):
+        # fewer siblings than MinMember -> PreFilter rejects (core.go:243-266)
+        cluster = self.gang_cluster(min_member=5, members=3)
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert not report.bound
+        assert len(report.failed) == 3
+
+    def test_partial_capacity_gang_waits_then_expires(self):
+        # node fits only 2 of 3 members -> 2 reserve (Permit Wait), none bind
+        # (reject_percentage=100 disables whole-gang PostFilter rejection so
+        # the Wait/timeout path is observable)
+        cluster = self.gang_cluster(min_member=3, members=3, cpu_each=1000, node_cpu=2000)
+        sched = self.scheduler(permit_waiting_seconds=10, reject_percentage=100)
+        report = run_cycle(sched, cluster, now=1000)
+        assert not report.bound
+        assert len(report.reserved) == 2
+        assert cluster.gang_deadline_ms["default/g"] == 11_000
+        # deadline passes -> reservations released, failure recorded; with no
+        # backoff configured the gang immediately retries and re-reserves
+        report2 = run_cycle(sched, cluster, now=12_000)
+        assert "default/g" in report2.expired_gangs
+        assert cluster.gang_last_failure_ms["default/g"] == 12_000
+        assert cluster.gang_deadline_ms["default/g"] == 22_000  # fresh attempt
+
+    def test_gang_quorum_completes_after_capacity_frees(self):
+        cluster = self.gang_cluster(min_member=3, members=3, cpu_each=1000, node_cpu=2000)
+        sched = self.scheduler(permit_waiting_seconds=300, reject_percentage=100)
+        run_cycle(sched, cluster, now=1000)
+        assert len(cluster.reserved) == 2
+        # a second node appears; third member schedules; quorum releases all
+        cluster.add_node(mknode("n1", cpu=2000))
+        report = run_cycle(sched, cluster, now=2000)
+        assert len(report.bound) == 3
+        assert not cluster.reserved
+        assert all(
+            cluster.pods[f"default/m{i}"].node_name is not None for i in range(3)
+        )
+
+    def test_min_resources_cluster_check(self):
+        # MinResources exceeding whole-cluster free capacity -> reject all
+        cluster = self.gang_cluster(min_member=2, members=2, cpu_each=100)
+        cluster.pod_groups["default/g"].min_resources = {CPU: 50_000}
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert not report.bound
+        assert len(report.failed) == 2
+
+    def test_min_resources_not_consumed_by_own_members(self):
+        # MinResources equal to the whole cluster's capacity: later members
+        # must not be rejected because earlier members consumed free capacity
+        # (the gang's own pods are added back, core.go:433-467)
+        cluster = self.gang_cluster(min_member=3, members=3, cpu_each=1000, node_cpu=3000)
+        cluster.pod_groups["default/g"].min_resources = {CPU: 3000}
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert len(report.bound) == 3
+
+    def test_gated_pods_not_attempted_but_block_quorum(self):
+        # a gated sibling keeps the gang from ever reaching quorum ->
+        # PreFilter rejects the others; the gated pod itself is never a failure
+        cluster = self.gang_cluster(min_member=3, members=2)
+        gated = mkpod("m2", cpu=1000, gang="g", scheduling_gated=True)
+        cluster.add_pod(gated)
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert "default/m2" not in report.failed
+        assert len(report.failed) == 2  # non-gated members rejected by quorum
+        assert not report.bound
+
+    def test_reject_slack_uses_quorum_gap(self):
+        # MinMember=10 but assigned=9 via capacity for 9: gap 1/10 <= 10% ->
+        # gang is tolerated, reservations kept (coscheduling.go:180-185)
+        cluster = self.gang_cluster(
+            min_member=10, members=10, cpu_each=1000, node_cpu=9000
+        )
+        sched = self.scheduler(permit_waiting_seconds=300)
+        report = run_cycle(sched, cluster, now=1000)
+        assert len(report.reserved) == 9
+        assert not report.rejected_gangs
+
+    def test_incomplete_gang_not_backed_off(self):
+        # fewer members than MinMember: rejection must NOT back off the gang
+        # (coscheduling.go:196-204) so it retries when members appear
+        cluster = self.gang_cluster(min_member=5, members=2)
+        sched = self.scheduler(pod_group_backoff_seconds=60)
+        run_cycle(sched, cluster, now=1000)
+        assert "default/g" not in cluster.gang_backoff_until_ms
+
+    def test_backoff_blocks_next_cycle(self):
+        cluster = self.gang_cluster(min_member=3, members=3, cpu_each=1000, node_cpu=2000)
+        sched = self.scheduler(permit_waiting_seconds=5, pod_group_backoff_seconds=60)
+        run_cycle(sched, cluster, now=1000)  # 2 reserve, 1 fails -> gang rejected
+        # the failed member exceeded the 10% reject slack -> whole-gang reject
+        assert not cluster.reserved
+        assert cluster.gang_backoff_until_ms.get("default/g", 0) > 1000
+        report = run_cycle(sched, cluster, now=2000)
+        assert not report.bound and not report.reserved  # backed off
+
+    def test_failure_time_demotes_queue_order(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_pod_group(PodGroup(name="g", namespace="default", creation_ms=0))
+        cluster.gang_last_failure_ms["default/g"] = 500
+        gang_pod = mkpod("gp", gang="g", creation_ms=0)
+        plain_pod = mkpod("pp", creation_ms=100)
+        cluster.add_pod(gang_pod)
+        cluster.add_pod(plain_pod)
+        sched = self.scheduler()
+        order = sched.sort_pending([gang_pod, plain_pod], cluster)
+        assert order[0].name == "pp"  # failure time 500 > creation 100
+
+
+class TestCapacityScheduling:
+    def quota_cluster(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=100_000))
+        # memory must appear in Min: the aggregate check compares every
+        # canonical resource, so an uncovered memory request rejects
+        # (elasticquota.go:49-60 + cmp2 over all Resource fields)
+        gib = 1 << 30
+        cluster.add_quota(
+            ElasticQuota(
+                name="eq-a", namespace="a",
+                min={CPU: 1000, MEMORY: 10 * gib}, max={CPU: 2000, MEMORY: 20 * gib},
+            )
+        )
+        cluster.add_quota(
+            ElasticQuota(
+                name="eq-b", namespace="b",
+                min={CPU: 1000, MEMORY: 10 * gib}, max={CPU: 3000, MEMORY: 20 * gib},
+            )
+        )
+        return cluster
+
+    def scheduler(self):
+        return default_scheduler(CapacityScheduling())
+
+    def test_within_max_and_borrowing_admits(self):
+        cluster = self.quota_cluster()
+        # a wants 1500 (over its min 1000, under max 2000); cluster pool is
+        # 2000 min total with nothing used -> borrow allowed
+        cluster.add_pod(mkpod("a1", cpu=1500, ns="a"))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert "a/a1" in report.bound
+
+    def test_over_max_rejected(self):
+        cluster = self.quota_cluster()
+        cluster.add_pod(mkpod("a1", cpu=2500, ns="a"))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert report.failed == ["a/a1"]
+
+    def test_aggregate_over_min_rejected(self):
+        cluster = self.quota_cluster()
+        # b already uses 1900 of the 2000 guaranteed pool
+        used = mkpod("b0", cpu=1900, ns="b")
+        used.node_name = "n0"
+        cluster.add_pod(used)
+        cluster.add_pod(mkpod("a1", cpu=500, ns="a"))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert report.failed == ["a/a1"]
+
+    def test_usage_accumulates_within_cycle(self):
+        cluster = self.quota_cluster()
+        # two pods of 1100 each: first fits max 2000, second would be 2200
+        cluster.add_pod(mkpod("a1", cpu=1100, ns="a", creation_ms=1))
+        cluster.add_pod(mkpod("a2", cpu=1100, ns="a", creation_ms=2))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert "a/a1" in report.bound
+        assert "a/a2" in report.failed
+
+    def test_no_quota_namespace_passes(self):
+        cluster = self.quota_cluster()
+        cluster.add_pod(mkpod("free", cpu=50_000, ns="unquotaed"))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        assert "unquotaed/free" in report.bound
