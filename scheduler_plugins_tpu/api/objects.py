@@ -130,6 +130,38 @@ class Pod:
 
         return add_quantities(resources, self.overhead)
 
+    def effective_limits(self) -> dict[str, int]:
+        """Trimaran-style effective limits: per resource, sum of app
+        containers, then max against each init container individually, plus
+        overhead (/root/reference/pkg/trimaran/resourcestats.go:121-145
+        GetEffectiveResource over container limits)."""
+        resources: dict[str, int] = {}
+        for c in self.containers:
+            resources = add_quantities(resources, c.limits)
+        for ic in self.init_containers:
+            resources = max_quantities(resources, ic.limits)
+        return add_quantities(resources, self.overhead)
+
+    def tlp_predicted_cpu_millis(
+        self, multiplier: float = 1.5, default_millis: int = 1000
+    ) -> int:
+        """TargetLoadPacking's per-pod CPU prediction: per app container,
+        limit if set, else round(request * multiplier), else the default
+        1000m; plus pod overhead CPU
+        (/root/reference/pkg/trimaran/targetloadpacking/targetloadpacking.go:123-129,
+        198-205). Init containers are not counted."""
+        total = 0
+        for c in self.containers:
+            if c.limits.get(CPU):
+                total += c.limits[CPU]
+            elif c.requests.get(CPU):
+                # Go math.Round; requests are non-negative by construction
+                total += int(c.requests[CPU] * multiplier + 0.5)
+            else:
+                total += default_millis
+        total += self.overhead.get(CPU, 0)
+        return total
+
     def qos_class(self) -> QOSClass:
         """Mirror of upstream `v1qos.GetPodQOS` (cpu/memory only):
         BestEffort when no container names any cpu/memory request or limit;

@@ -76,12 +76,12 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
                     timeout_s = cosched.permit_waiting_seconds
                 cluster.gang_deadline_ms[pg.full_name] = now + 1000 * (timeout_s or 0)
         else:
-            cluster.bind(pod.uid, node_name)
+            cluster.bind(pod.uid, node_name, now)
             report.bound[pod.uid] = node_name
 
     # Permit Allow fan-out: quorum reached this cycle releases waiting siblings
     for pg in list(cluster.pod_groups.values()):
-        _maybe_release_gang(cluster, pg, report)
+        _maybe_release_gang(cluster, pg, report, now)
 
     # PostFilter: whole-gang rejection (coscheduling.go:160-209)
     for gang_name in failed_by_gang:
@@ -105,7 +105,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     return report
 
 
-def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport):
+def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport, now: int = 0):
     reserved = cluster.gang_reservations(pg)
     if not reserved:
         return
@@ -117,7 +117,7 @@ def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport):
     if bound + len(reserved) >= pg.min_member:
         for uid in reserved:
             node = cluster.reserved[uid]
-            cluster.bind(uid, node)
+            cluster.bind(uid, node, now)
             report.bound[uid] = node
             report.reserved.pop(uid, None)
         cluster.gang_deadline_ms.pop(pg.full_name, None)

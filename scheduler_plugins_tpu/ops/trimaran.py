@@ -1,0 +1,279 @@
+"""Trimaran load-aware score curves, vectorized over nodes.
+
+Each function mirrors one reference plugin's math bit-for-bit (float formulas,
+Go `math.Round` half-away rounding, int64 truncation):
+
+- `tlp_score`    TargetLoadPacking piecewise-linear best-fit packing curve
+  (/root/reference/pkg/trimaran/targetloadpacking/targetloadpacking.go:107-193).
+- `lvrb_score`   LoadVariationRiskBalancing risk = (mu + margin*sigma^(1/s))/2
+  (/root/reference/pkg/trimaran/loadvariationriskbalancing/analysis.go:34-69,
+  loadvariationriskbalancing.go:94-121).
+- `lroc_score`   LowRiskOverCommitment: w*riskLimit + (1-w)*riskLoad with the
+  beta-distribution overuse probability
+  (/root/reference/pkg/trimaran/lowriskovercommitment/lowriskovercommitment.go:157-256,
+  beta.go:106-191).
+- `peaks_score`  power-jump K1*(e^(K2*p) - e^(K2*x)) * 1e15
+  (/root/reference/pkg/trimaran/peaks/peaks.go:103-196).
+
+All utilisation inputs are percentages of capacity, exactly as the
+load-watcher reports them (resourcestats.go:33-107).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import betainc
+
+from scheduler_plugins_tpu.utils.intmath import round_half_away
+
+MAX_SCORE = 100.0
+
+
+def tlp_score(
+    cpu_avg_pct,
+    cpu_valid,
+    missing_cpu_millis,
+    node_cpu_capacity_millis,
+    pod_predicted_millis,
+    target_pct: float = 40.0,
+):
+    """(N,) TargetLoadPacking scores for one pod.
+
+    predicted% = 100 * (measured + missing-from-cache + pod) / capacity;
+    score rises linearly target->100 at the target utilisation, then falls
+    steeply to 0 at 100%, 0 beyond (targetloadpacking.go:150-186). Nodes
+    without metrics score the minimum (avoided).
+    """
+    cap = node_cpu_capacity_millis.astype(jnp.float64)
+    util_millis = cpu_avg_pct / 100.0 * cap
+    predicted = jnp.where(
+        cap != 0,
+        100.0
+        * (util_millis + missing_cpu_millis + pod_predicted_millis)
+        / jnp.maximum(cap, 1.0),
+        0.0,
+    )
+    rising = round_half_away(
+        (100.0 - target_pct) * predicted / target_pct + target_pct
+    )
+    falling = round_half_away(target_pct * (100.0 - predicted) / (100.0 - target_pct))
+    score = jnp.where(
+        predicted > target_pct,
+        jnp.where(predicted > 100.0, 0, falling),
+        rising,
+    )
+    return jnp.where(cpu_valid, score, 0).astype(jnp.int64)
+
+
+def _risk_component(avg_pct, std_pct, capacity, req, margin, sensitivity):
+    """computeScore (analysis.go:41-69) in [0, 100], float64."""
+    cap = capacity.astype(jnp.float64)
+    used = jnp.clip(avg_pct / 100.0 * cap, 0.0, cap)
+    stdev = jnp.clip(std_pct / 100.0 * cap, 0.0, cap)
+    req = jnp.maximum(jnp.asarray(req, jnp.float64), 0.0)
+    mu = jnp.clip((used + req) / jnp.maximum(cap, 1.0), 0.0, 1.0)
+    sigma = jnp.clip(stdev / jnp.maximum(cap, 1.0), 0.0, 1.0)
+    if sensitivity == 0:
+        # Go semantics: 1/0 = +Inf, Pow(sigma, +Inf) = 0 for sigma < 1, 1 at 1
+        sigma = jnp.where(sigma >= 1.0, 1.0, 0.0)
+    elif sensitivity > 0:
+        sigma = jnp.power(sigma, 1.0 / sensitivity)
+    sigma = jnp.clip(sigma * margin, 0.0, 1.0)
+    risk = (mu + sigma) / 2.0
+    score = (1.0 - risk) * MAX_SCORE
+    return jnp.where(cap > 0, score, 0.0)
+
+
+def lvrb_score(
+    metrics,
+    node_cpu_capacity_millis,
+    node_mem_capacity_bytes,
+    pod_cpu_millis,
+    pod_mem_bytes,
+    margin: float = 1.0,
+    sensitivity: float = 1.0,
+):
+    """(N,) LoadVariationRiskBalancing scores: min(cpuScore, memScore) when
+    both metrics exist, max of the valid one otherwise
+    (loadvariationriskbalancing.go:98-121)."""
+    cpu = _risk_component(
+        metrics.cpu_avg, metrics.cpu_std, node_cpu_capacity_millis,
+        pod_cpu_millis, margin, sensitivity,
+    )
+    mem = _risk_component(
+        metrics.mem_avg, metrics.mem_std, node_mem_capacity_bytes,
+        pod_mem_bytes, margin, sensitivity,
+    )
+    cpu = jnp.where(metrics.cpu_valid, cpu, 0.0)
+    mem = jnp.where(metrics.mem_valid, mem, 0.0)
+    both = metrics.cpu_valid & metrics.mem_valid
+    total = jnp.where(both, jnp.minimum(cpu, mem), jnp.maximum(cpu, mem))
+    return round_half_away(total)
+
+
+# ---------------------------------------------------------------------------
+# LowRiskOverCommitment
+# ---------------------------------------------------------------------------
+
+MAX_VARIANCE_ALLOWANCE = 0.99  # lowriskovercommitment.go:47
+_TINY = jnp.finfo(jnp.float64).tiny
+
+
+def _beta_cdf(threshold, alpha, beta_p, valid):
+    """DistributionFunction (beta.go:80-104): I_x(a,b) with x==0 -> 0,
+    x==1 -> 1; invalid fits propagate `valid`=False."""
+    x = jnp.clip(threshold, 0.0, 1.0)
+    safe_a = jnp.where(valid, alpha, 1.0)
+    safe_b = jnp.where(valid, beta_p, 1.0)
+    cdf = betainc(safe_a, safe_b, x)
+    cdf = jnp.where(x <= 0.0, 0.0, jnp.where(x >= 1.0, 1.0, cdf))
+    return cdf
+
+
+def compute_probability(mu, sigma, threshold):
+    """ComputeProbability (beta.go:174-191): P[util <= threshold] under a
+    beta distribution moment-matched to (mu, sigma).
+
+    Returns (prob, fit_valid, alpha, beta) — fit_valid mirrors
+    `fitDistribution != nil` for the conditioning step."""
+    m1 = mu
+    variance = sigma * sigma
+    m2 = variance + mu * mu
+    # MatchMoments validity (beta.go:107-117)
+    fit_valid = (
+        (m1 >= 0.0) & (m1 <= 1.0) & (variance >= 0.0) & (variance < m1 * (1.0 - m1))
+    )
+    temp = jnp.maximum(m1 * (1.0 - m1) / jnp.maximum(variance, _TINY) - 1.0, _TINY)
+    alpha = m1 * temp
+    beta_p = (1.0 - m1) * temp
+
+    degenerate_one = (mu == 0.0) | ((sigma == 0.0) & (mu <= threshold))
+    degenerate_zero = (sigma == 0.0) & (mu > threshold)
+    fit_valid = fit_valid & ~degenerate_one & ~degenerate_zero
+
+    cdf = _beta_cdf(threshold, alpha, beta_p, fit_valid)
+    cdf = jnp.where(jnp.isnan(cdf), 1.0, cdf)  # NaN CDF -> 1 (beta.go:189)
+    prob = jnp.where(
+        degenerate_one,
+        1.0,
+        jnp.where(degenerate_zero, 0.0, jnp.where(fit_valid, cdf, 0.0)),
+    )
+    return prob, fit_valid, alpha, beta_p
+
+
+def _risk_one_resource(
+    avg_pct, std_pct, valid, capacity, node_req, node_limit,
+    node_req_minus_pod, node_limit_minus_pod,
+    smoothing_window, risk_limit_weight,
+):
+    """computeRisk (lowriskovercommitment.go:173-256) for one resource,
+    vectorized over nodes. Quantities are int64 in native units."""
+    cap = capacity.astype(jnp.float64)
+    req = node_req.astype(jnp.float64)
+    limit = node_limit.astype(jnp.float64)
+    req_minus = node_req_minus_pod.astype(jnp.float64)
+    limit_minus = node_limit_minus_pod.astype(jnp.float64)
+
+    # (1) riskLimit: overcommit potential
+    risk_limit = jnp.where(
+        limit > cap,
+        (limit - cap) / jnp.maximum(limit - req, _TINY),
+        0.0,
+    )
+
+    # (2) riskLoad: measured overcommitment via beta fit
+    used = jnp.clip(avg_pct / 100.0 * cap, 0.0, cap)
+    stdev = jnp.clip(std_pct / 100.0 * cap, 0.0, cap)
+    mu = jnp.clip(used / jnp.maximum(cap, 1.0), 0.0, 1.0)
+    sigma = jnp.clip(stdev / jnp.maximum(cap, 1.0), 0.0, 1.0)
+    sigma = sigma * jnp.sqrt(jnp.float64(smoothing_window))
+    max_var = jnp.where((mu > 0.0) & (mu < 1.0), mu * (1.0 - mu), 0.0)
+    sigma = jnp.minimum(sigma, jnp.sqrt(max_var * MAX_VARIANCE_ALLOWANCE))
+
+    alloc_threshold = jnp.clip(req_minus / jnp.maximum(cap, 1.0), 0.0, 1.0)
+    alloc_prob, fit_valid, alpha, beta_p = compute_probability(
+        mu, sigma, alloc_threshold
+    )
+    # conditioning when limits don't overcommit (lowriskovercommitment.go:232-245)
+    conditioned = (limit_minus < cap) & (req_minus <= limit_minus)
+    limit_threshold = limit_minus / jnp.maximum(cap, 1.0)
+    limit_prob = _beta_cdf(limit_threshold, alpha, beta_p, fit_valid)
+    cond_prob = jnp.where(
+        limit_threshold == 0.0,
+        1.0,
+        jnp.where(
+            fit_valid & (limit_prob > 0.0),
+            jnp.clip(alloc_prob / jnp.maximum(limit_prob, _TINY), 0.0, 1.0),
+            alloc_prob,
+        ),
+    )
+    alloc_prob = jnp.where(conditioned, cond_prob, alloc_prob)
+    risk_load = jnp.where(valid, 1.0 - alloc_prob, 0.0)
+
+    total = risk_limit_weight * risk_limit + (1.0 - risk_limit_weight) * risk_load
+    return jnp.clip(total, 0.0, 1.0)
+
+
+def lroc_score(
+    metrics,
+    node_cpu_capacity,
+    node_mem_capacity,
+    node_req_cpu,
+    node_req_mem,
+    node_limit_cpu,
+    node_limit_mem,
+    pod_req_cpu,
+    pod_req_mem,
+    pod_limit_cpu,
+    pod_limit_mem,
+    smoothing_window: int = 5,
+    risk_limit_weight_cpu: float = 0.5,
+    risk_limit_weight_mem: float = 0.5,
+):
+    """(N,) LowRiskOverCommitment scores: round((1 - max(riskCPU, riskMem)) * 100).
+
+    node_req_*/node_limit_* EXCLUDE the pending pod (the minus-pod values);
+    the with-pod sums are formed here, with requests capped at capacity
+    (resourcestats.go:163-225)."""
+    req_cpu = jnp.minimum(node_req_cpu + pod_req_cpu, node_cpu_capacity)
+    req_mem = jnp.minimum(node_req_mem + pod_req_mem, node_mem_capacity)
+    req_cpu_minus = jnp.minimum(node_req_cpu, node_cpu_capacity)
+    req_mem_minus = jnp.minimum(node_req_mem, node_mem_capacity)
+    # the pending pod's limits are clamped to >= its requests, like every
+    # other pod's (SetMaxLimits in CreatePodResourcesStateData)
+    limit_cpu = node_limit_cpu + jnp.maximum(pod_limit_cpu, pod_req_cpu)
+    limit_mem = node_limit_mem + jnp.maximum(pod_limit_mem, pod_req_mem)
+
+    risk_cpu = _risk_one_resource(
+        metrics.cpu_avg, metrics.cpu_std, metrics.cpu_valid,
+        node_cpu_capacity, req_cpu, limit_cpu, req_cpu_minus, node_limit_cpu,
+        smoothing_window, risk_limit_weight_cpu,
+    )
+    risk_mem = _risk_one_resource(
+        metrics.mem_avg, metrics.mem_std, metrics.mem_valid,
+        node_mem_capacity, req_mem, limit_mem, req_mem_minus, node_limit_mem,
+        smoothing_window, risk_limit_weight_mem,
+    )
+    rank = 1.0 - jnp.maximum(risk_cpu, risk_mem)
+    return round_half_away(rank * MAX_SCORE)
+
+
+def peaks_score(
+    cpu_avg_pct,
+    cpu_valid,
+    node_cpu_capacity_millis,
+    pod_cpu_millis,
+    k1,
+    k2,
+):
+    """(N,) Peaks raw scores: power jump to be minimized, scaled by 1e15 and
+    truncated to int64 (peaks.go:103-146). predicted > 100% or missing
+    metrics -> MinNodeScore."""
+    cap = node_cpu_capacity_millis.astype(jnp.float64)
+    util_millis = cpu_avg_pct / 100.0 * cap
+    predicted = jnp.where(
+        cap != 0, 100.0 * (util_millis + pod_cpu_millis) / jnp.maximum(cap, 1.0), 0.0
+    )
+    jump = k1 * (jnp.exp(k2 * predicted) - jnp.exp(k2 * cpu_avg_pct))
+    score = jnp.trunc(jump * 1e15).astype(jnp.int64)
+    return jnp.where(cpu_valid & (predicted <= 100.0), score, 0)

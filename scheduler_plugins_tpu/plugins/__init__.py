@@ -16,3 +16,9 @@ from scheduler_plugins_tpu.plugins.noderesources import (  # noqa: F401
 )
 from scheduler_plugins_tpu.plugins.podstate import PodState  # noqa: F401
 from scheduler_plugins_tpu.plugins.qos import QOSSort  # noqa: F401
+from scheduler_plugins_tpu.plugins.trimaran import (  # noqa: F401
+    LoadVariationRiskBalancing,
+    LowRiskOverCommitment,
+    Peaks,
+    TargetLoadPacking,
+)

@@ -1,0 +1,176 @@
+"""Trimaran — the load-aware Score plugin family.
+
+Reference: /root/reference/pkg/trimaran (shared Collector/handler/
+resourcestats) with four Score-only plugins: TargetLoadPacking,
+LoadVariationRiskBalancing, LowRiskOverCommitment, Peaks (SURVEY.md §2.7).
+
+The metrics path maps as: load-watcher percentages land in
+`MetricsState` (cluster store ingests them; the 30s collector goroutine
+becomes a host-side refresh), the ScheduledPodsCache compensation becomes the
+per-node `missing_cpu_millis` column, and each plugin body is one vectorized
+curve from `ops.trimaran`.
+
+Defaults (apis/config/v1/defaults.go:49-106): TLP target 40%, request
+multiplier 1.5, default request 1000m; LVRB margin 1, sensitivity 1;
+LROC smoothing window 5, risk-limit weight 0.5 each.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops import CPU_I, MEMORY_I
+from scheduler_plugins_tpu.ops.normalize import peaks_normalize
+from scheduler_plugins_tpu.ops.trimaran import (
+    lroc_score,
+    lvrb_score,
+    peaks_score,
+    tlp_score,
+)
+
+
+class TargetLoadPacking(Plugin):
+    """Best-fit bin packing around a target CPU utilisation
+    (targetloadpacking.go:107-205)."""
+
+    name = "TargetLoadPacking"
+
+    def __init__(self, target_utilization_percent: int = 40):
+        if not 0 < target_utilization_percent <= 100:
+            raise ValueError("target utilization must be in (0, 100]")
+        self.target = float(target_utilization_percent)
+
+    def score(self, state, snap, p):
+        if snap.metrics is None:
+            return None
+        return tlp_score(
+            snap.metrics.cpu_avg,
+            snap.metrics.cpu_valid,
+            snap.metrics.missing_cpu_millis,
+            snap.nodes.capacity[:, CPU_I],
+            snap.pods.predicted_cpu_millis[p],
+            self.target,
+        )
+
+
+class LoadVariationRiskBalancing(Plugin):
+    """Risk = (mu + margin*sigma^(1/sensitivity))/2 over cpu+memory
+    (analysis.go:34-69)."""
+
+    name = "LoadVariationRiskBalancing"
+
+    def __init__(self, safe_variance_margin: float = 1.0, safe_variance_sensitivity: float = 1.0):
+        if safe_variance_margin < 0 or safe_variance_sensitivity < 0:
+            raise ValueError("margin/sensitivity must be non-negative")
+        self.margin = safe_variance_margin
+        self.sensitivity = safe_variance_sensitivity
+
+    def score(self, state, snap, p):
+        if snap.metrics is None:
+            return None
+        # LVRB reads node allocatable as capacity (resourcestats.go:56-66)
+        return lvrb_score(
+            snap.metrics,
+            snap.nodes.alloc[:, CPU_I],
+            snap.nodes.alloc[:, MEMORY_I],
+            snap.pods.req[p, CPU_I],
+            snap.pods.req[p, MEMORY_I],
+            self.margin,
+            self.sensitivity,
+        )
+
+
+class LowRiskOverCommitment(Plugin):
+    """Weighted overcommit-potential + measured-overuse risk
+    (lowriskovercommitment.go:157-256)."""
+
+    name = "LowRiskOverCommitment"
+
+    def __init__(
+        self,
+        smoothing_window_size: int = 5,
+        risk_limit_weights: Optional[Mapping[str, float]] = None,
+    ):
+        self.smoothing_window = smoothing_window_size
+        weights = dict(risk_limit_weights or {})
+        self.w_cpu = weights.get("cpu", 0.5)
+        self.w_mem = weights.get("memory", 0.5)
+
+    def score(self, state, snap, p):
+        if snap.metrics is None:
+            return None
+        raw = lroc_score(
+            snap.metrics,
+            snap.nodes.alloc[:, CPU_I],
+            snap.nodes.alloc[:, MEMORY_I],
+            snap.nodes.requested[:, CPU_I],
+            snap.nodes.requested[:, MEMORY_I],
+            snap.nodes.limits[:, CPU_I],
+            snap.nodes.limits[:, MEMORY_I],
+            snap.pods.req[p, CPU_I],
+            snap.pods.req[p, MEMORY_I],
+            snap.pods.limits[p, CPU_I],
+            snap.pods.limits[p, MEMORY_I],
+            self.smoothing_window,
+            self.w_cpu,
+            self.w_mem,
+        )
+        # best-effort pods are not scored (lowriskovercommitment.go:122-129);
+        # nodes with NO metrics at all score minimum, but partial (memory-only
+        # or cpu-only) metrics still rank (Score only early-outs on nil)
+        best_effort = (
+            (snap.pods.req[p, CPU_I] == 0)
+            & (snap.pods.req[p, MEMORY_I] == 0)
+            & (snap.pods.limits[p, CPU_I] == 0)
+            & (snap.pods.limits[p, MEMORY_I] == 0)
+        )
+        no_metrics = ~(snap.metrics.cpu_valid | snap.metrics.mem_valid)
+        return jnp.where(best_effort | no_metrics, 0, raw)
+
+
+class Peaks(Plugin):
+    """Power-aware packing: minimize the cluster power jump
+    Power = K0 + K1*e^(K2*util) (peaks.go:103-196, PeaksArgs power model
+    apis/config/types.go:287-307)."""
+
+    name = "Peaks"
+
+    def __init__(self, node_power_model: Optional[Mapping[str, tuple]] = None):
+        #: node name -> (K0, K1, K2); missing nodes get (0, 0, 0)
+        self.node_power_model = dict(node_power_model or {})
+        self._k1 = None
+        self._k2 = None
+
+    def prepare(self, meta):
+        n = len(meta.node_names)
+        k1 = np.zeros(max(n, 1), np.float64)
+        k2 = np.zeros(max(n, 1), np.float64)
+        for i, name in enumerate(meta.node_names):
+            model = self.node_power_model.get(name)
+            if model is not None:
+                k1[i], k2[i] = float(model[1]), float(model[2])
+        self._k1 = jnp.asarray(k1)
+        self._k2 = jnp.asarray(k2)
+
+    def score(self, state, snap, p):
+        if snap.metrics is None or self._k1 is None:
+            return None
+        N = snap.num_nodes
+        k1 = jnp.zeros(N, jnp.float64).at[: self._k1.shape[0]].set(self._k1)
+        k2 = jnp.zeros(N, jnp.float64).at[: self._k2.shape[0]].set(self._k2)
+        return peaks_score(
+            snap.metrics.cpu_avg,
+            snap.metrics.cpu_valid,
+            snap.nodes.capacity[:, CPU_I],
+            snap.pods.req[p, CPU_I],
+            k1,
+            k2,
+        )
+
+    def normalize(self, scores, feasible):
+        # lowest power jump wins (peaks.go:152-168)
+        return peaks_normalize(scores, feasible)

@@ -45,6 +45,10 @@ class Cluster:
     gang_deadline_ms: dict[str, int] = field(default_factory=dict)
     gang_backoff_until_ms: dict[str, int] = field(default_factory=dict)
     gang_last_failure_ms: dict[str, int] = field(default_factory=dict)
+    #: recently-bound pods whose load the metrics provider has not reported
+    #: yet (the trimaran PodAssignEventHandler ScheduledPodsCache,
+    #: /root/reference/pkg/trimaran/handler.go:47-171): uid -> (bind ms, node)
+    recent_bindings: dict[str, tuple[int, str]] = field(default_factory=dict)
 
     # -- upserts ---------------------------------------------------------
     def add_node(self, node: Node):
@@ -113,9 +117,10 @@ class Cluster:
         ]
 
     # -- binding / reservations -----------------------------------------
-    def bind(self, uid: str, node_name: str):
+    def bind(self, uid: str, node_name: str, now_ms: int = 0):
         self.reserved.pop(uid, None)
         self.pods[uid].node_name = node_name
+        self.recent_bindings[uid] = (now_ms, node_name)
 
     def reserve(self, uid: str, node_name: str):
         """Permit said Wait: hold the placement without binding."""
@@ -132,6 +137,38 @@ class Cluster:
             and p.namespace == pg.namespace
             and p.pod_group() == pg.name
         ]
+
+    #: metrics-agent reporting interval: recently-bound pods within this
+    #: window are presumed unreported and their predicted CPU is added
+    #: (handler.go comment; BASELINE.md metrics freshness envelope)
+    METRICS_REPORT_INTERVAL_MS = 60_000
+    #: ScheduledPodsCache GC horizon (handler.go: 5 minutes)
+    BINDING_CACHE_GC_MS = 300_000
+
+    def _metrics_with_missing(self, now_ms: int):
+        """Augment node metrics with the missing-utilization compensation
+        (targetloadpacking.go:148-168): predicted CPU of pods bound within
+        the metrics reporting interval, per node."""
+        if self.node_metrics is None:
+            return None
+        # GC the binding cache
+        for uid, (ts, _) in list(self.recent_bindings.items()):
+            if now_ms - ts > self.BINDING_CACHE_GC_MS:
+                del self.recent_bindings[uid]
+        missing: dict[str, int] = {}
+        for uid, (ts, node) in self.recent_bindings.items():
+            pod = self.pods.get(uid)
+            if pod is None or now_ms - ts >= self.METRICS_REPORT_INTERVAL_MS:
+                continue
+            missing[node] = missing.get(node, 0) + pod.tlp_predicted_cpu_millis()
+        if not missing:
+            return self.node_metrics
+        merged = {name: dict(m) for name, m in self.node_metrics.items()}
+        for node, millis in missing.items():
+            merged.setdefault(node, {})["missing_cpu_millis"] = (
+                merged.get(node, {}).get("missing_cpu_millis", 0) + millis
+            )
+        return merged
 
     # -- snapshot --------------------------------------------------------
     def snapshot(self, pending: list[Pod], now_ms: int = 0, **kwargs):
@@ -152,6 +189,7 @@ class Cluster:
             for name, until in self.gang_backoff_until_ms.items()
             if until > now_ms
         ]
+        metrics = self._metrics_with_missing(now_ms)
         return build_snapshot(
             list(self.nodes.values()),
             pending,
@@ -160,7 +198,7 @@ class Cluster:
             quotas=list(self.quotas.values()),
             nrts=list(self.nrts.values()),
             app_groups=list(self.app_groups.values()),
-            node_metrics=self.node_metrics,
+            node_metrics=metrics,
             backed_off_gangs=backed_off,
             extra_pods=self.gated_pods(),
             **kwargs,

@@ -56,8 +56,12 @@ F64 = np.float64
 @struct.dataclass
 class NodeState:
     alloc: np.ndarray  # (N, R) int64 allocatable
+    capacity: np.ndarray  # (N, R) int64 node capacity (TLP/Peaks read this)
     requested: np.ndarray  # (N, R) int64 sum of assigned pods' requests
     nonzero_requested: np.ndarray  # (N, R) int64 with upstream non-zero defaults
+    #: (N, R) sum of assigned pods' effective limits clamped to >= requests
+    #: per pod (trimaran SetMaxLimits, resourcestats.go:225-231)
+    limits: np.ndarray
     mask: np.ndarray  # (N,) bool — real, schedulable node
     region: np.ndarray  # (N,) int32 region code (-1 unset)
     zone: np.ndarray  # (N,) int32 zone code (-1 unset)
@@ -69,6 +73,9 @@ class NodeState:
 @struct.dataclass
 class PodState:
     req: np.ndarray  # (P, R) int64 effective request (pods slot = 0)
+    limits: np.ndarray  # (P, R) int64 trimaran effective limits (unclamped)
+    #: (P,) TargetLoadPacking per-pod CPU prediction with default args
+    predicted_cpu_millis: np.ndarray
     priority: np.ndarray  # (P,) int64
     ns: np.ndarray  # (P,) int32 namespace code
     gang: np.ndarray  # (P,) int32 gang code (-1 = not in a PodGroup)
@@ -288,8 +295,10 @@ def build_snapshot(
 
     # --- nodes ---------------------------------------------------------
     alloc = np.zeros((N, R), I64)
+    capacity = np.zeros((N, R), I64)
     requested = np.zeros((N, R), I64)
     nonzero_req = np.zeros((N, R), I64)
+    node_limits = np.zeros((N, R), I64)
     node_mask = np.zeros(N, bool)
     region = np.full(N, -1, I32)
     zone = np.full(N, -1, I32)
@@ -301,6 +310,7 @@ def build_snapshot(
     for i, node in enumerate(nodes):
         node_pos[node.name] = i
         alloc[i] = index.encode(node.allocatable)
+        capacity[i] = index.encode(node.capacity)
         node_mask[i] = not node.unschedulable
         if node.region:
             region[i] = regions_in.code(node.region)
@@ -318,6 +328,8 @@ def build_snapshot(
         req = index.encode(pod.effective_request())
         requested[i] += req
         nonzero_req[i] += nonzero_request(req, index)
+        # limits clamped to >= requests per pod (SetMaxLimits)
+        node_limits[i] += np.maximum(index.encode(pod.effective_limits()), req)
         pod_count[i] += 1
         if pod.terminating:
             terminating[i] += 1
@@ -329,8 +341,10 @@ def build_snapshot(
 
     node_state = NodeState(
         alloc=alloc,
+        capacity=capacity,
         requested=requested,
         nonzero_requested=nonzero_req,
+        limits=node_limits,
         mask=node_mask,
         region=region,
         zone=zone,
@@ -412,6 +426,8 @@ def build_snapshot(
 
     # --- pods (pending batch) -----------------------------------------
     preq = np.zeros((P, R), I64)
+    plimits = np.zeros((P, R), I64)
+    ppredicted = np.zeros(P, I64)
     ppriority = np.zeros(P, I64)
     pns = np.zeros(P, I32)
     pgang = np.full(P, -1, I32)
@@ -421,6 +437,8 @@ def build_snapshot(
     pgated = np.zeros(P, bool)
     for i, pod in enumerate(pending_pods):
         preq[i] = index.encode(pod.effective_request())
+        plimits[i] = index.encode(pod.effective_limits())
+        ppredicted[i] = pod.tlp_predicted_cpu_millis()
         ppriority[i] = pod.priority
         pns[i] = ns_in.code(pod.namespace)
         pgang[i] = _gang_of(pod)
@@ -430,6 +448,8 @@ def build_snapshot(
         pgated[i] = pod.scheduling_gated
     pod_state = PodState(
         req=preq,
+        limits=plimits,
+        predicted_cpu_millis=ppredicted,
         priority=ppriority,
         ns=pns,
         gang=pgang,

@@ -1,0 +1,217 @@
+"""Trimaran decision tables: TLP packing curve, LVRB risk, LROC beta risk,
+Peaks power jump, and the missing-utilization compensation path."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.ops.trimaran import (
+    compute_probability,
+    lvrb_score,
+    peaks_score,
+    tlp_score,
+)
+from scheduler_plugins_tpu.plugins import (
+    LoadVariationRiskBalancing,
+    LowRiskOverCommitment,
+    Peaks,
+    TargetLoadPacking,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.state.snapshot import MetricsState
+
+
+def metrics_state(cpu_avg, cpu_std=None, mem_avg=None, mem_std=None):
+    n = len(cpu_avg)
+    zeros = np.zeros(n)
+    return MetricsState(
+        cpu_avg=np.array(cpu_avg, float),
+        cpu_std=np.array(cpu_std, float) if cpu_std else zeros,
+        mem_avg=np.array(mem_avg, float) if mem_avg else zeros,
+        mem_std=np.array(mem_std, float) if mem_std else zeros,
+        cpu_valid=np.ones(n, bool),
+        mem_valid=np.array([mem_avg is not None] * n),
+        missing_cpu_millis=np.zeros(n, np.int64),
+    )
+
+
+class TestTLPCurve:
+    def test_rising_edge(self):
+        # util 20% + pod 1000m on 10 cores -> predicted 30%:
+        # score = round(60*30/40 + 40) = 85 (targetloadpacking.go:183-186)
+        s = tlp_score(
+            jnp.array([20.0]), jnp.array([True]), jnp.array([0]),
+            jnp.array([10_000]), 1000, 40.0,
+        )
+        assert int(s[0]) == 85
+
+    def test_peak_at_target(self):
+        s = tlp_score(
+            jnp.array([30.0]), jnp.array([True]), jnp.array([0]),
+            jnp.array([10_000]), 1000, 40.0,
+        )
+        assert int(s[0]) == 100
+
+    def test_falling_edge(self):
+        # predicted 60% -> round(40*(100-60)/60) = 27
+        s = tlp_score(
+            jnp.array([50.0]), jnp.array([True]), jnp.array([0]),
+            jnp.array([10_000]), 1000, 40.0,
+        )
+        assert int(s[0]) == 27
+
+    def test_overload_and_invalid_score_zero(self):
+        s = tlp_score(
+            jnp.array([99.0, 10.0]), jnp.array([True, False]), jnp.array([0, 0]),
+            jnp.array([10_000, 10_000]), 5000, 40.0,
+        )
+        assert s.tolist() == [0, 0]
+
+    def test_missing_utilization_shifts_prediction(self):
+        # 1000m of unreported recently-bound load moves 20% -> 40% predicted
+        s = tlp_score(
+            jnp.array([20.0]), jnp.array([True]), jnp.array([1000]),
+            jnp.array([10_000]), 1000, 40.0,
+        )
+        assert int(s[0]) == 100
+
+
+class TestLVRB:
+    def test_cpu_only_risk(self):
+        # mu = (5000+1000)/10000 = 0.6, sigma = 0.1 -> risk 0.35 -> score 65
+        m = metrics_state([50.0], cpu_std=[10.0])
+        s = lvrb_score(m, jnp.array([10_000]), jnp.array([32 << 30]), 1000, 0)
+        assert int(s[0]) == 65
+
+    def test_min_of_cpu_and_memory(self):
+        m = metrics_state([50.0], cpu_std=[10.0], mem_avg=[80.0], mem_std=[0.0])
+        cap_mem = 10 << 30
+        s = lvrb_score(m, jnp.array([10_000]), jnp.array([cap_mem]), 1000, 0)
+        # memScore: mu=0.8 sigma=0 -> risk .4 -> 60; cpuScore 65 -> min 60
+        assert int(s[0]) == 60
+
+    def test_sensitivity_root(self):
+        # sensitivity 2 -> sigma^(1/2): sigma .04 -> .2
+        m = metrics_state([0.0], cpu_std=[4.0])
+        s = lvrb_score(
+            m, jnp.array([10_000]), jnp.array([1 << 30]), 0, 0,
+            margin=1.0, sensitivity=2.0,
+        )
+        # mu 0, sigma sqrt(.04)=.2 -> risk .1 -> 90
+        assert int(s[0]) == 90
+
+
+class TestBeta:
+    def test_degenerate_cases(self):
+        p, valid, *_ = compute_probability(
+            jnp.array([0.0, 0.3, 0.3]), jnp.array([0.0, 0.0, 0.0]),
+            jnp.array([0.5, 0.5, 0.2]),
+        )
+        # mu=0 -> 1; sigma=0,mu<=t -> 1; sigma=0,mu>t -> 0
+        assert p.tolist() == [1.0, 1.0, 0.0]
+
+    def test_moment_matched_cdf_monotone(self):
+        mu = jnp.array([0.3, 0.3])
+        sigma = jnp.array([0.1, 0.1])
+        p_low, valid, *_ = compute_probability(mu, sigma, jnp.array([0.2, 0.8]))
+        assert bool(valid[0])
+        assert float(p_low[0]) < float(p_low[1])
+        # matches scipy within float tolerance
+        from scipy.stats import beta as scipy_beta
+
+        var = 0.01
+        temp = 0.3 * 0.7 / var - 1
+        a, b = 0.3 * temp, 0.7 * temp
+        assert math.isclose(
+            float(p_low[0]), scipy_beta.cdf(0.2, a, b), rel_tol=1e-9
+        )
+
+
+class TestPeaks:
+    def test_power_jump_and_normalize(self):
+        # K1=1, K2=0.1: util 10% + 500m/10c -> predicted 15%
+        s = peaks_score(
+            jnp.array([10.0, 10.0]), jnp.array([True, True]),
+            jnp.array([10_000, 10_000]), 500,
+            jnp.array([1.0, 2.0]), jnp.array([0.1, 0.1]),
+        )
+        expected0 = math.trunc((math.exp(1.5) - math.exp(1.0)) * 1e15)
+        assert int(s[0]) == expected0
+        assert int(s[1]) == 2 * expected0
+        from scheduler_plugins_tpu.ops.normalize import peaks_normalize
+
+        norm = peaks_normalize(s[None, :], jnp.ones((1, 2), bool))
+        assert norm[0, 0] == 100 and norm[0, 1] == 0  # lower jump wins
+
+
+class TestTrimaranCycle:
+    def cluster(self):
+        c = Cluster()
+        gib = 1 << 30
+        c.add_node(Node(name="hot", allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+        c.add_node(Node(name="cold", allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+        c.node_metrics = {
+            "hot": {"cpu_avg": 70.0, "cpu_std": 5.0, "mem_avg": 50.0},
+            "cold": {"cpu_avg": 10.0, "cpu_std": 1.0, "mem_avg": 10.0},
+        }
+        return c
+
+    def test_tlp_prefers_node_near_target(self):
+        c = self.cluster()
+        c.add_pod(Pod(name="p", containers=[Container(requests={CPU: 1000})]))
+        sched = Scheduler(Profile(plugins=[TargetLoadPacking()]))
+        report = run_cycle(sched, c, now=1000)
+        # cold: predicted (1000+1500)/10000=25% -> rising ~77; hot: 85% falling -> 10
+        assert report.bound["default/p"] == "cold"
+
+    def test_lvrb_prefers_low_variance(self):
+        c = self.cluster()
+        c.add_pod(Pod(name="p", containers=[Container(requests={CPU: 1000})]))
+        sched = Scheduler(Profile(plugins=[LoadVariationRiskBalancing()]))
+        report = run_cycle(sched, c, now=1000)
+        assert report.bound["default/p"] == "cold"
+
+    def test_lroc_runs_and_prefers_unloaded(self):
+        c = self.cluster()
+        # hot node carries allocated load (8 cores requested, 9 limit) so its
+        # alloc threshold and overcommit potential are both worse than cold's
+        resident = Pod(
+            name="resident",
+            containers=[Container(requests={CPU: 8000}, limits={CPU: 9000})],
+        )
+        resident.node_name = "hot"
+        c.add_pod(resident)
+        c.add_pod(
+            Pod(name="p", containers=[Container(requests={CPU: 1000}, limits={CPU: 20_000})])
+        )
+        sched = Scheduler(Profile(plugins=[LowRiskOverCommitment()]))
+        report = run_cycle(sched, c, now=1000)
+        assert report.bound["default/p"] == "cold"
+
+    def test_peaks_prefers_flat_power_model(self):
+        c = self.cluster()
+        c.add_pod(Pod(name="p", containers=[Container(requests={CPU: 1000})]))
+        sched = Scheduler(
+            Profile(plugins=[Peaks(node_power_model={
+                "hot": (100.0, 5.0, 0.03), "cold": (100.0, 1.0, 0.01),
+            })])
+        )
+        report = run_cycle(sched, c, now=1000)
+        assert report.bound["default/p"] == "cold"
+
+    def test_recent_binding_compensation(self):
+        c = self.cluster()
+        c.add_pod(Pod(name="p1", containers=[Container(requests={CPU: 2000})], creation_ms=1))
+        sched = Scheduler(Profile(plugins=[TargetLoadPacking()]))
+        run_cycle(sched, c, now=1000)
+        # p1 bound to cold; its 3000m predicted load is missing from metrics
+        snap, meta = c.snapshot(c.pending_pods(), now_ms=2000)
+        cold = meta.node_names.index("cold")
+        assert int(snap.metrics.missing_cpu_millis[cold]) == 3000
+        # after the reporting interval it ages out
+        snap2, _ = c.snapshot(c.pending_pods(), now_ms=70_000)
+        assert int(snap2.metrics.missing_cpu_millis[cold]) == 0
