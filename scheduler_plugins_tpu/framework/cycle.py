@@ -43,6 +43,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     )
 
     _expire_gangs(cluster, now, report)
+    _resync_nrt_cache(cluster)
 
     pending = cluster.pending_pods()
     if not pending:
@@ -102,7 +103,38 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
             continue  # a subsequent pod may still complete the quorum
         _reject_gang(cluster, pg, now, report, cosched, len(members))
 
+    _mark_overreserved_on_failures(cluster, report)
     return report
+
+
+def _resync_nrt_cache(cluster: Cluster):
+    """Drive the over-reserve cache's resync loop (the reference's background
+    `wait.Forever(Resync, period)` goroutine, pluginhelpers.go:73): reconcile
+    dirty nodes against their latest agent reports."""
+    cache = cluster.nrt_cache
+    if cache is None or not hasattr(cache, "resync"):
+        return
+    if not cache.desynced_nodes():
+        return
+    node_pods: dict[str, list] = {}
+    for pod in cluster.pods.values():
+        if pod.node_name is not None:
+            node_pods.setdefault(pod.node_name, []).append(pod)
+    cache.resync(node_pods)
+
+
+def _mark_overreserved_on_failures(cluster: Cluster, report: CycleReport):
+    """Filter failures on cached views may mean the deduction is stale
+    (filter.go:219-223 NodeMaybeOverReserved): mark every node carrying
+    assumed pods dirty so the next resync reconciles it."""
+    cache = cluster.nrt_cache
+    if not report.failed or cache is None:
+        return
+    if not hasattr(cache, "mark_maybe_overreserved") or not hasattr(cache, "assumed"):
+        return
+    for node, assumed in cache.assumed.items():
+        if assumed:
+            cache.mark_maybe_overreserved(node)
 
 
 def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport, now: int = 0):

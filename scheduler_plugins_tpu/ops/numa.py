@@ -1,0 +1,302 @@
+"""NUMA-aware fitting and scoring kernels (NodeResourceTopology).
+
+Reference: /root/reference/pkg/noderesourcetopology — the largest component
+(SURVEY.md §2.6). The per-node × per-container × per-resource × per-zone Go
+loops become fixed-shape boolean algebra over the (Z, R) zone tensors; all
+functions here operate on ONE node's zone block and are `jax.vmap`-ed over
+nodes by the plugin.
+
+Semantics mapped bit-for-bit:
+- `feasible_zones`      resourcesAvailableInAnyNUMANodes (filter.go:90-160):
+  per-resource zone bitmask AND, early reject on node-level absence, QoS
+  gating (isResourceSetSuitable, numaresources.go:137-142), host-level
+  resource bypass (numaresources.go:105-121).
+- `single_numa_fit`     container-scope handler (filter.go:39-78): init
+  containers checked without subtraction (they run serially), app containers
+  subtract their grant from the chosen (lowest-id) zone.
+- strategy scores       least/most/balanced per zone over the requested
+  resources (least_allocated.go, most_allocated.go, balanced_allocation.go);
+  node score = zero-skipping min over zones (score.go:110-124); container
+  scope = float mean over containers (score.go:152-165).
+- `least_numa_*`        minimal-k zone-combination search with average
+  inter-zone distance preference (least_numa.go:40-258).
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.resources import (
+    CPU,
+    EPHEMERAL_STORAGE,
+    MEMORY,
+    ResourceIndex,
+)
+from scheduler_plugins_tpu.utils.intmath import go_div
+
+MAX_NODE_SCORE = 100
+MAX_DISTANCE = 255.0  # least_numa.go:32
+
+
+# ---------------------------------------------------------------------------
+# static (host-side) resource classification — numaresources.go:105-135
+# ---------------------------------------------------------------------------
+
+
+def numa_affine_mask(index: ResourceIndex) -> np.ndarray:
+    """cpu, memory and hugepages must expose NUMA affinity."""
+    out = np.zeros(len(index), bool)
+    for i, name in enumerate(index.names):
+        out[i] = name in (CPU, MEMORY) or name.startswith("hugepages-")
+    return out
+
+
+def host_level_mask(index: ResourceIndex) -> np.ndarray:
+    """ephemeral-storage, storage and non-native (extended) resources may
+    legitimately lack NUMA affinity."""
+    out = np.zeros(len(index), bool)
+    for i, name in enumerate(index.names):
+        out[i] = (
+            name in (EPHEMERAL_STORAGE, "storage")
+            or "/" in name  # extended resources are namespaced
+        )
+    return out
+
+
+@lru_cache(maxsize=16)
+def subset_masks(Z: int):
+    """All non-empty zone subsets ordered by (size, lexicographic) — the
+    enumeration order of combin.Combinations ascending bitmaskLen
+    (least_numa.go:160-174). Returns (masks (S, Z) bool, sizes (S,) int32)."""
+    masks, sizes = [], []
+    for k in range(1, Z + 1):
+        for combo in itertools.combinations(range(Z), k):
+            row = np.zeros(Z, bool)
+            row[list(combo)] = True
+            masks.append(row)
+            sizes.append(k)
+    return np.array(masks), np.array(sizes, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# filter
+# ---------------------------------------------------------------------------
+
+
+def feasible_zones(avail, reported, zone_mask, node_alloc, guaranteed, req,
+                   affine, host_level):
+    """(Z,) feasible-zone mask + scalar ok for one request on one node.
+
+    Mirrors resourcesAvailableInAnyNUMANodes: zero-qty resources ignored;
+    node-level absence is an early reject; a resource reported by no zone
+    passes only if host-level; non-guaranteed pods skip the quantity check
+    for NUMA-affine resources.
+    """
+    relevant = req > 0  # (R,) — zero-qty requests are ignored (filter.go:100-104)
+    present = node_alloc > 0
+    early_reject = jnp.any(relevant & ~present)
+
+    reported_z = reported & zone_mask[:, None]  # (Z, R)
+    suitable = (~guaranteed & affine[None, :]) | (avail >= req[None, :])
+    per_resource = reported_z & suitable  # (Z, R)
+    has_affinity = jnp.any(reported_z, axis=0)  # (R,)
+    # resource constrains the bitmask unless it's irrelevant, or unreported
+    # but host-level
+    constrain = relevant & ~(~has_affinity & host_level)
+    feasible = jnp.all(
+        jnp.where(constrain[None, :], per_resource, True), axis=1
+    ) & zone_mask
+    ok = ~early_reject & feasible.any()
+    return feasible, ok
+
+
+def single_numa_fit(avail, reported, zone_mask, node_alloc, guaranteed,
+                    creq, is_init, cmask, affine, host_level):
+    """Container-scope single-numa-node Filter verdict for one node.
+
+    creq: (C, R) per-container requests (init containers first); app
+    containers subtract their grant from the chosen zone before the next
+    container (filter.go:39-78).
+    """
+    C = creq.shape[0]
+    Z = avail.shape[0]
+    ok = jnp.bool_(True)
+    for c in range(C):
+        feasible, ok_c = feasible_zones(
+            avail, reported, zone_mask, node_alloc, guaranteed, creq[c],
+            affine, host_level,
+        )
+        applies = cmask[c]
+        ok &= ~applies | ok_c
+        # chosen zone: lowest feasible NUMA id (filter.go:152-157)
+        zone = jnp.argmax(feasible)
+        subtract = applies & ok_c & ~is_init[c]
+        grant = jnp.where(
+            subtract & (jnp.arange(Z) == zone)[:, None] & reported,
+            creq[c][None, :],
+            0,
+        )
+        avail = avail - grant
+    return ok
+
+
+def pod_scope_fit(avail, reported, zone_mask, node_alloc, guaranteed, req,
+                  affine, host_level):
+    """Pod-scope single-numa-node Filter: the pod-effective request must fit
+    one zone (filter.go:162-173)."""
+    _, ok = feasible_zones(
+        avail, reported, zone_mask, node_alloc, guaranteed, req, affine,
+        host_level,
+    )
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# strategy scores (LeastAllocated / MostAllocated / BalancedAllocation)
+# ---------------------------------------------------------------------------
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+BALANCED_ALLOCATION = "BalancedAllocation"
+LEAST_NUMA_NODES = "LeastNUMANodes"
+
+
+def _weighted_zone_score(per_resource, relevant, weights):
+    """sum_r score_r * w_r / sum_r w_r over the requested resources."""
+    w = jnp.where(relevant, weights, 0)
+    wsum = jnp.maximum(jnp.sum(w), 1)
+    return go_div(jnp.sum(per_resource * w, axis=-1), wsum)
+
+
+def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
+    """(Z,) per-zone scores for one request on one node."""
+    cap = avail  # zone "allocatable" = published available (pluginhelpers.go)
+    if strategy == LEAST_ALLOCATED:
+        per = jnp.where(
+            (cap == 0) | (req[None, :] > cap),
+            0,
+            (cap - req[None, :]) * MAX_NODE_SCORE // jnp.maximum(cap, 1),
+        )
+        scores = _weighted_zone_score(per, relevant, weights)
+    elif strategy == MOST_ALLOCATED:
+        per = jnp.where(
+            (cap == 0) | (req[None, :] > cap),
+            0,
+            req[None, :] * MAX_NODE_SCORE // jnp.maximum(cap, 1),
+        )
+        scores = _weighted_zone_score(per, relevant, weights)
+    elif strategy == BALANCED_ALLOCATION:
+        fraction = jnp.where(
+            cap == 0, 1.0, req[None, :].astype(jnp.float64) / jnp.maximum(cap, 1)
+        )
+        over = jnp.any(relevant[None, :] & (fraction > 1.0), axis=1)
+        n = jnp.maximum(jnp.sum(relevant), 1)
+        mean = jnp.sum(jnp.where(relevant[None, :], fraction, 0.0), axis=1) / n
+        sq = jnp.sum(
+            jnp.where(relevant[None, :], (fraction - mean[:, None]) ** 2, 0.0),
+            axis=1,
+        )
+        # gonum stat.Variance is the unbiased sample variance (N-1 divisor)
+        variance = jnp.where(n > 1, sq / jnp.maximum(n - 1, 1), 0.0)
+        scores = jnp.where(
+            over, 0, jnp.trunc((1.0 - variance) * MAX_NODE_SCORE).astype(jnp.int64)
+        )
+    else:  # pragma: no cover
+        raise ValueError(f"illegal scoring strategy {strategy}")
+    return jnp.where(zone_mask, scores, 0)
+
+
+def min_over_zones(scores, zone_mask):
+    """Zero-skipping min (score.go:110-124): zones scoring 0 are ignored by
+    the kubelet, so 0 only results when every zone scored 0."""
+    nonzero = zone_mask & (scores != 0)
+    min_nonzero = jnp.min(jnp.where(nonzero, scores, jnp.int64(2**62)))
+    return jnp.where(nonzero.any(), min_nonzero, 0)
+
+
+# ---------------------------------------------------------------------------
+# LeastNUMANodes
+# ---------------------------------------------------------------------------
+
+
+def _subset_distances(distances, masks, sizes):
+    """(S,) average pairwise distance per subset (nodesAvgDistance,
+    least_numa.go:117-139): sum of costs over the full subset product divided
+    by |subset|^2. Missing costs were defaulted at snapshot build."""
+    m = masks.astype(jnp.float64)  # (S, Z)
+    pair_sums = jnp.einsum("sz,zy,sy->s", m, distances.astype(jnp.float64), m)
+    return pair_sums / jnp.maximum(sizes.astype(jnp.float64) ** 2, 1.0)
+
+
+def least_numa_required(avail, reported, zone_mask, distances, guaranteed,
+                        req, affine, masks, sizes):
+    """(count, is_min_avg_distance, ok, chosen_mask (Z,)) for one request.
+
+    numaNodesRequired (least_numa.go:158-258): smallest k such that a k-zone
+    combination fits; within that k, a combination achieving the minimal
+    average distance over ALL k-subsets wins the bonus; otherwise the fitting
+    combination with the smallest distance is chosen.
+    """
+    S, Z = masks.shape
+    relevant = req > 0
+
+    # validity: every zone of the subset must report every requested resource
+    # (isValidCombineResources) and contain only real zones
+    zone_reports_all = jnp.all(
+        jnp.where(relevant[None, :], reported, True), axis=1
+    )  # (Z,)
+    valid = jnp.all(~masks | (zone_reports_all & zone_mask)[None, :], axis=1)
+
+    combined = masks.astype(jnp.int64) @ jnp.where(
+        reported, avail, 0
+    )  # (S, R) summed availability
+    suitable = (~guaranteed & affine[None, :]) | (combined >= req[None, :])
+    fits = valid & jnp.all(jnp.where(relevant[None, :], suitable, True), axis=1)
+
+    dist = _subset_distances(distances, masks, sizes)  # (S,)
+    big = jnp.float64(1e18)
+
+    # per subset-size k: min distance over ALL valid-size subsets of that k
+    # (minAvgDistanceInCombinations runs over every combination of that size)
+    ks = sizes
+    min_dist_per_k = jnp.min(
+        jnp.where(ks[None, :] == ks[:, None], dist[None, :], big), axis=1
+    )  # (S,) min distance among subsets with the same size
+
+    # smallest fitting k
+    kmin = jnp.min(jnp.where(fits, ks, jnp.int32(Z + 1)))
+    ok = kmin <= Z
+    in_k = fits & (ks == kmin)
+    # prefer distance == min over all subsets of size kmin; among those the
+    # generation order (lowest index) wins, matching the early return
+    is_min = in_k & (dist == min_dist_per_k)
+    pick_pool = jnp.where(is_min.any(), is_min, in_k)
+    # lowest-distance fitting subset fallback, ties by generation order
+    order_penalty = jnp.arange(S, dtype=jnp.float64) * 1e-9
+    pick = jnp.argmin(jnp.where(pick_pool, dist + order_penalty, big))
+    chosen = masks[pick] & ok
+    return (
+        jnp.where(ok, kmin, 0).astype(jnp.int32),
+        is_min.any(),
+        ok,
+        chosen,
+    )
+
+
+def least_numa_normalize(count, is_min_distance, max_numa):
+    """normalizeScore (least_numa.go:91-102)."""
+    per_numa = MAX_NODE_SCORE // jnp.maximum(max_numa, 1)
+    score = MAX_NODE_SCORE - count * per_numa
+    return jnp.where(is_min_distance, score + per_numa // 2, score)
+
+
+def only_non_numa(reported, zone_mask, req):
+    """onlyNonNUMAResources: every requested resource is unreported by every
+    zone (least_numa.go:262-273)."""
+    relevant = req > 0
+    reported_any = jnp.any(reported & zone_mask[:, None], axis=0)
+    return ~jnp.any(relevant & reported_any)

@@ -14,6 +14,9 @@ from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling  # noqa: F40
 from scheduler_plugins_tpu.plugins.noderesources import (  # noqa: F401
     NodeResourcesAllocatable,
 )
+from scheduler_plugins_tpu.plugins.noderesourcetopology import (  # noqa: F401
+    NodeResourceTopologyMatch,
+)
 from scheduler_plugins_tpu.plugins.podstate import PodState  # noqa: F401
 from scheduler_plugins_tpu.plugins.qos import QOSSort  # noqa: F401
 from scheduler_plugins_tpu.plugins.trimaran import (  # noqa: F401

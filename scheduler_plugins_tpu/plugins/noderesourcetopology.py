@@ -1,0 +1,235 @@
+"""NodeResourceTopologyMatch — NUMA-aware Filter + Score.
+
+Reference: /root/reference/pkg/noderesourcetopology (plugin.go:79-83 extension
+points; SURVEY.md §2.6). The per-node cache tier (OverReserve / Passthrough /
+DiscardReserved) is host-side bookkeeping implemented in
+`state.nrt_cache`; this plugin consumes whatever zone availability the
+snapshot carries and contributes:
+
+- Filter: only for nodes whose topology-manager policy is single-numa-node
+  (filter.go:176-225) — container-scope handler with sequential subtraction
+  or pod-scope handler, selected per node by the NRT-mirrored scope.
+- Score: non-guaranteed pods always score 100 (score.go:72-75); nodes without
+  NRT data score 0; strategies LeastAllocated / MostAllocated /
+  BalancedAllocation / LeastNUMANodes with per-node scope handling.
+
+All zone math is vmapped over nodes from `ops.numa` single-node kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    QOSClass,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
+)
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops import numa as numa_ops
+from scheduler_plugins_tpu.ops.numa import (
+    BALANCED_ALLOCATION,
+    LEAST_ALLOCATED,
+    LEAST_NUMA_NODES,
+    MOST_ALLOCATED,
+)
+
+STRATEGIES = (
+    LEAST_ALLOCATED,
+    MOST_ALLOCATED,
+    BALANCED_ALLOCATION,
+    LEAST_NUMA_NODES,
+)
+
+
+class NodeResourceTopologyMatch(Plugin):
+    name = "NodeResourceTopologyMatch"
+
+    def __init__(
+        self,
+        scoring_strategy: str = LEAST_ALLOCATED,
+        resources: Sequence[tuple[str, int]] = (),
+    ):
+        if scoring_strategy not in STRATEGIES:
+            raise ValueError(f"illegal scoring strategy {scoring_strategy!r}")
+        self.strategy = scoring_strategy
+        self.resources = tuple(resources)
+        self._affine: Optional[jnp.ndarray] = None
+        self._host_level: Optional[jnp.ndarray] = None
+        self._weights: Optional[jnp.ndarray] = None
+
+    def prepare(self, meta):
+        self._affine = jnp.asarray(numa_ops.numa_affine_mask(meta.index))
+        self._host_level = jnp.asarray(numa_ops.host_level_mask(meta.index))
+        self._host_extended = jnp.asarray(
+            np.array(["/" in name for name in meta.index.names], bool)
+        )
+        w = np.ones(len(meta.index), np.int64)  # default weight 1 (score.go:49-60)
+        for name, weight in self.resources:
+            if name in meta.index and weight >= 1:
+                w[meta.index.position(name)] = weight
+        self._weights = jnp.asarray(w)
+
+    # -- Filter ----------------------------------------------------------
+    def filter(self, state, snap, p):
+        if snap.numa is None:
+            return None
+        numa = snap.numa
+        guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
+        creq = snap.pods.container_req[p]
+        is_init = snap.pods.container_is_init[p]
+        cmask = snap.pods.container_mask[p]
+        req = snap.pods.req[p]
+
+        container_ok = jax.vmap(
+            lambda avail, reported, zmask, alloc: numa_ops.single_numa_fit(
+                avail, reported, zmask, alloc, guaranteed, creq, is_init,
+                cmask, self._affine, self._host_level,
+            )
+        )(numa.available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+        pod_ok = jax.vmap(
+            lambda avail, reported, zmask, alloc: numa_ops.pod_scope_fit(
+                avail, reported, zmask, alloc, guaranteed, req,
+                self._affine, self._host_level,
+            )
+        )(numa.available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+
+        scoped = jnp.where(
+            numa.scope == int(TopologyManagerScope.POD), pod_ok, container_ok
+        )
+        # only single-numa-node policy filters (filter.go:230-241)
+        applies = numa.has_nrt & (
+            numa.policy == int(TopologyManagerPolicy.SINGLE_NUMA_NODE)
+        )
+        verdict = jnp.where(applies, scoped, True)
+        # stale cache view -> Unschedulable regardless of policy
+        # (filter.go:194-197)
+        verdict &= numa.fresh
+        # best-effort pods without extended-resource requests skip the NUMA
+        # filter entirely (filter.go:180-183 IncludeNonNative)
+        non_native = jnp.any(
+            (snap.pods.req[p] > 0) & self._host_extended
+        )
+        skip = (snap.pods.qos[p] == int(QOSClass.BEST_EFFORT)) & ~non_native
+        return jnp.where(skip, True, verdict)
+
+    # -- Score -----------------------------------------------------------
+    def score(self, state, snap, p):
+        if snap.numa is None:
+            return None
+        numa = snap.numa
+        Z = numa.available.shape[1]
+        guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
+
+        if self.strategy == LEAST_NUMA_NODES:
+            raw = self._least_numa_scores(snap, p, guaranteed)
+        else:
+            raw = self._strategy_scores(snap, p)
+
+        # nodes without NRT or with a stale cache view score 0
+        # (score.go:78-91); non-guaranteed pods always score max
+        # (score.go:72-75)
+        raw = jnp.where(numa.has_nrt & numa.fresh, raw, 0)
+        return jnp.where(guaranteed, raw, numa_ops.MAX_NODE_SCORE)
+
+    def _strategy_scores(self, snap, p):
+        numa = snap.numa
+        req = snap.pods.req[p]
+        relevant = req > 0
+        creq = snap.pods.container_req[p]
+        cmask = snap.pods.container_mask[p]
+        C = creq.shape[0]
+
+        def node_pod_scope(avail, zmask):
+            zs = numa_ops.zone_strategy_scores(
+                self.strategy, req, avail, zmask, relevant, self._weights
+            )
+            return numa_ops.min_over_zones(zs, zmask)
+
+        def node_container_scope(avail, zmask):
+            # mean over containers, float, truncated (score.go:152-165)
+            total = jnp.float64(0.0)
+            count = jnp.maximum(jnp.sum(cmask), 1)
+            for c in range(C):
+                zs = numa_ops.zone_strategy_scores(
+                    self.strategy, creq[c], avail, zmask,
+                    creq[c] > 0, self._weights,
+                )
+                s = numa_ops.min_over_zones(zs, zmask)
+                total = total + jnp.where(cmask[c], s.astype(jnp.float64), 0.0)
+            return jnp.trunc(total / count).astype(jnp.int64)
+
+        pod_scores = jax.vmap(node_pod_scope)(numa.available, numa.zone_mask)
+        cont_scores = jax.vmap(node_container_scope)(
+            numa.available, numa.zone_mask
+        )
+        return jnp.where(
+            numa.scope == int(TopologyManagerScope.POD), pod_scores, cont_scores
+        )
+
+    def _least_numa_scores(self, snap, p, guaranteed):
+        numa = snap.numa
+        Z = numa.available.shape[1]
+        masks_np, sizes_np = numa_ops.subset_masks(Z)
+        masks = jnp.asarray(masks_np)
+        sizes = jnp.asarray(sizes_np)
+        req = snap.pods.req[p]
+        creq = snap.pods.container_req[p]
+        is_init = snap.pods.container_is_init[p]
+        cmask = snap.pods.container_mask[p]
+        C = creq.shape[0]
+
+        def node_pod(avail, reported, zmask, dists, max_numa):
+            skip = numa_ops.only_non_numa(reported, zmask, req)
+            count, is_min, ok, _ = numa_ops.least_numa_required(
+                avail, reported, zmask, dists, guaranteed, req,
+                self._affine, masks, sizes,
+            )
+            score = numa_ops.least_numa_normalize(count, is_min, max_numa)
+            return jnp.where(skip, numa_ops.MAX_NODE_SCORE,
+                             jnp.where(ok, score, 0))
+
+        def node_container(avail, reported, zmask, dists, max_numa):
+            worst = jnp.int32(0)
+            all_min = jnp.bool_(True)
+            failed = jnp.bool_(False)
+            for c in range(C):
+                applies = cmask[c] & ~numa_ops.only_non_numa(
+                    reported, zmask, creq[c]
+                )
+                count, is_min, ok, chosen = numa_ops.least_numa_required(
+                    avail, reported, zmask, dists, guaranteed, creq[c],
+                    self._affine, masks, sizes,
+                )
+                failed |= applies & ~ok
+                worst = jnp.where(applies & ok, jnp.maximum(worst, count), worst)
+                all_min &= ~applies | is_min
+                # subtract the full request from every chosen zone for every
+                # container, init containers included (subtractFromNUMAs is
+                # unconditional in the least-numa loop, least_numa.go:40-64)
+                grant = jnp.where(
+                    (applies & ok) & chosen[:, None] & reported,
+                    creq[c][None, :],
+                    0,
+                )
+                avail = avail - grant
+            score = numa_ops.least_numa_normalize(worst, all_min, max_numa)
+            return jnp.where(
+                failed, 0, jnp.where(worst == 0, numa_ops.MAX_NODE_SCORE, score)
+            )
+
+        pod_scores = jax.vmap(node_pod)(
+            numa.available, numa.reported, numa.zone_mask, numa.distances,
+            numa.max_numa,
+        )
+        cont_scores = jax.vmap(node_container)(
+            numa.available, numa.reported, numa.zone_mask, numa.distances,
+            numa.max_numa,
+        )
+        return jnp.where(
+            numa.scope == int(TopologyManagerScope.POD), pod_scores, cont_scores
+        )

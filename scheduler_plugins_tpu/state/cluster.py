@@ -39,6 +39,9 @@ class Cluster:
     seccomp_profiles: dict[str, SeccompProfile] = field(default_factory=dict)
     priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
     node_metrics: Optional[dict] = None
+    #: optional NRT cache policy (state.nrt_cache); when set, snapshots read
+    #: the cache's adjusted zone view instead of the raw NRT objects
+    nrt_cache: Optional[object] = None
 
     # scheduling-runtime bookkeeping (host-only)
     reserved: dict[str, str] = field(default_factory=dict)  # uid -> node
@@ -59,9 +62,12 @@ class Cluster:
 
     def add_pod(self, pod: Pod):
         self.pods[pod.uid] = pod
+        if self.nrt_cache is not None and hasattr(self.nrt_cache, "track_pod"):
+            # foreign-pod detection (cache/foreign_pods.go:42-99)
+            self.nrt_cache.track_pod(pod)
 
     def remove_pod(self, uid: str):
-        self.reserved.pop(uid, None)
+        self.release_reservation(uid)  # notifies the NRT cache too
         self.pods.pop(uid, None)
 
     def add_pod_group(self, pg: PodGroup):
@@ -72,6 +78,8 @@ class Cluster:
 
     def add_nrt(self, nrt: NodeResourceTopology):
         self.nrts[nrt.node_name] = nrt
+        if self.nrt_cache is not None:
+            self.nrt_cache.update_nrt(nrt)
 
     def add_app_group(self, ag: AppGroup):
         self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
@@ -121,13 +129,21 @@ class Cluster:
         self.reserved.pop(uid, None)
         self.pods[uid].node_name = node_name
         self.recent_bindings[uid] = (now_ms, node_name)
+        if self.nrt_cache is not None:
+            # Reserve -> bind -> PostBind lifecycle for the NRT cache
+            self.nrt_cache.reserve(node_name, self.pods[uid])
+            self.nrt_cache.post_bind(node_name, self.pods[uid])
 
     def reserve(self, uid: str, node_name: str):
         """Permit said Wait: hold the placement without binding."""
         self.reserved[uid] = node_name
+        if self.nrt_cache is not None:
+            self.nrt_cache.reserve(node_name, self.pods[uid])
 
     def release_reservation(self, uid: str):
-        self.reserved.pop(uid, None)
+        node = self.reserved.pop(uid, None)
+        if node is not None and self.nrt_cache is not None:
+            self.nrt_cache.unreserve(node, self.pods[uid])
 
     def gang_reservations(self, pg: PodGroup) -> list[str]:
         return [
@@ -149,12 +165,13 @@ class Cluster:
         """Augment node metrics with the missing-utilization compensation
         (targetloadpacking.go:148-168): predicted CPU of pods bound within
         the metrics reporting interval, per node."""
-        if self.node_metrics is None:
-            return None
-        # GC the binding cache
+        # GC the binding cache regardless of metrics config, or it grows
+        # unboundedly on clusters without trimaran metrics
         for uid, (ts, _) in list(self.recent_bindings.items()):
             if now_ms - ts > self.BINDING_CACHE_GC_MS:
                 del self.recent_bindings[uid]
+        if self.node_metrics is None:
+            return None
         missing: dict[str, int] = {}
         for uid, (ts, node) in self.recent_bindings.items():
             pod = self.pods.get(uid)
@@ -190,13 +207,19 @@ class Cluster:
             if until > now_ms
         ]
         metrics = self._metrics_with_missing(now_ms)
+        nrt_list = list(self.nrts.values())
+        stale_nodes: list[str] = []
+        if self.nrt_cache is not None:
+            nrt_list, stale = self.nrt_cache.view()
+            stale_nodes = list(stale)
         return build_snapshot(
             list(self.nodes.values()),
             pending,
             assigned_pods=assigned,
             pod_groups=list(self.pod_groups.values()),
             quotas=list(self.quotas.values()),
-            nrts=list(self.nrts.values()),
+            nrts=nrt_list,
+            stale_nrt_nodes=stale_nodes,
             app_groups=list(self.app_groups.values()),
             node_metrics=metrics,
             backed_off_gangs=backed_off,

@@ -1,0 +1,226 @@
+"""NodeResourceTopology cache tier — host-side, event-driven bookkeeping.
+
+Reference: /root/reference/pkg/noderesourcetopology/cache (SURVEY.md §2.6).
+Three interchangeable policies select how zone availability reaches the
+snapshot between a Reserve and the node agent's next NRT report:
+
+- `PassthroughCache`    always reads the live NRT objects; always fresh
+  (cache/passthrough.go).
+- `DiscardReservedCache` blocks a node entirely between Reserve and
+  PostBind/Unreserve (reservationMap keyed node -> podUIDs,
+  cache/discardreserved.go:46-110).
+- `OverReserveCache`    the flagship: stores NRT deep-copies plus per-node
+  assumed pod requests; the view deducts assumed resources from EVERY zone
+  pessimistically (cache/store.go:129-160, overreserve.go:101-127); nodes
+  hosting foreign pods are not fresh; a background resync accepts a node's
+  newer NRT only when the agent-stamped pod fingerprint matches the pods the
+  scheduler believes are on the node (overreserve.go:276-348), then flushes
+  and bumps the generation (overreserve.go:351-373).
+
+The pod fingerprint is functionally equivalent to the podfingerprint library:
+a stable hash over the sorted (namespace, name) pairs of the node's pods.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from scheduler_plugins_tpu.api.objects import NodeResourceTopology, Pod
+from scheduler_plugins_tpu.api.resources import add_quantities
+
+
+def compute_pod_fingerprint(pods: Iterable[tuple[str, str]]) -> str:
+    """Stable fingerprint over (namespace, name) pairs — the contract of the
+    podfingerprint library: agent and scheduler compute it independently from
+    their own view of the node's pods and compare."""
+    h = hashlib.sha256()
+    for ns, name in sorted(pods):
+        h.update(f"{ns}/{name};".encode())
+    return "pfp0v1:" + h.hexdigest()[:16]
+
+
+class NrtCache:
+    """Interface: snapshot-facing view + scheduling lifecycle hooks."""
+
+    def view(self) -> tuple[list[NodeResourceTopology], set[str]]:
+        """Returns (adjusted NRT list, stale node names)."""
+        raise NotImplementedError
+
+    def reserve(self, node: str, pod: Pod) -> None:  # Reserve
+        pass
+
+    def unreserve(self, node: str, pod: Pod) -> None:  # Unreserve
+        pass
+
+    def post_bind(self, node: str, pod: Pod) -> None:  # PostBind
+        pass
+
+    def update_nrt(self, nrt: NodeResourceTopology) -> None:  # informer event
+        raise NotImplementedError
+
+
+class PassthroughCache(NrtCache):
+    """Live API reads, always fresh (cache/passthrough.go)."""
+
+    def __init__(self):
+        self.nrts: dict[str, NodeResourceTopology] = {}
+
+    def update_nrt(self, nrt: NodeResourceTopology) -> None:
+        self.nrts[nrt.node_name] = nrt
+
+    def view(self):
+        return list(self.nrts.values()), set()
+
+
+class DiscardReservedCache(NrtCache):
+    """Node fully blocked while any reservation is in flight
+    (cache/discardreserved.go:46-110)."""
+
+    def __init__(self):
+        self.nrts: dict[str, NodeResourceTopology] = {}
+        self.reservations: dict[str, set[str]] = {}
+
+    def update_nrt(self, nrt: NodeResourceTopology) -> None:
+        self.nrts[nrt.node_name] = nrt
+
+    def reserve(self, node: str, pod: Pod) -> None:
+        self.reservations.setdefault(node, set()).add(pod.uid)
+
+    def unreserve(self, node: str, pod: Pod) -> None:
+        self._clear(node, pod)
+
+    def post_bind(self, node: str, pod: Pod) -> None:
+        self._clear(node, pod)
+
+    def _clear(self, node: str, pod: Pod) -> None:
+        uids = self.reservations.get(node)
+        if uids is not None:
+            uids.discard(pod.uid)
+            if not uids:
+                del self.reservations[node]
+
+    def view(self):
+        stale = {node for node, uids in self.reservations.items() if uids}
+        return list(self.nrts.values()), stale
+
+
+@dataclass
+class OverReserveCache(NrtCache):
+    """Pessimistic over-reservation with fingerprint-gated resync."""
+
+    #: scheduler profile names considered "ours" — running pods with a
+    #: different schedulerName mark their node foreign
+    #: (cache/foreign_pods.go:42-99)
+    our_schedulers: set[str] = field(default_factory=lambda: {"tpu-scheduler"})
+
+    def __post_init__(self):
+        self.nrts: dict[str, NodeResourceTopology] = {}  # flushed copies
+        self.pending: dict[str, NodeResourceTopology] = {}  # awaiting resync
+        self.assumed: dict[str, dict[str, dict]] = {}  # node -> uid -> req
+        self.assumed_pods: dict[str, set[tuple[str, str]]] = {}  # node -> (ns, name)
+        self.foreign: set[str] = set()
+        self.maybe_overreserved: set[str] = set()
+        self.attr_changed: set[str] = set()
+        self.generation = 0
+
+    # -- informer events -------------------------------------------------
+    def update_nrt(self, nrt: NodeResourceTopology) -> None:
+        node = nrt.node_name
+        if node not in self.nrts:
+            # first sighting: accept directly (reserve() is a no-op for
+            # nodes without a cached NRT, overreserve.go:151-163, so no
+            # stale deduction can exist yet)
+            self.nrts[node] = copy.deepcopy(nrt)
+        else:
+            if node in self.nrts and (
+                nrt.policy != self.nrts[node].policy
+                or nrt.scope != self.nrts[node].scope
+            ):
+                # kubelet config change -> must resync (cache/attr_watch.go:40-99)
+                self.attr_changed.add(node)
+            self.pending[node] = copy.deepcopy(nrt)
+
+    def track_pod(self, pod: Pod) -> None:
+        """Informer pod event: a running pod owned by another scheduler marks
+        its node foreign (cache/foreign_pods.go)."""
+        if pod.node_name and pod.scheduler_name not in self.our_schedulers:
+            self.foreign.add(pod.node_name)
+
+    # -- scheduling lifecycle -------------------------------------------
+    def reserve(self, node: str, pod: Pod) -> None:
+        if node not in self.nrts:
+            # no NRT data yet: nothing to over-reserve against
+            # (overreserve.go:151-163)
+            return
+        self.assumed.setdefault(node, {})[pod.uid] = pod.effective_request()
+        self.assumed_pods.setdefault(node, set()).add((pod.namespace, pod.name))
+
+    def unreserve(self, node: str, pod: Pod) -> None:
+        self.assumed.get(node, {}).pop(pod.uid, None)
+        self.assumed_pods.get(node, set()).discard((pod.namespace, pod.name))
+
+    def mark_maybe_overreserved(self, node: str) -> None:
+        """Filter failure on a cached view: the deduction may be stale
+        (filter.go:220-223)."""
+        self.maybe_overreserved.add(node)
+
+    # -- view ------------------------------------------------------------
+    def view(self):
+        out = []
+        for node, nrt in self.nrts.items():
+            total = {}
+            for req in self.assumed.get(node, {}).values():
+                total = add_quantities(total, req)
+            if total:
+                adjusted = copy.deepcopy(nrt)
+                for zone in adjusted.zones:
+                    # deduct assumed from EVERY zone pessimistically
+                    # (cache/store.go:129-160)
+                    zone.available = {
+                        name: qty - total.get(name, 0)
+                        for name, qty in zone.available.items()
+                    }
+                out.append(adjusted)
+            else:
+                out.append(nrt)
+        return out, set(self.foreign)
+
+    # -- resync loop -----------------------------------------------------
+    def desynced_nodes(self) -> set[str]:
+        """dirty = foreign ∪ maybe-overreserved ∪ attr-changed
+        (GetDesyncedNodes, overreserve.go:212-245)."""
+        return self.foreign | self.maybe_overreserved | self.attr_changed
+
+    def resync(self, node_pods: dict[str, list[Pod]]) -> list[str]:
+        """One resync pass: for each dirty node with a pending NRT, accept it
+        only when the agent-stamped fingerprint matches the pods the
+        scheduler knows on that node (overreserve.go:276-348). Returns the
+        flushed node names; bumps the generation once if any flushed."""
+        flushed = []
+        for node in sorted(self.desynced_nodes()):
+            candidate = self.pending.get(node)
+            if candidate is None:
+                continue
+            known = {
+                (p.namespace, p.name) for p in node_pods.get(node, [])
+            } | self.assumed_pods.get(node, set())
+            expected = compute_pod_fingerprint(known)
+            if not candidate.pod_fingerprint:
+                continue  # no fingerprint data: refuse (overreserve.go:306-310)
+            if candidate.pod_fingerprint != expected:
+                continue  # agent hasn't caught up; keep the cached view
+            self.nrts[node] = candidate
+            del self.pending[node]
+            # the agent's report embeds every pod we assumed -> drop them
+            self.assumed.pop(node, None)
+            self.assumed_pods.pop(node, None)
+            self.foreign.discard(node)
+            self.maybe_overreserved.discard(node)
+            self.attr_changed.discard(node)
+            flushed.append(node)
+        if flushed:
+            self.generation += 1  # overreserve.go:369
+        return flushed

@@ -76,6 +76,12 @@ class PodState:
     limits: np.ndarray  # (P, R) int64 trimaran effective limits (unclamped)
     #: (P,) TargetLoadPacking per-pod CPU prediction with default args
     predicted_cpu_millis: np.ndarray
+    #: (P, C, R) raw per-container requests, init containers first — the NUMA
+    #: container-scope Filter/Score iterate containers individually
+    #: (filter.go:39-78, score.go:152-165)
+    container_req: np.ndarray
+    container_is_init: np.ndarray  # (P, C) bool
+    container_mask: np.ndarray  # (P, C) bool
     priority: np.ndarray  # (P,) int64
     ns: np.ndarray  # (P,) int32 namespace code
     gang: np.ndarray  # (P,) int32 gang code (-1 = not in a PodGroup)
@@ -146,6 +152,12 @@ class NumaState:
     scope: np.ndarray  # (N,) int32 TopologyManagerScope
     distances: np.ndarray  # (N, Z, Z) int32 SLIT costs (default 10)
     has_nrt: np.ndarray  # (N,) bool
+    #: (N,) cache freshness: not-fresh nodes are Unschedulable for any
+    #: non-best-effort pod (filter.go:194-197) and score 0
+    fresh: np.ndarray
+    #: (N,) per-node topology-manager MaxNUMANodes (LeastNUMA normalization,
+    #: least_numa.go:88-102; default 8)
+    max_numa: np.ndarray
 
 
 @struct.dataclass
@@ -262,6 +274,7 @@ def build_snapshot(
     pad_pods: Optional[int] = None,
     backed_off_gangs: Sequence[str] = (),
     extra_pods: Sequence[Pod] = (),
+    stale_nrt_nodes: Sequence[str] = (),
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -428,6 +441,16 @@ def build_snapshot(
     preq = np.zeros((P, R), I64)
     plimits = np.zeros((P, R), I64)
     ppredicted = np.zeros(P, I64)
+    C = max(
+        max(
+            (len(p.init_containers) + len(p.containers) for p in pending_pods),
+            default=1,
+        ),
+        1,
+    )
+    pcreq = np.zeros((P, C, R), I64)
+    pcinit = np.zeros((P, C), bool)
+    pcmask = np.zeros((P, C), bool)
     ppriority = np.zeros(P, I64)
     pns = np.zeros(P, I32)
     pgang = np.full(P, -1, I32)
@@ -439,6 +462,10 @@ def build_snapshot(
         preq[i] = index.encode(pod.effective_request())
         plimits[i] = index.encode(pod.effective_limits())
         ppredicted[i] = pod.tlp_predicted_cpu_millis()
+        for c, cont in enumerate(list(pod.init_containers) + list(pod.containers)):
+            pcreq[i, c] = index.encode(cont.requests)
+            pcinit[i, c] = c < len(pod.init_containers)
+            pcmask[i, c] = True
         ppriority[i] = pod.priority
         pns[i] = ns_in.code(pod.namespace)
         pgang[i] = _gang_of(pod)
@@ -450,6 +477,9 @@ def build_snapshot(
         req=preq,
         limits=plimits,
         predicted_cpu_millis=ppredicted,
+        container_req=pcreq,
+        container_is_init=pcinit,
+        container_mask=pcmask,
         priority=ppriority,
         ns=pns,
         gang=pgang,
@@ -535,6 +565,11 @@ def build_snapshot(
         scope = np.zeros(N, I32)
         distances = np.full((N, Z, Z), 10, I32)
         has_nrt = np.zeros(N, bool)
+        nrt_fresh = np.ones(N, bool)
+        max_numa = np.full(N, 8, I32)
+        for name in stale_nrt_nodes:
+            if name in node_pos:
+                nrt_fresh[node_pos[name]] = False
         for t in nrts:
             if t.node_name not in node_pos:
                 continue
@@ -542,6 +577,7 @@ def build_snapshot(
             has_nrt[i] = True
             policy[i] = int(t.policy)
             scope[i] = int(t.scope)
+            max_numa[i] = t.max_numa_nodes
             for zinfo in t.zones:
                 z = zinfo.numa_id
                 z_mask[i, z] = True
@@ -561,6 +597,8 @@ def build_snapshot(
             scope=scope,
             distances=distances,
             has_nrt=has_nrt,
+            fresh=nrt_fresh,
+            max_numa=max_numa,
         )
 
     snapshot = ClusterSnapshot(

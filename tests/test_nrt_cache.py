@@ -1,0 +1,143 @@
+"""NRT cache tier tests — the overreserve/discardreserved/passthrough state
+machines (mirrors cache/overreserve_test.go, discardreserved_test.go)."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    TopologyManagerPolicy,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.state.nrt_cache import (
+    DiscardReservedCache,
+    OverReserveCache,
+    PassthroughCache,
+    compute_pod_fingerprint,
+)
+
+gib = 1 << 30
+
+
+def mknrt(node, cpu_per_zone=4000, fingerprint=""):
+    return NodeResourceTopology(
+        node_name=node,
+        zones=[
+            NUMAZone(numa_id=i, available={CPU: cpu_per_zone, MEMORY: 16 * gib})
+            for i in range(2)
+        ],
+        policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+        pod_fingerprint=fingerprint,
+    )
+
+
+def gpod(name, cpu=1000, node=None):
+    p = Pod(
+        name=name,
+        containers=[
+            Container(requests={CPU: cpu, MEMORY: gib}, limits={CPU: cpu, MEMORY: gib})
+        ],
+    )
+    p.node_name = node
+    return p
+
+
+class TestOverReserve:
+    def test_view_deducts_assumed_from_all_zones(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        cache.reserve("n0", gpod("p1", cpu=1500))
+        nrts, stale = cache.view()
+        assert not stale
+        for zone in nrts[0].zones:
+            assert zone.available[CPU] == 2500  # pessimistic: every zone
+
+    def test_foreign_pod_marks_node_stale(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        alien = gpod("alien", node="n0")
+        alien.scheduler_name = "default-scheduler"
+        cache.track_pod(alien)
+        _, stale = cache.view()
+        assert stale == {"n0"}
+        assert cache.desynced_nodes() == {"n0"}
+
+    def test_resync_requires_matching_fingerprint(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1", node="n0")
+        cache.reserve("n0", pod)
+        cache.mark_maybe_overreserved("n0")
+        # agent publishes a new NRT with a fingerprint NOT including p1
+        cache.update_nrt(mknrt("n0", cpu_per_zone=3000,
+                               fingerprint=compute_pod_fingerprint([])))
+        assert cache.resync({"n0": []}) == []  # mismatch: still dirty
+        assert "n0" in cache.desynced_nodes()
+        # agent catches up: fingerprint covers p1
+        fp = compute_pod_fingerprint([("default", "p1")])
+        cache.update_nrt(mknrt("n0", cpu_per_zone=3000, fingerprint=fp))
+        assert cache.resync({"n0": []}) == ["n0"]
+        assert cache.generation == 1
+        nrts, stale = cache.view()
+        assert not stale
+        # assumed dropped; flushed view is the agent's report
+        assert nrts[0].zones[0].available[CPU] == 3000
+
+    def test_attribute_change_marks_dirty(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        changed = mknrt("n0")
+        changed.policy = TopologyManagerPolicy.RESTRICTED
+        cache.update_nrt(changed)
+        assert "n0" in cache.desynced_nodes()
+
+
+class TestDiscardReserved:
+    def test_node_blocked_between_reserve_and_postbind(self):
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1")
+        cache.reserve("n0", pod)
+        _, stale = cache.view()
+        assert stale == {"n0"}
+        cache.post_bind("n0", pod)
+        _, stale = cache.view()
+        assert not stale
+
+
+class TestPassthrough:
+    def test_always_fresh_live_reads(self):
+        cache = PassthroughCache()
+        cache.update_nrt(mknrt("n0"))
+        nrts, stale = cache.view()
+        assert len(nrts) == 1 and not stale
+
+
+class TestCacheInCycle:
+    def test_overreserve_blocks_second_overcommit(self):
+        # one node, zones 4000/4000; two 3-core guaranteed pods in separate
+        # cycles: after the first binds, the cached view deducts 3000 from
+        # every zone -> the second pod cannot align and fails
+        c = Cluster()
+        c.nrt_cache = OverReserveCache()
+        c.add_node(Node(name="n0", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 10}))
+        c.add_nrt(mknrt("n0"))
+        sched = Scheduler(Profile(plugins=[NodeResourceTopologyMatch()]))
+        c.add_pod(gpod("p1", cpu=3000))
+        r1 = run_cycle(sched, c, now=1000)
+        assert "default/p1" in r1.bound
+        c.add_pod(gpod("p2", cpu=3000))
+        r2 = run_cycle(sched, c, now=2000)
+        # pessimistic deduction leaves 1000 per zone -> p2 unschedulable
+        assert r2.failed == ["default/p2"]
+        # resync with an agent report covering p1 restores capacity
+        fp = compute_pod_fingerprint([("default", "p1")])
+        c.add_nrt(mknrt("n0", cpu_per_zone=4000, fingerprint=fp))
+        c.nrt_cache.mark_maybe_overreserved("n0")
+        c.nrt_cache.resync({"n0": [c.pods["default/p1"]]})
+        r3 = run_cycle(sched, c, now=3000)
+        assert "default/p2" in r3.bound

@@ -1,0 +1,226 @@
+"""NodeResourceTopology decision tables, mirroring the reference's filter/score
+unit tests (filter_test.go, score_test.go, least_numa_test.go patterns)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS, ResourceIndex
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.ops import numa as numa_ops
+from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def guaranteed_pod(name, cpu, mem, containers=None, **kw):
+    if containers is None:
+        containers = [
+            Container(requests={CPU: cpu, MEMORY: mem}, limits={CPU: cpu, MEMORY: mem})
+        ]
+    return Pod(name=name, containers=containers, **kw)
+
+
+def nrt(node, zone_avail, policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+        scope=TopologyManagerScope.CONTAINER):
+    zones = [
+        NUMAZone(numa_id=i, available=avail, costs={j: 10 if i == j else 20 for j in range(len(zone_avail))})
+        for i, avail in enumerate(zone_avail)
+    ]
+    return NodeResourceTopology(node_name=node, zones=zones, policy=policy, scope=scope)
+
+
+def cluster_with(nrts, node_cpu=8000, node_mem=32 * gib):
+    c = Cluster()
+    for t in nrts:
+        c.add_node(
+            Node(name=t.node_name, allocatable={CPU: node_cpu, MEMORY: node_mem, PODS: 110})
+        )
+        c.add_nrt(t)
+    return c
+
+
+class TestNumaFilter:
+    def test_fits_single_zone(self):
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}]),
+        ])
+        c.add_pod(guaranteed_pod("p", 3000, 8 * gib))
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert "default/p" in r.bound
+
+    def test_split_across_zones_rejected(self):
+        # 5 cores fit the node total but no single zone -> single-numa rejects
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}]),
+        ])
+        c.add_pod(guaranteed_pod("p", 5000, 8 * gib))
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert r.failed == ["default/p"]
+
+    def test_non_guaranteed_pod_skips_numa_affine_check(self):
+        # burstable pod: cpu/mem NUMA quantities don't constrain
+        c = cluster_with([
+            nrt("n0", [{CPU: 1000, MEMORY: 1 * gib}, {CPU: 1000, MEMORY: 1 * gib}]),
+        ])
+        c.add_pod(Pod(name="p", containers=[Container(requests={CPU: 5000})]))
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert "default/p" in r.bound
+
+    def test_container_sequential_subtraction(self):
+        # two 3-core containers: each fits a zone alone, but zone 0 can't host
+        # both -> second container lands on zone 1; pod fits
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}]),
+        ])
+        pod = guaranteed_pod(
+            "p", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+            ],
+        )
+        c.add_pod(pod)
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert "default/p" in r.bound
+
+    def test_three_containers_overflow_rejected(self):
+        # 3 x 3-core guaranteed containers vs 2 zones x 4 cores -> impossible
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}]),
+        ])
+        pod = guaranteed_pod(
+            "p", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib})
+                for _ in range(3)
+            ],
+        )
+        c.add_pod(pod)
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert r.failed == ["default/p"]
+
+    def test_pod_scope_checks_whole_pod(self):
+        # pod scope: 2x3-core containers = 6 cores must fit ONE zone -> reject
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+                scope=TopologyManagerScope.POD),
+        ])
+        pod = guaranteed_pod(
+            "p", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+            ],
+        )
+        c.add_pod(pod)
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert r.failed == ["default/p"]
+
+    def test_non_single_numa_policy_passes(self):
+        c = cluster_with([
+            nrt("n0", [{CPU: 1000, MEMORY: 1 * gib}],
+                policy=TopologyManagerPolicy.BEST_EFFORT),
+        ])
+        c.add_pod(guaranteed_pod("p", 4000, 2 * gib))
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert "default/p" in r.bound
+
+
+class TestNumaScore:
+    def make_snapshot(self, strategy, zone_avail_a, zone_avail_b, pod, scope=TopologyManagerScope.CONTAINER):
+        c = cluster_with([
+            nrt("a", zone_avail_a, scope=scope),
+            nrt("b", zone_avail_b, scope=scope),
+        ])
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[NodeResourceTopologyMatch(scoring_strategy=strategy)]))
+        return c, sched
+
+    def test_least_allocated_prefers_emptier_zones(self):
+        c, sched = self.make_snapshot(
+            "LeastAllocated",
+            [{CPU: 8000, MEMORY: 16 * gib}, {CPU: 8000, MEMORY: 16 * gib}],
+            [{CPU: 2000, MEMORY: 2 * gib}, {CPU: 2000, MEMORY: 2 * gib}],
+            guaranteed_pod("p", 1000, 1 * gib),
+        )
+        r = run_cycle(sched, c, now=1000)
+        assert r.bound["default/p"] == "a"
+
+    def test_most_allocated_prefers_fuller_zones(self):
+        c, sched = self.make_snapshot(
+            "MostAllocated",
+            [{CPU: 8000, MEMORY: 16 * gib}, {CPU: 8000, MEMORY: 16 * gib}],
+            [{CPU: 2000, MEMORY: 2 * gib}, {CPU: 2000, MEMORY: 2 * gib}],
+            guaranteed_pod("p", 1000, 1 * gib),
+        )
+        r = run_cycle(sched, c, now=1000)
+        assert r.bound["default/p"] == "b"
+
+    def test_non_guaranteed_scores_max_everywhere(self):
+        c, sched = self.make_snapshot(
+            "LeastAllocated",
+            [{CPU: 8000, MEMORY: 16 * gib}],
+            [{CPU: 100, MEMORY: 1 * gib}],
+            Pod(name="p", containers=[Container(requests={CPU: 100})], creation_ms=5),
+        )
+        r = run_cycle(sched, c, now=1000)
+        # both nodes score 100 -> tie-break lowest index ("a")
+        assert r.bound["default/p"] == "a"
+
+    def test_least_numa_prefers_fewer_zones(self):
+        # node a: fits in 1 zone; node b: needs 2 zones
+        c, sched = self.make_snapshot(
+            "LeastNUMANodes",
+            [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+            [{CPU: 2000, MEMORY: 8 * gib}, {CPU: 2000, MEMORY: 8 * gib}],
+            guaranteed_pod("p", 3000, 4 * gib),
+        )
+        r = run_cycle(sched, c, now=1000)
+        assert r.bound["default/p"] == "a"
+
+
+class TestLeastNumaOps:
+    def test_subset_enumeration_order(self):
+        masks, sizes = numa_ops.subset_masks(3)
+        assert sizes.tolist() == [1, 1, 1, 2, 2, 2, 3]
+        assert masks[3].tolist() == [True, True, False]  # first pair = {0,1}
+
+    def test_required_count_and_distance_preference(self):
+        # 4 zones, 2+2 core each; request 4000 -> k=2; zones {0,1} (distance
+        # 10/11 local) beat {0,2}
+        Z = 4
+        avail = jnp.array([[2000], [2000], [2000], [2000]], jnp.int64)
+        reported = jnp.ones((Z, 1), bool)
+        zmask = jnp.ones(Z, bool)
+        dists = jnp.full((Z, Z), 20, jnp.int32)
+        dists = dists.at[jnp.arange(Z), jnp.arange(Z)].set(10)
+        dists = dists.at[0, 1].set(11).at[1, 0].set(11)  # 0-1 close
+        masks, sizes = numa_ops.subset_masks(Z)
+        count, is_min, ok, chosen = numa_ops.least_numa_required(
+            avail, reported, zmask, dists, jnp.bool_(True),
+            jnp.array([4000], jnp.int64), jnp.array([True]),
+            jnp.asarray(masks), jnp.asarray(sizes),
+        )
+        assert bool(ok) and int(count) == 2
+        assert chosen.tolist() == [True, True, False, False]
+        assert bool(is_min)
+
+    def test_normalize(self):
+        assert int(numa_ops.least_numa_normalize(1, False, 8)) == 88
+        assert int(numa_ops.least_numa_normalize(1, True, 8)) == 94
+        assert int(numa_ops.least_numa_normalize(4, False, 8)) == 52
