@@ -51,7 +51,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     pending = scheduler.sort_pending(pending, cluster)
 
     snap, meta = cluster.snapshot(pending, now_ms=now)
-    scheduler.prepare(meta)
+    scheduler.prepare(meta, cluster)
     result = scheduler.solve(snap)
 
     assignment = np.asarray(result.assignment)

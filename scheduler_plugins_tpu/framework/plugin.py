@@ -45,6 +45,12 @@ class SolverState:
     #: the MinResources cluster check (the gang's own pods don't count
     #: against it, core.go:433-467)
     gang_inflight: Optional[jnp.ndarray] = None
+    #: (W, N) live placed-pod counts per AppGroup workload — in-cycle
+    #: placements must be visible to later pods' network tallies
+    net_placed: Optional[jnp.ndarray] = None
+    #: (N, Z, R) live NUMA zone availability with in-cycle placements
+    #: pessimistically deducted from every zone of the chosen node
+    numa_avail: Optional[jnp.ndarray] = None
 
 
 class Plugin:
@@ -58,6 +64,19 @@ class Plugin:
 
     def prepare(self, meta: SnapshotMeta) -> None:
         """Bake per-snapshot-layout constants (resource weights, arg vectors)."""
+
+    def aux(self):
+        """Per-cycle array inputs (weight vectors, cost matrices) that must be
+        TRACED into the solve rather than closure-captured — jit caches the
+        traced program by shape, so closure-captured arrays would be
+        constant-folded and silently go stale when config or name<->code
+        layouts change between cycles. Return a pytree of arrays or None."""
+        return None
+
+    def bind_aux(self, aux) -> None:
+        """Called inside the traced solve with this plugin's aux pytree (as
+        tracers); tensor methods read `self._aux`."""
+        self._aux = aux
 
     # --- host-side -------------------------------------------------------
     def queue_key(self, pod, cluster):  # pragma: no cover - trivial default

@@ -52,7 +52,9 @@ class Profile:
     def __post_init__(self):
         if self.queue_sort is None:
             for plugin in self.plugins:
-                if type(plugin).queue_key is not Plugin.queue_key:
+                if type(plugin).queue_key is not Plugin.queue_key or hasattr(
+                    plugin, "queue_compare"
+                ):
                     self.queue_sort = plugin
                     break
 
@@ -71,8 +73,19 @@ class Scheduler:
     # -- queue ----------------------------------------------------------
     def sort_pending(self, pods, cluster=None):
         """QueueSort: order the pending list with the profile's comparator
-        (default: upstream PrioritySort — priority desc, then queue time)."""
+        (default: upstream PrioritySort — priority desc, then queue time).
+        Plugins exposing a pairwise `queue_compare` (TopologicalSort) are
+        used via cmp_to_key, preserving exact Less() semantics."""
         qs = self.profile.queue_sort
+        if qs is not None and hasattr(qs, "queue_compare"):
+            import functools
+
+            return sorted(
+                pods,
+                key=functools.cmp_to_key(
+                    lambda a, b: qs.queue_compare(a, b, cluster)
+                ),
+            )
 
         def key(pod):
             if qs is not None:
@@ -84,9 +97,11 @@ class Scheduler:
         return sorted(pods, key=key)
 
     # -- solve ----------------------------------------------------------
-    def prepare(self, meta: SnapshotMeta):
+    def prepare(self, meta: SnapshotMeta, cluster=None):
         for plugin in self.profile.plugins:
             plugin.prepare(meta)
+            if hasattr(plugin, "prepare_cluster"):
+                plugin.prepare_cluster(meta, cluster)
 
     def _make_solve(self):
         plugins = tuple(self.profile.plugins)
@@ -127,7 +142,13 @@ class Scheduler:
                 state = plugin.commit(state, snap, p, choice)
             return state, (choice, ok)
 
-        def solve(snap: ClusterSnapshot, state0: SolverState) -> SolveResult:
+        def solve(
+            snap: ClusterSnapshot, state0: SolverState, auxes
+        ) -> SolveResult:
+            # bind per-plugin traced aux inputs (weight vectors, cost
+            # matrices) so they are solve ARGUMENTS, not baked constants
+            for plugin, aux in zip(plugins, auxes):
+                plugin.bind_aux(aux)
             P = snap.num_pods
             state, (assignment, admitted) = jax.lax.scan(
                 lambda c, p: step(c, p, snap), state0, jnp.arange(P)
@@ -153,10 +174,11 @@ class Scheduler:
         """Run the fused plugin pipeline over the snapshot's pending batch."""
         if state0 is None:
             state0 = self.initial_state(snap)
+        auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
         key = "solve"
         if key not in self._solve_cache:
             self._solve_cache[key] = self._make_solve()
-        return self._solve_cache[key](snap, state0)
+        return self._solve_cache[key](snap, state0, auxes)
 
     def initial_state(self, snap: ClusterSnapshot) -> SolverState:
         free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
@@ -167,11 +189,17 @@ class Scheduler:
             G = snap.gangs.min_member.shape[0]
             gang_sched = jnp.zeros(G, jnp.int32)
             gang_inflight = jnp.zeros((G, snap.num_resources), jnp.int64)
+        net_placed = (
+            snap.network.placed_node if snap.network is not None else None
+        )
+        numa_avail = snap.numa.available if snap.numa is not None else None
         return SolverState(
             free=free,
             eq_used=eq_used,
             gang_scheduled=gang_sched,
             gang_inflight=gang_inflight,
+            net_placed=net_placed,
+            numa_avail=numa_avail,
         )
 
 

@@ -1,0 +1,152 @@
+"""NetworkOverhead dependency cost/violation accumulation.
+
+Reference: /root/reference/pkg/networkaware/networkoverhead/networkoverhead.go
+:500-638. For each already-placed pod of each dependency workload, the cost
+between the candidate node and the placed pod's location depends only on
+(region, zone) codes:
+
+    same node                         -> satisfied, cost += 0  (SameHostname)
+    same zone (different node)        -> satisfied (unconditionally), cost += 1
+    same region, different zone       -> zone-cost map lookup:
+                                         found -> satisfied/violated by
+                                         MaxNetworkCost, cost += value;
+                                         missing -> no count, cost += MaxCost
+    different region                  -> region-cost lookup, same pattern
+    placed node has no region+zone    -> violated, cost += MaxCost
+
+The placed-pod counts are carried through the assignment scan as a (W, N)
+matrix (`SolverState.net_placed`) so that members placed earlier in the same
+cycle are visible to later pods — mirroring the reference's assumed-pod
+snapshot updates between one-at-a-time cycles. Zone/region aggregates are
+recomputed per pod with segment scatter-adds (cheap: D x ZC).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_COST = 100  # networkoverhead.go MaxCost
+SAME_ZONE_COST = 1
+SAME_HOST_COST = 0
+
+
+def dependency_tallies(
+    dep_workload,
+    dep_max_cost,
+    dep_mask,
+    placed_node,
+    node_zone,
+    node_region,
+    zone_region,
+    zone_cost,
+    region_cost,
+):
+    """Per-node (satisfied, violated, cost) tallies for one pod.
+
+    dep_workload/dep_max_cost/dep_mask: (D,) dependency rows;
+    placed_node: (W, N) live placed-pod counts; node_zone/node_region: (N,)
+    codes (-1 unset); zone_region: (ZC,) region of each zone; zone_cost /
+    region_cost: dense matrices with -1 for missing pairs.
+    Returns three (N,) int64 arrays.
+    """
+    N = node_zone.shape[0]
+    ZC = zone_cost.shape[0]
+    RC = region_cost.shape[0]
+    w = jnp.maximum(dep_workload, 0)
+    placed = jnp.where(dep_mask[:, None], placed_node[w], 0)  # (D, N)
+
+    # aggregate placed pods by location class
+    zoned = node_zone >= 0
+    rnoz = (node_zone < 0) & (node_region >= 0)
+    unloc = (node_zone < 0) & (node_region < 0)
+    D = placed.shape[0]
+    placed_zone = jnp.zeros((D, ZC), placed.dtype).at[
+        :, jnp.maximum(node_zone, 0)
+    ].add(jnp.where(zoned[None, :], placed, 0))
+    placed_rnoz = jnp.zeros((D, RC), placed.dtype).at[
+        :, jnp.maximum(node_region, 0)
+    ].add(jnp.where(rnoz[None, :], placed, 0))
+    placed_unloc = jnp.sum(jnp.where(unloc[None, :], placed, 0), axis=1)  # (D,)
+
+    nz = jnp.maximum(node_zone, 0)
+    nr = jnp.maximum(node_region, 0)
+    same_zone = node_zone[:, None] == jnp.arange(ZC)[None, :]  # (N, ZC)
+    same_region = node_region[:, None] == zone_region[None, :]  # (N, ZC)
+
+    zcost_row = zone_cost[nz]  # (N, ZC)
+    rcost_zone = region_cost[nr][:, jnp.maximum(zone_region, 0)]  # (N, ZC)
+    rcost_zone = jnp.where(zone_region[None, :] >= 0, rcost_zone, -1)
+
+    pair_cost = jnp.where(
+        same_zone,
+        SAME_ZONE_COST,
+        jnp.where(
+            same_region,
+            jnp.where(zcost_row >= 0, zcost_row, MAX_COST),
+            jnp.where(rcost_zone >= 0, rcost_zone, MAX_COST),
+        ),
+    )  # (N, ZC)
+    pair_known = jnp.where(same_region, zcost_row >= 0, rcost_zone >= 0)
+    pair_lookup = jnp.where(same_region, zcost_row, rcost_zone)
+
+    # same-node pods are handled separately: remove them from their zone
+    same_node_cnt = placed  # (D, N)
+    zone_cnt = placed_zone[:, None, :] - jnp.where(
+        same_zone[None, :, :], same_node_cnt[:, :, None], 0
+    )
+    zone_cnt = jnp.maximum(zone_cnt, 0)  # (D, N, ZC)
+
+    # same-zone pods are unconditionally satisfied (networkoverhead.go:542-545)
+    sat_pair = same_zone[None, :, :] | (
+        pair_known[None, :, :] & (pair_lookup[None, :, :] <= dep_max_cost[:, None, None])
+    )
+    vio_pair = ~same_zone[None, :, :] & pair_known[None, :, :] & ~sat_pair
+
+    satisfied = jnp.sum(jnp.where(sat_pair, zone_cnt, 0), axis=(0, 2))
+    violated = jnp.sum(jnp.where(vio_pair, zone_cnt, 0), axis=(0, 2))
+    cost = jnp.sum(zone_cnt * pair_cost[None, :, :], axis=(0, 2))
+
+    # same-node pods: satisfied, SameHostname cost (networkoverhead.go:521-525)
+    satisfied = satisfied + jnp.sum(same_node_cnt, axis=0)
+    cost = cost + SAME_HOST_COST * jnp.sum(same_node_cnt, axis=0)
+
+    # region-only placed pods: zone lookup misses within the same region
+    # (cost MaxCost, no count); region-cost lookup across regions
+    same_r = node_region[:, None] == jnp.arange(RC)[None, :]  # (N, RC)
+    rcost = region_cost[nr]  # (N, RC)
+    rn_cost = jnp.where(same_r, MAX_COST, jnp.where(rcost >= 0, rcost, MAX_COST))
+    rn_known = ~same_r & (rcost >= 0)
+    rn_sat = rn_known[None, :, :] & (
+        jnp.where(rcost >= 0, rcost, MAX_COST)[None, :, :]
+        <= dep_max_cost[:, None, None]
+    )
+    rn_vio = rn_known[None, :, :] & ~rn_sat
+    node_rnoz = rnoz  # (N,)
+    rnoz_cnt = placed_rnoz[:, None, :] - jnp.where(
+        (node_rnoz[:, None] & same_r)[None, :, :], same_node_cnt[:, :, None], 0
+    )
+    rnoz_cnt = jnp.maximum(rnoz_cnt, 0)
+    satisfied = satisfied + jnp.sum(jnp.where(rn_sat, rnoz_cnt, 0), axis=(0, 2))
+    violated = violated + jnp.sum(jnp.where(rn_vio, rnoz_cnt, 0), axis=(0, 2))
+    cost = cost + jnp.sum(rnoz_cnt * rn_cost[None, :, :], axis=(0, 2))
+
+    # unlocated placed pods: violated, MaxCost each
+    unloc_cnt = jnp.maximum(
+        placed_unloc[:, None] - jnp.where(unloc[None, :], same_node_cnt, 0), 0
+    )  # (D, N)
+    violated = violated + jnp.sum(unloc_cnt, axis=0)
+    cost = cost + MAX_COST * jnp.sum(unloc_cnt, axis=0)
+
+    return (
+        satisfied.astype(jnp.int64),
+        violated.astype(jnp.int64),
+        cost.astype(jnp.int64),
+    )
+
+
+def placed_commit(net_placed, workload, choice):
+    """Reserve: record an in-cycle placement of `workload` on `choice`."""
+    w = jnp.maximum(workload, 0)
+    n = jnp.maximum(choice, 0)
+    add = ((workload >= 0) & (choice >= 0)).astype(net_placed.dtype)
+    return net_placed.at[w, n].add(add)

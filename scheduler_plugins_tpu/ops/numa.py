@@ -260,12 +260,19 @@ def least_numa_required(avail, reported, zone_mask, distances, guaranteed,
     dist = _subset_distances(distances, masks, sizes)  # (S,)
     big = jnp.float64(1e18)
 
-    # per subset-size k: min distance over ALL valid-size subsets of that k
-    # (minAvgDistanceInCombinations runs over every combination of that size)
+    # per subset-size k: min distance over every same-size subset of REAL
+    # zones (the reference enumerates combinations of the node's actual NUMA
+    # cells only — padded phantom zones must not win the distance minimum)
+    real_subset = jnp.all(~masks | zone_mask[None, :], axis=1)  # (S,)
     ks = sizes
     min_dist_per_k = jnp.min(
-        jnp.where(ks[None, :] == ks[:, None], dist[None, :], big), axis=1
-    )  # (S,) min distance among subsets with the same size
+        jnp.where(
+            (ks[None, :] == ks[:, None]) & real_subset[None, :],
+            dist[None, :],
+            big,
+        ),
+        axis=1,
+    )  # (S,) min distance among real subsets with the same size
 
     # smallest fitting k
     kmin = jnp.min(jnp.where(fits, ks, jnp.int32(Z + 1)))

@@ -17,8 +17,13 @@ from scheduler_plugins_tpu.plugins.noderesources import (  # noqa: F401
 from scheduler_plugins_tpu.plugins.noderesourcetopology import (  # noqa: F401
     NodeResourceTopologyMatch,
 )
+from scheduler_plugins_tpu.plugins.networkaware import (  # noqa: F401
+    NetworkOverhead,
+    TopologicalSort,
+)
 from scheduler_plugins_tpu.plugins.podstate import PodState  # noqa: F401
 from scheduler_plugins_tpu.plugins.qos import QOSSort  # noqa: F401
+from scheduler_plugins_tpu.plugins.sysched import SySched  # noqa: F401
 from scheduler_plugins_tpu.plugins.trimaran import (  # noqa: F401
     LoadVariationRiskBalancing,
     LowRiskOverCommitment,

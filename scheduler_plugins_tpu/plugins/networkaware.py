@@ -1,0 +1,151 @@
+"""Network-aware plugins: NetworkOverhead (PreFilter/Filter/Score) and
+TopologicalSort (QueueSort).
+
+Reference: /root/reference/pkg/networkaware (SURVEY.md §2.8). Pods belong to an
+AppGroup CR (microservice DAG with per-dependency MaxNetworkCost); a
+NetworkTopology CR carries origin->destination costs per topology key
+(region/zone) per weights profile. The per-node costMap walk becomes a dense
+gather over (zone, region) codes (`ops.network.dependency_tallies`):
+
+- Filter rejects a node when violated > satisfied dependencies
+  (networkoverhead.go:326-359).
+- Score is the accumulated cost, normalized inverted (lowest cost wins,
+  networkoverhead.go:362-420 — same transform as Peaks).
+- Pods without an AppGroup or dependencies "score equally": filter passes,
+  score 0 (the scoreEqually path).
+
+TopologicalSort orders pods of the SAME AppGroup by their index in
+AppGroup.Status.TopologyOrder, falling back to upstream PrioritySort
+otherwise (topologicalsort.go:102-132) — an inherently pairwise comparator,
+exposed via `queue_compare`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.network import dependency_tallies, placed_commit
+from scheduler_plugins_tpu.ops.normalize import peaks_normalize
+
+DEFAULT_WEIGHTS_NAME = "UserDefined"  # defaults.go:232-244
+DEFAULT_NETWORK_TOPOLOGY_NAME = "nt-default"
+
+
+class NetworkOverhead(Plugin):
+    name = "NetworkOverhead"
+
+    def __init__(
+        self,
+        weights_name: str = DEFAULT_WEIGHTS_NAME,
+        network_topology_name: str = DEFAULT_NETWORK_TOPOLOGY_NAME,
+        namespaces: tuple = (),
+    ):
+        self.weights_name = weights_name
+        self.network_topology_name = network_topology_name
+        self.namespaces = namespaces
+        self._zone_cost: Optional[jnp.ndarray] = None
+        self._region_cost: Optional[jnp.ndarray] = None
+
+    def prepare_cluster(self, meta, cluster):
+        """Lower the NetworkTopology CR's cost lists into dense (ZC, ZC) /
+        (RC, RC) matrices on this snapshot's zone/region codes
+        (networkoverhead.go:448-497 costMap extraction)."""
+        ZC = max(len(meta.zones), 1)
+        RC = max(len(meta.regions), 1)
+        zone_cost = np.full((ZC, ZC), -1, np.int64)
+        region_cost = np.full((RC, RC), -1, np.int64)
+        nt = None
+        if cluster is not None:
+            for cand in cluster.network_topologies.values():
+                if cand.name == self.network_topology_name:
+                    nt = cand
+                    break
+        if nt is not None:
+            weights = nt.weights.get(self.weights_name, {})
+            for (orig, dest), cost in weights.get("zone", {}).items():
+                if orig in meta.zones and dest in meta.zones:
+                    zone_cost[meta.zones.index(orig), meta.zones.index(dest)] = cost
+            for (orig, dest), cost in weights.get("region", {}).items():
+                if orig in meta.regions and dest in meta.regions:
+                    region_cost[
+                        meta.regions.index(orig), meta.regions.index(dest)
+                    ] = cost
+        self._zone_cost = jnp.asarray(zone_cost)
+        self._region_cost = jnp.asarray(region_cost)
+
+    def aux(self):
+        if self._zone_cost is None:
+            return None
+        return (self._zone_cost, self._region_cost)
+
+    def _tallies(self, state, snap, p):
+        net = snap.network
+        placed = state.net_placed if state.net_placed is not None else net.placed_node
+        zone_cost, region_cost = self._aux
+        return dependency_tallies(
+            net.dep_workload[p],
+            net.dep_max_cost[p],
+            net.dep_mask[p],
+            placed,
+            snap.nodes.zone,
+            snap.nodes.region,
+            net.zone_region,
+            zone_cost,
+            region_cost,
+        )
+
+    def filter(self, state, snap, p):
+        if snap.network is None or self._zone_cost is None:
+            return None
+        satisfied, violated, _ = self._tallies(state, snap, p)
+        score_equally = ~snap.network.dep_mask[p].any()
+        return score_equally | (violated <= satisfied)
+
+    def score(self, state, snap, p):
+        if snap.network is None or self._zone_cost is None:
+            return None
+        _, _, cost = self._tallies(state, snap, p)
+        score_equally = ~snap.network.dep_mask[p].any()
+        return jnp.where(score_equally, 0, cost)
+
+    def commit(self, state, snap, p, choice):
+        if snap.network is None or state.net_placed is None:
+            return state
+        return state.replace(
+            net_placed=placed_commit(
+                state.net_placed, snap.network.pod_workload[p], choice
+            )
+        )
+
+    def normalize(self, scores, feasible):
+        return peaks_normalize(scores, feasible)
+
+
+class TopologicalSort(Plugin):
+    """QueueSort by AppGroup topology order (topologicalsort.go:102-132)."""
+
+    name = "TopologicalSort"
+
+    def __init__(self, namespaces: tuple = ()):
+        self.namespaces = namespaces
+
+    def queue_compare(self, p1, p2, cluster):
+        """Pairwise Less(): same AppGroup -> topology-order index; different
+        or none -> upstream PrioritySort (priority desc, queue time asc)."""
+        ag1, ag2 = p1.app_group(), p2.app_group()
+        if ag1 and ag1 == ag2 and p1.namespace == p2.namespace and cluster is not None:
+            ag = cluster.app_groups.get(f"{p1.namespace}/{ag1}")
+            if ag is not None:
+                o1 = ag.topology_order.get(p1.workload_selector(), 0)
+                o2 = ag.topology_order.get(p2.workload_selector(), 0)
+                if o1 != o2:
+                    return -1 if o1 <= o2 else 1
+        if p1.priority != p2.priority:
+            return -1 if p1.priority > p2.priority else 1
+        if p1.creation_ms != p2.creation_ms:
+            return -1 if p1.creation_ms < p2.creation_ms else 1
+        return -1 if p1.uid < p2.uid else 1

@@ -52,8 +52,11 @@ class NodeResourcesAllocatable(Plugin):
                 w[meta.index.position(name)] = weight
         self._weights = jnp.asarray(w)
 
+    def aux(self):
+        return self._weights
+
     def score(self, state, snap, p):
-        return allocatable_scores(snap.nodes.alloc, self._weights, self.mode_sign)
+        return allocatable_scores(snap.nodes.alloc, self._aux, self.mode_sign)
 
     def normalize(self, scores, feasible):
         return minmax_normalize(scores, feasible)

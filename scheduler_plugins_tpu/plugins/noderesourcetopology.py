@@ -74,11 +74,24 @@ class NodeResourceTopologyMatch(Plugin):
                 w[meta.index.position(name)] = weight
         self._weights = jnp.asarray(w)
 
+    def aux(self):
+        return (self._affine, self._host_level, self._host_extended, self._weights)
+
+    def _numa_avail(self, state, snap):
+        """Zone availability with in-cycle placements deducted — the
+        carried equivalent of the over-reserve cache's assumed-pod deduction
+        between one-at-a-time cycles (cache/overreserve.go:148-160)."""
+        if state is not None and state.numa_avail is not None:
+            return state.numa_avail
+        return snap.numa.available
+
     # -- Filter ----------------------------------------------------------
     def filter(self, state, snap, p):
         if snap.numa is None:
             return None
         numa = snap.numa
+        affine, host_level, host_extended, _ = self._aux
+        available = self._numa_avail(state, snap)
         guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
         creq = snap.pods.container_req[p]
         is_init = snap.pods.container_is_init[p]
@@ -88,15 +101,15 @@ class NodeResourceTopologyMatch(Plugin):
         container_ok = jax.vmap(
             lambda avail, reported, zmask, alloc: numa_ops.single_numa_fit(
                 avail, reported, zmask, alloc, guaranteed, creq, is_init,
-                cmask, self._affine, self._host_level,
+                cmask, affine, host_level,
             )
-        )(numa.available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+        )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
         pod_ok = jax.vmap(
             lambda avail, reported, zmask, alloc: numa_ops.pod_scope_fit(
                 avail, reported, zmask, alloc, guaranteed, req,
-                self._affine, self._host_level,
+                affine, host_level,
             )
-        )(numa.available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+        )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
 
         scoped = jnp.where(
             numa.scope == int(TopologyManagerScope.POD), pod_ok, container_ok
@@ -111,11 +124,24 @@ class NodeResourceTopologyMatch(Plugin):
         verdict &= numa.fresh
         # best-effort pods without extended-resource requests skip the NUMA
         # filter entirely (filter.go:180-183 IncludeNonNative)
-        non_native = jnp.any(
-            (snap.pods.req[p] > 0) & self._host_extended
-        )
+        non_native = jnp.any((snap.pods.req[p] > 0) & host_extended)
         skip = (snap.pods.qos[p] == int(QOSClass.BEST_EFFORT)) & ~non_native
         return jnp.where(skip, True, verdict)
+
+    def commit(self, state, snap, p, choice):
+        """Reserve: pessimistically deduct the placed pod's request from
+        EVERY zone of the chosen node (ReserveNodeResources +
+        GetCachedNRTCopy deduction semantics, cache/store.go:129-160)."""
+        if snap.numa is None or state.numa_avail is None:
+            return state
+        N = state.numa_avail.shape[0]
+        onehot = (jnp.arange(N) == choice)[:, None, None]
+        deduct = jnp.where(
+            (choice >= 0) & onehot & snap.numa.reported,
+            snap.pods.req[p][None, None, :],
+            0,
+        )
+        return state.replace(numa_avail=state.numa_avail - deduct)
 
     # -- Score -----------------------------------------------------------
     def score(self, state, snap, p):
@@ -126,9 +152,9 @@ class NodeResourceTopologyMatch(Plugin):
         guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
 
         if self.strategy == LEAST_NUMA_NODES:
-            raw = self._least_numa_scores(snap, p, guaranteed)
+            raw = self._least_numa_scores(state, snap, p, guaranteed)
         else:
-            raw = self._strategy_scores(snap, p)
+            raw = self._strategy_scores(state, snap, p)
 
         # nodes without NRT or with a stale cache view score 0
         # (score.go:78-91); non-guaranteed pods always score max
@@ -136,7 +162,7 @@ class NodeResourceTopologyMatch(Plugin):
         raw = jnp.where(numa.has_nrt & numa.fresh, raw, 0)
         return jnp.where(guaranteed, raw, numa_ops.MAX_NODE_SCORE)
 
-    def _strategy_scores(self, snap, p):
+    def _strategy_scores(self, state, snap, p):
         numa = snap.numa
         req = snap.pods.req[p]
         relevant = req > 0
@@ -144,9 +170,11 @@ class NodeResourceTopologyMatch(Plugin):
         cmask = snap.pods.container_mask[p]
         C = creq.shape[0]
 
+        _, _, _, weights = self._aux
+
         def node_pod_scope(avail, zmask):
             zs = numa_ops.zone_strategy_scores(
-                self.strategy, req, avail, zmask, relevant, self._weights
+                self.strategy, req, avail, zmask, relevant, weights
             )
             return numa_ops.min_over_zones(zs, zmask)
 
@@ -157,26 +185,26 @@ class NodeResourceTopologyMatch(Plugin):
             for c in range(C):
                 zs = numa_ops.zone_strategy_scores(
                     self.strategy, creq[c], avail, zmask,
-                    creq[c] > 0, self._weights,
+                    creq[c] > 0, weights,
                 )
                 s = numa_ops.min_over_zones(zs, zmask)
                 total = total + jnp.where(cmask[c], s.astype(jnp.float64), 0.0)
             return jnp.trunc(total / count).astype(jnp.int64)
 
-        pod_scores = jax.vmap(node_pod_scope)(numa.available, numa.zone_mask)
-        cont_scores = jax.vmap(node_container_scope)(
-            numa.available, numa.zone_mask
-        )
+        available = self._numa_avail(state, snap)
+        pod_scores = jax.vmap(node_pod_scope)(available, numa.zone_mask)
+        cont_scores = jax.vmap(node_container_scope)(available, numa.zone_mask)
         return jnp.where(
             numa.scope == int(TopologyManagerScope.POD), pod_scores, cont_scores
         )
 
-    def _least_numa_scores(self, snap, p, guaranteed):
+    def _least_numa_scores(self, state, snap, p, guaranteed):
         numa = snap.numa
         Z = numa.available.shape[1]
         masks_np, sizes_np = numa_ops.subset_masks(Z)
         masks = jnp.asarray(masks_np)
         sizes = jnp.asarray(sizes_np)
+        affine = self._aux[0]
         req = snap.pods.req[p]
         creq = snap.pods.container_req[p]
         is_init = snap.pods.container_is_init[p]
@@ -187,7 +215,7 @@ class NodeResourceTopologyMatch(Plugin):
             skip = numa_ops.only_non_numa(reported, zmask, req)
             count, is_min, ok, _ = numa_ops.least_numa_required(
                 avail, reported, zmask, dists, guaranteed, req,
-                self._affine, masks, sizes,
+                affine, masks, sizes,
             )
             score = numa_ops.least_numa_normalize(count, is_min, max_numa)
             return jnp.where(skip, numa_ops.MAX_NODE_SCORE,
@@ -203,7 +231,7 @@ class NodeResourceTopologyMatch(Plugin):
                 )
                 count, is_min, ok, chosen = numa_ops.least_numa_required(
                     avail, reported, zmask, dists, guaranteed, creq[c],
-                    self._affine, masks, sizes,
+                    affine, masks, sizes,
                 )
                 failed |= applies & ~ok
                 worst = jnp.where(applies & ok, jnp.maximum(worst, count), worst)
@@ -222,12 +250,13 @@ class NodeResourceTopologyMatch(Plugin):
                 failed, 0, jnp.where(worst == 0, numa_ops.MAX_NODE_SCORE, score)
             )
 
+        available = self._numa_avail(state, snap)
         pod_scores = jax.vmap(node_pod)(
-            numa.available, numa.reported, numa.zone_mask, numa.distances,
+            available, numa.reported, numa.zone_mask, numa.distances,
             numa.max_numa,
         )
         cont_scores = jax.vmap(node_container)(
-            numa.available, numa.reported, numa.zone_mask, numa.distances,
+            available, numa.reported, numa.zone_mask, numa.distances,
             numa.max_numa,
         )
         return jnp.where(

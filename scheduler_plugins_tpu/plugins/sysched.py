@@ -1,0 +1,60 @@
+"""SySched — syscall-aware pod spreading (Score + Normalize).
+
+Reference: /root/reference/pkg/sysched/sysched.go:215-296. A pod's syscall set
+comes from the SeccompProfile CRs its containers reference; score =
+"extraneous syscall difference":
+
+    |hostSyscalls - podSyscalls|
+    + sum over existing pods p on the node of |(host ∪ pod) - p|
+
+Lower is better (DefaultNormalizeScore reversed). Pods without any profile
+score a huge constant on every node (the reference returns math.MaxInt64 —
+clamped here to 2^53 so the normalize multiply cannot overflow int64, which
+in Go silently wraps); after reverse-normalization all nodes come out equal,
+so placement is unaffected.
+
+The per-existing-pod sum uses the SyscallState decomposition (see
+state.snapshot.SyscallState): pod_count * |newHost| - sum_s newHost[s]*counts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.normalize import default_normalize
+
+NO_PROFILE_SCORE = 2**53
+
+
+class SySched(Plugin):
+    name = "SySched"
+
+    def __init__(self, default_profile_namespace: str = "default",
+                 default_profile_name: str = "all-syscalls"):
+        # defaults.go:246-256
+        self.default_profile_namespace = default_profile_namespace
+        self.default_profile_name = default_profile_name
+
+    def score(self, state, snap, p):
+        if snap.syscalls is None:
+            return None
+        sys = snap.syscalls
+        pod = sys.pod_sets[p]  # (S,)
+        host = sys.host_sets  # (N, S)
+        new_host = host | pod[None, :]
+        # |host - pod|
+        own_diff = jnp.sum(host & ~pod[None, :], axis=1).astype(jnp.int64)
+        # sum_p |newHost - p| = pod_count*|newHost| - sum_s newHost[s]*counts
+        new_size = jnp.sum(new_host, axis=1).astype(jnp.int64)
+        overlap = jnp.sum(
+            jnp.where(new_host, sys.counts, 0), axis=1
+        ).astype(jnp.int64)
+        others = sys.host_pod_count.astype(jnp.int64) * new_size - overlap
+        total = own_diff + others
+        # empty host -> 0 (sysched.go:255-259); no pod profile -> huge score
+        total = jnp.where(sys.host_pod_count == 0, 0, total)
+        return jnp.where(sys.has_profile[p], total, NO_PROFILE_SCORE)
+
+    def normalize(self, scores, feasible):
+        return default_normalize(scores, feasible, reverse=True)

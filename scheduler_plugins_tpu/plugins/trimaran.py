@@ -156,12 +156,16 @@ class Peaks(Plugin):
         self._k1 = jnp.asarray(k1)
         self._k2 = jnp.asarray(k2)
 
+    def aux(self):
+        return (self._k1, self._k2)
+
     def score(self, state, snap, p):
         if snap.metrics is None or self._k1 is None:
             return None
         N = snap.num_nodes
-        k1 = jnp.zeros(N, jnp.float64).at[: self._k1.shape[0]].set(self._k1)
-        k2 = jnp.zeros(N, jnp.float64).at[: self._k2.shape[0]].set(self._k2)
+        a_k1, a_k2 = self._aux
+        k1 = jnp.zeros(N, jnp.float64).at[: a_k1.shape[0]].set(a_k1)
+        k2 = jnp.zeros(N, jnp.float64).at[: a_k2.shape[0]].set(a_k2)
         return peaks_score(
             snap.metrics.cpu_avg,
             snap.metrics.cpu_valid,

@@ -68,7 +68,14 @@ class Cluster:
 
     def remove_pod(self, uid: str):
         self.release_reservation(uid)  # notifies the NRT cache too
-        self.pods.pop(uid, None)
+        pod = self.pods.pop(uid, None)
+        if (
+            pod is not None
+            and pod.node_name is not None
+            and self.nrt_cache is not None
+        ):
+            # a bound pod's assumed deduction must not outlive the pod
+            self.nrt_cache.unreserve(pod.node_name, pod)
 
     def add_pod_group(self, pg: PodGroup):
         self.pod_groups[pg.full_name] = pg
@@ -83,6 +90,15 @@ class Cluster:
 
     def add_app_group(self, ag: AppGroup):
         self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
+
+    def add_network_topology(self, nt: NetworkTopology):
+        self.network_topologies[f"{nt.namespace}/{nt.name}"] = nt
+
+    def add_seccomp_profile(self, sp: SeccompProfile):
+        self.seccomp_profiles[sp.full_name] = sp
+
+    def add_priority_class(self, pc: PriorityClass):
+        self.priority_classes[pc.name] = pc
 
     # -- derived ---------------------------------------------------------
     def pod_group_of(self, pod: Pod) -> Optional[PodGroup]:
@@ -224,5 +240,6 @@ class Cluster:
             node_metrics=metrics,
             backed_off_gangs=backed_off,
             extra_pods=self.gated_pods(),
+            seccomp_profiles=list(self.seccomp_profiles.values()),
             **kwargs,
         )

@@ -119,8 +119,8 @@ class OverReserveCache(NrtCache):
     def __post_init__(self):
         self.nrts: dict[str, NodeResourceTopology] = {}  # flushed copies
         self.pending: dict[str, NodeResourceTopology] = {}  # awaiting resync
-        self.assumed: dict[str, dict[str, dict]] = {}  # node -> uid -> req
-        self.assumed_pods: dict[str, set[tuple[str, str]]] = {}  # node -> (ns, name)
+        #: node -> uid -> (namespace, name, request)
+        self.assumed: dict[str, dict[str, tuple[str, str, dict]]] = {}
         self.foreign: set[str] = set()
         self.maybe_overreserved: set[str] = set()
         self.attr_changed: set[str] = set()
@@ -129,18 +129,22 @@ class OverReserveCache(NrtCache):
     # -- informer events -------------------------------------------------
     def update_nrt(self, nrt: NodeResourceTopology) -> None:
         node = nrt.node_name
-        if node not in self.nrts:
-            # first sighting: accept directly (reserve() is a no-op for
-            # nodes without a cached NRT, overreserve.go:151-163, so no
-            # stale deduction can exist yet)
+        if nrt.policy != getattr(self.nrts.get(node), "policy", nrt.policy) or (
+            nrt.scope != getattr(self.nrts.get(node), "scope", nrt.scope)
+        ):
+            # kubelet config change -> must resync (cache/attr_watch.go:40-99)
+            self.attr_changed.add(node)
+        if (
+            node not in self.assumed
+            and node not in self.foreign
+            and node not in self.maybe_overreserved
+        ):
+            # clean node: the informer keeps the store fresh directly; only
+            # nodes with live deductions defer to the fingerprint-gated
+            # resync (overreserve.go informer path vs resync path)
             self.nrts[node] = copy.deepcopy(nrt)
+            self.pending.pop(node, None)
         else:
-            if node in self.nrts and (
-                nrt.policy != self.nrts[node].policy
-                or nrt.scope != self.nrts[node].scope
-            ):
-                # kubelet config change -> must resync (cache/attr_watch.go:40-99)
-                self.attr_changed.add(node)
             self.pending[node] = copy.deepcopy(nrt)
 
     def track_pod(self, pod: Pod) -> None:
@@ -155,12 +159,14 @@ class OverReserveCache(NrtCache):
             # no NRT data yet: nothing to over-reserve against
             # (overreserve.go:151-163)
             return
-        self.assumed.setdefault(node, {})[pod.uid] = pod.effective_request()
-        self.assumed_pods.setdefault(node, set()).add((pod.namespace, pod.name))
+        self.assumed.setdefault(node, {})[pod.uid] = (
+            pod.namespace,
+            pod.name,
+            pod.effective_request(),
+        )
 
     def unreserve(self, node: str, pod: Pod) -> None:
         self.assumed.get(node, {}).pop(pod.uid, None)
-        self.assumed_pods.get(node, set()).discard((pod.namespace, pod.name))
 
     def mark_maybe_overreserved(self, node: str) -> None:
         """Filter failure on a cached view: the deduction may be stale
@@ -172,7 +178,7 @@ class OverReserveCache(NrtCache):
         out = []
         for node, nrt in self.nrts.items():
             total = {}
-            for req in self.assumed.get(node, {}).values():
+            for _, _, req in self.assumed.get(node, {}).values():
                 total = add_quantities(total, req)
             if total:
                 adjusted = copy.deepcopy(nrt)
@@ -203,20 +209,36 @@ class OverReserveCache(NrtCache):
         for node in sorted(self.desynced_nodes()):
             candidate = self.pending.get(node)
             if candidate is None:
+                if node in self.attr_changed and node in self.nrts:
+                    # config change already applied via the informer path
+                    self.attr_changed.discard(node)
                 continue
-            known = {
-                (p.namespace, p.name) for p in node_pods.get(node, [])
-            } | self.assumed_pods.get(node, set())
-            expected = compute_pod_fingerprint(known)
-            if not candidate.pod_fingerprint:
-                continue  # no fingerprint data: refuse (overreserve.go:306-310)
-            if candidate.pod_fingerprint != expected:
-                continue  # agent hasn't caught up; keep the cached view
+            if node not in self.attr_changed:
+                # fingerprint from the scheduler's pod view only (the
+                # reference reads the pod lister; a deleted pod must not
+                # block convergence). Config-changed nodes flush
+                # unconditionally (overreserve.go separate ConfigChanged loop).
+                known = {(p.namespace, p.name) for p in node_pods.get(node, [])}
+                expected = compute_pod_fingerprint(known)
+                if not candidate.pod_fingerprint:
+                    continue  # no fingerprint data: refuse (overreserve.go:306-310)
+                if candidate.pod_fingerprint != expected:
+                    continue  # agent hasn't caught up; keep the cached view
             self.nrts[node] = candidate
             del self.pending[node]
-            # the agent's report embeds every pod we assumed -> drop them
-            self.assumed.pop(node, None)
-            self.assumed_pods.pop(node, None)
+            # the matched report covers exactly the node's bound pods: drop
+            # their assumed deductions, but keep in-flight (permit-waiting)
+            # reservations the agent cannot know about yet
+            covered = {(p.namespace, p.name) for p in node_pods.get(node, [])}
+            remaining = {
+                uid: entry
+                for uid, entry in self.assumed.get(node, {}).items()
+                if (entry[0], entry[1]) not in covered
+            }
+            if remaining:
+                self.assumed[node] = remaining
+            else:
+                self.assumed.pop(node, None)
             self.foreign.discard(node)
             self.maybe_overreserved.discard(node)
             self.attr_changed.discard(node)

@@ -161,6 +161,23 @@ class NumaState:
 
 
 @struct.dataclass
+class SyscallState:
+    """SySched syscall-set tensors (/root/reference/pkg/sysched/sysched.go).
+
+    The per-existing-pod difference sum decomposes per syscall:
+        sum_p |newHost - p| = pod_count * |newHost| - sum_s newHost[s] * counts[n, s]
+    so only per-node unions and per-syscall pod counts are needed.
+    """
+
+    pod_sets: np.ndarray  # (P, S) bool — pending pods' syscall sets
+    has_profile: np.ndarray  # (P,) bool
+    host_sets: np.ndarray  # (N, S) bool — union over assigned pods
+    #: (N, S) number of assigned pods on the node whose set contains syscall s
+    counts: np.ndarray
+    host_pod_count: np.ndarray  # (N,) int32 assigned pods (HostToPods length)
+
+
+@struct.dataclass
 class ClusterSnapshot:
     nodes: NodeState
     pods: PodState
@@ -169,6 +186,7 @@ class ClusterSnapshot:
     metrics: Optional[MetricsState] = None
     numa: Optional[NumaState] = None
     network: Optional["NetworkState"] = None
+    syscalls: Optional[SyscallState] = None
 
     @property
     def num_nodes(self) -> int:
@@ -197,14 +215,12 @@ class NetworkState:
     dep_workload: np.ndarray  # (P, D) int32 workload code (-1 pad)
     dep_max_cost: np.ndarray  # (P, D) int64
     dep_mask: np.ndarray  # (P, D) bool
-    placed_node: np.ndarray  # (W, N) int32 placed dep pods per node
-    placed_zone: np.ndarray  # (W, ZC) int32 placed dep pods per zone code
-    placed_region: np.ndarray  # (W, RC) int32 placed dep pods per region code
-    placed_unlocated: np.ndarray  # (W,) int32 placed pods on nodes without region+zone
-    zone_cost: np.ndarray  # (ZC, ZC) int64 origin-zone -> dest-zone cost (-1 missing)
-    region_cost: np.ndarray  # (RC, RC) int64 origin-region -> dest-region cost (-1 missing)
-    same_zone_pairs: np.ndarray  # (ZC, ZC) bool — same-zone indicator
-    same_region_pairs: np.ndarray  # (RC, RC) bool
+    pod_workload: np.ndarray  # (P,) int32 pending pod's own workload (-1 none)
+    #: (W, N) placed pods per workload per node; the live copy is carried
+    #: through the scan (SolverState.net_placed) so in-cycle placements are
+    #: visible to later pods
+    placed_node: np.ndarray
+    zone_region: np.ndarray  # (ZC,) int32 region code of each zone (-1 unknown)
 
 
 @dataclass
@@ -275,6 +291,7 @@ def build_snapshot(
     backed_off_gangs: Sequence[str] = (),
     extra_pods: Sequence[Pod] = (),
     stale_nrt_nodes: Sequence[str] = (),
+    seccomp_profiles: Sequence = (),
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -613,6 +630,11 @@ def build_snapshot(
         )
         if app_groups
         else None,
+        syscalls=_build_syscalls(
+            seccomp_profiles, pending_pods, assigned_pods, node_pos, N, P
+        )
+        if seccomp_profiles
+        else None,
     )
     # hand jit-ready device arrays to callers (numpy is build-time only;
     # tracer indexing inside lax.scan requires jax arrays)
@@ -646,12 +668,14 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
     dep_workload = np.full((P, D), -1, I32)
     dep_max_cost = np.zeros((P, D), I64)
     dep_mask = np.zeros((P, D), bool)
+    pod_workload = np.full(P, -1, I32)
     for i, pod in enumerate(pending_pods):
         sel = pod.workload_selector()
         key = f"{pod.namespace}/{sel}"
         wc = workloads_in.get(key) if sel else -1
         if wc < 0:
             continue
+        pod_workload[i] = wc
         deps = dep_lists.get(wc, [])
         for d, (dw, mc) in enumerate(deps):
             dep_workload[i, d] = dw
@@ -659,9 +683,10 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
             dep_mask[i, d] = True
 
     placed_node = np.zeros((W, N), I32)
-    placed_zone = np.zeros((W, ZC), I32)
-    placed_region = np.zeros((W, RC), I32)
-    placed_unlocated = np.zeros(W, I32)
+    zone_region = np.full(ZC, -1, I32)
+    for ni in range(N):
+        if zone[ni] >= 0 and region[ni] >= 0:
+            zone_region[zone[ni]] = region[ni]
     for pod in assigned_pods:
         sel = pod.workload_selector()
         if not sel or pod.node_name not in node_pos:
@@ -670,29 +695,68 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
         wc = workloads_in.get(key)
         if wc < 0:
             continue
-        ni = node_pos[pod.node_name]
-        placed_node[wc, ni] += 1
-        r, z = region[ni], zone[ni]
-        if r < 0 and z < 0:
-            placed_unlocated[wc] += 1
-        else:
-            if z >= 0:
-                placed_zone[wc, z] += 1
-            if r >= 0:
-                placed_region[wc, r] += 1
+        placed_node[wc, node_pos[pod.node_name]] += 1
 
-    eye_z = np.eye(ZC, dtype=bool)
-    eye_r = np.eye(RC, dtype=bool)
     return NetworkState(
         dep_workload=dep_workload,
         dep_max_cost=dep_max_cost,
         dep_mask=dep_mask,
+        pod_workload=pod_workload,
         placed_node=placed_node,
-        placed_zone=placed_zone,
-        placed_region=placed_region,
-        placed_unlocated=placed_unlocated,
-        zone_cost=np.full((ZC, ZC), -1, I64),
-        region_cost=np.full((RC, RC), -1, I64),
-        same_zone_pairs=eye_z,
-        same_region_pairs=eye_r,
+        zone_region=zone_region,
+    )
+
+
+def _build_syscalls(profiles, pending_pods, assigned_pods, node_pos, N, P):
+    """Lower seccomp profiles + pod references into SyscallState
+    (/root/reference/pkg/sysched/sysched.go:124-210: pod syscall set = union
+    of its containers' SeccompProfile CRs; empty = unconfined)."""
+    by_name = {}
+    universe: list[str] = []
+    pos: dict[str, int] = {}
+    for prof in profiles:
+        by_name[prof.full_name] = prof
+        for sc in sorted(prof.syscalls):
+            if sc not in pos:
+                pos[sc] = len(universe)
+                universe.append(sc)
+    S = max(len(universe), 1)
+
+    def pod_set(pod):
+        vec = np.zeros(S, bool)
+        found = False
+        for cont in list(pod.containers) + list(pod.init_containers):
+            ref = cont.seccomp_profile
+            if ref and "/" not in ref:
+                # bare names resolve in the pod's own namespace
+                ref = f"{pod.namespace}/{ref}"
+            prof = by_name.get(ref) if ref else None
+            if prof is not None:
+                found = True
+                for sc in prof.syscalls:
+                    vec[pos[sc]] = True
+        return vec, found
+
+    pod_sets = np.zeros((P, S), bool)
+    has_profile = np.zeros(P, bool)
+    for i, pod in enumerate(pending_pods):
+        pod_sets[i], has_profile[i] = pod_set(pod)
+
+    host_sets = np.zeros((N, S), bool)
+    counts = np.zeros((N, S), I32)
+    host_pods = np.zeros(N, I32)
+    for pod in assigned_pods:
+        if pod.node_name not in node_pos:
+            continue
+        ni = node_pos[pod.node_name]
+        vec, _ = pod_set(pod)
+        host_sets[ni] |= vec
+        counts[ni] += vec
+        host_pods[ni] += 1
+    return SyscallState(
+        pod_sets=pod_sets,
+        has_profile=has_profile,
+        host_sets=host_sets,
+        counts=counts,
+        host_pod_count=host_pods,
     )

@@ -1,0 +1,152 @@
+"""Network-aware decision tables: cost accumulation, dependency violation
+filtering, inverted normalization, topological queue ordering."""
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    NetworkTopology,
+    Node,
+    Pod,
+    APP_GROUP_LABEL,
+    REGION_LABEL,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import NetworkOverhead, TopologicalSort
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def mknode(name, region, zone):
+    return Node(
+        name=name,
+        allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110},
+        labels={REGION_LABEL: region, ZONE_LABEL: zone},
+    )
+
+
+def mkpod(name, workload, node=None, deps=False):
+    p = Pod(
+        name=name,
+        containers=[Container(requests={CPU: 100})],
+        labels={APP_GROUP_LABEL: "ag", WORKLOAD_SELECTOR_LABEL: workload},
+    )
+    p.node_name = node
+    return p
+
+
+def network_cluster():
+    c = Cluster()
+    c.add_node(mknode("na1", "r-a", "z-a1"))
+    c.add_node(mknode("na2", "r-a", "z-a2"))
+    c.add_node(mknode("nb1", "r-b", "z-b1"))
+    ag = AppGroup(
+        name="ag",
+        workloads=[
+            AppGroupWorkload(selector="db"),
+            AppGroupWorkload(
+                selector="web",
+                dependencies=[AppGroupDependency(workload_selector="db", max_network_cost=5)],
+            ),
+        ],
+        topology_order={"db": 1, "web": 2},
+    )
+    c.add_app_group(ag)
+    c.add_network_topology(
+        NetworkTopology(
+            weights={
+                "UserDefined": {
+                    "zone": {("z-a1", "z-a2"): 3, ("z-a2", "z-a1"): 3},
+                    "region": {("r-a", "r-b"): 50, ("r-b", "r-a"): 50},
+                }
+            }
+        )
+    )
+    return c
+
+
+class TestNetworkOverhead:
+    def test_prefers_same_node_then_zone(self):
+        c = network_cluster()
+        c.add_pod(mkpod("db-0", "db", node="na1"))
+        c.add_pod(mkpod("web-0", "web"))
+        sched = Scheduler(Profile(plugins=[NetworkOverhead()]))
+        r = run_cycle(sched, c, now=1000)
+        # na1: same host cost 0; na2: zone cost 3; nb1: region cost 50
+        assert r.bound["default/web-0"] == "na1"
+
+    def test_violating_region_filtered(self):
+        c = network_cluster()
+        c.add_pod(mkpod("db-0", "db", node="na1"))
+        # only the far-region node has capacity? force by cordoning region a
+        c.nodes["na1"].unschedulable = True
+        c.nodes["na2"].unschedulable = True
+        c.add_pod(mkpod("web-0", "web"))
+        sched = Scheduler(Profile(plugins=[NetworkOverhead()]))
+        r = run_cycle(sched, c, now=1000)
+        # nb1: region cost 50 > maxNetworkCost 5 -> violated > satisfied -> reject
+        assert r.failed == ["default/web-0"]
+
+    def test_pod_without_dependencies_scores_equally(self):
+        c = network_cluster()
+        c.add_pod(mkpod("db-0", "db"))
+        sched = Scheduler(Profile(plugins=[NetworkOverhead()]))
+        r = run_cycle(sched, c, now=1000)
+        assert "default/db-0" in r.bound
+
+    def test_unlocated_dependency_counts_violated(self):
+        c = network_cluster()
+        c.add_node(Node(name="bare", allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+        c.add_pod(mkpod("db-0", "db", node="bare"))
+        for n in ("na1", "na2", "nb1"):
+            c.nodes[n].unschedulable = True
+        c.add_pod(mkpod("web-0", "web"))
+        sched = Scheduler(Profile(plugins=[NetworkOverhead()]))
+        r = run_cycle(sched, c, now=1000)
+        # db on a label-less node: same-node placement is satisfied though
+        assert r.bound["default/web-0"] == "bare"
+
+
+class TestIntraCycleVisibility:
+    def test_in_cycle_placement_feeds_dependency_tallies(self):
+        # db and web pend in the SAME cycle; db (topo-first) lands in region
+        # a; web's dependency must see that placement: the far-region node
+        # violates maxNetworkCost and web fails rather than landing there
+        c = network_cluster()
+        for n in ("na1", "na2", "nb1"):
+            c.nodes[n].allocatable = {CPU: 150, MEMORY: 32 * gib, PODS: 110}
+            c.nodes[n].capacity = dict(c.nodes[n].allocatable)
+        c.nodes["na2"].unschedulable = True
+        c.add_pod(mkpod("db-0", "db"))
+        c.add_pod(mkpod("web-0", "web"))
+        sched = Scheduler(
+            Profile(plugins=[NetworkOverhead(), TopologicalSort()])
+        )
+        r = run_cycle(sched, c, now=1000)
+        assert r.bound["default/db-0"] == "na1"
+        # web fits only nb1 (na1 is full) but nb1 violates: region cost 50 > 5
+        assert "default/web-0" in r.failed
+
+
+class TestTopologicalSort:
+    def test_same_appgroup_ordered_by_topology(self):
+        c = network_cluster()
+        web = mkpod("web-0", "web")
+        db = mkpod("db-0", "db")
+        web.creation_ms, db.creation_ms = 1, 2  # creation order would flip it
+        sched = Scheduler(Profile(plugins=[TopologicalSort()]))
+        order = sched.sort_pending([web, db], c)
+        assert [p.name for p in order] == ["db-0", "web-0"]
+
+    def test_different_groups_fall_back_to_priority(self):
+        c = network_cluster()
+        a = Pod(name="a", containers=[Container()], priority=1, creation_ms=2)
+        b = Pod(name="b", containers=[Container()], priority=5, creation_ms=3)
+        sched = Scheduler(Profile(plugins=[TopologicalSort()]))
+        order = sched.sort_pending([a, b], c)
+        assert [p.name for p in order] == ["b", "a"]

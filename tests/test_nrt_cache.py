@@ -69,23 +69,59 @@ class TestOverReserve:
     def test_resync_requires_matching_fingerprint(self):
         cache = OverReserveCache()
         cache.update_nrt(mknrt("n0"))
-        pod = gpod("p1", node="n0")
+        pod = gpod("p1", node="n0")  # bound pod
         cache.reserve("n0", pod)
         cache.mark_maybe_overreserved("n0")
         # agent publishes a new NRT with a fingerprint NOT including p1
         cache.update_nrt(mknrt("n0", cpu_per_zone=3000,
                                fingerprint=compute_pod_fingerprint([])))
-        assert cache.resync({"n0": []}) == []  # mismatch: still dirty
+        assert cache.resync({"n0": [pod]}) == []  # mismatch: still dirty
         assert "n0" in cache.desynced_nodes()
         # agent catches up: fingerprint covers p1
         fp = compute_pod_fingerprint([("default", "p1")])
         cache.update_nrt(mknrt("n0", cpu_per_zone=3000, fingerprint=fp))
-        assert cache.resync({"n0": []}) == ["n0"]
+        assert cache.resync({"n0": [pod]}) == ["n0"]
         assert cache.generation == 1
         nrts, stale = cache.view()
         assert not stale
-        # assumed dropped; flushed view is the agent's report
+        # p1's assumed entry dropped (covered by the report); flushed view is
+        # the agent's report
         assert nrts[0].zones[0].available[CPU] == 3000
+
+    def test_flush_keeps_inflight_reservations(self):
+        # a permit-waiting pod (not bound) keeps its deduction across a flush
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        waiting = gpod("w1")  # no node_name: reserved, not bound
+        cache.reserve("n0", waiting)
+        cache.mark_maybe_overreserved("n0")
+        fp = compute_pod_fingerprint([])  # agent sees no pods
+        cache.update_nrt(mknrt("n0", cpu_per_zone=3000, fingerprint=fp))
+        assert cache.resync({"n0": []}) == ["n0"]
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 3000 - 1000
+
+    def test_deleted_pod_does_not_block_resync(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1", node="n0")
+        cache.reserve("n0", pod)
+        cache.mark_maybe_overreserved("n0")
+        cache.unreserve("n0", pod)  # pod deleted (remove_pod path)
+        fp = compute_pod_fingerprint([])
+        cache.update_nrt(mknrt("n0", fingerprint=fp))
+        assert cache.resync({"n0": []}) == ["n0"]  # converges
+
+    def test_attr_change_flushes_without_fingerprint(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        cache.reserve("n0", gpod("p1", node="n0"))  # node now dirty-deferred
+        changed = mknrt("n0")  # no fingerprint stamped
+        changed.policy = TopologyManagerPolicy.RESTRICTED
+        cache.update_nrt(changed)
+        assert "n0" in cache.attr_changed
+        assert cache.resync({"n0": []}) == ["n0"]  # unconditional flush
+        assert cache.nrts["n0"].policy == TopologyManagerPolicy.RESTRICTED
 
     def test_attribute_change_marks_dirty(self):
         cache = OverReserveCache()

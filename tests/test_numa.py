@@ -131,6 +131,21 @@ class TestNumaFilter:
         r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
         assert r.failed == ["default/p"]
 
+    def test_in_cycle_zone_deduction(self):
+        # two guaranteed 3-core pods in ONE cycle, node zones 4000/4000:
+        # node-level fit admits both (6000 < 8000) but after the first
+        # placement the carried zone view deducts 3000 from every zone,
+        # so the second pod cannot align -> rejected (the reference blocks
+        # it via the overreserve cache between one-at-a-time cycles)
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}]),
+        ])
+        c.add_pod(guaranteed_pod("p1", 3000, 1 * gib, creation_ms=1))
+        c.add_pod(guaranteed_pod("p2", 3000, 1 * gib, creation_ms=2))
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert "default/p1" in r.bound
+        assert r.failed == ["default/p2"]
+
     def test_non_single_numa_policy_passes(self):
         c = cluster_with([
             nrt("n0", [{CPU: 1000, MEMORY: 1 * gib}],

@@ -1,0 +1,43 @@
+"""SySched decision tables (mirrors sysched_test.go scoring patterns)."""
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod, SeccompProfile
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import SySched
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def prof_pod(name, profile, node=None):
+    p = Pod(name=name, containers=[Container(requests={CPU: 100}, seccomp_profile=profile)])
+    p.node_name = node
+    return p
+
+
+def sys_cluster():
+    c = Cluster()
+    for n in ("web-host", "db-host", "empty"):
+        c.add_node(Node(name=n, allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+    c.add_seccomp_profile(SeccompProfile(name="web", syscalls=frozenset({"read", "write", "accept", "listen"})))
+    c.add_seccomp_profile(SeccompProfile(name="db", syscalls=frozenset({"read", "write", "fsync", "mmap"})))
+    c.add_pod(prof_pod("w1", "web", node="web-host"))
+    c.add_pod(prof_pod("d1", "db", node="db-host"))
+    return c
+
+
+class TestSySched:
+    def test_colocates_similar_syscall_pods(self):
+        c = sys_cluster()
+        c.add_pod(prof_pod("w2", "web"))
+        r = run_cycle(Scheduler(Profile(plugins=[SySched()])), c, now=1000)
+        # web-host: diff 0 + existing pod sees 0 new -> 0
+        # db-host: |db-web|=2 + d1 sees |(db∪web)-db|=2 -> 4; empty -> 0
+        # tie between web-host and empty -> lowest index (web-host added first)
+        assert r.bound["default/w2"] == "web-host"
+
+    def test_unprofiled_pod_unaffected(self):
+        c = sys_cluster()
+        c.add_pod(prof_pod("plain", None))
+        r = run_cycle(Scheduler(Profile(plugins=[SySched()])), c, now=1000)
+        assert "default/plain" in r.bound
