@@ -1,0 +1,10 @@
+"""Synthetic cluster/workload scenario generators for the five BASELINE.md
+benchmark configurations and for tests."""
+
+from scheduler_plugins_tpu.models.scenarios import (  # noqa: F401
+    allocatable_scenario,
+    gang_quota_scenario,
+    network_scenario,
+    numa_scenario,
+    trimaran_scenario,
+)

@@ -1,0 +1,224 @@
+"""Synthetic scenario builders mirroring BASELINE.json's five configs:
+
+1. NodeResourcesAllocatable Score — nodes x pods (CPU-style integration scale)
+2. Trimaran TLP + LVRB — nodes with synthetic load metrics
+3. NodeResourceTopology NUMA Filter+Score — nodes x NUMA zones
+4. Coscheduling PodGroups + CapacityScheduling ElasticQuota — gangs x members
+5. NetworkAware NetworkOverhead — multi-region AppGroup topology
+
+All generators are deterministic (seeded numpy) so benchmark runs and
+differential tests are reproducible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    ElasticQuota,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    PodGroup,
+    APP_GROUP_LABEL,
+    POD_GROUP_LABEL,
+    REGION_LABEL,
+    TopologyManagerPolicy,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+GIB = 1 << 30
+
+
+def _nodes(n, cpu=64_000, mem=256 * GIB, pods=256):
+    return [
+        Node(name=f"node-{i:05d}", allocatable={CPU: cpu, MEMORY: mem, PODS: pods})
+        for i in range(n)
+    ]
+
+
+def _pods(p, rng, cpu_range=(100, 4000), mem_range=(256 << 20, 8 * GIB)):
+    cpus = rng.integers(*cpu_range, size=p)
+    mems = rng.integers(*mem_range, size=p)
+    return [
+        Pod(
+            name=f"pod-{i:06d}",
+            creation_ms=i,
+            containers=[Container(requests={CPU: int(cpus[i]), MEMORY: int(mems[i])})],
+        )
+        for i in range(p)
+    ]
+
+
+def allocatable_scenario(n_nodes=100, n_pods=1000, seed=0) -> Cluster:
+    """Config 1: plain allocatable-scored placement."""
+    rng = np.random.default_rng(seed)
+    cluster = Cluster()
+    for node in _nodes(n_nodes):
+        cluster.add_node(node)
+    for pod in _pods(n_pods, rng):
+        cluster.add_pod(pod)
+    return cluster
+
+
+def trimaran_scenario(n_nodes=5000, n_pods=2000, seed=0) -> Cluster:
+    """Config 2: load-aware scoring with synthetic metrics."""
+    rng = np.random.default_rng(seed)
+    cluster = allocatable_scenario(n_nodes, n_pods, seed)
+    cluster.node_metrics = {
+        f"node-{i:05d}": {
+            "cpu_avg": float(rng.uniform(5, 90)),
+            "cpu_std": float(rng.uniform(0, 15)),
+            "mem_avg": float(rng.uniform(5, 80)),
+            "mem_std": float(rng.uniform(0, 10)),
+        }
+        for i in range(n_nodes)
+    }
+    return cluster
+
+
+def numa_scenario(n_nodes=1000, n_pods=1000, zones=8, seed=0) -> Cluster:
+    """Config 3: NUMA-aware filter+score (guaranteed pods)."""
+    rng = np.random.default_rng(seed)
+    cluster = Cluster()
+    for node in _nodes(n_nodes):
+        cluster.add_node(node)
+        per_zone_cpu = 64_000 // zones
+        per_zone_mem = 256 * GIB // zones
+        cluster.add_nrt(
+            NodeResourceTopology(
+                node_name=node.name,
+                policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+                zones=[
+                    NUMAZone(
+                        numa_id=z,
+                        available={CPU: per_zone_cpu, MEMORY: per_zone_mem},
+                        costs={
+                            o: 10 if o == z else 20 for o in range(zones)
+                        },
+                    )
+                    for z in range(zones)
+                ],
+            )
+        )
+    cpus = rng.integers(500, per_zone_cpu // 2, size=n_pods)
+    for i in range(n_pods):
+        cpu = int(cpus[i])
+        cluster.add_pod(
+            Pod(
+                name=f"pod-{i:06d}",
+                creation_ms=i,
+                containers=[
+                    Container(
+                        requests={CPU: cpu, MEMORY: 1 * GIB},
+                        limits={CPU: cpu, MEMORY: 1 * GIB},
+                    )
+                ],
+            )
+        )
+    return cluster
+
+
+def gang_quota_scenario(n_gangs=100, gang_size=64, n_nodes=1000, seed=0) -> Cluster:
+    """Config 4: gangs with quota-governed namespaces."""
+    cluster = Cluster()
+    for node in _nodes(n_nodes):
+        cluster.add_node(node)
+    for g in range(n_gangs):
+        ns = f"team-{g % 16}"
+        if ns not in cluster.quotas:
+            cluster.add_quota(
+                ElasticQuota(
+                    name=f"eq-{ns}",
+                    namespace=ns,
+                    min={CPU: n_nodes * 4000, MEMORY: n_nodes * 16 * GIB},
+                    max={CPU: n_nodes * 8000, MEMORY: n_nodes * 32 * GIB},
+                )
+            )
+        cluster.add_pod_group(
+            PodGroup(name=f"gang-{g:04d}", namespace=ns, min_member=gang_size)
+        )
+        for m in range(gang_size):
+            cluster.add_pod(
+                Pod(
+                    name=f"gang-{g:04d}-m{m:03d}",
+                    namespace=ns,
+                    creation_ms=g * 1000 + m,
+                    containers=[
+                        Container(requests={CPU: 1000, MEMORY: 2 * GIB})
+                    ],
+                    labels={POD_GROUP_LABEL: f"gang-{g:04d}"},
+                )
+            )
+    return cluster
+
+
+def network_scenario(n_nodes=1000, n_pods=1000, n_regions=4, zones_per_region=4,
+                     n_workloads=32, seed=0) -> Cluster:
+    """Config 5: multi-region AppGroup dependency graph."""
+    rng = np.random.default_rng(seed)
+    cluster = Cluster()
+    for i, node in enumerate(_nodes(n_nodes)):
+        region = f"region-{i % n_regions}"
+        zone = f"zone-{i % (n_regions * zones_per_region)}"
+        node.labels = {REGION_LABEL: region, ZONE_LABEL: zone}
+        cluster.add_node(node)
+    workloads = [AppGroupWorkload(selector=f"wl-{w}") for w in range(n_workloads)]
+    for w in range(1, n_workloads):
+        workloads[w].dependencies.append(
+            AppGroupDependency(
+                workload_selector=f"wl-{rng.integers(0, w)}", max_network_cost=10
+            )
+        )
+    cluster.add_app_group(
+        AppGroup(
+            name="mesh",
+            workloads=workloads,
+            topology_order={f"wl-{w}": w for w in range(n_workloads)},
+        )
+    )
+    zone_names = [f"zone-{z}" for z in range(n_regions * zones_per_region)]
+    region_names = [f"region-{r}" for r in range(n_regions)]
+    cluster.add_network_topology(
+        NetworkTopology(
+            weights={
+                "UserDefined": {
+                    "zone": {
+                        (a, b): 5
+                        for a in zone_names
+                        for b in zone_names
+                        if a != b
+                    },
+                    "region": {
+                        (a, b): 50
+                        for a in region_names
+                        for b in region_names
+                        if a != b
+                    },
+                }
+            }
+        )
+    )
+    for i in range(n_pods):
+        w = int(rng.integers(0, n_workloads))
+        cluster.add_pod(
+            Pod(
+                name=f"pod-{i:06d}",
+                creation_ms=i,
+                containers=[Container(requests={CPU: 500, MEMORY: 1 * GIB})],
+                labels={
+                    APP_GROUP_LABEL: "mesh",
+                    WORKLOAD_SELECTOR_LABEL: f"wl-{w}",
+                },
+            )
+        )
+    return cluster

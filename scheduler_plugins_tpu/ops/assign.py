@@ -30,6 +30,44 @@ from scheduler_plugins_tpu.ops.fit import pod_fit_demand
 #: signature: (free (N,R), pod_index int32) -> (feasible (N,) bool, score (N,) int64)
 StepFn = Callable
 
+#: pods per admission chunk — bounds TPU scoped-VMEM use of the queue-order
+#: prefix cumsum (a full (P, N) int64 cumsum overflows the 16MB scoped vmem
+#: at bench shapes)
+ADMIT_CHUNK = 256
+
+
+def _queue_order_admission(onehot, demand, free):
+    """(P,) bool: pod admitted iff its node still fits after all earlier
+    winners of the same wave on that node.
+
+    Exact per-resource prefix sums via a fully-parallel blocked scan
+    (within-chunk cumsum + exclusive cumsum over the small chunk-totals
+    axis): an int64 cumsum over the whole P axis lowers to a vmem-hungry
+    u32-pair reduce-window on TPU, so chunks run in float64 — exact for
+    quantities below 2^53.
+    """
+    P, N = onehot.shape
+    R = demand.shape[1]
+    chunk = min(ADMIT_CHUNK, P)
+    if P % chunk != 0:  # padded batches are powers of two; fallback safety
+        chunk = P
+    K = P // chunk
+
+    fits = jnp.ones((P, N), bool)
+    for r in range(R):
+        contrib = (
+            (onehot * demand[:, r][:, None]).astype(jnp.float64)
+        ).reshape(K, chunk, N)
+        within = jnp.cumsum(contrib, axis=1)  # parallel over K blocks
+        totals = within[:, -1, :]  # (K, N)
+        base = jnp.concatenate(
+            [jnp.zeros((1, N), jnp.float64), jnp.cumsum(totals[:-1], axis=0)],
+            axis=0,
+        )  # exclusive block offsets (K tiny)
+        prefix = (base[:, None, :] + within).reshape(P, N)
+        fits &= prefix <= free[None, :, r].astype(jnp.float64)
+    return (onehot & fits).any(axis=1)
+
 
 def _pick(feasible, scores):
     """argmax score among feasible nodes, lowest index on ties; -1 if none."""
@@ -62,6 +100,98 @@ def greedy_assign(step_fn: StepFn, req, pod_mask, free0):
 
 
 @partial(jax.jit, static_argnames=("batch_fn", "max_waves"))
+def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
+    """Capacity-aware wave placement: queue-ranked pods spread across
+    score-ordered nodes by estimated per-node capacity, so a wave fills MANY
+    nodes (plain `wave_assign` fills one node per wave when scores tie —
+    e.g. the homogeneous-cluster Least-allocatable case, where the sequential
+    reference semantics pack node after node).
+
+    Per wave: rank active pods in queue order; order nodes by mean score
+    (desc, index tie-break); estimate each node's capacity in pods as
+    min_r floor(free_r / mean-demand_r); send pod rank k to the node whose
+    cumulative-capacity bucket contains k (falling back to the pod's argmax
+    when that node is infeasible for it); validate with the exact queue-order
+    prefix admission and retry the rest next wave.
+    """
+    P, R = req.shape
+    demand = pod_fit_demand(req)
+    N = free0.shape[0]
+
+    def wave(carry, _):
+        free, assignment = carry
+        active = (assignment == -1) & pod_mask
+        feasible, scores = batch_fn(free, active)
+        feasible &= active[:, None]
+        n_active = jnp.maximum(active.sum(), 1)
+
+        # node order by mean score over active pods (static scores -> the
+        # same pack order the sequential scan would follow)
+        mean_score = jnp.sum(jnp.where(active[:, None], scores, 0), axis=0)
+        order = jnp.argsort(-mean_score, stable=True)  # (N,)
+
+        # per-node capacity estimate in pods, from the mean active demand
+        mean_demand = (
+            jnp.sum(jnp.where(active[:, None], demand, 0), axis=0) // n_active
+        )
+        cap = jnp.min(
+            jnp.where(
+                mean_demand[None, :] > 0,
+                free // jnp.maximum(mean_demand[None, :], 1),
+                jnp.int64(P),
+            ),
+            axis=1,
+        )  # (N,)
+        cap = jnp.clip(cap, 0, P).astype(jnp.int32)
+        ccap = jnp.cumsum(cap[order], dtype=jnp.int32)  # (N,)
+
+        # queue-order rank among active pods (int32: int64 cumsum is
+        # vmem-hungry on TPU)
+        rank = jnp.cumsum(active, dtype=jnp.int32) - 1
+        bucket = jnp.searchsorted(ccap, rank, side="right")  # (P,)
+        target = order[jnp.minimum(bucket, N - 1)]
+        target_ok = jnp.take_along_axis(
+            feasible, target[:, None], axis=1
+        ).squeeze(1)
+        masked = jnp.where(feasible, scores, jnp.int64(-(2**62)))
+        fallback = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        choice = jnp.where(
+            target_ok, target.astype(jnp.int32),
+            jnp.where(feasible.any(axis=1), fallback, -1),
+        )
+        choice = jnp.where(active, choice, -1)
+
+        # exact queue-order admission per node, chunked for VMEM
+        onehot = (choice[:, None] == jnp.arange(N)[None, :]) & (
+            choice[:, None] >= 0
+        )
+        admitted = (choice >= 0) & _queue_order_admission(onehot, demand, free)
+        new_assignment = jnp.where(admitted, choice, assignment)
+        winners = onehot & admitted[:, None]
+        used = jnp.stack(
+            [(winners * demand[:, r][:, None]).sum(axis=0) for r in range(R)],
+            axis=-1,
+        )
+        return (free - used, new_assignment), admitted.sum()
+
+    def cond(loop_state):
+        _, _, wave_idx, progressed = loop_state
+        return (wave_idx < max_waves) & progressed
+
+    def body(loop_state):
+        free, assignment, wave_idx, _ = loop_state
+        (free, assignment), n_admitted = wave((free, assignment), None)
+        return free, assignment, wave_idx + 1, n_admitted > 0
+
+    free, assignment, _, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (free0, jnp.full(P, -1, jnp.int32), jnp.int32(0), jnp.bool_(True)),
+    )
+    return assignment, free
+
+
+@partial(jax.jit, static_argnames=("batch_fn", "max_waves"))
 def wave_assign(batch_fn, req, pod_mask, free0, max_waves: int = 8):
     """Wave-parallel placement.
 
@@ -84,18 +214,12 @@ def wave_assign(batch_fn, req, pod_mask, free0, max_waves: int = 8):
             feasible.any(axis=1), jnp.argmax(masked, axis=1).astype(jnp.int32), -1
         )
         # queue-order admission: pod p wins iff node still fits after all
-        # earlier winners of the same wave on the same node. Unrolled over the
-        # small static R axis to keep peak memory at (P, N), not (P, N, R).
+        # earlier winners of the same wave on the same node (chunked exact
+        # per-resource prefix sums)
         onehot = (choice[:, None] == jnp.arange(free.shape[0])[None, :]) & (
             choice[:, None] >= 0
         )  # (P, N)
-        fits_after = jnp.ones_like(onehot)
-        for r in range(R):
-            prefix_r = jnp.cumsum(onehot * demand[:, r][:, None], axis=0)
-            fits_after &= prefix_r <= free[None, :, r]
-        admitted = (choice >= 0) & jnp.take_along_axis(
-            fits_after, jnp.maximum(choice, 0)[:, None], axis=1
-        ).squeeze(1)
+        admitted = (choice >= 0) & _queue_order_admission(onehot, demand, free)
         new_assignment = jnp.where(admitted, choice, assignment)
         winners = onehot & admitted[:, None]  # (P, N)
         # per-resource masked sums (int64 matmul is unsupported on TPU)

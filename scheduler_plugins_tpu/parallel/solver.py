@@ -1,0 +1,160 @@
+"""Sharded batched solve — the multi-chip scheduling step.
+
+One "step" = the full pipeline over a pending wave, batched over pods and
+sharded over the mesh:
+
+    PreFilter (gang/quota admission against CARRIED usage, vmapped over pods)
+ -> Filter (resource fit + plugin masks, (P, N) sharded pods x nodes)
+ -> Score + Normalize (weighted sum)
+ -> wave conflict resolution (queue-order admission per node AND per
+    namespace quota, exact prefix sums)
+ -> Permit (gang quorum as a segment reduction)
+
+Node-axis reductions (argmax, fit all-reduce) and pod-axis prefix sums become
+XLA collectives over ICI; side tables (quota, gangs) are replicated and their
+segment sums psum naturally. Placements within a wave may differ from the
+bit-faithful sequential scan (`Scheduler.solve`) exactly as documented in
+`ops.assign.waterfill_assign` — this is the throughput path; the sequential
+path remains the parity gate. Hard constraints (fit, quota Max/aggregate-Min,
+gang quorum Wait) are enforced in both paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.ops.allocatable import (
+    MODE_LEAST,
+    allocatable_scores,
+)
+from scheduler_plugins_tpu.ops.assign import waterfill_assign
+from scheduler_plugins_tpu.ops.fit import fits, free_capacity, pod_fit_demand
+from scheduler_plugins_tpu.ops.gang import gang_admit
+from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+from scheduler_plugins_tpu.ops.quota import quota_admit
+
+
+def batch_admission(snap, free, eq_used=None):
+    """(P,) PreFilter verdicts for the batch against the carried state
+    (gang membership/backoff/MinResources + elastic quota)."""
+    ok = snap.pods.mask & ~snap.pods.gated
+    if snap.gangs is not None:
+        gang_ok = jax.vmap(lambda g: gang_admit(snap.gangs, free, g))(
+            snap.pods.gang
+        )
+        ok &= gang_ok
+    if snap.quota is not None:
+        used = eq_used if eq_used is not None else snap.quota.used
+        quota_ok = jax.vmap(
+            lambda ns, req: quota_admit(
+                used,
+                snap.quota.min,
+                snap.quota.max,
+                snap.quota.has_quota,
+                ns,
+                req,
+            )
+        )(snap.pods.ns, snap.pods.req)
+        ok &= quota_ok
+    return ok
+
+
+def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
+    """(P,) queue-order quota admission: pod admitted iff its namespace's
+    usage + the requests of earlier admitted pods of ALL namespaces stays
+    within Max (own) and aggregate Min (cluster pool) — the batched analog of
+    quota_commit threading through the sequential scan."""
+    quota = snap.quota
+    P = snap.num_pods
+    Q = quota.used.shape[0]
+    ns = snap.pods.ns
+    req = snap.pods.req.astype(jnp.float64)
+    active = assignment_order_ok
+    ns_onehot = (ns[:, None] == jnp.arange(Q)[None, :]) & active[:, None]
+
+    # per-namespace exclusive prefix of requests (float64 exact < 2^53)
+    used0 = eq_used.astype(jnp.float64)
+    ok = jnp.ones(P, bool)
+    agg_min = jnp.sum(
+        jnp.where(quota.has_quota[:, None], quota.min, 0), axis=0
+    ).astype(jnp.float64)
+    agg_used0 = jnp.sum(
+        jnp.where(quota.has_quota[:, None], eq_used, 0), axis=0
+    ).astype(jnp.float64)
+    for r in range(req.shape[1]):
+        contrib = ns_onehot * req[:, r][:, None]  # (P, Q)
+        prefix = jnp.cumsum(contrib, axis=0) - contrib  # exclusive
+        own_total = used0[:, r][None, :] + prefix + contrib
+        own_ok = jnp.take_along_axis(
+            own_total <= quota.max[:, r].astype(jnp.float64)[None, :],
+            ns[:, None],
+            axis=1,
+        ).squeeze(1)
+        # aggregate pool: all earlier admitted quota'd pods count
+        in_quota = jnp.take_along_axis(
+            quota.has_quota[None, :].repeat(P, 0), ns[:, None], axis=1
+        ).squeeze(1) & active
+        agg_contrib = jnp.where(in_quota, req[:, r], 0.0)
+        agg_prefix = jnp.cumsum(agg_contrib) - agg_contrib
+        agg_ok = agg_used0[r] + agg_prefix + agg_contrib <= agg_min[r]
+        has_q = jnp.take_along_axis(
+            quota.has_quota[None, :].repeat(P, 0), ns[:, None], axis=1
+        ).squeeze(1)
+        ok &= ~has_q | (own_ok & agg_ok)
+    return ok
+
+
+def batch_solve(snap, weights, max_waves: int = 8):
+    """Full batched step: admission -> fit -> allocatable score -> wave
+    assignment -> quota prefix enforcement -> gang quorum.
+    Returns (assignment (P,), admitted (P,), wait (P,))."""
+    free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    admitted = batch_admission(snap, free0)
+
+    def batch_fn(free, active):
+        feasible = fits(
+            snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
+        )
+        raw = allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+        scores = minmax_normalize(
+            jnp.broadcast_to(raw[None, :], feasible.shape), feasible
+        )
+        return feasible, scores
+
+    assignment, free = waterfill_assign(
+        batch_fn, snap.pods.req, admitted, free0, max_waves=max_waves
+    )
+
+    # namespace quota enforcement in queue order over the final assignment
+    if snap.quota is not None:
+        placed = assignment >= 0
+        quota_ok = _namespace_quota_prefix_ok(placed, snap, snap.quota.used)
+        assignment = jnp.where(placed & ~quota_ok, -1, assignment)
+
+    # Permit: gang quorum over final placements (as in Scheduler.solve)
+    wait = jnp.zeros(snap.num_pods, bool)
+    if snap.gangs is not None:
+        placed = (assignment >= 0).astype(jnp.int32)
+        gang = snap.pods.gang
+        in_gang = gang >= 0
+        G = snap.gangs.min_member.shape[0]
+        sched = jnp.zeros(G, jnp.int32).at[jnp.maximum(gang, 0)].add(
+            jnp.where(in_gang, placed, 0)
+        )
+        quorum = snap.gangs.assigned + sched >= snap.gangs.min_member
+        pod_quorum = jnp.where(in_gang, quorum[jnp.maximum(gang, 0)], True)
+        wait = (assignment >= 0) & ~pod_quorum
+
+    return assignment, admitted, wait
+
+
+def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
+    """Jit `batch_solve` with the snapshot sharded over `mesh`; XLA inserts
+    the cross-shard collectives."""
+    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+
+    snap = shard_snapshot(snap, mesh)
+    with jax.set_mesh(mesh):
+        fn = jax.jit(lambda s, w: batch_solve(s, w, max_waves))
+        return fn(snap, weights)
