@@ -1,0 +1,9 @@
+"""CRD status controllers — the companion controller binary's reconcilers
+(/root/reference/cmd/controller, pkg/controllers)."""
+
+from scheduler_plugins_tpu.controllers.elasticquota import (  # noqa: F401
+    reconcile_elastic_quotas,
+)
+from scheduler_plugins_tpu.controllers.podgroup import (  # noqa: F401
+    reconcile_pod_groups,
+)

@@ -32,6 +32,8 @@ class CycleReport:
     failed: list[str] = field(default_factory=list)
     rejected_gangs: list[str] = field(default_factory=list)
     expired_gangs: list[str] = field(default_factory=list)
+    #: preemptor uid -> (nominated node, victim uids)
+    preempted: dict[str, tuple[str, list[str]]] = field(default_factory=dict)
 
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) -> CycleReport:
@@ -104,7 +106,64 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
         _reject_gang(cluster, pg, now, report, cosched, len(members))
 
     _mark_overreserved_on_failures(cluster, report)
+    _run_preemption(scheduler, cluster, pending, report, now)
     return report
+
+
+def _run_preemption(scheduler, cluster, pending, report, now):
+    """PostFilter preemption: for each still-failed pod in queue order, dry
+    run victim removal across all nodes, nominate the best candidate, mark
+    victims terminating (the apiserver DELETE boundary in the reference)
+    and record the nomination (SURVEY.md §3.3).
+
+    Runs against a FRESH snapshot (this cycle's binds must count as node
+    usage, or just-bound pods double as phantom victims) and threads the
+    cycle's earlier nominations into each dry run so two preemptors cannot
+    claim the same freed capacity (the upstream evaluator filters with
+    nominated pods)."""
+    engine = scheduler.profile.preemption
+    if engine is None or not report.failed:
+        return
+    rejected = set(report.rejected_gangs)
+    by_uid = {p.uid: p for p in pending}
+    failed_pods = [by_uid[uid] for uid in report.failed if uid in by_uid]
+    # post-bind state: assigned pods now include this cycle's placements
+    snap, meta = cluster.snapshot(failed_pods, now_ms=now)
+    nominated_extra = np.zeros(
+        (len(meta.node_names), len(meta.index)), np.int64
+    )
+    node_pos = {name: i for i, name in enumerate(meta.node_names)}
+    for pod in failed_pods:
+        if pod.nominated_node_name is not None:
+            # a stale nomination did not help this cycle: clear it so the
+            # pod can re-enter PostFilter next time (upstream clears
+            # NominatedNodeName when the pod is unschedulable again)
+            pod.nominated_node_name = None
+            continue
+        pg = cluster.pod_group_of(pod)
+        if pg is not None and pg.full_name in rejected:
+            continue  # the whole gang was rejected; no point preempting
+        result = engine.preempt(
+            cluster, scheduler, pod, snap, meta, now,
+            extra_reserved=nominated_extra,
+        )
+        if result is None:
+            continue
+        pod.nominated_node_name = result.nominated_node
+        n = node_pos[result.nominated_node]
+        demand = meta.index.encode(pod.effective_request())
+        demand[meta.index.position("pods")] = 1
+        victim_freed = np.zeros(len(meta.index), np.int64)
+        for victim_uid in result.victims:
+            victim = cluster.pods.get(victim_uid)
+            if victim is not None:
+                victim.deletion_ms = now  # DELETE issued; kubelet terminates
+                victim_freed += meta.index.encode(victim.effective_request())
+                victim_freed[meta.index.position("pods")] += 1
+        # net effect on the node for later preemptors: nominee demand minus
+        # the capacity its victims will free
+        nominated_extra[n] += demand - victim_freed
+        report.preempted[pod.uid] = (result.nominated_node, result.victims)
 
 
 def _resync_nrt_cache(cluster: Cluster):

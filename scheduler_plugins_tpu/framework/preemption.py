@@ -1,0 +1,308 @@
+"""Preemption engine — the PostFilter tier.
+
+Mirrors the upstream preemption evaluator driving plugin-specific victim
+rules (SURVEY.md §3.3):
+
+- `DEFAULT` mode: victims are lower-priority pods
+  (upstream DefaultPreemption semantics).
+- `CAPACITY` mode: ElasticQuota borrow rules
+  (/root/reference/pkg/capacityscheduling/capacity_scheduling.go:486-677):
+  a preemptor whose quota would stay over Min preys on same-namespace
+  lower-priority pods; a preemptor within its guaranteed Min preys on other
+  namespaces' pods whose quota is over Min; non-quota preemptors prey on
+  non-quota lower-priority pods. Post-removal quota gates (own Max, aggregate
+  Min) apply, and the reprieve loop re-checks them.
+- Preemption toleration (/root/reference/pkg/preemptiontoleration): victims
+  whose PriorityClass carries the toleration annotations are exempt when the
+  preemptor's priority is below MinimumPreemptablePriority and the victim is
+  inside its toleration window.
+
+TPU mapping per SURVEY.md §7 step 7: the "remove all eligible victims,
+re-filter" dry run is vectorized across all nodes at once (eligibility masks
++ per-node segment sums); the small per-node reprieve refinement stays
+host-side and exact. Candidate ranking follows the upstream pickOneNode
+criteria (min highest victim priority -> min priority sum -> fewest victims
+-> lowest index).
+
+The node re-filter in the dry run is the resource fit (+ quota gates); other
+enabled Filter plugins are not re-run against the hypothetical state in this
+round — the reference re-runs the full filter chain.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import Pod
+from scheduler_plugins_tpu.api.resources import PODS
+
+# PriorityClass annotations (preemption_toleration_policy.go:26-28)
+ANNOTATION_PREFIX = "preemption-toleration.scheduling.x-k8s.io/"
+ANNOTATION_MIN_PREEMPTABLE = ANNOTATION_PREFIX + "minimum-preemptable-priority"
+ANNOTATION_TOLERATION_SECONDS = ANNOTATION_PREFIX + "toleration-seconds"
+
+
+class PreemptionMode(enum.Enum):
+    DEFAULT = "Default"
+    CAPACITY = "CapacityScheduling"
+
+
+@dataclass
+class PreemptionResult:
+    nominated_node: str
+    victims: list[str]  # uids, most important first
+
+
+class PreemptionEngine:
+    def __init__(self, mode: PreemptionMode = PreemptionMode.DEFAULT,
+                 toleration: bool = False):
+        self.mode = mode
+        self.toleration = toleration
+
+    # -- exemption -------------------------------------------------------
+    def exempted(self, victim: Pod, preemptor: Pod, cluster, now_ms: int) -> bool:
+        """ExemptedFromPreemption (preemption_toleration.go:129-181)."""
+        if not self.toleration or not victim.priority_class_name:
+            return False
+        pc = cluster.priority_classes.get(victim.priority_class_name)
+        if pc is None:
+            return False
+        raw = pc.annotations.get(ANNOTATION_MIN_PREEMPTABLE)
+        if raw is None:
+            return False
+        try:
+            min_preemptable = int(raw)
+            # absent toleration-seconds defaults to 0: no time-based
+            # toleration (preemption_toleration_policy.go:73)
+            toleration_s = int(
+                pc.annotations.get(ANNOTATION_TOLERATION_SECONDS, 0)
+            )
+        except ValueError:
+            return False  # unparsable policy -> no toleration
+        if preemptor.priority >= min_preemptable:
+            return False
+        if toleration_s < 0:
+            return True  # tolerate forever
+        scheduled_ms = victim.creation_ms  # scheduled-at proxy
+        return scheduled_ms + toleration_s * 1000 > now_ms
+
+    # -- eligibility -----------------------------------------------------
+    def _eligible(self, victims, preemptor, cluster, snap, meta, now_ms):
+        """(V,) bool eligibility per mode."""
+        pri = np.array([v.priority for v in victims])
+        same_ns = np.array([v.namespace == preemptor.namespace for v in victims])
+        lower = pri < preemptor.priority
+
+        if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
+            quota = snap.quota
+            has_q = np.asarray(quota.has_quota)
+            used = np.asarray(quota.used)
+            qmin = np.asarray(quota.min)
+            ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+            v_ns = np.array(
+                [ns_codes.get(v.namespace, -1) for v in victims]
+            )
+            v_has_q = (v_ns >= 0) & has_q[np.maximum(v_ns, 0)]
+            p_ns = ns_codes.get(preemptor.namespace, -1)
+            p_has_q = p_ns >= 0 and bool(has_q[p_ns])
+            if p_has_q:
+                req = meta.index.encode(preemptor.effective_request())
+                # usedOverMinWith: used + req > min in any resource
+                more_than_min = bool(np.any(used[p_ns] + req > qmin[p_ns]))
+                if more_than_min:
+                    eligible = v_has_q & same_ns & lower
+                else:
+                    over_min = np.any(used > qmin, axis=1)  # (Q,)
+                    v_over = (v_ns >= 0) & over_min[np.maximum(v_ns, 0)]
+                    eligible = v_has_q & ~same_ns & v_over
+            else:
+                eligible = ~v_has_q & lower
+        else:
+            eligible = lower
+
+        if self.toleration:
+            exempt = np.array(
+                [self.exempted(v, preemptor, cluster, now_ms) for v in victims]
+            )
+            eligible &= ~exempt
+        return eligible
+
+    # -- main ------------------------------------------------------------
+    def preempt(self, cluster, scheduler, preemptor: Pod, snap, meta,
+                now_ms: int, extra_reserved=None) -> Optional[PreemptionResult]:
+        victims_all = [
+            p
+            for p in cluster.pods.values()
+            if p.node_name is not None and not p.terminating
+        ]
+        if not victims_all:
+            return None
+        node_pos = {name: i for i, name in enumerate(meta.node_names)}
+        v_node = np.array(
+            [node_pos.get(v.node_name, -1) for v in victims_all]
+        )
+        keep = v_node >= 0
+        victims_all = [v for v, k in zip(victims_all, keep) if k]
+        if not victims_all:
+            return None
+        v_node = v_node[keep]
+
+        index = meta.index
+        R = len(index)
+        N = len(meta.node_names)
+        v_req = np.zeros((len(victims_all), R), np.int64)
+        for i, v in enumerate(victims_all):
+            v_req[i] = index.encode(v.effective_request())
+            v_req[i, index.position(PODS)] = 1
+        v_pri = np.array([v.priority for v in victims_all])
+
+        eligible = self._eligible(victims_all, preemptor, cluster, snap, meta, now_ms)
+        if not eligible.any():
+            return None
+
+        # batched dry run: free + sum of eligible victims' demand per node
+        free = np.asarray(snap.nodes.alloc - snap.nodes.requested)[:N]
+        if extra_reserved is not None:
+            # earlier preemptors' nominations this cycle hold capacity
+            free = free - extra_reserved[:N]
+        removed = np.zeros((N, R), np.int64)
+        np.add.at(removed, v_node[eligible], v_req[eligible])
+        demand = index.encode(preemptor.effective_request())
+        demand[index.position(PODS)] = 1
+        node_mask = np.asarray(snap.nodes.mask)[:N]
+        fits = np.all(free + removed >= demand[None, :], axis=1) & node_mask
+        has_victims = np.zeros(N, bool)
+        has_victims[v_node[eligible]] = True
+        fits &= has_victims  # nodes without victims are unresolvable
+
+        # capacity-mode quota gates after removing all victims
+        if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
+            fits &= self._quota_gate(
+                victims_all, v_node, v_req, eligible, preemptor, snap, meta, N
+            )
+        if not fits.any():
+            return None
+
+        # pickOneNode: min highest victim priority -> min priority sum ->
+        # fewest victims -> lowest index
+        big = np.int64(2**62)
+        max_pri = np.full(N, -big, np.int64)
+        np.maximum.at(max_pri, v_node[eligible], v_pri[eligible])
+        sum_pri = np.zeros(N, np.int64)
+        np.add.at(sum_pri, v_node[eligible], v_pri[eligible])
+        count = np.zeros(N, np.int64)
+        np.add.at(count, v_node[eligible], 1)
+        order = sorted(
+            np.nonzero(fits)[0],
+            key=lambda n: (max_pri[n], sum_pri[n], count[n], n),
+        )
+        chosen = int(order[0])
+
+        # host-side reprieve on the chosen node (exact, small)
+        final_victims = self._reprieve(
+            victims_all, v_node, v_req, v_pri, eligible, chosen,
+            free[chosen], demand, preemptor, snap, meta,
+        )
+        return PreemptionResult(
+            nominated_node=meta.node_names[chosen],
+            victims=[v.uid for v in final_victims],
+        )
+
+    def _quota_gate(self, victims, v_node, v_req, eligible, preemptor, snap,
+                    meta, N):
+        """(N,) post-removal gates: own used+req <= Max and aggregate
+        used+req <= aggregate Min (capacity_scheduling.go:612-618)."""
+        quota = snap.quota
+        used = np.asarray(quota.used)
+        qmin = np.asarray(quota.min)
+        qmax = np.asarray(quota.max)
+        has_q = np.asarray(quota.has_quota)
+        ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+        p_ns = ns_codes.get(preemptor.namespace, -1)
+        if p_ns < 0 or not has_q[p_ns]:
+            return np.ones(N, bool)
+        req = meta.index.encode(preemptor.effective_request())
+        R = used.shape[1]
+        # the gates only need two per-node sums: removed usage of the
+        # preemptor's namespace (own-Max) and removed usage across all quota
+        # namespaces (aggregate-Min) — no dense (N, Q, R) tensor
+        removed_own = np.zeros((N, R), np.int64)
+        removed_total = np.zeros((N, R), np.int64)
+        for i in np.nonzero(eligible)[0]:
+            victim = victims[i]
+            ns = ns_codes.get(victim.namespace, -1)
+            if ns < 0 or not has_q[ns]:
+                continue
+            vec = meta.index.encode(victim.effective_request())
+            removed_total[v_node[i]] += vec
+            if ns == p_ns:
+                removed_own[v_node[i]] += vec
+        own_ok = np.all(
+            used[p_ns][None, :] - removed_own + req[None, :]
+            <= qmax[p_ns][None, :],
+            axis=1,
+        )
+        agg_used = np.sum(used * has_q[:, None], axis=0)
+        agg_min = np.sum(qmin * has_q[:, None], axis=0)
+        agg_ok = np.all(
+            agg_used[None, :] - removed_total + req[None, :]
+            <= agg_min[None, :],
+            axis=1,
+        )
+        return own_ok & agg_ok
+
+    def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
+                  demand, preemptor, snap, meta):
+        """Add back victims most-important-first while the preemptor still
+        fits and quota gates hold (capacity_scheduling.go:632-670)."""
+        idxs = [i for i in np.nonzero(eligible)[0] if v_node[i] == node]
+        # MoreImportantPod: higher priority, then earlier start
+        idxs.sort(key=lambda i: (-v_pri[i], victims[i].creation_ms))
+        free_after = free_n + v_req[idxs].sum(axis=0)
+
+        quota = snap.quota
+        use_quota = self.mode == PreemptionMode.CAPACITY and quota is not None
+        if use_quota:
+            ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+            has_q = np.asarray(quota.has_quota)
+            used = np.asarray(quota.used).copy()
+            qmin = np.asarray(quota.min)
+            qmax = np.asarray(quota.max)
+            p_ns = ns_codes.get(preemptor.namespace, -1)
+            req = meta.index.encode(preemptor.effective_request())
+            for i in idxs:
+                ns = ns_codes.get(victims[i].namespace, -1)
+                if ns >= 0 and has_q[ns]:
+                    used[ns] -= meta.index.encode(victims[i].effective_request())
+
+        final = []
+        for i in idxs:
+            candidate_free = free_after - v_req[i]
+            fits = bool(np.all(candidate_free >= demand))
+            quota_ok = True
+            if use_quota and fits and p_ns >= 0 and has_q[p_ns]:
+                vec = meta.index.encode(victims[i].effective_request())
+                ns = ns_codes.get(victims[i].namespace, -1)
+                used_try = used.copy()
+                if ns >= 0 and has_q[ns]:
+                    used_try[ns] += vec
+                own_ok = np.all(used_try[p_ns] + req <= qmax[p_ns])
+                agg = np.sum(used_try * has_q[:, None], axis=0)
+                agg_ok = np.all(agg + req <= np.sum(qmin * has_q[:, None], axis=0))
+                quota_ok = bool(own_ok and agg_ok)
+            if fits and quota_ok:
+                # reprieved: stays on the node
+                free_after = candidate_free
+                if use_quota:
+                    ns = ns_codes.get(victims[i].namespace, -1)
+                    if ns >= 0 and has_q[ns]:
+                        used[ns] += meta.index.encode(
+                            victims[i].effective_request()
+                        )
+            else:
+                final.append(victims[i])
+        return final

@@ -47,6 +47,10 @@ class Profile:
     #: `queue_key` (a profile enables exactly one QueueSort upstream), falling
     #: back to upstream PrioritySort semantics
     queue_sort: Optional[Plugin] = None
+    #: PostFilter preemption engine; None auto-selects from the enabled
+    #: plugins (CapacityScheduling -> quota-aware preemption,
+    #: PreemptionToleration -> default preemption with toleration)
+    preemption: Optional[object] = None
     name: str = "tpu-scheduler"
 
     def __post_init__(self):
@@ -56,6 +60,11 @@ class Profile:
                     plugin, "queue_compare"
                 ):
                     self.queue_sort = plugin
+                    break
+        if self.preemption is None:
+            for plugin in self.plugins:
+                if hasattr(plugin, "preemption_engine"):
+                    self.preemption = plugin.preemption_engine()
                     break
 
 

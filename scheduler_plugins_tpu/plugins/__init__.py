@@ -22,6 +22,9 @@ from scheduler_plugins_tpu.plugins.networkaware import (  # noqa: F401
     TopologicalSort,
 )
 from scheduler_plugins_tpu.plugins.podstate import PodState  # noqa: F401
+from scheduler_plugins_tpu.plugins.preemptiontoleration import (  # noqa: F401
+    PreemptionToleration,
+)
 from scheduler_plugins_tpu.plugins.qos import QOSSort  # noqa: F401
 from scheduler_plugins_tpu.plugins.sysched import SySched  # noqa: F401
 from scheduler_plugins_tpu.plugins.trimaran import (  # noqa: F401

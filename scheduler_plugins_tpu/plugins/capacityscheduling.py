@@ -20,6 +20,17 @@ from scheduler_plugins_tpu.ops.quota import quota_admit, quota_commit
 class CapacityScheduling(Plugin):
     name = "CapacityScheduling"
 
+    def preemption_engine(self):
+        """PostFilter = quota-aware preemption
+        (capacity_scheduling.go:331-348 wraps the upstream evaluator with the
+        EQ borrow rules)."""
+        from scheduler_plugins_tpu.framework.preemption import (
+            PreemptionEngine,
+            PreemptionMode,
+        )
+
+        return PreemptionEngine(PreemptionMode.CAPACITY)
+
     def admit(self, state, snap, p):
         if snap.quota is None or state.eq_used is None:
             return None

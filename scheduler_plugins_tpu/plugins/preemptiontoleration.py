@@ -1,0 +1,24 @@
+"""PreemptionToleration — PostFilter plugin: DefaultPreemption with victim
+exemption by PriorityClass toleration policy.
+
+Reference: /root/reference/pkg/preemptiontoleration (SelectVictimsOnNode is a
+near-copy of upstream DefaultPreemption except victims may be exempted:
+ExemptedFromPreemption, preemption_toleration.go:124-181). The plugin itself
+contributes no Filter/Score tensors — it configures the cycle's preemption
+engine (framework.preemption) with toleration enabled.
+"""
+
+from __future__ import annotations
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.framework.preemption import (
+    PreemptionEngine,
+    PreemptionMode,
+)
+
+
+class PreemptionToleration(Plugin):
+    name = "PreemptionToleration"
+
+    def preemption_engine(self) -> PreemptionEngine:
+        return PreemptionEngine(PreemptionMode.DEFAULT, toleration=True)

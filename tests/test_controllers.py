@@ -1,0 +1,104 @@
+"""Controller reconciliation tests (mirrors podgroup_controller_test.go and
+elasticquota_controller_test.go scenarios)."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    ElasticQuota,
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    PodPhase,
+    POD_GROUP_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU
+from scheduler_plugins_tpu.controllers import (
+    reconcile_elastic_quotas,
+    reconcile_pod_groups,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+
+def member(name, phase=PodPhase.PENDING, ns="default", cpu=100):
+    return Pod(
+        name=name,
+        namespace=ns,
+        phase=phase,
+        containers=[Container(requests={CPU: cpu})],
+        labels={POD_GROUP_LABEL: "g"},
+    )
+
+
+class TestPodGroupController:
+    def test_pending_to_scheduling_at_min_member(self):
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0"))
+        reconcile_pod_groups(c, now_ms=100)
+        assert pg.phase == PodGroupPhase.PENDING
+        c.add_pod(member("m1"))
+        reconcile_pod_groups(c, now_ms=200)
+        assert pg.phase == PodGroupPhase.SCHEDULING
+        assert pg.schedule_start_ms == 200
+        assert pg.occupied_by
+
+    def test_running_then_finished(self):
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2, phase=PodGroupPhase.SCHEDULING)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0", PodPhase.RUNNING))
+        c.add_pod(member("m1", PodPhase.RUNNING))
+        reconcile_pod_groups(c)
+        assert pg.phase == PodGroupPhase.RUNNING
+        for uid in ("default/m0", "default/m1"):
+            c.pods[uid].phase = PodPhase.SUCCEEDED
+        reconcile_pod_groups(c)
+        assert pg.phase == PodGroupPhase.FINISHED
+        # terminal: no further transitions
+        c.pods["default/m0"].phase = PodPhase.FAILED
+        reconcile_pod_groups(c)
+        assert pg.phase == PodGroupPhase.FINISHED
+
+    def test_failed_final_state(self):
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2, phase=PodGroupPhase.SCHEDULING)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0", PodPhase.FAILED))
+        c.add_pod(member("m1", PodPhase.RUNNING))
+        reconcile_pod_groups(c)
+        assert pg.phase == PodGroupPhase.FAILED
+
+    def test_member_loss_demotes_to_pending(self):
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2, phase=PodGroupPhase.RUNNING)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0", PodPhase.RUNNING))
+        reconcile_pod_groups(c)
+        assert pg.phase == PodGroupPhase.PENDING
+
+    def test_stale_schedule_timeout_event(self):
+        c = Cluster()
+        pg = PodGroup(
+            name="g",
+            min_member=1,
+            phase=PodGroupPhase.SCHEDULING,
+            creation_ms=0,
+            schedule_start_ms=49 * 3600 * 1000,
+        )
+        c.add_pod_group(pg)
+        events = reconcile_pod_groups(c, now_ms=50 * 3600 * 1000)
+        assert any("Timeout" in e for e in events)
+
+
+class TestElasticQuotaController:
+    def test_used_tracks_running_pods(self):
+        c = Cluster()
+        eq = ElasticQuota(name="q", namespace="ns", min={CPU: 1000})
+        c.add_quota(eq)
+        c.add_pod(member("r1", PodPhase.RUNNING, ns="ns", cpu=300))
+        c.add_pod(member("p1", PodPhase.PENDING, ns="ns", cpu=500))
+        events = reconcile_elastic_quotas(c)
+        assert eq.used == {CPU: 300}
+        assert events == ["Normal Synced ns/q"]
+        # idempotent: no event when nothing changed
+        assert reconcile_elastic_quotas(c) == []

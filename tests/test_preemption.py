@@ -1,0 +1,225 @@
+"""Preemption decision tables: default priority preemption, quota borrow
+rules, toleration exemption, reprieve minimization (mirrors
+capacity_scheduling_test.go and preemption_toleration_test.go patterns)."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    ElasticQuota,
+    Node,
+    Pod,
+    PriorityClass,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.framework.preemption import (
+    ANNOTATION_MIN_PREEMPTABLE,
+    ANNOTATION_TOLERATION_SECONDS,
+    PreemptionEngine,
+    PreemptionMode,
+)
+from scheduler_plugins_tpu.plugins import (
+    CapacityScheduling,
+    NodeResourcesAllocatable,
+    PreemptionToleration,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def mknode(name, cpu=4000):
+    return Node(name=name, allocatable={CPU: cpu, MEMORY: 32 * gib, PODS: 110})
+
+
+def mkpod(name, cpu, ns="default", priority=0, node=None, pc="", created=0):
+    p = Pod(
+        name=name,
+        namespace=ns,
+        priority=priority,
+        priority_class_name=pc,
+        creation_ms=created,
+        containers=[Container(requests={CPU: cpu, MEMORY: gib})],
+    )
+    p.node_name = node
+    return p
+
+
+def default_sched(*extra):
+    return Scheduler(
+        Profile(
+            plugins=[NodeResourcesAllocatable(), *extra],
+            preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+        )
+    )
+
+
+class TestDefaultPreemption:
+    def test_preempts_lower_priority_victim(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("high", 3000, priority=10))
+        report = run_cycle(default_sched(), cluster, now=1000)
+        assert "default/high" in report.preempted
+        node, victims = report.preempted["default/high"]
+        assert node == "n0" and victims == ["default/low"]
+        assert cluster.pods["default/low"].terminating
+        assert cluster.pods["default/high"].nominated_node_name == "n0"
+
+    def test_no_preemption_of_equal_or_higher_priority(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_pod(mkpod("peer", 3000, priority=10, node="n0"))
+        cluster.add_pod(mkpod("claimant", 3000, priority=10))
+        report = run_cycle(default_sched(), cluster, now=1000)
+        assert not report.preempted
+
+    def test_reprieve_minimizes_victims(self):
+        # two victims of 1500 each; preemptor needs 1400: removing both fits,
+        # the reprieve adds the more important (higher-priority) one back and
+        # only the lower-priority pod is evicted
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=4000))
+        cluster.add_pod(mkpod("v1", 1500, priority=5, node="n0", created=1))
+        cluster.add_pod(mkpod("v2", 1500, priority=1, node="n0", created=2))
+        filler = mkpod("filler", 1000, priority=20, node="n0", created=0)
+        cluster.add_pod(filler)
+        cluster.add_pod(mkpod("big", 1400, priority=10))
+        report = run_cycle(default_sched(), cluster, now=1000)
+        _, victims = report.preempted["default/big"]
+        assert victims == ["default/v2"]  # lower-priority victim only
+        assert not cluster.pods["default/v1"].terminating
+
+    def test_picks_node_with_lowest_victim_priority(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("a"))
+        cluster.add_node(mknode("b"))
+        cluster.add_pod(mkpod("va", 3000, priority=8, node="a"))
+        cluster.add_pod(mkpod("vb", 3000, priority=2, node="b"))
+        cluster.add_pod(mkpod("claimant", 3000, priority=10))
+        report = run_cycle(default_sched(), cluster, now=1000)
+        node, victims = report.preempted["default/claimant"]
+        assert node == "b" and victims == ["default/vb"]
+
+
+class TestCapacityPreemption:
+    def cluster(self):
+        c = Cluster()
+        c.add_node(mknode("n0", cpu=4000))
+        c.add_quota(ElasticQuota(name="a", namespace="a",
+                                 min={CPU: 2000, MEMORY: 8 * gib},
+                                 max={CPU: 4000, MEMORY: 16 * gib}))
+        c.add_quota(ElasticQuota(name="b", namespace="b",
+                                 min={CPU: 2000, MEMORY: 8 * gib},
+                                 max={CPU: 4000, MEMORY: 16 * gib}))
+        return c
+
+    def sched(self):
+        return Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(), CapacityScheduling()])
+        )
+
+    def test_borrowing_namespace_evicted_by_guaranteed_claimant(self):
+        # b borrows beyond its min (uses 3000 > min 2000); a's pod within its
+        # own min preempts b's pods even at LOWER priority
+        c = self.cluster()
+        c.add_pod(mkpod("b1", 1500, ns="b", priority=5, node="n0", created=1))
+        c.add_pod(mkpod("b2", 1500, ns="b", priority=5, node="n0", created=2))
+        c.add_pod(mkpod("a1", 1500, ns="a", priority=1))
+        report = run_cycle(self.sched(), c, now=1000)
+        assert "a/a1" in report.preempted
+        node, victims = report.preempted["a/a1"]
+        assert node == "n0" and len(victims) == 1
+        assert victims[0].startswith("b/")
+
+    def test_over_min_claimant_preempts_own_namespace_only(self):
+        # a already uses 2000 (its min); another a pod means preying on its
+        # own lower-priority pods, not on b's
+        c = self.cluster()
+        c.add_pod(mkpod("a-old", 2000, ns="a", priority=1, node="n0", created=1))
+        c.add_pod(mkpod("b-old", 1500, ns="b", priority=1, node="n0", created=2))
+        c.add_pod(mkpod("a-new", 1500, ns="a", priority=5))
+        report = run_cycle(self.sched(), c, now=1000)
+        assert "a/a-new" in report.preempted
+        _, victims = report.preempted["a/a-new"]
+        assert victims == ["a/a-old"]
+
+    def test_non_quota_preemptor_spares_quota_pods(self):
+        c = self.cluster()
+        c.add_pod(mkpod("b1", 3000, ns="b", priority=1, node="n0"))
+        c.add_pod(mkpod("free", 3000, ns="noquota", priority=10))
+        report = run_cycle(self.sched(), c, now=1000)
+        # only victim candidates are non-EQ pods; none exist -> no preemption
+        assert not report.preempted
+
+
+class TestPreemptionToleration:
+    def test_tolerated_victim_is_spared(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_priority_class(
+            PriorityClass(
+                name="tolerant",
+                value=1,
+                annotations={
+                    ANNOTATION_MIN_PREEMPTABLE: "100",
+                    ANNOTATION_TOLERATION_SECONDS: "-1",
+                },
+            )
+        )
+        cluster.add_pod(
+            mkpod("victim", 3000, priority=1, node="n0", pc="tolerant")
+        )
+        cluster.add_pod(mkpod("claimant", 3000, priority=50))
+        sched = Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(), PreemptionToleration()])
+        )
+        report = run_cycle(sched, cluster, now=1000)
+        assert not report.preempted  # claimant priority 50 < threshold 100
+
+    def test_high_priority_preemptor_overrides_toleration(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_priority_class(
+            PriorityClass(
+                name="tolerant",
+                value=1,
+                annotations={ANNOTATION_MIN_PREEMPTABLE: "100"},
+            )
+        )
+        cluster.add_pod(
+            mkpod("victim", 3000, priority=1, node="n0", pc="tolerant")
+        )
+        cluster.add_pod(mkpod("boss", 3000, priority=200))
+        sched = Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(), PreemptionToleration()])
+        )
+        report = run_cycle(sched, cluster, now=1000)
+        assert "default/boss" in report.preempted
+
+    def test_toleration_window_expiry(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0"))
+        cluster.add_priority_class(
+            PriorityClass(
+                name="brief",
+                value=1,
+                annotations={
+                    ANNOTATION_MIN_PREEMPTABLE: "100",
+                    ANNOTATION_TOLERATION_SECONDS: "10",
+                },
+            )
+        )
+        cluster.add_pod(
+            mkpod("victim", 3000, priority=1, node="n0", pc="brief", created=0)
+        )
+        cluster.add_pod(mkpod("claimant", 3000, priority=50))
+        sched = Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(), PreemptionToleration()])
+        )
+        # within the 10s window: spared
+        report = run_cycle(sched, cluster, now=5_000)
+        assert not report.preempted
+        # after the window: preempted
+        report = run_cycle(sched, cluster, now=20_000)
+        assert "default/claimant" in report.preempted
