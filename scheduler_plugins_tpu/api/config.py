@@ -1,0 +1,123 @@
+"""Profile configuration: the KubeSchedulerConfiguration-equivalent surface.
+
+The reference decodes per-plugin args from YAML through a scheme with
+versioned defaulting and validation (SURVEY.md §5;
+/root/reference/apis/config/types.go:28-307, v1/defaults.go:29-256,
+validation/validation_pluginargs.go:48-110). Here a plain dict (parsed from
+YAML/JSON upstream of this module) lowers to a `framework.Profile`:
+
+    {
+      "profileName": "tpu-scheduler",
+      "plugins": ["Coscheduling", "CapacityScheduling", ...],
+      "pluginConfig": [
+        {"name": "Coscheduling", "args": {"permitWaitingTimeSeconds": 10}},
+        ...
+      ],
+    }
+
+Plugin constructors carry the reference's defaulting and validation (each
+raises ValueError on invalid args, mirroring validation_pluginargs.go).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+from scheduler_plugins_tpu.framework.runtime import Profile
+
+#: camelCase arg name -> plugin constructor kwarg, per plugin
+_ARG_MAPS: dict[str, dict[str, str]] = {
+    "Coscheduling": {
+        "permitWaitingTimeSeconds": "permit_waiting_seconds",
+        "podGroupBackoffSeconds": "pod_group_backoff_seconds",
+        "podGroupRejectPercentage": "reject_percentage",
+    },
+    "NodeResourcesAllocatable": {"resources": "resources", "mode": "mode"},
+    "TargetLoadPacking": {
+        "targetUtilization": "target_utilization_percent",
+    },
+    "LoadVariationRiskBalancing": {
+        "safeVarianceMargin": "safe_variance_margin",
+        "safeVarianceSensitivity": "safe_variance_sensitivity",
+    },
+    "LowRiskOverCommitment": {
+        "smoothingWindowSize": "smoothing_window_size",
+        "riskLimitWeights": "risk_limit_weights",
+    },
+    "Peaks": {"nodePowerModel": "node_power_model"},
+    "NodeResourceTopologyMatch": {
+        "scoringStrategy": "scoring_strategy",
+        "resources": "resources",
+    },
+    "NetworkOverhead": {
+        "weightsName": "weights_name",
+        "networkTopologyName": "network_topology_name",
+        "namespaces": "namespaces",
+    },
+    "TopologicalSort": {"namespaces": "namespaces"},
+    "SySched": {
+        "defaultProfileNamespace": "default_profile_namespace",
+        "defaultProfileName": "default_profile_name",
+    },
+    "CapacityScheduling": {},
+    "PreemptionToleration": {},
+    "PodState": {},
+    "QOSSort": {},
+}
+
+
+def _registry():
+    from scheduler_plugins_tpu import plugins as p
+
+    return {
+        "Coscheduling": p.Coscheduling,
+        "CapacityScheduling": p.CapacityScheduling,
+        "NodeResourcesAllocatable": p.NodeResourcesAllocatable,
+        "NodeResourceTopologyMatch": p.NodeResourceTopologyMatch,
+        "TargetLoadPacking": p.TargetLoadPacking,
+        "LoadVariationRiskBalancing": p.LoadVariationRiskBalancing,
+        "LowRiskOverCommitment": p.LowRiskOverCommitment,
+        "Peaks": p.Peaks,
+        "NetworkOverhead": p.NetworkOverhead,
+        "TopologicalSort": p.TopologicalSort,
+        "PreemptionToleration": p.PreemptionToleration,
+        "SySched": p.SySched,
+        "PodState": p.PodState,
+        "QOSSort": p.QOSSort,
+    }
+
+
+def available_plugins() -> tuple[str, ...]:
+    """The full plugin roster — the 14 plugins the reference compiles into its
+    scheduler binary (/root/reference/cmd/scheduler/main.go:50-67;
+    CrossNodePreemption is registration-commented-out there and spec-only
+    here, see docs/PARITY.md)."""
+    return tuple(sorted(_registry()))
+
+
+def load_profile(config: Mapping) -> Profile:
+    """Lower a configuration mapping into a Profile.
+
+    Unknown plugin names or args raise ValueError (the scheme would fail to
+    decode); per-plugin validation happens in the constructors.
+    """
+    registry = _registry()
+    args_by_plugin: dict[str, Mapping] = {}
+    for entry in config.get("pluginConfig", []):
+        args_by_plugin[entry["name"]] = entry.get("args", {})
+
+    plugins = []
+    for name in config.get("plugins", []):
+        cls = registry.get(name)
+        if cls is None:
+            raise ValueError(f"unknown plugin {name!r}")
+        arg_map = _ARG_MAPS.get(name, {})
+        kwargs = {}
+        for key, value in args_by_plugin.get(name, {}).items():
+            if key not in arg_map:
+                raise ValueError(f"unknown arg {key!r} for plugin {name}")
+            kwargs[arg_map[key]] = value
+        plugins.append(cls(**kwargs))
+    return Profile(
+        plugins=plugins, name=config.get("profileName", "tpu-scheduler")
+    )

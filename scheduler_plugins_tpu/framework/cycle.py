@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from scheduler_plugins_tpu.framework.preemption import encode_demand
 from scheduler_plugins_tpu.framework.runtime import Scheduler, now_ms as _now_ms
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
 from scheduler_plugins_tpu.state.cluster import Cluster
@@ -132,6 +133,10 @@ def _run_preemption(scheduler, cluster, pending, report, now):
     nominated_extra = np.zeros(
         (len(meta.node_names), len(meta.index)), np.int64
     )
+    nominated_quota = None
+    if snap.quota is not None:
+        nominated_quota = np.zeros(np.asarray(snap.quota.used).shape, np.int64)
+    ns_pos = {ns: i for i, ns in enumerate(meta.namespaces)}
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
     for pod in failed_pods:
         if pod.nominated_node_name is not None:
@@ -146,20 +151,24 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
             extra_reserved=nominated_extra,
+            extra_quota_used=nominated_quota,
         )
         if result is None:
             continue
         pod.nominated_node_name = result.nominated_node
         n = node_pos[result.nominated_node]
-        demand = meta.index.encode(pod.effective_request())
-        demand[meta.index.position("pods")] = 1
+        demand = encode_demand(meta.index, pod)
+        if nominated_quota is not None and pod.namespace in ns_pos:
+            # later preemptors must see this nomination as quota usage
+            nominated_quota[ns_pos[pod.namespace]] += meta.index.encode(
+                pod.effective_request()
+            )
         victim_freed = np.zeros(len(meta.index), np.int64)
         for victim_uid in result.victims:
             victim = cluster.pods.get(victim_uid)
             if victim is not None:
                 victim.deletion_ms = now  # DELETE issued; kubelet terminates
-                victim_freed += meta.index.encode(victim.effective_request())
-                victim_freed[meta.index.position("pods")] += 1
+                victim_freed += encode_demand(meta.index, victim)
         # net effect on the node for later preemptors: nominee demand minus
         # the capacity its victims will free
         nominated_extra[n] += demand - victim_freed

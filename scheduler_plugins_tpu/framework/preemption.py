@@ -46,6 +46,14 @@ ANNOTATION_MIN_PREEMPTABLE = ANNOTATION_PREFIX + "minimum-preemptable-priority"
 ANNOTATION_TOLERATION_SECONDS = ANNOTATION_PREFIX + "toleration-seconds"
 
 
+def encode_demand(index, pod: "Pod"):
+    """Pod demand vector with the pods slot set to 1 (the host-side analog
+    of ops.fit.pod_fit_demand)."""
+    vec = index.encode(pod.effective_request())
+    vec[index.position(PODS)] = 1
+    return vec
+
+
 class PreemptionMode(enum.Enum):
     DEFAULT = "Default"
     CAPACITY = "CapacityScheduling"
@@ -58,6 +66,10 @@ class PreemptionResult:
 
 
 class PreemptionEngine:
+    #: candidate-node cap for the exact per-node reprieve (the upstream
+    #: evaluator samples candidates too, preemption_toleration.go:306-331)
+    MAX_CANDIDATES = 100
+
     def __init__(self, mode: PreemptionMode = PreemptionMode.DEFAULT,
                  toleration: bool = False):
         self.mode = mode
@@ -91,7 +103,8 @@ class PreemptionEngine:
         return scheduled_ms + toleration_s * 1000 > now_ms
 
     # -- eligibility -----------------------------------------------------
-    def _eligible(self, victims, preemptor, cluster, snap, meta, now_ms):
+    def _eligible(self, victims, preemptor, cluster, snap, meta, now_ms,
+                  extra_quota_used=None):
         """(V,) bool eligibility per mode."""
         pri = np.array([v.priority for v in victims])
         same_ns = np.array([v.namespace == preemptor.namespace for v in victims])
@@ -101,6 +114,8 @@ class PreemptionEngine:
             quota = snap.quota
             has_q = np.asarray(quota.has_quota)
             used = np.asarray(quota.used)
+            if extra_quota_used is not None:
+                used = used + extra_quota_used
             qmin = np.asarray(quota.min)
             ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
             v_ns = np.array(
@@ -133,7 +148,8 @@ class PreemptionEngine:
 
     # -- main ------------------------------------------------------------
     def preempt(self, cluster, scheduler, preemptor: Pod, snap, meta,
-                now_ms: int, extra_reserved=None) -> Optional[PreemptionResult]:
+                now_ms: int, extra_reserved=None,
+                extra_quota_used=None) -> Optional[PreemptionResult]:
         victims_all = [
             p
             for p in cluster.pods.values()
@@ -160,7 +176,9 @@ class PreemptionEngine:
             v_req[i, index.position(PODS)] = 1
         v_pri = np.array([v.priority for v in victims_all])
 
-        eligible = self._eligible(victims_all, preemptor, cluster, snap, meta, now_ms)
+        eligible = self._eligible(
+            victims_all, preemptor, cluster, snap, meta, now_ms, extra_quota_used
+        )
         if not eligible.any():
             return None
 
@@ -171,8 +189,7 @@ class PreemptionEngine:
             free = free - extra_reserved[:N]
         removed = np.zeros((N, R), np.int64)
         np.add.at(removed, v_node[eligible], v_req[eligible])
-        demand = index.encode(preemptor.effective_request())
-        demand[index.position(PODS)] = 1
+        demand = encode_demand(index, preemptor)
         node_mask = np.asarray(snap.nodes.mask)[:N]
         fits = np.all(free + removed >= demand[None, :], axis=1) & node_mask
         has_victims = np.zeros(N, bool)
@@ -182,42 +199,49 @@ class PreemptionEngine:
         # capacity-mode quota gates after removing all victims
         if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
             fits &= self._quota_gate(
-                victims_all, v_node, v_req, eligible, preemptor, snap, meta, N
+                victims_all, v_node, v_req, eligible, preemptor, snap, meta, N,
+                extra_quota_used,
             )
         if not fits.any():
             return None
 
-        # pickOneNode: min highest victim priority -> min priority sum ->
-        # fewest victims -> lowest index
-        big = np.int64(2**62)
-        max_pri = np.full(N, -big, np.int64)
-        np.maximum.at(max_pri, v_node[eligible], v_pri[eligible])
-        sum_pri = np.zeros(N, np.int64)
-        np.add.at(sum_pri, v_node[eligible], v_pri[eligible])
-        count = np.zeros(N, np.int64)
-        np.add.at(count, v_node[eligible], 1)
-        order = sorted(
-            np.nonzero(fits)[0],
-            key=lambda n: (max_pri[n], sum_pri[n], count[n], n),
-        )
-        chosen = int(order[0])
-
-        # host-side reprieve on the chosen node (exact, small)
-        final_victims = self._reprieve(
-            victims_all, v_node, v_req, v_pri, eligible, chosen,
-            free[chosen], demand, preemptor, snap, meta,
-        )
+        # run the exact reprieve per candidate (bounded, like the upstream
+        # candidate sampling) and rank by the FINAL minimized victim sets —
+        # pickOneNode criteria: min highest victim priority -> min priority
+        # sum -> fewest victims -> lowest index (upstream pickOneNode)
+        candidates = np.nonzero(fits)[0][: self.MAX_CANDIDATES]
+        best = None
+        for n in candidates:
+            final = self._reprieve(
+                victims_all, v_node, v_req, v_pri, eligible, int(n),
+                free[int(n)], demand, preemptor, snap, meta, extra_quota_used,
+            )
+            if not final:
+                continue
+            stats = (
+                max(v.priority for v in final),
+                sum(v.priority for v in final),
+                len(final),
+                int(n),
+            )
+            if best is None or stats < best[0]:
+                best = (stats, int(n), final)
+        if best is None:
+            return None
+        _, chosen, final_victims = best
         return PreemptionResult(
             nominated_node=meta.node_names[chosen],
             victims=[v.uid for v in final_victims],
         )
 
     def _quota_gate(self, victims, v_node, v_req, eligible, preemptor, snap,
-                    meta, N):
+                    meta, N, extra_quota_used=None):
         """(N,) post-removal gates: own used+req <= Max and aggregate
         used+req <= aggregate Min (capacity_scheduling.go:612-618)."""
         quota = snap.quota
         used = np.asarray(quota.used)
+        if extra_quota_used is not None:
+            used = used + extra_quota_used
         qmin = np.asarray(quota.min)
         qmax = np.asarray(quota.max)
         has_q = np.asarray(quota.has_quota)
@@ -256,7 +280,7 @@ class PreemptionEngine:
         return own_ok & agg_ok
 
     def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
-                  demand, preemptor, snap, meta):
+                  demand, preemptor, snap, meta, extra_quota_used=None):
         """Add back victims most-important-first while the preemptor still
         fits and quota gates hold (capacity_scheduling.go:632-670)."""
         idxs = [i for i in np.nonzero(eligible)[0] if v_node[i] == node]
@@ -270,6 +294,8 @@ class PreemptionEngine:
             ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
             has_q = np.asarray(quota.has_quota)
             used = np.asarray(quota.used).copy()
+            if extra_quota_used is not None:
+                used = used + extra_quota_used
             qmin = np.asarray(quota.min)
             qmax = np.asarray(quota.max)
             p_ns = ns_codes.get(preemptor.namespace, -1)

@@ -1,0 +1,79 @@
+"""Config surface tests: profile loading, defaulting, validation
+(mirrors apis/config validation + defaults coverage)."""
+
+import pytest
+
+from scheduler_plugins_tpu.api.config import available_plugins, load_profile
+from scheduler_plugins_tpu.framework.preemption import PreemptionMode
+from scheduler_plugins_tpu.plugins import Coscheduling, TargetLoadPacking
+
+
+class TestLoadProfile:
+    def test_full_roster_loads(self):
+        profile = load_profile({"plugins": list(available_plugins())})
+        assert len(profile.plugins) == 14
+
+    def test_args_and_defaults(self):
+        profile = load_profile(
+            {
+                "plugins": ["Coscheduling", "TargetLoadPacking"],
+                "pluginConfig": [
+                    {
+                        "name": "Coscheduling",
+                        "args": {"permitWaitingTimeSeconds": 10},
+                    }
+                ],
+            }
+        )
+        cosched = next(p for p in profile.plugins if isinstance(p, Coscheduling))
+        assert cosched.permit_waiting_seconds == 10
+        assert cosched.reject_percentage == 10  # default (defaults.go:29-47)
+        tlp = next(p for p in profile.plugins if isinstance(p, TargetLoadPacking))
+        assert tlp.target == 40.0  # default target utilization
+
+    def test_capacity_profile_selects_quota_preemption(self):
+        profile = load_profile({"plugins": ["CapacityScheduling"]})
+        assert profile.preemption.mode == PreemptionMode.CAPACITY
+
+    def test_unknown_plugin_rejected(self):
+        with pytest.raises(ValueError, match="unknown plugin"):
+            load_profile({"plugins": ["Bogus"]})
+
+    def test_unknown_arg_rejected(self):
+        with pytest.raises(ValueError, match="unknown arg"):
+            load_profile(
+                {
+                    "plugins": ["Coscheduling"],
+                    "pluginConfig": [
+                        {"name": "Coscheduling", "args": {"nope": 1}}
+                    ],
+                }
+            )
+
+    def test_invalid_args_rejected_by_validation(self):
+        # validation_pluginargs.go:48-58: negative timeout invalid
+        with pytest.raises(ValueError):
+            load_profile(
+                {
+                    "plugins": ["Coscheduling"],
+                    "pluginConfig": [
+                        {
+                            "name": "Coscheduling",
+                            "args": {"permitWaitingTimeSeconds": -5},
+                        }
+                    ],
+                }
+            )
+        # NodeResourceTopologyMatch strategy must be legal
+        with pytest.raises(ValueError):
+            load_profile(
+                {
+                    "plugins": ["NodeResourceTopologyMatch"],
+                    "pluginConfig": [
+                        {
+                            "name": "NodeResourceTopologyMatch",
+                            "args": {"scoringStrategy": "Bogus"},
+                        }
+                    ],
+                }
+            )
