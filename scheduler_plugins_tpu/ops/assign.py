@@ -30,43 +30,37 @@ from scheduler_plugins_tpu.ops.fit import pod_fit_demand
 #: signature: (free (N,R), pod_index int32) -> (feasible (N,) bool, score (N,) int64)
 StepFn = Callable
 
-#: pods per admission chunk — bounds TPU scoped-VMEM use of the queue-order
-#: prefix cumsum (a full (P, N) int64 cumsum overflows the 16MB scoped vmem
-#: at bench shapes)
-ADMIT_CHUNK = 256
-
-
 def _queue_order_admission(onehot, demand, free):
     """(P,) bool: pod admitted iff its node still fits after all earlier
     winners of the same wave on that node.
 
-    Exact per-resource prefix sums via a fully-parallel blocked scan
-    (within-chunk cumsum + exclusive cumsum over the small chunk-totals
-    axis): an int64 cumsum over the whole P axis lowers to a vmem-hungry
-    u32-pair reduce-window on TPU, so chunks run in float64 — exact for
-    quantities below 2^53.
+    Exact per-node queue-order prefix sums WITHOUT a (P, N) cumsum (int64
+    2-D cumsums lower to vmem-hungry reduce-windows on TPU and compile
+    pathologically): sort pods by (chosen node, queue position), run 1-D
+    float64 cumsums (exact below 2^53) over the sorted axis, rebase each
+    node's segment with a forward-filled running maximum, and scatter the
+    verdicts back.
     """
     P, N = onehot.shape
     R = demand.shape[1]
-    chunk = min(ADMIT_CHUNK, P)
-    if P % chunk != 0:  # padded batches are powers of two; fallback safety
-        chunk = P
-    K = P // chunk
+    choice = jnp.where(
+        onehot.any(axis=1), jnp.argmax(onehot, axis=1), N
+    )  # (P,) chosen node, N = "no choice" sentinel sorted last
+    rank = jnp.arange(P)
+    order = jnp.argsort(choice * P + rank)  # stable (choice, queue) order
+    seg = choice[order]  # (P,) sorted segment ids
+    first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
 
-    fits = jnp.ones((P, N), bool)
-    for r in range(R):
-        contrib = (
-            (onehot * demand[:, r][:, None]).astype(jnp.float64)
-        ).reshape(K, chunk, N)
-        within = jnp.cumsum(contrib, axis=1)  # parallel over K blocks
-        totals = within[:, -1, :]  # (K, N)
-        base = jnp.concatenate(
-            [jnp.zeros((1, N), jnp.float64), jnp.cumsum(totals[:-1], axis=0)],
-            axis=0,
-        )  # exclusive block offsets (K tiny)
-        prefix = (base[:, None, :] + within).reshape(P, N)
-        fits &= prefix <= free[None, :, r].astype(jnp.float64)
-    return (onehot & fits).any(axis=1)
+    dem_sorted = demand[order].astype(jnp.float64)  # (P, R)
+    csum = jnp.cumsum(dem_sorted, axis=0)  # 1-D scans per resource column
+    exclusive = csum - dem_sorted
+    # segment base = exclusive sum at the segment's first row, forward-filled
+    # (cummax works: exclusive is non-decreasing along the sorted axis)
+    base = jax.lax.cummax(jnp.where(first[:, None], exclusive, -1.0), axis=0)
+    within = csum - base  # inclusive per-segment prefix
+    free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)  # (P, R)
+    ok_sorted = jnp.all(within <= free_row, axis=1) & (seg < N)
+    return jnp.zeros(P, bool).at[order].set(ok_sorted)
 
 
 def _pick(feasible, scores):
@@ -123,6 +117,7 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
         active = (assignment == -1) & pod_mask
         feasible, scores = batch_fn(free, active)
         feasible &= active[:, None]
+        neg_inf = jnp.iinfo(scores.dtype).min // 2
         n_active = jnp.maximum(active.sum(), 1)
 
         # node order by mean score over active pods (static scores -> the
@@ -153,7 +148,7 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
         target_ok = jnp.take_along_axis(
             feasible, target[:, None], axis=1
         ).squeeze(1)
-        masked = jnp.where(feasible, scores, jnp.int64(-(2**62)))
+        masked = jnp.where(feasible, scores, neg_inf)
         fallback = jnp.argmax(masked, axis=1).astype(jnp.int32)
         choice = jnp.where(
             target_ok, target.astype(jnp.int32),
@@ -161,7 +156,7 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
         )
         choice = jnp.where(active, choice, -1)
 
-        # exact queue-order admission per node, chunked for VMEM
+        # exact queue-order admission per node (sorted-segment prefix sums)
         onehot = (choice[:, None] == jnp.arange(N)[None, :]) & (
             choice[:, None] >= 0
         )
@@ -214,8 +209,8 @@ def wave_assign(batch_fn, req, pod_mask, free0, max_waves: int = 8):
             feasible.any(axis=1), jnp.argmax(masked, axis=1).astype(jnp.int32), -1
         )
         # queue-order admission: pod p wins iff node still fits after all
-        # earlier winners of the same wave on the same node (chunked exact
-        # per-resource prefix sums)
+        # earlier winners of the same wave on the same node (sorted-segment
+        # exact prefix sums)
         onehot = (choice[:, None] == jnp.arange(free.shape[0])[None, :]) & (
             choice[:, None] >= 0
         )  # (P, N)

@@ -28,19 +28,17 @@ def round_half_away(x):
     return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
 
 
-_I64_MAX = jnp.int64(2**63 - 1)
-_I64_MIN = jnp.int64(-(2**63))
-
-
 def masked_min(scores, mask, axis=-1, keepdims=False):
-    """Min over `mask`-selected entries; int64 max where mask is empty
+    """Min over `mask`-selected entries; dtype max where mask is empty
     (mirrors `lowest := math.MaxInt64` loop initialisation)."""
-    return jnp.min(jnp.where(mask, scores, _I64_MAX), axis=axis, keepdims=keepdims)
+    sentinel = jnp.iinfo(scores.dtype).max
+    return jnp.min(jnp.where(mask, scores, sentinel), axis=axis, keepdims=keepdims)
 
 
 def masked_max(scores, mask, axis=-1, keepdims=False):
-    """Max over `mask`-selected entries; int64 min where mask is empty."""
-    return jnp.max(jnp.where(mask, scores, _I64_MIN), axis=axis, keepdims=keepdims)
+    """Max over `mask`-selected entries; dtype min where mask is empty."""
+    sentinel = jnp.iinfo(scores.dtype).min
+    return jnp.max(jnp.where(mask, scores, sentinel), axis=axis, keepdims=keepdims)
 
 
 def pad_axis(arr, target: int, axis: int = 0, fill=0):

@@ -1,0 +1,77 @@
+"""Batched/sharded solver tests: waterfill correctness, score-range safety,
+mesh parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.parallel import make_mesh, sharded_batch_solve
+from scheduler_plugins_tpu.parallel.solver import batch_solve
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def solve(snap, weights):
+    return jax.jit(lambda s, w: batch_solve(s, w))(snap, weights)
+
+
+class TestBatchSolve:
+    def test_huge_raw_scores_preserve_ordering(self):
+        # weights {cpu:1, memory:1} make raw scores ~ -(memory bytes), far
+        # outside int32: the order-preserving shift must keep Least-mode
+        # preferring the smallest node instead of collapsing/wrapping scores
+        c = Cluster()
+        sizes = [256, 64, 16]  # GiB
+        for i, g in enumerate(sizes):
+            c.add_node(Node(name=f"n{i}", allocatable={CPU: 64_000, MEMORY: g * gib, PODS: 110}))
+        c.add_pod(Pod(name="p", containers=[Container(requests={CPU: 100, MEMORY: gib})]))
+        snap, meta = c.snapshot(c.pending_pods(), now_ms=0)
+        weights = jnp.asarray(meta.index.encode({CPU: 1, MEMORY: 1}), jnp.int64)
+        assignment, _, _ = solve(snap, weights)
+        assert meta.node_names[int(assignment[0])] == "n2"  # 16 GiB node
+
+    def test_capacity_never_violated_heterogeneous(self):
+        rng = np.random.default_rng(1)
+        c = Cluster()
+        for i in range(16):
+            c.add_node(Node(name=f"n{i}", allocatable={
+                CPU: int(rng.integers(2000, 16_000)),
+                MEMORY: int(rng.integers(4, 64)) * gib,
+                PODS: 20,
+            }))
+        for j in range(200):
+            c.add_pod(Pod(name=f"p{j}", creation_ms=j, containers=[Container(requests={
+                CPU: int(rng.integers(100, 3000)),
+                MEMORY: int(rng.integers(1, 8)) * gib,
+            })]))
+        snap, meta = c.snapshot(sorted(c.pending_pods(), key=lambda p: p.creation_ms))
+        weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+        assignment, _, _ = solve(snap, weights)
+        an = np.asarray(assignment)
+        req = np.asarray(snap.pods.req)
+        alloc = np.asarray(snap.nodes.alloc)
+        used = np.zeros_like(alloc)
+        for i, n in enumerate(an):
+            if n >= 0:
+                used[n] += req[i]
+                used[n, 3] += 1
+        assert (used <= alloc).all()
+
+    def test_sharded_matches_single_device(self):
+        c = Cluster()
+        for i in range(8):
+            c.add_node(Node(name=f"n{i}", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 20}))
+        for j in range(32):
+            c.add_pod(Pod(name=f"p{j}", creation_ms=j,
+                          containers=[Container(requests={CPU: 900, MEMORY: gib})]))
+        snap, meta = c.snapshot(
+            sorted(c.pending_pods(), key=lambda p: p.creation_ms),
+            pad_nodes=8, pad_pods=32,
+        )
+        weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+        a1, _, _ = solve(snap, weights)
+        a8, _, _ = sharded_batch_solve(snap, make_mesh(8), weights)
+        assert a1.tolist() == np.asarray(a8).tolist()
