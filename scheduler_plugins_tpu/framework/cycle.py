@@ -24,6 +24,7 @@ from scheduler_plugins_tpu.framework.preemption import encode_demand
 from scheduler_plugins_tpu.framework.runtime import Scheduler, now_ms as _now_ms
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
 from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import observability as obs
 
 
 @dataclass
@@ -41,6 +42,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     if now is None:
         now = _now_ms()
     report = CycleReport()
+    obs.metrics.inc(obs.SCHEDULING_CYCLES)
     cosched = next(
         (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)), None
     )
@@ -53,9 +55,11 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
         return report
     pending = scheduler.sort_pending(pending, cluster)
 
-    snap, meta = cluster.snapshot(pending, now_ms=now)
-    scheduler.prepare(meta, cluster)
-    result = scheduler.solve(snap)
+    generation = getattr(cluster.nrt_cache, "generation", None)
+    with obs.flow("cycle", generation=generation, pending=len(pending)):
+        snap, meta = cluster.snapshot(pending, now_ms=now)
+        scheduler.prepare(meta, cluster)
+        result = scheduler.solve(snap)
 
     assignment = np.asarray(result.assignment)
     admitted = np.asarray(result.admitted)
@@ -108,6 +112,9 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
 
     _mark_overreserved_on_failures(cluster, report)
     _run_preemption(scheduler, cluster, pending, report, now)
+    obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
+    obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
+    obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
     return report
 
 
@@ -148,6 +155,7 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         pg = cluster.pod_group_of(pod)
         if pg is not None and pg.full_name in rejected:
             continue  # the whole gang was rejected; no point preempting
+        obs.metrics.inc(obs.PREEMPTION_ATTEMPTS)
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
             extra_reserved=nominated_extra,
@@ -155,6 +163,7 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         )
         if result is None:
             continue
+        obs.metrics.inc(obs.PREEMPTION_VICTIMS, len(result.victims))
         pod.nominated_node_name = result.nominated_node
         n = node_pos[result.nominated_node]
         demand = encode_demand(meta.index, pod)

@@ -30,6 +30,7 @@ from typing import Iterable, Optional
 
 from scheduler_plugins_tpu.api.objects import NodeResourceTopology, Pod
 from scheduler_plugins_tpu.api.resources import add_quantities
+from scheduler_plugins_tpu.utils import observability as obs
 
 
 def compute_pod_fingerprint(pods: Iterable[tuple[str, str]]) -> str:
@@ -245,4 +246,5 @@ class OverReserveCache(NrtCache):
             flushed.append(node)
         if flushed:
             self.generation += 1  # overreserve.go:369
+            obs.metrics.inc(obs.CACHE_RESYNC_FLUSHES, len(flushed))
         return flushed

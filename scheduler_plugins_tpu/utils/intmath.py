@@ -28,16 +28,24 @@ def round_half_away(x):
     return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
 
 
+def _dtype_bounds(dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        info = jnp.finfo(dtype)
+    else:
+        info = jnp.iinfo(dtype)
+    return info.min, info.max
+
+
 def masked_min(scores, mask, axis=-1, keepdims=False):
     """Min over `mask`-selected entries; dtype max where mask is empty
     (mirrors `lowest := math.MaxInt64` loop initialisation)."""
-    sentinel = jnp.iinfo(scores.dtype).max
+    _, sentinel = _dtype_bounds(scores.dtype)
     return jnp.min(jnp.where(mask, scores, sentinel), axis=axis, keepdims=keepdims)
 
 
 def masked_max(scores, mask, axis=-1, keepdims=False):
     """Max over `mask`-selected entries; dtype min where mask is empty."""
-    sentinel = jnp.iinfo(scores.dtype).min
+    sentinel, _ = _dtype_bounds(scores.dtype)
     return jnp.max(jnp.where(mask, scores, sentinel), axis=axis, keepdims=keepdims)
 
 

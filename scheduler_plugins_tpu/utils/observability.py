@@ -1,0 +1,73 @@
+"""Observability: leveled flow-correlated logging and scheduling metrics.
+
+Mirrors the reference's observability surface (SURVEY.md §5):
+- contextual leveled logging with FlowBegin/FlowEnd markers, subsystem names
+  and a cache GENERATION attached to every line so a scheduling decision can
+  be cross-correlated with the resync that produced its data
+  (/root/reference/pkg/noderesourcetopology/logging/logging.go:30-56);
+- prometheus-style counters the reference increments (preemption attempts,
+  scheduling cycle stats; cmd/scheduler/main.go:23-24,
+  capacity_scheduling.go:333).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import Counter
+from contextlib import contextmanager
+
+logger = logging.getLogger("scheduler_plugins_tpu")
+
+FLOW_BEGIN = "FlowBegin"
+FLOW_END = "FlowEnd"
+
+
+class Metrics:
+    """Process-wide scheduling counters (the scheduler_perf surface)."""
+
+    def __init__(self):
+        self._counts: Counter[str] = Counter()
+
+    def inc(self, name: str, value: int = 1) -> None:
+        self._counts[name] += value
+
+    def get(self, name: str) -> int:
+        return self._counts[name]
+
+    def snapshot(self) -> dict[str, int]:
+        return dict(self._counts)
+
+    def reset(self) -> None:
+        self._counts.clear()
+
+
+#: global registry, like the upstream prometheus default registry
+metrics = Metrics()
+
+# counter names (prometheus-style)
+SCHEDULING_CYCLES = "scheduler_scheduling_cycles_total"
+PODS_BOUND = "scheduler_pods_bound_total"
+PODS_FAILED = "scheduler_pods_unschedulable_total"
+PREEMPTION_ATTEMPTS = "scheduler_preemption_attempts_total"
+PREEMPTION_VICTIMS = "scheduler_preemption_victims_total"
+GANG_REJECTIONS = "scheduler_gang_rejections_total"
+CACHE_RESYNC_FLUSHES = "scheduler_nrt_cache_flushes_total"
+
+
+@contextmanager
+def flow(subsystem: str, generation: int | None = None, **ctx):
+    """Flow-correlated log span: emits FlowBegin/FlowEnd with the subsystem,
+    optional cache generation and contextual key/values, plus duration."""
+    fields = " ".join(f"{k}={v}" for k, v in ctx.items())
+    gen = f" generation={generation}" if generation is not None else ""
+    logger.debug("%s subsystem=%s%s %s", FLOW_BEGIN, subsystem, gen, fields)
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.debug(
+            "%s subsystem=%s%s %s durationMs=%.2f",
+            FLOW_END, subsystem, gen, fields,
+            (time.perf_counter() - start) * 1000,
+        )

@@ -1,0 +1,140 @@
+"""Differential placement gate (BASELINE.md): the jitted sequential solve
+must produce BIT-IDENTICAL placements to an independent, reference-shaped
+Python implementation of the same semantics (per-pod scan over all nodes:
+resource fit -> weighted allocatable score with Go integer division ->
+min-max normalize -> argmax with lowest-index tie-break -> commit)."""
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def go_div(a, b):
+    q = abs(a) // b
+    return -q if a < 0 else q
+
+
+def reference_loop(nodes, pods, weights, sign=-1):
+    """Independent per-pod x per-node implementation (the Go path's shape)."""
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    wsum = sum(weights.values())
+    raw = {
+        n.name: go_div(
+            sum(sign * n.allocatable.get(r, 0) * w for r, w in weights.items()),
+            wsum,
+        )
+        for n in nodes
+    }
+    placements = []
+    for pod in pods:
+        req = pod.effective_request()
+        feasible = [
+            n.name
+            for n in nodes
+            if free[n.name].get(PODS, 0) >= 1
+            and all(free[n.name].get(r, 0) >= q for r, q in req.items())
+        ]
+        if not feasible:
+            placements.append(None)
+            continue
+        lo = min(raw[f] for f in feasible)
+        hi = max(raw[f] for f in feasible)
+        best, best_score = None, None
+        for name in feasible:
+            score = 0 if hi == lo else (raw[name] - lo) * 100 // (hi - lo)
+            if best_score is None or score > best_score:
+                best, best_score = name, score
+        for r, q in req.items():
+            free[best][r] = free[best].get(r, 0) - q
+        free[best][PODS] -= 1
+        placements.append(best)
+    return placements
+
+
+def random_cluster(rng, n_nodes, n_pods):
+    nodes = [
+        Node(
+            name=f"n{i:03d}",
+            allocatable={
+                CPU: int(rng.integers(2000, 64_000)),
+                MEMORY: int(rng.integers(4, 256)) * gib,
+                PODS: int(rng.integers(4, 60)),
+            },
+        )
+        for i in range(n_nodes)
+    ]
+    pods = [
+        Pod(
+            name=f"p{j:04d}",
+            creation_ms=j,
+            containers=[
+                Container(
+                    requests={
+                        CPU: int(rng.integers(50, 8000)),
+                        MEMORY: int(rng.integers(1, 16)) * gib,
+                    }
+                )
+            ],
+        )
+        for j in range(n_pods)
+    ]
+    return nodes, pods
+
+
+class TestDifferential:
+    def test_bit_identical_placements_random_scenarios(self):
+        weights = {CPU: 1 << 20, MEMORY: 1}
+        for seed in range(5):
+            rng = np.random.default_rng(seed)
+            n_nodes = int(rng.integers(3, 40))
+            n_pods = int(rng.integers(10, 120))
+            nodes, pods = random_cluster(rng, n_nodes, n_pods)
+
+            expected = reference_loop(nodes, pods, weights)
+
+            cluster = Cluster()
+            for n in nodes:
+                cluster.add_node(n)
+            for p in pods:
+                cluster.add_pod(p)
+            sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+            pending = sched.sort_pending(cluster.pending_pods(), cluster)
+            snap, meta = cluster.snapshot(pending, now_ms=0)
+            sched.prepare(meta, cluster)
+            result = sched.solve(snap)
+            got = [
+                meta.node_names[int(a)] if int(a) >= 0 else None
+                for a in np.asarray(result.assignment)[: len(pods)]
+            ]
+            assert got == expected, f"seed {seed}: divergence"
+
+    def test_most_mode_differential(self):
+        weights = {CPU: 1 << 20, MEMORY: 1}
+        rng = np.random.default_rng(42)
+        nodes, pods = random_cluster(rng, 12, 60)
+        expected = reference_loop(nodes, pods, weights, sign=+1)
+        cluster = Cluster()
+        for n in nodes:
+            cluster.add_node(n)
+        for p in pods:
+            cluster.add_pod(p)
+        sched = Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(mode="Most")])
+        )
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        result = sched.solve(snap)
+        got = [
+            meta.node_names[int(a)] if int(a) >= 0 else None
+            for a in np.asarray(result.assignment)[: len(pods)]
+        ]
+        assert got == expected

@@ -1,0 +1,35 @@
+"""Observability tests: metrics counters and flow-correlated logging."""
+
+import logging
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import observability as obs
+
+gib = 1 << 30
+
+
+class TestMetrics:
+    def test_cycle_counters(self):
+        obs.metrics.reset()
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={CPU: 1000, MEMORY: 4 * gib, PODS: 10}))
+        c.add_pod(Pod(name="ok", creation_ms=1, containers=[Container(requests={CPU: 100})]))
+        c.add_pod(Pod(name="huge", creation_ms=2, containers=[Container(requests={CPU: 99_000})]))
+        run_cycle(Scheduler(Profile(plugins=[NodeResourcesAllocatable()])), c, now=1000)
+        snap = obs.metrics.snapshot()
+        assert snap[obs.SCHEDULING_CYCLES] == 1
+        assert snap[obs.PODS_BOUND] == 1
+        assert snap[obs.PODS_FAILED] == 1
+
+    def test_flow_markers_emitted(self, caplog):
+        obs.metrics.reset()
+        with caplog.at_level(logging.DEBUG, logger="scheduler_plugins_tpu"):
+            with obs.flow("cycle", generation=7, pending=3):
+                pass
+        text = caplog.text
+        assert "FlowBegin" in text and "FlowEnd" in text
+        assert "generation=7" in text and "durationMs" in text
