@@ -1,19 +1,19 @@
-"""Benchmark: pods scheduled per second on the flagship batched solver.
+"""Benchmark: pods scheduled per second.
 
-Runs the BASELINE config-1 shape (allocatable-scored placement) scaled up
-(default 1024 nodes x 8192 pods), on the real accelerator when present:
+Default (`python bench.py`): the BASELINE config-1 flagship — allocatable-
+scored placement, 1024 nodes x 8192 pods — on the wave-parallel batched
+solver (the throughput mode). `--config 2..5` run the other BASELINE.md
+scenarios on the bit-faithful sequential solve with the matching plugin
+profiles (trimaran, NUMA, gang+quota, network-aware).
 
-- `tpu` path: the wave-parallel batched solve (admission -> fit -> score ->
-  conflict resolution), the throughput mode of the framework.
-- `baseline`: a pure-Python per-pod x per-node loop implementing the same
-  filter/score/assign semantics — the algorithmic shape of the reference's
-  Go hot loop (upstream scheduler framework fan-out; the reference publishes
-  no numbers of its own, BASELINE.md). Measured on a subsample and
-  extrapolated per-pod.
+`baseline` is a pure-Python per-pod x per-node loop implementing the
+reference's algorithmic shape (the Go hot loop; the reference publishes no
+numbers of its own, BASELINE.md), measured on a subsample and extrapolated.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import argparse
 import json
 import time
 
@@ -67,6 +67,19 @@ def python_baseline_pods_per_sec(cluster, sample=200):
     return len(pods) / elapsed
 
 
+def _emit(metric, pods_per_sec, detail, baseline):
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(pods_per_sec, 1),
+                "unit": f"pods/s ({detail})",
+                "vs_baseline": round(pods_per_sec / baseline, 2),
+            }
+        )
+    )
+
+
 def main(n_nodes=1024, n_pods=8192):
     import jax
     import jax.numpy as jnp
@@ -107,18 +120,71 @@ def main(n_nodes=1024, n_pods=8192):
     pods_per_sec = n_pods / elapsed
 
     baseline = python_baseline_pods_per_sec(cluster)
-
-    print(
-        json.dumps(
-            {
-                "metric": "pods_scheduled_per_sec",
-                "value": round(pods_per_sec, 1),
-                "unit": f"pods/s ({n_nodes} nodes x {n_pods} pods, {placed} placed)",
-                "vs_baseline": round(pods_per_sec / baseline, 2),
-            }
-        )
+    _emit(
+        "pods_scheduled_per_sec",
+        pods_per_sec,
+        f"{n_nodes} nodes x {n_pods} pods, {placed} placed",
+        baseline,
     )
 
 
+def sequential_config(config: int):
+    """BASELINE configs 2-5 on the bit-faithful sequential solve."""
+    import jax  # noqa: F401
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.models import (
+        gang_quota_scenario,
+        network_scenario,
+        numa_scenario,
+        trimaran_scenario,
+    )
+    from scheduler_plugins_tpu import plugins as P
+
+    if config == 2:
+        cluster = trimaran_scenario(n_nodes=5000, n_pods=2048)
+        plugins = [P.TargetLoadPacking(), P.LoadVariationRiskBalancing()]
+        metric, detail = "trimaran_pods_per_sec", "5000 nodes, TLP+LVRB, sequential"
+    elif config == 3:
+        cluster = numa_scenario(n_nodes=1024, n_pods=512, zones=8)
+        plugins = [P.NodeResourceTopologyMatch()]
+        metric, detail = "numa_pods_per_sec", "1024 nodes x 8 zones, sequential"
+    elif config == 4:
+        cluster = gang_quota_scenario(n_gangs=32, gang_size=64, n_nodes=1024)
+        plugins = [P.NodeResourcesAllocatable(), P.Coscheduling(), P.CapacityScheduling()]
+        metric, detail = "gang_quota_pods_per_sec", "32 gangs x 64, 1024 nodes, sequential"
+    elif config == 5:
+        cluster = network_scenario(n_nodes=1024, n_pods=1024)
+        plugins = [P.NetworkOverhead(), P.TopologicalSort()]
+        metric, detail = "network_pods_per_sec", "1024 nodes multi-region, sequential"
+    else:
+        raise SystemExit(f"unknown config {config}")
+
+    scheduler = Scheduler(Profile(plugins=plugins))
+    pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+    n_pods = len(pending)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    scheduler.prepare(meta, cluster)
+    np.asarray(scheduler.solve(snap).assignment)  # compile
+    times = []
+    assignment = None
+    for _ in range(3):
+        start = time.perf_counter()
+        result = scheduler.solve(snap)
+        assignment = np.asarray(result.assignment)  # forces completion
+        times.append(time.perf_counter() - start)
+    elapsed = sorted(times)[len(times) // 2]
+    placed = int((assignment >= 0).sum())
+    baseline = python_baseline_pods_per_sec(cluster, sample=100)
+    _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed", baseline)
+
+
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, default=1,
+                        help="BASELINE.md scenario (1-5); default flagship")
+    args = parser.parse_args()
+    if args.config == 1:
+        main()
+    else:
+        sequential_config(args.config)

@@ -48,7 +48,7 @@ class TargetLoadPacking(Plugin):
         if snap.metrics is None:
             return None
         return tlp_score(
-            snap.metrics.cpu_avg,
+            snap.metrics.cpu_tlp,
             snap.metrics.cpu_valid,
             snap.metrics.missing_cpu_millis,
             snap.nodes.capacity[:, CPU_I],

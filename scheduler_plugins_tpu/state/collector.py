@@ -1,0 +1,98 @@
+"""Load-watcher metrics collector.
+
+Mirror of the Trimaran Collector (/root/reference/pkg/trimaran/collector.go:
+42-150): polls a load-watcher-compatible HTTP endpoint (`GET /watcher`) for
+`WatcherMetrics` JSON —
+
+    {"Window": {"Duration": "15m", "Start": ..., "End": ...},
+     "Data": {"NodeMetricsMap": {
+        "<node>": {"Metrics": [
+            {"Type": "CPU"|"Memory", "Operator": "Latest"|"Average"|"Std",
+             "Value": <float>, "Unit": ...}, ...]}}}}
+
+— and folds it into the cluster store's `node_metrics` mapping (percent of
+capacity, the exact GetResourceData selection rules: Average preferred,
+Latest/empty operator as fallback, Std separate;
+/root/reference/pkg/trimaran/resourcestats.go:88-106). The reference refreshes
+every 30 seconds in a goroutine; here `refresh()` is explicit and the caller
+owns the cadence (a thread or the cycle loop).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+#: metric type / operator strings (load-watcher watcher package)
+CPU = "CPU"
+MEMORY = "Memory"
+LATEST = "Latest"
+AVERAGE = "Average"
+STD = "Std"
+
+DEFAULT_REFRESH_SECONDS = 30  # collector.go:33
+
+
+def parse_watcher_metrics(payload: dict) -> dict[str, dict]:
+    """WatcherMetrics JSON -> per-node metric dict for `Cluster.node_metrics`."""
+    out: dict[str, dict] = {}
+    node_map = (payload.get("Data") or {}).get("NodeMetricsMap") or {}
+    for node, node_metrics in node_map.items():
+        entry: dict = {}
+        cpu_avg_found = mem_avg_found = False
+        for metric in node_metrics.get("Metrics", []):
+            mtype = metric.get("Type")
+            op = metric.get("Operator", "")
+            value = float(metric.get("Value", 0.0))
+            if mtype == CPU:
+                if op == AVERAGE:
+                    entry["cpu_avg"] = value
+                    cpu_avg_found = True
+                elif op == STD:
+                    entry["cpu_std"] = value
+                elif op in ("", LATEST) and not cpu_avg_found:
+                    entry["cpu_avg"] = value
+                if op in (AVERAGE, LATEST):
+                    # TargetLoadPacking's own selection lets a later
+                    # Latest override Average (targetloadpacking.go:130-139)
+                    entry["cpu_tlp"] = value
+            elif mtype == MEMORY:
+                if op == AVERAGE:
+                    entry["mem_avg"] = value
+                    mem_avg_found = True
+                elif op == STD:
+                    entry["mem_std"] = value
+                elif op in ("", LATEST) and not mem_avg_found:
+                    entry["mem_avg"] = value
+        if entry:
+            out[node] = entry
+    return out
+
+
+class LoadWatcherCollector:
+    """HTTP client against a load-watcher service (`WatcherAddress` arg,
+    apis/config TrimaranSpec)."""
+
+    def __init__(self, watcher_address: str, timeout_s: float = 5.0):
+        self.watcher_address = watcher_address.rstrip("/")
+        self.timeout_s = timeout_s
+        self.last_payload: Optional[dict] = None
+
+    def fetch(self) -> dict[str, dict]:
+        with urllib.request.urlopen(
+            f"{self.watcher_address}/watcher", timeout=self.timeout_s
+        ) as resp:
+            self.last_payload = json.loads(resp.read())
+        return parse_watcher_metrics(self.last_payload)
+
+    def refresh(self, cluster) -> dict[str, dict]:
+        """One collector tick: fetch and install into the cluster store.
+        On failure the previous metrics stay (the reference keeps serving the
+        cached WatcherMetrics when a fetch errors)."""
+        try:
+            metrics = self.fetch()
+        except Exception:
+            return cluster.node_metrics or {}
+        cluster.node_metrics = metrics
+        return metrics

@@ -127,6 +127,10 @@ class MetricsState:
     (/root/reference/pkg/trimaran/collector.go, resourcestats.go:33-107)."""
 
     cpu_avg: np.ndarray  # (N,) float64 %
+    #: (N,) the CPU value TargetLoadPacking reads — its selection loop lets a
+    #: later Latest override Average (targetloadpacking.go:130-139); defaults
+    #: to cpu_avg
+    cpu_tlp: np.ndarray
     cpu_std: np.ndarray  # (N,) float64 %
     mem_avg: np.ndarray  # (N,) float64 %
     mem_std: np.ndarray  # (N,) float64 %
@@ -537,6 +541,7 @@ def build_snapshot(
     metrics_state = None
     if node_metrics is not None:
         cpu_avg = np.zeros(N, F64)
+        cpu_tlp = np.zeros(N, F64)
         cpu_std = np.zeros(N, F64)
         mem_avg = np.zeros(N, F64)
         mem_std = np.zeros(N, F64)
@@ -549,15 +554,20 @@ def build_snapshot(
             i = node_pos[name]
             if "cpu_avg" in m:
                 cpu_avg[i] = m["cpu_avg"]
-                cpu_valid[i] = True
+            cpu_tlp[i] = m.get("cpu_tlp", m.get("cpu_avg", 0.0))
             cpu_std[i] = m.get("cpu_std", 0.0)
+            # a node with ANY cpu sample (avg/latest or std-only) is valid:
+            # GetResourceData returns isValid=true, avg=0 for std-only
+            # (resourcestats.go:88-106)
+            cpu_valid[i] = "cpu_avg" in m or "cpu_std" in m
             if "mem_avg" in m:
                 mem_avg[i] = m["mem_avg"]
-                mem_valid[i] = True
+            mem_valid[i] = "mem_avg" in m or "mem_std" in m
             mem_std[i] = m.get("mem_std", 0.0)
             missing[i] = m.get("missing_cpu_millis", 0)
         metrics_state = MetricsState(
             cpu_avg=cpu_avg,
+            cpu_tlp=cpu_tlp,
             cpu_std=cpu_std,
             mem_avg=mem_avg,
             mem_std=mem_std,

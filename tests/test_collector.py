@@ -1,0 +1,109 @@
+"""Collector tests — the metrics provider faked at the HTTP boundary, exactly
+like the reference's httptest-based trimaran tests (collector_test.go:86)."""
+
+import http.server
+import json
+import threading
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU as CPU_RES, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import TargetLoadPacking
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.state.collector import (
+    LoadWatcherCollector,
+    parse_watcher_metrics,
+)
+
+gib = 1 << 30
+
+WATCHER_JSON = {
+    "Window": {"Duration": "15m", "Start": 0, "End": 900},
+    "Data": {
+        "NodeMetricsMap": {
+            "hot": {
+                "Metrics": [
+                    {"Type": "CPU", "Operator": "Average", "Value": 70.0},
+                    {"Type": "CPU", "Operator": "Std", "Value": 8.0},
+                    {"Type": "Memory", "Operator": "Average", "Value": 55.0},
+                ]
+            },
+            "cold": {
+                "Metrics": [
+                    # Latest-only (backward-compat path: no Average present)
+                    {"Type": "CPU", "Operator": "Latest", "Value": 10.0},
+                    {"Type": "Memory", "Operator": "", "Value": 12.0},
+                ]
+            },
+        }
+    },
+}
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps(WATCHER_JSON).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence
+        pass
+
+
+def serve():
+    server = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, f"http://127.0.0.1:{server.server_port}"
+
+
+class TestParse:
+    def test_operator_selection_rules(self):
+        metrics = parse_watcher_metrics(WATCHER_JSON)
+        assert metrics["hot"] == {
+            "cpu_avg": 70.0, "cpu_tlp": 70.0, "cpu_std": 8.0, "mem_avg": 55.0,
+        }
+        assert metrics["cold"] == {"cpu_avg": 10.0, "cpu_tlp": 10.0, "mem_avg": 12.0}
+
+    def test_average_wins_over_latest_except_tlp(self):
+        # GetResourceData prefers Average (LVRB/LROC path), while TLP's own
+        # loop takes the LAST Average-or-Latest (targetloadpacking.go:130-139)
+        payload = {"Data": {"NodeMetricsMap": {"n": {"Metrics": [
+            {"Type": "CPU", "Operator": "Average", "Value": 40.0},
+            {"Type": "CPU", "Operator": "Latest", "Value": 99.0},
+        ]}}}}
+        parsed = parse_watcher_metrics(payload)["n"]
+        assert parsed["cpu_avg"] == 40.0
+        assert parsed["cpu_tlp"] == 99.0
+
+
+class TestHTTPCollector:
+    def test_fetch_and_schedule_through_http_boundary(self):
+        server, addr = serve()
+        try:
+            cluster = Cluster()
+            for name in ("hot", "cold"):
+                cluster.add_node(
+                    Node(name=name, allocatable={CPU_RES: 10_000, MEMORY: 32 * gib, PODS: 110})
+                )
+            cluster.add_pod(
+                Pod(name="p", containers=[Container(requests={CPU_RES: 1000})])
+            )
+            collector = LoadWatcherCollector(addr)
+            metrics = collector.refresh(cluster)
+            assert metrics["hot"]["cpu_avg"] == 70.0
+            report = run_cycle(
+                Scheduler(Profile(plugins=[TargetLoadPacking()])), cluster, now=1000
+            )
+            assert report.bound["default/p"] == "cold"
+        finally:
+            server.shutdown()
+
+    def test_fetch_failure_keeps_cached_metrics(self):
+        cluster = Cluster()
+        cluster.node_metrics = {"n": {"cpu_avg": 5.0}}
+        collector = LoadWatcherCollector("http://127.0.0.1:1")  # closed port
+        assert collector.refresh(cluster) == {"n": {"cpu_avg": 5.0}}
+        assert cluster.node_metrics == {"n": {"cpu_avg": 5.0}}
