@@ -375,6 +375,26 @@ class SeccompProfile:
 
 
 @dataclass
+class PodDisruptionBudget:
+    """Minimal PDB surface for preemption's violating-victim partitioning
+    (/root/reference/pkg/capacityscheduling/capacity_scheduling.go:889-934):
+    label selector + the API-server-computed DisruptionsAllowed budget."""
+
+    name: str
+    namespace: str = "default"
+    #: match-labels selector; empty matches NOTHING (upstream semantics)
+    selector: Mapping[str, str] = field(default_factory=dict)
+    disruptions_allowed: int = 0
+    #: pod names already being disrupted (not re-counted)
+    disrupted_pods: frozenset[str] = frozenset()
+
+    def matches(self, pod: "Pod") -> bool:
+        if not self.selector or pod.namespace != self.namespace or not pod.labels:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.selector.items())
+
+
+@dataclass
 class PriorityClass:
     """PriorityClass with the preemption-toleration annotations
     (/root/reference/pkg/preemptiontoleration/policy.go)."""

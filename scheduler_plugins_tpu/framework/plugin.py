@@ -51,6 +51,10 @@ class SolverState:
     #: (N, Z, R) live NUMA zone availability with in-cycle placements
     #: pessimistically deducted from every zone of the chosen node
     numa_avail: Optional[jnp.ndarray] = None
+    #: (P,) which batch pods have placed so far in this scan — nominee
+    #: aggregates drop a nominee the moment it places (upstream removes
+    #: assumed pods from the nominated set)
+    placed_mask: Optional[jnp.ndarray] = None
 
 
 class Plugin:

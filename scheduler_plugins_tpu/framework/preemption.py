@@ -21,8 +21,8 @@ TPU mapping per SURVEY.md §7 step 7: the "remove all eligible victims,
 re-filter" dry run is vectorized across all nodes at once (eligibility masks
 + per-node segment sums); the small per-node reprieve refinement stays
 host-side and exact. Candidate ranking follows the upstream pickOneNode
-criteria (min highest victim priority -> min priority sum -> fewest victims
--> lowest index).
+criteria (fewest PDB violations -> min highest victim priority -> min
+priority sum -> fewest victims -> lowest index).
 
 The node re-filter in the dry run is the resource fit (+ quota gates); other
 enabled Filter plugins are not re-run against the hypothetical state in this
@@ -207,18 +207,21 @@ class PreemptionEngine:
 
         # run the exact reprieve per candidate (bounded, like the upstream
         # candidate sampling) and rank by the FINAL minimized victim sets —
-        # pickOneNode criteria: min highest victim priority -> min priority
-        # sum -> fewest victims -> lowest index (upstream pickOneNode)
+        # pickOneNode criteria: fewest PDB violations -> min highest victim
+        # priority -> min priority sum -> fewest victims -> lowest index
         candidates = np.nonzero(fits)[0][: self.MAX_CANDIDATES]
+        pdbs = list(getattr(cluster, "pdbs", {}).values())
         best = None
         for n in candidates:
-            final = self._reprieve(
+            final, violations = self._reprieve(
                 victims_all, v_node, v_req, v_pri, eligible, int(n),
-                free[int(n)], demand, preemptor, snap, meta, extra_quota_used,
+                free[int(n)], demand, preemptor, snap, meta, pdbs,
+                extra_quota_used,
             )
             if not final:
                 continue
             stats = (
+                violations,
                 max(v.priority for v in final),
                 sum(v.priority for v in final),
                 len(final),
@@ -279,14 +282,43 @@ class PreemptionEngine:
         )
         return own_ok & agg_ok
 
+    @staticmethod
+    def partition_pdb_violations(candidates, pdbs):
+        """filterPodsWithPDBViolation (capacity_scheduling.go:889-934):
+        decrement each matching PDB's DisruptionsAllowed per candidate (pods
+        already in DisruptedPods don't count); a candidate whose budget went
+        negative is 'violating'. Returns (violating, non_violating) index
+        lists, order preserved."""
+        allowed = [pdb.disruptions_allowed for pdb in pdbs]
+        violating, non_violating = [], []
+        for i, pod in candidates:
+            violated = False
+            for j, pdb in enumerate(pdbs):
+                if not pdb.matches(pod) or pod.name in pdb.disrupted_pods:
+                    continue
+                allowed[j] -= 1
+                if allowed[j] < 0:
+                    violated = True
+            (violating if violated else non_violating).append(i)
+        return violating, non_violating
+
     def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
-                  demand, preemptor, snap, meta, extra_quota_used=None):
+                  demand, preemptor, snap, meta, pdbs=(),
+                  extra_quota_used=None):
         """Add back victims most-important-first while the preemptor still
-        fits and quota gates hold (capacity_scheduling.go:632-670)."""
+        fits and quota gates hold (capacity_scheduling.go:632-670); PDB-
+        violating candidates are reprieved FIRST so they get the best chance
+        of surviving, and surviving violations are counted for pickOneNode.
+        Returns (final_victims, num_violating)."""
         idxs = [i for i in np.nonzero(eligible)[0] if v_node[i] == node]
         # MoreImportantPod: higher priority, then earlier start
         idxs.sort(key=lambda i: (-v_pri[i], victims[i].creation_ms))
-        free_after = free_n + v_req[idxs].sum(axis=0)
+        violating, non_violating = self.partition_pdb_violations(
+            [(i, victims[i]) for i in idxs], list(pdbs)
+        )
+        violating_set = set(violating)
+        idxs = violating + non_violating
+        free_after = free_n + v_req[idxs].sum(axis=0) if idxs else free_n
 
         quota = snap.quota
         use_quota = self.mode == PreemptionMode.CAPACITY and quota is not None
@@ -306,6 +338,7 @@ class PreemptionEngine:
                     used[ns] -= meta.index.encode(victims[i].effective_request())
 
         final = []
+        num_violating = 0
         for i in idxs:
             candidate_free = free_after - v_req[i]
             fits = bool(np.all(candidate_free >= demand))
@@ -331,4 +364,9 @@ class PreemptionEngine:
                         )
             else:
                 final.append(victims[i])
-        return final
+                if i in violating_set:
+                    num_violating += 1
+        # keep victims sorted most-important-first (the reference re-sorts
+        # after mixing the two partitions)
+        final.sort(key=lambda v: (-v.priority, v.creation_ms))
+        return final, num_violating

@@ -10,9 +10,9 @@ capacity_scheduling.go:208-282, comparators elasticquota.go:96-221):
    exceeds the sum of Min in any resource (the cluster's guaranteed pool is
    exhausted; absent Min entries are 0).
 
-The nominated-pod aggregates the reference folds in (lines 228-263) are the
-preemption-nomination feedback loop; they are added by the preemption engine
-once nominations exist in the snapshot.
+The nominated-pod aggregates (lines 228-263) — the preemption-nomination
+feedback loop — enter through the optional `nominated_in_eq` /
+`nominated_total` vectors the snapshot builder precomputes per pending pod.
 """
 
 from __future__ import annotations
@@ -20,18 +20,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def quota_admit(eq_used, eq_min, eq_max, has_quota, ns, req):
+def quota_admit(eq_used, eq_min, eq_max, has_quota, ns, req,
+                nominated_in_eq=None, nominated_total=None):
     """Scalar admission verdict for one pod.
 
     eq_used/eq_min/eq_max: (Q, R); has_quota: (Q,); ns: scalar namespace code;
-    req: (R,) pod effective request. Pods in namespaces without an EQ pass
-    (capacity_scheduling.go:218-224).
+    req: (R,) pod effective request; nominated_in_eq/nominated_total: optional
+    (R,) nominated-pod aggregates for this pod. Pods in namespaces without an
+    EQ pass (capacity_scheduling.go:218-224).
     """
+    in_eq = req if nominated_in_eq is None else req + nominated_in_eq
+    total = req if nominated_total is None else req + nominated_total
     used_ns = eq_used[ns]
-    over_max = jnp.any(used_ns + req > eq_max[ns])
+    over_max = jnp.any(used_ns + in_eq > eq_max[ns])
     agg_used = jnp.sum(jnp.where(has_quota[:, None], eq_used, 0), axis=0)
     agg_min = jnp.sum(jnp.where(has_quota[:, None], eq_min, 0), axis=0)
-    over_min = jnp.any(agg_used + req > agg_min)
+    over_min = jnp.any(agg_used + total > agg_min)
     return jnp.where(has_quota[ns], ~(over_max | over_min), True)
 
 

@@ -46,16 +46,28 @@ def batch_admission(snap, free, eq_used=None):
         ok &= gang_ok
     if snap.quota is not None:
         used = eq_used if eq_used is not None else snap.quota.used
+        # (P, R) nominee aggregates from the (M, P) tables — admission runs
+        # before any placement here, so the static view is exact
+        nom_in_eq = jnp.sum(
+            snap.quota.nom_in_eq_mask[:, :, None] * snap.quota.nom_req[:, None, :],
+            axis=0,
+        )
+        nom_total = jnp.sum(
+            snap.quota.nom_total_mask[:, :, None] * snap.quota.nom_req[:, None, :],
+            axis=0,
+        )
         quota_ok = jax.vmap(
-            lambda ns, req: quota_admit(
+            lambda ns, req, in_eq, total: quota_admit(
                 used,
                 snap.quota.min,
                 snap.quota.max,
                 snap.quota.has_quota,
                 ns,
                 req,
+                in_eq,
+                total,
             )
-        )(snap.pods.ns, snap.pods.req)
+        )(snap.pods.ns, snap.pods.req, nom_in_eq, nom_total)
         ok &= quota_ok
     return ok
 

@@ -34,13 +34,39 @@ class CapacityScheduling(Plugin):
     def admit(self, state, snap, p):
         if snap.quota is None or state.eq_used is None:
             return None
+        import jax.numpy as jnp
+
+        quota = snap.quota
+        # live nominee aggregates: a nominee that already placed in this
+        # scan is usage (eq_used carry), not a nomination anymore
+        placed = (
+            state.placed_mask[jnp.maximum(quota.nom_batch_idx, 0)]
+            & (quota.nom_batch_idx >= 0)
+            if state.placed_mask is not None
+            else jnp.zeros(quota.nom_req.shape[0], bool)
+        )  # (M,)
+        live = ~placed
+        in_eq = jnp.sum(
+            jnp.where(
+                (quota.nom_in_eq_mask[:, p] & live)[:, None], quota.nom_req, 0
+            ),
+            axis=0,
+        )
+        total = jnp.sum(
+            jnp.where(
+                (quota.nom_total_mask[:, p] & live)[:, None], quota.nom_req, 0
+            ),
+            axis=0,
+        )
         return quota_admit(
             state.eq_used,
-            snap.quota.min,
-            snap.quota.max,
-            snap.quota.has_quota,
+            quota.min,
+            quota.max,
+            quota.has_quota,
             snap.pods.ns[p],
             snap.pods.req[p],
+            in_eq,
+            total,
         )
 
     def commit(self, state, snap, p, choice):

@@ -19,6 +19,7 @@ from scheduler_plugins_tpu.api.objects import (
     Node,
     NodeResourceTopology,
     Pod,
+    PodDisruptionBudget,
     PodGroup,
     PodPhase,
     PriorityClass,
@@ -38,6 +39,7 @@ class Cluster:
     network_topologies: dict[str, NetworkTopology] = field(default_factory=dict)
     seccomp_profiles: dict[str, SeccompProfile] = field(default_factory=dict)
     priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
+    pdbs: dict[str, PodDisruptionBudget] = field(default_factory=dict)
     node_metrics: Optional[dict] = None
     #: optional NRT cache policy (state.nrt_cache); when set, snapshots read
     #: the cache's adjusted zone view instead of the raw NRT objects
@@ -99,6 +101,9 @@ class Cluster:
 
     def add_priority_class(self, pc: PriorityClass):
         self.priority_classes[pc.name] = pc
+
+    def add_pdb(self, pdb: PodDisruptionBudget):
+        self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
 
     # -- derived ---------------------------------------------------------
     def pod_group_of(self, pod: Pod) -> Optional[PodGroup]:

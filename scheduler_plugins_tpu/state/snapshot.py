@@ -119,6 +119,16 @@ class QuotaState:
     max: np.ndarray  # (Q, R) int64
     used: np.ndarray  # (Q, R) int64
     has_quota: np.ndarray  # (Q,) bool namespace has an EQ
+    #: nominated-pod tables (capacity_scheduling.go:226-263). M nominees:
+    #: their requests, per-(nominee, pending-pod) contribution masks for the
+    #: own-Max ("in EQ": same namespace, priority >= pod) and aggregate-Min
+    #: checks, and each nominee's index in the pending batch (-1 if outside)
+    #: so in-scan placements drop them from the aggregates (upstream removes
+    #: a pod from the nominated set the moment it is assumed).
+    nom_req: np.ndarray  # (M, R) int64
+    nom_in_eq_mask: np.ndarray  # (M, P) bool
+    nom_total_mask: np.ndarray  # (M, P) bool
+    nom_batch_idx: np.ndarray  # (M,) int32
 
 
 @struct.dataclass
@@ -535,7 +545,39 @@ def build_snapshot(
             nsi = ns_in.get(pod.namespace)
             if qhas[nsi]:
                 qused[nsi] += index.encode(pod.effective_request())
-        quota_state = QuotaState(min=qmin, max=qmax, used=qused, has_quota=qhas)
+        # nominated-pod tables
+        nominated = [
+            p
+            for p in list(pending_pods) + list(extra_pods)
+            if p.nominated_node_name is not None and p.node_name is None
+        ]
+        batch_pos = {p.uid: i for i, p in enumerate(pending_pods)}
+        M = max(len(nominated), 1)
+        nom_req = np.zeros((M, R), I64)
+        nom_in_eq_mask = np.zeros((M, P), bool)
+        nom_total_mask = np.zeros((M, P), bool)
+        nom_batch_idx = np.full(M, -1, I32)
+        if nominated:
+            over_min = np.any(qused > qmin, axis=1)  # (Q,) usedOverMin
+            for j, m in enumerate(nominated):
+                m_ns = ns_in.get(m.namespace)
+                if m_ns < 0 or not qhas[m_ns]:
+                    continue
+                nom_req[j] = index.encode(m.effective_request())
+                nom_batch_idx[j] = batch_pos.get(m.uid, -1)
+                for i, pod in enumerate(pending_pods):
+                    if m.uid == pod.uid:
+                        continue
+                    if m.namespace == pod.namespace and m.priority >= pod.priority:
+                        nom_in_eq_mask[j, i] = True
+                        nom_total_mask[j, i] = True
+                    elif m.namespace != pod.namespace and not over_min[m_ns]:
+                        nom_total_mask[j, i] = True
+        quota_state = QuotaState(
+            min=qmin, max=qmax, used=qused, has_quota=qhas,
+            nom_req=nom_req, nom_in_eq_mask=nom_in_eq_mask,
+            nom_total_mask=nom_total_mask, nom_batch_idx=nom_batch_idx,
+        )
 
     # --- metrics --------------------------------------------------------
     metrics_state = None

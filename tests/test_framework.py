@@ -263,6 +263,40 @@ class TestCapacityScheduling:
         assert "a/a1" in report.bound
         assert "a/a2" in report.failed
 
+    def test_nominated_pods_count_toward_quota(self):
+        # an UNBOUND nominated pod's request counts toward its namespace's
+        # Max for same-ns, lower-priority claimants
+        # (capacity_scheduling.go:226-263). The nominee can't fit any node
+        # (victims still terminating, modeled as an oversized memory ask), so
+        # only the nominated aggregate can reject "late".
+        cluster = self.quota_cluster()
+        nominee = mkpod(
+            "vip", cpu=1500, mem=999 * (1 << 30), ns="a",
+            priority=10, creation_ms=1,
+        )
+        nominee.nominated_node_name = "n0"
+        cluster.add_pod(nominee)
+        cluster.add_pod(mkpod("late", cpu=800, ns="a", priority=1, creation_ms=2))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        # max cpu 2000: nominee 1500 (nominated, unplaced) + late 800 > 2000
+        assert "a/late" in report.failed
+
+    def test_bound_nominee_not_double_counted(self):
+        # the nominee binds early in the SAME scan: its usage enters the
+        # eq_used carry and must simultaneously LEAVE the nominated
+        # aggregate, or "late" is charged twice (upstream removes assumed
+        # pods from the nominated set)
+        cluster = self.quota_cluster()
+        nominee = mkpod("vip", cpu=900, ns="a", priority=10, creation_ms=1)
+        nominee.nominated_node_name = "n0"
+        cluster.add_pod(nominee)
+        cluster.add_pod(mkpod("late", cpu=800, ns="a", priority=1, creation_ms=2))
+        report = run_cycle(self.scheduler(), cluster, now=1000)
+        # 900 (bound) + 800 = 1700 <= max 2000: both must schedule; double
+        # counting would compute 900 + 900 + 800 = 2600 > 2000 and fail late
+        assert "a/vip" in report.bound
+        assert "a/late" in report.bound
+
     def test_no_quota_namespace_passes(self):
         cluster = self.quota_cluster()
         cluster.add_pod(mkpod("free", cpu=50_000, ns="unquotaed"))

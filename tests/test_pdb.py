@@ -1,0 +1,90 @@
+"""PDB-aware preemption tests (filterPodsWithPDBViolation semantics)."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.framework.preemption import (
+    PreemptionEngine,
+    PreemptionMode,
+)
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def mkpod(name, cpu, priority=0, node=None, labels=None, created=0):
+    p = Pod(
+        name=name,
+        priority=priority,
+        creation_ms=created,
+        labels=labels or {},
+        containers=[Container(requests={CPU: cpu, MEMORY: gib})],
+    )
+    p.node_name = node
+    return p
+
+
+def sched():
+    return Scheduler(
+        Profile(
+            plugins=[NodeResourcesAllocatable()],
+            preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+        )
+    )
+
+
+class TestPDBPartition:
+    def test_partition_budget_decrement(self):
+        pdb = PodDisruptionBudget(
+            name="pdb", selector={"app": "web"}, disruptions_allowed=1
+        )
+        pods = [
+            (0, mkpod("w1", 100, labels={"app": "web"})),
+            (1, mkpod("w2", 100, labels={"app": "web"})),
+            (2, mkpod("other", 100, labels={"app": "db"})),
+        ]
+        violating, ok = PreemptionEngine.partition_pdb_violations(pods, [pdb])
+        # first web pod consumes the budget; second violates; db unmatched
+        assert violating == [1]
+        assert ok == [0, 2]
+
+    def test_disrupted_pods_not_recounted(self):
+        pdb = PodDisruptionBudget(
+            name="pdb", selector={"app": "web"}, disruptions_allowed=0,
+            disrupted_pods=frozenset({"w1"}),
+        )
+        pods = [(0, mkpod("w1", 100, labels={"app": "web"}))]
+        violating, ok = PreemptionEngine.partition_pdb_violations(pods, [pdb])
+        assert violating == [] and ok == [0]
+
+    def test_empty_selector_matches_nothing(self):
+        pdb = PodDisruptionBudget(name="pdb", disruptions_allowed=0)
+        pods = [(0, mkpod("w1", 100, labels={"app": "web"}))]
+        violating, ok = PreemptionEngine.partition_pdb_violations(pods, [pdb])
+        assert violating == [] and ok == [0]
+
+
+class TestPDBInCycle:
+    def test_prefers_node_without_pdb_violation(self):
+        cluster = Cluster()
+        cluster.add_node(Node(name="a", allocatable={CPU: 4000, MEMORY: 32 * gib, PODS: 110}))
+        cluster.add_node(Node(name="b", allocatable={CPU: 4000, MEMORY: 32 * gib, PODS: 110}))
+        # node a hosts a PDB-protected victim with zero budget; node b an
+        # unprotected victim of HIGHER priority — upstream's first criterion
+        # (fewest PDB violations) must outrank victim priority
+        cluster.add_pdb(
+            PodDisruptionBudget(name="guard", selector={"app": "web"},
+                                disruptions_allowed=0)
+        )
+        cluster.add_pod(mkpod("va", 3500, priority=1, node="a", labels={"app": "web"}))
+        cluster.add_pod(mkpod("vb", 3500, priority=5, node="b"))
+        cluster.add_pod(mkpod("claimant", 3500, priority=10))
+        report = run_cycle(sched(), cluster, now=1000)
+        node, victims = report.preempted["default/claimant"]
+        assert node == "b" and victims == ["default/vb"]
