@@ -128,8 +128,9 @@ def main(n_nodes=1024, n_pods=8192):
     )
 
 
-def sequential_config(config: int):
-    """BASELINE configs 2-5 on the bit-faithful sequential solve."""
+def sequential_config(config: int, mode: str = "sequential"):
+    """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
+    profile-generic batched throughput mode (--mode batch)."""
     import jax  # noqa: F401
 
     from scheduler_plugins_tpu.framework import Profile, Scheduler
@@ -165,13 +166,25 @@ def sequential_config(config: int):
     n_pods = len(pending)
     snap, meta = cluster.snapshot(pending, now_ms=0)
     scheduler.prepare(meta, cluster)
-    np.asarray(scheduler.solve(snap).assignment)  # compile
+
+    if mode == "batch":
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+        detail = detail.replace("sequential", "batched")
+        metric = metric.replace("_pods_per_sec", "_batch_pods_per_sec")
+
+        def run():
+            return profile_batch_solve(scheduler, snap)[0]
+    else:
+        def run():
+            return scheduler.solve(snap).assignment
+
+    np.asarray(run())  # compile
     times = []
     assignment = None
     for _ in range(3):
         start = time.perf_counter()
-        result = scheduler.solve(snap)
-        assignment = np.asarray(result.assignment)  # forces completion
+        assignment = np.asarray(run())  # forces completion
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment >= 0).sum())
@@ -183,8 +196,11 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=1,
                         help="BASELINE.md scenario (1-5); default flagship")
+    parser.add_argument("--mode", choices=["sequential", "batch"],
+                        default="sequential",
+                        help="configs 2-5: bit-faithful scan or batched waves")
     args = parser.parse_args()
     if args.config == 1:
         main()
     else:
-        sequential_config(args.config)
+        sequential_config(args.config, args.mode)

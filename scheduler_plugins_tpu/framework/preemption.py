@@ -146,6 +146,39 @@ class PreemptionEngine:
             eligible &= ~exempt
         return eligible
 
+    @staticmethod
+    def _nominated_aggregates(cluster, preemptor, snap, meta):
+        """(in_eq, total) request vectors of OTHER nominated pods, per the
+        PreFilter rules (capacity_scheduling.go:226-263) — the reprieve's
+        quota re-check folds these in (reprievePod, :646)."""
+        R = len(meta.index)
+        in_eq = np.zeros(R, np.int64)
+        total = np.zeros(R, np.int64)
+        if snap.quota is None:
+            return in_eq, total
+        ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+        has_q = np.asarray(snap.quota.has_quota)
+        used = np.asarray(snap.quota.used)
+        qmin = np.asarray(snap.quota.min)
+        over_min = np.any(used > qmin, axis=1)
+        for m in cluster.pods.values():
+            if (
+                m.uid == preemptor.uid
+                or m.nominated_node_name is None
+                or m.node_name is not None
+            ):
+                continue
+            m_ns = ns_codes.get(m.namespace, -1)
+            if m_ns < 0 or not has_q[m_ns]:
+                continue
+            req_m = meta.index.encode(m.effective_request())
+            if m.namespace == preemptor.namespace and m.priority >= preemptor.priority:
+                in_eq += req_m
+                total += req_m
+            elif m.namespace != preemptor.namespace and not over_min[m_ns]:
+                total += req_m
+        return in_eq, total
+
     # -- main ------------------------------------------------------------
     def preempt(self, cluster, scheduler, preemptor: Pod, snap, meta,
                 now_ms: int, extra_reserved=None,
@@ -211,12 +244,13 @@ class PreemptionEngine:
         # priority -> min priority sum -> fewest victims -> lowest index
         candidates = np.nonzero(fits)[0][: self.MAX_CANDIDATES]
         pdbs = list(getattr(cluster, "pdbs", {}).values())
+        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
         best = None
         for n in candidates:
             final, violations = self._reprieve(
                 victims_all, v_node, v_req, v_pri, eligible, int(n),
                 free[int(n)], demand, preemptor, snap, meta, pdbs,
-                extra_quota_used,
+                extra_quota_used, nom_aggs,
             )
             if not final:
                 continue
@@ -304,7 +338,7 @@ class PreemptionEngine:
 
     def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
                   demand, preemptor, snap, meta, pdbs=(),
-                  extra_quota_used=None):
+                  extra_quota_used=None, nom_aggs=None):
         """Add back victims most-important-first while the preemptor still
         fits and quota gates hold (capacity_scheduling.go:632-670); PDB-
         violating candidates are reprieved FIRST so they get the best chance
@@ -332,6 +366,14 @@ class PreemptionEngine:
             qmax = np.asarray(quota.max)
             p_ns = ns_codes.get(preemptor.namespace, -1)
             req = meta.index.encode(preemptor.effective_request())
+            # reprievePod folds the nominated aggregates into both gates
+            # (capacity_scheduling.go:646)
+            nom_in_eq, nom_total = (
+                nom_aggs if nom_aggs is not None
+                else (np.zeros_like(req), np.zeros_like(req))
+            )
+            req_in_eq = req + nom_in_eq
+            req_total = req + nom_total
             for i in idxs:
                 ns = ns_codes.get(victims[i].namespace, -1)
                 if ns >= 0 and has_q[ns]:
@@ -349,9 +391,11 @@ class PreemptionEngine:
                 used_try = used.copy()
                 if ns >= 0 and has_q[ns]:
                     used_try[ns] += vec
-                own_ok = np.all(used_try[p_ns] + req <= qmax[p_ns])
+                own_ok = np.all(used_try[p_ns] + req_in_eq <= qmax[p_ns])
                 agg = np.sum(used_try * has_q[:, None], axis=0)
-                agg_ok = np.all(agg + req <= np.sum(qmin * has_q[:, None], axis=0))
+                agg_ok = np.all(
+                    agg + req_total <= np.sum(qmin * has_q[:, None], axis=0)
+                )
                 quota_ok = bool(own_ok and agg_ok)
             if fits and quota_ok:
                 # reprieved: stays on the node

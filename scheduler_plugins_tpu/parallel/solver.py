@@ -35,6 +35,41 @@ from scheduler_plugins_tpu.ops.normalize import minmax_normalize
 from scheduler_plugins_tpu.ops.quota import quota_admit
 
 
+def nominated_aggregates_batch(quota):
+    """(P, R) nominee aggregates from the (M, P) masks x (M, R) requests."""
+    in_eq = (
+        quota.nom_in_eq_mask.astype(jnp.float64).T
+        @ quota.nom_req.astype(jnp.float64)
+    ).astype(jnp.int64)
+    total = (
+        quota.nom_total_mask.astype(jnp.float64).T
+        @ quota.nom_req.astype(jnp.float64)
+    ).astype(jnp.int64)
+    return in_eq, total
+
+
+def finalize_assignment(assignment, snap):
+    """Shared tail: queue-order namespace quota enforcement + gang quorum
+    Permit over the final placements (used by both batched solvers)."""
+    if snap.quota is not None:
+        placed = assignment >= 0
+        quota_ok = _namespace_quota_prefix_ok(placed, snap, snap.quota.used)
+        assignment = jnp.where(placed & ~quota_ok, -1, assignment)
+    wait = jnp.zeros(snap.num_pods, bool)
+    if snap.gangs is not None:
+        placed = (assignment >= 0).astype(jnp.int32)
+        gang = snap.pods.gang
+        in_gang = gang >= 0
+        G = snap.gangs.min_member.shape[0]
+        sched = jnp.zeros(G, jnp.int32).at[jnp.maximum(gang, 0)].add(
+            jnp.where(in_gang, placed, 0)
+        )
+        quorum = snap.gangs.assigned + sched >= snap.gangs.min_member
+        pod_quorum = jnp.where(in_gang, quorum[jnp.maximum(gang, 0)], True)
+        wait = (assignment >= 0) & ~pod_quorum
+    return assignment, wait
+
+
 def batch_admission(snap, free, eq_used=None):
     """(P,) PreFilter verdicts for the batch against the carried state
     (gang membership/backoff/MinResources + elastic quota)."""
@@ -47,15 +82,10 @@ def batch_admission(snap, free, eq_used=None):
     if snap.quota is not None:
         used = eq_used if eq_used is not None else snap.quota.used
         # (P, R) nominee aggregates from the (M, P) tables — admission runs
-        # before any placement here, so the static view is exact
-        nom_in_eq = jnp.sum(
-            snap.quota.nom_in_eq_mask[:, :, None] * snap.quota.nom_req[:, None, :],
-            axis=0,
-        )
-        nom_total = jnp.sum(
-            snap.quota.nom_total_mask[:, :, None] * snap.quota.nom_req[:, None, :],
-            axis=0,
-        )
+        # before any placement here, so the static view is exact. float64
+        # matmul avoids an (M, P, R) temporary AND the unsupported s64
+        # dot_general on TPU (exact below 2^53).
+        nom_in_eq, nom_total = nominated_aggregates_batch(snap.quota)
         quota_ok = jax.vmap(
             lambda ns, req, in_eq, total: quota_admit(
                 used,
@@ -148,27 +178,70 @@ def batch_solve(snap, weights, max_waves: int = 8):
         batch_fn, snap.pods.req, admitted, free0, max_waves=max_waves
     )
 
-    # namespace quota enforcement in queue order over the final assignment
-    if snap.quota is not None:
-        placed = assignment >= 0
-        quota_ok = _namespace_quota_prefix_ok(placed, snap, snap.quota.used)
-        assignment = jnp.where(placed & ~quota_ok, -1, assignment)
-
-    # Permit: gang quorum over final placements (as in Scheduler.solve)
-    wait = jnp.zeros(snap.num_pods, bool)
-    if snap.gangs is not None:
-        placed = (assignment >= 0).astype(jnp.int32)
-        gang = snap.pods.gang
-        in_gang = gang >= 0
-        G = snap.gangs.min_member.shape[0]
-        sched = jnp.zeros(G, jnp.int32).at[jnp.maximum(gang, 0)].add(
-            jnp.where(in_gang, placed, 0)
-        )
-        quorum = snap.gangs.assigned + sched >= snap.gangs.min_member
-        pod_quorum = jnp.where(in_gang, quorum[jnp.maximum(gang, 0)], True)
-        wait = (assignment >= 0) & ~pod_quorum
-
+    assignment, wait = finalize_assignment(assignment, snap)
     return assignment, admitted, wait
+
+
+def profile_batch_solve(scheduler, snap, max_waves: int = 8):
+    """Throughput mode for an ARBITRARY plugin profile: the same plugin
+    tensor methods the sequential scan fuses are vmapped over the pod batch
+    against the cycle-initial state, then placed wave-parallel.
+
+    Semantics vs the sequential parity path: plugin Filter/Score run against
+    the CYCLE-INITIAL carried state (quota usage, NUMA zones, placed
+    workloads) rather than being recomputed after every single placement;
+    resource fit, queue-order node admission, quota prefix caps and gang
+    quorum remain exact. That is the wave trade-off documented in
+    ops.assign.waterfill_assign, extended to every plugin.
+    """
+    import jax
+
+    plugins = tuple(scheduler.profile.plugins)
+    state0 = scheduler.initial_state(snap)
+    auxes = tuple(p.aux() for p in plugins)
+
+    def batch(snap, state0, auxes):
+        for plugin, aux in zip(plugins, auxes):
+            plugin.bind_aux(aux)
+        P = snap.num_pods
+
+        def per_pod(p):
+            ok = snap.pods.mask[p] & ~snap.pods.gated[p]
+            for plugin in plugins:
+                verdict = plugin.admit(state0, snap, p)
+                if verdict is not None:
+                    ok &= verdict
+            feasible = jnp.ones(snap.num_nodes, bool)
+            for plugin in plugins:
+                mask = plugin.filter(state0, snap, p)
+                if mask is not None:
+                    feasible &= mask
+            total = jnp.zeros(snap.num_nodes, jnp.int64)
+            for plugin in plugins:
+                raw = plugin.score(state0, snap, p)
+                if raw is not None:
+                    total = total + plugin.weight * plugin.normalize(raw, feasible)
+            return ok, feasible, total
+
+        admitted, plugin_feasible, scores0 = jax.vmap(per_pod)(jnp.arange(P))
+
+        def batch_fn(free, active):
+            feasible = fits(
+                snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
+            ) & plugin_feasible
+            return feasible, scores0
+
+        assignment, _ = waterfill_assign(
+            batch_fn, snap.pods.req, admitted, state0.free, max_waves=max_waves
+        )
+        assignment, wait = finalize_assignment(assignment, snap)
+        return assignment, admitted, wait
+
+    key = ("profile_batch", max_waves)
+    cache = scheduler._solve_cache
+    if key not in cache:
+        cache[key] = jax.jit(batch)
+    return cache[key](snap, state0, auxes)
 
 
 def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):

@@ -60,6 +60,37 @@ class TestBatchSolve:
                 used[n, 3] += 1
         assert (used <= alloc).all()
 
+    def test_profile_batch_solve_respects_constraints(self):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.models import gang_quota_scenario
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+            NodeResourcesAllocatable,
+        )
+
+        cluster = gang_quota_scenario(n_gangs=6, gang_size=8, n_nodes=16)
+        sched = Scheduler(
+            Profile(plugins=[NodeResourcesAllocatable(), Coscheduling(),
+                             CapacityScheduling()])
+        )
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        assignment, admitted, wait = profile_batch_solve(sched, snap)
+        an = np.asarray(assignment)
+        assert (an[: len(pending)] >= 0).all()  # everything fits here
+        # capacity replay
+        req = np.asarray(snap.pods.req)
+        alloc = np.asarray(snap.nodes.alloc)
+        used = np.zeros_like(alloc)
+        for i, n in enumerate(an):
+            if n >= 0:
+                used[n] += req[i]
+                used[n, 3] += 1
+        assert (used <= alloc).all()
+
     def test_sharded_matches_single_device(self):
         c = Cluster()
         for i in range(8):
