@@ -140,10 +140,6 @@ def _run_preemption(scheduler, cluster, pending, report, now):
     nominated_extra = np.zeros(
         (len(meta.node_names), len(meta.index)), np.int64
     )
-    nominated_quota = None
-    if snap.quota is not None:
-        nominated_quota = np.zeros(np.asarray(snap.quota.used).shape, np.int64)
-    ns_pos = {ns: i for i, ns in enumerate(meta.namespaces)}
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
     for pod in failed_pods:
         if pod.nominated_node_name is not None:
@@ -159,19 +155,15 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
             extra_reserved=nominated_extra,
-            extra_quota_used=nominated_quota,
         )
         if result is None:
             continue
         obs.metrics.inc(obs.PREEMPTION_VICTIMS, len(result.victims))
+        # setting the nomination NOW makes this pod visible to later
+        # preemptors' live nominated aggregates (quota feedback) exactly once
         pod.nominated_node_name = result.nominated_node
         n = node_pos[result.nominated_node]
         demand = encode_demand(meta.index, pod)
-        if nominated_quota is not None and pod.namespace in ns_pos:
-            # later preemptors must see this nomination as quota usage
-            nominated_quota[ns_pos[pod.namespace]] += meta.index.encode(
-                pod.effective_request()
-            )
         victim_freed = np.zeros(len(meta.index), np.int64)
         for victim_uid in result.victims:
             victim = cluster.pods.get(victim_uid)

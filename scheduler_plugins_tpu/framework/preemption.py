@@ -104,7 +104,7 @@ class PreemptionEngine:
 
     # -- eligibility -----------------------------------------------------
     def _eligible(self, victims, preemptor, cluster, snap, meta, now_ms,
-                  extra_quota_used=None):
+                  nom_aggs=None):
         """(V,) bool eligibility per mode."""
         pri = np.array([v.priority for v in victims])
         same_ns = np.array([v.namespace == preemptor.namespace for v in victims])
@@ -114,8 +114,6 @@ class PreemptionEngine:
             quota = snap.quota
             has_q = np.asarray(quota.has_quota)
             used = np.asarray(quota.used)
-            if extra_quota_used is not None:
-                used = used + extra_quota_used
             qmin = np.asarray(quota.min)
             ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
             v_ns = np.array(
@@ -126,8 +124,12 @@ class PreemptionEngine:
             p_has_q = p_ns >= 0 and bool(has_q[p_ns])
             if p_has_q:
                 req = meta.index.encode(preemptor.effective_request())
-                # usedOverMinWith: used + req > min in any resource
-                more_than_min = bool(np.any(used[p_ns] + req > qmin[p_ns]))
+                # usedOverMinWith over nominatedPodsReqInEQWithPodReq
+                # (capacity_scheduling.go:560): req + same-ns nominee aggregate
+                in_eq_agg = nom_aggs[0] if nom_aggs is not None else 0
+                more_than_min = bool(
+                    np.any(used[p_ns] + req + in_eq_agg > qmin[p_ns])
+                )
                 if more_than_min:
                     eligible = v_has_q & same_ns & lower
                 else:
@@ -148,9 +150,13 @@ class PreemptionEngine:
 
     @staticmethod
     def _nominated_aggregates(cluster, preemptor, snap, meta):
-        """(in_eq, total) request vectors of OTHER nominated pods, per the
-        PreFilter rules (capacity_scheduling.go:226-263) — the reprieve's
-        quota re-check folds these in (reprievePod, :646)."""
+        """(in_eq, total) request vectors of OTHER nominated pods — live
+        cluster view, so nominations made earlier in THIS cycle count exactly
+        once. Classification shares `ops.quota.nominee_contribution` with the
+        snapshot builder; resource names outside this snapshot's axis are
+        dropped (the index is unioned over nodes/pending/assigned only)."""
+        from scheduler_plugins_tpu.ops.quota import nominee_contribution
+
         R = len(meta.index)
         in_eq = np.zeros(R, np.int64)
         total = np.zeros(R, np.int64)
@@ -171,18 +177,26 @@ class PreemptionEngine:
             m_ns = ns_codes.get(m.namespace, -1)
             if m_ns < 0 or not has_q[m_ns]:
                 continue
-            req_m = meta.index.encode(m.effective_request())
-            if m.namespace == preemptor.namespace and m.priority >= preemptor.priority:
+            req_m = meta.index.encode(
+                {
+                    name: qty
+                    for name, qty in m.effective_request().items()
+                    if name in meta.index
+                }
+            )
+            counts_in_eq, counts_total = nominee_contribution(
+                m.namespace == preemptor.namespace, m.priority,
+                preemptor.priority, bool(over_min[m_ns]),
+            )
+            if counts_in_eq:
                 in_eq += req_m
-                total += req_m
-            elif m.namespace != preemptor.namespace and not over_min[m_ns]:
+            if counts_total:
                 total += req_m
         return in_eq, total
 
     # -- main ------------------------------------------------------------
     def preempt(self, cluster, scheduler, preemptor: Pod, snap, meta,
-                now_ms: int, extra_reserved=None,
-                extra_quota_used=None) -> Optional[PreemptionResult]:
+                now_ms: int, extra_reserved=None) -> Optional[PreemptionResult]:
         victims_all = [
             p
             for p in cluster.pods.values()
@@ -209,8 +223,9 @@ class PreemptionEngine:
             v_req[i, index.position(PODS)] = 1
         v_pri = np.array([v.priority for v in victims_all])
 
+        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
         eligible = self._eligible(
-            victims_all, preemptor, cluster, snap, meta, now_ms, extra_quota_used
+            victims_all, preemptor, cluster, snap, meta, now_ms, nom_aggs
         )
         if not eligible.any():
             return None
@@ -232,8 +247,7 @@ class PreemptionEngine:
         # capacity-mode quota gates after removing all victims
         if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
             fits &= self._quota_gate(
-                victims_all, v_node, v_req, eligible, preemptor, snap, meta, N,
-                extra_quota_used,
+                victims_all, v_node, v_req, eligible, preemptor, snap, meta, N
             )
         if not fits.any():
             return None
@@ -244,13 +258,11 @@ class PreemptionEngine:
         # priority -> min priority sum -> fewest victims -> lowest index
         candidates = np.nonzero(fits)[0][: self.MAX_CANDIDATES]
         pdbs = list(getattr(cluster, "pdbs", {}).values())
-        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
         best = None
         for n in candidates:
             final, violations = self._reprieve(
                 victims_all, v_node, v_req, v_pri, eligible, int(n),
-                free[int(n)], demand, preemptor, snap, meta, pdbs,
-                extra_quota_used, nom_aggs,
+                free[int(n)], demand, preemptor, snap, meta, pdbs, nom_aggs,
             )
             if not final:
                 continue
@@ -272,13 +284,11 @@ class PreemptionEngine:
         )
 
     def _quota_gate(self, victims, v_node, v_req, eligible, preemptor, snap,
-                    meta, N, extra_quota_used=None):
+                    meta, N):
         """(N,) post-removal gates: own used+req <= Max and aggregate
         used+req <= aggregate Min (capacity_scheduling.go:612-618)."""
         quota = snap.quota
         used = np.asarray(quota.used)
-        if extra_quota_used is not None:
-            used = used + extra_quota_used
         qmin = np.asarray(quota.min)
         qmax = np.asarray(quota.max)
         has_q = np.asarray(quota.has_quota)
@@ -337,8 +347,7 @@ class PreemptionEngine:
         return violating, non_violating
 
     def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
-                  demand, preemptor, snap, meta, pdbs=(),
-                  extra_quota_used=None, nom_aggs=None):
+                  demand, preemptor, snap, meta, pdbs=(), nom_aggs=None):
         """Add back victims most-important-first while the preemptor still
         fits and quota gates hold (capacity_scheduling.go:632-670); PDB-
         violating candidates are reprieved FIRST so they get the best chance
@@ -360,8 +369,6 @@ class PreemptionEngine:
             ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
             has_q = np.asarray(quota.has_quota)
             used = np.asarray(quota.used).copy()
-            if extra_quota_used is not None:
-                used = used + extra_quota_used
             qmin = np.asarray(quota.min)
             qmax = np.asarray(quota.max)
             p_ns = ns_codes.get(preemptor.namespace, -1)

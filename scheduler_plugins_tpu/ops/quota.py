@@ -44,3 +44,15 @@ def quota_commit(eq_used, has_quota, ns, req, placed):
     (capacity_scheduling.go:350-368)."""
     add = jnp.where(placed & has_quota[ns], req, 0)
     return eq_used.at[ns].add(add)
+
+
+def nominee_contribution(same_namespace: bool, nominee_priority: int,
+                         pod_priority: int, nominee_eq_over_min: bool):
+    """The single source of truth for which aggregates a nominated pod's
+    request joins, for a given pending pod (capacity_scheduling.go:247-257):
+    returns (counts_in_eq, counts_in_total)."""
+    if same_namespace and nominee_priority >= pod_priority:
+        return True, True
+    if not same_namespace and not nominee_eq_over_min:
+        return False, True
+    return False, False

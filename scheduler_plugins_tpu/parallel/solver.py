@@ -205,17 +205,22 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             plugin.bind_aux(aux)
         P = snap.num_pods
 
+        from scheduler_plugins_tpu.ops.fit import fits_one
+
         def per_pod(p):
             ok = snap.pods.mask[p] & ~snap.pods.gated[p]
             for plugin in plugins:
                 verdict = plugin.admit(state0, snap, p)
                 if verdict is not None:
                     ok &= verdict
-            feasible = jnp.ones(snap.num_nodes, bool)
+            # normalize over the same fit-and-admit-filtered set the
+            # sequential step uses (cycle-initial free capacity)
+            feasible = fits_one(snap.pods.req[p], state0.free, snap.nodes.mask)
             for plugin in plugins:
                 mask = plugin.filter(state0, snap, p)
                 if mask is not None:
                     feasible &= mask
+            feasible &= ok
             total = jnp.zeros(snap.num_nodes, jnp.int64)
             for plugin in plugins:
                 raw = plugin.score(state0, snap, p)

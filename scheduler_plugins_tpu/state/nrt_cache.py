@@ -28,9 +28,37 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-from scheduler_plugins_tpu.api.objects import NodeResourceTopology, Pod
-from scheduler_plugins_tpu.api.resources import add_quantities
+from scheduler_plugins_tpu.api.objects import (
+    NodeResourceTopology,
+    Pod,
+    QOSClass,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, add_quantities
 from scheduler_plugins_tpu.utils import observability as obs
+
+
+def uses_exclusive_resources(pod: Pod) -> bool:
+    """AreExclusiveForPod (resourcerequests/exclusive.go:47-95): extended
+    resources are always exclusive (devices); for Guaranteed pods, integral
+    CPU, any memory and hugepages are exclusive. Non-restartable init
+    containers are ignored (they finish before steady state)."""
+    qos = pod.qos_class()
+    containers = [
+        c for c in pod.init_containers if c.restart_policy_always
+    ] + list(pod.containers)
+    for c in containers:
+        for name, qty in c.requests.items():
+            # extended resources are devices; kubernetes.io/-prefixed names
+            # are NATIVE (IsNativeResource, exclusive.go:74-77)
+            if "/" in name and not name.startswith("kubernetes.io/"):
+                return True
+            if qos != QOSClass.GUARANTEED:
+                continue
+            if name == CPU and qty > 0 and qty % 1000 == 0:
+                return True
+            if (name == MEMORY or name.startswith("hugepages-")) and qty > 0:
+                return True
+    return False
 
 
 def compute_pod_fingerprint(pods: Iterable[tuple[str, str]]) -> str:
@@ -116,6 +144,11 @@ class OverReserveCache(NrtCache):
     #: different schedulerName mark their node foreign
     #: (cache/foreign_pods.go:42-99)
     our_schedulers: set[str] = field(default_factory=lambda: {"tpu-scheduler"})
+    #: ForeignPodsDetect mode: "All" (default) or "OnlyExclusiveResources",
+    #: which narrows foreign marking to pods with pinned cpus/devices
+    #: (apis/config defaults: ForeignPodsDetect=All;
+    #: resourcerequests/exclusive.go:47-95)
+    foreign_pods_detect: str = "All"
 
     def __post_init__(self):
         self.nrts: dict[str, NodeResourceTopology] = {}  # flushed copies
@@ -150,9 +183,16 @@ class OverReserveCache(NrtCache):
 
     def track_pod(self, pod: Pod) -> None:
         """Informer pod event: a running pod owned by another scheduler marks
-        its node foreign (cache/foreign_pods.go)."""
-        if pod.node_name and pod.scheduler_name not in self.our_schedulers:
-            self.foreign.add(pod.node_name)
+        its node foreign (cache/foreign_pods.go); in OnlyExclusiveResources
+        mode, only pods that pin cpus/devices count."""
+        if not pod.node_name or pod.scheduler_name in self.our_schedulers:
+            return
+        if (
+            self.foreign_pods_detect == "OnlyExclusiveResources"
+            and not uses_exclusive_resources(pod)
+        ):
+            return
+        self.foreign.add(pod.node_name)
 
     # -- scheduling lifecycle -------------------------------------------
     def reserve(self, node: str, pod: Pod) -> None:

@@ -565,14 +565,17 @@ def build_snapshot(
                     continue
                 nom_req[j] = index.encode(m.effective_request())
                 nom_batch_idx[j] = batch_pos.get(m.uid, -1)
+                from scheduler_plugins_tpu.ops.quota import nominee_contribution
+
                 for i, pod in enumerate(pending_pods):
                     if m.uid == pod.uid:
                         continue
-                    if m.namespace == pod.namespace and m.priority >= pod.priority:
-                        nom_in_eq_mask[j, i] = True
-                        nom_total_mask[j, i] = True
-                    elif m.namespace != pod.namespace and not over_min[m_ns]:
-                        nom_total_mask[j, i] = True
+                    in_eq, total = nominee_contribution(
+                        m.namespace == pod.namespace, m.priority,
+                        pod.priority, bool(over_min[m_ns]),
+                    )
+                    nom_in_eq_mask[j, i] = in_eq
+                    nom_total_mask[j, i] = total
         quota_state = QuotaState(
             min=qmin, max=qmax, used=qused, has_quota=qhas,
             nom_req=nom_req, nom_in_eq_mask=nom_in_eq_mask,

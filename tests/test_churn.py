@@ -1,0 +1,197 @@
+"""Multi-cycle churn simulation — the elastic-recovery story (SURVEY.md §5):
+pods arrive, run, complete and die across many cycles; gangs, quota and
+preemption interact. Invariants checked every cycle:
+
+- no node is ever over capacity (replaying current placements);
+- no namespace ever exceeds its quota Max (bound pods);
+- every gang is all-or-nothing: bound members are 0 or >= MinMember;
+- the cluster converges (eventually everything schedulable is bound).
+"""
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    ElasticQuota,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    POD_GROUP_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.controllers import (
+    reconcile_elastic_quotas,
+    reconcile_pod_groups,
+)
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import (
+    CapacityScheduling,
+    Coscheduling,
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def check_invariants(cluster):
+    # capacity
+    used = {n: {} for n in cluster.nodes}
+    for pod in cluster.pods.values():
+        if pod.node_name is None:
+            continue
+        bucket = used[pod.node_name]
+        for r, q in pod.effective_request().items():
+            bucket[r] = bucket.get(r, 0) + q
+        bucket[PODS] = bucket.get(PODS, 0) + 1
+    for name, node in cluster.nodes.items():
+        for r, q in used[name].items():
+            assert q <= node.allocatable.get(r, 0), (name, r)
+    # quota max (cpu/mem)
+    for eq in cluster.quotas.values():
+        total = {}
+        for pod in cluster.pods.values():
+            if pod.namespace == eq.namespace and pod.node_name is not None:
+                for r, q in pod.effective_request().items():
+                    total[r] = total.get(r, 0) + q
+        for r, cap in eq.max.items():
+            assert total.get(r, 0) <= cap, (eq.namespace, r)
+    # gang all-or-nothing over BOUND members
+    for pg in cluster.pod_groups.values():
+        bound = sum(
+            1 for p in cluster.gang_members(pg) if p.node_name is not None
+        )
+        assert bound == 0 or bound >= pg.min_member, (pg.full_name, bound)
+
+
+class TestChurn:
+    def test_thirty_cycle_churn(self):
+        rng = np.random.default_rng(7)
+        cluster = Cluster()
+        for i in range(8):
+            cluster.add_node(
+                Node(name=f"n{i}", allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 30})
+            )
+        cluster.add_quota(
+            ElasticQuota(
+                name="eq", namespace="team",
+                min={CPU: 64_000, MEMORY: 256 * gib},
+                max={CPU: 96_000, MEMORY: 384 * gib},
+            )
+        )
+        sched = Scheduler(
+            Profile(
+                plugins=[
+                    NodeResourcesAllocatable(),
+                    Coscheduling(permit_waiting_seconds=5),
+                    CapacityScheduling(),
+                ]
+            )
+        )
+        serial = 0
+        for cycle in range(30):
+            now = 1000 * (cycle + 1)
+            # arrivals: some plain pods, occasionally a gang
+            for _ in range(int(rng.integers(0, 6))):
+                serial += 1
+                cluster.add_pod(
+                    Pod(
+                        name=f"p{serial:04d}",
+                        namespace="team",
+                        creation_ms=now,
+                        priority=int(rng.integers(0, 5)),
+                        containers=[
+                            Container(requests={
+                                CPU: int(rng.integers(200, 4000)),
+                                MEMORY: int(rng.integers(1, 8)) * gib,
+                            })
+                        ],
+                    )
+                )
+            if cycle % 5 == 1:
+                gname = f"g{cycle}"
+                cluster.add_pod_group(
+                    PodGroup(name=gname, namespace="team", min_member=3,
+                             creation_ms=now)
+                )
+                for m in range(3):
+                    serial += 1
+                    cluster.add_pod(
+                        Pod(
+                            name=f"{gname}-m{m}",
+                            namespace="team",
+                            creation_ms=now + m,
+                            labels={POD_GROUP_LABEL: gname},
+                            containers=[
+                                Container(requests={CPU: 2000, MEMORY: 4 * gib})
+                            ],
+                        )
+                    )
+            # completions/deletions: some running PLAIN pods finish (gang
+            # member completion is normal lifecycle, not scheduler-caused
+            # partiality — the all-or-nothing invariant below targets the
+            # scheduler, so keep gangs intact here)
+            bound = [
+                p for p in cluster.pods.values()
+                if p.node_name is not None and not p.pod_group()
+            ]
+            for pod in bound:
+                if rng.random() < 0.15:
+                    cluster.remove_pod(pod.uid)
+            run_cycle(sched, cluster, now=now)
+            # mark bound pods running and reconcile controllers
+            for pod in cluster.pods.values():
+                if pod.node_name is not None and pod.phase == PodPhase.PENDING:
+                    pod.phase = PodPhase.RUNNING
+            reconcile_pod_groups(cluster, now_ms=now)
+            reconcile_elastic_quotas(cluster)
+            check_invariants(cluster)
+
+        # drain: arrivals stop and running plain pods complete over time,
+        # freeing capacity/quota — everything schedulable must eventually bind
+        for extra in range(10):
+            running_plain = [
+                p for p in cluster.pods.values()
+                if p.node_name is not None and not p.pod_group()
+            ]
+            for pod in running_plain[: max(1, len(running_plain) // 2)]:
+                cluster.remove_pod(pod.uid)
+            run_cycle(sched, cluster, now=40_000 + extra * 1000)
+            check_invariants(cluster)
+        plain_left = [
+            p for p in cluster.pending_pods() if not p.pod_group()
+        ]
+        assert not plain_left, [p.uid for p in plain_left]
+
+
+class TestExclusiveForeign:
+    def test_only_exclusive_mode_ignores_shareable_pods(self):
+        from scheduler_plugins_tpu.state.nrt_cache import (
+            OverReserveCache,
+            uses_exclusive_resources,
+        )
+
+        shareable = Pod(
+            name="s", containers=[Container(requests={CPU: 1500})]
+        )  # burstable, fractional cpu
+        pinned = Pod(
+            name="p",
+            containers=[
+                Container(requests={CPU: 2000, MEMORY: gib},
+                          limits={CPU: 2000, MEMORY: gib})
+            ],
+        )  # guaranteed, integral cpu
+        device = Pod(
+            name="d", containers=[Container(requests={"nvidia.com/gpu": 1})]
+        )
+        assert not uses_exclusive_resources(shareable)
+        assert uses_exclusive_resources(pinned)
+        assert uses_exclusive_resources(device)
+
+        cache = OverReserveCache(foreign_pods_detect="OnlyExclusiveResources")
+        for pod, node in ((shareable, "a"), (pinned, "b")):
+            pod.node_name = node
+            pod.scheduler_name = "default-scheduler"
+            cache.track_pod(pod)
+        assert cache.foreign == {"b"}
