@@ -1,0 +1,209 @@
+"""Cluster event feed — the cross-process bridge front end.
+
+The reference's cross-process feed is apiserver List/Watch into informer
+caches (SURVEY.md §2.9); the north-star design ships cluster snapshots from
+a cluster-side agent to the TPU scheduler host. This module implements that
+boundary as a newline-delimited JSON event protocol over TCP — deliberately
+language-agnostic so a Go/C++ agent can speak it without Python bindings —
+applied to the host `Cluster` store (and through it the native columnar
+store when attached):
+
+    {"op": "upsert_node", "name": ..., "allocatable": {res: int}, ...}
+    {"op": "upsert_pod",  "name": ..., "namespace": ..., "requests": {...},
+     "limits": {...}, "priority": 0, "node": null|name, "labels": {...}}
+    {"op": "delete_pod", "uid": ...}          (or namespace+name)
+    {"op": "delete_node", "name": ...}
+    {"op": "upsert_quota"|"delete_quota", ...}
+    {"op": "upsert_pod_group"|"delete_pod_group", ...}
+    {"op": "metrics", "nodes": {node: {"cpu_avg": ..., ...}}}
+
+Pod events may carry scheduler_name/phase/deletion_ms so foreign-pod
+detection and lifecycle accounting work through this boundary. A bound pod
+is not demoted by a stale echo without a node (informer-cache semantics).
+
+Each line is acknowledged with {"ok": true} or {"ok": false, "error": ...};
+the {"op": "sync"} barrier acks with cluster counts, so an agent can fence a
+batch before requesting a scheduling cycle.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    ElasticQuota,
+    Node,
+    Pod,
+    PodGroup,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+
+def apply_event(cluster: Cluster, event: dict) -> dict:
+    """Apply one event to the store; returns the ack payload."""
+    op = event.get("op")
+    if op == "upsert_node":
+        cluster.add_node(
+            Node(
+                name=event["name"],
+                allocatable={k: int(v) for k, v in event["allocatable"].items()},
+                labels=event.get("labels", {}),
+                unschedulable=event.get("unschedulable", False),
+            )
+        )
+    elif op == "upsert_pod":
+        pod = Pod(
+            name=event["name"],
+            namespace=event.get("namespace", "default"),
+            uid=event.get("uid", ""),
+            priority=int(event.get("priority", 0)),
+            creation_ms=int(event.get("creation_ms", 0)),
+            labels=event.get("labels", {}),
+            scheduler_name=event.get(
+                "scheduler_name", "tpu-scheduler"
+            ),
+            phase=event.get("phase", "Pending"),
+            deletion_ms=event.get("deletion_ms"),
+            containers=[
+                Container(
+                    requests={k: int(v) for k, v in event.get("requests", {}).items()},
+                    limits={k: int(v) for k, v in event.get("limits", {}).items()},
+                )
+            ],
+        )
+        pod.node_name = event.get("node")
+        existing = cluster.pods.get(pod.uid)
+        if existing is not None and existing.node_name is not None and pod.node_name is None:
+            # stale watch echo predating our bind: the local binding is the
+            # newer truth (informer caches resolve the same way via resource
+            # versions; this protocol carries none)
+            pod.node_name = existing.node_name
+        cluster.add_pod(pod)
+    elif op == "delete_pod":
+        uid = event.get("uid") or f"{event.get('namespace', 'default')}/{event.get('name')}"
+        if uid not in cluster.pods:
+            return {"ok": False, "error": f"unknown pod {uid!r}"}
+        cluster.remove_pod(uid)
+    elif op == "delete_node":
+        cluster.remove_node(event["name"])
+    elif op == "delete_quota":
+        cluster.quotas.pop(event.get("namespace", "default"), None)
+    elif op == "delete_pod_group":
+        cluster.pod_groups.pop(
+            f"{event.get('namespace', 'default')}/{event['name']}", None
+        )
+    elif op == "upsert_quota":
+        cluster.add_quota(
+            ElasticQuota(
+                name=event["name"],
+                namespace=event.get("namespace", "default"),
+                min={k: int(v) for k, v in event.get("min", {}).items()},
+                max={k: int(v) for k, v in event.get("max", {}).items()},
+            )
+        )
+    elif op == "upsert_pod_group":
+        cluster.add_pod_group(
+            PodGroup(
+                name=event["name"],
+                namespace=event.get("namespace", "default"),
+                min_member=int(event.get("min_member", 1)),
+                min_resources={
+                    k: int(v) for k, v in event.get("min_resources", {}).items()
+                },
+                creation_ms=int(event.get("creation_ms", 0)),
+            )
+        )
+    elif op == "metrics":
+        cluster.node_metrics = event["nodes"]
+    elif op == "sync":
+        return {
+            "ok": True,
+            "nodes": len(cluster.nodes),
+            "pods": len(cluster.pods),
+            "pending": len(cluster.pending_pods()),
+        }
+    else:
+        return {"ok": False, "error": f"unknown op {op!r}"}
+    return {"ok": True}
+
+
+class FeedServer:
+    """TCP server applying the event protocol to a Cluster store.
+
+    `lock` serializes event application; anything else touching the store
+    concurrently (scheduling cycles, controllers) must hold it too — use
+    `run_cycle` / `locked()` rather than calling framework.run_cycle
+    directly on a live-fed cluster.
+    """
+
+    def __init__(self, cluster: Cluster, host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        event = json.loads(raw)
+                        with outer.lock:
+                            ack = apply_event(outer.cluster, event)
+                    except Exception as exc:  # malformed line: report, keep going
+                        ack = {"ok": False, "error": str(exc)}
+                    self.wfile.write((json.dumps(ack) + "\n").encode())
+                    self.wfile.flush()
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def locked(self):
+        """Context manager guarding store access against the feed threads."""
+        return self.lock
+
+    def run_cycle(self, scheduler, now=None):
+        """One scheduling cycle holding the feed lock."""
+        from scheduler_plugins_tpu.framework.cycle import run_cycle
+
+        with self.lock:
+            return run_cycle(scheduler, self.cluster, now)
+
+
+class FeedClient:
+    """Minimal agent-side client (what a Go/C++ sidecar would implement)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._file = self._sock.makefile("rwb")
+
+    def send(self, event: dict) -> dict:
+        self._file.write((json.dumps(event) + "\n").encode())
+        self._file.flush()
+        return json.loads(self._file.readline())
+
+    def close(self):
+        self._file.close()
+        self._sock.close()

@@ -1,0 +1,83 @@
+"""Event-feed bridge tests: a remote agent drives the cluster over TCP and a
+scheduling cycle runs against the fed state."""
+
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.bridge.feed import FeedClient, FeedServer, apply_event
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+class TestFeed:
+    def test_agent_feeds_then_cycle_schedules(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            host, port = server.address
+            client = FeedClient(host, port)
+            assert client.send({
+                "op": "upsert_node", "name": "n0",
+                "allocatable": {CPU: 8000, MEMORY: 32 * gib, PODS: 110},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_quota", "name": "q", "namespace": "team",
+                "min": {CPU: 4000, MEMORY: 16 * gib},
+                "max": {CPU: 6000, MEMORY: 24 * gib},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_pod", "name": "web", "namespace": "team",
+                "requests": {CPU: 500, MEMORY: gib},
+            })["ok"]
+            sync = client.send({"op": "sync"})
+            assert sync == {"ok": True, "nodes": 1, "pods": 1, "pending": 1}
+            report = server.run_cycle(
+                Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+                now=1000,
+            )
+            assert report.bound == {"team/web": "n0"}
+            # stale watch echo without the node must NOT demote the binding
+            assert client.send({
+                "op": "upsert_pod", "name": "web", "namespace": "team",
+                "requests": {CPU: 500, MEMORY: gib},
+            })["ok"]
+            assert cluster.pods["team/web"].node_name == "n0"
+            # delete by namespace+name (no uid); unknown deletes are errors
+            assert client.send({
+                "op": "delete_pod", "namespace": "team", "name": "web",
+            })["ok"]
+            assert not client.send({"op": "delete_pod", "uid": "team/ghost"})["ok"]
+            assert client.send({"op": "sync"})["pods"] == 0
+            # node lifecycle: delete_node removes it from scheduling
+            assert client.send({"op": "delete_node", "name": "n0"})["ok"]
+            assert client.send({"op": "sync"})["nodes"] == 0
+            client.close()
+        finally:
+            server.stop()
+
+    def test_malformed_and_unknown_events_reported(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            bad = client.send({"op": "explode"})
+            assert not bad["ok"] and "unknown op" in bad["error"]
+            # malformed JSON line
+            client._file.write(b"{not json\n")
+            client._file.flush()
+            import json as _json
+
+            ack = _json.loads(client._file.readline())
+            assert not ack["ok"]
+            # the connection stays usable afterwards
+            assert client.send({"op": "sync"})["ok"]
+            client.close()
+        finally:
+            server.stop()
+
+    def test_metrics_event(self):
+        cluster = Cluster()
+        apply_event(cluster, {"op": "metrics",
+                              "nodes": {"n0": {"cpu_avg": 42.0}}})
+        assert cluster.node_metrics == {"n0": {"cpu_avg": 42.0}}
