@@ -137,6 +137,10 @@ def _run_preemption(scheduler, cluster, pending, report, now):
     failed_pods = [by_uid[uid] for uid in report.failed if uid in by_uid]
     # post-bind state: assigned pods now include this cycle's placements
     snap, meta = cluster.snapshot(failed_pods, now_ms=now)
+    # re-prepare: the preemption snapshot's resource-axis layout can differ
+    # from the main cycle's (extended names are interned in first-seen
+    # order), and plugin aux arrays must match THIS meta
+    scheduler.prepare(meta, cluster)
     nominated_extra = np.zeros(
         (len(meta.node_names), len(meta.index)), np.int64
     )

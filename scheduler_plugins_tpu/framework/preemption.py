@@ -24,9 +24,11 @@ host-side and exact. Candidate ranking follows the upstream pickOneNode
 criteria (fewest PDB violations -> min highest victim priority -> min
 priority sum -> fewest victims -> lowest index).
 
-The node re-filter in the dry run is the resource fit (+ quota gates); other
-enabled Filter plugins are not re-run against the hypothetical state in this
-round — the reference re-runs the full filter chain.
+The dry-run re-filter covers resource fit, the quota gates AND the enabled
+plugins' Filter chain evaluated against the current cache state — the same
+view the reference's RunFilterPluginsWithNominatedPods gives plugin filters
+(removing victims from the NodeInfo does not alter e.g. the NRT cache copy
+the TopologyMatch filter reads).
 """
 
 from __future__ import annotations
@@ -240,6 +242,13 @@ class PreemptionEngine:
         demand = encode_demand(index, preemptor)
         node_mask = np.asarray(snap.nodes.mask)[:N]
         fits = np.all(free + removed >= demand[None, :], axis=1) & node_mask
+        # plugin Filter chain (NUMA alignment, network violations, ...)
+        # for the preemptor, like RunFilterPluginsWithNominatedPods
+        if scheduler is not None and preemptor.uid in meta.pod_names:
+            p_idx = meta.pod_names.index(preemptor.uid)
+            fits &= np.asarray(
+                scheduler.filter_verdicts(snap, p_idx)
+            )[:N]
         has_victims = np.zeros(N, bool)
         has_victims[v_node[eligible]] = True
         fits &= has_victims  # nodes without victims are unresolvable

@@ -199,6 +199,34 @@ class Scheduler:
             self._solve_cache[key] = self._make_solve()
         return self._solve_cache[key](snap, state0, auxes)
 
+    def filter_verdicts(self, snap: ClusterSnapshot, pod_index: int):
+        """(N,) AND of the enabled plugins' Filter verdicts for one pod
+        against the cycle-initial state (resource fit excluded — callers
+        handle capacity themselves). Used by the preemption dry run, which
+        mirrors RunFilterPluginsWithNominatedPods: plugin filters see the
+        CURRENT cache state, exactly as the reference's re-filter does
+        (removing victims from the NodeInfo does not change e.g. the NRT
+        cache view the TopologyMatch filter reads)."""
+        plugins = tuple(self.profile.plugins)
+        key = "filter_verdicts"
+        if key not in self._solve_cache:
+
+            def verdicts(snap, state0, auxes, p):
+                for plugin, aux in zip(plugins, auxes):
+                    plugin.bind_aux(aux)
+                feasible = jnp.ones(snap.num_nodes, bool)
+                for plugin in plugins:
+                    mask = plugin.filter(state0, snap, p)
+                    if mask is not None:
+                        feasible &= mask
+                return feasible
+
+            self._solve_cache[key] = jax.jit(verdicts)
+        auxes = tuple(plugin.aux() for plugin in plugins)
+        return self._solve_cache[key](
+            snap, self.initial_state(snap), auxes, pod_index
+        )
+
     def initial_state(self, snap: ClusterSnapshot) -> SolverState:
         free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
         eq_used = snap.quota.used if snap.quota is not None else None

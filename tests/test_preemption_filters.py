@@ -1,0 +1,72 @@
+"""Preemption dry-run must respect the plugin Filter chain: a node whose
+victims would free enough RESOURCES is still not a candidate when a plugin
+filter (here: NUMA single-numa alignment) rejects the preemptor there."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    TopologyManagerPolicy,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.framework.preemption import (
+    PreemptionEngine,
+    PreemptionMode,
+)
+from scheduler_plugins_tpu.plugins import (
+    NodeResourcesAllocatable,
+    NodeResourceTopologyMatch,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def gpod(name, cpu, priority=0, node=None):
+    p = Pod(
+        name=name,
+        priority=priority,
+        containers=[
+            Container(requests={CPU: cpu, MEMORY: gib}, limits={CPU: cpu, MEMORY: gib})
+        ],
+    )
+    p.node_name = node
+    return p
+
+
+class TestPreemptionFilterChain:
+    def test_numa_filter_steers_candidate_choice(self):
+        cluster = Cluster()
+        # node "split": zones 2000/2000 — can never align a 3000m guaranteed
+        # pod, regardless of evictions. node "fat": zone 4000 — aligns it.
+        cluster.add_node(Node(name="split", allocatable={CPU: 4000, MEMORY: 32 * gib, PODS: 110}))
+        cluster.add_node(Node(name="fat", allocatable={CPU: 4000, MEMORY: 32 * gib, PODS: 110}))
+        cluster.add_nrt(NodeResourceTopology(
+            node_name="split",
+            policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+            zones=[NUMAZone(numa_id=0, available={CPU: 2000, MEMORY: 16 * gib}),
+                   NUMAZone(numa_id=1, available={CPU: 2000, MEMORY: 16 * gib})],
+        ))
+        cluster.add_nrt(NodeResourceTopology(
+            node_name="fat",
+            policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+            zones=[NUMAZone(numa_id=0, available={CPU: 4000, MEMORY: 32 * gib})],
+        ))
+        # low-priority victims occupy both nodes fully
+        cluster.add_pod(gpod("v-split", 3500, priority=1, node="split"))
+        cluster.add_pod(gpod("v-fat", 3500, priority=5, node="fat"))
+        cluster.add_pod(gpod("claimant", 3000, priority=10))
+        sched = Scheduler(
+            Profile(
+                plugins=[NodeResourcesAllocatable(), NodeResourceTopologyMatch()],
+                preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+            )
+        )
+        report = run_cycle(sched, cluster, now=1000)
+        # without the filter chain the engine would pick "split" (its victim
+        # has the LOWER priority); NUMA alignment forbids it -> "fat"
+        node, victims = report.preempted["default/claimant"]
+        assert node == "fat" and victims == ["default/v-fat"]
