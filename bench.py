@@ -128,6 +128,73 @@ def main(n_nodes=1024, n_pods=8192):
     )
 
 
+def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
+    """The BASELINE.json headline scale: 10k nodes x 100k pending pods.
+
+    Pods stream through the batched waterfill in queue-order chunks with
+    free capacity carried between chunks (chunk boundaries preserve the
+    queue order the sequential semantics define), bounding the (P, N)
+    working set to one chunk."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+    from scheduler_plugins_tpu.models import allocatable_scenario
+    from scheduler_plugins_tpu.ops.allocatable import (
+        MODE_LEAST,
+        allocatable_scores,
+        demote_scores_int32,
+    )
+    from scheduler_plugins_tpu.ops.assign import waterfill_assign
+    from scheduler_plugins_tpu.ops.fit import fits, free_capacity
+    from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+
+    cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=n_pods)
+    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+    # pad to a chunk multiple so every chunk shares one compiled shape
+    padded = ((n_pods + chunk - 1) // chunk) * chunk
+    snap, meta = cluster.snapshot(pending, now_ms=0, pad_pods=padded)
+    weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+
+    raw32 = demote_scores_int32(
+        allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+    )
+    node_mask = snap.nodes.mask
+
+    def solve_chunk(req_chunk, mask_chunk, free0):
+        def batch_fn(free, active):
+            feasible = fits(req_chunk, free, pod_mask=active, node_mask=node_mask)
+            scores = minmax_normalize(
+                jnp.broadcast_to(raw32[None, :], feasible.shape), feasible
+            )
+            return feasible, scores
+
+        return waterfill_assign(batch_fn, req_chunk, mask_chunk, free0, max_waves=8)
+
+    solve_chunk = jax.jit(solve_chunk)
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    # warm up compile on the first chunk shape
+    a, f = solve_chunk(snap.pods.req[:chunk], snap.pods.mask[:chunk], free)
+    np.asarray(a)
+
+    start = time.perf_counter()
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    placed = 0
+    for lo in range(0, padded, chunk):
+        a, free = solve_chunk(
+            snap.pods.req[lo:lo + chunk], snap.pods.mask[lo:lo + chunk], free
+        )
+        placed += int((np.asarray(a) >= 0).sum())
+    elapsed = time.perf_counter() - start
+    baseline = python_baseline_pods_per_sec(cluster, sample=40)
+    _emit(
+        "north_star_pods_per_sec",
+        n_pods / elapsed,
+        f"{n_nodes} nodes x {n_pods} pods chunked x{chunk}, {placed} placed",
+        baseline,
+    )
+
+
 def sequential_config(config: int, mode: str = "sequential"):
     """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
     profile-generic batched throughput mode (--mode batch)."""
@@ -195,12 +262,15 @@ def sequential_config(config: int, mode: str = "sequential"):
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=1,
-                        help="BASELINE.md scenario (1-5); default flagship")
+                        help="BASELINE.md scenario (1-5; 6 = 10k-node x "
+                             "100k-pod north-star scale); default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
                         help="configs 2-5: bit-faithful scan or batched waves")
     args = parser.parse_args()
     if args.config == 1:
         main()
+    elif args.config == 6:
+        north_star()
     else:
         sequential_config(args.config, args.mode)

@@ -32,6 +32,18 @@ def allocatable_scores(alloc, weights, mode_sign=MODE_LEAST):
     return go_div(node_score, weight_sum)
 
 
+def demote_scores_int32(raw):
+    """Order-preserving demotion of raw int64 scores to int32 for the heavy
+    (P, N) normalize (int64 is emulated u32 pairs on TPU): a dynamic right
+    shift squeezes magnitudes under 2^23 so (score - lo) * 100 cannot
+    overflow int32 for ANY weight configuration. Shifting may merge
+    near-ties; the sequential parity path stays full int64."""
+    max_abs = jnp.max(jnp.abs(raw))
+    bits = jnp.ceil(jnp.log2(max_abs.astype(jnp.float64) + 1.0))
+    shift = jnp.maximum(bits - 23, 0).astype(jnp.int64)
+    return (raw >> shift).astype(jnp.int32)
+
+
 def allocatable_score_matrix(alloc, weights, mode_sign, feasible):
     """Full plugin output: (P, N) normalized scores given (P, N) feasibility.
 

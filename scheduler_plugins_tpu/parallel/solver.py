@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from scheduler_plugins_tpu.ops.allocatable import (
     MODE_LEAST,
     allocatable_scores,
+    demote_scores_int32,
 )
 from scheduler_plugins_tpu.ops.assign import waterfill_assign
 from scheduler_plugins_tpu.ops.fit import fits, free_capacity, pod_fit_demand
@@ -158,17 +159,9 @@ def batch_solve(snap, weights, max_waves: int = 8):
         feasible = fits(
             snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
         )
-        # int64 arithmetic is emulated u32 pairs on TPU, so the heavy
-        # (P, N) normalize runs in int32. Raw scores can exceed int32 for
-        # arbitrary weight configs; an order-PRESERVING dynamic right-shift
-        # squeezes them under 2^23 so (score - lo) * 100 cannot overflow.
-        # (Shifting may merge near-ties — the wave path is already not
-        # bit-exact; the sequential path stays full int64.)
-        raw = allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
-        max_abs = jnp.max(jnp.abs(raw))
-        bits = jnp.ceil(jnp.log2(max_abs.astype(jnp.float64) + 1.0))
-        shift = jnp.maximum(bits - 23, 0).astype(jnp.int64)
-        raw = (raw >> shift).astype(jnp.int32)
+        raw = demote_scores_int32(
+            allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+        )
         scores = minmax_normalize(
             jnp.broadcast_to(raw[None, :], feasible.shape), feasible
         )
