@@ -82,6 +82,14 @@ class Plugin:
         tracers); tensor methods read `self._aux`."""
         self._aux = aux
 
+    def static_key(self):
+        """Hashable fingerprint of any PYTHON-LEVEL specialization this
+        plugin bakes into the trace (static branch selections that cannot be
+        traced aux arrays). The runtime keys its jit caches on the tuple of
+        these, so changing a specialization retraces instead of silently
+        reusing a stale program."""
+        return None
+
     # --- host-side -------------------------------------------------------
     def queue_key(self, pod, cluster):  # pragma: no cover - trivial default
         """QueueSort key component for `pod`; tuples compare lexicographically."""

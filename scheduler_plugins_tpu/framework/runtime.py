@@ -194,7 +194,9 @@ class Scheduler:
         if state0 is None:
             state0 = self.initial_state(snap)
         auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
-        key = "solve"
+        key = ("solve",) + tuple(
+            plugin.static_key() for plugin in self.profile.plugins
+        )
         if key not in self._solve_cache:
             self._solve_cache[key] = self._make_solve()
         return self._solve_cache[key](snap, state0, auxes)
@@ -208,7 +210,7 @@ class Scheduler:
         (removing victims from the NodeInfo does not change e.g. the NRT
         cache view the TopologyMatch filter reads)."""
         plugins = tuple(self.profile.plugins)
-        key = "filter_verdicts"
+        key = ("filter_verdicts",) + tuple(p.static_key() for p in plugins)
         if key not in self._solve_cache:
 
             def verdicts(snap, state0, auxes, p):

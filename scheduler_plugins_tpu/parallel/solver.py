@@ -235,7 +235,9 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait
 
-    key = ("profile_batch", max_waves)
+    key = ("profile_batch", max_waves) + tuple(
+        p.static_key() for p in plugins
+    )
     cache = scheduler._solve_cache
     if key not in cache:
         cache[key] = jax.jit(batch)

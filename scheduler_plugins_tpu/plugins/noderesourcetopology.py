@@ -62,7 +62,25 @@ class NodeResourceTopologyMatch(Plugin):
         self._host_level: Optional[jnp.ndarray] = None
         self._weights: Optional[jnp.ndarray] = None
 
+    def prepare_cluster(self, meta, cluster):
+        """Static specialization: when every NRT shares one topology-manager
+        scope (the overwhelmingly common fleet configuration), trace only
+        that scope's handler instead of computing both and selecting
+        (halves the per-step NUMA work in the sequential scan)."""
+        self._uniform_scope = None
+        if cluster is not None and cluster.nrts:
+            scopes = {int(t.scope) for t in cluster.nrts.values()}
+            if len(scopes) == 1:
+                self._uniform_scope = scopes.pop()
+
+    def static_key(self):
+        # the uniform-scope specialization is a Python-level branch baked
+        # into the trace; key the runtime's jit caches on it so a fleet
+        # scope change retraces instead of reusing the stale program
+        return ("nrt_scope", getattr(self, "_uniform_scope", None))
+
     def prepare(self, meta):
+        self._uniform_scope = getattr(self, "_uniform_scope", None)
         self._affine = jnp.asarray(numa_ops.numa_affine_mask(meta.index))
         self._host_level = jnp.asarray(numa_ops.host_level_mask(meta.index))
         self._host_extended = jnp.asarray(
@@ -98,22 +116,32 @@ class NodeResourceTopologyMatch(Plugin):
         cmask = snap.pods.container_mask[p]
         req = snap.pods.req[p]
 
-        container_ok = jax.vmap(
-            lambda avail, reported, zmask, alloc: numa_ops.single_numa_fit(
-                avail, reported, zmask, alloc, guaranteed, creq, is_init,
-                cmask, affine, host_level,
-            )
-        )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
-        pod_ok = jax.vmap(
-            lambda avail, reported, zmask, alloc: numa_ops.pod_scope_fit(
-                avail, reported, zmask, alloc, guaranteed, req,
-                affine, host_level,
-            )
-        )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+        def container_fit():
+            return jax.vmap(
+                lambda avail, reported, zmask, alloc: numa_ops.single_numa_fit(
+                    avail, reported, zmask, alloc, guaranteed, creq, is_init,
+                    cmask, affine, host_level,
+                )
+            )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
 
-        scoped = jnp.where(
-            numa.scope == int(TopologyManagerScope.POD), pod_ok, container_ok
-        )
+        def pod_fit():
+            return jax.vmap(
+                lambda avail, reported, zmask, alloc: numa_ops.pod_scope_fit(
+                    avail, reported, zmask, alloc, guaranteed, req,
+                    affine, host_level,
+                )
+            )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+
+        if self._uniform_scope == int(TopologyManagerScope.POD):
+            scoped = pod_fit()
+        elif self._uniform_scope == int(TopologyManagerScope.CONTAINER):
+            scoped = container_fit()
+        else:
+            scoped = jnp.where(
+                numa.scope == int(TopologyManagerScope.POD),
+                pod_fit(),
+                container_fit(),
+            )
         # only single-numa-node policy filters (filter.go:230-241)
         applies = numa.has_nrt & (
             numa.policy == int(TopologyManagerPolicy.SINGLE_NUMA_NODE)
@@ -192,6 +220,10 @@ class NodeResourceTopologyMatch(Plugin):
             return jnp.trunc(total / count).astype(jnp.int64)
 
         available = self._numa_avail(state, snap)
+        if self._uniform_scope == int(TopologyManagerScope.POD):
+            return jax.vmap(node_pod_scope)(available, numa.zone_mask)
+        if self._uniform_scope == int(TopologyManagerScope.CONTAINER):
+            return jax.vmap(node_container_scope)(available, numa.zone_mask)
         pod_scores = jax.vmap(node_pod_scope)(available, numa.zone_mask)
         cont_scores = jax.vmap(node_container_scope)(available, numa.zone_mask)
         return jnp.where(
@@ -251,14 +283,14 @@ class NodeResourceTopologyMatch(Plugin):
             )
 
         available = self._numa_avail(state, snap)
-        pod_scores = jax.vmap(node_pod)(
-            available, numa.reported, numa.zone_mask, numa.distances,
-            numa.max_numa,
-        )
-        cont_scores = jax.vmap(node_container)(
-            available, numa.reported, numa.zone_mask, numa.distances,
-            numa.max_numa,
-        )
+        args = (available, numa.reported, numa.zone_mask, numa.distances,
+                numa.max_numa)
+        if self._uniform_scope == int(TopologyManagerScope.POD):
+            return jax.vmap(node_pod)(*args)
+        if self._uniform_scope == int(TopologyManagerScope.CONTAINER):
+            return jax.vmap(node_container)(*args)
         return jnp.where(
-            numa.scope == int(TopologyManagerScope.POD), pod_scores, cont_scores
+            numa.scope == int(TopologyManagerScope.POD),
+            jax.vmap(node_pod)(*args),
+            jax.vmap(node_container)(*args),
         )

@@ -164,13 +164,13 @@ class Peaks(Plugin):
                 raw = json.load(f)
             return {
                 node: (
-                    model.get("K0", 0.0),
-                    model.get("K1", 0.0),
-                    model.get("K2", 0.0),
+                    float(model.get("K0", 0.0)),
+                    float(model.get("K1", 0.0)),
+                    float(model.get("K2", 0.0)),
                 )
                 for node, model in raw.items()
             }
-        except (OSError, ValueError, AttributeError) as exc:
+        except (OSError, ValueError, TypeError, AttributeError) as exc:
             raise ValueError(
                 f"invalid NODE_POWER_MODEL file {path!r}: {exc}"
             ) from exc

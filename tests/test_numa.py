@@ -146,6 +146,67 @@ class TestNumaFilter:
         assert "default/p1" in r.bound
         assert r.failed == ["default/p2"]
 
+    def test_mixed_scopes_in_one_cluster(self):
+        # one container-scope node, one pod-scope node: the per-node scope
+        # selection path (no uniform-scope specialization) must hold.
+        # 2x3-core guaranteed containers: container scope fits (one per
+        # zone), pod scope (6 cores in one zone) does not.
+        c = cluster_with([
+            nrt("cont", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+                scope=TopologyManagerScope.CONTAINER),
+            nrt("podn", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+                scope=TopologyManagerScope.POD),
+        ])
+        pod = guaranteed_pod(
+            "p", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+            ],
+        )
+        c.add_pod(pod)
+        r = run_cycle(Scheduler(Profile(plugins=[NodeResourceTopologyMatch()])), c, now=1000)
+        assert r.bound["default/p"] == "cont"
+
+    def test_scope_change_retraces_specialization(self):
+        # cycle 1 specializes on CONTAINER scope; flipping the fleet to POD
+        # scope (same shapes) must retrace, not reuse the stale program
+        c = cluster_with([
+            nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+                scope=TopologyManagerScope.CONTAINER),
+        ])
+        pod = guaranteed_pod(
+            "p1", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+            ],
+        )
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[NodeResourceTopologyMatch()]))
+        r1 = run_cycle(sched, c, now=1000)
+        assert "default/p1" in r1.bound  # container scope: one per zone
+        # fleet reconfigured to pod scope; identical request must now fail
+        c.remove_pod("default/p1")
+        c.add_nrt(nrt("n0", [{CPU: 4000, MEMORY: 16 * gib}, {CPU: 4000, MEMORY: 16 * gib}],
+                      scope=TopologyManagerScope.POD))
+        pod2 = guaranteed_pod(
+            "p2", 0, 0,
+            containers=[
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+                Container(requests={CPU: 3000, MEMORY: 1 * gib},
+                          limits={CPU: 3000, MEMORY: 1 * gib}),
+            ],
+        )
+        c.add_pod(pod2)
+        r2 = run_cycle(sched, c, now=2000)
+        assert r2.failed == ["default/p2"]
+
     def test_non_single_numa_policy_passes(self):
         c = cluster_with([
             nrt("n0", [{CPU: 1000, MEMORY: 1 * gib}],
