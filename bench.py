@@ -184,6 +184,8 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
         a, free = solve_chunk(
             snap.pods.req[lo:lo + chunk], snap.pods.mask[lo:lo + chunk], free
         )
+        # per-chunk host sync: chaining chunks device-side balloons the
+        # in-flight working set through the tunneled backend
         placed += int((np.asarray(a) >= 0).sum())
     elapsed = time.perf_counter() - start
     baseline = python_baseline_pods_per_sec(cluster, sample=40)
