@@ -20,43 +20,49 @@ def go_div(a, b):
     return -q if a < 0 else q
 
 
-def reference_loop(nodes, pods, weights, sign=-1):
-    """Independent per-pod x per-node implementation (the Go path's shape)."""
-    free = {n.name: dict(n.allocatable) for n in nodes}
-    for n in nodes:
-        free[n.name].setdefault(PODS, 0)
+def static_scores(nodes, weights, sign=-1):
     wsum = sum(weights.values())
-    raw = {
+    return {
         n.name: go_div(
             sum(sign * n.allocatable.get(r, 0) * w for r, w in weights.items()),
             wsum,
         )
         for n in nodes
     }
-    placements = []
-    for pod in pods:
-        req = pod.effective_request()
-        feasible = [
-            n.name
-            for n in nodes
-            if free[n.name].get(PODS, 0) >= 1
-            and all(free[n.name].get(r, 0) >= q for r, q in req.items())
-        ]
-        if not feasible:
-            placements.append(None)
-            continue
-        lo = min(raw[f] for f in feasible)
-        hi = max(raw[f] for f in feasible)
-        best, best_score = None, None
-        for name in feasible:
-            score = 0 if hi == lo else (raw[name] - lo) * 100 // (hi - lo)
-            if best_score is None or score > best_score:
-                best, best_score = name, score
-        for r, q in req.items():
-            free[best][r] = free[best].get(r, 0) - q
-        free[best][PODS] -= 1
-        placements.append(best)
-    return placements
+
+
+def place_one(free, raw, node_order, req):
+    """The shared per-pod step: fit -> min-max normalize -> argmax with
+    lowest-index tie-break -> commit. Returns the chosen node name or None."""
+    feasible = [
+        name
+        for name in node_order
+        if free[name].get(PODS, 0) >= 1
+        and all(free[name].get(r, 0) >= v for r, v in req.items())
+    ]
+    if not feasible:
+        return None
+    lo = min(raw[f] for f in feasible)
+    hi = max(raw[f] for f in feasible)
+    best, best_score = None, None
+    for name in feasible:
+        score = 0 if hi == lo else (raw[name] - lo) * 100 // (hi - lo)
+        if best_score is None or score > best_score:
+            best, best_score = name, score
+    for r, v in req.items():
+        free[best][r] = free[best].get(r, 0) - v
+    free[best][PODS] -= 1
+    return best
+
+
+def reference_loop(nodes, pods, weights, sign=-1):
+    """Independent per-pod x per-node implementation (the Go path's shape)."""
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    raw = static_scores(nodes, weights, sign)
+    order = [n.name for n in nodes]
+    return [place_one(free, raw, order, p.effective_request()) for p in pods]
 
 
 def random_cluster(rng, n_nodes, n_pods):
@@ -89,6 +95,44 @@ def random_cluster(rng, n_nodes, n_pods):
     return nodes, pods
 
 
+def reference_loop_quota(nodes, pods, weights, quotas, sign=-1):
+    """Reference loop + ElasticQuota admission (over-Max, aggregate-over-Min)
+    with usage committed per placement."""
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    raw = static_scores(nodes, weights, sign)
+    order = [n.name for n in nodes]
+    axis = sorted({r for q in quotas.values() for r in list(q["min"]) + list(q["max"])}
+                  | {r for p in pods for r in p.effective_request()}
+                  | {CPU, MEMORY, "ephemeral-storage", PODS})
+    used = {ns: {r: 0 for r in axis} for ns in quotas}
+    placements = []
+    for pod in pods:
+        req = pod.effective_request()
+        ns = pod.namespace
+        if ns in quotas:
+            q = quotas[ns]
+            over_max = any(
+                used[ns].get(r, 0) + req.get(r, 0) > q["max"].get(r, 2**63 - 1)
+                for r in axis
+            )
+            agg_used = {r: sum(used[m].get(r, 0) for m in quotas) for r in axis}
+            agg_min = {r: sum(quotas[m]["min"].get(r, 0) for m in quotas) for r in axis}
+            over_min = any(
+                agg_used[r] + req.get(r, 0) > agg_min[r] for r in axis
+            )
+            if over_max or over_min:
+                placements.append(None)
+                continue
+        best = place_one(free, raw, order, req)
+        if best is not None and ns in quotas:
+            for r, v in req.items():
+                used[ns][r] = used[ns].get(r, 0) + v
+        placements.append(best)
+    return placements
+
+
 class TestDifferential:
     def test_bit_identical_placements_random_scenarios(self):
         weights = {CPU: 1 << 20, MEMORY: 1}
@@ -115,6 +159,120 @@ class TestDifferential:
                 for a in np.asarray(result.assignment)[: len(pods)]
             ]
             assert got == expected, f"seed {seed}: divergence"
+
+    def test_quota_differential(self):
+        from scheduler_plugins_tpu.api.objects import ElasticQuota
+        from scheduler_plugins_tpu.plugins import CapacityScheduling
+
+        weights = {CPU: 1 << 20, MEMORY: 1}
+        for seed in range(3):
+            rng = np.random.default_rng(100 + seed)
+            nodes, pods = random_cluster(rng, 10, 80)
+            namespaces = ["a", "b", "c"]
+            for i, pod in enumerate(pods):
+                pod.namespace = namespaces[i % 3]
+                pod.uid = f"{pod.namespace}/{pod.name}"
+            quotas = {
+                ns: {
+                    "min": {CPU: int(rng.integers(20_000, 60_000)),
+                            MEMORY: int(rng.integers(64, 256)) * gib},
+                    "max": {CPU: int(rng.integers(60_000, 120_000)),
+                            MEMORY: int(rng.integers(256, 512)) * gib},
+                }
+                for ns in namespaces[:2]  # one namespace stays quota-free
+            }
+            cluster = Cluster()
+            for n in nodes:
+                cluster.add_node(n)
+            for p in pods:
+                cluster.add_pod(p)
+            for ns, q in quotas.items():
+                cluster.add_quota(
+                    ElasticQuota(name=ns, namespace=ns, min=q["min"], max=q["max"])
+                )
+            sched = Scheduler(
+                Profile(plugins=[NodeResourcesAllocatable(), CapacityScheduling()])
+            )
+            pending = sched.sort_pending(cluster.pending_pods(), cluster)
+            snap, meta = cluster.snapshot(pending, now_ms=0)
+            sched.prepare(meta, cluster)
+            result = sched.solve(snap)
+            assignment = np.asarray(result.assignment)
+            got = [
+                meta.node_names[int(a)] if int(a) >= 0 else None
+                for a in assignment[: len(pending)]
+            ]
+            # the reference loop consumes pods in the solver's queue order
+            expected = reference_loop_quota(nodes, pending, weights, quotas)
+            assert got == expected, f"seed {seed}: quota divergence"
+
+    def test_multi_cycle_differential(self):
+        # three consecutive cycles with churn between them: placements must
+        # stay bit-identical against the reference loop replayed per cycle
+        weights = {CPU: 1 << 20, MEMORY: 1}
+        rng = np.random.default_rng(999)
+        nodes, _ = random_cluster(rng, 8, 0)
+        cluster = Cluster()
+        for n in nodes:
+            cluster.add_node(n)
+        sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+        serial = 0
+        for cycle in range(3):
+            # arrivals
+            _, fresh = random_cluster(rng, 1, 10)
+            for p in fresh:
+                serial += 1
+                p.name = f"c{cycle}-p{serial}"
+                p.uid = f"default/{p.name}"
+                p.creation_ms = cycle * 1000 + serial
+                cluster.add_pod(p)
+            pending = sched.sort_pending(cluster.pending_pods(), cluster)
+            # reference loop sees nodes with CURRENT usage: model via
+            # shrunken allocatable
+            assigned = [p for p in cluster.pods.values() if p.node_name]
+            used = {n.name: {} for n in nodes}
+            for p in assigned:
+                for r, v in p.effective_request().items():
+                    used[p.node_name][r] = used[p.node_name].get(r, 0) + v
+                used[p.node_name][PODS] = used[p.node_name].get(PODS, 0) + 1
+            eff_nodes = [
+                Node(
+                    name=n.name,
+                    allocatable={
+                        r: n.allocatable.get(r, 0) - used[n.name].get(r, 0)
+                        for r in set(n.allocatable) | set(used[n.name])
+                    },
+                )
+                for n in nodes
+            ]
+            # scores in the real solver use TRUE allocatable; mimic by
+            # passing raw scores from the original nodes
+            free = {n.name: dict(n.allocatable) for n in eff_nodes}
+            for n in eff_nodes:
+                free[n.name].setdefault(PODS, 0)
+            raw = static_scores(nodes, weights)  # scores use TRUE allocatable
+            order = [n.name for n in eff_nodes]
+            expected = [
+                place_one(free, raw, order, p.effective_request())
+                for p in pending
+            ]
+            snap, meta = cluster.snapshot(pending, now_ms=cycle * 1000)
+            sched.prepare(meta, cluster)
+            result = sched.solve(snap)
+            assignment = np.asarray(result.assignment)
+            got = [
+                meta.node_names[int(a)] if int(a) >= 0 else None
+                for a in assignment[: len(pending)]
+            ]
+            assert got == expected, f"cycle {cycle}: divergence"
+            # apply bindings + random completions
+            for p, node in zip(pending, got):
+                if node is not None:
+                    cluster.bind(p.uid, node)
+            bound = [p for p in cluster.pods.values() if p.node_name]
+            for p in bound:
+                if rng.random() < 0.3:
+                    cluster.remove_pod(p.uid)
 
     def test_most_mode_differential(self):
         weights = {CPU: 1 << 20, MEMORY: 1}
