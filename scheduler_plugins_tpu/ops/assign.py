@@ -1,6 +1,7 @@
 """Placement: turn per-pod feasibility + scores into node assignments.
 
-Two modes:
+Three modes, all returning assignment = (P,) int32 node index (-1 =
+unschedulable):
 
 - `greedy_assign` — bit-faithful to the reference's one-pod-at-a-time cycle:
   a `lax.scan` over the pod queue where each step filters/scores against the
@@ -8,13 +9,15 @@ Two modes:
   (SURVEY.md §7 "sequential semantics"). Tie-break: lowest node index (the
   upstream framework randomizes among equals; we pin determinism instead).
 
-- `wave_assign` — the TPU-throughput mode: scores are computed for the whole
-  batch at once, pods pick their argmax node, conflicts are resolved by queue
-  order within the wave via a much shorter scan over *waves*. Placements can
-  differ from sequential mode when a wave overcommits a node; the caller
-  chooses the trade-off.
+- `waterfill_assign` — the TPU-throughput default: queue-ranked pods spread
+  across score-ordered nodes by estimated per-node capacity per wave, with
+  EXACT queue-order admission; converges in a few waves even when scores tie.
 
-Both return assignment = (P,) int32 node index, -1 for unschedulable.
+- `wave_assign` — the simpler argmax-per-pod wave variant (one node fills
+  per wave under tied scores; kept for comparison and tests).
+
+Wave placements can differ from sequential mode in tie-breaking; hard
+constraints hold in all modes.
 """
 
 from __future__ import annotations

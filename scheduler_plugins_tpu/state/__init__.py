@@ -1,6 +1,7 @@
 """Cluster state: the dense-tensor snapshot consumed by the jitted solver and
 the mutable host-side store that builds/maintains it from cluster events."""
 
+from scheduler_plugins_tpu.state.cluster import Cluster  # noqa: F401
 from scheduler_plugins_tpu.state.snapshot import (  # noqa: F401
     ClusterSnapshot,
     SnapshotMeta,
