@@ -35,16 +35,22 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
     "NodeResourcesAllocatable": {"resources": "resources", "mode": "mode"},
     "TargetLoadPacking": {
         "targetUtilization": "target_utilization_percent",
+        "watcherAddress": "watcher_address",
     },
     "LoadVariationRiskBalancing": {
         "safeVarianceMargin": "safe_variance_margin",
         "safeVarianceSensitivity": "safe_variance_sensitivity",
+        "watcherAddress": "watcher_address",
     },
     "LowRiskOverCommitment": {
         "smoothingWindowSize": "smoothing_window_size",
         "riskLimitWeights": "risk_limit_weights",
+        "watcherAddress": "watcher_address",
     },
-    "Peaks": {"nodePowerModel": "node_power_model"},
+    "Peaks": {
+        "nodePowerModel": "node_power_model",
+        "watcherAddress": "watcher_address",
+    },
     "NodeResourceTopologyMatch": {
         "scoringStrategy": "scoring_strategy",
         "resources": "resources",

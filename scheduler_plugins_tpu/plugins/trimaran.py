@@ -39,10 +39,15 @@ class TargetLoadPacking(Plugin):
 
     name = "TargetLoadPacking"
 
-    def __init__(self, target_utilization_percent: int = 40):
+    def __init__(self, target_utilization_percent: int = 40,
+                 watcher_address: Optional[str] = None):
         if not 0 < target_utilization_percent <= 100:
             raise ValueError("target utilization must be in (0, 100]")
         self.target = float(target_utilization_percent)
+        #: TrimaranSpec WatcherAddress (apis/config/types.go TrimaranSpec):
+        #: when set, the cycle driver polls this load-watcher endpoint on
+        #: the collector cadence and installs the metrics into the store
+        self.watcher_address = watcher_address
 
     def score(self, state, snap, p):
         if snap.metrics is None:
@@ -63,11 +68,14 @@ class LoadVariationRiskBalancing(Plugin):
 
     name = "LoadVariationRiskBalancing"
 
-    def __init__(self, safe_variance_margin: float = 1.0, safe_variance_sensitivity: float = 1.0):
+    def __init__(self, safe_variance_margin: float = 1.0,
+                 safe_variance_sensitivity: float = 1.0,
+                 watcher_address: Optional[str] = None):
         if safe_variance_margin < 0 or safe_variance_sensitivity < 0:
             raise ValueError("margin/sensitivity must be non-negative")
         self.margin = safe_variance_margin
         self.sensitivity = safe_variance_sensitivity
+        self.watcher_address = watcher_address
 
     def score(self, state, snap, p):
         if snap.metrics is None:
@@ -94,8 +102,10 @@ class LowRiskOverCommitment(Plugin):
         self,
         smoothing_window_size: int = 5,
         risk_limit_weights: Optional[Mapping[str, float]] = None,
+        watcher_address: Optional[str] = None,
     ):
         self.smoothing_window = smoothing_window_size
+        self.watcher_address = watcher_address
         weights = dict(risk_limit_weights or {})
         self.w_cpu = weights.get("cpu", 0.5)
         self.w_mem = weights.get("memory", 0.5)
@@ -139,7 +149,9 @@ class Peaks(Plugin):
 
     name = "Peaks"
 
-    def __init__(self, node_power_model: Optional[Mapping[str, tuple]] = None):
+    def __init__(self, node_power_model: Optional[Mapping[str, tuple]] = None,
+                 watcher_address: Optional[str] = None):
+        self.watcher_address = watcher_address
         #: node name -> (K0, K1, K2); missing nodes get (0, 0, 0). When the
         #: args carry no model, the NODE_POWER_MODEL env var names a JSON
         #: file {node: {"K0":..., "K1":..., "K2":...}} (peaks.go:59-74).

@@ -107,3 +107,36 @@ class TestHTTPCollector:
         collector = LoadWatcherCollector("http://127.0.0.1:1")  # closed port
         assert collector.refresh(cluster) == {"n": {"cpu_avg": 5.0}}
         assert cluster.node_metrics == {"n": {"cpu_avg": 5.0}}
+
+
+class TestCycleIntegration:
+    def test_watcher_address_arg_drives_cycle_refresh(self):
+        server, addr = serve()
+        try:
+            cluster = Cluster()
+            for name in ("hot", "cold"):
+                cluster.add_node(
+                    Node(name=name,
+                         allocatable={CPU_RES: 10_000, MEMORY: 32 * gib, PODS: 110})
+                )
+            sched = Scheduler(
+                Profile(plugins=[TargetLoadPacking(watcher_address=addr)])
+            )
+            run_cycle(sched, cluster, now=1_000)  # kicks off the async fetch
+            sched._collectors[addr]["thread"].join(timeout=5)
+            # metrics install on the next cycle and steer placement
+            cluster.add_pod(
+                Pod(name="p", containers=[Container(requests={CPU_RES: 1000})])
+            )
+            report = run_cycle(sched, cluster, now=2_000)
+            assert cluster.node_metrics["hot"]["cpu_avg"] == 70.0
+            assert report.bound["default/p"] == "cold"
+            # within the 30s cadence no new fetch is scheduled
+            stamp = sched._collectors[addr]["last_ms"]
+            run_cycle(sched, cluster, now=10_000)
+            assert sched._collectors[addr]["last_ms"] == stamp
+            # past the cadence it schedules another fetch
+            run_cycle(sched, cluster, now=40_000)
+            assert sched._collectors[addr]["last_ms"] == 40_000
+        finally:
+            server.shutdown()
