@@ -182,62 +182,22 @@ def _run_preemption(scheduler, cluster, pending, report, now):
 
 
 def _refresh_metrics(scheduler, cluster: Cluster, now: int):
-    """The collector pull loop (collector.go:89-97): every distinct
-    WatcherAddress configured by a trimaran plugin is polled on the 30s
-    cadence, each in a background thread so a slow or dead watcher never
-    blocks the scheduling cycle (the reference polls in its own goroutine
-    for the same reason). Completed fetches merge into the store per node;
-    failures keep the previous metrics."""
-    import threading
+    """The collector pull loop: every distinct WatcherAddress configured by
+    a trimaran plugin gets an async collector (cached on the scheduler)
+    ticked once per cycle — see state.collector.AsyncLoadWatcherCollector
+    for the cadence/threading/install semantics."""
+    from scheduler_plugins_tpu.state.collector import AsyncLoadWatcherCollector
 
-    from scheduler_plugins_tpu.state.collector import (
-        DEFAULT_REFRESH_SECONDS,
-        LoadWatcherCollector,
-    )
-
-    addresses = []
+    collectors = getattr(scheduler, "_collectors", None)
     for plugin in scheduler.profile.plugins:
         address = getattr(plugin, "watcher_address", None)
-        if address and address not in addresses:
-            addresses.append(address)
-    if not addresses:
-        return
-    collectors = getattr(scheduler, "_collectors", None)
-    if collectors is None:
-        collectors = scheduler._collectors = {}
-    for address in addresses:
-        entry = collectors.get(address)
-        if entry is None:
-            entry = collectors[address] = {
-                "collector": LoadWatcherCollector(address),
-                "last_ms": None,
-                "latest": None,
-                "thread": None,
-            }
-        # install the most recent completed fetch (non-blocking)
-        latest = entry["latest"]
-        if latest is not None:
-            merged = dict(cluster.node_metrics or {})
-            merged.update(latest)
-            cluster.node_metrics = merged
-            entry["latest"] = None
-        due = (
-            entry["last_ms"] is None
-            or now - entry["last_ms"] >= DEFAULT_REFRESH_SECONDS * 1000
-        )
-        in_flight = entry["thread"] is not None and entry["thread"].is_alive()
-        if not due or in_flight:
+        if not address:
             continue
-        entry["last_ms"] = now
-
-        def fetch(entry=entry):
-            try:
-                entry["latest"] = entry["collector"].fetch()
-            except Exception:
-                pass  # keep previous metrics (reference cache behavior)
-
-        entry["thread"] = threading.Thread(target=fetch, daemon=True)
-        entry["thread"].start()
+        if collectors is None:
+            collectors = scheduler._collectors = {}
+        if address not in collectors:
+            collectors[address] = AsyncLoadWatcherCollector(address)
+        collectors[address].tick(cluster, now)
 
 
 def _resync_nrt_cache(cluster: Cluster):

@@ -70,6 +70,55 @@ def parse_watcher_metrics(payload: dict) -> dict[str, dict]:
     return out
 
 
+class AsyncLoadWatcherCollector:
+    """Cadence-owning collector: polls in a background thread so a slow or
+    dead watcher never blocks the scheduling cycle (the reference polls in
+    its own goroutine, collector.go:89-97). Completed fetches REPLACE this
+    source's previous contribution in the store — nodes the watcher stopped
+    reporting are evicted (falling back to the neutral no-metrics path), and
+    other sources' nodes are untouched. Failures keep the previous data."""
+
+    def __init__(self, watcher_address: str,
+                 refresh_seconds: int = DEFAULT_REFRESH_SECONDS):
+        self.collector = LoadWatcherCollector(watcher_address)
+        self.refresh_ms = refresh_seconds * 1000
+        self.last_ms: Optional[int] = None
+        self.latest: Optional[dict] = None
+        self.my_nodes: set[str] = set()
+        self.thread = None
+
+    def tick(self, cluster, now_ms: int) -> None:
+        """Install any completed fetch; start a new one when the cadence is
+        due and none is in flight. Never blocks."""
+        import threading
+
+        latest = self.latest
+        if latest is not None:
+            current = cluster.node_metrics or {}
+            merged = {
+                node: m for node, m in current.items()
+                if node not in self.my_nodes or node in latest
+            }
+            merged.update(latest)
+            cluster.node_metrics = merged
+            self.my_nodes = set(latest)
+            self.latest = None
+        due = self.last_ms is None or now_ms - self.last_ms >= self.refresh_ms
+        in_flight = self.thread is not None and self.thread.is_alive()
+        if not due or in_flight:
+            return
+        self.last_ms = now_ms
+
+        def fetch():
+            try:
+                self.latest = self.collector.fetch()
+            except Exception:
+                pass  # keep previous metrics (reference cache behavior)
+
+        self.thread = threading.Thread(target=fetch, daemon=True)
+        self.thread.start()
+
+
 class LoadWatcherCollector:
     """HTTP client against a load-watcher service (`WatcherAddress` arg,
     apis/config TrimaranSpec)."""

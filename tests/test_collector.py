@@ -123,7 +123,7 @@ class TestCycleIntegration:
                 Profile(plugins=[TargetLoadPacking(watcher_address=addr)])
             )
             run_cycle(sched, cluster, now=1_000)  # kicks off the async fetch
-            sched._collectors[addr]["thread"].join(timeout=5)
+            sched._collectors[addr].thread.join(timeout=5)
             # metrics install on the next cycle and steer placement
             cluster.add_pod(
                 Pod(name="p", containers=[Container(requests={CPU_RES: 1000})])
@@ -132,11 +132,30 @@ class TestCycleIntegration:
             assert cluster.node_metrics["hot"]["cpu_avg"] == 70.0
             assert report.bound["default/p"] == "cold"
             # within the 30s cadence no new fetch is scheduled
-            stamp = sched._collectors[addr]["last_ms"]
+            stamp = sched._collectors[addr].last_ms
             run_cycle(sched, cluster, now=10_000)
-            assert sched._collectors[addr]["last_ms"] == stamp
+            assert sched._collectors[addr].last_ms == stamp
             # past the cadence it schedules another fetch
             run_cycle(sched, cluster, now=40_000)
-            assert sched._collectors[addr]["last_ms"] == 40_000
+            assert sched._collectors[addr].last_ms == 40_000
         finally:
             server.shutdown()
+
+
+class TestAsyncCollector:
+    def test_source_eviction_on_replacement(self):
+        from scheduler_plugins_tpu.state.collector import AsyncLoadWatcherCollector
+
+        cluster = Cluster()
+        cluster.node_metrics = {"other": {"cpu_avg": 1.0}}
+        col = AsyncLoadWatcherCollector("http://unused:1")
+        # simulate a completed fetch covering n1+n2
+        col.latest = {"n1": {"cpu_avg": 50.0}, "n2": {"cpu_avg": 60.0}}
+        col.last_ms = 0
+        col.tick(cluster, now_ms=1)
+        assert set(cluster.node_metrics) == {"other", "n1", "n2"}
+        # next fetch drops n2: it must be EVICTED, foreign "other" untouched
+        col.latest = {"n1": {"cpu_avg": 55.0}}
+        col.tick(cluster, now_ms=2)
+        assert set(cluster.node_metrics) == {"other", "n1"}
+        assert cluster.node_metrics["n1"]["cpu_avg"] == 55.0

@@ -1,0 +1,194 @@
+"""Full-stack integration churn: every subsystem active in one loop —
+allocatable + trimaran scoring, NUMA topology with the over-reserve cache,
+gangs, elastic quota, network-aware constraints, preemption, controllers —
+with cross-cutting invariants each cycle."""
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.config import load_profile
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    ElasticQuota,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    PodGroup,
+    PodPhase,
+    APP_GROUP_LABEL,
+    POD_GROUP_LABEL,
+    REGION_LABEL,
+    TopologyManagerPolicy,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.controllers import (
+    reconcile_elastic_quotas,
+    reconcile_pod_groups,
+)
+from scheduler_plugins_tpu.framework import Scheduler, run_cycle
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.state.nrt_cache import OverReserveCache
+
+gib = 1 << 30
+
+
+def build_cluster():
+    cluster = Cluster()
+    cluster.nrt_cache = OverReserveCache()
+    for i in range(6):
+        name = f"n{i}"
+        cluster.add_node(
+            Node(
+                name=name,
+                allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 40},
+                labels={
+                    REGION_LABEL: f"r{i % 2}",
+                    ZONE_LABEL: f"z{i % 3}",
+                },
+            )
+        )
+        cluster.add_nrt(
+            NodeResourceTopology(
+                node_name=name,
+                policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+                zones=[
+                    NUMAZone(numa_id=z, available={CPU: 8000, MEMORY: 32 * gib})
+                    for z in range(2)
+                ],
+            )
+        )
+    cluster.add_quota(
+        ElasticQuota(
+            name="eq", namespace="team",
+            min={CPU: 48_000, MEMORY: 192 * gib},
+            max={CPU: 80_000, MEMORY: 320 * gib},
+        )
+    )
+    cluster.add_app_group(
+        AppGroup(
+            name="svc", namespace="team",
+            workloads=[
+                AppGroupWorkload(selector="db"),
+                AppGroupWorkload(
+                    selector="api",
+                    dependencies=[AppGroupDependency("db", max_network_cost=10)],
+                ),
+            ],
+            topology_order={"db": 1, "api": 2},
+        )
+    )
+    cluster.add_network_topology(
+        NetworkTopology(weights={"UserDefined": {
+            "zone": {(f"z{a}", f"z{b}"): 5 for a in range(3) for b in range(3) if a != b},
+            "region": {("r0", "r1"): 40, ("r1", "r0"): 40},
+        }})
+    )
+    cluster.node_metrics = {
+        f"n{i}": {"cpu_avg": 10.0 + 10 * i, "cpu_std": 2.0, "mem_avg": 20.0}
+        for i in range(6)
+    }
+    return cluster
+
+
+FULL_PROFILE = [
+    "NodeResourcesAllocatable", "TargetLoadPacking",
+    "LoadVariationRiskBalancing", "NodeResourceTopologyMatch",
+    "NetworkOverhead", "Coscheduling", "CapacityScheduling", "PodState",
+]
+
+
+def check_invariants(cluster):
+    used = {n: {} for n in cluster.nodes}
+    for pod in cluster.pods.values():
+        if pod.node_name is None:
+            continue
+        bucket = used[pod.node_name]
+        for r, q in pod.effective_request().items():
+            bucket[r] = bucket.get(r, 0) + q
+        bucket[PODS] = bucket.get(PODS, 0) + 1
+    for name, node in cluster.nodes.items():
+        for r, q in used[name].items():
+            assert q <= node.allocatable.get(r, 0), (name, r)
+    for pg in cluster.pod_groups.values():
+        bound = sum(1 for p in cluster.gang_members(pg) if p.node_name is not None)
+        assert bound == 0 or bound >= pg.min_member, (pg.full_name, bound)
+    for eq in cluster.quotas.values():
+        total = {}
+        for pod in cluster.pods.values():
+            if pod.namespace == eq.namespace and pod.node_name is not None:
+                for r, q in pod.effective_request().items():
+                    total[r] = total.get(r, 0) + q
+        for r, cap in eq.max.items():
+            assert total.get(r, 0) <= cap, (eq.namespace, r)
+
+
+class TestFullStack:
+    def test_twenty_cycles_all_subsystems(self):
+        rng = np.random.default_rng(11)
+        cluster = build_cluster()
+        scheduler = Scheduler(load_profile({"plugins": FULL_PROFILE}))
+        serial = 0
+        for cycle in range(20):
+            now = 1000 * (cycle + 1)
+            # microservice pairs (network-aware), guaranteed NUMA pods,
+            # plain burstable pods, occasional gangs
+            for _ in range(int(rng.integers(0, 3))):
+                serial += 1
+                kind = rng.integers(0, 3)
+                if kind == 0:  # db+api pair
+                    for wl in ("db", "api"):
+                        serial += 1
+                        cluster.add_pod(Pod(
+                            name=f"{wl}-{serial}", namespace="team",
+                            creation_ms=now + serial,
+                            labels={APP_GROUP_LABEL: "svc",
+                                    WORKLOAD_SELECTOR_LABEL: wl},
+                            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+                        ))
+                elif kind == 1:  # guaranteed NUMA pod
+                    cluster.add_pod(Pod(
+                        name=f"g-{serial}", namespace="team", creation_ms=now + serial,
+                        containers=[Container(
+                            requests={CPU: 3000, MEMORY: 4 * gib},
+                            limits={CPU: 3000, MEMORY: 4 * gib})],
+                    ))
+                else:  # burstable
+                    cluster.add_pod(Pod(
+                        name=f"b-{serial}", namespace="team", creation_ms=now + serial,
+                        priority=int(rng.integers(0, 5)),
+                        containers=[Container(requests={
+                            CPU: int(rng.integers(200, 2500)),
+                            MEMORY: int(rng.integers(1, 6)) * gib})],
+                    ))
+            if cycle % 6 == 3:
+                gname = f"ring{cycle}"
+                cluster.add_pod_group(PodGroup(
+                    name=gname, namespace="team", min_member=3, creation_ms=now))
+                for m in range(3):
+                    serial += 1
+                    cluster.add_pod(Pod(
+                        name=f"{gname}-{m}", namespace="team",
+                        creation_ms=now + serial,
+                        labels={POD_GROUP_LABEL: gname},
+                        containers=[Container(requests={CPU: 1000, MEMORY: 2 * gib})],
+                    ))
+            # completions (plain pods only; gang lifecycle covered elsewhere)
+            for pod in [p for p in cluster.pods.values()
+                        if p.node_name and not p.pod_group()]:
+                if rng.random() < 0.1:
+                    cluster.remove_pod(pod.uid)
+            run_cycle(scheduler, cluster, now=now)
+            for pod in cluster.pods.values():
+                if pod.node_name is not None and pod.phase == PodPhase.PENDING:
+                    pod.phase = PodPhase.RUNNING
+            reconcile_pod_groups(cluster, now_ms=now)
+            reconcile_elastic_quotas(cluster)
+            check_invariants(cluster)
+        # something actually scheduled through the full stack
+        assert sum(1 for p in cluster.pods.values() if p.node_name) > 0
