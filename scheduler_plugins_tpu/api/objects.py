@@ -92,6 +92,17 @@ class Pod:
     scheduling_gated: bool = False
     #: PriorityClass name, consumed by PreemptionToleration policy lookup.
     priority_class_name: str = ""
+    #: memoized derived quantities — a pod's container spec is immutable
+    #: after creation (k8s semantics), and the snapshot builder re-derives
+    #: these for every pod on every cycle. init=False keeps the cache out of
+    #: constructors and dataclasses.replace (a spec change must not smuggle
+    #: a stale cache).
+    _req_cache: Optional[dict] = field(
+        default=None, init=False, repr=False, compare=False
+    )
+    _lim_cache: Optional[dict] = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def __post_init__(self):
         if not self.uid:
@@ -119,6 +130,10 @@ class Pod:
         (GetPodEffectiveRequest; init containers are a plain per-resource max,
         with no sidecar special-casing).
         """
+        if self._req_cache is not None:
+            # fresh copy per call: callers may hold or mutate their result
+            # (the NRT cache stores these long-term)
+            return dict(self._req_cache)
         resources: dict[str, int] = {}
         for c in self.containers:
             resources = add_quantities(resources, c.requests)
@@ -128,19 +143,23 @@ class Pod:
             init_max = max_quantities(init_max, ic.requests)
         resources = max_quantities(resources, init_max)
 
-        return add_quantities(resources, self.overhead)
+        self._req_cache = add_quantities(resources, self.overhead)
+        return dict(self._req_cache)
 
     def effective_limits(self) -> dict[str, int]:
         """Trimaran-style effective limits: per resource, sum of app
         containers, then max against each init container individually, plus
         overhead (/root/reference/pkg/trimaran/resourcestats.go:121-145
         GetEffectiveResource over container limits)."""
+        if self._lim_cache is not None:
+            return dict(self._lim_cache)
         resources: dict[str, int] = {}
         for c in self.containers:
             resources = add_quantities(resources, c.limits)
         for ic in self.init_containers:
             resources = max_quantities(resources, ic.limits)
-        return add_quantities(resources, self.overhead)
+        self._lim_cache = add_quantities(resources, self.overhead)
+        return dict(self._lim_cache)
 
     def tlp_predicted_cpu_millis(
         self, multiplier: float = 1.5, default_millis: int = 1000
