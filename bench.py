@@ -15,9 +15,50 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import argparse
 import json
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def apply_platform_override():
+    """The environment's sitecustomize pins `jax_platforms` via config, which
+    beats env vars; re-apply an explicit JAX_PLATFORMS so `JAX_PLATFORMS=cpu
+    python bench.py` behaves as JAX normally would."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def backend_probe(timeout=90):
+    """CLAUDE.md tunnel probe: an 8x8 matmul must round-trip through a host
+    transfer before anything else runs. In a subprocess so a dead axon tunnel
+    (which blocks forever at 0% CPU) cannot hang the bench itself; returns
+    None when healthy, else a short diagnosis string."""
+    # self-contained (no `import bench`: the subprocess inherits the caller's
+    # cwd, which need not be the repo root)
+    code = (
+        "import os, jax;"
+        "p = os.environ.get('JAX_PLATFORMS');"
+        "p and jax.config.update('jax_platforms', p);"
+        "import numpy as np, jax.numpy as jnp;"
+        "np.asarray(jnp.ones((8,8)) @ jnp.ones((8,8)))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"tpu-backend-timeout ({timeout}s)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return "tpu-backend-error: " + (tail[-1][:160] if tail else "unknown")
+    return None
 
 
 def python_baseline_pods_per_sec(cluster, sample=200):
@@ -270,6 +311,21 @@ if __name__ == "__main__":
                         default="sequential",
                         help="configs 2-5: bit-faithful scan or batched waves")
     args = parser.parse_args()
+    apply_platform_override()
+    diagnosis = backend_probe()
+    if diagnosis is not None:
+        # one parseable line, rc=0 — the environment is sick, not the code
+        metric = {
+            1: "pods_scheduled_per_sec", 2: "trimaran_pods_per_sec",
+            3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
+            5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
+        }.get(args.config, "pods_scheduled_per_sec")
+        print(json.dumps({
+            "metric": metric, "value": 0, "unit": "pods/s",
+            "vs_baseline": 0.0, "error": "tpu-backend-unavailable",
+            "detail": diagnosis,
+        }))
+        sys.exit(0)
     if args.config == 1:
         main()
     elif args.config == 6:
