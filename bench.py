@@ -320,6 +320,8 @@ if __name__ == "__main__":
             3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
             5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
         }.get(args.config, "pods_scheduled_per_sec")
+        if args.config in (2, 3, 4, 5) and args.mode == "batch":
+            metric = metric.replace("_pods_per_sec", "_batch_pods_per_sec")
         print(json.dumps({
             "metric": metric, "value": 0, "unit": "pods/s",
             "vs_baseline": 0.0, "error": "tpu-backend-unavailable",

@@ -251,10 +251,15 @@ def least_numa_required(avail, reported, zone_mask, distances, guaranteed,
     )  # (Z,)
     valid = jnp.all(~masks | (zone_reports_all & zone_mask)[None, :], axis=1)
 
-    combined = masks.astype(jnp.int64) @ jnp.where(
-        reported, avail, 0
-    )  # (S, R) summed availability
-    suitable = (~guaranteed & affine[None, :]) | (combined >= req[None, :])
+    # (S, R) summed availability via float64 matmul — exact below 2^53
+    # (≤ 64 zones of byte quantities stays well under); int64 dot_general is
+    # unsupported on TPU, and an (S, Z, R) masked-sum temporary would blow up
+    # vmem under the per-(pod, node) vmap
+    avail_reported = jnp.where(reported, avail, 0).astype(jnp.float64)
+    combined = masks.astype(jnp.float64) @ avail_reported
+    suitable = (~guaranteed & affine[None, :]) | (
+        combined >= req[None, :].astype(jnp.float64)
+    )
     fits = valid & jnp.all(jnp.where(relevant[None, :], suitable, True), axis=1)
 
     dist = _subset_distances(distances, masks, sizes)  # (S,)

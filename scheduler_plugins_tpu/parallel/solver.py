@@ -104,47 +104,30 @@ def batch_admission(snap, free, eq_used=None):
 
 
 def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
-    """(P,) queue-order quota admission: pod admitted iff its namespace's
-    usage + the requests of earlier admitted pods of ALL namespaces stays
-    within Max (own) and aggregate Min (cluster pool) — the batched analog of
-    quota_commit threading through the sequential scan."""
+    """(P,) queue-order quota admission, exact: a `lax.scan` threads admitted
+    usage through the batch in queue order, so a pod is charged against Max
+    (own namespace) and the aggregate-Min pool only if it was itself admitted
+    — identical semantics to `quota_commit` threading through the sequential
+    scan (no over-approximation from rejected pods' requests)."""
     quota = snap.quota
-    P = snap.num_pods
-    Q = quota.used.shape[0]
-    ns = snap.pods.ns
-    req = snap.pods.req.astype(jnp.float64)
-    active = assignment_order_ok
-    ns_onehot = (ns[:, None] == jnp.arange(Q)[None, :]) & active[:, None]
+    agg_min = jnp.sum(jnp.where(quota.has_quota[:, None], quota.min, 0), axis=0)
+    agg_used0 = jnp.sum(jnp.where(quota.has_quota[:, None], eq_used, 0), axis=0)
 
-    # per-namespace exclusive prefix of requests (float64 exact < 2^53)
-    used0 = eq_used.astype(jnp.float64)
-    ok = jnp.ones(P, bool)
-    agg_min = jnp.sum(
-        jnp.where(quota.has_quota[:, None], quota.min, 0), axis=0
-    ).astype(jnp.float64)
-    agg_used0 = jnp.sum(
-        jnp.where(quota.has_quota[:, None], eq_used, 0), axis=0
-    ).astype(jnp.float64)
-    for r in range(req.shape[1]):
-        contrib = ns_onehot * req[:, r][:, None]  # (P, Q)
-        prefix = jnp.cumsum(contrib, axis=0) - contrib  # exclusive
-        own_total = used0[:, r][None, :] + prefix + contrib
-        own_ok = jnp.take_along_axis(
-            own_total <= quota.max[:, r].astype(jnp.float64)[None, :],
-            ns[:, None],
-            axis=1,
-        ).squeeze(1)
-        # aggregate pool: all earlier admitted quota'd pods count
-        in_quota = jnp.take_along_axis(
-            quota.has_quota[None, :].repeat(P, 0), ns[:, None], axis=1
-        ).squeeze(1) & active
-        agg_contrib = jnp.where(in_quota, req[:, r], 0.0)
-        agg_prefix = jnp.cumsum(agg_contrib) - agg_contrib
-        agg_ok = agg_used0[r] + agg_prefix + agg_contrib <= agg_min[r]
-        has_q = jnp.take_along_axis(
-            quota.has_quota[None, :].repeat(P, 0), ns[:, None], axis=1
-        ).squeeze(1)
-        ok &= ~has_q | (own_ok & agg_ok)
+    def step(carry, x):
+        used, agg_used = carry
+        ns_p, req_p, active = x
+        has_q = quota.has_quota[ns_p]
+        own_ok = jnp.all(used[ns_p] + req_p <= quota.max[ns_p])
+        agg_ok = jnp.all(agg_used + req_p <= agg_min)
+        ok = ~has_q | (own_ok & agg_ok)
+        add = jnp.where(active & has_q & ok, req_p, 0)
+        return (used.at[ns_p].add(add), agg_used + add), ok
+
+    (_, _), ok = jax.lax.scan(
+        step,
+        (eq_used, agg_used0),
+        (snap.pods.ns, snap.pods.req, assignment_order_ok),
+    )
     return ok
 
 

@@ -145,6 +145,10 @@ class MetricsState:
     mem_avg: np.ndarray  # (N,) float64 %
     mem_std: np.ndarray  # (N,) float64 %
     cpu_valid: np.ndarray  # (N,) bool
+    #: (N,) whether an Average/Latest CPU metric was actually seen — TLP
+    #: requires one (targetloadpacking.go:130-146) and must not score a
+    #: std-only node from a defaulted 0/avg value
+    cpu_tlp_valid: np.ndarray
     mem_valid: np.ndarray  # (N,) bool
     #: predicted-but-unreported CPU millis per node (ScheduledPodsCache
     #: compensation, /root/reference/pkg/trimaran/handler.go:47-171)
@@ -361,14 +365,23 @@ def build_snapshot(
         if node.zone:
             zone[i] = zones_in.code(node.zone)
 
+    # nominated counter (PodState score, pod_state.go:56): every unbound pod
+    # with a nomination counts, wherever it lives — upstream's nominator keeps
+    # a popped pod's own nomination until assume, so the batch is included
+    seen_nominated: set = set()
+    for pod in list(pending_pods) + list(assigned_pods) + list(extra_pods):
+        if (
+            pod.node_name is None
+            and pod.nominated_node_name in node_pos
+            and pod.uid not in seen_nominated
+        ):
+            seen_nominated.add(pod.uid)
+            nominated[node_pos[pod.nominated_node_name]] += 1
+
     for pod in assigned_pods:
-        target = pod.nominated_node_name if pod.node_name is None else pod.node_name
-        if target is None or target not in node_pos:
+        if pod.node_name is None or pod.node_name not in node_pos:
             continue
-        i = node_pos[target]
-        if pod.node_name is None:
-            nominated[i] += 1
-            continue
+        i = node_pos[pod.node_name]
         req = index.encode(pod.effective_request())
         requested[i] += req
         nonzero_req[i] += nonzero_request(req, index)
@@ -591,6 +604,7 @@ def build_snapshot(
         mem_avg = np.zeros(N, F64)
         mem_std = np.zeros(N, F64)
         cpu_valid = np.zeros(N, bool)
+        cpu_tlp_valid = np.zeros(N, bool)
         mem_valid = np.zeros(N, bool)
         missing = np.zeros(N, I64)
         for name, m in node_metrics.items():
@@ -605,6 +619,7 @@ def build_snapshot(
             # GetResourceData returns isValid=true, avg=0 for std-only
             # (resourcestats.go:88-106)
             cpu_valid[i] = "cpu_avg" in m or "cpu_std" in m
+            cpu_tlp_valid[i] = "cpu_tlp" in m or "cpu_avg" in m
             if "mem_avg" in m:
                 mem_avg[i] = m["mem_avg"]
             mem_valid[i] = "mem_avg" in m or "mem_std" in m
@@ -617,6 +632,7 @@ def build_snapshot(
             mem_avg=mem_avg,
             mem_std=mem_std,
             cpu_valid=cpu_valid,
+            cpu_tlp_valid=cpu_tlp_valid,
             mem_valid=mem_valid,
             missing_cpu_millis=missing,
         )

@@ -139,6 +139,34 @@ class TestSnapshot:
         # pods-slot carries the count
         assert snap.nodes.requested[0, meta.index.position(PODS)] == 2
 
+    def test_nominated_counted_from_any_pod_list(self):
+        # pod_state.go:56 NominatedPodsForNode: every unbound nominated pod
+        # counts, including pods in the pending batch (upstream's nominator
+        # keeps a popped pod's nomination until assume); dedup by uid
+        nodes = [Node(name="n0", allocatable={CPU: 4000}),
+                 Node(name="n1", allocatable={CPU: 4000})]
+        batch_nom = mkpod("b0", cpu=10, nominated_node_name="n0")
+        other_nom = mkpod("x0", cpu=10, nominated_node_name="n0")
+        bound = mkpod("a0", cpu=10, node="n1", nominated_node_name="n1")
+        snap, meta = build_snapshot(
+            nodes, [batch_nom], assigned_pods=[other_nom, bound],
+            extra_pods=[batch_nom],  # duplicate listing must not double count
+        )
+        assert snap.nodes.nominated[0] == 2  # b0 + x0
+        assert snap.nodes.nominated[1] == 0  # bound pod's stale nomination ignored
+
+    def test_tlp_validity_requires_average_or_latest(self):
+        nodes = [Node(name="n0", allocatable={CPU: 4000}),
+                 Node(name="n1", allocatable={CPU: 4000})]
+        snap, _ = build_snapshot(
+            nodes, [mkpod("p0", cpu=1)],
+            node_metrics={"n0": {"cpu_std": 5.0}, "n1": {"cpu_avg": 30.0}},
+        )
+        # std-only node: usable for LVRB (cpu_valid) but NOT for TLP
+        # (targetloadpacking.go:130-146 needs an Average/Latest sample)
+        assert snap.metrics.cpu_valid[0] and not snap.metrics.cpu_tlp_valid[0]
+        assert snap.metrics.cpu_valid[1] and snap.metrics.cpu_tlp_valid[1]
+
     def test_gang_membership_counts(self):
         from scheduler_plugins_tpu.api.objects import POD_GROUP_LABEL
 

@@ -91,6 +91,25 @@ class TestBatchSolve:
                 used[n, 3] += 1
         assert (used <= alloc).all()
 
+    def test_quota_prefix_is_exact_not_conservative(self):
+        # p0 (30) admits, p1 (30) busts Max=50 and is evicted by the prefix
+        # check, p2 (20) must then STILL admit (30+20=50): a rejected pod's
+        # request no longer counts against later pods
+        from scheduler_plugins_tpu.api.objects import ElasticQuota
+
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={CPU: 100_000, MEMORY: 100 * gib, PODS: 100}))
+        c.add_quota(ElasticQuota(name="eq", namespace="team",
+                                 min={CPU: 50_000}, max={CPU: 50_000}))
+        for j, millis in enumerate([30_000, 30_000, 20_000]):
+            c.add_pod(Pod(name=f"p{j}", namespace="team", creation_ms=j,
+                          containers=[Container(requests={CPU: millis})]))
+        snap, meta = c.snapshot(sorted(c.pending_pods(), key=lambda p: p.creation_ms))
+        weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+        assignment, _, _ = solve(snap, weights)
+        an = np.asarray(assignment)[:3]
+        assert an[0] >= 0 and an[2] >= 0 and an[1] == -1, an.tolist()
+
     def test_sharded_matches_single_device(self):
         c = Cluster()
         for i in range(8):

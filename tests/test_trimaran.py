@@ -35,6 +35,7 @@ def metrics_state(cpu_avg, cpu_std=None, mem_avg=None, mem_std=None):
         mem_avg=np.array(mem_avg, float) if mem_avg else zeros,
         mem_std=np.array(mem_std, float) if mem_std else zeros,
         cpu_valid=np.ones(n, bool),
+        cpu_tlp_valid=np.ones(n, bool),
         mem_valid=np.array([mem_avg is not None] * n),
         missing_cpu_millis=np.zeros(n, np.int64),
     )
