@@ -49,7 +49,12 @@ class SolverState:
     #: placements must be visible to later pods' network tallies
     net_placed: Optional[jnp.ndarray] = None
     #: (N, Z, R) live NUMA zone availability with in-cycle placements
-    #: pessimistically deducted from every zone of the chosen node
+    #: pessimistically deducted from every reported zone of the chosen node
+    #: (cache/store.go:129-160). Carried as FLOAT64 — exact for integer
+    #: quantities below 2^53 — so the scan body's feasibility compares and
+    #: score divisions run entirely in f64 with no per-step int64
+    #: temporaries or conversions (integer division is the slow path on
+    #: both backends)
     numa_avail: Optional[jnp.ndarray] = None
     #: (P,) which batch pods have placed so far in this scan — nominee
     #: aggregates drop a nominee the moment it places (upstream removes
@@ -81,6 +86,18 @@ class Plugin:
         """Called inside the traced solve with this plugin's aux pytree (as
         tracers); tensor methods read `self._aux`."""
         self._aux = aux
+
+    def prepare_solve(self, snap: ClusterSnapshot):
+        """Called once inside the traced solve, BEFORE the per-pod scan:
+        derive loop-invariant tensors from the snapshot (dtype conversions,
+        static masks) so they are computed once instead of per scan step.
+        Return a pytree (read back via `self._presolve`) or None."""
+        return None
+
+    def bind_presolve(self, ctx) -> None:
+        """Called inside the traced solve with this plugin's prepare_solve
+        result; tensor methods read `self._presolve`."""
+        self._presolve = ctx
 
     def static_key(self):
         """Hashable fingerprint of any PYTHON-LEVEL specialization this

@@ -176,6 +176,9 @@ class Scheduler:
             # matrices) so they are solve ARGUMENTS, not baked constants
             for plugin, aux in zip(plugins, auxes):
                 plugin.bind_aux(aux)
+            # loop-invariant per-solve precomputes (hoisted out of the scan)
+            for plugin in plugins:
+                plugin.bind_presolve(plugin.prepare_solve(snap))
             P = snap.num_pods
             # unrolling amortizes per-step loop overhead on TPU (~+20%
             # throughput); the body stays strictly one-pod-at-a-time
@@ -232,6 +235,8 @@ class Scheduler:
             def verdicts(snap, state0, auxes, p):
                 for plugin, aux in zip(plugins, auxes):
                     plugin.bind_aux(aux)
+                for plugin in plugins:
+                    plugin.bind_presolve(plugin.prepare_solve(snap))
                 feasible = jnp.ones(snap.num_nodes, bool)
                 for plugin in plugins:
                     mask = plugin.filter(state0, snap, p)
@@ -257,7 +262,12 @@ class Scheduler:
         net_placed = (
             snap.network.placed_node if snap.network is not None else None
         )
-        numa_avail = snap.numa.available if snap.numa is not None else None
+        if snap.numa is not None:
+            from scheduler_plugins_tpu.ops.numa import live_avail_init
+
+            numa_avail = live_avail_init(snap.numa)
+        else:
+            numa_avail = None
         placed_mask = (
             jnp.zeros(snap.num_pods, bool) if snap.quota is not None else None
         )

@@ -27,6 +27,7 @@ from __future__ import annotations
 import itertools
 from functools import lru_cache
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,7 +37,7 @@ from scheduler_plugins_tpu.api.resources import (
     MEMORY,
     ResourceIndex,
 )
-from scheduler_plugins_tpu.utils.intmath import go_div
+from scheduler_plugins_tpu.utils.intmath import floordiv_exact
 
 MAX_NODE_SCORE = 100
 MAX_DISTANCE = 255.0  # least_numa.go:32
@@ -67,6 +68,26 @@ def host_level_mask(index: ResourceIndex) -> np.ndarray:
     return out
 
 
+def live_avail_init(numa):
+    """Initial live zone availability for the solver carry: scaled float32
+    when the snapshot's pack guard holds (values * 100 exact in f32,
+    placements scale-invariant), else float64 (exact < 2^53). Quantities and
+    requests must go through `scale_qty` with the same scales."""
+    if numa.pack_scales is not None:
+        s = jnp.asarray(numa.pack_scales, jnp.int64)
+        return (numa.available // s[None, None, :]).astype(jnp.float32)
+    return numa.available.astype(jnp.float64)
+
+
+def scale_qty(numa, vec):
+    """Request vector in the solver's NUMA quantity domain (see
+    `live_avail_init`); broadcasting over the trailing resource axis."""
+    if numa.pack_scales is None:
+        return vec
+    s = jnp.asarray(numa.pack_scales, vec.dtype)
+    return (vec // s).astype(jnp.float32)
+
+
 @lru_cache(maxsize=16)
 def subset_masks(Z: int):
     """All non-empty zone subsets ordered by (size, lexicographic) — the
@@ -87,21 +108,19 @@ def subset_masks(Z: int):
 # ---------------------------------------------------------------------------
 
 
-def feasible_zones(avail, reported, zone_mask, node_alloc, guaranteed, req,
-                   affine, host_level):
-    """(Z,) feasible-zone mask + scalar ok for one request on one node.
-
-    Mirrors resourcesAvailableInAnyNUMANodes: zero-qty resources ignored;
-    node-level absence is an early reject; a resource reported by no zone
-    passes only if host-level; non-guaranteed pods skip the quantity check
-    for NUMA-affine resources.
-    """
+def feasible_zones_from_suitable(suitable_qty, reported, zone_mask,
+                                 node_alloc, guaranteed, req, affine,
+                                 host_level):
+    """`feasible_zones` with the quantity check precomputed: `suitable_qty`
+    is (Z, R) `live_avail >= req` — callers in the sequential scan compute it
+    as one fused `avail0 >= req + deduct` compare over all nodes instead of
+    materializing the live availability tensor per step."""
     relevant = req > 0  # (R,) — zero-qty requests are ignored (filter.go:100-104)
     present = node_alloc > 0
     early_reject = jnp.any(relevant & ~present)
 
     reported_z = reported & zone_mask[:, None]  # (Z, R)
-    suitable = (~guaranteed & affine[None, :]) | (avail >= req[None, :])
+    suitable = (~guaranteed & affine[None, :]) | suitable_qty
     per_resource = reported_z & suitable  # (Z, R)
     has_affinity = jnp.any(reported_z, axis=0)  # (R,)
     # resource constrains the bitmask unless it's irrelevant, or unreported
@@ -112,6 +131,21 @@ def feasible_zones(avail, reported, zone_mask, node_alloc, guaranteed, req,
     ) & zone_mask
     ok = ~early_reject & feasible.any()
     return feasible, ok
+
+
+def feasible_zones(avail, reported, zone_mask, node_alloc, guaranteed, req,
+                   affine, host_level):
+    """(Z,) feasible-zone mask + scalar ok for one request on one node.
+
+    Mirrors resourcesAvailableInAnyNUMANodes: zero-qty resources ignored;
+    node-level absence is an early reject; a resource reported by no zone
+    passes only if host-level; non-guaranteed pods skip the quantity check
+    for NUMA-affine resources.
+    """
+    return feasible_zones_from_suitable(
+        avail >= req[None, :], reported, zone_mask, node_alloc, guaranteed,
+        req, affine, host_level,
+    )
 
 
 def single_numa_fit(avail, reported, zone_mask, node_alloc, guaranteed,
@@ -144,17 +178,6 @@ def single_numa_fit(avail, reported, zone_mask, node_alloc, guaranteed,
     return ok
 
 
-def pod_scope_fit(avail, reported, zone_mask, node_alloc, guaranteed, req,
-                  affine, host_level):
-    """Pod-scope single-numa-node Filter: the pod-effective request must fit
-    one zone (filter.go:162-173)."""
-    _, ok = feasible_zones(
-        avail, reported, zone_mask, node_alloc, guaranteed, req, affine,
-        host_level,
-    )
-    return ok
-
-
 # ---------------------------------------------------------------------------
 # strategy scores (LeastAllocated / MostAllocated / BalancedAllocation)
 # ---------------------------------------------------------------------------
@@ -165,31 +188,48 @@ BALANCED_ALLOCATION = "BalancedAllocation"
 LEAST_NUMA_NODES = "LeastNUMANodes"
 
 
-def _weighted_zone_score(per_resource, relevant, weights):
-    """sum_r score_r * w_r / sum_r w_r over the requested resources."""
-    w = jnp.where(relevant, weights, 0)
-    wsum = jnp.maximum(jnp.sum(w), 1)
-    return go_div(jnp.sum(per_resource * w, axis=-1), wsum)
+def _weighted_zone_score(per_resource_f, relevant, weights):
+    """sum_r score_r * w_r / sum_r w_r over the requested resources, in the
+    caller's float dtype (callers guarantee exactness: per-resource scores
+    are <= 100, so the weighted sum stays < 2^24 for f32 / 2^53 for f64)."""
+    w = jnp.where(relevant, weights, 0).astype(per_resource_f.dtype)
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    return floordiv_exact(
+        jnp.sum(per_resource_f * w, axis=-1), wsum
+    ).astype(jnp.int64)
 
 
 def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
-    """(Z,) per-zone scores for one request on one node."""
+    """(Z,) per-zone scores for one request on one node.
+
+    The integer divisions of least_allocated.go:45-55 / most_allocated.go are
+    computed as exact-floor float divisions in `avail`'s dtype — f32 when the
+    snapshot packs (values * 100 < 2^24), else f64 (< 2^53): this sits in
+    the per-pod scan's hot path, where per-element integer division is the
+    dominant cost on both backends. BalancedAllocation keeps its ratio math
+    in f64: the reference computes it in Go float64, and f64 division of the
+    (scale-invariant) rational reproduces its rounding bit-for-bit.
+    """
     cap = avail  # zone "allocatable" = published available (pluginhelpers.go)
-    if strategy == LEAST_ALLOCATED:
+    dt = (
+        cap.dtype
+        if jnp.issubdtype(cap.dtype, jnp.floating)
+        else jnp.float64
+    )
+    if strategy in (LEAST_ALLOCATED, MOST_ALLOCATED):
+        capf = cap.astype(dt)
+        reqf = req[None, :].astype(dt)
+        numer = (capf - reqf) if strategy == LEAST_ALLOCATED else reqf
         per = jnp.where(
-            (cap == 0) | (req[None, :] > cap),
-            0,
-            (cap - req[None, :]) * MAX_NODE_SCORE // jnp.maximum(cap, 1),
-        )
-        scores = _weighted_zone_score(per, relevant, weights)
-    elif strategy == MOST_ALLOCATED:
-        per = jnp.where(
-            (cap == 0) | (req[None, :] > cap),
-            0,
-            req[None, :] * MAX_NODE_SCORE // jnp.maximum(cap, 1),
+            (capf == 0) | (reqf > capf),
+            0.0,
+            floordiv_exact(
+                numer * float(MAX_NODE_SCORE), jnp.maximum(capf, 1)
+            ),
         )
         scores = _weighted_zone_score(per, relevant, weights)
     elif strategy == BALANCED_ALLOCATION:
+        cap = cap.astype(jnp.float64)
         fraction = jnp.where(
             cap == 0, 1.0, req[None, :].astype(jnp.float64) / jnp.maximum(cap, 1)
         )
@@ -251,14 +291,23 @@ def least_numa_required(avail, reported, zone_mask, distances, guaranteed,
     )  # (Z,)
     valid = jnp.all(~masks | (zone_reports_all & zone_mask)[None, :], axis=1)
 
-    # (S, R) summed availability via float64 matmul — exact below 2^53
-    # (≤ 64 zones of byte quantities stays well under); int64 dot_general is
+    # (S, R) summed availability via float matmul in avail's dtype — exact
+    # (packed f32 keeps sums < 2^24; f64 < 2^53); int64 dot_general is
     # unsupported on TPU, and an (S, Z, R) masked-sum temporary would blow up
     # vmem under the per-(pod, node) vmap
-    avail_reported = jnp.where(reported, avail, 0).astype(jnp.float64)
-    combined = masks.astype(jnp.float64) @ avail_reported
+    dt = (
+        avail.dtype
+        if jnp.issubdtype(avail.dtype, jnp.floating)
+        else jnp.float64
+    )
+    avail_reported = jnp.where(reported, avail, 0).astype(dt)
+    # HIGHEST precision: default TPU matmul truncates f32 operands to bf16,
+    # which would break the pack guard's exactness promise
+    combined = jnp.matmul(
+        masks.astype(dt), avail_reported, precision=jax.lax.Precision.HIGHEST
+    )
     suitable = (~guaranteed & affine[None, :]) | (
-        combined >= req[None, :].astype(jnp.float64)
+        combined >= req[None, :].astype(dt)
     )
     fits = valid & jnp.all(jnp.where(relevant[None, :], suitable, True), axis=1)
 

@@ -179,6 +179,8 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     def batch(snap, state0, auxes):
         for plugin, aux in zip(plugins, auxes):
             plugin.bind_aux(aux)
+        for plugin in plugins:
+            plugin.bind_presolve(plugin.prepare_solve(snap))
         P = snap.num_pods
 
         from scheduler_plugins_tpu.ops.fit import fits_one

@@ -74,10 +74,21 @@ class NodeResourceTopologyMatch(Plugin):
                 self._uniform_scope = scopes.pop()
 
     def static_key(self):
-        # the uniform-scope specialization is a Python-level branch baked
-        # into the trace; key the runtime's jit caches on it so a fleet
-        # scope change retraces instead of reusing the stale program
-        return ("nrt_scope", getattr(self, "_uniform_scope", None))
+        # the uniform-scope specialization and the f32-weight guard are
+        # Python-level branches baked into the trace; key the runtime's jit
+        # caches on them so a config change retraces instead of reusing the
+        # stale program
+        return (
+            "nrt_scope", getattr(self, "_uniform_scope", None),
+            "w_f32_ok", self._weights_f32_ok(),
+        )
+
+    def _weights_f32_ok(self):
+        """Whether the f32 fast path keeps the weighted zone-score sums
+        exact: per-resource scores are <= 100, so sum(100 * w) over the FULL
+        weight vector (defaults included) must stay below 2^24. Computed in
+        `prepare` from the actual vector; conservatively False before."""
+        return bool(getattr(self, "_w_f32_ok", False))
 
     def prepare(self, meta):
         self._uniform_scope = getattr(self, "_uniform_scope", None)
@@ -91,17 +102,46 @@ class NodeResourceTopologyMatch(Plugin):
             if name in meta.index and weight >= 1:
                 w[meta.index.position(name)] = weight
         self._weights = jnp.asarray(w)
+        self._w_f32_ok = int(w.sum()) * numa_ops.MAX_NODE_SCORE < (1 << 24)
 
     def aux(self):
         return (self._affine, self._host_level, self._host_extended, self._weights)
 
     def _numa_avail(self, state, snap):
-        """Zone availability with in-cycle placements deducted — the
+        """Live zone availability with in-cycle placements deducted — the
         carried equivalent of the over-reserve cache's assumed-pod deduction
-        between one-at-a-time cycles (cache/overreserve.go:148-160)."""
+        between one-at-a-time cycles (cache/overreserve.go:148-160). FLOAT
+        (packed f32 or f64, see ops.numa.live_avail_init): feasibility
+        compares and score divisions run without per-step int64 temporaries.
+        Requests entering any comparison against this tensor go through
+        `self._qty`."""
         if state is not None and state.numa_avail is not None:
             return state.numa_avail
-        return snap.numa.available
+        return numa_ops.live_avail_init(snap.numa)
+
+    def prepare_solve(self, snap):
+        if snap.numa is None:
+            return None
+        # loop-invariant: the whole batch's requests scaled into the
+        # live-availability quantity domain once per solve, not per scan step
+        return {
+            "req": numa_ops.scale_qty(snap.numa, snap.pods.req),
+            "creq": numa_ops.scale_qty(snap.numa, snap.pods.container_req),
+        }
+
+    def _qty_req(self, snap, p):
+        """Pod p's effective request in the live-availability domain."""
+        pre = getattr(self, "_presolve", None)
+        if pre is not None:
+            return pre["req"][p]
+        return numa_ops.scale_qty(snap.numa, snap.pods.req[p])
+
+    def _qty_creq(self, snap, p):
+        """Pod p's (C, R) container requests in the live-availability domain."""
+        pre = getattr(self, "_presolve", None)
+        if pre is not None:
+            return pre["creq"][p]
+        return numa_ops.scale_qty(snap.numa, snap.pods.container_req[p])
 
     # -- Filter ----------------------------------------------------------
     def filter(self, state, snap, p):
@@ -109,14 +149,30 @@ class NodeResourceTopologyMatch(Plugin):
             return None
         numa = snap.numa
         affine, host_level, host_extended, _ = self._aux
-        available = self._numa_avail(state, snap)
         guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
-        creq = snap.pods.container_req[p]
+        creq = self._qty_creq(snap, p)
         is_init = snap.pods.container_is_init[p]
         cmask = snap.pods.container_mask[p]
-        req = snap.pods.req[p]
+        req = self._qty_req(snap, p)
+
+        available = self._numa_avail(state, snap)  # (N, Z, R) float
+
+        def fit_one_request(r):
+            """(N,) fit verdicts for a single (R,) request: one fused f64
+            compare over all nodes (exact — integer values below 2^53)."""
+            suitable_qty = available >= r[None, None, :]  # (N, Z, R)
+            return jax.vmap(
+                lambda sq, reported, zmask, alloc:
+                numa_ops.feasible_zones_from_suitable(
+                    sq, reported, zmask, alloc, guaranteed, r,
+                    affine, host_level,
+                )[1]
+            )(suitable_qty, numa.reported, numa.zone_mask, snap.nodes.alloc)
 
         def container_fit():
+            if creq.shape[0] == 1:
+                # single container: no sequential subtraction to thread
+                return fit_one_request(creq[0])
             return jax.vmap(
                 lambda avail, reported, zmask, alloc: numa_ops.single_numa_fit(
                     avail, reported, zmask, alloc, guaranteed, creq, is_init,
@@ -125,12 +181,7 @@ class NodeResourceTopologyMatch(Plugin):
             )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
 
         def pod_fit():
-            return jax.vmap(
-                lambda avail, reported, zmask, alloc: numa_ops.pod_scope_fit(
-                    avail, reported, zmask, alloc, guaranteed, req,
-                    affine, host_level,
-                )
-            )(available, numa.reported, numa.zone_mask, snap.nodes.alloc)
+            return fit_one_request(req)
 
         if self._uniform_scope == int(TopologyManagerScope.POD):
             scoped = pod_fit()
@@ -158,16 +209,17 @@ class NodeResourceTopologyMatch(Plugin):
 
     def commit(self, state, snap, p, choice):
         """Reserve: pessimistically deduct the placed pod's request from
-        EVERY zone of the chosen node (ReserveNodeResources +
+        EVERY reported zone of the chosen node (ReserveNodeResources +
         GetCachedNRTCopy deduction semantics, cache/store.go:129-160)."""
         if snap.numa is None or state.numa_avail is None:
             return state
         N = state.numa_avail.shape[0]
         onehot = (jnp.arange(N) == choice)[:, None, None]
+        reqq = self._qty_req(snap, p).astype(state.numa_avail.dtype)
         deduct = jnp.where(
             (choice >= 0) & onehot & snap.numa.reported,
-            snap.pods.req[p][None, None, :],
-            0,
+            reqq[None, None, :],
+            0.0,
         )
         return state.replace(numa_avail=state.numa_avail - deduct)
 
@@ -192,9 +244,9 @@ class NodeResourceTopologyMatch(Plugin):
 
     def _strategy_scores(self, state, snap, p):
         numa = snap.numa
-        req = snap.pods.req[p]
+        req = self._qty_req(snap, p)
         relevant = req > 0
-        creq = snap.pods.container_req[p]
+        creq = self._qty_creq(snap, p)
         cmask = snap.pods.container_mask[p]
         C = creq.shape[0]
 
@@ -219,7 +271,12 @@ class NodeResourceTopologyMatch(Plugin):
                 total = total + jnp.where(cmask[c], s.astype(jnp.float64), 0.0)
             return jnp.trunc(total / count).astype(jnp.int64)
 
+        # float live availability (packed f32 / f64): exact, and feeds the
+        # exact-floor divisions in zone_strategy_scores without per-step
+        # int64 temporaries; oversized user weights force the f64 path
         available = self._numa_avail(state, snap)
+        if available.dtype == jnp.float32 and not self._weights_f32_ok():
+            available = available.astype(jnp.float64)
         if self._uniform_scope == int(TopologyManagerScope.POD):
             return jax.vmap(node_pod_scope)(available, numa.zone_mask)
         if self._uniform_scope == int(TopologyManagerScope.CONTAINER):
@@ -237,8 +294,8 @@ class NodeResourceTopologyMatch(Plugin):
         masks = jnp.asarray(masks_np)
         sizes = jnp.asarray(sizes_np)
         affine = self._aux[0]
-        req = snap.pods.req[p]
-        creq = snap.pods.container_req[p]
+        req = self._qty_req(snap, p)
+        creq = self._qty_creq(snap, p)
         is_init = snap.pods.container_is_init[p]
         cmask = snap.pods.container_mask[p]
         C = creq.shape[0]

@@ -176,6 +176,13 @@ class NumaState:
     #: (N,) per-node topology-manager MaxNUMANodes (LeastNUMA normalization,
     #: least_numa.go:88-102; default 8)
     max_numa: np.ndarray
+    #: STATIC per-resource power-of-2 rescale enabling the f32 NUMA fast
+    #: path: every zone quantity and pending request is exactly divisible by
+    #: its scale and the rescaled values keep `value * 100 < 2^24` (exact in
+    #: float32, scale-invariant trunc-division scores). None when any
+    #: resource fails the guard — solvers then carry float64. Part of the
+    #: pytree STRUCTURE, so jit retraces when packability changes.
+    pack_scales: Optional[tuple] = struct.field(pytree_node=False, default=None)
 
 
 @struct.dataclass
@@ -687,6 +694,9 @@ def build_snapshot(
             has_nrt=has_nrt,
             fresh=nrt_fresh,
             max_numa=max_numa,
+            pack_scales=_numa_pack_scales(
+                z_avail, z_alloc, preq, pcreq, R
+            ),
         )
 
     snapshot = ClusterSnapshot(
@@ -714,6 +724,38 @@ def build_snapshot(
 
     snapshot = jax.tree.map(jnp.asarray, snapshot)
     return snapshot, meta
+
+
+#: rescaled quantities must keep value * MAX_NODE_SCORE (100) exactly
+#: representable in float32
+_F32_PACK_LIMIT = (1 << 24) // 128
+
+
+def _numa_pack_scales(z_avail, z_alloc, preq, pcreq, R):
+    """Per-resource power-of-2 scales for the f32 NUMA fast path, or None.
+
+    A resource packs when every zone quantity and every pending (container)
+    request is divisible by 2^k and the rescaled maximum stays below
+    2^24/128 (so `value * 100` is exact in float32). Scale-invariance of the
+    trunc-division strategy scores (floor of an unchanged rational) keeps
+    packed placements bit-identical to the int64 semantics.
+    """
+    scales = []
+    for r in range(R):
+        vals = np.concatenate(
+            [z_avail[:, :, r].ravel(), z_alloc[:, :, r].ravel(),
+             preq[:, r].ravel(), pcreq[:, :, r].ravel()]
+        )
+        vals = vals[vals > 0]
+        if vals.size == 0:
+            scales.append(1)
+            continue
+        # largest power of two dividing every value: min of lowest set bits
+        scale = int(np.min(vals & -vals))
+        if int(vals.max()) // scale >= _F32_PACK_LIMIT:
+            return None
+        scales.append(scale)
+    return tuple(scales)
 
 
 def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zone, meta, P):

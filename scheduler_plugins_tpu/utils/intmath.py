@@ -22,6 +22,29 @@ def go_div(a, b):
     return jnp.where(a < 0, -q, q).astype(a.dtype)
 
 
+def floordiv_exact(a, b):
+    """Exact floor(a/b) in floating point for integer-valued inputs, b > 0.
+
+    Runs in `a`'s dtype when it is floating (callers guarantee the values and
+    intermediate products are exactly representable there — < 2^24 for f32,
+    < 2^53 for f64), else float64. Computed as a correctly-rounded division
+    plus a one-step correction (the float quotient can land one off across
+    an integer boundary; the remainder check is exact at these magnitudes).
+    Integer division is the slow path on both backends — CPU SIMD has no
+    integer divide and TPU emulates s64 arithmetic — while float division
+    vectorizes. For non-negative a this equals Go's truncating division.
+    """
+    a = jnp.asarray(a)
+    dt = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float64
+    af = a.astype(dt)
+    bf = jnp.asarray(b).astype(dt)
+    q = jnp.floor(af / bf)
+    r = af - q * bf  # exact: |r| < 2b
+    q = jnp.where(r < 0, q - 1.0, q)
+    q = jnp.where(r >= bf, q + 1.0, q)
+    return q
+
+
 def round_half_away(x):
     """Go `math.Round`: round half away from zero, as int64."""
     x = jnp.asarray(x)

@@ -300,3 +300,60 @@ class TestLeastNumaOps:
         assert int(numa_ops.least_numa_normalize(1, False, 8)) == 88
         assert int(numa_ops.least_numa_normalize(1, True, 8)) == 94
         assert int(numa_ops.least_numa_normalize(4, False, 8)) == 52
+
+
+class TestF32Packing:
+    """The packed-f32 fast path must be bit-identical to the f64 path
+    (scale-invariant trunc division) and must disengage when quantities
+    don't divide."""
+
+    def _solve(self, cluster, force_f64=False, strategy="LeastAllocated"):
+        sched = Scheduler(Profile(plugins=[NodeResourceTopologyMatch(
+            scoring_strategy=strategy)]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        if force_f64:
+            snap = snap.replace(numa=snap.numa.replace(pack_scales=None))
+        sched.prepare(meta, cluster)
+        return np.asarray(sched.solve(snap).assignment), snap
+
+    def _mixed_cluster(self, odd_memory=False):
+        rng = np.random.default_rng(7)
+        c = Cluster()
+        mem_unit = (1 << 30) + (3 if odd_memory else 0)
+        for i in range(12):
+            c.add_node(Node(name=f"n{i}", allocatable={
+                CPU: 16_000, MEMORY: 64 * gib, PODS: 110}))
+            c.add_nrt(nrt(f"n{i}", [
+                {CPU: 4000, MEMORY: 8 * mem_unit},
+                {CPU: 4000, MEMORY: 8 * mem_unit},
+                {CPU: 4000, MEMORY: 8 * mem_unit},
+                {CPU: 4000, MEMORY: 8 * mem_unit},
+            ]))
+        for j in range(24):
+            c.add_pod(guaranteed_pod(
+                f"p{j}", int(rng.integers(100, 3800)), mem_unit, creation_ms=j))
+        return c
+
+    def test_packs_and_matches_f64(self):
+        c = self._mixed_cluster()
+        a32, snap = self._solve(c)
+        assert snap.numa.pack_scales is not None
+        assert snap.numa.pack_scales[1] > 1  # memory rescaled
+        a64, _ = self._solve(c, force_f64=True)
+        assert a32.tolist() == a64.tolist()
+        assert (a32 >= 0).sum() > 0
+
+    def test_packs_and_matches_f64_least_numa(self):
+        c = self._mixed_cluster()
+        a32, snap = self._solve(c, strategy="LeastNUMANodes")
+        assert snap.numa.pack_scales is not None
+        a64, _ = self._solve(c, force_f64=True, strategy="LeastNUMANodes")
+        assert a32.tolist() == a64.tolist()
+
+    def test_odd_quantities_disable_packing(self):
+        # memory quantities not divisible by a useful power of two AND too
+        # large for f32: guard must fall back to f64
+        c = self._mixed_cluster(odd_memory=True)
+        _, snap = self._solve(c)
+        assert snap.numa.pack_scales is None
