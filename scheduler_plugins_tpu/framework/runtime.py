@@ -235,8 +235,11 @@ class Scheduler:
             def verdicts(snap, state0, auxes, p):
                 for plugin, aux in zip(plugins, auxes):
                     plugin.bind_aux(aux)
+                # presolve deliberately NOT bound: it precomputes whole-batch
+                # tensors to amortize a P-step scan, but this entry evaluates
+                # ONE pod — the plugins' per-row fallbacks are cheaper here
                 for plugin in plugins:
-                    plugin.bind_presolve(plugin.prepare_solve(snap))
+                    plugin.bind_presolve(None)
                 feasible = jnp.ones(snap.num_nodes, bool)
                 for plugin in plugins:
                     mask = plugin.filter(state0, snap, p)

@@ -73,9 +73,15 @@ def dependency_tallies(
     same_zone = node_zone[:, None] == jnp.arange(ZC)[None, :]  # (N, ZC)
     same_region = node_region[:, None] == zone_region[None, :]  # (N, ZC)
 
-    zcost_row = zone_cost[nz]  # (N, ZC)
+    # a candidate without a zone/region label looks up with key "" in the
+    # reference (networkoverhead.go:544-566) — always a miss, never row 0
+    zcost_row = jnp.where((node_zone >= 0)[:, None], zone_cost[nz], -1)  # (N, ZC)
     rcost_zone = region_cost[nr][:, jnp.maximum(zone_region, 0)]  # (N, ZC)
-    rcost_zone = jnp.where(zone_region[None, :] >= 0, rcost_zone, -1)
+    rcost_zone = jnp.where(
+        (node_region >= 0)[:, None] & (zone_region[None, :] >= 0),
+        rcost_zone,
+        -1,
+    )
 
     pair_cost = jnp.where(
         same_zone,
@@ -110,15 +116,26 @@ def dependency_tallies(
     satisfied = satisfied + jnp.sum(same_node_cnt, axis=0)
     cost = cost + SAME_HOST_COST * jnp.sum(same_node_cnt, axis=0)
 
-    # region-only placed pods: zone lookup misses within the same region
-    # (cost MaxCost, no count); region-cost lookup across regions
+    # region-only placed pods. Same region: a ZONED candidate's zone lookup
+    # misses (destination "" -> cost MaxCost, no count) but a ZONELESS
+    # candidate compares "" == "" as the SAME zone -> satisfied, cost 1
+    # (networkoverhead.go:541-545). Across regions: region-cost lookup,
+    # missing for label-less candidates.
     same_r = node_region[:, None] == jnp.arange(RC)[None, :]  # (N, RC)
-    rcost = region_cost[nr]  # (N, RC)
-    rn_cost = jnp.where(same_r, MAX_COST, jnp.where(rcost >= 0, rcost, MAX_COST))
+    rcost = jnp.where((node_region >= 0)[:, None], region_cost[nr], -1)  # (N, RC)
+    both_zoneless = (node_zone < 0)[:, None] & same_r  # (N, RC)
+    rn_cost = jnp.where(
+        both_zoneless,
+        SAME_ZONE_COST,
+        jnp.where(same_r, MAX_COST, jnp.where(rcost >= 0, rcost, MAX_COST)),
+    )
     rn_known = ~same_r & (rcost >= 0)
-    rn_sat = rn_known[None, :, :] & (
-        jnp.where(rcost >= 0, rcost, MAX_COST)[None, :, :]
-        <= dep_max_cost[:, None, None]
+    rn_sat = both_zoneless[None, :, :] | (
+        rn_known[None, :, :]
+        & (
+            jnp.where(rcost >= 0, rcost, MAX_COST)[None, :, :]
+            <= dep_max_cost[:, None, None]
+        )
     )
     rn_vio = rn_known[None, :, :] & ~rn_sat
     node_rnoz = rnoz  # (N,)

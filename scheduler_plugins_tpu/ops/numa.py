@@ -230,8 +230,15 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
         scores = _weighted_zone_score(per, relevant, weights)
     elif strategy == BALANCED_ALLOCATION:
         cap = cap.astype(jnp.float64)
+        # fractionOfCapacity (balanced_allocation.go:50-55): req/capacity
+        # unclamped — a NEGATIVE live capacity (pessimistic in-cycle
+        # deduction) yields a negative fraction that feeds the variance, it
+        # is NOT the over case. Unclamped division is also scale-invariant,
+        # so the packed-f32 domain reproduces it bit-for-bit after upcast.
         fraction = jnp.where(
-            cap == 0, 1.0, req[None, :].astype(jnp.float64) / jnp.maximum(cap, 1)
+            cap == 0,
+            1.0,
+            req[None, :].astype(jnp.float64) / jnp.where(cap == 0, 1.0, cap),
         )
         over = jnp.any(relevant[None, :] & (fraction > 1.0), axis=1)
         n = jnp.maximum(jnp.sum(relevant), 1)

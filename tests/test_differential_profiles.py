@@ -1,0 +1,720 @@
+"""Differential gates for the remaining BASELINE profiles (VERDICT round-1
+item #4): independent, reference-shaped Python oracles for
+
+- NUMA container-scope single-numa-node Filter + LeastAllocated Score
+  (/root/reference/pkg/noderesourcetopology/filter.go:39-160, score.go,
+  least_allocated.go:25-55),
+- gang MinResources / quorum admission + ElasticQuota caps
+  (/root/reference/pkg/coscheduling/core/core.go:243-305, 404-467;
+  /root/reference/pkg/capacityscheduling/capacity_scheduling.go:208-282),
+- NetworkOverhead dependency tallies + inverted normalization
+  (/root/reference/pkg/networkaware/networkoverhead/networkoverhead.go:
+  326-418, 500-638),
+
+run over randomized clusters and compared bit-for-bit against the jitted
+sequential solve. The oracles are written from the reference semantics, not
+from the ops code."""
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    ElasticQuota,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    PodGroup,
+    APP_GROUP_LABEL,
+    POD_GROUP_LABEL,
+    REGION_LABEL,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler
+from scheduler_plugins_tpu.plugins import (
+    CapacityScheduling,
+    Coscheduling,
+    NetworkOverhead,
+    NodeResourcesAllocatable,
+    NodeResourceTopologyMatch,
+    TopologicalSort,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+MAX_COST = 100
+
+
+def solve_names(plugins, cluster, now_ms=0):
+    """Run the jitted sequential solve; return (pending, [node name | None],
+    result)."""
+    sched = Scheduler(Profile(plugins=plugins))
+    pending = sched.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=now_ms)
+    sched.prepare(meta, cluster)
+    result = sched.solve(snap)
+    got = [
+        meta.node_names[int(a)] if int(a) >= 0 else None
+        for a in np.asarray(result.assignment)[: len(pending)]
+    ]
+    return pending, got, result
+
+
+# ---------------------------------------------------------------------------
+# NUMA oracle
+# ---------------------------------------------------------------------------
+
+
+def _is_affine(r):
+    return r in (CPU, MEMORY) or r.startswith("hugepages-")
+
+
+def _is_host_level(r):
+    return r in ("ephemeral-storage", "storage") or "/" in r
+
+
+def _zone_fit_one(zones, node_alloc, guaranteed, creq):
+    """resourcesAvailableInAnyNUMANodes (filter.go:90-160): returns the
+    lowest feasible zone id or None. `zones` = {zone_id: {res: avail}}
+    (presence == reported)."""
+    relevant = [r for r, v in creq.items() if v > 0]
+    if any(node_alloc.get(r, 0) <= 0 for r in relevant):
+        return None  # node-level absence: early reject
+    reported_any = {r: any(r in z for z in zones.values()) for r in relevant}
+    constraining = [
+        r for r in relevant if not (not reported_any[r] and _is_host_level(r))
+    ]
+    for zid in sorted(zones):
+        ok = True
+        for r in constraining:
+            if r not in zones[zid]:
+                ok = False
+                break
+            # non-guaranteed pods skip the quantity check for NUMA-affine
+            # resources (numaresources.go:137-142)
+            if (guaranteed or not _is_affine(r)) and zones[zid][r] < creq[r]:
+                ok = False
+                break
+        if not ok:
+            continue
+        return zid
+    return None
+
+
+def _numa_filter(zones, node_alloc, pod):
+    """Container-scope handler (filter.go:39-78): init containers checked
+    without subtraction, app containers subtract their grant from the chosen
+    zone before the next container."""
+    guaranteed = pod.qos_class().name == "GUARANTEED"
+    zs = {zid: dict(av) for zid, av in zones.items()}
+    for cont, is_init in [(c, True) for c in pod.init_containers] + [
+        (c, False) for c in pod.containers
+    ]:
+        zid = _zone_fit_one(zs, node_alloc, guaranteed, cont.requests)
+        if zid is None:
+            return False
+        if not is_init:
+            for r, v in cont.requests.items():
+                if r in zs[zid]:
+                    zs[zid][r] -= v
+    return True
+
+
+def _least_allocated_zone_score(creq, zone):
+    """least_allocated.go:25-55 with default weight 1 per resource."""
+    relevant = [r for r, v in creq.items() if v > 0]
+    if not relevant:
+        return 0
+    total = 0
+    for r in relevant:
+        cap = zone.get(r, 0)
+        req = creq[r]
+        total += 0 if cap == 0 or req > cap else (cap - req) * 100 // cap
+    return total // len(relevant)
+
+
+def _numa_score(zones, pod, has_nrt):
+    """score.go: container-scope mean of zero-skipping zone minima;
+    non-guaranteed pods always score 100 (score.go:72-75)."""
+    if pod.qos_class().name != "GUARANTEED":
+        return 100
+    if not has_nrt:
+        return 0
+    total = 0.0
+    containers = list(pod.init_containers) + list(pod.containers)
+    for cont in containers:
+        per_zone = [
+            _least_allocated_zone_score(cont.requests, zones[zid])
+            for zid in sorted(zones)
+        ]
+        nonzero = [s for s in per_zone if s != 0]
+        total += min(nonzero) if nonzero else 0
+    import math
+
+    return math.trunc(total / max(len(containers), 1))
+
+
+def reference_numa_loop(nodes, nrts, pods):
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    alloc = {n.name: n.allocatable for n in nodes}
+    zones = {
+        t.node_name: {z.numa_id: dict(z.available) for z in t.zones}
+        for t in nrts
+    }
+    order = [n.name for n in nodes]
+    placements = []
+    for pod in pods:
+        req = pod.effective_request()
+        feasible = []
+        scores = {}
+        for name in order:
+            if free[name].get(PODS, 0) < 1 or any(
+                free[name].get(r, 0) < v for r, v in req.items()
+            ):
+                continue
+            # Filter applies only to single-numa-node NRT nodes
+            if name in zones and not _numa_filter(
+                zones[name], alloc[name], pod
+            ):
+                continue
+            feasible.append(name)
+            scores[name] = _numa_score(
+                zones.get(name, {}), pod, name in zones
+            )
+        if not feasible:
+            placements.append(None)
+            continue
+        # single plugin without NormalizeScore: raw scores, first-max wins
+        best = max(feasible, key=lambda n: scores[n])  # ties: first in order
+        for r, v in req.items():
+            free[best][r] = free[best].get(r, 0) - v
+        free[best][PODS] -= 1
+        if best in zones:
+            # pessimistic all-zone deduction (cache/store.go:129-160)
+            for z in zones[best].values():
+                for r, v in req.items():
+                    if r in z:
+                        z[r] -= v
+        placements.append(best)
+    return placements
+
+
+class TestNumaDifferential:
+    def _random_numa_cluster(self, rng, n_nodes, n_pods):
+        cluster = Cluster()
+        nodes, nrts = [], []
+        for i in range(n_nodes):
+            node = Node(
+                name=f"n{i:03d}",
+                allocatable={
+                    CPU: int(rng.integers(8_000, 32_000)),
+                    MEMORY: int(rng.integers(16, 128)) * gib,
+                    PODS: int(rng.integers(8, 40)),
+                },
+            )
+            nodes.append(node)
+            cluster.add_node(node)
+            if rng.random() < 0.15:
+                continue  # some nodes have no NRT at all
+            z_count = int(rng.integers(2, 5))
+            zone_list = []
+            for z in range(z_count):
+                avail = {CPU: int(rng.integers(1000, 9000))}
+                if rng.random() < 0.9:  # some zones don't report memory
+                    avail[MEMORY] = int(rng.integers(2, 33)) * gib
+                zone_list.append(NUMAZone(numa_id=z, available=avail))
+            t = NodeResourceTopology(
+                node_name=node.name,
+                policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+                scope=TopologyManagerScope.CONTAINER,
+                zones=zone_list,
+            )
+            nrts.append(t)
+            cluster.add_nrt(t)
+        for j in range(n_pods):
+            n_cont = int(rng.integers(1, 4))
+            conts = []
+            for _ in range(n_cont):
+                req = {
+                    CPU: int(rng.integers(100, 4000)),
+                    MEMORY: int(rng.integers(1, 8)) * gib,
+                }
+                conts.append(
+                    Container(requests=req, limits=dict(req))
+                    if rng.random() < 0.7  # guaranteed...
+                    else Container(requests=req)  # ...or burstable
+                )
+            n_init = int(rng.integers(0, 2))
+            init = [
+                Container(
+                    requests={
+                        CPU: int(rng.integers(100, 5000)),
+                        MEMORY: int(rng.integers(1, 4)) * gib,
+                    }
+                )
+                for _ in range(n_init)
+            ]
+            cluster.add_pod(
+                Pod(
+                    name=f"p{j:04d}",
+                    creation_ms=j,
+                    containers=conts,
+                    init_containers=init,
+                )
+            )
+        return cluster, nodes, nrts
+
+    def test_numa_differential(self):
+        for seed in range(4):
+            rng = np.random.default_rng(2000 + seed)
+            cluster, nodes, nrts = self._random_numa_cluster(
+                rng, int(rng.integers(6, 20)), int(rng.integers(20, 80))
+            )
+            pending, got, _ = solve_names(
+                [NodeResourceTopologyMatch()], cluster
+            )
+            expected = reference_numa_loop(nodes, nrts, pending)
+            assert got == expected, f"seed {seed}: NUMA divergence"
+
+
+# ---------------------------------------------------------------------------
+# Gang + quota oracle
+# ---------------------------------------------------------------------------
+
+
+def go_div(a, b):
+    q = abs(a) // b
+    return -q if a < 0 else q
+
+
+def static_scores(nodes, weights, sign=-1):
+    wsum = sum(weights.values())
+    return {
+        n.name: go_div(
+            sum(sign * n.allocatable.get(r, 0) * w for r, w in weights.items()),
+            wsum,
+        )
+        for n in nodes
+    }
+
+
+def place_one(free, raw, node_order, req):
+    feasible = [
+        name
+        for name in node_order
+        if free[name].get(PODS, 0) >= 1
+        and all(free[name].get(r, 0) >= v for r, v in req.items())
+    ]
+    if not feasible:
+        return None
+    lo = min(raw[f] for f in feasible)
+    hi = max(raw[f] for f in feasible)
+    best, best_score = None, None
+    for name in feasible:
+        score = 0 if hi == lo else (raw[name] - lo) * 100 // (hi - lo)
+        if best_score is None or score > best_score:
+            best, best_score = name, score
+    for r, v in req.items():
+        free[best][r] = free[best].get(r, 0) - v
+    free[best][PODS] -= 1
+    return best
+
+
+def reference_gang_quota_loop(nodes, pending, pod_groups, quotas, gang_info):
+    """core.go:243-305 gang admission (member/gated quorum + MinResources
+    cluster sweep with own-demand add-back) + capacity_scheduling.go quota
+    caps, threaded through the allocatable placement loop."""
+    weights = {CPU: 1 << 20, MEMORY: 1}
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    raw = static_scores(nodes, weights)
+    order = [n.name for n in nodes]
+    used = {ns: {} for ns in quotas}
+    inflight = {g: {} for g in pod_groups}
+    placed_count = {g: 0 for g in pod_groups}
+    placements = []
+    for pod in pending:
+        req = pod.effective_request()
+        g = pod.pod_group()
+        gkey = f"{pod.namespace}/{g}" if g else None
+        if gkey is not None and gkey in pod_groups:
+            pg = pod_groups[gkey]
+            total, gated = gang_info[gkey]
+            if total < pg.min_member or total - gated < pg.min_member:
+                placements.append(None)
+                continue
+            if pg.min_resources:
+                demand = dict(pg.min_resources)
+                demand[PODS] = pg.min_member  # core.go:295-297
+                cap = {}
+                for name in free:
+                    for r, v in free[name].items():
+                        cap[r] = cap.get(r, 0) + v
+                for r, v in inflight[gkey].items():
+                    cap[r] = cap.get(r, 0) + v
+                if any(demand[r] > cap.get(r, 0) for r in demand):
+                    placements.append(None)
+                    continue
+        ns = pod.namespace
+        if ns in quotas:
+            q = quotas[ns]
+            axis = {CPU, MEMORY, PODS} | set(req)
+            over_max = any(
+                used[ns].get(r, 0) + req.get(r, 0)
+                > q["max"].get(r, 2**63 - 1)
+                for r in axis
+            )
+            agg_used = {
+                r: sum(used[m].get(r, 0) for m in quotas) for r in axis
+            }
+            agg_min = {
+                r: sum(quotas[m]["min"].get(r, 0) for m in quotas)
+                for r in axis
+            }
+            over_min = any(
+                agg_used[r] + req.get(r, 0) > agg_min[r] for r in axis
+            )
+            if over_max or over_min:
+                placements.append(None)
+                continue
+        best = place_one(free, raw, order, req)
+        placements.append(best)
+        if best is not None:
+            if ns in quotas:
+                for r, v in req.items():
+                    used[ns][r] = used[ns].get(r, 0) + v
+            if gkey is not None and gkey in pod_groups:
+                placed_count[gkey] += 1
+                for r, v in req.items():
+                    inflight[gkey][r] = inflight[gkey].get(r, 0) + v
+                inflight[gkey][PODS] = inflight[gkey].get(PODS, 0) + 1
+    return placements, placed_count
+
+
+class TestGangQuotaDifferential:
+    def test_gang_minresources_differential(self):
+        for seed in range(3):
+            rng = np.random.default_rng(3000 + seed)
+            cluster = Cluster()
+            nodes = []
+            for i in range(int(rng.integers(5, 14))):
+                node = Node(
+                    name=f"n{i:03d}",
+                    allocatable={
+                        CPU: int(rng.integers(8_000, 32_000)),
+                        MEMORY: int(rng.integers(16, 64)) * gib,
+                        PODS: int(rng.integers(10, 40)),
+                    },
+                )
+                nodes.append(node)
+                cluster.add_node(node)
+            quotas = {}
+            for ns in ("a", "b"):
+                quotas[ns] = {
+                    "min": {CPU: int(rng.integers(30_000, 80_000)),
+                            MEMORY: int(rng.integers(64, 128)) * gib},
+                    "max": {CPU: int(rng.integers(80_000, 160_000)),
+                            MEMORY: int(rng.integers(128, 256)) * gib},
+                }
+                cluster.add_quota(ElasticQuota(
+                    name=ns, namespace=ns,
+                    min=quotas[ns]["min"], max=quotas[ns]["max"],
+                ))
+            pod_groups = {}
+            gang_info = {}
+            serial = 0
+            for g in range(int(rng.integers(3, 7))):
+                ns = "a" if g % 2 == 0 else "b"
+                size = int(rng.integers(2, 8))
+                min_member = int(rng.integers(2, size + 2))  # some unreachable
+                minres = None
+                if rng.random() < 0.6:
+                    # occasionally demand more than the cluster holds
+                    scale = 4000 if rng.random() < 0.3 else 800
+                    minres = {CPU: min_member * scale * 10}
+                pg = PodGroup(
+                    name=f"g{g}", namespace=ns, min_member=min_member,
+                    min_resources=minres or {}, creation_ms=g,
+                )
+                pod_groups[pg.full_name] = pg
+                cluster.add_pod_group(pg)
+                gated = 0
+                for m in range(size):
+                    serial += 1
+                    is_gated = rng.random() < 0.1
+                    gated += is_gated
+                    cluster.add_pod(Pod(
+                        name=f"g{g}-m{m}", namespace=ns,
+                        creation_ms=g * 100 + m,
+                        containers=[Container(requests={
+                            CPU: int(rng.integers(200, 3000)),
+                            MEMORY: int(rng.integers(1, 8)) * gib,
+                        })],
+                        labels={POD_GROUP_LABEL: f"g{g}"},
+                        scheduling_gated=is_gated,
+                    ))
+                gang_info[pg.full_name] = (size, gated)
+            # some gangless, quota-free pods in the mix
+            for j in range(int(rng.integers(3, 10))):
+                serial += 1
+                cluster.add_pod(Pod(
+                    name=f"solo{j}", namespace="c", creation_ms=1000 + j,
+                    containers=[Container(requests={
+                        CPU: int(rng.integers(200, 3000)),
+                        MEMORY: int(rng.integers(1, 8)) * gib,
+                    })],
+                ))
+            pending, got, result = solve_names(
+                [NodeResourcesAllocatable(), Coscheduling(),
+                 CapacityScheduling()],
+                cluster,
+            )
+            expected, placed_count = reference_gang_quota_loop(
+                nodes, pending, pod_groups, quotas, gang_info
+            )
+            assert got == expected, f"seed {seed}: gang/quota divergence"
+            # Permit: placed members of an under-quorum gang must Wait
+            wait = np.asarray(result.wait)[: len(pending)]
+            for i, pod in enumerate(pending):
+                g = pod.pod_group()
+                gkey = f"{pod.namespace}/{g}" if g else None
+                if got[i] is not None and gkey in pod_groups:
+                    expect_wait = (
+                        placed_count[gkey] < pod_groups[gkey].min_member
+                    )
+                    assert bool(wait[i]) == expect_wait, (
+                        f"seed {seed}: wait divergence for {pod.name}"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# NetworkOverhead oracle
+# ---------------------------------------------------------------------------
+
+
+def _pair_tally(cand_loc, placed_loc, same_node, zone_cost, region_cost,
+                max_cost_dep):
+    """(satisfied, violated, cost) contribution of ONE placed dependency pod
+    (networkoverhead.go:500-638)."""
+    if same_node:
+        return 1, 0, 0
+    cand_region, cand_zone = cand_loc
+    p_region, p_zone = placed_loc
+    if p_region is None and p_zone is None:
+        return 0, 1, MAX_COST
+    if cand_region == p_region:
+        if cand_zone == p_zone:
+            return 1, 0, 1
+        value = zone_cost.get((cand_zone, p_zone))
+        if value is None:
+            return 0, 0, MAX_COST
+        return (1, 0, value) if value <= max_cost_dep else (0, 1, value)
+    value = region_cost.get((cand_region, p_region))
+    if value is None:
+        return 0, 0, MAX_COST
+    return (1, 0, value) if value <= max_cost_dep else (0, 1, value)
+
+
+def reference_network_loop(nodes, pending, deps_of, zone_cost, region_cost):
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    loc = {
+        n.name: (n.labels.get(REGION_LABEL), n.labels.get(ZONE_LABEL))
+        for n in nodes
+    }
+    order = [n.name for n in nodes]
+    placed = {}  # workload -> [node names]
+    placements = []
+    for pod in pending:
+        req = pod.effective_request()
+        wl = pod.workload_selector()
+        deps = deps_of.get(wl, [])
+        feasible = []
+        cost_of = {}
+        for name in order:
+            if free[name].get(PODS, 0) < 1 or any(
+                free[name].get(r, 0) < v for r, v in req.items()
+            ):
+                continue
+            sat = vio = cost = 0
+            for dep_wl, max_c in deps:
+                for p_node in placed.get(dep_wl, []):
+                    s, v, c = _pair_tally(
+                        loc[name], loc[p_node], p_node == name,
+                        zone_cost, region_cost, max_c,
+                    )
+                    sat += s
+                    vio += v
+                    cost += c
+            if deps and vio > sat:
+                continue  # Filter (networkoverhead.go:326-359)
+            feasible.append(name)
+            cost_of[name] = cost if deps else 0
+        if not feasible:
+            placements.append(None)
+            continue
+        # peaks-style inverted normalize (networkoverhead.go:362-418)
+        lo = min(cost_of[f] for f in feasible)
+        hi = max(cost_of[f] for f in feasible)
+        import math
+
+        best, best_score = None, None
+        for name in feasible:
+            if lo == 0 and hi == 0:
+                score = cost_of[name]
+            elif hi != lo:
+                score = 100 - math.trunc(
+                    100 * (cost_of[name] - lo) / (hi - lo)
+                )
+            else:
+                score = 100 - (cost_of[name] - lo)
+            if best_score is None or score > best_score:
+                best, best_score = name, score
+        for r, v in req.items():
+            free[best][r] = free[best].get(r, 0) - v
+        free[best][PODS] -= 1
+        if wl:
+            placed.setdefault(wl, []).append(best)
+        placements.append(best)
+    return placements
+
+
+class TestNetworkDifferential:
+    def test_network_differential(self):
+        for seed in range(3):
+            rng = np.random.default_rng(4000 + seed)
+            cluster = Cluster()
+            nodes = []
+            n_regions, zones_per = 3, 2
+            zone_names = [f"z{z}" for z in range(n_regions * zones_per)]
+            region_names = [f"r{r}" for r in range(n_regions)]
+            region_of_zone = {
+                f"z{z}": f"r{z // zones_per}"
+                for z in range(n_regions * zones_per)
+            }
+            for i in range(int(rng.integers(8, 16))):
+                labels = {}
+                roll = rng.random()
+                if roll < 0.8:
+                    zone = zone_names[i % len(zone_names)]
+                    labels = {
+                        ZONE_LABEL: zone,
+                        REGION_LABEL: region_of_zone[zone],
+                    }
+                elif roll < 0.9:
+                    labels = {REGION_LABEL: region_names[i % n_regions]}
+                # else: fully unlabeled node
+                node = Node(
+                    name=f"n{i:03d}",
+                    allocatable={CPU: 32_000, MEMORY: 64 * gib, PODS: 60},
+                    labels=labels,
+                )
+                nodes.append(node)
+                cluster.add_node(node)
+            # sparse random cost tables (some pairs missing)
+            zone_cost, region_cost = {}, {}
+            for a in zone_names:
+                for b in zone_names:
+                    if a != b and rng.random() < 0.7:
+                        zone_cost[(a, b)] = int(rng.integers(2, 40))
+            for a in region_names:
+                for b in region_names:
+                    if a != b and rng.random() < 0.8:
+                        region_cost[(a, b)] = int(rng.integers(20, 90))
+            cluster.add_network_topology(NetworkTopology(
+                weights={"UserDefined": {
+                    "zone": zone_cost, "region": region_cost,
+                }}
+            ))
+            n_wl = 6
+            workloads = [AppGroupWorkload(selector=f"w{w}") for w in range(n_wl)]
+            deps_of = {}
+            for w in range(1, n_wl):
+                dep = f"w{int(rng.integers(0, w))}"
+                max_c = int(rng.integers(5, 50))
+                workloads[w].dependencies.append(AppGroupDependency(
+                    workload_selector=dep, max_network_cost=max_c,
+                ))
+                deps_of[f"w{w}"] = [(dep, max_c)]
+            cluster.add_app_group(AppGroup(
+                name="ag", workloads=workloads,
+                topology_order={f"w{w}": w for w in range(n_wl)},
+            ))
+            for j in range(int(rng.integers(20, 60))):
+                cluster.add_pod(Pod(
+                    name=f"p{j:04d}", creation_ms=j,
+                    containers=[Container(requests={
+                        CPU: int(rng.integers(200, 2000)),
+                        MEMORY: int(rng.integers(1, 4)) * gib,
+                    })],
+                    labels={
+                        APP_GROUP_LABEL: "ag",
+                        WORKLOAD_SELECTOR_LABEL: f"w{int(rng.integers(0, n_wl))}",
+                    },
+                ))
+            pending, got, _ = solve_names(
+                [NetworkOverhead(), TopologicalSort()], cluster
+            )
+            expected = reference_network_loop(
+                nodes, pending, deps_of, zone_cost, region_cost
+            )
+            assert got == expected, f"seed {seed}: network divergence"
+
+
+class TestNetworkLabelEdges:
+    def test_region_only_and_unlabeled_candidates(self):
+        """Directed probe (caught a real bug): a candidate without a zone
+        label must MISS the zone-cost map (reference keys by "", never row
+        0), and two zoneless nodes in the same region count as same-zone
+        (networkoverhead.go:541-566)."""
+        cluster = Cluster()
+        nodes = []
+        specs = [
+            ("n0", {ZONE_LABEL: "z0", REGION_LABEL: "r0"}, 1),
+            ("n1", {REGION_LABEL: "r0"}, 50),   # region-only candidate
+            ("n2", {}, 50),                     # unlabeled candidate
+            ("n3", {ZONE_LABEL: "z1", REGION_LABEL: "r0"}, 50),
+        ]
+        for name, labels, pods in specs:
+            node = Node(name=name,
+                        allocatable={CPU: 32_000, MEMORY: 64 * gib, PODS: pods},
+                        labels=labels)
+            nodes.append(node)
+            cluster.add_node(node)
+        zone_cost = {("z0", "z0"): 1, ("z1", "z0"): 3, ("z0", "z1"): 3}
+        region_cost = {}
+        cluster.add_network_topology(NetworkTopology(
+            weights={"UserDefined": {"zone": zone_cost,
+                                     "region": region_cost}}))
+        w0 = AppGroupWorkload(selector="w0")
+        w1 = AppGroupWorkload(selector="w1")
+        w1.dependencies.append(
+            AppGroupDependency(workload_selector="w0", max_network_cost=5))
+        cluster.add_app_group(AppGroup(
+            name="ag", workloads=[w0, w1],
+            topology_order={"w0": 0, "w1": 1}))
+        for j, wl in enumerate(["w0", "w1", "w1"]):
+            cluster.add_pod(Pod(
+                name=f"p{j}", creation_ms=j,
+                containers=[Container(requests={CPU: 500, MEMORY: gib})],
+                labels={APP_GROUP_LABEL: "ag",
+                        WORKLOAD_SELECTOR_LABEL: wl}))
+        pending, got, _ = solve_names([NetworkOverhead()], cluster)
+        expected = reference_network_loop(
+            nodes, pending, {"w1": [("w0", 5)]}, zone_cost, region_cost)
+        assert got == expected
+        # n0 fills after p0; w1 pods must prefer n3 (known cost 3,
+        # satisfied) over the label-less candidates (MaxCost misses)
+        assert got == ["n0", "n3", "n3"]

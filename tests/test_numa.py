@@ -351,6 +351,17 @@ class TestF32Packing:
         a64, _ = self._solve(c, force_f64=True, strategy="LeastNUMANodes")
         assert a32.tolist() == a64.tolist()
 
+    def test_balanced_negative_live_capacity_parity(self):
+        # the pessimistic commit drives zones negative mid-cycle; the
+        # unclamped fractionOfCapacity (balanced_allocation.go:50-55) must
+        # stay bit-identical between the packed-f32 and f64 paths
+        c = self._mixed_cluster()
+        a32, snap = self._solve(c, strategy="BalancedAllocation")
+        assert snap.numa.pack_scales is not None
+        a64, _ = self._solve(c, force_f64=True, strategy="BalancedAllocation")
+        assert a32.tolist() == a64.tolist()
+        assert (a32 >= 0).sum() > 0
+
     def test_odd_quantities_disable_packing(self):
         # memory quantities not divisible by a useful power of two AND too
         # large for f32: guard must fall back to f64
