@@ -238,6 +238,22 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     )
 
 
+#: one source of truth for the config -> metric-name mapping (the error
+#: path must emit the same names the success paths do)
+CONFIG_METRICS = {
+    1: "pods_scheduled_per_sec", 2: "trimaran_pods_per_sec",
+    3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
+    5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
+}
+
+
+def metric_name(config: int, mode: str = "sequential") -> str:
+    metric = CONFIG_METRICS.get(config, CONFIG_METRICS[1])
+    if config in (2, 3, 4, 5) and mode == "batch":
+        metric = metric.replace("_pods_per_sec", "_batch_pods_per_sec")
+    return metric
+
+
 def sequential_config(config: int, mode: str = "sequential"):
     """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
     profile-generic batched throughput mode (--mode batch)."""
@@ -255,21 +271,22 @@ def sequential_config(config: int, mode: str = "sequential"):
     if config == 2:
         cluster = trimaran_scenario(n_nodes=5000, n_pods=2048)
         plugins = [P.TargetLoadPacking(), P.LoadVariationRiskBalancing()]
-        metric, detail = "trimaran_pods_per_sec", "5000 nodes, TLP+LVRB, sequential"
+        detail = "5000 nodes, TLP+LVRB, sequential"
     elif config == 3:
         cluster = numa_scenario(n_nodes=1024, n_pods=512, zones=8)
         plugins = [P.NodeResourceTopologyMatch()]
-        metric, detail = "numa_pods_per_sec", "1024 nodes x 8 zones, sequential"
+        detail = "1024 nodes x 8 zones, sequential"
     elif config == 4:
         cluster = gang_quota_scenario(n_gangs=32, gang_size=64, n_nodes=1024)
         plugins = [P.NodeResourcesAllocatable(), P.Coscheduling(), P.CapacityScheduling()]
-        metric, detail = "gang_quota_pods_per_sec", "32 gangs x 64, 1024 nodes, sequential"
+        detail = "32 gangs x 64, 1024 nodes, sequential"
     elif config == 5:
         cluster = network_scenario(n_nodes=1024, n_pods=1024)
         plugins = [P.NetworkOverhead(), P.TopologicalSort()]
-        metric, detail = "network_pods_per_sec", "1024 nodes multi-region, sequential"
+        detail = "1024 nodes multi-region, sequential"
     else:
         raise SystemExit(f"unknown config {config}")
+    metric = metric_name(config, mode)
 
     scheduler = Scheduler(Profile(plugins=plugins))
     pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
@@ -281,7 +298,6 @@ def sequential_config(config: int, mode: str = "sequential"):
         from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
 
         detail = detail.replace("sequential", "batched")
-        metric = metric.replace("_pods_per_sec", "_batch_pods_per_sec")
 
         def run():
             return profile_batch_solve(scheduler, snap)[0]
@@ -315,15 +331,8 @@ if __name__ == "__main__":
     diagnosis = backend_probe()
     if diagnosis is not None:
         # one parseable line, rc=0 — the environment is sick, not the code
-        metric = {
-            1: "pods_scheduled_per_sec", 2: "trimaran_pods_per_sec",
-            3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
-            5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
-        }.get(args.config, "pods_scheduled_per_sec")
-        if args.config in (2, 3, 4, 5) and args.mode == "batch":
-            metric = metric.replace("_pods_per_sec", "_batch_pods_per_sec")
         print(json.dumps({
-            "metric": metric, "value": 0, "unit": "pods/s",
+            "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
             "vs_baseline": 0.0, "error": "tpu-backend-unavailable",
             "detail": diagnosis,
         }))

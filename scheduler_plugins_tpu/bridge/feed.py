@@ -17,8 +17,35 @@ store when attached):
     {"op": "upsert_pod_group"|"delete_pod_group", ...}
     {"op": "metrics", "nodes": {node: {"cpu_avg": ..., ...}}}
 
+Protocol v2 covers the FULL CR surface the reference's informers watch
+(plugin.go:86-115 NRT; networkoverhead.go:136-171 AppGroup/NetworkTopology;
+sysched.go:305-396 pod/profile handlers; PriorityClass/PDB consumed by the
+preemption tier):
+
+    {"op": "upsert_nrt", "node": ..., "policy": int, "scope": int,
+     "max_numa_nodes": 8, "pod_fingerprint": "...",
+     "zones": [{"numa_id": 0, "available": {...}, "allocatable": {...},
+                "costs": {"1": 20}}]}                      | "delete_nrt"
+    {"op": "upsert_app_group", "name": ..., "namespace": ...,
+     "workloads": [{"selector": ..., "dependencies":
+                    [{"workload_selector": ..., "max_network_cost": 10}]}],
+     "topology_order": {selector: index}}                  | "delete_app_group"
+    {"op": "upsert_network_topology", "name": ..., "weights":
+     {weightsName: {"zone"|"region": [[orig, dest, cost], ...]}}}
+                                                  | "delete_network_topology"
+    {"op": "upsert_seccomp_profile", "name": ..., "syscalls": [...]}
+                                                  | "delete_seccomp_profile"
+    {"op": "upsert_priority_class", "name": ..., "value": 0,
+     "annotations": {...}}                        | "delete_priority_class"
+    {"op": "upsert_pdb", "name": ..., "selector": {...},
+     "disruptions_allowed": 1, "disrupted_pods": [...]}    | "delete_pdb"
+
 Pod events may carry scheduler_name/phase/deletion_ms so foreign-pod
-detection and lifecycle accounting work through this boundary. A bound pod
+detection and lifecycle accounting work through this boundary, plus the full
+spec surface: "containers"/"init_containers" (each {"requests", "limits",
+"restart_policy_always", "seccomp_profile"}), "overhead", "annotations",
+"nominated_node", "priority_class_name" and "scheduling_gated" — the
+single-container "requests"/"limits" shorthand remains valid. A bound pod
 is not demoted by a stale echo without a node (informer-cache semantics).
 
 Each line is acknowledged with {"ok": true} or {"ok": false, "error": ...};
@@ -35,13 +62,34 @@ import threading
 from typing import Optional
 
 from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
     Container,
     ElasticQuota,
+    NetworkTopology,
     Node,
+    NodeResourceTopology,
+    NUMAZone,
     Pod,
+    PodDisruptionBudget,
     PodGroup,
+    PriorityClass,
+    SeccompProfile,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
 )
 from scheduler_plugins_tpu.state.cluster import Cluster
+
+
+def _container(spec: dict) -> Container:
+    return Container(
+        name=spec.get("name", "c"),
+        requests={k: int(v) for k, v in spec.get("requests", {}).items()},
+        limits={k: int(v) for k, v in spec.get("limits", {}).items()},
+        restart_policy_always=bool(spec.get("restart_policy_always", False)),
+        seccomp_profile=spec.get("seccomp_profile"),
+    )
 
 
 def apply_event(cluster: Cluster, event: dict) -> dict:
@@ -57,6 +105,15 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
             )
         )
     elif op == "upsert_pod":
+        if "containers" in event:
+            containers = [_container(c) for c in event["containers"]]
+        else:  # single-container shorthand (protocol v1)
+            containers = [
+                Container(
+                    requests={k: int(v) for k, v in event.get("requests", {}).items()},
+                    limits={k: int(v) for k, v in event.get("limits", {}).items()},
+                )
+            ]
         pod = Pod(
             name=event["name"],
             namespace=event.get("namespace", "default"),
@@ -64,19 +121,22 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
             priority=int(event.get("priority", 0)),
             creation_ms=int(event.get("creation_ms", 0)),
             labels=event.get("labels", {}),
+            annotations=event.get("annotations", {}),
             scheduler_name=event.get(
                 "scheduler_name", "tpu-scheduler"
             ),
             phase=event.get("phase", "Pending"),
             deletion_ms=event.get("deletion_ms"),
-            containers=[
-                Container(
-                    requests={k: int(v) for k, v in event.get("requests", {}).items()},
-                    limits={k: int(v) for k, v in event.get("limits", {}).items()},
-                )
+            scheduling_gated=bool(event.get("scheduling_gated", False)),
+            priority_class_name=event.get("priority_class_name", ""),
+            overhead={k: int(v) for k, v in event.get("overhead", {}).items()},
+            containers=containers,
+            init_containers=[
+                _container(c) for c in event.get("init_containers", [])
             ],
         )
         pod.node_name = event.get("node")
+        pod.nominated_node_name = event.get("nominated_node")
         existing = cluster.pods.get(pod.uid)
         if existing is not None and existing.node_name is not None and pod.node_name is None:
             # stale watch echo predating our bind: the local binding is the
@@ -117,6 +177,123 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
                 },
                 creation_ms=int(event.get("creation_ms", 0)),
             )
+        )
+    elif op == "upsert_nrt":
+        cluster.add_nrt(
+            NodeResourceTopology(
+                node_name=event["node"],
+                policy=TopologyManagerPolicy(int(event.get("policy", 0))),
+                scope=TopologyManagerScope(int(event.get("scope", 0))),
+                max_numa_nodes=int(event.get("max_numa_nodes", 8)),
+                pod_fingerprint=event.get("pod_fingerprint", ""),
+                zones=[
+                    NUMAZone(
+                        numa_id=int(z["numa_id"]),
+                        available={
+                            k: int(v)
+                            for k, v in z.get("available", {}).items()
+                        },
+                        allocatable={
+                            k: int(v)
+                            for k, v in z.get("allocatable", {}).items()
+                        },
+                        costs={
+                            int(k): int(v)
+                            for k, v in z.get("costs", {}).items()
+                        },
+                    )
+                    for z in event.get("zones", [])
+                ],
+            )
+        )
+    elif op == "delete_nrt":
+        cluster.remove_nrt(event["node"])
+    elif op == "upsert_app_group":
+        cluster.add_app_group(
+            AppGroup(
+                name=event["name"],
+                namespace=event.get("namespace", "default"),
+                workloads=[
+                    AppGroupWorkload(
+                        selector=w["selector"],
+                        dependencies=[
+                            AppGroupDependency(
+                                workload_selector=d["workload_selector"],
+                                max_network_cost=int(
+                                    d.get("max_network_cost", 0)
+                                ),
+                            )
+                            for d in w.get("dependencies", [])
+                        ],
+                    )
+                    for w in event.get("workloads", [])
+                ],
+                topology_order={
+                    k: int(v)
+                    for k, v in event.get("topology_order", {}).items()
+                },
+            )
+        )
+    elif op == "delete_app_group":
+        cluster.app_groups.pop(
+            f"{event.get('namespace', 'default')}/{event['name']}", None
+        )
+    elif op == "upsert_network_topology":
+        # (origin, dest) pairs ride as [orig, dest, cost] triples on the wire
+        cluster.add_network_topology(
+            NetworkTopology(
+                name=event.get("name", "nt-default"),
+                namespace=event.get("namespace", "default"),
+                weights={
+                    wname: {
+                        key: {
+                            (str(o), str(d)): int(c) for o, d, c in triples
+                        }
+                        for key, triples in keys.items()
+                    }
+                    for wname, keys in event.get("weights", {}).items()
+                },
+            )
+        )
+    elif op == "delete_network_topology":
+        cluster.network_topologies.pop(
+            f"{event.get('namespace', 'default')}/{event['name']}", None
+        )
+    elif op == "upsert_seccomp_profile":
+        cluster.add_seccomp_profile(
+            SeccompProfile(
+                name=event["name"],
+                namespace=event.get("namespace", "default"),
+                syscalls=frozenset(event.get("syscalls", [])),
+            )
+        )
+    elif op == "delete_seccomp_profile":
+        cluster.seccomp_profiles.pop(
+            f"{event.get('namespace', 'default')}/{event['name']}", None
+        )
+    elif op == "upsert_priority_class":
+        cluster.add_priority_class(
+            PriorityClass(
+                name=event["name"],
+                value=int(event.get("value", 0)),
+                annotations=event.get("annotations", {}),
+            )
+        )
+    elif op == "delete_priority_class":
+        cluster.priority_classes.pop(event["name"], None)
+    elif op == "upsert_pdb":
+        cluster.add_pdb(
+            PodDisruptionBudget(
+                name=event["name"],
+                namespace=event.get("namespace", "default"),
+                selector=event.get("selector", {}),
+                disruptions_allowed=int(event.get("disruptions_allowed", 0)),
+                disrupted_pods=frozenset(event.get("disrupted_pods", [])),
+            )
+        )
+    elif op == "delete_pdb":
+        cluster.pdbs.pop(
+            f"{event.get('namespace', 'default')}/{event['name']}", None
         )
     elif op == "metrics":
         cluster.node_metrics = event["nodes"]

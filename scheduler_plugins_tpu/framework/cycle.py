@@ -173,7 +173,9 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         for victim_uid in result.victims:
             victim = cluster.pods.get(victim_uid)
             if victim is not None:
-                victim.deletion_ms = now  # DELETE issued; kubelet terminates
+                # DELETE issued; kubelet terminates (keeps the native
+                # mirror's terminating counts in sync too)
+                cluster.mark_terminating(victim_uid, now)
                 victim_freed += encode_demand(meta.index, victim)
         # net effect on the node for later preemptors: nominee demand minus
         # the capacity its victims will free

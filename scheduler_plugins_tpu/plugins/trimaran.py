@@ -208,10 +208,11 @@ class Peaks(Plugin):
         a_k1, a_k2 = self._aux
         k1 = jnp.zeros(N, jnp.float64).at[: a_k1.shape[0]].set(a_k1)
         k2 = jnp.zeros(N, jnp.float64).at[: a_k2.shape[0]].set(a_k2)
-        # Peaks needs an Average/Latest CPU sample (peaks.go:118-131) —
-        # cpu_valid alone is satisfied by a std-only report
+        # Peaks needs an Average/Latest CPU sample and takes the FIRST one in
+        # report order (peaks.go:118-131) — cpu_valid alone is satisfied by a
+        # std-only report, and cpu_avg/cpu_tlp have different selection rules
         return peaks_score(
-            snap.metrics.cpu_avg,
+            snap.metrics.cpu_peaks,
             snap.metrics.cpu_tlp_valid,
             snap.nodes.capacity[:, CPU_I],
             snap.pods.req[p, CPU_I],

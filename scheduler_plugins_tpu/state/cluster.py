@@ -55,18 +55,135 @@ class Cluster:
     #: /root/reference/pkg/trimaran/handler.go:47-171): uid -> (bind ms, node)
     recent_bindings: dict[str, tuple[int, str]] = field(default_factory=dict)
 
+    # -- native mirror ----------------------------------------------------
+    def attach_native_store(self):
+        """Mirror the hot node columns into the C++ columnar store
+        (bridge/snapshot_store.cc) so snapshots read them via memcpy exports
+        instead of an O(assigned pods) Python accumulate per cycle (the
+        informer-cache -> NodeInfo lowering the reference keeps in Go).
+        Replays current state; subsequent upserts/binds/deletes maintain it
+        incrementally. The fast path engages only when the snapshot's
+        resource axis is exactly the canonical four (the store layout,
+        CLAUDE.md invariant) and no side-table subsystems need the assigned
+        pod objects."""
+        from scheduler_plugins_tpu.bridge import NativeStore
+        from scheduler_plugins_tpu.api.resources import CANONICAL
+
+        self._native = NativeStore(len(CANONICAL))
+        self._native_node_ids: dict[str, int] = {}
+        self._native_pod_ids: dict[str, int] = {}
+        #: monotonic — deletions must never free an id for reuse, or a new
+        #: pod would silently replace a live one's store contribution
+        self._native_next_pod_id = 0
+        #: object keys carrying extended resources the 4-slot store cannot
+        #: represent; the fast path disengages while any are LIVE (deleting
+        #: the object re-enables it)
+        self._native_incompat: set[str] = set()
+        self._native_replaying = True
+        try:
+            for node in self.nodes.values():
+                self._native_upsert_node(node)
+            for pod in self.pods.values():
+                self._native_upsert_pod(pod)  # re-binds live reservations
+        finally:
+            self._native_replaying = False
+        return self._native
+
+    @property
+    def native(self):
+        return getattr(self, "_native", None)
+
+    def _canon_vec(self, key, *quantity_maps):
+        from scheduler_plugins_tpu.api.resources import CANONICAL
+
+        import numpy as np
+
+        vecs = []
+        incompat = False
+        for quantities in quantity_maps:
+            vec = np.zeros(len(CANONICAL), np.int64)
+            for r, v in quantities.items():
+                try:
+                    vec[CANONICAL.index(r)] = v
+                except ValueError:
+                    # extended resource: the 4-slot store can't carry it
+                    incompat = True
+            vecs.append(vec)
+        if incompat:
+            self._native_incompat.add(key)
+        else:
+            self._native_incompat.discard(key)
+        return vecs
+
+    def _native_upsert_node(self, node: Node):
+        if node.name not in self._native_node_ids:
+            self._native_node_ids[node.name] = len(self._native_node_ids)
+        alloc, cap = self._canon_vec(
+            f"node/{node.name}", node.allocatable, node.capacity
+        )
+        self._native.upsert_node(self._native_node_ids[node.name], alloc, cap)
+        if getattr(self, "_native_replaying", False):
+            return  # the attach replay upserts every pod afterwards anyway
+        # pods mirrored before their node arrived (cross-watch event
+        # ordering) were stored unbound: re-upsert them now
+        for pod in self.pods.values():
+            if pod.node_name == node.name:
+                self._native_upsert_pod(pod)
+        for uid, rnode in self.reserved.items():
+            if rnode == node.name and uid in self._native_pod_ids:
+                self._native.bind(
+                    self._native_pod_ids[uid],
+                    self._native_node_ids[node.name],
+                )
+
+    def _native_upsert_pod(self, pod: Pod):
+        if pod.uid not in self._native_pod_ids:
+            # ids are never reused: a delete+re-add is a new incarnation
+            self._native_pod_ids[pod.uid] = self._native_next_pod_id
+            self._native_next_pod_id += 1
+        req, lim = self._canon_vec(
+            f"pod/{pod.uid}", pod.effective_request(), pod.effective_limits()
+        )
+        self._native.upsert_pod(
+            self._native_pod_ids[pod.uid],
+            req,
+            limits=lim,
+            priority=pod.priority,
+            creation_ms=pod.creation_ms,
+            node_id=self._native_node_ids.get(pod.node_name, -1),
+            terminating=pod.terminating,
+        )
+        # a re-upsert of a permit-reserved pod must not drop its hold
+        rnode = self.reserved.get(pod.uid)
+        if rnode is not None and rnode in self._native_node_ids:
+            self._native.bind(
+                self._native_pod_ids[pod.uid], self._native_node_ids[rnode]
+            )
+
+    def _native_rebuild(self):
+        """Node deletion invalidates store row order: replay from scratch
+        (rare control-plane event; everything else is incremental)."""
+        self._native.close()
+        self.attach_native_store()
+
     # -- upserts ---------------------------------------------------------
     def add_node(self, node: Node):
         self.nodes[node.name] = node
+        if self.native is not None:
+            self._native_upsert_node(node)
 
     def remove_node(self, name: str):
         self.nodes.pop(name, None)
+        if self.native is not None:
+            self._native_rebuild()
 
     def add_pod(self, pod: Pod):
         self.pods[pod.uid] = pod
         if self.nrt_cache is not None and hasattr(self.nrt_cache, "track_pod"):
             # foreign-pod detection (cache/foreign_pods.go:42-99)
             self.nrt_cache.track_pod(pod)
+        if self.native is not None:
+            self._native_upsert_pod(pod)
 
     def remove_pod(self, uid: str):
         self.release_reservation(uid)  # notifies the NRT cache too
@@ -78,6 +195,21 @@ class Cluster:
         ):
             # a bound pod's assumed deduction must not outlive the pod
             self.nrt_cache.unreserve(pod.node_name, pod)
+        if pod is not None and self.native is not None:
+            pod_id = self._native_pod_ids.pop(uid, None)
+            if pod_id is not None:
+                self._native.delete_pod(pod_id)
+            self._native_incompat.discard(f"pod/{uid}")
+
+    def mark_terminating(self, uid: str, now_ms: int):
+        """DELETE issued (preemption victim): flips the terminating flag in
+        both the object model and the native mirror."""
+        pod = self.pods.get(uid)
+        if pod is None:
+            return
+        pod.deletion_ms = now_ms
+        if self.native is not None:
+            self._native_upsert_pod(pod)
 
     def add_pod_group(self, pg: PodGroup):
         self.pod_groups[pg.full_name] = pg
@@ -89,6 +221,13 @@ class Cluster:
         self.nrts[nrt.node_name] = nrt
         if self.nrt_cache is not None:
             self.nrt_cache.update_nrt(nrt)
+
+    def remove_nrt(self, node_name: str):
+        """NRT CR deleted: evict from the cache tier too, or the snapshot
+        keeps building NUMA tables from the stale copy forever."""
+        self.nrts.pop(node_name, None)
+        if self.nrt_cache is not None:
+            self.nrt_cache.delete_nrt(node_name)
 
     def add_app_group(self, ag: AppGroup):
         self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
@@ -154,17 +293,30 @@ class Cluster:
             # Reserve -> bind -> PostBind lifecycle for the NRT cache
             self.nrt_cache.reserve(node_name, self.pods[uid])
             self.nrt_cache.post_bind(node_name, self.pods[uid])
+        if self.native is not None:
+            # no-op if the reservation already bound it to this node
+            self._native.bind(
+                self._native_pod_ids[uid], self._native_node_ids[node_name]
+            )
 
     def reserve(self, uid: str, node_name: str):
         """Permit said Wait: hold the placement without binding."""
         self.reserved[uid] = node_name
         if self.nrt_cache is not None:
             self.nrt_cache.reserve(node_name, self.pods[uid])
+        if self.native is not None:
+            # a reservation holds capacity exactly like a binding
+            self._native.bind(
+                self._native_pod_ids[uid], self._native_node_ids[node_name]
+            )
 
     def release_reservation(self, uid: str):
         node = self.reserved.pop(uid, None)
         if node is not None and self.nrt_cache is not None:
             self.nrt_cache.unreserve(node, self.pods[uid])
+        if node is not None and self.native is not None:
+            # re-upsert as unbound (removes the hold's contribution)
+            self._native_upsert_pod(self.pods[uid])
 
     def gang_reservations(self, pg: PodGroup) -> list[str]:
         return [
@@ -213,15 +365,39 @@ class Cluster:
         """Lower current state for the solver. Reserved (permit-waiting) pods
         count as assigned to their reserved node — they hold capacity and
         quorum exactly like the reference's waiting pods in assignedPodsByPG."""
-        assigned = [p for p in self.pods.values() if p.node_name is not None]
-        for uid, node in self.reserved.items():
-            pod = self.pods.get(uid)
-            if pod is not None and pod.node_name is None:
-                import copy
+        # native fast path: node usage columns come from the C++ store,
+        # which accounts every bound AND reserved pod incrementally — the
+        # O(assigned) Python accumulate is skipped. Assigned pod objects are
+        # still needed whenever a side-table subsystem reads them.
+        native_exports = None
+        if (
+            self.native is not None
+            and not self._native_incompat
+            and not self.pod_groups
+            and not self.quotas
+            and not self.app_groups
+            and not self.seccomp_profiles
+        ):
+            exports = self._native.export_nodes()
+            if len(exports["ids"]) == len(self.nodes) and all(
+                self._native_node_ids.get(n) == i
+                for i, n in enumerate(self.nodes)
+            ):
+                native_exports = exports
+        if native_exports is not None:
+            assigned = []
+        else:
+            assigned = [
+                p for p in self.pods.values() if p.node_name is not None
+            ]
+            for uid, node in self.reserved.items():
+                pod = self.pods.get(uid)
+                if pod is not None and pod.node_name is None:
+                    import copy
 
-                held = copy.copy(pod)
-                held.node_name = node
-                assigned.append(held)
+                    held = copy.copy(pod)
+                    held.node_name = node
+                    assigned.append(held)
         backed_off = [
             name
             for name, until in self.gang_backoff_until_ms.items()
@@ -246,5 +422,6 @@ class Cluster:
             backed_off_gangs=backed_off,
             extra_pods=self.gated_pods(),
             seccomp_profiles=list(self.seccomp_profiles.values()),
+            native_nodes=native_exports,
             **kwargs,
         )

@@ -57,6 +57,9 @@ def parse_watcher_metrics(payload: dict) -> dict[str, dict]:
                     # TargetLoadPacking's own selection lets a later
                     # Latest override Average (targetloadpacking.go:130-139)
                     entry["cpu_tlp"] = value
+                    # Peaks breaks on the FIRST Average-or-Latest sample
+                    # (peaks.go:118-131)
+                    entry.setdefault("cpu_peaks", value)
             elif mtype == MEMORY:
                 if op == AVERAGE:
                     entry["mem_avg"] = value

@@ -90,6 +90,14 @@ class NrtCache:
     def update_nrt(self, nrt: NodeResourceTopology) -> None:  # informer event
         raise NotImplementedError
 
+    def delete_nrt(self, node: str) -> None:  # informer delete event
+        """CR deleted: the node no longer publishes topology; every cache
+        tier must drop its copy (and any pending resync state)."""
+        for attr in ("nrts", "pending"):
+            store = getattr(self, attr, None)
+            if store is not None:
+                store.pop(node, None)
+
 
 class PassthroughCache(NrtCache):
     """Live API reads, always fresh (cache/passthrough.go)."""

@@ -39,6 +39,7 @@ from scheduler_plugins_tpu.api.objects import (
     PodGroup,
 )
 from scheduler_plugins_tpu.api.resources import (
+    CANONICAL,
     CPU,
     DEFAULT_MEMORY_REQUEST,
     DEFAULT_MILLI_CPU_REQUEST,
@@ -141,6 +142,9 @@ class MetricsState:
     #: later Latest override Average (targetloadpacking.go:130-139); defaults
     #: to cpu_avg
     cpu_tlp: np.ndarray
+    #: (N,) the CPU value Peaks reads — the FIRST Average-or-Latest sample in
+    #: report order (peaks.go:118-131); defaults to cpu_tlp/cpu_avg
+    cpu_peaks: np.ndarray
     cpu_std: np.ndarray  # (N,) float64 %
     mem_avg: np.ndarray  # (N,) float64 %
     mem_std: np.ndarray  # (N,) float64 %
@@ -317,6 +321,7 @@ def build_snapshot(
     extra_pods: Sequence[Pod] = (),
     stale_nrt_nodes: Sequence[str] = (),
     seccomp_profiles: Sequence = (),
+    native_nodes: Optional[dict] = None,
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -325,6 +330,13 @@ def build_snapshot(
     contribute to node usage / gang+quota accounting. `extra_pods` are pods
     that are neither schedulable nor assigned (e.g. SchedulingGated) but still
     count toward gang membership and gated-quorum accounting.
+
+    `native_nodes`, when given, is a `bridge.NativeStore.export_nodes()` dict
+    whose rows are in the SAME order as `nodes`; the hot node columns (alloc,
+    capacity, requested, nonzero, limits, pod_count, terminating) are taken
+    from it verbatim — the caller guarantees the store already accounts every
+    assigned/reserved pod, so `assigned_pods` should be empty. Engaged only
+    when the resource axis is exactly the canonical four (the store layout).
     """
     index = ResourceIndex.union(
         {r: 0 for r in extra_resources},
@@ -361,11 +373,13 @@ def build_snapshot(
     terminating = np.zeros(N, I32)
     nominated = np.zeros(N, I32)
 
+    use_native = native_nodes is not None and tuple(index.names) == CANONICAL
     node_pos = {}
     for i, node in enumerate(nodes):
         node_pos[node.name] = i
-        alloc[i] = index.encode(node.allocatable)
-        capacity[i] = index.encode(node.capacity)
+        if not use_native:
+            alloc[i] = index.encode(node.allocatable)
+            capacity[i] = index.encode(node.capacity)
         node_mask[i] = not node.unschedulable
         if node.region:
             region[i] = regions_in.code(node.region)
@@ -385,23 +399,37 @@ def build_snapshot(
             seen_nominated.add(pod.uid)
             nominated[node_pos[pod.nominated_node_name]] += 1
 
-    for pod in assigned_pods:
-        if pod.node_name is None or pod.node_name not in node_pos:
-            continue
-        i = node_pos[pod.node_name]
-        req = index.encode(pod.effective_request())
-        requested[i] += req
-        nonzero_req[i] += nonzero_request(req, index)
-        # limits clamped to >= requests per pod (SetMaxLimits)
-        node_limits[i] += np.maximum(index.encode(pod.effective_limits()), req)
-        pod_count[i] += 1
-        if pod.terminating:
-            terminating[i] += 1
-
-    # the "pods" resource is accounted as a count, not a request sum
     pods_i = index.position(PODS)
-    requested[:, pods_i] = pod_count
-    nonzero_req[:, pods_i] = pod_count
+    if use_native:
+        # hot columns straight from the C++ store exports (the store
+        # already accounts every assigned/reserved pod, pods slot included)
+        n_act = len(nodes)
+        alloc[:n_act] = native_nodes["alloc"]
+        capacity[:n_act] = native_nodes["capacity"]
+        requested[:n_act] = native_nodes["requested"]
+        nonzero_req[:n_act] = native_nodes["nonzero_requested"]
+        node_limits[:n_act] = native_nodes["limits"]
+        pod_count[:n_act] = native_nodes["pod_count"]
+        terminating[:n_act] = native_nodes["terminating"]
+    else:
+        for pod in assigned_pods:
+            if pod.node_name is None or pod.node_name not in node_pos:
+                continue
+            i = node_pos[pod.node_name]
+            req = index.encode(pod.effective_request())
+            requested[i] += req
+            nonzero_req[i] += nonzero_request(req, index)
+            # limits clamped to >= requests per pod (SetMaxLimits)
+            node_limits[i] += np.maximum(
+                index.encode(pod.effective_limits()), req
+            )
+            pod_count[i] += 1
+            if pod.terminating:
+                terminating[i] += 1
+
+        # the "pods" resource is accounted as a count, not a request sum
+        requested[:, pods_i] = pod_count
+        nonzero_req[:, pods_i] = pod_count
 
     node_state = NodeState(
         alloc=alloc,
@@ -607,6 +635,7 @@ def build_snapshot(
     if node_metrics is not None:
         cpu_avg = np.zeros(N, F64)
         cpu_tlp = np.zeros(N, F64)
+        cpu_peaks = np.zeros(N, F64)
         cpu_std = np.zeros(N, F64)
         mem_avg = np.zeros(N, F64)
         mem_std = np.zeros(N, F64)
@@ -621,6 +650,9 @@ def build_snapshot(
             if "cpu_avg" in m:
                 cpu_avg[i] = m["cpu_avg"]
             cpu_tlp[i] = m.get("cpu_tlp", m.get("cpu_avg", 0.0))
+            cpu_peaks[i] = m.get(
+                "cpu_peaks", m.get("cpu_tlp", m.get("cpu_avg", 0.0))
+            )
             cpu_std[i] = m.get("cpu_std", 0.0)
             # a node with ANY cpu sample (avg/latest or std-only) is valid:
             # GetResourceData returns isValid=true, avg=0 for std-only
@@ -635,6 +667,7 @@ def build_snapshot(
         metrics_state = MetricsState(
             cpu_avg=cpu_avg,
             cpu_tlp=cpu_tlp,
+            cpu_peaks=cpu_peaks,
             cpu_std=cpu_std,
             mem_avg=mem_avg,
             mem_std=mem_std,

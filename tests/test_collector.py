@@ -63,13 +63,18 @@ class TestParse:
     def test_operator_selection_rules(self):
         metrics = parse_watcher_metrics(WATCHER_JSON)
         assert metrics["hot"] == {
-            "cpu_avg": 70.0, "cpu_tlp": 70.0, "cpu_std": 8.0, "mem_avg": 55.0,
+            "cpu_avg": 70.0, "cpu_tlp": 70.0, "cpu_peaks": 70.0,
+            "cpu_std": 8.0, "mem_avg": 55.0,
         }
-        assert metrics["cold"] == {"cpu_avg": 10.0, "cpu_tlp": 10.0, "mem_avg": 12.0}
+        assert metrics["cold"] == {
+            "cpu_avg": 10.0, "cpu_tlp": 10.0, "cpu_peaks": 10.0,
+            "mem_avg": 12.0,
+        }
 
     def test_average_wins_over_latest_except_tlp(self):
-        # GetResourceData prefers Average (LVRB/LROC path), while TLP's own
-        # loop takes the LAST Average-or-Latest (targetloadpacking.go:130-139)
+        # GetResourceData prefers Average (LVRB/LROC path), TLP's own loop
+        # takes the LAST Average-or-Latest (targetloadpacking.go:130-139),
+        # and Peaks breaks on the FIRST (peaks.go:118-131)
         payload = {"Data": {"NodeMetricsMap": {"n": {"Metrics": [
             {"Type": "CPU", "Operator": "Average", "Value": 40.0},
             {"Type": "CPU", "Operator": "Latest", "Value": 99.0},
@@ -77,6 +82,17 @@ class TestParse:
         parsed = parse_watcher_metrics(payload)["n"]
         assert parsed["cpu_avg"] == 40.0
         assert parsed["cpu_tlp"] == 99.0
+        assert parsed["cpu_peaks"] == 40.0
+
+    def test_peaks_takes_first_latest_before_average(self):
+        payload = {"Data": {"NodeMetricsMap": {"n": {"Metrics": [
+            {"Type": "CPU", "Operator": "Latest", "Value": 80.0},
+            {"Type": "CPU", "Operator": "Average", "Value": 30.0},
+        ]}}}}
+        parsed = parse_watcher_metrics(payload)["n"]
+        assert parsed["cpu_avg"] == 30.0   # Average overrides for LVRB/LROC
+        assert parsed["cpu_tlp"] == 30.0   # last Average-or-Latest
+        assert parsed["cpu_peaks"] == 80.0  # first Average-or-Latest
 
 
 class TestHTTPCollector:

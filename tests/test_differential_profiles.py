@@ -718,3 +718,308 @@ class TestNetworkLabelEdges:
         # n0 fills after p0; w1 pods must prefer n3 (known cost 3,
         # satisfied) over the label-less candidates (MaxCost misses)
         assert got == ["n0", "n3", "n3"]
+
+
+# ---------------------------------------------------------------------------
+# Preemption victim-selection oracle
+# ---------------------------------------------------------------------------
+
+
+def _demand(pod):
+    d = dict(pod.effective_request())
+    d[PODS] = 1
+    return d
+
+
+def _vec_le(a, b):
+    return all(a.get(r, 0) <= b.get(r, 0) for r in set(a) | set(b))
+
+
+def _le_max(a, qmax):
+    """used <= Max with absent Max entries UNBOUNDED (UpperBound semantics,
+    elasticquota.go:96-120)."""
+    return all(a.get(r, 0) <= qmax[r] for r in qmax)
+
+
+def _vadd(a, b, sign=1):
+    out = dict(a)
+    for r, v in b.items():
+        out[r] = out.get(r, 0) + sign * v
+    return out
+
+
+def reference_preempt(nodes, assigned, preemptor, quotas, pdbs, mode):
+    """SelectVictimsOnNode + pickOneNode from the reference semantics
+    (capacity_scheduling.go:486-677, 889-934; upstream preemption evaluator).
+    quotas: ns -> {"min", "max"}; returns (node, [victim uids]) or None."""
+    victims_all = [v for v in assigned if not v.terminating]
+    used = {ns: {} for ns in quotas}
+    for v in victims_all:
+        if v.namespace in quotas:
+            used[v.namespace] = _vadd(
+                used[v.namespace], v.effective_request()
+            )
+
+    def over_min(ns):
+        return any(
+            used[ns].get(r, 0) > quotas[ns]["min"].get(r, 0)
+            for r in set(used[ns]) | set(quotas[ns]["min"])
+        )
+
+    p_ns = preemptor.namespace
+    p_req = preemptor.effective_request()
+    if mode == "capacity" and p_ns in quotas:
+        more_than_min = any(
+            used[p_ns].get(r, 0) + p_req.get(r, 0)
+            > quotas[p_ns]["min"].get(r, 0)
+            for r in set(used[p_ns]) | set(p_req) | set(quotas[p_ns]["min"])
+        )
+        if more_than_min:
+            eligible = [
+                v for v in victims_all
+                if v.namespace == p_ns and v.priority < preemptor.priority
+            ]
+        else:
+            eligible = [
+                v for v in victims_all
+                if v.namespace != p_ns and v.namespace in quotas
+                and over_min(v.namespace)
+            ]
+    elif mode == "capacity":
+        eligible = [
+            v for v in victims_all
+            if v.namespace not in quotas and v.priority < preemptor.priority
+        ]
+    else:
+        eligible = [
+            v for v in victims_all if v.priority < preemptor.priority
+        ]
+    if not eligible:
+        return None
+
+    free = {n.name: dict(n.allocatable) for n in nodes}
+    for n in nodes:
+        free[n.name].setdefault(PODS, 0)
+    for v in assigned:
+        free[v.node_name] = _vadd(free[v.node_name], _demand(v), -1)
+    demand_p = _demand(preemptor)
+    agg_min = {}
+    for ns in quotas:
+        agg_min = _vadd(agg_min, quotas[ns]["min"])
+
+    best = None
+    for idx, n in enumerate(nodes):
+        vs = sorted(
+            (v for v in eligible if v.node_name == n.name),
+            key=lambda v: (-v.priority, v.creation_ms),
+        )
+        if not vs:
+            continue
+        removed = {}
+        for v in vs:
+            removed = _vadd(removed, _demand(v))
+        if not _vec_le(demand_p, _vadd(free[n.name], removed)):
+            continue
+        if mode == "capacity" and p_ns in quotas:
+            used_post = {ns: dict(used[ns]) for ns in quotas}
+            for v in vs:
+                if v.namespace in quotas:
+                    used_post[v.namespace] = _vadd(
+                        used_post[v.namespace], v.effective_request(), -1
+                    )
+            if not _le_max(
+                _vadd(used_post[p_ns], p_req), quotas[p_ns]["max"]
+            ):
+                continue
+            agg_post = {}
+            for ns in quotas:
+                agg_post = _vadd(agg_post, used_post[ns])
+            if not _vec_le(_vadd(agg_post, p_req), agg_min):
+                continue
+        # PDB partition in most-important-first order; violating reprieved
+        # first (capacity_scheduling.go:889-934 + 632-670)
+        allowed = {pdb.name: pdb.disruptions_allowed for pdb in pdbs}
+        violating, non_violating = [], []
+        for v in vs:
+            hit = False
+            for pdb in pdbs:
+                if pdb.matches(v) and v.name not in pdb.disrupted_pods:
+                    allowed[pdb.name] -= 1
+                    if allowed[pdb.name] < 0:
+                        hit = True
+            (violating if hit else non_violating).append(v)
+        order = violating + non_violating
+        violating_set = {v.uid for v in violating}
+        free_after = _vadd(free[n.name], removed)
+        used_sim = (
+            {ns: dict(used[ns]) for ns in quotas} if quotas else {}
+        )
+        if mode == "capacity" and p_ns in quotas:
+            for v in vs:
+                if v.namespace in quotas:
+                    used_sim[v.namespace] = _vadd(
+                        used_sim[v.namespace], v.effective_request(), -1
+                    )
+        final, n_viol = [], 0
+        for v in order:
+            cand_free = _vadd(free_after, _demand(v), -1)
+            ok = _vec_le(demand_p, cand_free)
+            if ok and mode == "capacity" and p_ns in quotas:
+                used_try = {ns: dict(used_sim[ns]) for ns in quotas}
+                if v.namespace in quotas:
+                    used_try[v.namespace] = _vadd(
+                        used_try[v.namespace], v.effective_request()
+                    )
+                ok &= _le_max(
+                    _vadd(used_try[p_ns], p_req), quotas[p_ns]["max"]
+                )
+                agg = {}
+                for ns in quotas:
+                    agg = _vadd(agg, used_try[ns])
+                ok &= _vec_le(_vadd(agg, p_req), agg_min)
+                if ok:
+                    used_sim = used_try
+            if ok:
+                free_after = cand_free
+            else:
+                final.append(v)
+                n_viol += v.uid in violating_set
+        if not final:
+            continue
+        final.sort(key=lambda v: (-v.priority, v.creation_ms))
+        stats = (
+            n_viol,
+            max(v.priority for v in final),
+            sum(v.priority for v in final),
+            len(final),
+            idx,
+        )
+        if best is None or stats < best[0]:
+            best = (stats, n.name, [v.uid for v in final])
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+class TestPreemptionDifferential:
+    def _scenario(self, rng, mode):
+        from scheduler_plugins_tpu.api.objects import PodDisruptionBudget
+        from scheduler_plugins_tpu.framework.preemption import (
+            PreemptionEngine, PreemptionMode,
+        )
+
+        cluster = Cluster()
+        nodes = []
+        for i in range(int(rng.integers(4, 9))):
+            node = Node(name=f"n{i:02d}", allocatable={
+                CPU: int(rng.integers(6_000, 16_000)),
+                MEMORY: 64 * gib, PODS: 40,
+            })
+            nodes.append(node)
+            cluster.add_node(node)
+        quotas = {}
+        if mode == "capacity":
+            for ns in ("a", "b"):
+                # small mins make a namespace run over Min (same-ns victim
+                # branch); large mins leave aggregate-Min headroom so the
+                # post-removal gate can pass (and enable the borrowed branch)
+                small = rng.random() < 0.5
+                quotas[ns] = {
+                    "min": {CPU: int(rng.integers(4_000, 12_000)) if small
+                            else int(rng.integers(40_000, 70_000)),
+                            MEMORY: int(rng.integers(40, 120)) * gib},
+                    "max": {CPU: int(rng.integers(40_000, 90_000)),
+                            MEMORY: 512 * gib},
+                }
+                cluster.add_quota(ElasticQuota(
+                    name=ns, namespace=ns,
+                    min=quotas[ns]["min"], max=quotas[ns]["max"],
+                ))
+        assigned = []
+        for j in range(int(rng.integers(12, 30))):
+            ns = ["a", "b", "c"][int(rng.integers(0, 3))]
+            v = Pod(
+                name=f"v{j:03d}", namespace=ns,
+                priority=int(rng.integers(0, 8)),
+                creation_ms=j,
+                containers=[Container(requests={
+                    CPU: int(rng.integers(1500, 6000)), MEMORY: gib,
+                })],
+                labels={"app": f"app-{j % 4}"},
+            )
+            v.node_name = f"n{int(rng.integers(0, len(nodes))):02d}"
+            assigned.append(v)
+            cluster.add_pod(v)
+        pdbs = []
+        for k in range(int(rng.integers(0, 3))):
+            ns = ["a", "b", "c"][int(rng.integers(0, 3))]
+            pdb = PodDisruptionBudget(
+                name=f"pdb{k}", namespace=ns,
+                selector={"app": f"app-{int(rng.integers(0, 4))}"},
+                disruptions_allowed=int(rng.integers(0, 2)),
+            )
+            pdbs.append(pdb)
+            cluster.add_pdb(pdb)
+        p_ns = ["a", "b", "c"][int(rng.integers(0, 3))] if mode == "capacity" else "c"
+        preemptor = Pod(
+            name="preemptor", namespace=p_ns, priority=20,
+            creation_ms=10_000,
+            containers=[Container(requests={
+                CPU: int(rng.integers(7_000, 11_000)), MEMORY: gib,
+            })],
+        )
+        cluster.add_pod(preemptor)
+        engine = PreemptionEngine(
+            PreemptionMode.CAPACITY if mode == "capacity"
+            else PreemptionMode.DEFAULT
+        )
+        return cluster, nodes, assigned, preemptor, quotas, pdbs, engine
+
+    def _run(self, mode, seeds, min_preemptions=2):
+        from scheduler_plugins_tpu.framework import run_cycle
+
+        preemptions = 0
+        for seed in seeds:
+            rng = np.random.default_rng(seed)
+            cluster, nodes, assigned, preemptor, quotas, pdbs, engine = (
+                self._scenario(rng, mode)
+            )
+            plugins = [NodeResourcesAllocatable()]
+            if mode == "capacity":
+                plugins.append(CapacityScheduling())
+            sched = Scheduler(Profile(plugins=plugins, preemption=engine))
+            # oracle first: run_cycle marks chosen victims terminating
+            expected = reference_preempt(
+                nodes, assigned, preemptor, quotas, pdbs, mode
+            )
+            report = run_cycle(sched, cluster, now=20_000)
+            if cluster.pods[preemptor.uid].node_name is not None or (
+                preemptor.uid in cluster.reserved
+            ):
+                continue  # preemptor fit outright: PostFilter never ran
+            got = report.preempted.get(preemptor.uid)
+            if got is None:
+                assert expected is None, f"seed {seed}: engine found nothing"
+            else:
+                preemptions += 1
+                assert expected is not None, f"seed {seed}: oracle found nothing"
+                assert (got[0], list(got[1])) == (
+                    expected[0], expected[1],
+                ), f"seed {seed}: victim divergence"
+        # the gate must not silently degrade to vacuous None == None passes
+        assert preemptions >= min_preemptions, (
+            f"only {preemptions} non-trivial preemption comparisons"
+        )
+
+    def test_default_mode_differential(self):
+        self._run("default", range(5000, 5010))
+
+    def test_capacity_mode_differential(self):
+        self._run(
+            "capacity",
+            # deterministic seed set: 6000-6009 exercise the None == None
+            # agreement; the rest are known preemption-producing seeds
+            list(range(6000, 6010))
+            + [6026, 6031, 6033, 6051, 6052, 6054, 6058, 6059],
+            min_preemptions=5,
+        )

@@ -81,3 +81,229 @@ class TestFeed:
         apply_event(cluster, {"op": "metrics",
                               "nodes": {"n0": {"cpu_avg": 42.0}}})
         assert cluster.node_metrics == {"n0": {"cpu_avg": 42.0}}
+
+
+class TestFeedChurnFullSurface:
+    """VERDICT round-1 #5 done-criterion: a multi-cycle churn driven ENTIRELY
+    through the TCP feed, with every plugin family active — NRT, AppGroup,
+    NetworkTopology, SeccompProfile, PriorityClass and PDB all cross the
+    process boundary as protocol-v2 events (the reference watches each via
+    informers: plugin.go:86-115, networkoverhead.go:136-171,
+    sysched.go:305-396)."""
+
+    def test_churn_through_feed_all_plugin_families(self):
+        import numpy as np
+
+        from scheduler_plugins_tpu.api.objects import (
+            APP_GROUP_LABEL,
+            POD_GROUP_LABEL,
+            REGION_LABEL,
+            WORKLOAD_SELECTOR_LABEL,
+            ZONE_LABEL,
+        )
+        from scheduler_plugins_tpu.api.resources import PODS
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+            NetworkOverhead,
+            NodeResourcesAllocatable,
+            NodeResourceTopologyMatch,
+            PodState,
+            SySched,
+            TargetLoadPacking,
+        )
+
+        rng = np.random.default_rng(11)
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            # --- cluster-scope CRs, all through the wire ---------------
+            for i in range(6):
+                zone = f"z{i % 4}"
+                assert client.send({
+                    "op": "upsert_node", "name": f"n{i}",
+                    "allocatable": {CPU: 16_000, MEMORY: 64 * gib, PODS: 30},
+                    "labels": {ZONE_LABEL: zone,
+                               REGION_LABEL: f"r{(i % 4) // 2}"},
+                })["ok"]
+                assert client.send({
+                    "op": "upsert_nrt", "node": f"n{i}",
+                    "policy": 3, "scope": 0,  # single-numa-node, container
+                    "zones": [
+                        {"numa_id": z,
+                         "available": {CPU: 8000, MEMORY: 32 * gib},
+                         "costs": {str(o): 10 if o == z else 20
+                                   for o in range(2)}}
+                        for z in range(2)
+                    ],
+                })["ok"]
+            assert client.send({
+                "op": "upsert_quota", "name": "eq", "namespace": "team",
+                "min": {CPU: 48_000, MEMORY: 192 * gib},
+                "max": {CPU: 80_000, MEMORY: 320 * gib},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_app_group", "name": "mesh", "namespace": "team",
+                "workloads": [
+                    {"selector": "frontend"},
+                    {"selector": "backend", "dependencies": [
+                        {"workload_selector": "frontend",
+                         "max_network_cost": 15},
+                    ]},
+                ],
+                "topology_order": {"frontend": 0, "backend": 1},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_network_topology", "name": "nt-default",
+                "namespace": "team",
+                "weights": {"UserDefined": {
+                    "zone": [[f"z{a}", f"z{b}", 5]
+                             for a in range(4) for b in range(4) if a != b],
+                    "region": [["r0", "r1", 40], ["r1", "r0", 40]],
+                }},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_seccomp_profile", "name": "web",
+                "namespace": "team",
+                "syscalls": ["read", "write", "open", "close"],
+            })["ok"]
+            assert client.send({
+                "op": "upsert_seccomp_profile", "name": "batch",
+                "namespace": "team",
+                "syscalls": ["read", "write", "mmap", "clone", "ptrace"],
+            })["ok"]
+            assert client.send({
+                "op": "upsert_priority_class", "name": "tolerated",
+                "value": 5, "annotations": {},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_pdb", "name": "web-pdb", "namespace": "team",
+                "selector": {"app": "frontend"}, "disruptions_allowed": 1,
+            })["ok"]
+
+            sched = Scheduler(Profile(plugins=[
+                NodeResourcesAllocatable(),
+                Coscheduling(permit_waiting_seconds=5),
+                CapacityScheduling(),
+                NodeResourceTopologyMatch(),
+                TargetLoadPacking(),
+                NetworkOverhead(),
+                SySched(),
+                PodState(),
+            ]))
+
+            serial = 0
+            total_bound = 0
+            for cycle in range(10):
+                now = 1000 * (cycle + 1)
+                assert client.send({
+                    "op": "metrics",
+                    "nodes": {f"n{i}": {"cpu_avg": float(rng.uniform(5, 60)),
+                                        "cpu_std": 4.0}
+                              for i in range(6)},
+                })["ok"]
+                for _ in range(int(rng.integers(1, 5))):
+                    serial += 1
+                    wl = "frontend" if serial % 2 else "backend"
+                    assert client.send({
+                        "op": "upsert_pod", "name": f"p{serial:04d}",
+                        "namespace": "team", "creation_ms": now,
+                        "priority": int(rng.integers(0, 5)),
+                        "priority_class_name": "tolerated",
+                        "labels": {APP_GROUP_LABEL: "mesh",
+                                   WORKLOAD_SELECTOR_LABEL: wl,
+                                   "app": wl},
+                        "containers": [
+                            {"requests": {CPU: int(rng.integers(200, 2500)),
+                                          MEMORY: 1 * gib},
+                             "limits": {CPU: int(rng.integers(2500, 4000)),
+                                        MEMORY: 2 * gib},
+                             "seccomp_profile": "team/web"},
+                            {"requests": {CPU: 200, MEMORY: gib},
+                             "seccomp_profile": "team/batch"},
+                        ],
+                        "init_containers": [
+                            {"requests": {CPU: 500, MEMORY: gib}},
+                        ],
+                        "overhead": {CPU: 50},
+                    })["ok"]
+                if cycle == 3:
+                    assert client.send({
+                        "op": "upsert_pod_group", "name": "gang",
+                        "namespace": "team", "min_member": 3,
+                        "creation_ms": now,
+                    })["ok"]
+                    for m in range(3):
+                        serial += 1
+                        assert client.send({
+                            "op": "upsert_pod", "name": f"gm{m}",
+                            "namespace": "team", "creation_ms": now + m,
+                            "labels": {POD_GROUP_LABEL: "gang"},
+                            "requests": {CPU: 1000, MEMORY: 2 * gib},
+                        })["ok"]
+                # completions through the wire
+                with server.locked():
+                    bound = [
+                        p.uid for p in cluster.pods.values()
+                        if p.node_name is not None and not p.pod_group()
+                    ]
+                for uid in bound:
+                    if rng.random() < 0.2:
+                        ns, name = uid.split("/", 1)
+                        assert client.send({
+                            "op": "delete_pod", "namespace": ns,
+                            "name": name,
+                        })["ok"]
+                sync = client.send({"op": "sync"})
+                assert sync["ok"]
+                report = server.run_cycle(sched, now=now)
+                total_bound += len(report.bound)
+                with server.locked():
+                    check_feed_invariants(cluster)
+
+            # every tensor family must have been active in the solve
+            with server.locked():
+                pending = cluster.pending_pods() or [
+                    next(iter(cluster.pods.values()))
+                ]
+                snap, _ = cluster.snapshot(pending, now_ms=99_000)
+            assert snap.numa is not None
+            assert snap.network is not None
+            assert snap.syscalls is not None
+            assert snap.metrics is not None
+            assert snap.quota is not None
+            assert total_bound > 10
+            client.close()
+        finally:
+            server.stop()
+
+
+def check_feed_invariants(cluster):
+    from scheduler_plugins_tpu.api.resources import PODS
+
+    used = {n: {} for n in cluster.nodes}
+    for pod in cluster.pods.values():
+        if pod.node_name is None:
+            continue
+        bucket = used[pod.node_name]
+        for r, q in pod.effective_request().items():
+            bucket[r] = bucket.get(r, 0) + q
+        bucket[PODS] = bucket.get(PODS, 0) + 1
+    for name, node in cluster.nodes.items():
+        for r, q in used[name].items():
+            assert q <= node.allocatable.get(r, 0), (name, r)
+    for eq in cluster.quotas.values():
+        total = {}
+        for pod in cluster.pods.values():
+            if pod.namespace == eq.namespace and pod.node_name is not None:
+                for r, q in pod.effective_request().items():
+                    total[r] = total.get(r, 0) + q
+        for r, cap in eq.max.items():
+            assert total.get(r, 0) <= cap, (eq.namespace, r)
+    for pg in cluster.pod_groups.values():
+        bound = sum(
+            1 for p in cluster.gang_members(pg) if p.node_name is not None
+        )
+        assert bound == 0 or bound >= pg.min_member, (pg.full_name, bound)

@@ -31,6 +31,7 @@ def metrics_state(cpu_avg, cpu_std=None, mem_avg=None, mem_std=None):
     return MetricsState(
         cpu_avg=np.array(cpu_avg, float),
         cpu_tlp=np.array(cpu_avg, float),
+        cpu_peaks=np.array(cpu_avg, float),
         cpu_std=np.array(cpu_std, float) if cpu_std else zeros,
         mem_avg=np.array(mem_avg, float) if mem_avg else zeros,
         mem_std=np.array(mem_std, float) if mem_std else zeros,
