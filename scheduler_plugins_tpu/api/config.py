@@ -36,24 +36,33 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
     "TargetLoadPacking": {
         "targetUtilization": "target_utilization_percent",
         "watcherAddress": "watcher_address",
+        "metricProvider": "metric_provider",
+        "defaultRequests": "default_requests",
+        "defaultRequestsMultiplier": "default_requests_multiplier",
     },
     "LoadVariationRiskBalancing": {
         "safeVarianceMargin": "safe_variance_margin",
         "safeVarianceSensitivity": "safe_variance_sensitivity",
         "watcherAddress": "watcher_address",
+        "metricProvider": "metric_provider",
     },
     "LowRiskOverCommitment": {
         "smoothingWindowSize": "smoothing_window_size",
         "riskLimitWeights": "risk_limit_weights",
         "watcherAddress": "watcher_address",
+        "metricProvider": "metric_provider",
     },
     "Peaks": {
         "nodePowerModel": "node_power_model",
         "watcherAddress": "watcher_address",
+        "metricProvider": "metric_provider",
     },
     "NodeResourceTopologyMatch": {
         "scoringStrategy": "scoring_strategy",
         "resources": "resources",
+        "cacheResyncPeriodSeconds": "cache_resync_period_seconds",
+        "discardReservedNodes": "discard_reserved_nodes",
+        "cache": "cache",
     },
     "NetworkOverhead": {
         "weightsName": "weights_name",

@@ -47,8 +47,10 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
         (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)), None
     )
 
+    for plugin in scheduler.profile.plugins:
+        plugin.configure_cluster(cluster)
     _expire_gangs(cluster, now, report)
-    _resync_nrt_cache(cluster)
+    _resync_nrt_cache(cluster, now)
     _refresh_metrics(scheduler, cluster, now)
 
     pending = cluster.pending_pods()
@@ -184,31 +186,53 @@ def _run_preemption(scheduler, cluster, pending, report, now):
 
 
 def _refresh_metrics(scheduler, cluster: Cluster, now: int):
-    """The collector pull loop: every distinct WatcherAddress configured by
-    a trimaran plugin gets an async collector (cached on the scheduler)
-    ticked once per cycle — see state.collector.AsyncLoadWatcherCollector
-    for the cadence/threading/install semantics."""
-    from scheduler_plugins_tpu.state.collector import AsyncLoadWatcherCollector
+    """The collector pull loop: every distinct metrics source configured by
+    a trimaran plugin — a WatcherAddress service or a MetricProvider library
+    client (collector.go:60-73) — gets an async collector (cached on the
+    scheduler) ticked once per cycle; see
+    state.collector.AsyncLoadWatcherCollector for cadence/threading."""
+    from scheduler_plugins_tpu.state.collector import (
+        AsyncLoadWatcherCollector,
+        make_metrics_client,
+    )
 
     collectors = getattr(scheduler, "_collectors", None)
     for plugin in scheduler.profile.plugins:
         address = getattr(plugin, "watcher_address", None)
-        if not address:
+        provider = getattr(plugin, "metric_provider", None)
+        if not address and not provider:
             continue
+        key = address or tuple(sorted((provider or {}).items()))
         if collectors is None:
             collectors = scheduler._collectors = {}
-        if address not in collectors:
-            collectors[address] = AsyncLoadWatcherCollector(address)
-        collectors[address].tick(cluster, now)
+        if key not in collectors:
+            try:
+                collectors[key] = AsyncLoadWatcherCollector(
+                    make_metrics_client(address, provider)
+                )
+            except ValueError:
+                # unusable source config: degrade to no metrics for this
+                # source instead of failing every cycle (None sentinel stops
+                # re-construction attempts)
+                collectors[key] = None
+        if collectors[key] is not None:
+            collectors[key].tick(cluster, now)
 
 
-def _resync_nrt_cache(cluster: Cluster):
+def _resync_nrt_cache(cluster: Cluster, now: int = 0):
     """Drive the over-reserve cache's resync loop (the reference's background
     `wait.Forever(Resync, period)` goroutine, pluginhelpers.go:73): reconcile
-    dirty nodes against their latest agent reports."""
+    dirty nodes against their latest agent reports, on the configured
+    CacheResyncPeriodSeconds cadence when the cache carries one."""
     cache = cluster.nrt_cache
     if cache is None or not hasattr(cache, "resync"):
         return
+    period_ms = getattr(cache, "resync_period_ms", 0)
+    if period_ms:
+        last = getattr(cache, "_last_resync_ms", None)
+        if last is not None and now - last < period_ms:
+            return
+        cache._last_resync_ms = now
     if not cache.desynced_nodes():
         return
     node_pods: dict[str, list] = {}

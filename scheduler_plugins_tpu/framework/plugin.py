@@ -108,6 +108,12 @@ class Plugin:
         return None
 
     # --- host-side -------------------------------------------------------
+    def configure_cluster(self, cluster) -> None:
+        """Called by the cycle driver BEFORE the snapshot is taken: plugins
+        whose args configure host-side machinery (NRT cache selection, pod
+        request-prediction defaults) install it here — the analog of the
+        wiring the reference does in each plugin's New()."""
+
     def queue_key(self, pod, cluster):  # pragma: no cover - trivial default
         """QueueSort key component for `pod`; tuples compare lexicographically."""
         return None

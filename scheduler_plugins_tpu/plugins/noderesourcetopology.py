@@ -49,18 +49,94 @@ STRATEGIES = (
 class NodeResourceTopologyMatch(Plugin):
     name = "NodeResourceTopologyMatch"
 
+    #: Cache.ForeignPodsDetect / ResyncMethod / InformerMode values
+    #: (apis/config/types.go:124-180)
+    FOREIGN_PODS_DETECT = ("All", "None", "OnlyExclusiveResources")
+    RESYNC_METHODS = ("Autodetect", "All", "OnlyExclusiveResources")
+    INFORMER_MODES = ("Shared", "Dedicated")
+
     def __init__(
         self,
         scoring_strategy: str = LEAST_ALLOCATED,
         resources: Sequence[tuple[str, int]] = (),
+        cache_resync_period_seconds: Optional[int] = None,
+        discard_reserved_nodes: Optional[bool] = None,
+        cache: Optional[dict] = None,
     ):
         if scoring_strategy not in STRATEGIES:
             raise ValueError(f"illegal scoring strategy {scoring_strategy!r}")
+        if cache_resync_period_seconds is not None and cache_resync_period_seconds < 0:
+            # validation_pluginargs.go ValidateNodeResourceTopologyMatchArgs
+            raise ValueError("cacheResyncPeriodSeconds must be >= 0")
         self.strategy = scoring_strategy
         self.resources = tuple(resources)
+        #: cache-implementation selection (pluginhelpers.go:47-78):
+        #: DiscardReservedNodes -> DiscardReserved; resync <= 0 ->
+        #: Passthrough; else OverReserve driven on the resync cadence.
+        #: `configure_cluster` installs the selected cache ONLY when one of
+        #: these args was PASSED — a default-constructed plugin leaves
+        #: manual cache wiring untouched.
+        self._cache_args_given = any(
+            v is not None
+            for v in (cache_resync_period_seconds, discard_reserved_nodes, cache)
+        )
+        self.cache_resync_period_seconds = int(cache_resync_period_seconds or 0)
+        self.discard_reserved_nodes = bool(discard_reserved_nodes)
+        cache = dict(cache or {})
+        self.cache_foreign_pods_detect = cache.get("foreignPodsDetect", "All")
+        self.cache_resync_method = cache.get("resyncMethod", "Autodetect")
+        self.cache_informer_mode = cache.get("informerMode", "Dedicated")
+        if self.cache_foreign_pods_detect not in self.FOREIGN_PODS_DETECT:
+            raise ValueError(
+                f"invalid foreignPodsDetect {self.cache_foreign_pods_detect!r}"
+            )
+        if self.cache_resync_method not in self.RESYNC_METHODS:
+            raise ValueError(
+                f"invalid resyncMethod {self.cache_resync_method!r}"
+            )
+        if self.cache_informer_mode not in self.INFORMER_MODES:
+            raise ValueError(
+                f"invalid informerMode {self.cache_informer_mode!r}"
+            )
         self._affine: Optional[jnp.ndarray] = None
         self._host_level: Optional[jnp.ndarray] = None
         self._weights: Optional[jnp.ndarray] = None
+
+    def _cache_signature(self):
+        return (
+            self.discard_reserved_nodes,
+            self.cache_resync_period_seconds,
+            self.cache_foreign_pods_detect,
+        )
+
+    def make_cache(self):
+        """Cache-tier selection exactly as initNodeTopologyInformer does it
+        (pluginhelpers.go:55-66)."""
+        from scheduler_plugins_tpu.state import nrt_cache as caches
+
+        if self.discard_reserved_nodes:
+            return caches.DiscardReservedCache()
+        if self.cache_resync_period_seconds <= 0:
+            return caches.PassthroughCache()
+        cache = caches.OverReserveCache(
+            foreign_pods_detect=self.cache_foreign_pods_detect
+        )
+        cache.resync_period_ms = self.cache_resync_period_seconds * 1000
+        return cache
+
+    def configure_cluster(self, cluster):
+        if cluster is None or not self._cache_args_given:
+            return
+        if getattr(cluster, "_nrt_cache_config", None) == self._cache_signature():
+            return
+        cache = self.make_cache()
+        for nrt in cluster.nrts.values():
+            cache.update_nrt(nrt)
+        if hasattr(cache, "track_pod"):
+            for pod in cluster.pods.values():
+                cache.track_pod(pod)
+        cluster.nrt_cache = cache
+        cluster._nrt_cache_config = self._cache_signature()
 
     def prepare_cluster(self, meta, cluster):
         """Static specialization: when every NRT shares one topology-manager

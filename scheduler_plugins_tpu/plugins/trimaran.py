@@ -33,6 +33,27 @@ from scheduler_plugins_tpu.ops.trimaran import (
 )
 
 
+def _validate_metric_provider(metric_provider: Optional[dict]):
+    """MetricProviderSpec surface check (apis/config/types.go:73-110,
+    validation_pluginargs.go ValidateTargetLoadPackingArgs) — a config this
+    build cannot honor must fail at construction, not crash run_cycle."""
+    if metric_provider is None:
+        return None
+    from scheduler_plugins_tpu.state.collector import METRIC_PROVIDER_TYPES
+
+    mtype = metric_provider.get("type", "KubernetesMetricsServer")
+    if mtype not in METRIC_PROVIDER_TYPES:
+        raise ValueError(f"invalid metric provider type {mtype!r}")
+    if mtype != "Prometheus":
+        raise ValueError(
+            f"metric provider type {mtype!r} needs an external SDK this "
+            "build does not bundle; configure watcherAddress or Prometheus"
+        )
+    if not metric_provider.get("address"):
+        raise ValueError("Prometheus metric provider requires an address")
+    return dict(metric_provider)
+
+
 class TargetLoadPacking(Plugin):
     """Best-fit bin packing around a target CPU utilisation
     (targetloadpacking.go:107-205)."""
@@ -40,7 +61,10 @@ class TargetLoadPacking(Plugin):
     name = "TargetLoadPacking"
 
     def __init__(self, target_utilization_percent: int = 40,
-                 watcher_address: Optional[str] = None):
+                 watcher_address: Optional[str] = None,
+                 metric_provider: Optional[dict] = None,
+                 default_requests: Optional[dict] = None,
+                 default_requests_multiplier="1.5"):
         if not 0 < target_utilization_percent <= 100:
             raise ValueError("target utilization must be in (0, 100]")
         self.target = float(target_utilization_percent)
@@ -48,6 +72,35 @@ class TargetLoadPacking(Plugin):
         #: when set, the cycle driver polls this load-watcher endpoint on
         #: the collector cadence and installs the metrics into the store
         self.watcher_address = watcher_address
+        #: TrimaranSpec MetricProvider: library-mode client selection when
+        #: no WatcherAddress is set (collector.go:60-73)
+        self.metric_provider = _validate_metric_provider(metric_provider)
+        #: DefaultRequests / DefaultRequestsMultiplier
+        #: (apis/config/v1/defaults.go:76-90: 1000m cpu, "1.5"; multiplier
+        #: must parse as a float >= 1, validation_pluginargs.go)
+        from scheduler_plugins_tpu.api.resources import CPU as _CPU
+
+        reqs = dict(default_requests) if default_requests else {_CPU: 1000}
+        self.default_request_cpu_millis = int(reqs.get(_CPU, 1000))
+        try:
+            self.default_requests_multiplier = float(default_requests_multiplier)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"invalid defaultRequestsMultiplier "
+                f"{default_requests_multiplier!r}"
+            ) from None
+        if self.default_requests_multiplier < 1:
+            raise ValueError("defaultRequestsMultiplier must be >= 1")
+
+    def configure_cluster(self, cluster):
+        """Install this plugin's pod CPU-prediction parameters: the snapshot
+        builder and the missing-utilization compensation use them when
+        lowering `tlp_predicted_cpu_millis`."""
+        if cluster is not None:
+            cluster.tlp_prediction = (
+                self.default_requests_multiplier,
+                self.default_request_cpu_millis,
+            )
 
     def score(self, state, snap, p):
         if snap.metrics is None:
@@ -70,12 +123,14 @@ class LoadVariationRiskBalancing(Plugin):
 
     def __init__(self, safe_variance_margin: float = 1.0,
                  safe_variance_sensitivity: float = 1.0,
-                 watcher_address: Optional[str] = None):
+                 watcher_address: Optional[str] = None,
+                 metric_provider: Optional[dict] = None):
         if safe_variance_margin < 0 or safe_variance_sensitivity < 0:
             raise ValueError("margin/sensitivity must be non-negative")
         self.margin = safe_variance_margin
         self.sensitivity = safe_variance_sensitivity
         self.watcher_address = watcher_address
+        self.metric_provider = _validate_metric_provider(metric_provider)
 
     def score(self, state, snap, p):
         if snap.metrics is None:
@@ -103,9 +158,11 @@ class LowRiskOverCommitment(Plugin):
         smoothing_window_size: int = 5,
         risk_limit_weights: Optional[Mapping[str, float]] = None,
         watcher_address: Optional[str] = None,
+        metric_provider: Optional[dict] = None,
     ):
         self.smoothing_window = smoothing_window_size
         self.watcher_address = watcher_address
+        self.metric_provider = _validate_metric_provider(metric_provider)
         weights = dict(risk_limit_weights or {})
         self.w_cpu = weights.get("cpu", 0.5)
         self.w_mem = weights.get("memory", 0.5)
@@ -150,8 +207,10 @@ class Peaks(Plugin):
     name = "Peaks"
 
     def __init__(self, node_power_model: Optional[Mapping[str, tuple]] = None,
-                 watcher_address: Optional[str] = None):
+                 watcher_address: Optional[str] = None,
+                 metric_provider: Optional[dict] = None):
         self.watcher_address = watcher_address
+        self.metric_provider = _validate_metric_provider(metric_provider)
         #: node name -> (K0, K1, K2); missing nodes get (0, 0, 0). When the
         #: args carry no model, the NODE_POWER_MODEL env var names a JSON
         #: file {node: {"K0":..., "K1":..., "K2":...}} (peaks.go:59-74).

@@ -41,6 +41,11 @@ class Cluster:
     priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
     pdbs: dict[str, PodDisruptionBudget] = field(default_factory=dict)
     node_metrics: Optional[dict] = None
+    #: TargetLoadPacking pod CPU-prediction parameters
+    #: (multiplier, default-request millis) — installed by the plugin's
+    #: configure_cluster from DefaultRequests/DefaultRequestsMultiplier
+    #: (apis/config/v1/defaults.go:76-90)
+    tlp_prediction: tuple = (1.5, 1000)
     #: optional NRT cache policy (state.nrt_cache); when set, snapshots read
     #: the cache's adjusted zone view instead of the raw NRT objects
     nrt_cache: Optional[object] = None
@@ -116,14 +121,17 @@ class Cluster:
         return vecs
 
     def _native_upsert_node(self, node: Node):
-        if node.name not in self._native_node_ids:
+        is_new = node.name not in self._native_node_ids
+        if is_new:
             self._native_node_ids[node.name] = len(self._native_node_ids)
         alloc, cap = self._canon_vec(
             f"node/{node.name}", node.allocatable, node.capacity
         )
         self._native.upsert_node(self._native_node_ids[node.name], alloc, cap)
-        if getattr(self, "_native_replaying", False):
-            return  # the attach replay upserts every pod afterwards anyway
+        if not is_new or getattr(self, "_native_replaying", False):
+            # known node (routine status update), or the attach replay will
+            # upsert every pod afterwards anyway: nothing to re-link
+            return
         # pods mirrored before their node arrived (cross-watch event
         # ordering) were stored unbound: re-upsert them now
         for pod in self.pods.values():
@@ -350,7 +358,9 @@ class Cluster:
             pod = self.pods.get(uid)
             if pod is None or now_ms - ts >= self.METRICS_REPORT_INTERVAL_MS:
                 continue
-            missing[node] = missing.get(node, 0) + pod.tlp_predicted_cpu_millis()
+            missing[node] = missing.get(node, 0) + pod.tlp_predicted_cpu_millis(
+                *self.tlp_prediction
+            )
         if not missing:
             return self.node_metrics
         merged = {name: dict(m) for name, m in self.node_metrics.items()}
@@ -423,5 +433,6 @@ class Cluster:
             extra_pods=self.gated_pods(),
             seccomp_profiles=list(self.seccomp_profiles.values()),
             native_nodes=native_exports,
+            tlp_prediction=self.tlp_prediction,
             **kwargs,
         )

@@ -81,9 +81,12 @@ class AsyncLoadWatcherCollector:
     reporting are evicted (falling back to the neutral no-metrics path), and
     other sources' nodes are untouched. Failures keep the previous data."""
 
-    def __init__(self, watcher_address: str,
+    def __init__(self, client,
                  refresh_seconds: int = DEFAULT_REFRESH_SECONDS):
-        self.collector = LoadWatcherCollector(watcher_address)
+        # back-compat: a bare address selects the HTTP service client
+        self.collector = (
+            LoadWatcherCollector(client) if isinstance(client, str) else client
+        )
         self.refresh_ms = refresh_seconds * 1000
         self.last_ms: Optional[int] = None
         self.latest: Optional[dict] = None
@@ -148,3 +151,98 @@ class LoadWatcherCollector:
             return cluster.node_metrics or {}
         cluster.node_metrics = metrics
         return metrics
+
+
+#: MetricProviderSpec.Type values (apis/config/types.go:73-79)
+METRIC_PROVIDER_TYPES = (
+    "KubernetesMetricsServer", "Prometheus", "SignalFx",
+)
+
+
+class PrometheusCollector:
+    """Library-mode metrics client for `MetricProvider.Type: Prometheus` —
+    the in-process equivalent of load-watcher's prometheus provider
+    (/root/reference/pkg/trimaran/collector.go:63-73 NewLibraryClient).
+    Queries the Prometheus HTTP API for per-node cpu/memory utilisation
+    percentages; samples land as Average metrics (the provider aggregates
+    over its range window)."""
+
+    CPU_QUERY = (
+        '100 - (avg by (instance) '
+        '(rate(node_cpu_seconds_total{mode="idle"}[15m])) * 100)'
+    )
+    MEM_QUERY = (
+        "100 * (1 - avg_over_time(node_memory_MemAvailable_bytes[15m]) "
+        "/ node_memory_MemTotal_bytes)"
+    )
+
+    def __init__(self, address: str, token: str = "",
+                 insecure_skip_verify: bool = False, timeout_s: float = 5.0):
+        if not address:
+            raise ValueError("Prometheus metric provider requires an address")
+        self.address = address.rstrip("/")
+        self.token = token
+        self.insecure_skip_verify = insecure_skip_verify
+        self.timeout_s = timeout_s
+
+    def _query(self, promql: str) -> dict[str, float]:
+        import ssl
+        import urllib.parse
+
+        url = f"{self.address}/api/v1/query?query={urllib.parse.quote(promql)}"
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        ctx = None
+        if self.insecure_skip_verify and url.startswith("https"):
+            ctx = ssl._create_unverified_context()
+        with urllib.request.urlopen(
+            req, timeout=self.timeout_s, context=ctx
+        ) as resp:
+            payload = json.loads(resp.read())
+        out: dict[str, float] = {}
+        for result in (payload.get("data") or {}).get("result", []):
+            instance = (result.get("metric") or {}).get("instance", "")
+            # instance labels commonly carry the scrape port
+            node = instance.split(":")[0]
+            try:
+                out[node] = float(result["value"][1])
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+        return out
+
+    def fetch(self) -> dict[str, dict]:
+        cpu = self._query(self.CPU_QUERY)
+        mem = self._query(self.MEM_QUERY)
+        out: dict[str, dict] = {}
+        for node, value in cpu.items():
+            out.setdefault(node, {}).update(
+                {"cpu_avg": value, "cpu_tlp": value, "cpu_peaks": value}
+            )
+        for node, value in mem.items():
+            out.setdefault(node, {})["mem_avg"] = value
+        return out
+
+
+def make_metrics_client(watcher_address: Optional[str] = None,
+                        metric_provider: Optional[dict] = None):
+    """collector.go:60-73: a WatcherAddress selects the remote service
+    client; otherwise the MetricProviderSpec selects an in-process library
+    client (Prometheus bundled; the metrics-server/SignalFx SDK clients are
+    not shipped in this build)."""
+    if watcher_address:
+        return LoadWatcherCollector(watcher_address)
+    mp = metric_provider or {}
+    mtype = mp.get("type", "KubernetesMetricsServer")
+    if mtype not in METRIC_PROVIDER_TYPES:
+        raise ValueError(f"invalid metric provider type {mtype!r}")
+    if mtype == "Prometheus":
+        return PrometheusCollector(
+            mp.get("address", ""),
+            token=mp.get("token", ""),
+            insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
+        )
+    raise ValueError(
+        f"metric provider type {mtype!r} needs an external SDK this build "
+        "does not bundle; configure watcherAddress or Prometheus"
+    )

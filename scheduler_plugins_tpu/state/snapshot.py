@@ -322,6 +322,7 @@ def build_snapshot(
     stale_nrt_nodes: Sequence[str] = (),
     seccomp_profiles: Sequence = (),
     native_nodes: Optional[dict] = None,
+    tlp_prediction: tuple = (1.5, 1000),
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -540,7 +541,7 @@ def build_snapshot(
     for i, pod in enumerate(pending_pods):
         preq[i] = index.encode(pod.effective_request())
         plimits[i] = index.encode(pod.effective_limits())
-        ppredicted[i] = pod.tlp_predicted_cpu_millis()
+        ppredicted[i] = pod.tlp_predicted_cpu_millis(*tlp_prediction)
         for c, cont in enumerate(list(pod.init_containers) + list(pod.containers)):
             pcreq[i, c] = index.encode(cont.requests)
             pcinit[i, c] = c < len(pod.init_containers)

@@ -175,3 +175,80 @@ class TestAsyncCollector:
         col.tick(cluster, now_ms=2)
         assert set(cluster.node_metrics) == {"other", "n1"}
         assert cluster.node_metrics["n1"]["cpu_avg"] == 55.0
+
+
+class TestPrometheusCollector:
+    """Library-mode client (MetricProvider.Type: Prometheus) faked at the
+    HTTP boundary, like the reference fakes the watcher with httptest."""
+
+    def _serve_prom(self):
+        import http.server
+        import json as _json
+        import threading
+        import urllib.parse
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                ).get("query", [""])[0]
+                value = 42.5 if "cpu" in query else 61.0
+                body = _json.dumps({
+                    "status": "success",
+                    "data": {"result": [
+                        {"metric": {"instance": "node-a:9100"},
+                         "value": [1700000000, str(value)]},
+                        {"metric": {"instance": "node-b"},
+                         "value": [1700000000, str(value + 1)]},
+                    ]},
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                # record auth for the token assertion
+                Handler.last_auth = self.headers.get("Authorization")
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, Handler, f"http://127.0.0.1:{server.server_port}"
+
+    def test_fetch_parses_vectors_and_strips_ports(self):
+        from scheduler_plugins_tpu.state.collector import PrometheusCollector
+
+        server, handler, addr = self._serve_prom()
+        try:
+            c = PrometheusCollector(addr, token="sekret")
+            metrics = c.fetch()
+            assert metrics["node-a"]["cpu_avg"] == 42.5
+            assert metrics["node-a"]["cpu_tlp"] == 42.5
+            assert metrics["node-a"]["cpu_peaks"] == 42.5
+            assert metrics["node-b"]["mem_avg"] == 62.0
+            assert handler.last_auth == "Bearer sekret"
+        finally:
+            server.shutdown()
+
+    def test_factory_selection(self):
+        import pytest
+
+        from scheduler_plugins_tpu.state.collector import (
+            LoadWatcherCollector,
+            PrometheusCollector,
+            make_metrics_client,
+        )
+
+        assert isinstance(
+            make_metrics_client("http://watcher:2020"), LoadWatcherCollector
+        )
+        assert isinstance(
+            make_metrics_client(None, {"type": "Prometheus",
+                                       "address": "http://prom:9090"}),
+            PrometheusCollector,
+        )
+        with pytest.raises(ValueError):
+            make_metrics_client(None, {"type": "SignalFx", "address": "x"})
+        with pytest.raises(ValueError):
+            make_metrics_client(None, {"type": "Prometheus"})  # no address

@@ -77,3 +77,127 @@ class TestLoadProfile:
                     ],
                 }
             )
+
+
+class TestConfigArgSurface:
+    """VERDICT round-1 #4/#6: the reference's full documented arg set decodes
+    through load_profile (apis/config/types.go:28-307)."""
+
+    def test_nrt_cache_selection_from_args(self):
+        from scheduler_plugins_tpu.state.cluster import Cluster
+        from scheduler_plugins_tpu.state.nrt_cache import (
+            DiscardReservedCache,
+            OverReserveCache,
+            PassthroughCache,
+        )
+
+        # pluginhelpers.go:47-78 selection table
+        cases = [
+            ({"discardReservedNodes": True}, DiscardReservedCache),
+            ({"cacheResyncPeriodSeconds": 0, "cache": {}}, PassthroughCache),
+            ({"cacheResyncPeriodSeconds": 5,
+              "cache": {"foreignPodsDetect": "OnlyExclusiveResources"}},
+             OverReserveCache),
+        ]
+        for args, expected in cases:
+            profile = load_profile({
+                "plugins": ["NodeResourceTopologyMatch"],
+                "pluginConfig": [
+                    {"name": "NodeResourceTopologyMatch", "args": args}
+                ],
+            })
+            plugin = profile.plugins[0]
+            cluster = Cluster()
+            plugin.configure_cluster(cluster)
+            assert isinstance(cluster.nrt_cache, expected), args
+        # over-reserve carries the detect mode + resync cadence
+        assert cluster.nrt_cache.foreign_pods_detect == "OnlyExclusiveResources"
+        assert cluster.nrt_cache.resync_period_ms == 5000
+
+    def test_default_construction_leaves_manual_wiring(self):
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+        from scheduler_plugins_tpu.state.cluster import Cluster
+        from scheduler_plugins_tpu.state.nrt_cache import OverReserveCache
+
+        cluster = Cluster()
+        manual = OverReserveCache()
+        cluster.nrt_cache = manual
+        NodeResourceTopologyMatch().configure_cluster(cluster)
+        assert cluster.nrt_cache is manual
+
+    def test_nrt_cache_arg_validation(self):
+        import pytest
+
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+
+        with pytest.raises(ValueError):
+            NodeResourceTopologyMatch(cache_resync_period_seconds=-1)
+        with pytest.raises(ValueError):
+            NodeResourceTopologyMatch(cache={"foreignPodsDetect": "bogus"})
+        with pytest.raises(ValueError):
+            NodeResourceTopologyMatch(cache={"informerMode": "bogus"})
+
+    def test_tlp_default_requests_flow_into_prediction(self):
+        from scheduler_plugins_tpu.api.objects import Container, Pod
+        from scheduler_plugins_tpu.api.resources import CPU
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        profile = load_profile({
+            "plugins": ["TargetLoadPacking"],
+            "pluginConfig": [{
+                "name": "TargetLoadPacking",
+                "args": {"defaultRequests": {CPU: 2000},
+                         "defaultRequestsMultiplier": "2.0"},
+            }],
+        })
+        plugin = profile.plugins[0]
+        cluster = Cluster()
+        plugin.configure_cluster(cluster)
+        assert cluster.tlp_prediction == (2.0, 2000)
+        # a request-only pod uses the multiplier; a bare pod the default
+        req_pod = Pod(name="r", containers=[Container(requests={CPU: 1000})])
+        bare_pod = Pod(name="b", containers=[Container()])
+        assert req_pod.tlp_predicted_cpu_millis(*cluster.tlp_prediction) == 2000
+        assert bare_pod.tlp_predicted_cpu_millis(*cluster.tlp_prediction) == 2000
+
+    def test_tlp_multiplier_validation(self):
+        import pytest
+
+        from scheduler_plugins_tpu.plugins import TargetLoadPacking
+
+        with pytest.raises(ValueError):
+            TargetLoadPacking(default_requests_multiplier="nope")
+        with pytest.raises(ValueError):
+            TargetLoadPacking(default_requests_multiplier="0.5")
+
+    def test_metric_provider_decode_and_validation(self):
+        import pytest
+
+        profile = load_profile({
+            "plugins": ["LoadVariationRiskBalancing"],
+            "pluginConfig": [{
+                "name": "LoadVariationRiskBalancing",
+                "args": {"metricProvider": {
+                    "type": "Prometheus", "address": "http://prom:9090",
+                }},
+            }],
+        })
+        assert profile.plugins[0].metric_provider["type"] == "Prometheus"
+        with pytest.raises(ValueError):
+            load_profile({
+                "plugins": ["TargetLoadPacking"],
+                "pluginConfig": [{
+                    "name": "TargetLoadPacking",
+                    "args": {"metricProvider": {"type": "Graphite"}},
+                }],
+            })
+        # types the build cannot honor fail at construction, not at cycle
+        # time (run_cycle additionally degrades to no-metrics if a client
+        # construction slips through)
+        from scheduler_plugins_tpu.plugins import TargetLoadPacking
+
+        with pytest.raises(ValueError):
+            TargetLoadPacking(metric_provider={"type": "SignalFx",
+                                               "address": "http://x"})
+        with pytest.raises(ValueError):
+            TargetLoadPacking(metric_provider={"type": "Prometheus"})
