@@ -92,6 +92,9 @@ class Pod:
     scheduling_gated: bool = False
     #: PriorityClass name, consumed by PreemptionToleration policy lookup.
     priority_class_name: str = ""
+    #: spec.preemptionPolicy: "Never" disqualifies the pod from preempting
+    #: (capacity_scheduling.go:412-416).
+    preemption_policy: Optional[str] = None
     #: memoized derived quantities — a pod's container spec is immutable
     #: after creation (k8s semantics), and the snapshot builder re-derives
     #: these for every pod on every cycle. init=False keeps the cache out of

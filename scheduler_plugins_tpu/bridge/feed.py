@@ -129,6 +129,7 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
             deletion_ms=event.get("deletion_ms"),
             scheduling_gated=bool(event.get("scheduling_gated", False)),
             priority_class_name=event.get("priority_class_name", ""),
+            preemption_policy=event.get("preemption_policy"),
             overhead={k: int(v) for k, v in event.get("overhead", {}).items()},
             containers=containers,
             init_containers=[

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from scheduler_plugins_tpu.framework.preemption import encode_demand
+from scheduler_plugins_tpu.framework.preemption import GATED, encode_demand
 from scheduler_plugins_tpu.framework.runtime import Scheduler, now_ms as _now_ms
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
 from scheduler_plugins_tpu.state.cluster import Cluster
@@ -149,21 +149,25 @@ def _run_preemption(scheduler, cluster, pending, report, now):
     )
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
     for pod in failed_pods:
-        if pod.nominated_node_name is not None:
-            # a stale nomination did not help this cycle: clear it so the
-            # pod can re-enter PostFilter next time (upstream clears
-            # NominatedNodeName when the pod is unschedulable again)
-            pod.nominated_node_name = None
-            continue
         pg = cluster.pod_group_of(pod)
         if pg is not None and pg.full_name in rejected:
             continue  # the whole gang was rejected; no point preempting
         obs.metrics.inc(obs.PREEMPTION_ATTEMPTS)
+        # PodEligibleToPreemptOthers runs inside preempt(): while pods this
+        # pod could benefit from are still terminating on its nominated
+        # node, it must NOT preempt again — and the nomination is KEPT so
+        # the gate can keep firing (capacity_scheduling.go:409-484)
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
             extra_reserved=nominated_extra,
         )
+        if result is GATED:
+            continue  # terminations in flight: nomination stays
         if result is None:
+            # nomination did not help and nothing is terminating: clear it
+            # so the pod re-enters PostFilter fresh (upstream clears
+            # NominatedNodeName when unschedulable again)
+            pod.nominated_node_name = None
             continue
         obs.metrics.inc(obs.PREEMPTION_VICTIMS, len(result.victims))
         # setting the nomination NOW makes this pod visible to later

@@ -61,6 +61,12 @@ class PreemptionMode(enum.Enum):
     CAPACITY = "CapacityScheduling"
 
 
+#: sentinel: the preemptor is currently INELIGIBLE (PodEligibleToPreemptOthers
+#: said no — terminations in flight on its nominated node); distinct from
+#: None ("eligible but no viable candidates") so callers keep the nomination
+GATED = object()
+
+
 @dataclass
 class PreemptionResult:
     nominated_node: str
@@ -104,7 +110,68 @@ class PreemptionEngine:
         scheduled_ms = victim.creation_ms  # scheduled-at proxy
         return scheduled_ms + toleration_s * 1000 > now_ms
 
-    # -- eligibility -----------------------------------------------------
+    # -- preemptor eligibility -------------------------------------------
+    def pod_eligible(self, cluster, preemptor: Pod, snap, meta,
+                     nom_aggs=None) -> bool:
+        """PodEligibleToPreemptOthers: a pod that already preempted must not
+        preempt again while pods it could benefit from are still terminating
+        on its nominated node (capacity_scheduling.go:409-484; upstream
+        DefaultPreemption semantics for the DEFAULT mode)."""
+        if getattr(preemptor, "preemption_policy", None) == "Never":
+            return False
+        nom = preemptor.nominated_node_name
+        if not nom or nom not in cluster.nodes:
+            return True
+        on_node = [
+            p for p in cluster.pods.values() if p.node_name == nom
+        ]
+        if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
+            quota = snap.quota
+            ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+            has_q = np.asarray(quota.has_quota)
+            used = np.asarray(quota.used)
+            qmin = np.asarray(quota.min)
+
+            def ns_has_q(ns):
+                i = ns_codes.get(ns, -1)
+                return i >= 0 and bool(has_q[i])
+
+            p_ns = ns_codes.get(preemptor.namespace, -1)
+            if p_ns >= 0 and has_q[p_ns]:
+                req = meta.index.encode(preemptor.effective_request())
+                in_eq_agg = nom_aggs[0] if nom_aggs is not None else 0
+                more_than_min = bool(
+                    np.any(used[p_ns] + req + in_eq_agg > qmin[p_ns])
+                )
+                over_min = np.any(used > qmin, axis=1)
+                for p in on_node:
+                    if not p.terminating or not ns_has_q(p.namespace):
+                        continue
+                    if (
+                        p.namespace == preemptor.namespace
+                        and p.priority < preemptor.priority
+                    ):
+                        return False
+                    if (
+                        p.namespace != preemptor.namespace
+                        and not more_than_min
+                        and bool(over_min[ns_codes[p.namespace]])
+                    ):
+                        return False
+            else:
+                # non-quota preemptor: only non-quota terminating pods count
+                for p in on_node:
+                    if ns_has_q(p.namespace):
+                        continue
+                    if p.terminating and p.priority < preemptor.priority:
+                        return False
+        else:
+            for p in on_node:
+                if p.terminating and p.priority < preemptor.priority:
+                    return False
+        return True
+
+    # -- victim eligibility ----------------------------------------------
     def _eligible(self, victims, preemptor, cluster, snap, meta, now_ms,
                   nom_aggs=None):
         """(V,) bool eligibility per mode."""
@@ -198,7 +265,20 @@ class PreemptionEngine:
 
     # -- main ------------------------------------------------------------
     def preempt(self, cluster, scheduler, preemptor: Pod, snap, meta,
-                now_ms: int, extra_reserved=None) -> Optional[PreemptionResult]:
+                now_ms: int, extra_reserved=None):
+        """Returns a PreemptionResult, None (no viable candidates — a kept
+        nomination did not help), or the GATED sentinel (the preemptor must
+        not preempt right now because pods it benefits from are still
+        terminating on its nominated node — callers keep the nomination)."""
+        if getattr(preemptor, "preemption_policy", None) == "Never":
+            return None
+        # the eligibility gate runs BEFORE any victim encoding: while the
+        # nominated node's terminations are in flight (the steady state the
+        # gate exists for), the gated path must be near-free
+        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
+        if not self.pod_eligible(cluster, preemptor, snap, meta, nom_aggs):
+            return GATED
+
         victims_all = [
             p
             for p in cluster.pods.values()
@@ -225,7 +305,6 @@ class PreemptionEngine:
             v_req[i, index.position(PODS)] = 1
         v_pri = np.array([v.priority for v in victims_all])
 
-        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
         eligible = self._eligible(
             victims_all, preemptor, cluster, snap, meta, now_ms, nom_aggs
         )

@@ -223,3 +223,137 @@ class TestPreemptionToleration:
         # after the window: preempted
         report = run_cycle(sched, cluster, now=20_000)
         assert "default/claimant" in report.preempted
+
+
+class TestPodEligibleToPreemptOthers:
+    """Decision table for the preemptor-eligibility gate
+    (capacity_scheduling.go:409-484 + upstream DefaultPreemption):
+    terminating pods on the nominated node suppress re-preemption."""
+
+    def _capacity_cluster(self, used_over_min=False):
+        c = Cluster()
+        c.add_node(mknode("n0", cpu=8000))
+        c.add_node(mknode("n1", cpu=8000))
+        # quota namespaces a and b; b's min tiny so it runs over-min
+        # memory must appear in Min: an absent resource bounds at 0 and
+        # would make every memory-requesting preemptor "over min"
+        c.add_quota(ElasticQuota(
+            name="a", namespace="a",
+            min={CPU: 2000 if used_over_min else 50_000, MEMORY: 1 << 42},
+            max={CPU: 90_000, MEMORY: 1 << 44}))
+        c.add_quota(ElasticQuota(name="b", namespace="b",
+                                 min={CPU: 100}, max={CPU: 90_000}))
+        return c
+
+    def _gate(self, cluster, preemptor, mode=PreemptionMode.CAPACITY):
+        engine = PreemptionEngine(mode)
+        pending = [p for p in cluster.pods.values()
+                   if p.node_name is None and not p.terminating]
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        return engine.pod_eligible(cluster, preemptor, snap, meta)
+
+    def test_preemption_policy_never(self):
+        c = Cluster()
+        c.add_node(mknode("n0"))
+        p = mkpod("p", 1000, priority=10)
+        p.preemption_policy = "Never"
+        c.add_pod(p)
+        snap, meta = c.snapshot([p], now_ms=0)
+        assert not PreemptionEngine(PreemptionMode.DEFAULT).pod_eligible(
+            c, p, snap, meta)
+
+    def test_no_nomination_is_eligible(self):
+        c = self._capacity_cluster()
+        p = mkpod("p", 1000, ns="a", priority=10)
+        c.add_pod(p)
+        assert self._gate(c, p)
+
+    def test_same_ns_terminating_lower_priority_blocks(self):
+        # preemptor over its Min -> same-ns victims; a same-ns lower-priority
+        # pod already terminating on the nominated node blocks re-preemption
+        c = self._capacity_cluster(used_over_min=True)
+        victim = mkpod("v", 3000, ns="a", priority=1, node="n0")
+        victim.deletion_ms = 500
+        c.add_pod(victim)
+        p = mkpod("p", 4000, ns="a", priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert not self._gate(c, p)
+
+    def test_same_ns_terminating_higher_priority_does_not_block(self):
+        c = self._capacity_cluster(used_over_min=True)
+        victim = mkpod("v", 3000, ns="a", priority=20, node="n0")
+        victim.deletion_ms = 500
+        c.add_pod(victim)
+        p = mkpod("p", 4000, ns="a", priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert self._gate(c, p)
+
+    def test_borrowed_branch_other_ns_over_min_blocks(self):
+        # preemptor UNDER its Min (borrowed branch): a terminating pod of
+        # another over-min quota namespace on the nominated node blocks
+        c = self._capacity_cluster(used_over_min=False)
+        victim = mkpod("v", 3000, ns="b", priority=50, node="n0")
+        victim.deletion_ms = 500
+        c.add_pod(victim)
+        p = mkpod("p", 1000, ns="a", priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert not self._gate(c, p)
+
+    def test_other_ns_does_not_block_when_over_own_min(self):
+        # preemptor over its Min preys only same-ns: the other-ns terminating
+        # pod is irrelevant
+        c = self._capacity_cluster(used_over_min=True)
+        victim = mkpod("v", 3000, ns="b", priority=1, node="n0")
+        victim.deletion_ms = 500
+        c.add_pod(victim)
+        p = mkpod("p", 4000, ns="a", priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert self._gate(c, p)
+
+    def test_non_quota_preemptor_only_sees_non_quota_terminators(self):
+        c = self._capacity_cluster()
+        quota_victim = mkpod("vq", 2000, ns="a", priority=1, node="n0")
+        quota_victim.deletion_ms = 500
+        c.add_pod(quota_victim)
+        p = mkpod("p", 1000, ns="noq", priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert self._gate(c, p)  # quota'd terminator ignored
+        free_victim = mkpod("vf", 2000, ns="noq2", priority=1, node="n0")
+        free_victim.deletion_ms = 600
+        c.add_pod(free_victim)
+        assert not self._gate(c, p)
+
+    def test_default_mode_any_lower_priority_terminator_blocks(self):
+        c = Cluster()
+        c.add_node(mknode("n0"))
+        victim = mkpod("v", 2000, priority=1, node="n0")
+        victim.deletion_ms = 500
+        c.add_pod(victim)
+        p = mkpod("p", 1000, priority=10)
+        p.nominated_node_name = "n0"
+        c.add_pod(p)
+        assert not self._gate(c, p, PreemptionMode.DEFAULT)
+
+    def test_cycle_keeps_nomination_while_victims_terminate(self):
+        # end-to-end: after a preemption, the next cycle must neither
+        # re-preempt nor clear the nomination while the victim terminates;
+        # once the victim is gone the preemptor binds
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=3000))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("high", 3000, priority=10))
+        sched = default_sched()
+        r1 = run_cycle(sched, cluster, now=1000)
+        assert "default/high" in r1.preempted
+        assert cluster.pods["default/low"].terminating
+        r2 = run_cycle(sched, cluster, now=2000)
+        assert "default/high" not in r2.preempted  # gate held
+        assert cluster.pods["default/high"].nominated_node_name == "n0"
+        cluster.remove_pod("default/low")  # kubelet finished termination
+        r3 = run_cycle(sched, cluster, now=3000)
+        assert cluster.pods["default/high"].node_name == "n0"
