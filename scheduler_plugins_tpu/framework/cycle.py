@@ -81,11 +81,14 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
         if wait[i]:
             cluster.reserve(pod.uid, node_name)
             report.reserved[pod.uid] = node_name
-            if pg is not None and pg.full_name not in cluster.gang_deadline_ms:
-                timeout_s = pg.schedule_timeout_seconds
-                if timeout_s is None and cosched is not None:
-                    timeout_s = cosched.permit_waiting_seconds
-                cluster.gang_deadline_ms[pg.full_name] = now + 1000 * (timeout_s or 0)
+            # per-POD waiting timer from THIS pod's reservation time
+            # (upstream waitingPods, coscheduling.go:227-235;
+            # GetWaitTimeDuration: ScheduleTimeoutSeconds else
+            # PermitWaitingTimeSeconds)
+            timeout_s = pg.schedule_timeout_seconds if pg is not None else None
+            if timeout_s is None and cosched is not None:
+                timeout_s = cosched.permit_waiting_seconds
+            cluster.pod_deadline_ms[pod.uid] = now + 1000 * (timeout_s or 0)
         else:
             cluster.bind(pod.uid, node_name, now)
             report.bound[pod.uid] = node_name
@@ -148,6 +151,25 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         (len(meta.node_names), len(meta.index)), np.int64
     )
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
+    # pre-seed with PRIOR cycles' live nominations (kept while gated) minus
+    # the capacity their in-flight terminations will free — the upstream
+    # evaluator reads both from the nominator/NodeInfo, so a second
+    # preemptor cannot double-book capacity a kept nomination depends on.
+    # (A nomination that moves or clears during this loop leaves its seed
+    # in place for the rest of the cycle — a conservative overcount.)
+    for pod in cluster.pods.values():
+        if (
+            pod.node_name is None
+            and not pod.terminating
+            and pod.nominated_node_name in node_pos
+        ):
+            nominated_extra[node_pos[pod.nominated_node_name]] += (
+                encode_demand(meta.index, pod)
+            )
+        elif pod.terminating and pod.node_name in node_pos:
+            nominated_extra[node_pos[pod.node_name]] -= encode_demand(
+                meta.index, pod
+            )
     for pod in failed_pods:
         pg = cluster.pod_group_of(pod)
         if pg is not None and pg.full_name in rejected:
@@ -156,12 +178,23 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         # PodEligibleToPreemptOthers runs inside preempt(): while pods this
         # pod could benefit from are still terminating on its nominated
         # node, it must NOT preempt again — and the nomination is KEPT so
-        # the gate can keep firing (capacity_scheduling.go:409-484)
+        # the gate can keep firing (capacity_scheduling.go:409-484).
+        # The pod's OWN seeded hold must not block its own dry run
+        # (upstream excludes same-UID nominated pods).
+        own = None
+        if pod.nominated_node_name in node_pos and not pod.terminating:
+            own = (
+                node_pos[pod.nominated_node_name],
+                encode_demand(meta.index, pod),
+            )
+            nominated_extra[own[0]] -= own[1]
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
             extra_reserved=nominated_extra,
         )
         if result is GATED:
+            if own is not None:
+                nominated_extra[own[0]] += own[1]  # the hold stays
             continue  # terminations in flight: nomination stays
         if result is None:
             # nomination did not help and nothing is terminating: clear it
@@ -272,10 +305,9 @@ def _maybe_release_gang(cluster: Cluster, pg, report: CycleReport, now: int = 0)
     if bound + len(reserved) >= pg.min_member:
         for uid in reserved:
             node = cluster.reserved[uid]
-            cluster.bind(uid, node, now)
+            cluster.bind(uid, node, now)  # clears the pod's permit timer
             report.bound[uid] = node
             report.reserved.pop(uid, None)
-        cluster.gang_deadline_ms.pop(pg.full_name, None)
 
 
 def _reject_gang(cluster: Cluster, pg, now: int, report: CycleReport, cosched, member_count: int):
@@ -284,9 +316,8 @@ def _reject_gang(cluster: Cluster, pg, now: int, report: CycleReport, cosched, m
     gang has at least MinMember sibling pods (coscheduling.go:196-204) —
     an incomplete gang must retry as soon as its members appear."""
     for uid in cluster.gang_reservations(pg):
-        cluster.release_reservation(uid)
+        cluster.release_reservation(uid)  # clears the pod's permit timer
         report.reserved.pop(uid, None)
-    cluster.gang_deadline_ms.pop(pg.full_name, None)
     cluster.gang_last_failure_ms[pg.full_name] = now
     backoff_s = cosched.pod_group_backoff_seconds if cosched else 0
     if backoff_s > 0 and member_count >= pg.min_member:
@@ -295,17 +326,19 @@ def _reject_gang(cluster: Cluster, pg, now: int, report: CycleReport, cosched, m
 
 
 def _expire_gangs(cluster: Cluster, now: int, report: CycleReport):
-    """Permit timeout: waiting gangs past their deadline are rejected
-    (the upstream waitingPods timer firing Reject)."""
-    for gang_name, deadline in list(cluster.gang_deadline_ms.items()):
-        if now < deadline:
-            continue
-        pg = cluster.pod_groups.get(gang_name)
+    """Permit timeout: ANY waiting pod past its own deadline fires Reject
+    (the upstream per-pod waitingPods timer, coscheduling.go:227-251), which
+    unreserves every sibling — the earliest sibling deadline rejects the
+    whole gang; staggered reservations carry staggered deadlines."""
+    for uid, deadline in list(cluster.pod_deadline_ms.items()):
+        if now < deadline or uid not in cluster.pod_deadline_ms:
+            continue  # not due, or already cleared by a sibling's expiry
+        pod = cluster.pods.get(uid)
+        pg = cluster.pod_group_of(pod) if pod is not None else None
         if pg is None:
-            cluster.gang_deadline_ms.pop(gang_name, None)
+            cluster.release_reservation(uid)  # clears the timer too
             continue
-        for uid in cluster.gang_reservations(pg):
-            cluster.release_reservation(uid)
-        cluster.gang_deadline_ms.pop(gang_name, None)
-        cluster.gang_last_failure_ms[gang_name] = now
-        report.expired_gangs.append(gang_name)
+        for sibling_uid in cluster.gang_reservations(pg):
+            cluster.release_reservation(sibling_uid)
+        cluster.gang_last_failure_ms[pg.full_name] = now
+        report.expired_gangs.append(pg.full_name)

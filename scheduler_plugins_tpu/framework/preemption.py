@@ -111,8 +111,30 @@ class PreemptionEngine:
         return scheduled_ms + toleration_s * 1000 > now_ms
 
     # -- preemptor eligibility -------------------------------------------
+    @staticmethod
+    def _quota_view(snap, meta, preemptor, nom_aggs=None):
+        """Shared quota-state derivation for the eligibility checks: returns
+        (ns_codes, has_q, used, more_than_min, over_min). `more_than_min`
+        folds the same-ns nominee aggregate exactly like usedOverMinWith over
+        nominatedPodsReqInEQWithPodReq (capacity_scheduling.go:560)."""
+        quota = snap.quota
+        ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+        has_q = np.asarray(quota.has_quota)
+        used = np.asarray(quota.used)
+        qmin = np.asarray(quota.min)
+        over_min = np.any(used > qmin, axis=1)
+        more_than_min = False
+        p_ns = ns_codes.get(preemptor.namespace, -1)
+        if p_ns >= 0 and has_q[p_ns]:
+            req = meta.index.encode(preemptor.effective_request())
+            in_eq_agg = nom_aggs[0] if nom_aggs is not None else 0
+            more_than_min = bool(
+                np.any(used[p_ns] + req + in_eq_agg > qmin[p_ns])
+            )
+        return ns_codes, has_q, used, more_than_min, over_min
+
     def pod_eligible(self, cluster, preemptor: Pod, snap, meta,
-                     nom_aggs=None) -> bool:
+                     nom_aggs=None, scheduler=None) -> bool:
         """PodEligibleToPreemptOthers: a pod that already preempted must not
         preempt again while pods it could benefit from are still terminating
         on its nominated node (capacity_scheduling.go:409-484; upstream
@@ -120,17 +142,27 @@ class PreemptionEngine:
         if getattr(preemptor, "preemption_policy", None) == "Never":
             return False
         nom = preemptor.nominated_node_name
-        if not nom or nom not in cluster.nodes:
+        if not nom or nom not in cluster.nodes or nom not in meta.node_names:
             return True
+        nom_idx = meta.node_names.index(nom)
+        # upstream escape (capacity_scheduling.go:427-430): a nominated node
+        # the filters now consider UnschedulableAndUnresolvable frees the
+        # pod to preempt elsewhere immediately
+        if not bool(np.asarray(snap.nodes.mask)[nom_idx]):
+            return True
+        if scheduler is not None and preemptor.uid in meta.pod_names:
+            p_idx = meta.pod_names.index(preemptor.uid)
+            if not bool(
+                np.asarray(scheduler.filter_verdicts(snap, p_idx))[nom_idx]
+            ):
+                return True
         on_node = [
             p for p in cluster.pods.values() if p.node_name == nom
         ]
         if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
-            quota = snap.quota
-            ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
-            has_q = np.asarray(quota.has_quota)
-            used = np.asarray(quota.used)
-            qmin = np.asarray(quota.min)
+            ns_codes, has_q, _, more_than_min, over_min = self._quota_view(
+                snap, meta, preemptor, nom_aggs
+            )
 
             def ns_has_q(ns):
                 i = ns_codes.get(ns, -1)
@@ -138,12 +170,6 @@ class PreemptionEngine:
 
             p_ns = ns_codes.get(preemptor.namespace, -1)
             if p_ns >= 0 and has_q[p_ns]:
-                req = meta.index.encode(preemptor.effective_request())
-                in_eq_agg = nom_aggs[0] if nom_aggs is not None else 0
-                more_than_min = bool(
-                    np.any(used[p_ns] + req + in_eq_agg > qmin[p_ns])
-                )
-                over_min = np.any(used > qmin, axis=1)
                 for p in on_node:
                     if not p.terminating or not ns_has_q(p.namespace):
                         continue
@@ -180,11 +206,9 @@ class PreemptionEngine:
         lower = pri < preemptor.priority
 
         if self.mode == PreemptionMode.CAPACITY and snap.quota is not None:
-            quota = snap.quota
-            has_q = np.asarray(quota.has_quota)
-            used = np.asarray(quota.used)
-            qmin = np.asarray(quota.min)
-            ns_codes = {ns: i for i, ns in enumerate(meta.namespaces)}
+            ns_codes, has_q, _, more_than_min, over_min = self._quota_view(
+                snap, meta, preemptor, nom_aggs
+            )
             v_ns = np.array(
                 [ns_codes.get(v.namespace, -1) for v in victims]
             )
@@ -192,17 +216,9 @@ class PreemptionEngine:
             p_ns = ns_codes.get(preemptor.namespace, -1)
             p_has_q = p_ns >= 0 and bool(has_q[p_ns])
             if p_has_q:
-                req = meta.index.encode(preemptor.effective_request())
-                # usedOverMinWith over nominatedPodsReqInEQWithPodReq
-                # (capacity_scheduling.go:560): req + same-ns nominee aggregate
-                in_eq_agg = nom_aggs[0] if nom_aggs is not None else 0
-                more_than_min = bool(
-                    np.any(used[p_ns] + req + in_eq_agg > qmin[p_ns])
-                )
                 if more_than_min:
                     eligible = v_has_q & same_ns & lower
                 else:
-                    over_min = np.any(used > qmin, axis=1)  # (Q,)
                     v_over = (v_ns >= 0) & over_min[np.maximum(v_ns, 0)]
                     eligible = v_has_q & ~same_ns & v_over
             else:
@@ -274,9 +290,17 @@ class PreemptionEngine:
             return None
         # the eligibility gate runs BEFORE any victim encoding: while the
         # nominated node's terminations are in flight (the steady state the
-        # gate exists for), the gated path must be near-free
-        nom_aggs = self._nominated_aggregates(cluster, preemptor, snap, meta)
-        if not self.pod_eligible(cluster, preemptor, snap, meta, nom_aggs):
+        # gate exists for), the gated path must be near-free. The nominee
+        # aggregates are only consumed by quota logic, so DEFAULT mode skips
+        # the O(pods) scan entirely.
+        nom_aggs = (
+            self._nominated_aggregates(cluster, preemptor, snap, meta)
+            if self.mode == PreemptionMode.CAPACITY and snap.quota is not None
+            else None
+        )
+        if not self.pod_eligible(
+            cluster, preemptor, snap, meta, nom_aggs, scheduler
+        ):
             return GATED
 
         victims_all = [

@@ -137,8 +137,28 @@ class Scheduler:
                 verdict = plugin.admit(state, snap, p)
                 if verdict is not None:
                     ok &= verdict
-            # Filter: built-in resource fit + plugin filters
-            feasible = fits_one(snap.pods.req[p], state.free, snap.nodes.mask)
+            # Filter: built-in resource fit + plugin filters. Nominated
+            # pods' demand holds capacity against lower-or-equal-priority
+            # pods (upstream AddNominatedPods: priority >= evaluated pod,
+            # same UID excluded); a batch nominee stops holding once placed.
+            free_eff = state.free
+            if snap.nominees is not None:
+                nm = snap.nominees
+                live = (
+                    nm.mask
+                    & (nm.priority >= snap.pods.priority[p])
+                    & (nm.batch_idx != p)
+                )
+                if state.placed_mask is not None:
+                    placed_in_batch = (nm.batch_idx >= 0) & state.placed_mask[
+                        jnp.maximum(nm.batch_idx, 0)
+                    ]
+                    live &= ~placed_in_batch
+                hold = jnp.zeros_like(state.free).at[
+                    jnp.maximum(nm.node, 0)
+                ].add(jnp.where(live[:, None], nm.demand, 0))
+                free_eff = state.free - hold
+            feasible = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
             for plugin in plugins:
                 mask = plugin.filter(state, snap, p)
                 if mask is not None:
@@ -272,7 +292,9 @@ class Scheduler:
         else:
             numa_avail = None
         placed_mask = (
-            jnp.zeros(snap.num_pods, bool) if snap.quota is not None else None
+            jnp.zeros(snap.num_pods, bool)
+            if snap.quota is not None or snap.nominees is not None
+            else None
         )
         return SolverState(
             free=free,

@@ -52,7 +52,11 @@ class Cluster:
 
     # scheduling-runtime bookkeeping (host-only)
     reserved: dict[str, str] = field(default_factory=dict)  # uid -> node
-    gang_deadline_ms: dict[str, int] = field(default_factory=dict)
+    #: per-POD permit deadlines (the upstream waitingPods timers,
+    #: coscheduling.go:227-235): uid -> wall-clock ms at which this waiting
+    #: pod's Permit times out; each sibling gets its own timer at ITS
+    #: reservation time, and the earliest firing rejects the whole gang
+    pod_deadline_ms: dict[str, int] = field(default_factory=dict)
     gang_backoff_until_ms: dict[str, int] = field(default_factory=dict)
     gang_last_failure_ms: dict[str, int] = field(default_factory=dict)
     #: recently-bound pods whose load the metrics provider has not reported
@@ -295,6 +299,7 @@ class Cluster:
     # -- binding / reservations -----------------------------------------
     def bind(self, uid: str, node_name: str, now_ms: int = 0):
         self.reserved.pop(uid, None)
+        self.pod_deadline_ms.pop(uid, None)
         self.pods[uid].node_name = node_name
         self.recent_bindings[uid] = (now_ms, node_name)
         if self.nrt_cache is not None:
@@ -319,6 +324,7 @@ class Cluster:
             )
 
     def release_reservation(self, uid: str):
+        self.pod_deadline_ms.pop(uid, None)
         node = self.reserved.pop(uid, None)
         if node is not None and self.nrt_cache is not None:
             self.nrt_cache.unreserve(node, self.pods[uid])

@@ -207,6 +207,22 @@ class SyscallState:
 
 
 @struct.dataclass
+class NomineeState:
+    """Unbound pods nominated to a node after preemption: their demand HOLDS
+    node capacity against lower-or-equal-priority pods during the solve — the
+    upstream nominator's AddNominatedPods semantics
+    (RunFilterPluginsWithNominatedPods adds nominated pods with priority >=
+    the evaluated pod). A nominee inside the pending batch stops holding the
+    moment it places (tracked via `SolverState.placed_mask`)."""
+
+    node: np.ndarray  # (M,) int32 nominated node index
+    demand: np.ndarray  # (M, R) int64 fit demand (pods slot = 1)
+    priority: np.ndarray  # (M,) int64
+    batch_idx: np.ndarray  # (M,) int32 index in the pending batch, -1 outside
+    mask: np.ndarray  # (M,) bool
+
+
+@struct.dataclass
 class ClusterSnapshot:
     nodes: NodeState
     pods: PodState
@@ -216,6 +232,7 @@ class ClusterSnapshot:
     numa: Optional[NumaState] = None
     network: Optional["NetworkState"] = None
     syscalls: Optional[SyscallState] = None
+    nominees: Optional[NomineeState] = None
 
     @property
     def num_nodes(self) -> int:
@@ -391,6 +408,7 @@ def build_snapshot(
     # with a nomination counts, wherever it lives — upstream's nominator keeps
     # a popped pod's own nomination until assume, so the batch is included
     seen_nominated: set = set()
+    nominee_pods: list[Pod] = []
     for pod in list(pending_pods) + list(assigned_pods) + list(extra_pods):
         if (
             pod.node_name is None
@@ -399,6 +417,7 @@ def build_snapshot(
         ):
             seen_nominated.add(pod.uid)
             nominated[node_pos[pod.nominated_node_name]] += 1
+            nominee_pods.append(pod)
 
     pods_i = index.position(PODS)
     if use_native:
@@ -733,7 +752,28 @@ def build_snapshot(
             ),
         )
 
+    # nominee capacity holds (upstream AddNominatedPods semantics)
+    nominee_state = None
+    if nominee_pods:
+        M = len(nominee_pods)
+        batch_pos_nom = {p.uid: i for i, p in enumerate(pending_pods)}
+        nom_node = np.zeros(M, I32)
+        nom_demand = np.zeros((M, R), I64)
+        nom_pri = np.zeros(M, I64)
+        nom_batch = np.full(M, -1, I32)
+        for j, p in enumerate(nominee_pods):
+            nom_node[j] = node_pos[p.nominated_node_name]
+            nom_demand[j] = index.encode(p.effective_request())
+            nom_demand[j, pods_i] = 1
+            nom_pri[j] = p.priority
+            nom_batch[j] = batch_pos_nom.get(p.uid, -1)
+        nominee_state = NomineeState(
+            node=nom_node, demand=nom_demand, priority=nom_pri,
+            batch_idx=nom_batch, mask=np.ones(M, bool),
+        )
+
     snapshot = ClusterSnapshot(
+        nominees=nominee_state,
         nodes=node_state,
         pods=pod_state,
         gangs=gang_state,

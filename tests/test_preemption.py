@@ -357,3 +357,72 @@ class TestPodEligibleToPreemptOthers:
         cluster.remove_pod("default/low")  # kubelet finished termination
         r3 = run_cycle(sched, cluster, now=3000)
         assert cluster.pods["default/high"].node_name == "n0"
+
+
+class TestNominatedCapacityHolds:
+    def test_lower_priority_pod_cannot_steal_nominated_capacity(self):
+        # upstream AddNominatedPods: P (prio 10) nominated to n0 while its
+        # victim terminates; a lower-priority Q must NOT bind into the slice
+        # P depends on, but a HIGHER-priority pod may
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=3000))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("high", 3000, priority=10))
+        sched = default_sched()
+        run_cycle(sched, cluster, now=1000)
+        assert cluster.pods["default/high"].nominated_node_name == "n0"
+        # victim finishes: 3000m free, but the nomination holds it
+        cluster.remove_pod("default/low")
+        cluster.add_pod(mkpod("sneaky", 2000, priority=5, created=1500))
+        report = run_cycle(sched, cluster, now=2000)
+        assert cluster.pods["default/high"].node_name == "n0"
+        assert cluster.pods["default/sneaky"].node_name is None
+
+    def test_higher_priority_pod_ignores_nomination_hold(self):
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=3000))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("mid", 3000, priority=10))
+        sched = default_sched()
+        run_cycle(sched, cluster, now=1000)
+        assert cluster.pods["default/mid"].nominated_node_name == "n0"
+        cluster.remove_pod("default/low")
+        # a strictly higher-priority pod may take the capacity (upstream
+        # only adds nominated pods with priority >= the evaluated pod)
+        cluster.add_pod(mkpod("vip", 3000, priority=50, created=1500))
+        run_cycle(sched, cluster, now=2000)
+        assert cluster.pods["default/vip"].node_name == "n0"
+        assert cluster.pods["default/mid"].node_name is None
+
+    def test_second_preemptor_cannot_double_book_freed_capacity(self):
+        # two preemptors, one node: the first nominates; the second's dry
+        # run must see the first's hold and find nothing
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=3000))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("p1", 3000, priority=10))
+        sched = default_sched()
+        r1 = run_cycle(sched, cluster, now=1000)
+        assert "default/p1" in r1.preempted
+        cluster.add_pod(mkpod("p2", 3000, priority=9, created=1500))
+        r2 = run_cycle(sched, cluster, now=2000)
+        assert "default/p2" not in r2.preempted
+
+    def test_unresolvable_nominated_node_frees_reelection(self):
+        # upstream escape: the nominated node goes unschedulable while the
+        # victim terminates -> the preemptor is eligible to preempt elsewhere
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=3000))
+        cluster.add_node(mknode("n1", cpu=3000))
+        cluster.add_pod(mkpod("v0", 3000, priority=1, node="n0"))
+        cluster.add_pod(mkpod("v1", 3000, priority=1, node="n1"))
+        cluster.add_pod(mkpod("high", 3000, priority=10))
+        sched = default_sched()
+        r1 = run_cycle(sched, cluster, now=1000)
+        node1, victims1 = r1.preempted["default/high"]
+        # the nominated node becomes unschedulable mid-termination
+        cluster.nodes[node1].unschedulable = True
+        r2 = run_cycle(sched, cluster, now=2000)
+        assert "default/high" in r2.preempted
+        node2, _ = r2.preempted["default/high"]
+        assert node2 != node1
