@@ -151,26 +151,43 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         (len(meta.node_names), len(meta.index)), np.int64
     )
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
-    # pre-seed with PRIOR cycles' live nominations (kept while gated) minus
-    # the capacity their in-flight terminations will free — the upstream
-    # evaluator reads both from the nominator/NodeInfo, so a second
-    # preemptor cannot double-book capacity a kept nomination depends on.
-    # (A nomination that moves or clears during this loop leaves its seed
-    # in place for the rest of the cycle — a conservative overcount.)
+    # PRIOR cycles' live nominations (kept while gated) hold capacity in the
+    # dry runs, but only against preemptors of lower-or-equal priority
+    # (upstream AddNominatedPods adds nominees with priority >= the evaluated
+    # pod); the capacity their in-flight terminations will free is credited
+    # to everyone. failed_pods arrive in queue order (priority descending),
+    # so each hold is folded in exactly once by a pointer sweep as the
+    # preemptor priority drops to its level. (A nomination that moves or
+    # clears during this loop leaves its seed in place for the rest of the
+    # cycle — a conservative overcount.)
     for pod in cluster.pods.values():
-        if (
-            pod.node_name is None
-            and not pod.terminating
-            and pod.nominated_node_name in node_pos
-        ):
-            nominated_extra[node_pos[pod.nominated_node_name]] += (
-                encode_demand(meta.index, pod)
-            )
-        elif pod.terminating and pod.node_name in node_pos:
+        if pod.terminating and pod.node_name in node_pos:
             nominated_extra[node_pos[pod.node_name]] -= encode_demand(
                 meta.index, pod
             )
+    prior_holds = sorted(
+        (
+            (
+                node_pos[pod.nominated_node_name],
+                encode_demand(meta.index, pod),
+                pod.priority,
+            )
+            for pod in cluster.pods.values()
+            if pod.node_name is None
+            and not pod.terminating
+            and pod.nominated_node_name in node_pos
+        ),
+        key=lambda t: -t[2],
+    )
+    hold_ptr = 0
     for pod in failed_pods:
+        while (
+            hold_ptr < len(prior_holds)
+            and prior_holds[hold_ptr][2] >= pod.priority
+        ):
+            n_, demand_, _ = prior_holds[hold_ptr]
+            nominated_extra[n_] += demand_
+            hold_ptr += 1
         pg = cluster.pod_group_of(pod)
         if pg is not None and pg.full_name in rejected:
             continue  # the whole gang was rejected; no point preempting

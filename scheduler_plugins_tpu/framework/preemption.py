@@ -141,21 +141,18 @@ class PreemptionEngine:
         DefaultPreemption semantics for the DEFAULT mode)."""
         if getattr(preemptor, "preemption_policy", None) == "Never":
             return False
+        del scheduler  # plain-Unschedulable filters must NOT trigger the escape
         nom = preemptor.nominated_node_name
         if not nom or nom not in cluster.nodes or nom not in meta.node_names:
             return True
         nom_idx = meta.node_names.index(nom)
-        # upstream escape (capacity_scheduling.go:427-430): a nominated node
-        # the filters now consider UnschedulableAndUnresolvable frees the
-        # pod to preempt elsewhere immediately
+        # upstream escape (capacity_scheduling.go:427-430): only a nominated
+        # node that became UnschedulableAndUnresolvable (cordoned/gone) frees
+        # the pod to preempt elsewhere — a resolvable plugin-filter rejection
+        # (e.g. NUMA on the still-occupied cache view) keeps the gate closed,
+        # or one pod would collect two victim sets
         if not bool(np.asarray(snap.nodes.mask)[nom_idx]):
             return True
-        if scheduler is not None and preemptor.uid in meta.pod_names:
-            p_idx = meta.pod_names.index(preemptor.uid)
-            if not bool(
-                np.asarray(scheduler.filter_verdicts(snap, p_idx))[nom_idx]
-            ):
-                return True
         on_node = [
             p for p in cluster.pods.values() if p.node_name == nom
         ]

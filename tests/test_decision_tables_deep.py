@@ -1,0 +1,485 @@
+"""Deep decision tables (VERDICT round-1 #9): the four areas where the
+reference's unit suites are thickest, mirrored case-for-case —
+
+- OverReserve cache state machine (cache/overreserve_test.go, 1344 LoC)
+- LROC beta-distribution edge table (lowriskovercommitment/beta_test.go)
+- SySched extraneous-syscall set arithmetic (sysched_test.go)
+- NetworkOverhead filter thresholds (networkoverhead_test.go)
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    SeccompProfile,
+    TopologyManagerPolicy,
+    APP_GROUP_LABEL,
+    REGION_LABEL,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.ops.trimaran import compute_probability
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.state.nrt_cache import (
+    OverReserveCache,
+    compute_pod_fingerprint,
+)
+
+gib = 1 << 30
+
+
+def mknrt(node, cpu_per_zone=4000, fingerprint="", policy=None):
+    nrt = NodeResourceTopology(
+        node_name=node,
+        zones=[
+            NUMAZone(numa_id=i, available={CPU: cpu_per_zone, MEMORY: 16 * gib})
+            for i in range(2)
+        ],
+        policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+        pod_fingerprint=fingerprint,
+    )
+    if policy is not None:
+        nrt.policy = policy
+    return nrt
+
+
+def gpod(name, cpu=1000, node=None, ns="default"):
+    p = Pod(
+        name=name,
+        namespace=ns,
+        containers=[
+            Container(requests={CPU: cpu, MEMORY: gib},
+                      limits={CPU: cpu, MEMORY: gib})
+        ],
+    )
+    p.node_name = node
+    return p
+
+
+class TestOverReserveStateMachine:
+    """Mirrors the overreserve_test.go state machine case-for-case."""
+
+    def test_reserve_alone_does_not_mark_dirty(self):
+        # TestDirtyNodesMarkDiscarded: reserves on a pristine cache leave
+        # the desynced set empty; only NodeMaybeOverReserved marks
+        cache = OverReserveCache()
+        for n in ("node-1", "node-4"):
+            cache.update_nrt(mknrt(n))
+            cache.reserve(n, gpod(f"p-{n}"))
+        assert cache.desynced_nodes() == set()
+        for n in ("node-1", "node-4"):
+            cache.mark_maybe_overreserved(n)
+        assert cache.desynced_nodes() == {"node-1", "node-4"}
+
+    def test_dirty_not_unmarked_on_reserve(self):
+        # TestDirtyNodesNotUnmarkedOnReserve: only a flush clears dirty
+        cache = OverReserveCache()
+        for n in ("node-1", "node-4"):
+            cache.update_nrt(mknrt(n))
+            cache.reserve(n, gpod(f"p-{n}"))
+            cache.mark_maybe_overreserved(n)
+        cache.reserve("node-4", gpod("extra"))
+        assert cache.desynced_nodes() == {"node-1", "node-4"}
+
+    def test_reserve_skips_without_nrt(self):
+        # TestReserveSkipsWithoutNRT: no NRT data -> nothing assumed
+        cache = OverReserveCache()
+        cache.reserve("ghost", gpod("p1"))
+        assert "ghost" not in cache.assumed
+        nrts, _ = cache.view()
+        assert nrts == []
+
+    def test_cached_copy_reserve_release_sequence(self):
+        # TestGetCachedNRTCopyReserve / ReleaseNone / ReserveRelease
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1", cpu=1500)
+        # reserve: deduction visible
+        cache.reserve("n0", pod)
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 2500
+        # release a NEVER-reserved pod: no effect
+        cache.unreserve("n0", gpod("stranger"))
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 2500
+        # release the reserved pod: deduction gone
+        cache.unreserve("n0", pod)
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 4000
+
+    def test_multiple_reservations_accumulate(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        cache.reserve("n0", gpod("a", cpu=1000))
+        cache.reserve("n0", gpod("b", cpu=500))
+        nrts, _ = cache.view()
+        for zone in nrts[0].zones:
+            assert zone.available[CPU] == 2500  # every zone, both pods
+
+    def test_resync_without_fingerprint_refuses(self):
+        # TestResyncNoPodFingerprint: an agent report without a stamped
+        # fingerprint cannot be trusted for a dirty node
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1", node="n0")
+        cache.reserve("n0", pod)
+        cache.mark_maybe_overreserved("n0")
+        cache.update_nrt(mknrt("n0", cpu_per_zone=3000))  # no fingerprint
+        assert cache.resync({"n0": [pod]}) == []
+        assert "n0" in cache.desynced_nodes()
+        assert cache.generation == 0
+
+    def test_resync_mismatch_keeps_node_dirty_and_assumed(self):
+        # TestResyncFingerprintMismatchKeepsNodeDirty
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        pod = gpod("p1", node="n0")
+        cache.reserve("n0", pod)
+        cache.mark_maybe_overreserved("n0")
+        cache.update_nrt(
+            mknrt("n0", cpu_per_zone=3000, fingerprint="pfp0vFFFFdeadbeef")
+        )
+        assert cache.resync({"n0": [pod]}) == []
+        assert "n0" in cache.desynced_nodes()
+        # the stale cached view (with the deduction) keeps serving
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 4000 - 1000
+
+    def test_resync_interleaved_reservation_kept(self):
+        # TestResyncReserveInterleaved: a reservation taken while the node
+        # is dirty survives a failed resync attempt
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        bound = gpod("p1", node="n0")
+        cache.reserve("n0", bound)
+        cache.mark_maybe_overreserved("n0")
+        cache.update_nrt(
+            mknrt("n0", cpu_per_zone=4000, fingerprint="pfp0vBADBAD")
+        )
+        waiting = gpod("w1", cpu=500)
+        cache.reserve("n0", waiting)  # interleaved
+        assert cache.resync({"n0": [bound]}) == []
+        assert set(cache.assumed["n0"]) == {bound.uid, waiting.uid}
+
+    def test_resync_flush_drops_covered_keeps_waiting(self):
+        # TestResyncMatchFingerprint + in-flight reservation preservation
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        bound = gpod("p1", node="n0")
+        waiting = gpod("w1", cpu=500)
+        cache.reserve("n0", bound)
+        cache.reserve("n0", waiting)
+        cache.mark_maybe_overreserved("n0")
+        fp = compute_pod_fingerprint([("default", "p1")])
+        cache.update_nrt(mknrt("n0", cpu_per_zone=2000, fingerprint=fp))
+        assert cache.resync({"n0": [bound]}) == ["n0"]
+        assert cache.generation == 1
+        assert "n0" not in cache.desynced_nodes()
+        # covered pod's deduction dropped, waiting pod's kept
+        assert set(cache.assumed.get("n0", {})) == {waiting.uid}
+        nrts, _ = cache.view()
+        assert nrts[0].zones[0].available[CPU] == 2000 - 500
+
+    def test_unknown_node_with_foreign_pods(self):
+        # TestUnknownNodeWithForeignPods: foreign marking works for nodes
+        # the cache has no NRT for; resync tolerates the missing report
+        cache = OverReserveCache()
+        alien = gpod("alien", node="mystery")
+        alien.scheduler_name = "default-scheduler"
+        cache.track_pod(alien)
+        assert cache.desynced_nodes() == {"mystery"}
+        assert cache.resync({}) == []
+        assert "mystery" in cache.desynced_nodes()
+
+    def test_foreign_node_always_stale_until_resynced(self):
+        # TestNodeWithForeignPods + TestOverresevedGetCachedNRTCopyWithForeignPods
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        alien = gpod("alien", node="n0")
+        alien.scheduler_name = "default-scheduler"
+        cache.track_pod(alien)
+        _, stale = cache.view()
+        assert stale == {"n0"}
+        # resync with a fingerprint covering the foreign pod clears it
+        fp = compute_pod_fingerprint([("default", "alien")])
+        cache.update_nrt(mknrt("n0", fingerprint=fp))
+        assert cache.resync({"n0": [alien]}) == ["n0"]
+        _, stale = cache.view()
+        assert stale == set()
+
+    def test_generation_bumps_once_per_pass(self):
+        cache = OverReserveCache()
+        for n in ("a", "b"):
+            cache.update_nrt(mknrt(n))
+            cache.reserve(n, gpod(f"p-{n}", node=n))
+            cache.mark_maybe_overreserved(n)
+            fp = compute_pod_fingerprint([("default", f"p-{n}")])
+            cache.update_nrt(mknrt(n, fingerprint=fp))
+        flushed = cache.resync(
+            {n: [gpod(f"p-{n}", node=n)] for n in ("a", "b")}
+        )
+        assert sorted(flushed) == ["a", "b"]
+        assert cache.generation == 1  # one bump for the whole pass
+
+
+class TestBetaEdgeTable:
+    """lowriskovercommitment/beta_test.go vectors through
+    compute_probability (moment-matched CDF)."""
+
+    @staticmethod
+    def _params(alpha, beta):
+        m1 = alpha / (alpha + beta)
+        var = alpha * beta / ((alpha + beta) ** 2 * (alpha + beta + 1))
+        return m1, math.sqrt(var)
+
+    @pytest.mark.parametrize("alpha,beta,x,want", [
+        (1.0, 1.0, 0.25, 0.25),     # uniform: CDF(x) = x
+        (1.0, 1.0, 0.5, 0.5),
+        (2.0, 2.0, 0.5, 0.5),       # beta(2,2) PDF symmetry
+        (2.0, 2.0, 0.0, 0.0),       # x == 0 -> 0 (beta.go:84-87)
+        (2.0, 2.0, 1.0, 1.0),       # x == 1 -> 1
+        (1.0, 2.0, 0.5, 0.75),      # CDF = 1 - (1-x)^2
+        (3.0, 1.0, 0.5, 0.125),     # CDF = x^3
+    ])
+    def test_moment_matched_cdf(self, alpha, beta, x, want):
+        mu, sigma = self._params(alpha, beta)
+        prob, valid, a, b = compute_probability(
+            jnp.float64(mu), jnp.float64(sigma), jnp.float64(x)
+        )
+        assert bool(valid)
+        assert float(a) == pytest.approx(alpha, abs=1e-9)
+        assert float(b) == pytest.approx(beta, abs=1e-9)
+        assert float(prob) == pytest.approx(want, abs=1e-6)
+
+    def test_degenerate_zero_mu_is_certain(self):
+        # mu == 0: utilization certainly below any threshold
+        prob, valid, _, _ = compute_probability(
+            jnp.float64(0.0), jnp.float64(0.1), jnp.float64(0.5)
+        )
+        assert float(prob) == 1.0 and not bool(valid)
+
+    def test_degenerate_zero_sigma_point_mass(self):
+        below, _, _, _ = compute_probability(
+            jnp.float64(0.3), jnp.float64(0.0), jnp.float64(0.5)
+        )
+        above, _, _, _ = compute_probability(
+            jnp.float64(0.7), jnp.float64(0.0), jnp.float64(0.5)
+        )
+        assert float(below) == 1.0
+        assert float(above) == 0.0
+
+    def test_invalid_moments_rejected(self):
+        # variance >= m1(1-m1): MatchMoments fails (beta.go:107-117)
+        prob, valid, _, _ = compute_probability(
+            jnp.float64(0.5), jnp.float64(0.6), jnp.float64(0.5)
+        )
+        assert not bool(valid)
+        assert float(prob) == 0.0
+
+    def test_cdf_monotone_in_threshold(self):
+        mu, sigma = self._params(2.0, 5.0)
+        probs = [
+            float(compute_probability(
+                jnp.float64(mu), jnp.float64(sigma), jnp.float64(x)
+            )[0])
+            for x in (0.1, 0.3, 0.5, 0.7, 0.9)
+        ]
+        assert probs == sorted(probs)
+
+
+class TestSySchedSetArithmetic:
+    """Exact extraneous-syscall scores (sysched.go:234-279)."""
+
+    def _snapshot(self, pod_profile, hosts):
+        """hosts: {node: [profile names of its pods]}; returns (snap, p=0)."""
+        c = Cluster()
+        profiles = {
+            "web": frozenset({"read", "write", "accept", "listen"}),
+            "db": frozenset({"read", "write", "fsync", "mmap"}),
+            "tiny": frozenset({"read"}),
+            "wild": frozenset({"read", "write", "ptrace", "clone", "execve"}),
+        }
+        for name, syscalls in profiles.items():
+            c.add_seccomp_profile(SeccompProfile(name=name, syscalls=syscalls))
+        serial = 0
+        for node, pod_profiles in hosts.items():
+            c.add_node(Node(name=node, allocatable={
+                CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+            for prof in pod_profiles:
+                serial += 1
+                c.add_pod(Pod(
+                    name=f"h{serial}", node_name=node,
+                    containers=[Container(requests={CPU: 100},
+                                          seccomp_profile=f"default/{prof}")],
+                ))
+        pending = Pod(name="pending", containers=[Container(
+            requests={CPU: 100},
+            seccomp_profile=f"default/{pod_profile}" if pod_profile else None,
+        )])
+        c.add_pod(pending)
+        snap, meta = c.snapshot([pending], now_ms=0)
+        return snap, meta
+
+    def _scores(self, pod_profile, hosts):
+        from scheduler_plugins_tpu.plugins import SySched
+
+        snap, meta = self._snapshot(pod_profile, hosts)
+        plugin = SySched()
+        plugin.prepare(meta)
+        plugin.bind_aux(plugin.aux())
+        raw = np.asarray(plugin.score(None, snap, 0))
+        return {name: int(raw[i]) for i, name in enumerate(meta.node_names)}
+
+    def test_identical_profile_scores_zero(self):
+        scores = self._scores("web", {"n0": ["web"]})
+        # |host-pod| = 0; existing pod sees |(host∪pod)-web| = 0
+        assert scores["n0"] == 0
+
+    def test_disjoint_extraneous_both_directions(self):
+        scores = self._scores("web", {"n0": ["db"]})
+        # |db-web| = {fsync,mmap} = 2; d sees |(db∪web)-db| = {accept,listen} = 2
+        assert scores["n0"] == 4
+
+    def test_subset_profile(self):
+        scores = self._scores("tiny", {"n0": ["web"]})
+        # |web-tiny| = 3; w sees |(web∪tiny)-web| = 0
+        assert scores["n0"] == 3
+
+    def test_superset_profile(self):
+        scores = self._scores("wild", {"n0": ["tiny"]})
+        # |tiny-wild| = 0; tiny sees |(tiny∪wild)-tiny| = 4
+        assert scores["n0"] == 4
+
+    def test_multiple_existing_pods_sum(self):
+        scores = self._scores("web", {"n0": ["db", "tiny"]})
+        # host = db∪tiny = {read,write,fsync,mmap}; |host-web| = 2
+        # newHost = host∪web (6 syscalls: read,write,fsync,mmap,accept,listen)
+        # db sees 6-4=2; tiny sees 6-1=5 -> total 2+2+5 = 9
+        assert scores["n0"] == 9
+
+    def test_empty_host_scores_zero(self):
+        scores = self._scores("web", {"n0": []})
+        assert scores["n0"] == 0  # sysched.go:255-259
+
+    def test_unprofiled_pod_scores_equal_everywhere(self):
+        scores = self._scores(None, {"n0": ["db"], "n1": ["web"]})
+        assert scores["n0"] == scores["n1"]  # MaxInt analog on every node
+
+
+class TestNetworkOverheadThresholds:
+    """Filter verdict boundaries (networkoverhead.go:326-359, 500-573)."""
+
+    def _cluster(self, zone_cost, max_cost, placed_zones):
+        c = Cluster()
+        region_of = {"z0": "r0", "z1": "r0", "z2": "r1"}
+        for i, z in enumerate(["z0", "z1", "z2"]):
+            c.add_node(Node(
+                name=f"n-{z}", allocatable={CPU: 64_000, MEMORY: 64 * gib,
+                                            PODS: 110},
+                labels={ZONE_LABEL: z, REGION_LABEL: region_of[z]},
+            ))
+        c.add_network_topology(NetworkTopology(weights={"UserDefined": {
+            "zone": zone_cost, "region": {("r0", "r1"): 80, ("r1", "r0"): 80},
+        }}))
+        w0 = AppGroupWorkload(selector="w0")
+        w1 = AppGroupWorkload(selector="w1")
+        w1.dependencies.append(AppGroupDependency(
+            workload_selector="w0", max_network_cost=max_cost))
+        c.add_app_group(AppGroup(name="ag", workloads=[w0, w1],
+                                 topology_order={"w0": 0, "w1": 1}))
+        for j, z in enumerate(placed_zones):
+            c.add_pod(Pod(
+                name=f"placed{j}", node_name=f"n-{z}",
+                containers=[Container(requests={CPU: 100})],
+                labels={APP_GROUP_LABEL: "ag",
+                        WORKLOAD_SELECTOR_LABEL: "w0"},
+            ))
+        pending = Pod(
+            name="pending",
+            containers=[Container(requests={CPU: 100})],
+            labels={APP_GROUP_LABEL: "ag", WORKLOAD_SELECTOR_LABEL: "w1"},
+        )
+        c.add_pod(pending)
+        return c, pending
+
+    def _verdicts(self, zone_cost, max_cost, placed_zones):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.plugins import NetworkOverhead
+
+        c, pending = self._cluster(zone_cost, max_cost, placed_zones)
+        snap, meta = c.snapshot([pending], now_ms=0)
+        plugin = NetworkOverhead()
+        sched = Scheduler(Profile(plugins=[plugin]))
+        sched.prepare(meta, c)
+        plugin.bind_aux(plugin.aux())
+        state0 = sched.initial_state(snap)
+        verdict = np.asarray(plugin.filter(state0, snap, 0))
+        return {name: bool(verdict[i]) for i, name in enumerate(meta.node_names)}
+
+    def test_cost_equal_to_max_is_satisfied(self):
+        # cost <= MaxNetworkCost counts satisfied (networkoverhead.go:549-553)
+        v = self._verdicts({("z1", "z0"): 10, ("z0", "z1"): 10}, 10, ["z0"])
+        assert v["n-z1"]  # cost 10 == max 10: satisfied
+
+    def test_cost_above_max_violates_and_filters(self):
+        v = self._verdicts({("z1", "z0"): 11, ("z0", "z1"): 11}, 10, ["z0"])
+        assert not v["n-z1"]  # 1 violated > 0 satisfied
+
+    def test_equal_satisfied_and_violated_passes(self):
+        # violated <= satisfied passes the Filter (strict > rejects)
+        v = self._verdicts(
+            {("z1", "z0"): 11, ("z0", "z1"): 11}, 10, ["z0", "z1"]
+        )
+        # candidate n-z1: placed z0 -> cost 11 violated; placed z1 ->
+        # same-zone satisfied => 1 violated vs 1 satisfied -> pass
+        assert v["n-z1"]
+
+    def test_missing_cost_pair_counts_nothing(self):
+        # a missing zone-cost entry adds MaxCost but neither satisfied nor
+        # violated (networkoverhead.go:546-556) -> filter passes
+        v = self._verdicts({}, 10, ["z0"])
+        assert v["n-z1"]
+
+    def test_cross_region_uses_region_cost(self):
+        # n-z2 sits in r1; region cost 80 > max 10 -> violated
+        v = self._verdicts({}, 10, ["z0"])
+        assert not v["n-z2"]
+        # generous max accepts the region cost
+        v = self._verdicts({}, 90, ["z0"])
+        assert v["n-z2"]
+
+    def test_same_zone_always_satisfied(self):
+        # same-zone placement satisfies unconditionally even with max 0
+        v = self._verdicts({}, 0, ["z1"])
+        assert v["n-z1"]
+
+    def test_pod_without_dependencies_passes_everywhere(self):
+        from scheduler_plugins_tpu.plugins import NetworkOverhead
+
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+
+        c, pending = self._cluster({}, 10, ["z0"])
+        # re-label the pending pod as the dependency-free workload w0
+        pending.labels = {APP_GROUP_LABEL: "ag",
+                          WORKLOAD_SELECTOR_LABEL: "w0"}
+        snap, meta = c.snapshot([pending], now_ms=0)
+        plugin = NetworkOverhead()
+        sched = Scheduler(Profile(plugins=[plugin]))
+        sched.prepare(meta, c)
+        plugin.bind_aux(plugin.aux())
+        state0 = sched.initial_state(snap)
+        verdict = np.asarray(plugin.filter(state0, snap, 0))
+        assert verdict.all()
