@@ -151,43 +151,35 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         (len(meta.node_names), len(meta.index)), np.int64
     )
     node_pos = {name: i for i, name in enumerate(meta.node_names)}
-    # PRIOR cycles' live nominations (kept while gated) hold capacity in the
-    # dry runs, but only against preemptors of lower-or-equal priority
-    # (upstream AddNominatedPods adds nominees with priority >= the evaluated
-    # pod); the capacity their in-flight terminations will free is credited
-    # to everyone. failed_pods arrive in queue order (priority descending),
-    # so each hold is folded in exactly once by a pointer sweep as the
-    # preemptor priority drops to its level. (A nomination that moves or
-    # clears during this loop leaves its seed in place for the rest of the
-    # cycle — a conservative overcount.)
+    # PRIOR cycles' live nominations (kept while gated) and nominations made
+    # EARLIER IN THIS LOOP hold capacity in the dry runs, but only against
+    # preemptors of lower-or-equal priority (upstream AddNominatedPods adds
+    # nominees with priority >= the evaluated pod, same UID excluded); the
+    # capacity in-flight terminations will free is credited to everyone.
+    # Each preemptor's view is assembled fresh from the hold list — the
+    # queue order of failed_pods is NOT priority-descending under every
+    # QueueSort (TopologicalSort orders same-AppGroup pods by topology
+    # index), so a one-way pointer sweep would fold low-priority holds in
+    # against later higher-priority preemptors. A nomination that clears or
+    # moves during this loop drops its old hold (same-UID dedup below).
     for pod in cluster.pods.values():
         if pod.terminating and pod.node_name in node_pos:
             nominated_extra[node_pos[pod.node_name]] -= encode_demand(
                 meta.index, pod
             )
-    prior_holds = sorted(
+    holds = [
         (
-            (
-                node_pos[pod.nominated_node_name],
-                encode_demand(meta.index, pod),
-                pod.priority,
-            )
-            for pod in cluster.pods.values()
-            if pod.node_name is None
-            and not pod.terminating
-            and pod.nominated_node_name in node_pos
-        ),
-        key=lambda t: -t[2],
-    )
-    hold_ptr = 0
+            node_pos[pod.nominated_node_name],
+            encode_demand(meta.index, pod),
+            pod.priority,
+            pod.uid,
+        )
+        for pod in cluster.pods.values()
+        if pod.node_name is None
+        and not pod.terminating
+        and pod.nominated_node_name in node_pos
+    ]
     for pod in failed_pods:
-        while (
-            hold_ptr < len(prior_holds)
-            and prior_holds[hold_ptr][2] >= pod.priority
-        ):
-            n_, demand_, _ = prior_holds[hold_ptr]
-            nominated_extra[n_] += demand_
-            hold_ptr += 1
         pg = cluster.pod_group_of(pod)
         if pg is not None and pg.full_name in rejected:
             continue  # the whole gang was rejected; no point preempting
@@ -196,23 +188,20 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         # pod could benefit from are still terminating on its nominated
         # node, it must NOT preempt again — and the nomination is KEPT so
         # the gate can keep firing (capacity_scheduling.go:409-484).
-        # The pod's OWN seeded hold must not block its own dry run
-        # (upstream excludes same-UID nominated pods).
-        own = None
-        if pod.nominated_node_name in node_pos and not pod.terminating:
-            own = (
-                node_pos[pod.nominated_node_name],
-                encode_demand(meta.index, pod),
-            )
-            nominated_extra[own[0]] -= own[1]
+        extra = nominated_extra.copy()
+        for n_, demand_, prio_, uid_ in holds:
+            if prio_ >= pod.priority and uid_ != pod.uid:
+                extra[n_] += demand_
         result = engine.preempt(
             cluster, scheduler, pod, snap, meta, now,
-            extra_reserved=nominated_extra,
+            extra_reserved=extra,
         )
         if result is GATED:
-            if own is not None:
-                nominated_extra[own[0]] += own[1]  # the hold stays
-            continue  # terminations in flight: nomination stays
+            continue  # terminations in flight: nomination (hold) stays
+        # past this point the pod's nomination either clears or moves —
+        # either way its previous hold is dead (same-UID dedup also keeps a
+        # re-preempting nominee from holding double)
+        holds = [h for h in holds if h[3] != pod.uid]
         if result is None:
             # nomination did not help and nothing is terminating: clear it
             # so the pod re-enters PostFilter fresh (upstream clears
@@ -233,9 +222,11 @@ def _run_preemption(scheduler, cluster, pending, report, now):
                 # mirror's terminating counts in sync too)
                 cluster.mark_terminating(victim_uid, now)
                 victim_freed += encode_demand(meta.index, victim)
-        # net effect on the node for later preemptors: nominee demand minus
-        # the capacity its victims will free
-        nominated_extra[n] += demand - victim_freed
+        # the new nominee holds its demand against later lower-or-equal-
+        # priority preemptors; the capacity its victims free is credited
+        # to everyone
+        holds.append((n, demand, pod.priority, pod.uid))
+        nominated_extra[n] -= victim_freed
         report.preempted[pod.uid] = (result.nominated_node, result.victims)
 
 

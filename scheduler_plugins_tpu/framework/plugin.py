@@ -70,6 +70,14 @@ class Plugin:
     #: score weight, the framework multiplies normalized scores by this
     #: (upstream plugin weights in the profile config).
     weight: int = 1
+    #: True when `filter` reads the SolverState carry (its verdict depends
+    #: on earlier in-cycle placements). The batched throughput path
+    #: (`parallel.solver.profile_batch_solve`) re-evaluates such filters
+    #: every wave against the committed carry — a plugin that sets this MUST
+    #: implement `commit_batch`, and should implement the `wave_guard` pair
+    #: when its filter is a hard resource constraint that same-wave
+    #: placements can violate.
+    state_dependent_filter: bool = False
 
     def prepare(self, meta: SnapshotMeta) -> None:
         """Bake per-snapshot-layout constants (resource weights, arg vectors)."""
@@ -138,3 +146,26 @@ class Plugin:
     def commit(self, state: SolverState, snap: ClusterSnapshot, p, choice):
         """Reserve: fold `choice` (node index or -1) into the carried state."""
         return state
+
+    # --- batched throughput path (parallel.solver) -----------------------
+    def commit_batch(self, state: SolverState, snap: ClusterSnapshot,
+                     placed, choice):
+        """Batched Reserve: fold a whole wave's placements (`placed` (P,)
+        bool, `choice` (P,) int32) into the carry in one shot. Must be
+        order-independent — the carries this framework uses (zone
+        deductions, placement tallies) are sums, so batch == any sequential
+        order of per-pod `commit`s. Required iff `state_dependent_filter`."""
+        return state
+
+    def wave_guard_demand(self, snap: ClusterSnapshot):
+        """(P, R') non-negative per-pod demand in this plugin's admission
+        domain, or None when the plugin needs no within-wave guard."""
+        return None
+
+    def wave_guard(self, state: SolverState, snap: ClusterSnapshot, p, node,
+                   prefix):
+        """Exact within-wave admission: True iff pod `p` still passes this
+        plugin's filter on `node` after `prefix` (R',) of earlier same-wave
+        winners' demand landed there (evaluated against the wave-start
+        carry). See `ops.assign.waterfill_assign_stateful`."""
+        return jnp.bool_(True)

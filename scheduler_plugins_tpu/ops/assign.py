@@ -33,34 +33,38 @@ from scheduler_plugins_tpu.ops.fit import pod_fit_demand
 #: signature: (free (N,R), pod_index int32) -> (feasible (N,) bool, score (N,) int64)
 StepFn = Callable
 
+def _sorted_segments(onehot):
+    """Queue-order segment layout for a wave's node choices: `order` sorts
+    pods by (chosen node, queue position) with "no choice" (sentinel N)
+    last; `seg` = sorted segment ids; `first` marks each segment's head."""
+    P, N = onehot.shape
+    choice = jnp.where(onehot.any(axis=1), jnp.argmax(onehot, axis=1), N)
+    order = jnp.argsort(choice * P + jnp.arange(P))  # stable (choice, queue)
+    seg = choice[order]
+    first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
+    return order, seg, first
+
+
+def _segment_prefix(values_sorted, first):
+    """Inclusive per-segment prefix sums of NON-NEGATIVE (P, R) float values
+    WITHOUT a (P, N) cumsum (int64 2-D cumsums lower to vmem-hungry
+    reduce-windows on TPU and compile pathologically): 1-D cumsums over the
+    sorted axis, rebased per segment with a forward-filled running maximum
+    (cummax works because the exclusive cumsum is non-decreasing)."""
+    csum = jnp.cumsum(values_sorted, axis=0)
+    exclusive = csum - values_sorted
+    base = jax.lax.cummax(jnp.where(first[:, None], exclusive, -1.0), axis=0)
+    return csum - base
+
+
 def _queue_order_admission(onehot, demand, free):
     """(P,) bool: pod admitted iff its node still fits after all earlier
-    winners of the same wave on that node.
-
-    Exact per-node queue-order prefix sums WITHOUT a (P, N) cumsum (int64
-    2-D cumsums lower to vmem-hungry reduce-windows on TPU and compile
-    pathologically): sort pods by (chosen node, queue position), run 1-D
-    float64 cumsums (exact below 2^53) over the sorted axis, rebase each
-    node's segment with a forward-filled running maximum, and scatter the
-    verdicts back.
-    """
+    winners of the same wave on that node (exact sorted-segment prefix
+    sums in float64 — exact below 2^53)."""
     P, N = onehot.shape
-    R = demand.shape[1]
-    choice = jnp.where(
-        onehot.any(axis=1), jnp.argmax(onehot, axis=1), N
-    )  # (P,) chosen node, N = "no choice" sentinel sorted last
-    rank = jnp.arange(P)
-    order = jnp.argsort(choice * P + rank)  # stable (choice, queue) order
-    seg = choice[order]  # (P,) sorted segment ids
-    first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
-
+    order, seg, first = _sorted_segments(onehot)
     dem_sorted = demand[order].astype(jnp.float64)  # (P, R)
-    csum = jnp.cumsum(dem_sorted, axis=0)  # 1-D scans per resource column
-    exclusive = csum - dem_sorted
-    # segment base = exclusive sum at the segment's first row, forward-filled
-    # (cummax works: exclusive is non-decreasing along the sorted axis)
-    base = jax.lax.cummax(jnp.where(first[:, None], exclusive, -1.0), axis=0)
-    within = csum - base  # inclusive per-segment prefix
+    within = _segment_prefix(dem_sorted, first)  # inclusive per-segment
     free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)  # (P, R)
     ok_sorted = jnp.all(within <= free_row, axis=1) & (seg < N)
     return jnp.zeros(P, bool).at[order].set(ok_sorted)
@@ -110,25 +114,72 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
     cumulative-capacity bucket contains k (falling back to the pod's argmax
     when that node is infeasible for it); validate with the exact queue-order
     prefix admission and retry the rest next wave.
+
+    Stateless front-end of `waterfill_assign_stateful` (one shared wave
+    body): no plugin carry, no guards.
+    """
+    assignment, free, _ = waterfill_assign_stateful(
+        lambda f, _state, active: batch_fn(f, active),
+        lambda state, _placed, _choice: state,
+        (),
+        (),
+        req,
+        pod_mask,
+        free0,
+        jnp.int32(0),
+        max_waves=max_waves,
+    )
+    return assignment, free
+
+
+def waterfill_assign_stateful(
+    batch_fn,
+    commit_fn,
+    guards,
+    guard_demands,
+    req,
+    pod_mask,
+    free0,
+    state0,
+    max_waves: int = 4,
+):
+    """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
+    filters (NUMA zone availability, network placement tallies): the carries
+    the sequential scan threads per pod are re-evaluated per WAVE here, so
+    hard plugin constraints hold against committed placements instead of the
+    cycle-initial snapshot.
+
+    - ``batch_fn(free, state, active) -> (feasible (P,N), scores (P,N))`` is
+      re-invoked every wave with the carried state (per-wave re-filtering).
+    - ``commit_fn(state, placed (P,) bool, choice (P,) int32) -> state``
+      folds a whole wave's placements into the carry (must be
+      order-independent — the framework's carries are sums).
+    - ``guards`` / ``guard_demands``: per-plugin exact WITHIN-wave admission.
+      Each guard is ``fn(state, p, node, prefix (R_g,)) -> bool`` evaluated
+      in queue order with ``prefix`` = the exclusive per-(wave, node) sum of
+      ``guard_demands[i]`` (a (P, R_g) non-negative float array) over earlier
+      same-wave choosers of the same node. A pod whose guard fails retries
+      next wave against the committed state. Prefixes include earlier
+      choosers that were themselves rejected — conservative (never violates
+      hard constraints; may defer a feasible pod to the next wave), matching
+      `_queue_order_admission`'s capacity semantics.
+
+    Not jitted itself: designed to run inside a caller's jit (the closures
+    are trace-local). Returns (assignment, free, state).
     """
     P, R = req.shape
     demand = pod_fit_demand(req)
     N = free0.shape[0]
 
-    def wave(carry, _):
-        free, assignment = carry
+    def wave(free, assignment, state):
         active = (assignment == -1) & pod_mask
-        feasible, scores = batch_fn(free, active)
+        feasible, scores = batch_fn(free, state, active)
         feasible &= active[:, None]
         neg_inf = jnp.iinfo(scores.dtype).min // 2
         n_active = jnp.maximum(active.sum(), 1)
 
-        # node order by mean score over active pods (static scores -> the
-        # same pack order the sequential scan would follow)
         mean_score = jnp.sum(jnp.where(active[:, None], scores, 0), axis=0)
-        order = jnp.argsort(-mean_score, stable=True)  # (N,)
-
-        # per-node capacity estimate in pods, from the mean active demand
+        order_n = jnp.argsort(-mean_score, stable=True)  # (N,)
         mean_demand = (
             jnp.sum(jnp.where(active[:, None], demand, 0), axis=0) // n_active
         )
@@ -139,15 +190,12 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
                 jnp.int64(P),
             ),
             axis=1,
-        )  # (N,)
+        )
         cap = jnp.clip(cap, 0, P).astype(jnp.int32)
-        ccap = jnp.cumsum(cap[order], dtype=jnp.int32)  # (N,)
-
-        # queue-order rank among active pods (int32: int64 cumsum is
-        # vmem-hungry on TPU)
+        ccap = jnp.cumsum(cap[order_n], dtype=jnp.int32)
         rank = jnp.cumsum(active, dtype=jnp.int32) - 1
-        bucket = jnp.searchsorted(ccap, rank, side="right")  # (P,)
-        target = order[jnp.minimum(bucket, N - 1)]
+        bucket = jnp.searchsorted(ccap, rank, side="right")
+        target = order_n[jnp.minimum(bucket, N - 1)]
         target_ok = jnp.take_along_axis(
             feasible, target[:, None], axis=1
         ).squeeze(1)
@@ -159,34 +207,49 @@ def waterfill_assign(batch_fn, req, pod_mask, free0, max_waves: int = 4):
         )
         choice = jnp.where(active, choice, -1)
 
-        # exact queue-order admission per node (sorted-segment prefix sums)
         onehot = (choice[:, None] == jnp.arange(N)[None, :]) & (
             choice[:, None] >= 0
         )
-        admitted = (choice >= 0) & _queue_order_admission(onehot, demand, free)
+        order, seg, first = _sorted_segments(onehot)
+        dem_sorted = demand[order].astype(jnp.float64)
+        within = _segment_prefix(dem_sorted, first)
+        free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)
+        ok_sorted = jnp.all(within <= free_row, axis=1) & (seg < N)
+        node_sorted = jnp.minimum(seg, N - 1)
+        for guard, gdem in zip(guards, guard_demands):
+            gd_sorted = gdem[order].astype(jnp.float64)
+            g_within = _segment_prefix(gd_sorted, first)
+            g_excl = g_within - gd_sorted  # exclusive: earlier choosers only
+            ok_sorted &= jax.vmap(
+                lambda p, n, pre: guard(state, p, n, pre)
+            )(order, node_sorted, g_excl)
+        admitted = (choice >= 0) & jnp.zeros(P, bool).at[order].set(ok_sorted)
+
         new_assignment = jnp.where(admitted, choice, assignment)
         winners = onehot & admitted[:, None]
         used = jnp.stack(
             [(winners * demand[:, r][:, None]).sum(axis=0) for r in range(R)],
             axis=-1,
         )
-        return (free - used, new_assignment), admitted.sum()
+        state = commit_fn(state, admitted, choice)
+        return free - used, new_assignment, state, admitted.sum()
 
     def cond(loop_state):
-        _, _, wave_idx, progressed = loop_state
+        _, _, _, wave_idx, progressed = loop_state
         return (wave_idx < max_waves) & progressed
 
     def body(loop_state):
-        free, assignment, wave_idx, _ = loop_state
-        (free, assignment), n_admitted = wave((free, assignment), None)
-        return free, assignment, wave_idx + 1, n_admitted > 0
+        free, assignment, state, wave_idx, _ = loop_state
+        free, assignment, state, n_admitted = wave(free, assignment, state)
+        return free, assignment, state, wave_idx + 1, n_admitted > 0
 
-    free, assignment, _, _ = jax.lax.while_loop(
+    free, assignment, state, _, _ = jax.lax.while_loop(
         cond,
         body,
-        (free0, jnp.full(P, -1, jnp.int32), jnp.int32(0), jnp.bool_(True)),
+        (free0, jnp.full(P, -1, jnp.int32), state0, jnp.int32(0),
+         jnp.bool_(True)),
     )
-    return assignment, free
+    return assignment, free, state
 
 
 @partial(jax.jit, static_argnames=("batch_fn", "max_waves"))

@@ -160,19 +160,43 @@ def batch_solve(snap, weights, max_waves: int = 8):
 
 def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     """Throughput mode for an ARBITRARY plugin profile: the same plugin
-    tensor methods the sequential scan fuses are vmapped over the pod batch
-    against the cycle-initial state, then placed wave-parallel.
+    tensor methods the sequential scan fuses are vmapped over the pod batch,
+    then placed wave-parallel.
 
-    Semantics vs the sequential parity path: plugin Filter/Score run against
-    the CYCLE-INITIAL carried state (quota usage, NUMA zones, placed
-    workloads) rather than being recomputed after every single placement;
-    resource fit, queue-order node admission, quota prefix caps and gang
-    quorum remain exact. That is the wave trade-off documented in
-    ops.assign.waterfill_assign, extended to every plugin.
+    Semantics vs the sequential parity path:
+
+    - **Hard plugin constraints hold.** Filters of plugins whose verdict
+      depends on earlier placements (`state_dependent_filter`: NUMA zone
+      fitting, network dependency thresholds) are RE-EVALUATED every wave
+      against the carried state with the previous waves' placements
+      committed (`ops.assign.waterfill_assign_stateful`), and within a wave
+      the NUMA plugin's exact zone guard checks each pod against the
+      same-node demand of earlier same-wave winners — so a final placement
+      never lands on a node whose zones were consumed mid-wave. Resource
+      fit, queue-order node admission, quota prefix caps and gang quorum
+      were already exact.
+    - **Scores stay cycle-initial** (soft orderings): score tensors are
+      computed once against the cycle-initial state, so tie-breaking and
+      score-driven packing order may differ from the sequential scan —
+      the wave trade-off documented in ops.assign.waterfill_assign.
     """
     import jax
 
     plugins = tuple(scheduler.profile.plugins)
+    static_plugins = tuple(
+        p for p in plugins if not p.state_dependent_filter
+    )
+    dyn_plugins = tuple(p for p in plugins if p.state_dependent_filter)
+    from scheduler_plugins_tpu.framework.plugin import Plugin as _PluginBase
+
+    for p in dyn_plugins:
+        # the hard-constraint guarantee relies on wave commits actually
+        # updating the carry — fail loudly, not silently, on a plugin that
+        # declares a state-dependent filter without a batched Reserve
+        if type(p).commit_batch is _PluginBase.commit_batch:
+            raise TypeError(
+                f"{p.name}: state_dependent_filter requires commit_batch"
+            )
     state0 = scheduler.initial_state(snap)
     auxes = tuple(p.aux() for p in plugins)
 
@@ -191,10 +215,20 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 verdict = plugin.admit(state0, snap, p)
                 if verdict is not None:
                     ok &= verdict
+            # state-INDEPENDENT filters are wave-invariant: evaluate once;
             # normalize over the same fit-and-admit-filtered set the
-            # sequential step uses (cycle-initial free capacity)
-            feasible = fits_one(snap.pods.req[p], state0.free, snap.nodes.mask)
-            for plugin in plugins:
+            # sequential step uses (cycle-initial free capacity + the
+            # cycle-initial view of the state-dependent filters)
+            static_feasible = jnp.ones(snap.num_nodes, bool)
+            for plugin in static_plugins:
+                mask = plugin.filter(state0, snap, p)
+                if mask is not None:
+                    static_feasible &= mask
+            feasible = (
+                fits_one(snap.pods.req[p], state0.free, snap.nodes.mask)
+                & static_feasible
+            )
+            for plugin in dyn_plugins:
                 mask = plugin.filter(state0, snap, p)
                 if mask is not None:
                     feasible &= mask
@@ -204,18 +238,52 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 raw = plugin.score(state0, snap, p)
                 if raw is not None:
                     total = total + plugin.weight * plugin.normalize(raw, feasible)
-            return ok, feasible, total
+            return ok, static_feasible, total
 
-        admitted, plugin_feasible, scores0 = jax.vmap(per_pod)(jnp.arange(P))
+        admitted, static_feasible, scores0 = jax.vmap(per_pod)(jnp.arange(P))
 
-        def batch_fn(free, active):
+        def batch_fn(free, state, active):
             feasible = fits(
                 snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
-            ) & plugin_feasible
+            ) & static_feasible
+            for plugin in dyn_plugins:
+                def one(p, _pl=plugin):
+                    return _pl.filter(state, snap, p)
+                # a filter can opt out (None) on Python-level layout checks;
+                # the probe's dead ops are DCE'd by XLA
+                if one(jnp.int32(0)) is None:
+                    continue
+                feasible &= jax.vmap(one)(jnp.arange(P))
             return feasible, scores0
 
-        assignment, _ = waterfill_assign(
-            batch_fn, snap.pods.req, admitted, state0.free, max_waves=max_waves
+        def commit_fn(state, placed, choice):
+            for plugin in dyn_plugins:
+                state = plugin.commit_batch(state, snap, placed, choice)
+            return state
+
+        guards, guard_demands = [], []
+        for plugin in dyn_plugins:
+            gdem = plugin.wave_guard_demand(snap)
+            if gdem is not None:
+                guards.append(
+                    lambda state, p, n, pre, _pl=plugin: _pl.wave_guard(
+                        state, snap, p, n, pre
+                    )
+                )
+                guard_demands.append(gdem)
+
+        from scheduler_plugins_tpu.ops.assign import waterfill_assign_stateful
+
+        assignment, _, _ = waterfill_assign_stateful(
+            batch_fn,
+            commit_fn,
+            tuple(guards),
+            tuple(guard_demands),
+            snap.pods.req,
+            admitted,
+            state0.free,
+            state0,
+            max_waves=max_waves,
         )
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait

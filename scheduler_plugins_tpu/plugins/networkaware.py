@@ -37,6 +37,10 @@ DEFAULT_NETWORK_TOPOLOGY_NAME = "nt-default"
 
 class NetworkOverhead(Plugin):
     name = "NetworkOverhead"
+    #: Filter tallies read the carried in-cycle placement counts — the
+    #: batched path re-evaluates it per wave (counting heuristic, not a
+    #: resource-safety bound, so no within-wave guard is needed)
+    state_dependent_filter = True
 
     def __init__(
         self,
@@ -118,6 +122,19 @@ class NetworkOverhead(Plugin):
         return state.replace(
             net_placed=placed_commit(
                 state.net_placed, snap.network.pod_workload[p], choice
+            )
+        )
+
+    def commit_batch(self, state, snap, placed, choice):
+        """Batched Reserve: placement tallies are counts, so one scatter-add
+        over the wave's winners equals any sequential order of `commit`s."""
+        if snap.network is None or state.net_placed is None:
+            return state
+        return state.replace(
+            net_placed=placed_commit(
+                state.net_placed,
+                snap.network.pod_workload,
+                jnp.where(placed, choice, -1),
             )
         )
 

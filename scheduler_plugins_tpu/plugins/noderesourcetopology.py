@@ -48,6 +48,9 @@ STRATEGIES = (
 
 class NodeResourceTopologyMatch(Plugin):
     name = "NodeResourceTopologyMatch"
+    #: the Filter reads the carried zone availability (in-cycle pessimistic
+    #: deductions) — the batched path must re-evaluate it per wave
+    state_dependent_filter = True
 
     #: Cache.ForeignPodsDetect / ResyncMethod / InformerMode values
     #: (apis/config/types.go:124-180)
@@ -298,6 +301,93 @@ class NodeResourceTopologyMatch(Plugin):
             0.0,
         )
         return state.replace(numa_avail=state.numa_avail - deduct)
+
+    def commit_batch(self, state, snap, placed, choice):
+        """Batched Reserve for the wave path: the pessimistic all-reported-
+        zone deduction is a sum over placed pods, so one segment-sum per
+        node reproduces any sequential order of `commit`s exactly."""
+        if snap.numa is None or state.numa_avail is None:
+            return state
+        N = state.numa_avail.shape[0]
+        pre = getattr(self, "_presolve", None)
+        reqq = (
+            pre["req"] if pre is not None
+            else numa_ops.scale_qty(snap.numa, snap.pods.req)
+        ).astype(state.numa_avail.dtype)  # (P, R)
+        node_demand = jnp.zeros(
+            (N, reqq.shape[1]), state.numa_avail.dtype
+        ).at[jnp.maximum(choice, 0)].add(
+            jnp.where(placed[:, None], reqq, 0)
+        )
+        deduct = jnp.where(snap.numa.reported, node_demand[:, None, :], 0)
+        return state.replace(numa_avail=state.numa_avail - deduct)
+
+    def wave_guard_demand(self, snap):
+        """Within-wave guard demand: the pod request in the live-availability
+        quantity domain — what an earlier same-wave winner pessimistically
+        deducts from every zone of the shared node."""
+        if snap.numa is None:
+            return None
+        pre = getattr(self, "_presolve", None)
+        if pre is not None:
+            return pre["req"]
+        return numa_ops.scale_qty(snap.numa, snap.pods.req)
+
+    def wave_guard(self, state, snap, p, node, prefix):
+        """Exact within-wave single-numa admission: re-run this pod's Filter
+        verdict for `node` only, with earlier same-wave winners' demand
+        (`prefix`, already in the live-quantity domain) pessimistically
+        deducted from every zone — the same view a sequential scan's carry
+        would have shown (filter.go:90-160 semantics on the adjusted
+        availability)."""
+        if snap.numa is None:
+            return jnp.bool_(True)
+        numa = snap.numa
+        affine, host_level, host_extended, _ = self._aux
+        avail = self._numa_avail(state, snap)[node]  # (Z, R) float
+        avail = avail - jnp.where(
+            numa.reported[node], prefix[None, :].astype(avail.dtype), 0
+        )
+        guaranteed = snap.pods.qos[p] == int(QOSClass.GUARANTEED)
+        req = self._qty_req(snap, p)
+        creq = self._qty_creq(snap, p)
+        is_init = snap.pods.container_is_init[p]
+        cmask = snap.pods.container_mask[p]
+        node_args = (
+            numa.reported[node], numa.zone_mask[node], snap.nodes.alloc[node]
+        )
+
+        def one_request(r):
+            _, ok = numa_ops.feasible_zones(
+                avail, *node_args, guaranteed, r, affine, host_level
+            )
+            return ok
+
+        def container_fit():
+            if creq.shape[0] == 1:
+                return one_request(creq[0])
+            return numa_ops.single_numa_fit(
+                avail, *node_args, guaranteed, creq, is_init, cmask,
+                affine, host_level,
+            )
+
+        if self._uniform_scope == int(TopologyManagerScope.POD):
+            scoped = one_request(req)
+        elif self._uniform_scope == int(TopologyManagerScope.CONTAINER):
+            scoped = container_fit()
+        else:
+            scoped = jnp.where(
+                numa.scope[node] == int(TopologyManagerScope.POD),
+                one_request(req),
+                container_fit(),
+            )
+        applies = numa.has_nrt[node] & (
+            numa.policy[node] == int(TopologyManagerPolicy.SINGLE_NUMA_NODE)
+        )
+        verdict = jnp.where(applies, scoped, True) & numa.fresh[node]
+        non_native = jnp.any((snap.pods.req[p] > 0) & host_extended)
+        skip = (snap.pods.qos[p] == int(QOSClass.BEST_EFFORT)) & ~non_native
+        return jnp.where(skip, True, verdict)
 
     # -- Score -----------------------------------------------------------
     def score(self, state, snap, p):

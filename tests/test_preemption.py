@@ -426,3 +426,42 @@ class TestNominatedCapacityHolds:
         assert "default/high" in r2.preempted
         node2, _ = r2.preempted["default/high"]
         assert node2 != node1
+
+
+class TestHoldOrderIndependence:
+    def test_low_priority_hold_not_folded_against_higher_preemptor(self):
+        # failed_pods is only priority-descending under priority-based
+        # QueueSorts; TopologicalSort can put a LOW-priority pod first. A
+        # prior nominee's hold (prio 10) must bind against a prio-0
+        # preemptor but NOT against a prio-100 preemptor processed later in
+        # the same loop (upstream AddNominatedPods: nominee priority >= the
+        # evaluated pod).
+        from scheduler_plugins_tpu.framework.cycle import (
+            CycleReport,
+            _run_preemption,
+        )
+
+        cluster = Cluster()
+        cluster.add_node(mknode("n0", cpu=4000))
+        cluster.add_pod(mkpod("low", 3000, priority=1, node="n0"))
+        # prior-cycle nominee holding 3000m on n0 at priority 10
+        nom = mkpod("nom", 3000, priority=10)
+        nom.nominated_node_name = "n0"
+        cluster.add_pod(nom)
+        w0 = mkpod("w0", 3000, priority=0, created=1)
+        w1 = mkpod("w1", 3000, priority=100, created=2)
+        cluster.add_pod(w0)
+        cluster.add_pod(w1)
+        sched = default_sched()
+        report = CycleReport()
+        # queue order NOT priority-descending (as TopologicalSort produces)
+        report.failed = [w0.uid, w1.uid]
+        _run_preemption(sched, cluster, [w0, w1], report, now=1000)
+        # w0 (prio 0): victim "low" (prio 1) outranks it and nom's hold
+        # applies -> no preemption
+        assert "default/w0" not in report.preempted
+        # w1 (prio 100): nom's prio-10 hold must NOT apply; evicting "low"
+        # frees 3000m -> preemption succeeds on n0
+        assert "default/w1" in report.preempted
+        node, victims = report.preempted["default/w1"]
+        assert node == "n0" and victims == ["default/low"]
