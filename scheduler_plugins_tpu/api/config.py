@@ -78,6 +78,10 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
     "PreemptionToleration": {},
     "PodState": {},
     "QOSSort": {},
+    "NodeAffinity": {},
+    "TaintToleration": {},
+    "PodTopologySpread": {},
+    "InterPodAffinity": {},
 }
 
 
@@ -99,14 +103,22 @@ def _registry():
         "SySched": p.SySched,
         "PodState": p.PodState,
         "QOSSort": p.QOSSort,
+        # in-tree companions (upstream kube-scheduler, not /root/reference):
+        # real profiles enable these alongside the reference plugins
+        "NodeAffinity": p.NodeAffinity,
+        "TaintToleration": p.TaintToleration,
+        "PodTopologySpread": p.PodTopologySpread,
+        "InterPodAffinity": p.InterPodAffinity,
     }
 
 
 def available_plugins() -> tuple[str, ...]:
-    """The full plugin roster — the 14 plugins the reference compiles into its
-    scheduler binary (/root/reference/cmd/scheduler/main.go:50-67;
+    """The full plugin roster — the 14 plugins the reference compiles into
+    its scheduler binary (/root/reference/cmd/scheduler/main.go:50-67;
     CrossNodePreemption is registration-commented-out there and spec-only
-    here, see docs/PARITY.md)."""
+    here, see docs/PARITY.md) plus the in-tree companions (NodeAffinity,
+    TaintToleration, PodTopologySpread, InterPodAffinity) that real
+    profiles combine them with."""
     return tuple(sorted(_registry()))
 
 

@@ -57,6 +57,184 @@ class PodPhase(enum.StrEnum):
     UNKNOWN = "Unknown"
 
 
+# ---------------------------------------------------------------------------
+# In-tree scheduling spec fragments (upstream core/v1 types — not defined by
+# the reference repo, but real profiles combine its plugins with the in-tree
+# NodeAffinity / TaintToleration / PodTopologySpread / InterPodAffinity
+# plugins; see docs/PARITY.md "companion plugins")
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Taint:
+    """core/v1 Taint. Effects: NoSchedule | PreferNoSchedule | NoExecute."""
+
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class Toleration:
+    """core/v1 Toleration; upstream v1helper.TolerationsTolerateTaint rules:
+    empty effect matches all effects; empty key with Exists matches all
+    taints; operator Exists ignores value."""
+
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" | NoSchedule | PreferNoSchedule | NoExecute
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key:
+            if self.key != taint.key:
+                return False
+        elif self.operator != "Exists":
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class LabelSelectorRequirement:
+    """metav1.LabelSelectorRequirement (In | NotIn | Exists | DoesNotExist)."""
+
+    key: str
+    operator: str
+    values: tuple = ()
+
+
+@dataclass
+class LabelSelector:
+    """metav1.LabelSelector: AND of match_labels and match_expressions.
+    NOTE: a None selector matches nothing; an empty selector matches
+    everything (metav1 semantics)."""
+
+    match_labels: Mapping[str, str] = field(default_factory=dict)
+    match_expressions: list[LabelSelectorRequirement] = field(
+        default_factory=list
+    )
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for r in self.match_expressions:
+            has = r.key in labels
+            if r.operator == "In":
+                if not has or labels[r.key] not in r.values:
+                    return False
+            elif r.operator == "NotIn":
+                if has and labels[r.key] in r.values:
+                    return False
+            elif r.operator == "Exists":
+                if not has:
+                    return False
+            elif r.operator == "DoesNotExist":
+                if has:
+                    return False
+            else:
+                raise ValueError(f"unknown selector operator {r.operator!r}")
+        return True
+
+    def _key(self):
+        return (
+            tuple(sorted(self.match_labels.items())),
+            tuple(
+                (r.key, r.operator, tuple(r.values))
+                for r in self.match_expressions
+            ),
+        )
+
+
+@dataclass
+class NodeSelectorRequirement:
+    """core/v1 NodeSelectorRequirement
+    (In | NotIn | Exists | DoesNotExist | Gt | Lt); NotIn/DoesNotExist match
+    when the label is absent (apimachinery labels.Requirement semantics)."""
+
+    key: str
+    operator: str
+    values: tuple = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            return not has or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator in ("Gt", "Lt"):
+            if not has or len(self.values) != 1:
+                return False
+            try:
+                lhs, rhs = int(val), int(self.values[0])
+            except ValueError:
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        raise ValueError(f"unknown node selector operator {self.operator!r}")
+
+
+@dataclass
+class NodeSelectorTerm:
+    """AND of match_expressions (node labels) and match_fields
+    (metadata.name only, as upstream supports)."""
+
+    match_expressions: list[NodeSelectorRequirement] = field(
+        default_factory=list
+    )
+    match_fields: list[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, node: "Node") -> bool:
+        return all(
+            r.matches(node.labels) for r in self.match_expressions
+        ) and all(
+            r.matches({"metadata.name": node.name}) for r in self.match_fields
+        )
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int  # 1..100
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """core/v1 TopologySpreadConstraint (whenUnsatisfiable DoNotSchedule
+    filters, ScheduleAnyway scores). minDomains/nodeAffinityPolicy/
+    nodeTaintsPolicy refinements are not modeled."""
+
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = "DoNotSchedule"  # | ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodAffinityTerm:
+    """core/v1 PodAffinityTerm: selector over pod labels, scoped to
+    `namespaces` (empty = the incoming pod's own namespace), co-location
+    judged by `topology_key` domains."""
+
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: tuple = ()
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int  # 1..100
+    term: PodAffinityTerm
+
+
 @dataclass
 class Container:
     name: str = "c"
@@ -95,6 +273,29 @@ class Pod:
     #: spec.preemptionPolicy: "Never" disqualifies the pod from preempting
     #: (capacity_scheduling.go:412-416).
     preemption_policy: Optional[str] = None
+    #: spec.nodeSelector: all key=value pairs must match node labels.
+    node_selector: Mapping[str, str] = field(default_factory=dict)
+    #: requiredDuringSchedulingIgnoredDuringExecution node affinity: OR over
+    #: terms (empty list = no constraint).
+    node_affinity_required: list[NodeSelectorTerm] = field(default_factory=list)
+    #: preferredDuringScheduling node affinity terms (weighted score).
+    node_affinity_preferred: list[PreferredSchedulingTerm] = field(
+        default_factory=list
+    )
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_spread: list[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
+    pod_affinity_required: list[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: list[WeightedPodAffinityTerm] = field(
+        default_factory=list
+    )
+    pod_anti_affinity_required: list[PodAffinityTerm] = field(
+        default_factory=list
+    )
+    pod_anti_affinity_preferred: list[WeightedPodAffinityTerm] = field(
+        default_factory=list
+    )
     #: memoized derived quantities — a pod's container spec is immutable
     #: after creation (k8s semantics), and the snapshot builder re-derives
     #: these for every pod on every cycle. init=False keeps the cache out of
@@ -220,6 +421,7 @@ class Node:
     capacity: Mapping[str, int] = field(default_factory=dict)
     labels: Mapping[str, str] = field(default_factory=dict)
     unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.capacity:

@@ -60,6 +60,15 @@ class SolverState:
     #: aggregates drop a nominee the moment it places (upstream removes
     #: assumed pods from the nominated set)
     placed_mask: Optional[jnp.ndarray] = None
+    #: (TR, D) live per-(track, topology-domain) pod counts (topology
+    #: spread / inter-pod affinity; track = unique (selector, topology key)
+    #: pair): base = assigned matches, in-cycle placements added by the
+    #: BUILT-IN commit (`ops.selectors.commit_tracks`) — not per-plugin,
+    #: because both consumers read the same carry
+    sel_counts: Optional[jnp.ndarray] = None
+    #: (E, D) live anti-affinity domain presence: True when a pod carrying
+    #: existing-anti term e occupies a node in domain d; built-in commit
+    anti_domains: Optional[jnp.ndarray] = None
 
 
 class Plugin:
@@ -169,3 +178,18 @@ class Plugin:
         winners' demand landed there (evaluated against the wave-start
         carry). See `ops.assign.waterfill_assign_stateful`."""
         return jnp.bool_(True)
+
+    #: overridden (not None) when the plugin's hard filter must be
+    #: re-validated pod-by-pod after the batched waterfill: the wave guard
+    #: only sees same-NODE conflicts, but domain-counting constraints
+    #: (topology spread, inter-pod anti-affinity) span nodes. The batched
+    #: solver then runs a sequential demotion scan in queue order calling
+    #: this with each placed pod's chosen node — the check is O(1) per pod
+    #: (a few gathers), unlike re-running the (N,)-wide filter.
+    validate_at = None
+
+    # subclasses override as:
+    # def validate_at(self, state, snap, p, node) -> bool:
+    #     '''True iff pod `p` still passes this plugin's hard filter on
+    #     `node` against the live carry; the scan commits the pod (via
+    #     `commit`) only when every validator agrees, else demotes it.'''

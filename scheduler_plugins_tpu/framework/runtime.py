@@ -185,6 +185,12 @@ class Scheduler:
                 state = state.replace(
                     placed_mask=state.placed_mask.at[p].set(choice >= 0)
                 )
+            if snap.scheduling is not None:
+                # built-in: selector/domain carries are shared by multiple
+                # plugins (spread, inter-pod affinity) — commit once
+                from scheduler_plugins_tpu.ops.selectors import commit_tracks
+
+                state = commit_tracks(state, snap.scheduling, p, choice)
             for plugin in plugins:
                 state = plugin.commit(state, snap, p, choice)
             return state, (choice, ok)
@@ -296,6 +302,13 @@ class Scheduler:
             if snap.quota is not None or snap.nominees is not None
             else None
         )
+        sel_counts = None
+        anti_domains = None
+        if snap.scheduling is not None:
+            if snap.scheduling.track_base is not None:
+                sel_counts = jnp.asarray(snap.scheduling.track_base)
+            if snap.scheduling.exist_anti_base is not None:
+                anti_domains = jnp.asarray(snap.scheduling.exist_anti_base)
         return SolverState(
             free=free,
             eq_used=eq_used,
@@ -304,6 +317,8 @@ class Scheduler:
             net_placed=net_placed,
             numa_avail=numa_avail,
             placed_mask=placed_mask,
+            sel_counts=sel_counts,
+            anti_domains=anti_domains,
         )
 
 

@@ -142,6 +142,8 @@ def waterfill_assign_stateful(
     free0,
     state0,
     max_waves: int = 4,
+    validate_fn=None,
+    validate_commit_fn=None,
 ):
     """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
     filters (NUMA zone availability, network placement tallies): the carries
@@ -163,6 +165,16 @@ def waterfill_assign_stateful(
       choosers that were themselves rejected — conservative (never violates
       hard constraints; may defer a feasible pod to the next wave), matching
       `_queue_order_admission`'s capacity semantics.
+    - ``validate_fn(state, q, choice) -> bool`` /
+      ``validate_commit_fn(state, q, choice) -> state``: per-wave SEQUENTIAL
+      validation for hard constraints that span nodes (topology-domain
+      counting): after guard admission, the wave's winners are re-checked
+      one at a time in queue order against the live carry, committing (via
+      ``validate_commit_fn``) only the kept ones; a demoted pod re-enters
+      the next wave against the committed state. ``commit_fn`` must then
+      EXCLUDE the carries ``validate_commit_fn`` maintains. The scan body
+      is a handful of gathers per pod — this is for O(1)-per-pod checks,
+      not (N,)-wide filters.
 
     Not jitted itself: designed to run inside a caller's jit (the closures
     are trace-local). Returns (assignment, free, state).
@@ -224,6 +236,20 @@ def waterfill_assign_stateful(
                 lambda p, n, pre: guard(state, p, n, pre)
             )(order, node_sorted, g_excl)
         admitted = (choice >= 0) & jnp.zeros(P, bool).at[order].set(ok_sorted)
+
+        if validate_fn is not None:
+            # cross-node hard constraints: sequential queue-order re-check
+            # of this wave's winners against the live carry; kept pods
+            # commit immediately so later pods in the same wave see them
+            def vstep(vstate, q):
+                act = admitted[q]
+                ok = act & validate_fn(vstate, q, choice[q])
+                kept_choice = jnp.where(ok, choice[q], jnp.int32(-1))
+                vstate = validate_commit_fn(vstate, q, kept_choice)
+                return vstate, ok
+
+            state, kept = jax.lax.scan(vstep, state, jnp.arange(P))
+            admitted = kept
 
         new_assignment = jnp.where(admitted, choice, assignment)
         winners = onehot & admitted[:, None]

@@ -1,0 +1,46 @@
+"""Framework-maintained selector/topology-domain carries.
+
+The live per-(track, domain) pod counts (`SolverState.sel_counts`) and the
+anti-affinity domain-presence bits (`SolverState.anti_domains`) are read by
+BOTH PodTopologySpread and InterPodAffinity (plugins/intree.py) — so the
+commit is a single built-in step of the solve (like the built-in capacity
+Reserve), not a per-plugin `commit` that would double-apply when both
+plugins are enabled.
+
+Tables come from `state.scheduling.SchedulingState`:
+    pend_match (S, P)  pod q matches selector group s
+    track_sel/track_topo (TR,)  track -> (selector group, topology key)
+    topo_code (K, N)  node -> domain code under key k (-1 = key absent)
+    exist_anti_{sel,topo} (E,), exist_anti_carrier (E, P)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def commit_tracks(state, sched, p, choice):
+    """Fold pod `p`'s placement on `choice` (-1 = none) into the carries."""
+    if state.sel_counts is not None and sched.track_base is not None:
+        dom = sched.topo_code[sched.track_topo, choice]  # (TR,)
+        inc = sched.pend_match[sched.track_sel, p] & (choice >= 0) & (dom >= 0)
+        TR = state.sel_counts.shape[0]
+        state = state.replace(
+            sel_counts=state.sel_counts.at[
+                jnp.arange(TR), jnp.maximum(dom, 0)
+            ].add(inc.astype(state.sel_counts.dtype))
+        )
+    if state.anti_domains is not None and sched.exist_anti_sel is not None:
+        dom = sched.topo_code[sched.exist_anti_topo, choice]  # (E,)
+        mark = (
+            sched.exist_anti_carrier[:, p] & (choice >= 0) & (dom >= 0)
+        )
+        E = state.anti_domains.shape[0]
+        state = state.replace(
+            anti_domains=state.anti_domains.at[
+                jnp.arange(E), jnp.maximum(dom, 0)
+            ].max(mark)
+        )
+    return state
+
+

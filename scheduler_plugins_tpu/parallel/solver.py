@@ -192,10 +192,16 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     for p in dyn_plugins:
         # the hard-constraint guarantee relies on wave commits actually
         # updating the carry — fail loudly, not silently, on a plugin that
-        # declares a state-dependent filter without a batched Reserve
-        if type(p).commit_batch is _PluginBase.commit_batch:
+        # declares a state-dependent filter with neither a batched Reserve
+        # nor a sequential validator (framework-carried tracks count via
+        # validate_at; see ops.selectors)
+        if (
+            type(p).commit_batch is _PluginBase.commit_batch
+            and p.validate_at is None
+        ):
             raise TypeError(
-                f"{p.name}: state_dependent_filter requires commit_batch"
+                f"{p.name}: state_dependent_filter requires commit_batch "
+                "or validate_at"
             )
     state0 = scheduler.initial_state(snap)
     auxes = tuple(p.aux() for p in plugins)
@@ -256,10 +262,41 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 feasible &= jax.vmap(one)(jnp.arange(P))
             return feasible, scores0
 
+        # hard DOMAIN constraints (topology spread, inter-pod anti-affinity)
+        # span nodes, so neither the per-wave re-filter nor the same-node
+        # wave guard can see a same-wave cross-node conflict. Validators
+        # re-check each wave's winners sequentially in queue order against
+        # the live carry (O(1) gathers per pod) inside the waterfill; their
+        # carries commit per pod there, every other dyn carry batch-commits
+        # on the kept winners.
+        validators = tuple(
+            pl for pl in dyn_plugins if pl.validate_at is not None
+        )
+        batch_committers = tuple(
+            pl for pl in dyn_plugins if pl.validate_at is None
+        )
+
         def commit_fn(state, placed, choice):
-            for plugin in dyn_plugins:
+            for plugin in batch_committers:
                 state = plugin.commit_batch(state, snap, placed, choice)
             return state
+
+        validate_fn = validate_commit_fn = None
+        if validators:
+            from scheduler_plugins_tpu.ops.selectors import commit_tracks
+
+            def validate_fn(state, q, choice):
+                ok = jnp.bool_(True)
+                for pl in validators:
+                    ok &= pl.validate_at(state, snap, q, choice)
+                return ok
+
+            def validate_commit_fn(state, q, choice):
+                if snap.scheduling is not None:
+                    state = commit_tracks(state, snap.scheduling, q, choice)
+                for pl in validators:
+                    state = pl.commit(state, snap, q, choice)
+                return state
 
         guards, guard_demands = [], []
         for plugin in dyn_plugins:
@@ -284,6 +321,8 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             state0.free,
             state0,
             max_waves=max_waves,
+            validate_fn=validate_fn,
+            validate_commit_fn=validate_commit_fn,
         )
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait

@@ -5,7 +5,18 @@ Coscheduling, CapacityScheduling, NodeResourcesAllocatable,
 NodeResourceTopologyMatch, TargetLoadPacking, LoadVariationRiskBalancing,
 LowRiskOverCommitment, Peaks, NetworkOverhead, TopologicalSort,
 PreemptionToleration, SySched, PodState, QOSSort.
+
+Plus the in-tree companion plugins real profiles combine them with
+(upstream kube-scheduler, not in /root/reference): NodeAffinity,
+TaintToleration, PodTopologySpread, InterPodAffinity.
 """
+
+from scheduler_plugins_tpu.plugins.intree import (  # noqa: F401
+    InterPodAffinity,
+    NodeAffinity,
+    PodTopologySpread,
+    TaintToleration,
+)
 
 from scheduler_plugins_tpu.plugins.capacityscheduling import (  # noqa: F401
     CapacityScheduling,

@@ -1,0 +1,315 @@
+"""In-tree companion plugins: NodeAffinity, TaintToleration,
+PodTopologySpread and InterPodAffinity.
+
+These are upstream kube-scheduler plugins (k8s.io/kubernetes
+pkg/scheduler/framework/plugins/{nodeaffinity,tainttoleration,
+podtopologyspread,interpodaffinity}), NOT part of /root/reference — but real
+profiles enable
+them alongside the reference's plugins, so drop-in completeness requires
+them (docs/PARITY.md "companion plugins", SURVEY.md §7 build plan item 2's
+extension-point trait layer).
+
+All matching work happens host-side at snapshot build
+(`state.scheduling.build_scheduling` interns unique specs and evaluates each
+against every node once); the jitted tensor methods are row gathers and
+small segment sums.
+
+- NodeAffinity: Filter = nodeSelector AND required-affinity terms; Score =
+  sum of matching preferred-term weights, default-normalized (upstream
+  nodeaffinity.go Score/NormalizeScore).
+- TaintToleration: Filter = no untolerated NoSchedule/NoExecute taint;
+  Score = count of untolerated PreferNoSchedule taints, reverse-normalized
+  (upstream tainttoleration.go CountIntolerableTaintsPreferNoSchedule).
+- PodTopologySpread: live per-selector counts carried through the solve
+  (`SolverState.sel_counts`); Filter enforces DoNotSchedule constraints
+  (matchNum + self − globalMin <= maxSkew over the constraint key's
+  domains); Score sums ScheduleAnyway match counts, reverse-normalized.
+  Not modeled: minDomains, nodeAffinityPolicy/nodeTaintsPolicy refinements
+  (upstream defaults approximated by counting over all ready nodes with the
+  key), matchLabelKeys.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.ops.normalize import default_normalize
+
+
+class NodeAffinity(Plugin):
+    name = "NodeAffinity"
+
+    def filter(self, state, snap, p):
+        if snap.scheduling is None:
+            return None
+        s = snap.scheduling
+        return s.node_term_ok[s.pod_node_term[p]]
+
+    def score(self, state, snap, p):
+        if snap.scheduling is None:
+            return None
+        s = snap.scheduling
+        return s.pref_score[s.pod_pref[p]]
+
+    def normalize(self, scores, feasible):
+        return default_normalize(scores, feasible)
+
+
+class PodTopologySpread(Plugin):
+    """maxSkew spreading over topology domains.
+
+    Live counts are (TR, D) per (selector-track, domain), carried through
+    the solve; every check is a handful of gathers:
+
+        matchNum(node) = counts[track, domain(node)]
+        verdict(node)  = has_key(node)
+                         & (matchNum + selfMatch - min_domain <= maxSkew)
+
+    with min_domain the minimum count over the key's existing domains
+    (upstream's global minimum). DoNotSchedule constraints filter;
+    ScheduleAnyway constraints score (summed match counts, fewer = better).
+    """
+
+    name = "PodTopologySpread"
+    #: the filter reads the carried live counts — later placements change
+    #: earlier verdicts, and domains SPAN nodes, so the batched path also
+    #: re-validates placements sequentially (`validate_at`)
+    state_dependent_filter = True
+
+    def _counts(self, state, snap):
+        if state is not None and state.sel_counts is not None:
+            return state.sel_counts
+        return snap.scheduling.track_base
+
+    def _constraint_state(self, state, snap, p):
+        """Per-constraint (CT,) tensors shared by filter/score/validate:
+        live domain counts, the global per-constraint minimum, and masks."""
+        s = snap.scheduling
+        counts = self._counts(state, snap)  # (TR, D)
+        track = s.spread_track[p]  # (CT,)
+        dc = counts[track]  # (CT, D)
+        exists = s.domain_exists[s.spread_topo[p]]  # (CT, D)
+        big = jnp.int64(1) << 62
+        minm = jnp.min(jnp.where(exists, dc, big), axis=1)  # (CT,)
+        return s, dc, minm
+
+    def filter(self, state, snap, p):
+        s = snap.scheduling
+        if s is None or s.spread_track is None:
+            return None
+        s, dc, minm = self._constraint_state(state, snap, p)
+        code = s.topo_code[s.spread_topo[p]]  # (CT, N)
+        has = s.topo_has[s.spread_topo[p]]  # (CT, N)
+        match_at = jnp.take_along_axis(
+            dc, jnp.maximum(code, 0), axis=1
+        )  # (CT, N)
+        selfm = s.spread_self[p][:, None].astype(jnp.int64)
+        ok = match_at + selfm - minm[:, None] <= s.spread_max_skew[p][:, None]
+        applies = (s.spread_mask[p] & s.spread_hard[p])[:, None]
+        # a node missing the constraint's key is unschedulable for
+        # DoNotSchedule constraints (upstream PreFilter node filtering)
+        verdict = jnp.where(applies, has & ok, True)
+        return jnp.all(verdict, axis=0)
+
+    def score(self, state, snap, p):
+        s = snap.scheduling
+        if s is None or s.spread_track is None:
+            return None
+        s, dc, _ = self._constraint_state(state, snap, p)
+        code = s.topo_code[s.spread_topo[p]]
+        has = s.topo_has[s.spread_topo[p]]
+        match_at = jnp.take_along_axis(dc, jnp.maximum(code, 0), axis=1)
+        applies = (s.spread_mask[p] & ~s.spread_hard[p])[:, None] & has
+        return jnp.sum(jnp.where(applies, match_at, 0), axis=0)
+
+    def normalize(self, scores, feasible):
+        # fewer matching pods in the node's domains = better spread
+        return default_normalize(scores, feasible, reverse=True)
+
+    def validate_at(self, state, snap, p, node):
+        """Hard-constraint re-check at one node against the live carry —
+        O(CT x D), used by the batched solver's post-wave demotion scan
+        (domain constraints span nodes, so the same-node wave guard cannot
+        see them)."""
+        s = snap.scheduling
+        if s is None or s.spread_track is None:
+            return jnp.bool_(True)
+        s, dc, minm = self._constraint_state(state, snap, p)
+        code = s.topo_code[s.spread_topo[p], node]  # (CT,)
+        has = s.topo_has[s.spread_topo[p], node]
+        match_at = jnp.take_along_axis(
+            dc, jnp.maximum(code, 0)[:, None], axis=1
+        ).squeeze(1)
+        selfm = s.spread_self[p].astype(jnp.int64)
+        ok = match_at + selfm - minm <= s.spread_max_skew[p]
+        applies = s.spread_mask[p] & s.spread_hard[p]
+        return jnp.all(jnp.where(applies, has & ok, True))
+
+
+class InterPodAffinity(Plugin):
+    """Required/preferred pod (anti-)affinity over topology domains.
+
+    All selector matching is host-precomputed into the track tables
+    (state.scheduling); the live (TR, D) counts and (E, D) anti-domain
+    presence bits are carried through the solve, so in-cycle placements are
+    visible exactly as the reference's one-pod-per-cycle loop would see
+    them. Checks per (pod, node):
+
+    - required affinity term: node has the key AND (matching pods exist in
+      the node's domain OR nobody matches cluster-wide and the pod matches
+      its own term — the upstream first-pod escape).
+    - required anti term (the incoming pod's own): no matching pod in the
+      node's domain.
+    - SYMMETRY: a node is blocked when its domain hosts a pod CARRYING a
+      required anti term whose selector matches the incoming pod
+      (upstream existingAntiAffinityCounts).
+    - preferred terms score weight x domain match count (anti negative),
+      min-max normalized.
+
+    Not modeled: namespaceSelector, symmetric weighting of EXISTING pods'
+    preferred terms toward the incoming pod.
+    """
+
+    name = "InterPodAffinity"
+    state_dependent_filter = True
+
+    def _counts(self, state, snap):
+        if state is not None and state.sel_counts is not None:
+            return state.sel_counts
+        return snap.scheduling.track_base
+
+    def _anti_domains(self, state, snap):
+        if state is not None and state.anti_domains is not None:
+            return state.anti_domains
+        return snap.scheduling.exist_anti_base
+
+    def filter(self, state, snap, p):
+        s = snap.scheduling
+        if s is None or s.aff_track is None:
+            return None
+        counts = self._counts(state, snap)
+        N = snap.num_nodes
+        verdict = jnp.ones(N, bool)
+
+        # required affinity
+        code = s.topo_code[s.aff_topo[p]]  # (AT, N)
+        has = s.topo_has[s.aff_topo[p]]
+        dc = counts[s.aff_track[p]]  # (AT, D)
+        exists = s.domain_exists[s.aff_topo[p]]
+        total = jnp.sum(jnp.where(exists, dc, 0), axis=1)  # (AT,)
+        match_at = jnp.take_along_axis(dc, jnp.maximum(code, 0), axis=1)
+        ok = has & (
+            (match_at > 0)
+            | ((total == 0) & s.aff_self[p][:, None])
+        )
+        verdict &= jnp.all(
+            jnp.where(s.aff_mask[p][:, None], ok, True), axis=0
+        )
+
+        # the incoming pod's own required anti terms
+        codeb = s.topo_code[s.anti_topo[p]]
+        hasb = s.topo_has[s.anti_topo[p]]
+        dcb = counts[s.anti_track[p]]
+        match_b = jnp.take_along_axis(dcb, jnp.maximum(codeb, 0), axis=1)
+        okb = ~hasb | (match_b == 0)
+        verdict &= jnp.all(
+            jnp.where(s.anti_mask[p][:, None], okb, True), axis=0
+        )
+
+        # symmetry: carriers of matching anti terms block the domain
+        if s.exist_anti_sel is not None:
+            domains = self._anti_domains(state, snap)  # (E, D)
+            codee = s.topo_code[s.exist_anti_topo]  # (E, N)
+            blocked = (
+                jnp.take_along_axis(domains, jnp.maximum(codee, 0), axis=1)
+                & (codee >= 0)
+            )
+            m = s.exist_anti_match[:, p]  # (E,)
+            verdict &= ~jnp.any(m[:, None] & blocked, axis=0)
+        return verdict
+
+    def score(self, state, snap, p):
+        s = snap.scheduling
+        if s is None or s.waff_track is None:
+            return None
+        counts = self._counts(state, snap)
+        code = s.topo_code[s.waff_topo[p]]  # (WT, N)
+        has = s.topo_has[s.waff_topo[p]]
+        dc = counts[s.waff_track[p]]  # (WT, D)
+        match_at = jnp.take_along_axis(dc, jnp.maximum(code, 0), axis=1)
+        contrib = jnp.where(
+            s.waff_mask[p][:, None] & has,
+            s.waff_weight[p][:, None] * match_at,
+            0,
+        )
+        return jnp.sum(contrib, axis=0)
+
+    def normalize(self, scores, feasible):
+        from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+
+        return minmax_normalize(scores, feasible)
+
+    def validate_at(self, state, snap, p, node):
+        """Single-node hard re-check against the live carry (batched-path
+        demotion scan) — O(terms) gathers."""
+        s = snap.scheduling
+        if s is None or s.aff_track is None:
+            return jnp.bool_(True)
+        counts = self._counts(state, snap)
+        ok = jnp.bool_(True)
+
+        code = s.topo_code[s.aff_topo[p], node]  # (AT,)
+        has = s.topo_has[s.aff_topo[p], node]
+        dc = counts[s.aff_track[p]]  # (AT, D)
+        exists = s.domain_exists[s.aff_topo[p]]
+        total = jnp.sum(jnp.where(exists, dc, 0), axis=1)
+        match_at = jnp.take_along_axis(
+            dc, jnp.maximum(code, 0)[:, None], axis=1
+        ).squeeze(1)
+        aff_ok = has & (
+            (match_at > 0) | ((total == 0) & s.aff_self[p])
+        )
+        ok &= jnp.all(jnp.where(s.aff_mask[p], aff_ok, True))
+
+        codeb = s.topo_code[s.anti_topo[p], node]
+        hasb = s.topo_has[s.anti_topo[p], node]
+        dcb = counts[s.anti_track[p]]
+        match_b = jnp.take_along_axis(
+            dcb, jnp.maximum(codeb, 0)[:, None], axis=1
+        ).squeeze(1)
+        ok &= jnp.all(
+            jnp.where(s.anti_mask[p], ~hasb | (match_b == 0), True)
+        )
+
+        if s.exist_anti_sel is not None:
+            domains = self._anti_domains(state, snap)
+            codee = s.topo_code[s.exist_anti_topo, node]  # (E,)
+            blocked = (
+                jnp.take_along_axis(
+                    domains, jnp.maximum(codee, 0)[:, None], axis=1
+                ).squeeze(1)
+                & (codee >= 0)
+            )
+            ok &= ~jnp.any(s.exist_anti_match[:, p] & blocked)
+        return ok
+
+
+class TaintToleration(Plugin):
+    name = "TaintToleration"
+
+    def filter(self, state, snap, p):
+        if snap.scheduling is None:
+            return None
+        s = snap.scheduling
+        return s.tol_ok[s.pod_tol[p]]
+
+    def score(self, state, snap, p):
+        if snap.scheduling is None:
+            return None
+        s = snap.scheduling
+        return s.tol_prefer[s.pod_tol[p]]
+
+    def normalize(self, scores, feasible):
+        # fewer intolerable PreferNoSchedule taints wins
+        return default_normalize(scores, feasible, reverse=True)

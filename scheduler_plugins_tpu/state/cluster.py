@@ -63,6 +63,10 @@ class Cluster:
     #: yet (the trimaran PodAssignEventHandler ScheduledPodsCache,
     #: /root/reference/pkg/trimaran/handler.go:47-171): uid -> (bind ms, node)
     recent_bindings: dict[str, tuple[int, str]] = field(default_factory=dict)
+    #: uids of LIVE pods carrying spread/affinity specs — the native
+    #: snapshot fast path must disengage while any exist, because the
+    #: scheduling tables need the assigned pod objects it skips
+    _selector_spec_pods: set = field(default_factory=set)
 
     # -- native mirror ----------------------------------------------------
     def attach_native_store(self):
@@ -189,8 +193,23 @@ class Cluster:
         if self.native is not None:
             self._native_rebuild()
 
+    @staticmethod
+    def _has_selector_specs(pod: Pod) -> bool:
+        return bool(
+            pod.topology_spread
+            or pod.pod_affinity_required
+            or pod.pod_anti_affinity_required
+            or pod.pod_affinity_preferred
+            or pod.pod_anti_affinity_preferred
+        )
+
     def add_pod(self, pod: Pod):
         self.pods[pod.uid] = pod
+        if self._has_selector_specs(pod):
+            # spread/affinity tables need ASSIGNED pod objects at snapshot
+            # build, which the native fast path skips (pod specs are
+            # immutable, so count on add/remove)
+            self._selector_spec_pods.add(pod.uid)
         if self.nrt_cache is not None and hasattr(self.nrt_cache, "track_pod"):
             # foreign-pod detection (cache/foreign_pods.go:42-99)
             self.nrt_cache.track_pod(pod)
@@ -199,6 +218,7 @@ class Cluster:
 
     def remove_pod(self, uid: str):
         self.release_reservation(uid)  # notifies the NRT cache too
+        self._selector_spec_pods.discard(uid)
         pod = self.pods.pop(uid, None)
         if (
             pod is not None
@@ -393,6 +413,7 @@ class Cluster:
             and not self.quotas
             and not self.app_groups
             and not self.seccomp_profiles
+            and not self._selector_spec_pods
         ):
             exports = self._native.export_nodes()
             if len(exports["ids"]) == len(self.nodes) and all(

@@ -1,0 +1,528 @@
+"""In-tree scheduling-spec tensors: taints/tolerations, node affinity, and
+pod-label selector/topology-domain counting (topology spread, pod affinity).
+
+The upstream kube-scheduler plugins NodeAffinity, TaintToleration,
+PodTopologySpread and InterPodAffinity are not part of the reference repo,
+but every real KubeSchedulerConfiguration profile combines the reference's
+plugins with them (docs/PARITY.md "companion plugins"). Their semantics are
+label/taint matching — string work that does not belong on the TPU. The
+TPU-first formulation:
+
+- intern each pod's node-filter spec (nodeSelector + required node affinity)
+  and toleration set into a small set of UNIQUE specs (workload replicas
+  share specs), evaluate each unique spec against every node ONCE host-side
+  (numpy bools), and hand the solver dense lookup tables:
+
+      node_term_ok  (T+1, N) bool   required-affinity verdict per spec
+      pref_score    (U+1, N) int64  summed weights of matching preferred terms
+      tol_ok        (T2, N) bool    no untolerated NoSchedule/NoExecute taint
+      tol_prefer    (T2, N) int64   untolerated PreferNoSchedule taint count
+
+  The per-pod Filter/Score inside the jitted solve is then a single row
+  gather — O(1) per (pod, node) regardless of expression complexity.
+
+- intern the pod-label selectors of spread constraints / affinity terms into
+  S unique (namespace-scope, selector) groups and the topology keys into K
+  codes; count matching ASSIGNED pods per (group, node) once host-side
+  (`sel_base`), and record which PENDING pods match each group
+  (`pend_match`) so the solver can carry live counts through in-cycle
+  placements (`SolverState.sel_counts`). Per-domain aggregation is then a
+  segment-sum over `topo_code` rows inside the jitted solve.
+
+Row T (pad row) of `node_term_ok` is all-true: pods with no node constraint
+index it. `pref_score` row U is all-zero. Toleration sets always index a
+real row (the empty set is a legitimate set that tolerates nothing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+from flax import struct
+
+from scheduler_plugins_tpu.api.objects import Node, Pod
+
+I64 = np.int64
+I32 = np.int32
+
+
+@struct.dataclass
+class SchedulingState:
+    """Dense lookup tables for the in-tree companion plugins."""
+
+    node_term_ok: np.ndarray  # (T+1, N) bool
+    pod_node_term: np.ndarray  # (P,) int32 row index (T = unconstrained)
+    pref_score: np.ndarray  # (U+1, N) int64
+    pod_pref: np.ndarray  # (P,) int32 row index (U = no preferences)
+    tol_ok: np.ndarray  # (T2, N) bool
+    tol_prefer: np.ndarray  # (T2, N) int64
+    pod_tol: np.ndarray  # (P,) int32 row index
+    # --- selector/topology-domain counting (spread + inter-pod affinity);
+    # None when no pending pod carries such constraints. A "track" is a
+    # unique (selector group, topology key) pair; live counts are carried
+    # per (track, domain) — (TR, D) — so the per-pod checks and per-
+    # placement commits are O(constraints x domains), never O(N) ----------
+    pend_match: Optional[np.ndarray] = None  # (S, P) bool pod in sel group
+    topo_code: Optional[np.ndarray] = None  # (K, N) int32 domain code (-1)
+    topo_has: Optional[np.ndarray] = None  # (K, N) bool key present
+    domain_exists: Optional[np.ndarray] = None  # (K, D) bool
+    track_sel: Optional[np.ndarray] = None  # (TR,) int32 selector group
+    track_topo: Optional[np.ndarray] = None  # (TR,) int32 key code
+    track_base: Optional[np.ndarray] = None  # (TR, D) int64 assigned matches
+    # per-pod spread constraints, padded to CT
+    spread_track: Optional[np.ndarray] = None  # (P, CT) int32 track index
+    spread_topo: Optional[np.ndarray] = None  # (P, CT) int32 key code
+    spread_max_skew: Optional[np.ndarray] = None  # (P, CT) int64
+    spread_hard: Optional[np.ndarray] = None  # (P, CT) bool DoNotSchedule
+    spread_self: Optional[np.ndarray] = None  # (P, CT) bool pod matches own sel
+    spread_mask: Optional[np.ndarray] = None  # (P, CT) bool
+    # per-pod inter-pod affinity terms, padded to AT/BT/WT. `*_self` marks
+    # the upstream first-pod special case: the term matches the incoming
+    # pod itself, so an otherwise-empty cluster does not deadlock.
+    aff_track: Optional[np.ndarray] = None  # (P, AT) int32 required affinity
+    aff_topo: Optional[np.ndarray] = None  # (P, AT) int32 key code
+    aff_self: Optional[np.ndarray] = None  # (P, AT) bool
+    aff_mask: Optional[np.ndarray] = None  # (P, AT) bool
+    anti_track: Optional[np.ndarray] = None  # (P, BT) int32 required anti
+    anti_topo: Optional[np.ndarray] = None  # (P, BT) int32
+    anti_mask: Optional[np.ndarray] = None  # (P, BT) bool
+    # preferred (anti-)affinity terms: weighted domain-count scoring
+    waff_track: Optional[np.ndarray] = None  # (P, WT) int32
+    waff_topo: Optional[np.ndarray] = None  # (P, WT) int32
+    waff_weight: Optional[np.ndarray] = None  # (P, WT) int64 (negative=anti)
+    waff_mask: Optional[np.ndarray] = None  # (P, WT) bool
+    # EXISTING pods' required anti-affinity (symmetry): an incoming pod
+    # matching group `exist_anti_sel[e]` is blocked on nodes whose domain
+    # (under `exist_anti_topo[e]`) hosts a pod carrying term e. Domain
+    # presence is carried live (`SolverState.anti_domains`) because pending
+    # pods' own anti terms join E and their placements create new blocks.
+    exist_anti_sel: Optional[np.ndarray] = None  # (E,) int32 selector group
+    exist_anti_topo: Optional[np.ndarray] = None  # (E,) int32 key code
+    exist_anti_base: Optional[np.ndarray] = None  # (E, D) bool assigned
+    #: (E, P) which pending pods carry term e (their placement marks the
+    #: domain) — identity, not selector match
+    exist_anti_carrier: Optional[np.ndarray] = None
+    #: (E, P) which pending pods MATCH term e's selector (they get blocked)
+    exist_anti_match: Optional[np.ndarray] = None
+
+
+def _node_filter_key(pod: Pod):
+    return (
+        tuple(sorted(pod.node_selector.items())),
+        tuple(
+            (
+                tuple(
+                    (r.key, r.operator, tuple(r.values))
+                    for r in term.match_expressions
+                ),
+                tuple(
+                    (r.key, r.operator, tuple(r.values))
+                    for r in term.match_fields
+                ),
+            )
+            for term in pod.node_affinity_required
+        ),
+    )
+
+
+def _pref_key(pod: Pod):
+    return tuple(
+        (
+            t.weight,
+            tuple(
+                (r.key, r.operator, tuple(r.values))
+                for r in t.preference.match_expressions
+            ),
+            tuple(
+                (r.key, r.operator, tuple(r.values))
+                for r in t.preference.match_fields
+            ),
+        )
+        for t in pod.node_affinity_preferred
+    )
+
+
+def _tol_key(pod: Pod):
+    return tuple(
+        sorted(
+            (t.key, t.operator, t.value, t.effect) for t in pod.tolerations
+        )
+    )
+
+
+def _node_filter_matches(pod: Pod, node: Node) -> bool:
+    """spec.nodeSelector AND (OR over required affinity terms) — upstream
+    component-helpers nodeaffinity.GetRequiredNodeAffinity semantics."""
+    for k, v in pod.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    if pod.node_affinity_required:
+        return any(t.matches(node) for t in pod.node_affinity_required)
+    return True
+
+
+def _has_selector_specs(pending, assigned) -> bool:
+    return any(
+        p.topology_spread
+        or p.pod_affinity_required
+        or p.pod_anti_affinity_required
+        or p.pod_affinity_preferred
+        or p.pod_anti_affinity_preferred
+        for p in pending
+    ) or any(p.pod_anti_affinity_required for p in assigned)
+
+
+def relevant(nodes, pending, assigned=()) -> bool:
+    """Whether any spec exists that makes the tables non-trivial."""
+    return (
+        any(n.taints for n in nodes)
+        or any(
+            p.node_selector
+            or p.node_affinity_required
+            or p.node_affinity_preferred
+            for p in pending
+        )
+        or _has_selector_specs(pending, assigned)
+    )
+
+
+def build_scheduling(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    N: int,
+    P: int,
+    assigned: Sequence[Pod] = (),
+) -> Optional[SchedulingState]:
+    """Lower specs into `SchedulingState`; None when nothing is relevant."""
+    if not relevant(nodes, pending, assigned):
+        return None
+
+    term_rows: dict = {}
+    pref_rows: dict = {}
+    tol_rows: dict = {}
+    pod_node_term = np.zeros(P, I32)
+    pod_pref = np.zeros(P, I32)
+    pod_tol = np.zeros(P, I32)
+    term_pods: list[Pod] = []
+    pref_pods: list[Pod] = []
+    tol_pods: list[Pod] = []
+    for i, pod in enumerate(pending):
+        if pod.node_selector or pod.node_affinity_required:
+            k = _node_filter_key(pod)
+            if k not in term_rows:
+                term_rows[k] = len(term_rows)
+                term_pods.append(pod)
+            pod_node_term[i] = term_rows[k]
+        else:
+            pod_node_term[i] = -1  # remapped to the all-true pad row below
+        if pod.node_affinity_preferred:
+            k = _pref_key(pod)
+            if k not in pref_rows:
+                pref_rows[k] = len(pref_rows)
+                pref_pods.append(pod)
+            pod_pref[i] = pref_rows[k]
+        else:
+            pod_pref[i] = -1
+        k = _tol_key(pod)
+        if k not in tol_rows:
+            tol_rows[k] = len(tol_rows)
+            tol_pods.append(pod)
+        pod_tol[i] = tol_rows[k]
+
+    T, U, T2 = len(term_rows), len(pref_rows), max(len(tol_rows), 1)
+    node_term_ok = np.zeros((T + 1, N), bool)
+    node_term_ok[T] = True  # unconstrained row
+    pref_score = np.zeros((U + 1, N), I64)
+    tol_ok = np.ones((T2, N), bool)
+    tol_prefer = np.zeros((T2, N), I64)
+
+    for t, pod in enumerate(term_pods):
+        for n, node in enumerate(nodes):
+            node_term_ok[t, n] = _node_filter_matches(pod, node)
+    for u, pod in enumerate(pref_pods):
+        for n, node in enumerate(nodes):
+            pref_score[u, n] = sum(
+                t.weight
+                for t in pod.node_affinity_preferred
+                if t.preference.matches(node)
+            )
+    for s, pod in enumerate(tol_pods):
+        for n, node in enumerate(nodes):
+            for taint in node.taints:
+                if any(t.tolerates(taint) for t in pod.tolerations):
+                    continue
+                if taint.effect in ("NoSchedule", "NoExecute"):
+                    tol_ok[s, n] = False
+                elif taint.effect == "PreferNoSchedule":
+                    tol_prefer[s, n] += 1
+
+    return SchedulingState(
+        node_term_ok=node_term_ok,
+        pod_node_term=np.where(pod_node_term < 0, T, pod_node_term).astype(I32),
+        pref_score=pref_score,
+        pod_pref=np.where(pod_pref < 0, U, pod_pref).astype(I32),
+        tol_ok=tol_ok,
+        tol_prefer=tol_prefer,
+        pod_tol=pod_tol,
+        **_build_selector_tables(nodes, pending, assigned, N, P),
+    )
+
+
+def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
+    """Selector-group / topology-domain / track tables for PodTopologySpread
+    and InterPodAffinity: a track = unique (selector group, topology key)
+    pair; assigned pods aggregate into per-(track, domain) base counts;
+    existing/pending required anti-affinity terms form the E axis."""
+    if not _has_selector_specs(pending, assigned):
+        return {}
+
+    sels: dict = {}  # (ns scope, selector key) -> index
+    sel_objs: list = []  # (ns tuple, LabelSelector-or-None)
+    keys: dict = {}  # topology key -> index
+    key_names: list[str] = []
+    tracks: dict = {}  # (sel idx, key idx) -> track index
+
+    def sel_id(ns_scope: tuple, selector) -> int:
+        k = (ns_scope, None if selector is None else selector._key())
+        if k not in sels:
+            sels[k] = len(sels)
+            sel_objs.append((ns_scope, selector))
+        return sels[k]
+
+    def key_id(name: str) -> int:
+        if name not in keys:
+            keys[name] = len(keys)
+            key_names.append(name)
+        return keys[name]
+
+    def track_id(s: int, k: int) -> int:
+        if (s, k) not in tracks:
+            tracks[(s, k)] = len(tracks)
+        return tracks[(s, k)]
+
+    def term_ids(pod: Pod, term) -> tuple[int, int, int]:
+        """(sel, key, track) for a PodAffinityTerm scoped to the pod."""
+        scope = tuple(term.namespaces) or (pod.namespace,)
+        s = sel_id(scope, term.label_selector)
+        k = key_id(term.topology_key)
+        return s, k, track_id(s, k)
+
+    CT = max((len(p.topology_spread) for p in pending), default=1) or 1
+    spread_track = np.zeros((P, CT), I32)
+    spread_topo = np.zeros((P, CT), I32)
+    spread_max_skew = np.zeros((P, CT), I64)
+    spread_hard = np.zeros((P, CT), bool)
+    spread_self = np.zeros((P, CT), bool)
+    spread_mask = np.zeros((P, CT), bool)
+    for i, pod in enumerate(pending):
+        for c, tsc in enumerate(pod.topology_spread):
+            s = sel_id((pod.namespace,), tsc.label_selector)
+            k = key_id(tsc.topology_key)
+            spread_track[i, c] = track_id(s, k)
+            spread_topo[i, c] = k
+            spread_max_skew[i, c] = tsc.max_skew
+            spread_hard[i, c] = tsc.when_unsatisfiable == "DoNotSchedule"
+            spread_self[i, c] = _sel_matches(
+                tsc.label_selector, (pod.namespace,), pod
+            )
+            spread_mask[i, c] = True
+
+    # inter-pod affinity terms (incoming pod's own)
+    AT = max((len(p.pod_affinity_required) for p in pending), default=1) or 1
+    BT = (
+        max((len(p.pod_anti_affinity_required) for p in pending), default=1)
+        or 1
+    )
+    WT = (
+        max(
+            (
+                len(p.pod_affinity_preferred)
+                + len(p.pod_anti_affinity_preferred)
+                for p in pending
+            ),
+            default=1,
+        )
+        or 1
+    )
+    aff_track = np.zeros((P, AT), I32)
+    aff_topo = np.zeros((P, AT), I32)
+    aff_self = np.zeros((P, AT), bool)
+    aff_mask = np.zeros((P, AT), bool)
+    anti_track = np.zeros((P, BT), I32)
+    anti_topo = np.zeros((P, BT), I32)
+    anti_mask = np.zeros((P, BT), bool)
+    waff_track = np.zeros((P, WT), I32)
+    waff_topo = np.zeros((P, WT), I32)
+    waff_weight = np.zeros((P, WT), I64)
+    waff_mask = np.zeros((P, WT), bool)
+    # E axis: unique required anti-affinity (selector, key) pairs carried by
+    # assigned OR pending pods (symmetry: carriers block matching pods)
+    anti_terms: dict = {}  # (sel, key) -> e index
+
+    def anti_term_id(s: int, k: int) -> int:
+        if (s, k) not in anti_terms:
+            anti_terms[(s, k)] = len(anti_terms)
+        return anti_terms[(s, k)]
+
+    pend_carriers: list[list[int]] = []  # per e, pending carrier indices
+    for i, pod in enumerate(pending):
+        for c, term in enumerate(pod.pod_affinity_required):
+            s, k, t = term_ids(pod, term)
+            aff_track[i, c] = t
+            aff_topo[i, c] = k
+            aff_self[i, c] = _sel_matches(
+                term.label_selector, tuple(term.namespaces) or (pod.namespace,), pod
+            )
+            aff_mask[i, c] = True
+        for c, term in enumerate(pod.pod_anti_affinity_required):
+            s, k, t = term_ids(pod, term)
+            anti_track[i, c] = t
+            anti_topo[i, c] = k
+            anti_mask[i, c] = True
+            e = anti_term_id(s, k)
+            while len(pend_carriers) <= e:
+                pend_carriers.append([])
+            pend_carriers[e].append(i)
+        w = 0
+        for wt in pod.pod_affinity_preferred:
+            s, k, t = term_ids(pod, wt.term)
+            waff_track[i, w] = t
+            waff_topo[i, w] = k
+            waff_weight[i, w] = wt.weight
+            waff_mask[i, w] = True
+            w += 1
+        for wt in pod.pod_anti_affinity_preferred:
+            s, k, t = term_ids(pod, wt.term)
+            waff_track[i, w] = t
+            waff_topo[i, w] = k
+            waff_weight[i, w] = -wt.weight
+            waff_mask[i, w] = True
+            w += 1
+
+    # assigned pods' anti terms join E; remember who carries each term
+    assigned_carrier_terms: list[tuple[Pod, int]] = []
+    for pod in assigned:
+        for term in pod.pod_anti_affinity_required:
+            scope = tuple(term.namespaces) or (pod.namespace,)
+            s = sel_id(scope, term.label_selector)
+            k = key_id(term.topology_key)
+            e = anti_term_id(s, k)
+            while len(pend_carriers) <= e:
+                pend_carriers.append([])
+            assigned_carrier_terms.append((pod, e))
+
+    S, K = len(sel_objs), max(len(key_names), 1)
+    # topology domain codes per key (value interned per key)
+    topo_code = np.full((K, N), -1, I32)
+    topo_has = np.zeros((K, N), bool)
+    domain_values: list[dict] = [dict() for _ in range(K)]
+    for k, name in enumerate(key_names):
+        for n, node in enumerate(nodes):
+            val = node.labels.get(name)
+            if val is None:
+                continue
+            dv = domain_values[k]
+            if val not in dv:
+                dv[val] = len(dv)
+            topo_code[k, n] = dv[val]
+            topo_has[k, n] = True
+    D = max((len(dv) for dv in domain_values), default=1) or 1
+    domain_exists = np.zeros((K, D), bool)
+    for k, dv in enumerate(domain_values):
+        for code in dv.values():
+            domain_exists[k, code] = True
+
+    TR = max(len(tracks), 1)
+    track_sel = np.zeros(TR, I32)
+    track_topo = np.zeros(TR, I32)
+    for (s, k), t in tracks.items():
+        track_sel[t] = s
+        track_topo[t] = k
+
+    node_pos = {node.name: n for n, node in enumerate(nodes)}
+    track_base = np.zeros((TR, D), I64)
+    for pod in assigned:
+        n = node_pos.get(pod.node_name)
+        if n is None:
+            continue
+        for (s, k), t in tracks.items():
+            code = topo_code[k, n]
+            ns, selector = sel_objs[s]
+            if code >= 0 and _sel_matches(selector, ns, pod):
+                track_base[t, code] += 1
+    pend_match = np.zeros((S, P), bool)
+    for i, pod in enumerate(pending):
+        for s, (ns, selector) in enumerate(sel_objs):
+            pend_match[s, i] = _sel_matches(selector, ns, pod)
+
+    out = dict(
+        pend_match=pend_match,
+        topo_code=topo_code,
+        topo_has=topo_has,
+        domain_exists=domain_exists,
+        track_sel=track_sel,
+        track_topo=track_topo,
+        track_base=track_base,
+        spread_track=spread_track,
+        spread_topo=spread_topo,
+        spread_max_skew=spread_max_skew,
+        spread_hard=spread_hard,
+        spread_self=spread_self,
+        spread_mask=spread_mask,
+        aff_track=aff_track,
+        aff_topo=aff_topo,
+        aff_self=aff_self,
+        aff_mask=aff_mask,
+        anti_track=anti_track,
+        anti_topo=anti_topo,
+        anti_mask=anti_mask,
+        waff_track=waff_track,
+        waff_topo=waff_topo,
+        waff_weight=waff_weight,
+        waff_mask=waff_mask,
+    )
+
+    if anti_terms:
+        E = len(anti_terms)
+        exist_anti_sel = np.zeros(E, I32)
+        exist_anti_topo = np.zeros(E, I32)
+        for (s, k), e in anti_terms.items():
+            exist_anti_sel[e] = s
+            exist_anti_topo[e] = k
+        exist_anti_base = np.zeros((E, D), bool)
+        for pod, e in assigned_carrier_terms:
+            n = node_pos.get(pod.node_name)
+            if n is None:
+                continue
+            code = topo_code[exist_anti_topo[e], n]
+            if code >= 0:
+                exist_anti_base[e, code] = True
+        exist_anti_carrier = np.zeros((E, P), bool)
+        for e, carriers in enumerate(pend_carriers):
+            for i in carriers:
+                exist_anti_carrier[e, i] = True
+        exist_anti_match = np.zeros((E, P), bool)
+        for e in range(E):
+            exist_anti_match[e] = pend_match[exist_anti_sel[e]]
+        out.update(
+            exist_anti_sel=exist_anti_sel,
+            exist_anti_topo=exist_anti_topo,
+            exist_anti_base=exist_anti_base,
+            exist_anti_carrier=exist_anti_carrier,
+            exist_anti_match=exist_anti_match,
+        )
+    return out
+
+
+def _sel_matches(selector, ns_scope, pod: Pod) -> bool:
+    """Namespace-scoped label-selector match (metav1: a nil selector matches
+    nothing; an empty selector matches everything). `ns_scope` is a str or
+    a tuple of namespaces (PodAffinityTerm.namespaces)."""
+    if isinstance(ns_scope, str):
+        ns_scope = (ns_scope,)
+    if pod.namespace not in ns_scope:
+        return False
+    if selector is None:
+        return False
+    return selector.matches(pod.labels)

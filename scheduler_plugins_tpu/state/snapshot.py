@@ -47,6 +47,7 @@ from scheduler_plugins_tpu.api.resources import (
     PODS,
     ResourceIndex,
 )
+from scheduler_plugins_tpu.state import scheduling as _sched
 from scheduler_plugins_tpu.utils.intmath import bucket_size
 
 I64 = np.int64
@@ -233,6 +234,9 @@ class ClusterSnapshot:
     network: Optional["NetworkState"] = None
     syscalls: Optional[SyscallState] = None
     nominees: Optional[NomineeState] = None
+    #: in-tree companion-plugin tables (taints, node affinity) — see
+    #: state.scheduling
+    scheduling: Optional["_sched.SchedulingState"] = None
 
     @property
     def num_nodes(self) -> int:
@@ -790,6 +794,9 @@ def build_snapshot(
         )
         if seccomp_profiles
         else None,
+        scheduling=_sched.build_scheduling(
+            nodes, pending_pods, N, P, assigned=assigned_pods
+        ),
     )
     # hand jit-ready device arrays to callers (numpy is build-time only;
     # tracer indexing inside lax.scan requires jax arrays)

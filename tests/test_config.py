@@ -11,7 +11,7 @@ from scheduler_plugins_tpu.plugins import Coscheduling, TargetLoadPacking
 class TestLoadProfile:
     def test_full_roster_loads(self):
         profile = load_profile({"plugins": list(available_plugins())})
-        assert len(profile.plugins) == 14
+        assert len(profile.plugins) == 18  # 14 reference + 4 in-tree companions
 
     def test_args_and_defaults(self):
         profile = load_profile(
