@@ -48,9 +48,28 @@ spec surface: "containers"/"init_containers" (each {"requests", "limits",
 single-container "requests"/"limits" shorthand remains valid. A bound pod
 is not demoted by a stale echo without a node (informer-cache semantics).
 
+Node events may carry "taints"; pod events the in-tree spec fragments the
+companion plugins consume (plugins/intree.py): "node_selector",
+"node_affinity" {"required": [term], "preferred": [{"weight", "preference":
+term}]} (term = {"match_expressions"/"match_fields":
+[{"key","operator","values"}]}), "tolerations", "topology_spread"
+[{"max_skew","topology_key","when_unsatisfiable","label_selector"}], and
+"pod_affinity"/"pod_anti_affinity" {"required": [pterm], "preferred":
+[{"weight","term": pterm}]} (pterm = {"topology_key","label_selector",
+"namespaces"}; label_selector = {"match_labels","match_expressions"}).
+
+Every object event may carry "rv" — a per-object monotonic resource
+version; the server drops events at or below the last applied version
+({"ok": true, "stale": true}), giving informer-grade fencing across
+replays, reordering, and redundant agents.
+
 Each line is acknowledged with {"ok": true} or {"ok": false, "error": ...};
 the {"op": "sync"} barrier acks with cluster counts, so an agent can fence a
 batch before requesting a scheduling cycle.
+
+Transports: newline-JSON (above), the same events in gRPC message framing
+(5-byte prefix; auto-detected per connection, `FramedFeedClient`), or real
+gRPC via `bridge.grpc_feed` (HTTP/2, JSON codec, no protobuf stubs).
 """
 
 from __future__ import annotations
@@ -67,19 +86,33 @@ from scheduler_plugins_tpu.api.objects import (
     AppGroupWorkload,
     Container,
     ElasticQuota,
+    LabelSelector,
+    LabelSelectorRequirement,
     NetworkTopology,
     Node,
     NodeResourceTopology,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
     NUMAZone,
     Pod,
+    PodAffinityTerm,
     PodDisruptionBudget,
     PodGroup,
+    PreferredSchedulingTerm,
     PriorityClass,
     SeccompProfile,
+    Taint,
+    Toleration,
     TopologyManagerPolicy,
     TopologyManagerScope,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
 )
 from scheduler_plugins_tpu.state.cluster import Cluster
+
+#: framed-transport sanity bound — far above any real event, far below a
+#: memory-exhausting allocation from a garbage header
+MAX_FRAME_BYTES = 16 << 20
 
 
 def _container(spec: dict) -> Container:
@@ -92,9 +125,183 @@ def _container(spec: dict) -> Container:
     )
 
 
-def apply_event(cluster: Cluster, event: dict) -> dict:
-    """Apply one event to the store; returns the ack payload."""
+def _node_term(spec: dict) -> NodeSelectorTerm:
+    def req(r):
+        return NodeSelectorRequirement(
+            key=r["key"], operator=r["operator"],
+            values=tuple(r.get("values", ())),
+        )
+
+    return NodeSelectorTerm(
+        match_expressions=[
+            req(r) for r in spec.get("match_expressions") or []
+        ],
+        match_fields=[req(r) for r in spec.get("match_fields") or []],
+    )
+
+
+def _label_selector(spec: Optional[dict]) -> Optional[LabelSelector]:
+    if spec is None:
+        return None
+    return LabelSelector(
+        match_labels=spec.get("match_labels") or {},
+        match_expressions=[
+            LabelSelectorRequirement(
+                key=r["key"], operator=r["operator"],
+                values=tuple(r.get("values") or ()),
+            )
+            for r in spec.get("match_expressions") or []
+        ],
+    )
+
+
+def _pod_term(spec: dict) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        topology_key=spec["topology_key"],
+        label_selector=_label_selector(spec.get("label_selector")),
+        namespaces=tuple(spec.get("namespaces", ())),
+    )
+
+
+def _pod_spec_fragments(event: dict) -> dict:
+    """In-tree scheduling spec fragments (nodeSelector / affinity /
+    tolerations / topology spread) from a pod event — the pieces real
+    profiles need for the companion plugins (plugins/intree.py)."""
+    out: dict = {}
+    # `or {}` / `or []` throughout: agents marshaling structs without
+    # omitempty emit JSON null for absent fields
+    if event.get("node_selector"):
+        out["node_selector"] = dict(event["node_selector"])
+    na = event.get("node_affinity") or {}
+    if na.get("required"):
+        out["node_affinity_required"] = [
+            _node_term(t) for t in na["required"]
+        ]
+    if na.get("preferred"):
+        out["node_affinity_preferred"] = [
+            PreferredSchedulingTerm(
+                weight=int(t["weight"]),
+                preference=_node_term(t.get("preference", {})),
+            )
+            for t in na["preferred"]
+        ]
+    if event.get("tolerations"):
+        out["tolerations"] = [
+            Toleration(
+                key=t.get("key", ""),
+                operator=t.get("operator", "Equal"),
+                value=t.get("value", ""),
+                effect=t.get("effect", ""),
+            )
+            for t in event["tolerations"]
+        ]
+    if event.get("topology_spread"):
+        out["topology_spread"] = [
+            TopologySpreadConstraint(
+                max_skew=int(c["max_skew"]),
+                topology_key=c["topology_key"],
+                when_unsatisfiable=c.get(
+                    "when_unsatisfiable", "DoNotSchedule"
+                ),
+                label_selector=_label_selector(c.get("label_selector")),
+            )
+            for c in event["topology_spread"]
+        ]
+    for side, attr in (
+        ("pod_affinity", "pod_affinity"),
+        ("pod_anti_affinity", "pod_anti_affinity"),
+    ):
+        spec = event.get(side) or {}
+        if spec.get("required"):
+            out[f"{attr}_required"] = [_pod_term(t) for t in spec["required"]]
+        if spec.get("preferred"):
+            out[f"{attr}_preferred"] = [
+                WeightedPodAffinityTerm(
+                    weight=int(t["weight"]), term=_pod_term(t["term"])
+                )
+                for t in spec["preferred"]
+            ]
+    return out
+
+
+#: op -> (kind, key fields) for resource-version fencing; namespaced kinds
+#: key on "namespace/name"
+_RV_KINDS = {
+    "upsert_node": ("node", ("name",)),
+    "delete_node": ("node", ("name",)),
+    "upsert_pod": ("pod", ("namespace", "name")),
+    "delete_pod": ("pod", ("namespace", "name")),
+    "upsert_quota": ("quota", ("namespace",)),
+    "delete_quota": ("quota", ("namespace",)),
+    "upsert_pod_group": ("pod_group", ("namespace", "name")),
+    "delete_pod_group": ("pod_group", ("namespace", "name")),
+    "upsert_nrt": ("nrt", ("node",)),
+    "delete_nrt": ("nrt", ("node",)),
+    "upsert_app_group": ("app_group", ("namespace", "name")),
+    "delete_app_group": ("app_group", ("namespace", "name")),
+    "upsert_network_topology": ("network_topology", ("namespace", "name")),
+    "delete_network_topology": ("network_topology", ("namespace", "name")),
+    "upsert_seccomp_profile": ("seccomp_profile", ("namespace", "name")),
+    "delete_seccomp_profile": ("seccomp_profile", ("namespace", "name")),
+    "upsert_priority_class": ("priority_class", ("name",)),
+    "delete_priority_class": ("priority_class", ("name",)),
+    "upsert_pdb": ("pdb", ("namespace", "name")),
+    "delete_pdb": ("pdb", ("namespace", "name")),
+}
+
+
+def _rv_key(event: dict):
+    spec = _RV_KINDS.get(event.get("op"))
+    if spec is None:
+        return None
+    kind, fields = spec
+    if kind == "pod":
+        # one fence lane per pod regardless of which identifier a given
+        # agent sends: namespace/name when available (the default uid
+        # format), bare uid only as the delete-by-uid fallback
+        if event.get("name"):
+            return (kind, f"{event.get('namespace', 'default')}/{event['name']}")
+        return (kind, event.get("uid", ""))
+    ident = "/".join(
+        str(event.get(f, "default" if f == "namespace" else ""))
+        for f in fields
+    )
+    return (kind, ident)
+
+
+def apply_event(
+    cluster: Cluster, event: dict, rv_table: Optional[dict] = None
+) -> dict:
+    """Apply one event to the store; returns the ack payload.
+
+    When the event carries `rv` (a per-object monotonic resource version,
+    the informer-cache fencing the reference gets from the apiserver) and
+    `rv_table` is provided, an event at or below the last applied version
+    for that object is dropped with ``{"ok": true, "stale": true}`` — so
+    replays, races between redundant agents, and out-of-order delivery
+    cannot regress the store. Events without `rv` apply unconditionally
+    (last-writer-wins, protocol v1/v2 behavior).
+    """
     op = event.get("op")
+    fence = None
+    if rv_table is not None and "rv" in event:
+        key = _rv_key(event)
+        if key is not None:
+            rv = int(event["rv"])
+            last = rv_table.get(key)
+            if last is not None and rv <= last:
+                return {"ok": True, "stale": True, "last_rv": last}
+            # recorded only AFTER the op applies cleanly — a malformed
+            # event must not burn its version (the agent retries the
+            # corrected event under the same rv)
+            fence = (key, rv)
+    ack = _apply_op(cluster, event, op)
+    if fence is not None and ack.get("ok", True):
+        rv_table[fence[0]] = fence[1]
+    return ack
+
+
+def _apply_op(cluster: Cluster, event: dict, op) -> dict:
     if op == "upsert_node":
         cluster.add_node(
             Node(
@@ -102,6 +309,14 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
                 allocatable={k: int(v) for k, v in event["allocatable"].items()},
                 labels=event.get("labels", {}),
                 unschedulable=event.get("unschedulable", False),
+                taints=[
+                    Taint(
+                        key=t["key"],
+                        value=t.get("value", ""),
+                        effect=t.get("effect", "NoSchedule"),
+                    )
+                    for t in event.get("taints", [])
+                ],
             )
         )
     elif op == "upsert_pod":
@@ -135,14 +350,21 @@ def apply_event(cluster: Cluster, event: dict) -> dict:
             init_containers=[
                 _container(c) for c in event.get("init_containers", [])
             ],
+            **_pod_spec_fragments(event),
         )
         pod.node_name = event.get("node")
         pod.nominated_node_name = event.get("nominated_node")
         existing = cluster.pods.get(pod.uid)
-        if existing is not None and existing.node_name is not None and pod.node_name is None:
-            # stale watch echo predating our bind: the local binding is the
-            # newer truth (informer caches resolve the same way via resource
-            # versions; this protocol carries none)
+        if (
+            existing is not None
+            and existing.node_name is not None
+            and pod.node_name is None
+            and "rv" not in event
+        ):
+            # un-fenced stale watch echo predating our bind: the local
+            # binding is the newer truth. An rv-carrying event already
+            # passed the fence, so its missing node is REAL (e.g. the
+            # apiserver rejected the bind) and must apply as-is.
             pod.node_name = existing.node_name
         cluster.add_pod(pod)
     elif op == "delete_pod":
@@ -322,21 +544,71 @@ class FeedServer:
     def __init__(self, cluster: Cluster, host: str = "127.0.0.1", port: int = 0):
         self.cluster = cluster
         self.lock = threading.Lock()
+        #: (kind, id) -> last applied resource version (shared across
+        #: connections: redundant agents fence against each other)
+        self.rv_table: dict = {}
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
+            def _apply(self, raw: bytes) -> bytes:
+                try:
+                    event = json.loads(raw)
+                    with outer.lock:
+                        ack = apply_event(
+                            outer.cluster, event, rv_table=outer.rv_table
+                        )
+                except Exception as exc:  # malformed: report, keep going
+                    ack = {"ok": False, "error": str(exc)}
+                return json.dumps(ack).encode()
+
             def handle(self):
+                # transport sniff: a gRPC-style frame starts with the
+                # 0x00/0x01 compressed-flag byte; newline-JSON starts with
+                # "{" — one port speaks both
+                first = self.rfile.peek(1)[:1]
+                if first in (b"\x00", b"\x01"):
+                    self._handle_framed()
+                else:
+                    self._handle_lines()
+
+            def _handle_lines(self):
                 for raw in self.rfile:
                     raw = raw.strip()
                     if not raw:
                         continue
-                    try:
-                        event = json.loads(raw)
-                        with outer.lock:
-                            ack = apply_event(outer.cluster, event)
-                    except Exception as exc:  # malformed line: report, keep going
-                        ack = {"ok": False, "error": str(exc)}
-                    self.wfile.write((json.dumps(ack) + "\n").encode())
+                    self.wfile.write(self._apply(raw) + b"\n")
+                    self.wfile.flush()
+
+            def _handle_framed(self):
+                """gRPC message framing (1-byte compressed flag + 4-byte
+                big-endian length) carrying the same JSON events — the wire
+                shape a Go agent's grpc stack produces, minus HTTP/2."""
+                import struct as _struct
+
+                while True:
+                    header = self.rfile.read(5)
+                    if len(header) < 5:
+                        return
+                    _flag, length = _struct.unpack(">BI", header)
+                    if length > MAX_FRAME_BYTES:
+                        # a bogus length would commit us to buffering GiBs
+                        # (one garbage byte routes a connection here) —
+                        # refuse and drop the connection
+                        body = json.dumps({
+                            "ok": False,
+                            "error": f"frame of {length} bytes exceeds "
+                                     f"max {MAX_FRAME_BYTES}",
+                        }).encode()
+                        self.wfile.write(
+                            _struct.pack(">BI", 0, len(body)) + body
+                        )
+                        self.wfile.flush()
+                        return
+                    payload = self.rfile.read(length)
+                    if len(payload) < length:
+                        return
+                    body = self._apply(payload)
+                    self.wfile.write(_struct.pack(">BI", 0, len(body)) + body)
                     self.wfile.flush()
 
         self._server = socketserver.ThreadingTCPServer((host, port), Handler)
@@ -381,6 +653,29 @@ class FeedClient:
         self._file.write((json.dumps(event) + "\n").encode())
         self._file.flush()
         return json.loads(self._file.readline())
+
+    def close(self):
+        self._file.close()
+
+
+class FramedFeedClient:
+    """Agent-side client speaking the gRPC-framed transport (same events,
+    5-byte message prefix instead of newlines)."""
+
+    def __init__(self, host: str, port: int):
+        import struct as _struct
+
+        self._struct = _struct
+        self._sock = socket.create_connection((host, port))
+        self._file = self._sock.makefile("rwb")
+
+    def send(self, event: dict) -> dict:
+        body = json.dumps(event).encode()
+        self._file.write(self._struct.pack(">BI", 0, len(body)) + body)
+        self._file.flush()
+        header = self._file.read(5)
+        _flag, length = self._struct.unpack(">BI", header)
+        return json.loads(self._file.read(length))
 
     def close(self):
         self._file.close()

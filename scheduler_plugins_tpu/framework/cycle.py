@@ -281,8 +281,12 @@ def _resync_nrt_cache(cluster: Cluster, now: int = 0):
     if not cache.desynced_nodes():
         return
     node_pods: dict[str, list] = {}
+    relevant = getattr(cache, "pod_relevant", lambda pod: True)
     for pod in cluster.pods.values():
-        if pod.node_name is not None:
+        # the cache's pod view goes through the informer-mode relevance
+        # predicate (podprovider.go:37-93): fingerprints must be computed
+        # over exactly the pods that provider would have listed
+        if pod.node_name is not None and relevant(pod):
             node_pods.setdefault(pod.node_name, []).append(pod)
     cache.resync(node_pods)
 

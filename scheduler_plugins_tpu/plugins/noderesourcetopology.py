@@ -110,6 +110,7 @@ class NodeResourceTopologyMatch(Plugin):
             self.discard_reserved_nodes,
             self.cache_resync_period_seconds,
             self.cache_foreign_pods_detect,
+            self.cache_informer_mode,
         )
 
     def make_cache(self):
@@ -122,7 +123,8 @@ class NodeResourceTopologyMatch(Plugin):
         if self.cache_resync_period_seconds <= 0:
             return caches.PassthroughCache()
         cache = caches.OverReserveCache(
-            foreign_pods_detect=self.cache_foreign_pods_detect
+            foreign_pods_detect=self.cache_foreign_pods_detect,
+            informer_mode=self.cache_informer_mode,
         )
         cache.resync_period_ms = self.cache_resync_period_seconds * 1000
         return cache

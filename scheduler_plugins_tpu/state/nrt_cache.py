@@ -31,6 +31,7 @@ from typing import Iterable, Optional
 from scheduler_plugins_tpu.api.objects import (
     NodeResourceTopology,
     Pod,
+    PodPhase,
     QOSClass,
 )
 from scheduler_plugins_tpu.api.resources import CPU, MEMORY, add_quantities
@@ -157,6 +158,23 @@ class OverReserveCache(NrtCache):
     #: (apis/config defaults: ForeignPodsDetect=All;
     #: resourcerequests/exclusive.go:47-95)
     foreign_pods_detect: str = "All"
+    #: Cache.InformerMode (podprovider/podprovider.go:37-93): which pod
+    #: events the cache's pod view (fingerprints, foreign tracking) sees.
+    #: "Dedicated" (reference default for this cache) = every bound pod;
+    #: "Shared" = only pods in Running phase — the shared informer's
+    #: relevance predicate (IsPodRelevantShared), so a bound-but-not-yet-
+    #: running pod is invisible to fingerprints and foreign detection.
+    informer_mode: str = "Dedicated"
+
+    def pod_relevant(self, pod: Pod) -> bool:
+        """The provider's PodFilterFunc. Deviation from the reference's
+        Dedicated predicate: a bound pod in Pending phase counts here (the
+        host store binds without simulating kubelet phase transitions, so
+        bound+Pending is normal, not the unexpected-listing case the
+        reference logs and drops)."""
+        if self.informer_mode == "Shared":
+            return pod.phase == PodPhase.RUNNING
+        return pod.node_name is not None
 
     def __post_init__(self):
         self.nrts: dict[str, NodeResourceTopology] = {}  # flushed copies
@@ -192,8 +210,11 @@ class OverReserveCache(NrtCache):
     def track_pod(self, pod: Pod) -> None:
         """Informer pod event: a running pod owned by another scheduler marks
         its node foreign (cache/foreign_pods.go); in OnlyExclusiveResources
-        mode, only pods that pin cpus/devices count."""
+        mode, only pods that pin cpus/devices count. The informer-mode
+        relevance predicate gates which pod events this view sees at all."""
         if not pod.node_name or pod.scheduler_name in self.our_schedulers:
+            return
+        if not self.pod_relevant(pod):
             return
         if (
             self.foreign_pods_detect == "OnlyExclusiveResources"

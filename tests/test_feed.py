@@ -307,3 +307,249 @@ def check_feed_invariants(cluster):
             1 for p in cluster.gang_members(pg) if p.node_name is not None
         )
         assert bound == 0 or bound >= pg.min_member, (pg.full_name, bound)
+
+
+class TestSpecFragments:
+    def test_taints_affinity_spread_over_the_wire(self):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.plugins import (
+            NodeAffinity,
+            NodeResourcesAllocatable,
+            PodTopologySpread,
+            TaintToleration,
+        )
+
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            ZONE = "topology.kubernetes.io/zone"
+            for i, (z, taints) in enumerate([
+                ("z-a", []), ("z-a", [{"key": "dedicated", "value": "x"}]),
+                ("z-b", []),
+            ]):
+                assert client.send({
+                    "op": "upsert_node", "name": f"n{i}",
+                    "allocatable": {"cpu": 8000, "memory": 32 << 30, "pods": 110},
+                    "labels": {ZONE: z, "disk": "ssd"}, "taints": taints,
+                })["ok"]
+            for j in range(2):
+                assert client.send({
+                    "op": "upsert_pod", "name": f"p{j}", "creation_ms": j,
+                    "labels": {"app": "web"},
+                    "requests": {"cpu": 500, "memory": 1 << 30},
+                    "node_selector": {"disk": "ssd"},
+                    "tolerations": [],
+                    "topology_spread": [{
+                        "max_skew": 1, "topology_key": ZONE,
+                        "when_unsatisfiable": "DoNotSchedule",
+                        "label_selector": {"match_labels": {"app": "web"}},
+                    }],
+                    "node_affinity": {"required": [{"match_expressions": [
+                        {"key": "disk", "operator": "In", "values": ["ssd"]}]}]},
+                })["ok"]
+            sched = Scheduler(Profile(plugins=[
+                NodeResourcesAllocatable(), NodeAffinity(), TaintToleration(),
+                PodTopologySpread()]))
+            report = server.run_cycle(sched, now=1000)
+            nodes = sorted(report.bound.values())
+            # taint keeps p off n1; spread forces one per zone
+            assert "n1" not in nodes
+            assert nodes == ["n0", "n2"]
+        finally:
+            server.stop()
+
+
+class TestResourceVersionFencing:
+    def test_stale_rv_dropped(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            assert client.send({
+                "op": "upsert_node", "name": "n0", "rv": 7,
+                "allocatable": {"cpu": 4000, "memory": 1 << 30, "pods": 10},
+            })["ok"]
+            ack = client.send({
+                "op": "upsert_node", "name": "n0", "rv": 5,  # replayed older
+                "allocatable": {"cpu": 1, "memory": 1, "pods": 1},
+            })
+            assert ack["ok"] and ack.get("stale") and ack["last_rv"] == 7
+            assert cluster.nodes["n0"].allocatable["cpu"] == 4000
+            assert client.send({
+                "op": "upsert_node", "name": "n0", "rv": 9,
+                "allocatable": {"cpu": 8000, "memory": 1 << 30, "pods": 10},
+            })["ok"]
+            assert cluster.nodes["n0"].allocatable["cpu"] == 8000
+        finally:
+            server.stop()
+
+    def test_stale_delete_fenced(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            client.send({"op": "upsert_pod", "name": "p", "rv": 10,
+                         "requests": {"cpu": 100}})
+            ack = client.send({"op": "delete_pod", "name": "p",
+                               "namespace": "default", "rv": 4})
+            assert ack.get("stale")
+            assert "default/p" in cluster.pods
+        finally:
+            server.stop()
+
+    def test_no_rv_is_last_writer_wins(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            client.send({"op": "upsert_node", "name": "n0",
+                         "allocatable": {"cpu": 1000, "memory": 1, "pods": 1}})
+            client.send({"op": "upsert_node", "name": "n0",
+                         "allocatable": {"cpu": 2000, "memory": 1, "pods": 1}})
+            assert cluster.nodes["n0"].allocatable["cpu"] == 2000
+        finally:
+            server.stop()
+
+
+class TestFramedTransport:
+    def test_framed_client_same_port(self):
+        from scheduler_plugins_tpu.bridge.feed import FramedFeedClient
+
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FramedFeedClient(*server.address)
+            ack = client.send({
+                "op": "upsert_node", "name": "n0",
+                "allocatable": {"cpu": 4000, "memory": 1 << 30, "pods": 10},
+            })
+            assert ack["ok"]
+            ack = client.send({"op": "sync"})
+            assert ack["nodes"] == 1
+            # line-mode clients still work on the same port
+            line = FeedClient(*server.address)
+            assert line.send({"op": "sync"})["nodes"] == 1
+        finally:
+            server.stop()
+
+
+class TestGrpcTransport:
+    def test_grpc_apply_and_stream(self):
+        import pytest
+
+        pytest.importorskip("grpc")
+        from scheduler_plugins_tpu.bridge.grpc_feed import (
+            GrpcFeedClient,
+            GrpcFeedServer,
+        )
+
+        cluster = Cluster()
+        server = GrpcFeedServer(cluster).start()
+        try:
+            client = GrpcFeedClient("127.0.0.1", server.port)
+            assert client.send({
+                "op": "upsert_node", "name": "n0",
+                "allocatable": {"cpu": 4000, "memory": 1 << 30, "pods": 10},
+            })["ok"]
+            acks = client.send_batch([
+                {"op": "upsert_pod", "name": f"p{j}", "rv": j,
+                 "requests": {"cpu": 100}}
+                for j in range(5)
+            ] + [{"op": "sync"}])
+            assert all(a["ok"] for a in acks)
+            assert acks[-1]["pods"] == 5
+            # fencing shared with the server's table
+            assert client.send({"op": "upsert_pod", "name": "p3", "rv": 2,
+                                "requests": {"cpu": 999}}).get("stale")
+            client.close()
+        finally:
+            server.stop()
+
+
+class TestFencingEdgeCases:
+    def test_failed_event_does_not_burn_its_rv(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            # malformed (missing allocatable) -> error, rv NOT recorded
+            ack = client.send({"op": "upsert_node", "name": "n0", "rv": 8})
+            assert not ack["ok"]
+            # corrected retry under the SAME rv must apply
+            ack = client.send({"op": "upsert_node", "name": "n0", "rv": 8,
+                               "allocatable": {"cpu": 4000, "memory": 1, "pods": 1}})
+            assert ack["ok"] and not ack.get("stale")
+            assert cluster.nodes["n0"].allocatable["cpu"] == 4000
+        finally:
+            server.stop()
+
+    def test_rv_event_without_node_really_unbinds(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            client.send({"op": "upsert_pod", "name": "p", "rv": 1,
+                         "requests": {"cpu": 100}, "node": "n0"})
+            assert cluster.pods["default/p"].node_name == "n0"
+            # fenced NEWER event without node: bind was rejected upstream
+            client.send({"op": "upsert_pod", "name": "p", "rv": 2,
+                         "requests": {"cpu": 100}})
+            assert cluster.pods["default/p"].node_name is None
+            # but an UN-fenced echo without node keeps the local bind
+            client.send({"op": "upsert_pod", "name": "p", "node": "n0",
+                         "rv": 3, "requests": {"cpu": 100}})
+            client.send({"op": "upsert_pod", "name": "p",
+                         "requests": {"cpu": 100}})
+            assert cluster.pods["default/p"].node_name == "n0"
+        finally:
+            server.stop()
+
+    def test_pod_fence_lane_shared_across_identifier_styles(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            client.send({"op": "upsert_pod", "uid": "default/p", "name": "p",
+                         "rv": 9, "requests": {"cpu": 100}})
+            # replay WITHOUT uid still lands in the same fence lane
+            ack = client.send({"op": "upsert_pod", "name": "p", "rv": 4,
+                               "requests": {"cpu": 999}})
+            assert ack.get("stale")
+            assert len(cluster.pods) == 1
+        finally:
+            server.stop()
+
+    def test_null_spec_fields_tolerated(self):
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            client = FeedClient(*server.address)
+            ack = client.send({
+                "op": "upsert_pod", "name": "p", "requests": {"cpu": 100},
+                "node_selector": None, "node_affinity": None,
+                "tolerations": None, "topology_spread": None,
+                "pod_affinity": None, "pod_anti_affinity": None,
+            })
+            assert ack["ok"], ack
+        finally:
+            server.stop()
+
+    def test_oversized_frame_refused(self):
+        import socket as _socket
+        import struct as _struct
+
+        cluster = Cluster()
+        server = FeedServer(cluster).start()
+        try:
+            sock = _socket.create_connection(server.address)
+            f = sock.makefile("rwb")
+            f.write(_struct.pack(">BI", 0, 0xFFFFFFFF))
+            f.flush()
+            header = f.read(5)
+            _flag, length = _struct.unpack(">BI", header)
+            import json as _json
+            ack = _json.loads(f.read(length))
+            assert not ack["ok"] and "exceeds" in ack["error"]
+        finally:
+            server.stop()

@@ -177,3 +177,86 @@ class TestCacheInCycle:
         c.nrt_cache.resync({"n0": [c.pods["default/p1"]]})
         r3 = run_cycle(sched, c, now=3000)
         assert "default/p2" in r3.bound
+
+
+class TestInformerModes:
+    """podprovider.go:37-93: the cache's pod view (fingerprints, foreign
+    tracking) goes through the informer-mode relevance predicate."""
+
+    def _cache(self, mode):
+        from scheduler_plugins_tpu.state.nrt_cache import OverReserveCache
+
+        return OverReserveCache(informer_mode=mode)
+
+    def _foreign_pod(self, phase):
+        from scheduler_plugins_tpu.api.objects import Container, Pod, PodPhase
+
+        p = Pod(name="intruder", scheduler_name="other-sched",
+                containers=[Container(requests={"cpu": 100})], phase=phase)
+        p.node_name = "n0"
+        return p
+
+    def test_shared_mode_sees_only_running_pods(self):
+        from scheduler_plugins_tpu.api.objects import PodPhase
+
+        cache = self._cache("Shared")
+        cache.track_pod(self._foreign_pod(PodPhase.PENDING))
+        assert "n0" not in cache.foreign  # bound but not Running: invisible
+        cache.track_pod(self._foreign_pod(PodPhase.RUNNING))
+        assert "n0" in cache.foreign
+
+    def test_dedicated_mode_sees_every_bound_pod(self):
+        from scheduler_plugins_tpu.api.objects import PodPhase
+
+        cache = self._cache("Dedicated")
+        cache.track_pod(self._foreign_pod(PodPhase.PENDING))
+        assert "n0" in cache.foreign
+
+    def test_resync_fingerprint_respects_shared_relevance(self):
+        # the agent stamps a fingerprint over the node's RUNNING pods; in
+        # Shared mode a bound-but-pending pod must not poison the expected
+        # fingerprint
+        from scheduler_plugins_tpu.api.objects import (
+            Container, Node, NodeResourceTopology, NUMAZone, Pod, PodPhase,
+        )
+        from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+        from scheduler_plugins_tpu.framework.cycle import _resync_nrt_cache
+        from scheduler_plugins_tpu.state.cluster import Cluster
+        from scheduler_plugins_tpu.state.nrt_cache import (
+            compute_pod_fingerprint,
+        )
+
+        gib = 1 << 30
+        cluster = Cluster()
+        cluster.add_node(Node(name="n0", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110}))
+        running = Pod(name="r0", phase=PodPhase.RUNNING,
+                      containers=[Container(requests={CPU: 100})])
+        running.node_name = "n0"
+        pending_bound = Pod(name="b0", phase=PodPhase.PENDING,
+                            containers=[Container(requests={CPU: 100})])
+        pending_bound.node_name = "n0"
+        cluster.add_pod(running)
+        cluster.add_pod(pending_bound)
+
+        cache = self._cache("Shared")
+        cluster.nrt_cache = cache
+        nrt0 = NodeResourceTopology(node_name="n0", zones=[
+            NUMAZone(numa_id=0, available={CPU: 4000, MEMORY: 16 * gib})])
+        cache.update_nrt(nrt0)
+        cache.mark_maybe_overreserved("n0")
+        # agent report fingerprinted over RUNNING pods only
+        nrt1 = NodeResourceTopology(node_name="n0", zones=[
+            NUMAZone(numa_id=0, available={CPU: 3000, MEMORY: 16 * gib})])
+        nrt1.pod_fingerprint = compute_pod_fingerprint({("default", "r0")})
+        cache.update_nrt(nrt1)
+        _resync_nrt_cache(cluster, now=0)
+        assert cache.nrts["n0"].zones[0].available[CPU] == 3000  # flushed
+
+    def test_informer_mode_flows_from_plugin_args(self):
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+
+        plugin = NodeResourceTopologyMatch(
+            cache_resync_period_seconds=5, cache={"informerMode": "Shared"}
+        )
+        cache = plugin.make_cache()
+        assert cache.informer_mode == "Shared"
