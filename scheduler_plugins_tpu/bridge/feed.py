@@ -656,6 +656,7 @@ class FeedClient:
 
     def close(self):
         self._file.close()
+        self._sock.close()
 
 
 class FramedFeedClient:
@@ -679,4 +680,5 @@ class FramedFeedClient:
 
     def close(self):
         self._file.close()
+        self._sock.close()
         self._sock.close()

@@ -16,8 +16,9 @@ fence with one {"op": "sync"} at the end. Resource-version fencing and the
 store lock are shared with any `FeedServer` attached to the same cluster
 when you pass its `lock`/`rv_table`.
 
-grpcio is an optional dependency: importing this module without it raises
-ImportError from `serve_grpc` only (the plain TCP feed keeps working).
+grpcio is an optional dependency: importing this module is always safe; the
+deferred `import grpc` raises ImportError only when constructing
+`GrpcFeedServer` / `GrpcFeedClient` (the plain TCP feed keeps working).
 """
 
 from __future__ import annotations

@@ -179,6 +179,16 @@ class Plugin:
         carry). See `ops.assign.waterfill_assign_stateful`."""
         return jnp.bool_(True)
 
+    def wave_capacity(self, state: SolverState, snap: ClusterSnapshot,
+                      active):
+        """(N,) per-node capacity ESTIMATE (in pods) under this plugin's
+        constraints for the current wave's active set, or None. Only steers
+        the waterfill's bucketing (how many queue-ranked pods are SENT to
+        each node) — admission stays exact via guards/validators — but a
+        tight estimate is what keeps a constrained wave from funneling pods
+        onto nodes that can only accept one."""
+        return None
+
     #: overridden (not None) when the plugin's hard filter must be
     #: re-validated pod-by-pod after the batched waterfill: the wave guard
     #: only sees same-NODE conflicts, but domain-counting constraints

@@ -144,6 +144,7 @@ def waterfill_assign_stateful(
     max_waves: int = 4,
     validate_fn=None,
     validate_commit_fn=None,
+    capacity_fns=(),
 ):
     """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
     filters (NUMA zone availability, network placement tallies): the carries
@@ -203,6 +204,12 @@ def waterfill_assign_stateful(
             ),
             axis=1,
         )
+        # plugin capacity refinements (NUMA zones, ...): bucketing must not
+        # send a node more pods than its tightest constraint can admit
+        for cap_fn in capacity_fns:
+            extra = cap_fn(state, active)
+            if extra is not None:
+                cap = jnp.minimum(cap, extra.astype(cap.dtype))
         cap = jnp.clip(cap, 0, P).astype(jnp.int32)
         ccap = jnp.cumsum(cap[order_n], dtype=jnp.int32)
         rank = jnp.cumsum(active, dtype=jnp.int32) - 1

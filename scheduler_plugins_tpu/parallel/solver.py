@@ -308,6 +308,14 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                     )
                 )
                 guard_demands.append(gdem)
+        capacity_fns = tuple(
+            (lambda state, active, _pl=plugin: _pl.wave_capacity(
+                state, snap, active
+            ))
+            for plugin in dyn_plugins
+            if type(plugin).wave_capacity
+            is not _PluginBase.wave_capacity
+        )
 
         from scheduler_plugins_tpu.ops.assign import waterfill_assign_stateful
 
@@ -323,6 +331,7 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             max_waves=max_waves,
             validate_fn=validate_fn,
             validate_commit_fn=validate_commit_fn,
+            capacity_fns=capacity_fns,
         )
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait

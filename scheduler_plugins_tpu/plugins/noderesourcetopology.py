@@ -324,6 +324,45 @@ class NodeResourceTopologyMatch(Plugin):
         deduct = jnp.where(snap.numa.reported, node_demand[:, None, :], 0)
         return state.replace(numa_avail=state.numa_avail - deduct)
 
+    def wave_capacity(self, state, snap, active):
+        """(N,) pods-per-node estimate under the pessimistic zone model:
+        every placement deducts from EVERY reported zone, so a node admits
+        at most floor(max_z avail[z, r] / mean_request_r) pods of the
+        active mix (min over requested resources). Steers waterfill
+        bucketing only — admission stays exact (wave guard)."""
+        if snap.numa is None:
+            return None
+        numa = snap.numa
+        pre = getattr(self, "_presolve", None)
+        reqq = (
+            pre["req"] if pre is not None
+            else numa_ops.scale_qty(snap.numa, snap.pods.req)
+        )
+        n_active = jnp.maximum(active.sum(), 1)
+        mean_req = (
+            jnp.sum(jnp.where(active[:, None], reqq, 0), axis=0) / n_active
+        )  # (R,) float
+        avail = self._numa_avail(state, snap)  # (N, Z, R)
+        reported = numa.reported & numa.zone_mask[:, :, None]
+        best_zone = jnp.max(
+            jnp.where(reported, avail, 0.0), axis=1
+        )  # (N, R)
+        per_r = jnp.where(
+            mean_req[None, :] > 0,
+            jnp.floor(best_zone / jnp.maximum(mean_req[None, :], 1e-9)),
+            jnp.inf,
+        )
+        cap = jnp.min(per_r, axis=1)
+        # clip while still FLOAT: a finite ratio above 2^31 (bytes-scale
+        # zone over a tiny mean request) would make the int32 convert
+        # undefined (wrap negative -> capacity 0 for the roomiest node)
+        cap = jnp.where(jnp.isfinite(cap), cap, float(snap.num_pods))
+        cap = jnp.clip(cap, 0.0, float(snap.num_pods)).astype(jnp.int32)
+        applies = numa.has_nrt & (
+            numa.policy == int(TopologyManagerPolicy.SINGLE_NUMA_NODE)
+        )
+        return jnp.where(applies, cap, snap.num_pods)
+
     def wave_guard_demand(self, snap):
         """Within-wave guard demand: the pod request in the live-availability
         quantity domain — what an earlier same-wave winner pessimistically

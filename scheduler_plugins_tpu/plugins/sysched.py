@@ -7,11 +7,15 @@ comes from the SeccompProfile CRs its containers reference; score =
     |hostSyscalls - podSyscalls|
     + sum over existing pods p on the node of |(host ∪ pod) - p|
 
-Lower is better (DefaultNormalizeScore reversed). Pods without any profile
-score a huge constant on every node (the reference returns math.MaxInt64 —
-clamped here to 2^53 so the normalize multiply cannot overflow int64, which
-in Go silently wraps); after reverse-normalization all nodes come out equal,
-so placement is unaffected.
+Lower is better (DefaultNormalizeScore reversed). Profile resolution
+(sysched.go:124-210, lowered in state.snapshot._build_syscalls): container
+SeccompProfile references (bare name, ns/name, or localhost path) merged
+with the first SPO auto-annotation; a pod resolving NOTHING falls back to
+the configured default all-syscalls CR, and only when that is absent too
+does it score a huge constant on every node (the reference returns
+math.MaxInt64 — clamped here to 2^53 so the normalize multiply cannot
+overflow int64, which in Go silently wraps); after reverse-normalization
+all nodes come out equal, so placement is unaffected.
 
 The per-existing-pod sum uses the SyscallState decomposition (see
 state.snapshot.SyscallState): pod_count * |newHost| - sum_s newHost[s]*counts.
@@ -35,6 +39,16 @@ class SySched(Plugin):
         # defaults.go:246-256
         self.default_profile_namespace = default_profile_namespace
         self.default_profile_name = default_profile_name
+
+    def configure_cluster(self, cluster):
+        """Install the default-profile fallback into the snapshot build: a
+        pod resolving NO profile takes the configured all-syscalls CR's set
+        (sysched.go:198-208); only when that CR is absent too does the pod
+        score the MaxInt64-equivalent."""
+        if cluster is not None:
+            cluster.sysched_default_profile = (
+                f"{self.default_profile_namespace}/{self.default_profile_name}"
+            )
 
     def score(self, state, snap, p):
         if snap.syscalls is None:

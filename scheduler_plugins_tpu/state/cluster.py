@@ -461,5 +461,8 @@ class Cluster:
             seccomp_profiles=list(self.seccomp_profiles.values()),
             native_nodes=native_exports,
             tlp_prediction=self.tlp_prediction,
+            sysched_default_profile=getattr(
+                self, "sysched_default_profile", None
+            ),
             **kwargs,
         )

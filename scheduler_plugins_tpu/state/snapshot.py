@@ -344,6 +344,7 @@ def build_snapshot(
     seccomp_profiles: Sequence = (),
     native_nodes: Optional[dict] = None,
     tlp_prediction: tuple = (1.5, 1000),
+    sysched_default_profile: Optional[str] = None,
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -790,7 +791,8 @@ def build_snapshot(
         if app_groups
         else None,
         syscalls=_build_syscalls(
-            seccomp_profiles, pending_pods, assigned_pods, node_pos, N, P
+            seccomp_profiles, pending_pods, assigned_pods, node_pos, N, P,
+            default_profile=sysched_default_profile,
         )
         if seccomp_profiles
         else None,
@@ -901,10 +903,35 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
     )
 
 
-def _build_syscalls(profiles, pending_pods, assigned_pods, node_pos, N, P):
+#: pod annotations whose key contains this mark carry an SPO profile path
+#: (sysched.go SPO_ANNOTATION)
+SPO_ANNOTATION = "seccomp.security.alpha.kubernetes.io"
+
+
+def parse_profile_path(path: str):
+    """parseNameNS (sysched.go:67-83): namespace = second-to-last path
+    segment, name = last segment minus extension; <2 segments = invalid."""
+    if not path:
+        return None
+    parts = path.split("/")
+    if len(parts) < 2:
+        return None
+    name = parts[-1]
+    if "." in name:
+        name = name[: name.rindex(".")]
+    return f"{parts[-2]}/{name}"
+
+
+def _build_syscalls(
+    profiles, pending_pods, assigned_pods, node_pos, N, P,
+    default_profile=None,
+):
     """Lower seccomp profiles + pod references into SyscallState
-    (/root/reference/pkg/sysched/sysched.go:124-210: pod syscall set = union
-    of its containers' SeccompProfile CRs; empty = unconfined)."""
+    (/root/reference/pkg/sysched/sysched.go:124-210): pod syscall set =
+    union of (container SeccompProfile references) + (the first SPO
+    annotation's profile); pods resolving NO syscalls fall back to the
+    configured default profile (the all-syscalls CR), and only when that
+    too is missing does the plugin score them MaxInt64-equivalent."""
     by_name = {}
     universe: list[str] = []
     pos: dict[str, int] = {}
@@ -916,16 +943,40 @@ def _build_syscalls(profiles, pending_pods, assigned_pods, node_pos, N, P):
                 universe.append(sc)
     S = max(len(universe), 1)
 
+    def resolve(ref, namespace):
+        if not ref:
+            return None
+        if ref.count("/") >= 2 or ref.endswith(".json"):
+            # localhost profile path (operator/<ns>/<name>.json)
+            ref = parse_profile_path(ref)
+        elif "/" not in ref:
+            # bare names resolve in the pod's own namespace
+            ref = f"{namespace}/{ref}"
+        return by_name.get(ref) if ref else None
+
     def pod_set(pod):
         vec = np.zeros(S, bool)
         found = False
         for cont in list(pod.containers) + list(pod.init_containers):
-            ref = cont.seccomp_profile
-            if ref and "/" not in ref:
-                # bare names resolve in the pod's own namespace
-                ref = f"{pod.namespace}/{ref}"
-            prof = by_name.get(ref) if ref else None
+            prof = resolve(cont.seccomp_profile, pod.namespace)
             if prof is not None:
+                found = True
+                for sc in prof.syscalls:
+                    vec[pos[sc]] = True
+        # SPO auto-annotation: the reference merges the FIRST seccomp
+        # annotation then breaks (sysched.go:171-196); Go map order is
+        # random — we pin sorted key order for determinism
+        for key in sorted(pod.annotations):
+            if SPO_ANNOTATION in key:
+                prof = resolve(pod.annotations[key], pod.namespace)
+                if prof is not None:
+                    found = True
+                    for sc in prof.syscalls:
+                        vec[pos[sc]] = True
+                break
+        if not found and default_profile is not None:
+            prof = by_name.get(default_profile)
+            if prof is not None and prof.syscalls:
                 found = True
                 for sc in prof.syscalls:
                     vec[pos[sc]] = True

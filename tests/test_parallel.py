@@ -255,3 +255,41 @@ class TestBatchedStateDependentFilters:
         seq = sched.solve(snap2)
         n_seq = int((np.asarray(seq.assignment)[:P] >= 0).sum())
         assert int((an[:P] >= 0).sum()) == n_seq
+
+
+class TestMultiHostLaunch:
+    """Single-process degenerate path of the multi-host recipe
+    (parallel/launch.py); the driver's dryrun exercises the mesh itself."""
+
+    def test_initialize_single_process_noop(self):
+        from scheduler_plugins_tpu.parallel import launch
+
+        assert launch.initialize() is False
+
+    def test_multihost_mesh_falls_back_locally(self):
+        from scheduler_plugins_tpu.parallel import launch
+
+        mesh = launch.make_multihost_mesh()
+        assert set(mesh.axis_names) == {"pods", "nodes"}
+
+    def test_distributed_solve_matches_local(self):
+        import jax
+        from scheduler_plugins_tpu.parallel import launch
+        from scheduler_plugins_tpu.parallel import make_mesh
+
+        c = Cluster()
+        for i in range(8):
+            c.add_node(Node(name=f"n{i}", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 20}))
+        for j in range(32):
+            c.add_pod(Pod(name=f"p{j}", creation_ms=j,
+                          containers=[Container(requests={CPU: 900, MEMORY: gib})]))
+        snap, meta = c.snapshot(
+            sorted(c.pending_pods(), key=lambda p: p.creation_ms),
+            pad_nodes=8, pad_pods=32,
+        )
+        weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+        snap_b = launch.broadcast_snapshot(snap)  # identity single-process
+        mesh = launch.make_multihost_mesh()
+        an = launch.distributed_solve(snap_b, mesh, weights)
+        a_local, _, _ = solve(snap, weights)
+        assert an.tolist() == np.asarray(a_local).tolist()

@@ -41,3 +41,153 @@ class TestSySched:
         c.add_pod(prof_pod("plain", None))
         r = run_cycle(Scheduler(Profile(plugins=[SySched()])), c, now=1000)
         assert "default/plain" in r.bound
+
+
+class TestProfileResolution:
+    """getSyscalls resolution paths (sysched.go:124-210) + parseNameNS
+    (sysched.go:67-83) vectors."""
+
+    def test_parse_profile_path(self):
+        from scheduler_plugins_tpu.state.snapshot import parse_profile_path
+
+        assert parse_profile_path("localhost/operator/default/z-seccomp.json") \
+            == "default/z-seccomp"
+        assert parse_profile_path("operator/prod/web.json") == "prod/web"
+        assert parse_profile_path("prod/web") == "prod/web"
+        assert parse_profile_path("web") is None  # <2 segments (ref returns "","")
+        assert parse_profile_path("") is None
+
+    def _cluster(self):
+        c = Cluster()
+        for n in ("a", "b"):
+            c.add_node(Node(name=n, allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+        c.add_seccomp_profile(SeccompProfile(
+            name="z-seccomp", syscalls=frozenset({"read", "write"})))
+        c.add_seccomp_profile(SeccompProfile(
+            name="x-seccomp", syscalls=frozenset({"read", "write", "open", "close"})))
+        c.add_seccomp_profile(SeccompProfile(
+            name="all-syscalls", syscalls=frozenset({"read", "write", "open",
+                                                     "close", "mmap", "fork"})))
+        return c
+
+    def _snap_sets(self, c, pod):
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[SySched()]))
+        pending = sched.sort_pending(c.pending_pods(), c)
+        snap, meta = c.snapshot(pending, now_ms=0)
+        import numpy as np
+        i = meta.pod_names.index(pod.uid)
+        return (int(np.asarray(snap.syscalls.pod_sets[i]).sum()),
+                bool(np.asarray(snap.syscalls.has_profile[i])))
+
+    def test_annotation_resolution(self):
+        c = self._cluster()
+        for p in c.pods.values():
+            pass
+        # SySched.configure_cluster runs inside run_cycle; emulate via snapshot
+        c.sysched_default_profile = "default/all-syscalls"
+        pod = Pod(name="p", containers=[Container(requests={CPU: 100})],
+                  annotations={"container.seccomp.security.alpha.kubernetes.io/c":
+                               "localhost/operator/default/z-seccomp.json"})
+        n, has = self._snap_sets(c, pod)
+        assert (n, has) == (2, True)
+
+    def test_localhost_path_in_container_ref(self):
+        c = self._cluster()
+        c.sysched_default_profile = "default/all-syscalls"
+        pod = Pod(name="p", containers=[Container(
+            requests={CPU: 100},
+            seccomp_profile="localhost/operator/default/x-seccomp.json")])
+        n, has = self._snap_sets(c, pod)
+        assert (n, has) == (4, True)
+
+    def test_empty_security_context_gets_default_full_profile(self):
+        # mirrors TestGetSyscalls "Pod with empty SecurityContext":
+        # resolution falls back to the all-syscalls default CR
+        c = self._cluster()
+        c.sysched_default_profile = "default/all-syscalls"
+        pod = Pod(name="p", containers=[Container(requests={CPU: 100})])
+        n, has = self._snap_sets(c, pod)
+        assert (n, has) == (6, True)
+
+    def test_missing_default_profile_means_unprofiled(self):
+        c = self._cluster()
+        c.sysched_default_profile = "default/not-there"
+        pod = Pod(name="p", containers=[Container(requests={CPU: 100})])
+        n, has = self._snap_sets(c, pod)
+        assert (n, has) == (0, False)
+
+    def test_configure_cluster_installs_default(self):
+        c = self._cluster()
+        pod = Pod(name="p", containers=[Container(requests={CPU: 100})])
+        c.add_pod(pod)
+        r = run_cycle(Scheduler(Profile(plugins=[SySched(
+            default_profile_namespace="default",
+            default_profile_name="all-syscalls")])), c, now=1000)
+        assert c.sysched_default_profile == "default/all-syscalls"
+        assert "default/p" in r.bound
+
+
+class TestScoreVectors:
+    """TestScore / TestNormalizeScore vectors (sysched_test.go:344-449)."""
+
+    def _cluster_with_existing(self):
+        c = Cluster()
+        for n in ("test", "other"):
+            c.add_node(Node(name=n, allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110}))
+        # z-seccomp subset of x-seccomp with 2 extra syscalls, as in the ref
+        c.add_seccomp_profile(SeccompProfile(
+            name="z-seccomp", syscalls=frozenset({"read", "write"})))
+        c.add_seccomp_profile(SeccompProfile(
+            name="x-seccomp", syscalls=frozenset({"read", "write", "open", "close"})))
+        existing = Pod(name="existing", containers=[Container(
+            requests={CPU: 100}, seccomp_profile="z-seccomp")])
+        existing.node_name = "test"
+        c.add_pod(existing)
+        return c
+
+    def _scores(self, c, pod):
+        import numpy as np
+        import jax.numpy as jnp
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[SySched()]))
+        pending = sched.sort_pending(c.pending_pods(), c)
+        snap, meta = c.snapshot(pending, now_ms=0)
+        sched.prepare(meta, c)
+        plugin = sched.profile.plugins[0]
+        plugin.bind_aux(plugin.aux())
+        plugin.bind_presolve(None)
+        state = sched.initial_state(snap)
+        i = meta.pod_names.index(pod.uid)
+        raw = np.asarray(plugin.score(state, snap, i))
+        return {meta.node_names[n]: int(raw[n])
+                for n in range(len(meta.node_names))}
+
+    def test_score_difference_is_two(self):
+        # x-seccomp pod onto the z-seccomp host: |host-pod|=0 (host subset),
+        # existing pod sees |(host∪pod)-z|=2 -> total 2 (ref expected: 2)
+        c = self._cluster_with_existing()
+        pod = Pod(name="pod", containers=[Container(
+            requests={CPU: 100}, seccomp_profile="x-seccomp")])
+        s = self._scores(c, pod)
+        assert s["test"] == 2
+        assert s["other"] == 0  # empty host scores zero (sysched.go:255-259)
+
+    def test_score_same_is_zero(self):
+        c = self._cluster_with_existing()
+        pod = Pod(name="pod", containers=[Container(
+            requests={CPU: 100}, seccomp_profile="z-seccomp")])
+        s = self._scores(c, pod)
+        assert s["test"] == 0
+
+    def test_normalize_vectors(self):
+        # DefaultNormalizeScore reversed: [100,200] -> [50,0]; [0,200] -> [100,0]
+        import jax.numpy as jnp
+        import numpy as np
+        from scheduler_plugins_tpu.ops.normalize import default_normalize
+
+        mask = jnp.ones(2, bool)
+        out = default_normalize(jnp.asarray([100, 200]), mask, reverse=True)
+        assert np.asarray(out).tolist() == [50, 0]
+        out = default_normalize(jnp.asarray([0, 200]), mask, reverse=True)
+        assert np.asarray(out).tolist() == [100, 0]
