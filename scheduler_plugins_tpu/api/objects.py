@@ -536,6 +536,11 @@ class NodeResourceTopology:
     #: pod fingerprint stamped by the node agent, validated by the
     #: over-reserve cache resync (/root/reference/pkg/noderesourcetopology/cache/overreserve.go:276-348).
     pod_fingerprint: str = ""
+    #: the agent's fingerprint method attribute (podfingerprint
+    #: AttributeMethod): "" / "all" = every pod; "with-exclusive-resources"
+    #: = only pods pinning cpus/devices were fingerprinted — the resync's
+    #: scheduler-side computation must match (store.go:204-222).
+    pod_fingerprint_method: str = ""
 
 
 # ---------------------------------------------------------------------------

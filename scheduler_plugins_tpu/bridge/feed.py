@@ -409,6 +409,9 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
                 scope=TopologyManagerScope(int(event.get("scope", 0))),
                 max_numa_nodes=int(event.get("max_numa_nodes", 8)),
                 pod_fingerprint=event.get("pod_fingerprint", ""),
+                pod_fingerprint_method=event.get(
+                    "pod_fingerprint_method", ""
+                ),
                 zones=[
                     NUMAZone(
                         numa_id=int(z["numa_id"]),
@@ -680,5 +683,4 @@ class FramedFeedClient:
 
     def close(self):
         self._file.close()
-        self._sock.close()
         self._sock.close()

@@ -212,7 +212,14 @@ class Scheduler:
             # time there buys nothing. The bench environment exposes the TPU
             # through a tunneled backend whose platform name is "axon", so
             # gate on device kind, not the backend name alone.
-            unroll = 8 if _is_tpu_backend() else 1
+            # SPT_SCAN_UNROLL overrides for tuning.
+            import os
+
+            unroll = int(
+                os.environ.get(
+                    "SPT_SCAN_UNROLL", 8 if _is_tpu_backend() else 1
+                )
+            )
             state, (assignment, admitted) = jax.lax.scan(
                 lambda c, p: step(c, p, snap), state0, jnp.arange(P),
                 unroll=unroll,

@@ -111,6 +111,7 @@ class NodeResourceTopologyMatch(Plugin):
             self.cache_resync_period_seconds,
             self.cache_foreign_pods_detect,
             self.cache_informer_mode,
+            self.cache_resync_method,
         )
 
     def make_cache(self):
@@ -125,6 +126,7 @@ class NodeResourceTopologyMatch(Plugin):
         cache = caches.OverReserveCache(
             foreign_pods_detect=self.cache_foreign_pods_detect,
             informer_mode=self.cache_informer_mode,
+            resync_method=self.cache_resync_method,
         )
         cache.resync_period_ms = self.cache_resync_period_seconds * 1000
         return cache
@@ -347,8 +349,12 @@ class NodeResourceTopologyMatch(Plugin):
         best_zone = jnp.max(
             jnp.where(reported, avail, 0.0), axis=1
         )  # (N, R)
+        # a resource NO zone reports does not constrain the zone fit (the
+        # exact filter's host-level bypass, feasible_zones_from_suitable) —
+        # it must not zero the estimate either
+        has_affinity = jnp.any(reported, axis=1)  # (N, R)
         per_r = jnp.where(
-            mean_req[None, :] > 0,
+            (mean_req[None, :] > 0) & has_affinity,
             jnp.floor(best_zone / jnp.maximum(mean_req[None, :], 1e-9)),
             jnp.inf,
         )

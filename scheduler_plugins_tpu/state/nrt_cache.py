@@ -158,6 +158,12 @@ class OverReserveCache(NrtCache):
     #: (apis/config defaults: ForeignPodsDetect=All;
     #: resourcerequests/exclusive.go:47-95)
     foreign_pods_detect: str = "All"
+    #: Cache.ResyncMethod (store.go:204-222 podFingerprintForNodeTopology):
+    #: which pods enter the expected-fingerprint computation. "All" = every
+    #: known pod; "OnlyExclusiveResources" = only pods pinning cpus/devices;
+    #: "Autodetect" (default) = follow the agent's stamped method attribute
+    #: per NRT (pod_fingerprint_method == "with-exclusive-resources").
+    resync_method: str = "Autodetect"
     #: Cache.InformerMode (podprovider/podprovider.go:37-93): which pod
     #: events the cache's pod view (fingerprints, foreign tracking) sees.
     #: "Dedicated" (reference default for this cache) = every bound pod;
@@ -288,7 +294,18 @@ class OverReserveCache(NrtCache):
                 # reference reads the pod lister; a deleted pod must not
                 # block convergence). Config-changed nodes flush
                 # unconditionally (overreserve.go separate ConfigChanged loop).
-                known = {(p.namespace, p.name) for p in node_pods.get(node, [])}
+                # ResyncMethod narrows which pods enter the computation to
+                # match how the agent fingerprinted (store.go:204-250):
+                only_excl = self.resync_method == "OnlyExclusiveResources" or (
+                    self.resync_method == "Autodetect"
+                    and candidate.pod_fingerprint_method
+                    == "with-exclusive-resources"
+                )
+                known = {
+                    (p.namespace, p.name)
+                    for p in node_pods.get(node, [])
+                    if not only_excl or uses_exclusive_resources(p)
+                }
                 expected = compute_pod_fingerprint(known)
                 if not candidate.pod_fingerprint:
                     continue  # no fingerprint data: refuse (overreserve.go:306-310)

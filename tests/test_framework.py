@@ -391,3 +391,80 @@ class TestPerPodPermitDeadlines:
         r = run_cycle(sched, cluster, now=10_999)
         assert not r.expired_gangs
         assert len(cluster.reserved) == 1
+
+
+gib = 1 << 30
+
+
+class TestQueueSortLessVectors:
+    """TestLess (coscheduling_test.go:188-439) + QOSSort Less
+    (qos/queue_sort.go:46-81) ordering vectors through sort_pending."""
+
+    def _order(self, pods, cluster=None, plugins=None):
+        from scheduler_plugins_tpu.plugins import Coscheduling
+
+        sched = Scheduler(Profile(plugins=plugins or [Coscheduling()]))
+        return [p.name for p in sched.sort_pending(pods, cluster)]
+
+    def test_priority_desc(self):
+        a = Pod(name="p1", namespace="ns1", priority=10)
+        b = Pod(name="p2", namespace="ns2", priority=100)
+        assert self._order([a, b]) == ["p2", "p1"]
+
+    def test_equal_priority_creation_time(self):
+        a = Pod(name="p1", namespace="ns1", priority=100, creation_ms=1000)
+        b = Pod(name="p2", namespace="ns2", priority=100, creation_ms=2000)
+        assert self._order([b, a]) == ["p1", "p2"]
+
+    def test_gang_member_uses_pod_group_creation_time(self):
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL, PodGroup,
+        )
+
+        c = Cluster()
+        c.add_pod_group(PodGroup(name="pg1", namespace="ns1", min_member=1,
+                                 creation_ms=500))
+        a = Pod(name="p1", namespace="ns1", priority=100, creation_ms=3000,
+                labels={POD_GROUP_LABEL: "pg1"})
+        b = Pod(name="p2", namespace="ns2", priority=100, creation_ms=1000)
+        # pg creation (500) beats plain pod creation (1000) despite the
+        # member pod being newer
+        assert self._order([b, a], c) == ["p1", "p2"]
+
+    def test_same_gang_ties_break_on_group_name_stably(self):
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL, PodGroup,
+        )
+
+        c = Cluster()
+        c.add_pod_group(PodGroup(name="pg1", namespace="ns1", min_member=2,
+                                 creation_ms=500))
+        a = Pod(name="z", namespace="ns1", priority=100, creation_ms=9,
+                labels={POD_GROUP_LABEL: "pg1"})
+        b = Pod(name="a", namespace="ns1", priority=100, creation_ms=8,
+                labels={POD_GROUP_LABEL: "pg1"})
+        # same key tuple -> python stable sort preserves input order (the
+        # upstream comparator also treats same-group pods as equal here)
+        assert self._order([a, b], c) == ["z", "a"]
+
+    def test_qos_orders_within_priority(self):
+        from scheduler_plugins_tpu.plugins import QOSSort
+
+        guaranteed = Pod(name="g", creation_ms=3, containers=[Container(
+            requests={CPU: 100, MEMORY: gib},
+            limits={CPU: 100, MEMORY: gib})])
+        burstable = Pod(name="b", creation_ms=2, containers=[Container(
+            requests={CPU: 100})])
+        besteffort = Pod(name="e", creation_ms=1, containers=[Container()])
+        order = self._order([besteffort, burstable, guaranteed],
+                            plugins=[QOSSort()])
+        assert order == ["g", "b", "e"]
+
+    def test_qos_priority_still_dominates(self):
+        from scheduler_plugins_tpu.plugins import QOSSort
+
+        hi = Pod(name="hi", priority=10, containers=[Container()])
+        lo = Pod(name="lo", priority=1, containers=[Container(
+            requests={CPU: 100, MEMORY: gib},
+            limits={CPU: 100, MEMORY: gib})])
+        assert self._order([lo, hi], plugins=[QOSSort()]) == ["hi", "lo"]

@@ -558,3 +558,43 @@ class TestNativeStoreGate:
         c.remove_pod("default/e")
         c.remove_pod("default/p")
         assert not c._selector_spec_pods
+
+
+class TestWaveCapacityHostLevelBypass:
+    def test_host_level_request_does_not_zero_capacity(self):
+        # ephemeral-storage is host-level: zones never report it; the
+        # batched NUMA capacity estimate must not starve such nodes
+        import jax.numpy as jnp
+        from scheduler_plugins_tpu.api.resources import EPHEMERAL_STORAGE
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+        from scheduler_plugins_tpu.plugins import (
+            NodeResourcesAllocatable,
+            NodeResourceTopologyMatch,
+        )
+        from scheduler_plugins_tpu.api.objects import (
+            NodeResourceTopology, NUMAZone, TopologyManagerPolicy,
+            TopologyManagerScope,
+        )
+
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={
+            CPU: 8000, MEMORY: 64 * gib, EPHEMERAL_STORAGE: 100 * gib,
+            PODS: 110}))
+        c.add_nrt(NodeResourceTopology(
+            node_name="n0",
+            zones=[NUMAZone(numa_id=z, available={CPU: 4000, MEMORY: 24 * gib})
+                   for z in range(2)],
+            policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+            scope=TopologyManagerScope.CONTAINER))
+        for j in range(2):
+            c.add_pod(Pod(name=f"p{j}", creation_ms=j, containers=[Container(
+                requests={CPU: 1000, MEMORY: 2 * gib, EPHEMERAL_STORAGE: gib},
+                limits={CPU: 1000, MEMORY: 2 * gib, EPHEMERAL_STORAGE: gib})]))
+        sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable(),
+                                           NodeResourceTopologyMatch()]))
+        pending = sched.sort_pending(c.pending_pods(), c)
+        snap, meta = c.snapshot(pending, now_ms=0)
+        sched.prepare(meta, c)
+        an = np.asarray(profile_batch_solve(sched, snap)[0])[: len(pending)]
+        assert (an >= 0).all(), an.tolist()

@@ -260,3 +260,63 @@ class TestInformerModes:
         )
         cache = plugin.make_cache()
         assert cache.informer_mode == "Shared"
+
+
+class TestResyncMethod:
+    """podFingerprintForNodeTopology (store.go:204-250): which pods enter
+    the expected-fingerprint computation per ResyncMethod x agent attribute."""
+
+    def _setup(self, method, agent_method=""):
+        from scheduler_plugins_tpu.api.objects import (
+            Container, NodeResourceTopology, NUMAZone, Pod,
+        )
+        from scheduler_plugins_tpu.state.nrt_cache import (
+            OverReserveCache, compute_pod_fingerprint,
+        )
+
+        cache = OverReserveCache(resync_method=method)
+        nrt0 = NodeResourceTopology(node_name="n0", zones=[
+            NUMAZone(numa_id=0, available={"cpu": 4000, "memory": 1 << 30})])
+        cache.update_nrt(nrt0)
+        cache.mark_maybe_overreserved("n0")
+        # exclusive pod: guaranteed with integral CPU; shared pod: burstable
+        excl = Pod(name="excl", containers=[Container(
+            requests={"cpu": 2000, "memory": 1 << 20},
+            limits={"cpu": 2000, "memory": 1 << 20})])
+        excl.node_name = "n0"
+        shared = Pod(name="shared", containers=[Container(requests={"cpu": 100})])
+        shared.node_name = "n0"
+        nrt1 = NodeResourceTopology(
+            node_name="n0",
+            zones=[NUMAZone(numa_id=0, available={"cpu": 2000, "memory": 1 << 30})],
+            pod_fingerprint=compute_pod_fingerprint({("default", "excl")}),
+            pod_fingerprint_method=agent_method,
+        )
+        cache.update_nrt(nrt1)
+        return cache, [excl, shared]
+
+    def test_only_exclusive_matches_agent_exclusive_fingerprint(self):
+        cache, pods = self._setup("OnlyExclusiveResources")
+        assert cache.resync({"n0": pods}) == ["n0"]  # shared pod excluded
+
+    def test_all_mismatches_agent_exclusive_fingerprint(self):
+        cache, pods = self._setup("All")
+        assert cache.resync({"n0": pods}) == []  # both pods fingerprinted
+
+    def test_autodetect_follows_agent_attribute(self):
+        cache, pods = self._setup(
+            "Autodetect", agent_method="with-exclusive-resources")
+        assert cache.resync({"n0": pods}) == ["n0"]
+
+    def test_autodetect_defaults_to_all_pods(self):
+        cache, pods = self._setup("Autodetect")
+        assert cache.resync({"n0": pods}) == []
+
+    def test_method_flows_from_plugin_args(self):
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+
+        plugin = NodeResourceTopologyMatch(
+            cache_resync_period_seconds=5,
+            cache={"resyncMethod": "OnlyExclusiveResources"},
+        )
+        assert plugin.make_cache().resync_method == "OnlyExclusiveResources"

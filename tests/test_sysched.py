@@ -82,8 +82,6 @@ class TestProfileResolution:
 
     def test_annotation_resolution(self):
         c = self._cluster()
-        for p in c.pods.values():
-            pass
         # SySched.configure_cluster runs inside run_cycle; emulate via snapshot
         c.sysched_default_profile = "default/all-syscalls"
         pod = Pod(name="p", containers=[Container(requests={CPU: 100})],

@@ -218,3 +218,118 @@ class TestTrimaranCycle:
         # after the reporting interval it ages out
         snap2, _ = c.snapshot(c.pending_pods(), now_ms=70_000)
         assert int(snap2.metrics.missing_cpu_millis[cold]) == 0
+
+
+class TestComputeScoreVectors:
+    """The reference's computeScore table (analysis_test.go:30-140) run
+    verbatim through _risk_component (values converted to the % domain the
+    snapshot carries)."""
+
+    CASES = [
+        # (margin, sensitivity, capacity, req, used_avg, used_stdev, want)
+        (1, 1, 100, 10, 40, 36, 57),
+        (1, 2, 0, 10, 40, 36, 0),        # zero capacity
+        (1, 2, 100, 10, -40, 36, 65),    # negative usedAvg
+        (1, 2, 100, 10, 200, 36, 20),    # large usedAvg
+        (1, 2, 100, 10, 40, -36, 75),    # negative usedStdev
+        (1, 2, 100, 10, 40, 120, 25),    # large usedStdev
+        (-1, 1, 100, 10, 40, 36, 75),    # negative margin
+        (1, -1, 100, 10, 40, 36, 57),    # negative sensitivity: pow skipped
+        (1, 0, 100, 10, 40, 36, 75),     # zero sensitivity: sigma -> 0
+    ]
+
+    def test_vectors(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from scheduler_plugins_tpu.ops.trimaran import _risk_component
+
+        for margin, sens, cap, req, avg, std, want in self.CASES:
+            c = max(cap, 1)
+            got = _risk_component(
+                jnp.asarray([avg / c * 100.0]),
+                jnp.asarray([std / c * 100.0]),
+                jnp.asarray([cap], jnp.int64),
+                jnp.asarray([req], jnp.float64),
+                float(margin),
+                float(sens),
+            )
+            got = int(round(float(np.asarray(got)[0])))
+            assert got == want, (margin, sens, cap, req, avg, std, got, want)
+
+
+class TestGetMuSigmaVectors:
+    """GetMuSigma clamp table (resourcestats_test.go TestGetMuSigma),
+    expressed through _risk_component with margin=1, sensitivity=1 so
+    score = (1 - (mu + sigma)/2) * 100."""
+
+    def _score(self, cap, req, avg, std):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from scheduler_plugins_tpu.ops.trimaran import _risk_component
+
+        c = max(cap, 1)
+        got = _risk_component(
+            jnp.asarray([avg / c * 100.0]), jnp.asarray([std / c * 100.0]),
+            jnp.asarray([cap], jnp.int64), jnp.asarray([req], jnp.float64),
+            1.0, 1.0,
+        )
+        return float(np.asarray(got)[0])
+
+    def test_proper(self):
+        # mu=0.5 sigma=0.36 -> 57
+        assert round(self._score(1000, 100, 400, 360)) == 57
+
+    def test_zero(self):
+        assert self._score(0, 0, 0, 0) == 0.0
+
+    def test_large_used_clamps_mu_to_one(self):
+        # mu clamped 1.0, sigma 0.3 -> (1-(1.3/2))*100 = 35
+        assert round(self._score(1000, 100, 1400, 300)) == 35
+
+    def test_large_deviation_clamps_sigma_to_one(self):
+        # mu 0.5, sigma clamped 1.0 -> 25
+        assert round(self._score(1000, 100, 400, 1600)) == 25
+
+
+class TestTLPReferenceVectors:
+    """TestTargetLoadPackingScoring (targetloadpacking_test.go:118-240)
+    vectors through tlp_score: 1000m node, default target 40."""
+
+    def _score(self, cpu_pct, valid, pod_millis, missing=0):
+        import jax.numpy as jnp
+        import numpy as np
+
+        s = tlp_score(
+            jnp.asarray([float(cpu_pct)]),
+            jnp.asarray([valid]),
+            jnp.asarray([missing], jnp.int64),
+            jnp.asarray([1000], jnp.int64),
+            jnp.asarray([pod_millis], jnp.int64),
+            target_pct=40.0,
+        )
+        return int(np.asarray(s)[0])
+
+    def test_new_node_scores_target(self):
+        # empty pod (predicted 0) on an idle node -> score == target (40)
+        assert self._score(0, True, 0) == 40
+
+    def test_hot_node_falling_edge(self):
+        # measured 50% (target+10), empty pod -> 40*(100-50)/60 = 33
+        assert self._score(50, True, 0) == 33
+
+    def test_excess_utilization_min_score(self):
+        # measured 30% + 1000m pod on 1000m node -> predicted 130% -> 0
+        assert self._score(30, True, 1000) == 0
+
+    def test_no_metrics_min_score(self):
+        assert self._score(0, False, 0) == 0
+
+    def test_rising_edge_peaks_at_target(self):
+        # predicted exactly at target -> max score 100
+        assert self._score(0, True, 400) == 100
+
+    def test_missing_cache_compensation_counts(self):
+        # 0% measured but 400m recently bound & unreported -> predicted 40%
+        assert self._score(0, True, 0, missing=400) == 100
