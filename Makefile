@@ -1,0 +1,29 @@
+# Developer entry points (the reference's Makefile/hack scripts equivalent:
+# /root/reference/Makefile:47-107 unit-test / integration-test / verify).
+
+PY ?= python
+
+.PHONY: test
+test:
+	$(PY) -m pytest tests/ -x -q
+
+.PHONY: bench
+bench:
+	$(PY) bench.py
+
+.PHONY: bench-all
+bench-all:
+	for c in 1 2 3 4 5; do $(PY) bench.py --config $$c || exit 1; done
+
+.PHONY: multichip
+multichip:
+	$(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+.PHONY: verify
+verify: test multichip
+
+.PHONY: native
+native:
+	g++ -O2 -std=c++17 -shared -fPIC \
+		-o scheduler_plugins_tpu/bridge/libsnapshot_store.so \
+		scheduler_plugins_tpu/bridge/snapshot_store.cc

@@ -78,7 +78,7 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
     "PreemptionToleration": {},
     "PodState": {},
     "QOSSort": {},
-    "NodeAffinity": {},
+    "NodeAffinity": {"addedAffinity": "added_affinity"},
     "TaintToleration": {},
     "PodTopologySpread": {},
     "InterPodAffinity": {},

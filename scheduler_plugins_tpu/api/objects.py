@@ -199,6 +199,25 @@ class NodeSelectorTerm:
             r.matches({"metadata.name": node.name}) for r in self.match_fields
         )
 
+    @classmethod
+    def from_wire(cls, spec: Mapping) -> "NodeSelectorTerm":
+        """The one parser for the wire shape ({"match_expressions":
+        [{"key","operator","values"}], "match_fields": [...]}) — used by the
+        feed protocol and config args alike (JSON-null tolerant)."""
+
+        def req(r):
+            return NodeSelectorRequirement(
+                key=r["key"], operator=r["operator"],
+                values=tuple(r.get("values") or ()),
+            )
+
+        return cls(
+            match_expressions=[
+                req(r) for r in spec.get("match_expressions") or []
+            ],
+            match_fields=[req(r) for r in spec.get("match_fields") or []],
+        )
+
 
 @dataclass
 class PreferredSchedulingTerm:

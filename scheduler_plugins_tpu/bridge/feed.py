@@ -91,7 +91,6 @@ from scheduler_plugins_tpu.api.objects import (
     NetworkTopology,
     Node,
     NodeResourceTopology,
-    NodeSelectorRequirement,
     NodeSelectorTerm,
     NUMAZone,
     Pod,
@@ -125,19 +124,7 @@ def _container(spec: dict) -> Container:
     )
 
 
-def _node_term(spec: dict) -> NodeSelectorTerm:
-    def req(r):
-        return NodeSelectorRequirement(
-            key=r["key"], operator=r["operator"],
-            values=tuple(r.get("values", ())),
-        )
-
-    return NodeSelectorTerm(
-        match_expressions=[
-            req(r) for r in spec.get("match_expressions") or []
-        ],
-        match_fields=[req(r) for r in spec.get("match_fields") or []],
-    )
+_node_term = NodeSelectorTerm.from_wire
 
 
 def _label_selector(spec: Optional[dict]) -> Optional[LabelSelector]:

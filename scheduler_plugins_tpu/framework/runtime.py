@@ -126,7 +126,7 @@ class Scheduler:
             if hasattr(plugin, "prepare_cluster"):
                 plugin.prepare_cluster(meta, cluster)
 
-    def _make_solve(self):
+    def _make_solve(self, unroll: int):
         plugins = tuple(self.profile.plugins)
 
         def step(carry, p, snap: ClusterSnapshot):
@@ -206,20 +206,6 @@ class Scheduler:
             for plugin in plugins:
                 plugin.bind_presolve(plugin.prepare_solve(snap))
             P = snap.num_pods
-            # unrolling amortizes per-step loop overhead on TPU (~+20%
-            # throughput); the body stays strictly one-pod-at-a-time
-            # (bit-faithful). CPU (tests) keeps unroll=1 — the extra compile
-            # time there buys nothing. The bench environment exposes the TPU
-            # through a tunneled backend whose platform name is "axon", so
-            # gate on device kind, not the backend name alone.
-            # SPT_SCAN_UNROLL overrides for tuning.
-            import os
-
-            unroll = int(
-                os.environ.get(
-                    "SPT_SCAN_UNROLL", 8 if _is_tpu_backend() else 1
-                )
-            )
             state, (assignment, admitted) = jax.lax.scan(
                 lambda c, p: step(c, p, snap), state0, jnp.arange(P),
                 unroll=unroll,
@@ -241,16 +227,39 @@ class Scheduler:
 
         return jax.jit(solve)
 
+    def _scan_unroll(self) -> int:
+        """Scan unroll factor: amortizes per-step loop overhead on TPU
+        (~+20%); the body stays strictly one-pod-at-a-time (bit-faithful).
+        CPU (tests) keeps 1 — extra compile time buys nothing there. The
+        bench environment exposes the TPU through a tunneled backend whose
+        platform name is "axon", so the default gates on device kind, not
+        backend name. SPT_SCAN_UNROLL overrides for tuning — read host-side
+        per solve and folded into the trace-cache key, so changing it
+        retraces instead of being silently baked."""
+        import os
+
+        raw = os.environ.get("SPT_SCAN_UNROLL")
+        if raw is None:
+            return 8 if _is_tpu_backend() else 1
+        try:
+            unroll = int(raw)
+        except ValueError:
+            raise ValueError(f"SPT_SCAN_UNROLL={raw!r} is not an integer")
+        if unroll < 1:
+            raise ValueError(f"SPT_SCAN_UNROLL must be >= 1, got {unroll}")
+        return unroll
+
     def solve(self, snap: ClusterSnapshot, state0: Optional[SolverState] = None):
         """Run the fused plugin pipeline over the snapshot's pending batch."""
         if state0 is None:
             state0 = self.initial_state(snap)
         auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
-        key = ("solve",) + tuple(
+        unroll = self._scan_unroll()
+        key = ("solve", unroll) + tuple(
             plugin.static_key() for plugin in self.profile.plugins
         )
         if key not in self._solve_cache:
-            self._solve_cache[key] = self._make_solve()
+            self._solve_cache[key] = self._make_solve(unroll)
         return self._solve_cache[key](snap, state0, auxes)
 
     def filter_verdicts(self, snap: ClusterSnapshot, pod_index: int):

@@ -40,11 +40,49 @@ from scheduler_plugins_tpu.ops.normalize import default_normalize
 class NodeAffinity(Plugin):
     name = "NodeAffinity"
 
+    def __init__(self, added_affinity=None):
+        #: NodeAffinityArgs.AddedAffinity (upstream): per-profile extra
+        #: REQUIRED node-selector terms (OR over terms) ANDed into every
+        #: pod's node affinity — cluster operators use it to fence a
+        #: profile to a node subset. Accepts NodeSelectorTerm objects or
+        #: the wire shape (NodeSelectorTerm.from_wire).
+        from scheduler_plugins_tpu.api.objects import NodeSelectorTerm
+
+        self.added_affinity = [
+            t if isinstance(t, NodeSelectorTerm)
+            else NodeSelectorTerm.from_wire(t)
+            for t in added_affinity or []
+        ]
+        self._added_mask = None
+
+    def prepare_cluster(self, meta, cluster):
+        if not self.added_affinity or cluster is None:
+            self._added_mask = None
+            return
+        import numpy as np
+
+        ok = np.ones(max(len(meta.node_names), 1), bool)
+        for i, name in enumerate(meta.node_names):
+            node = cluster.nodes.get(name)
+            ok[i] = node is not None and any(
+                t.matches(node) for t in self.added_affinity
+            )
+        self._added_mask = jnp.asarray(ok)
+
+    def aux(self):
+        return self._added_mask
+
     def filter(self, state, snap, p):
-        if snap.scheduling is None:
-            return None
-        s = snap.scheduling
-        return s.node_term_ok[s.pod_node_term[p]]
+        base = None
+        if snap.scheduling is not None:
+            s = snap.scheduling
+            base = s.node_term_ok[s.pod_node_term[p]]
+        added = getattr(self, "_aux", None)
+        if added is not None:
+            N = snap.num_nodes
+            padded = jnp.zeros(N, bool).at[: added.shape[0]].set(added)
+            base = padded if base is None else base & padded
+        return base
 
     def score(self, state, snap, p):
         if snap.scheduling is None:

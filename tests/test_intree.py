@@ -598,3 +598,42 @@ class TestWaveCapacityHostLevelBypass:
         sched.prepare(meta, c)
         an = np.asarray(profile_batch_solve(sched, snap)[0])[: len(pending)]
         assert (an >= 0).all(), an.tolist()
+
+
+class TestAddedAffinity:
+    def test_profile_fenced_to_node_subset(self):
+        # NodeAffinityArgs.addedAffinity: every pod of the profile is
+        # confined to matching nodes, even with no pod-level affinity
+        from scheduler_plugins_tpu.api.config import load_profile
+        from scheduler_plugins_tpu.framework import Scheduler
+
+        sched = Scheduler(load_profile({
+            "plugins": ["NodeResourcesAllocatable", "NodeAffinity"],
+            "pluginConfig": [{"name": "NodeAffinity", "args": {
+                "addedAffinity": [{"match_expressions": [
+                    {"key": "pool", "operator": "In", "values": ["gpu"]}]}],
+            }}],
+        }))
+        c = Cluster()
+        c.add_node(mknode("plain"))
+        c.add_node(mknode("fenced", {"pool": "gpu"}))
+        c.add_pod(mkpod("p"))
+        r = run_cycle(sched, c, now=1000)
+        assert r.bound["default/p"] == "fenced"
+
+    def test_added_affinity_ands_with_pod_affinity(self):
+        from scheduler_plugins_tpu.api.objects import (
+            NodeSelectorRequirement, NodeSelectorTerm,
+        )
+
+        plug = NodeAffinity(added_affinity=[NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(
+                key="pool", operator="In", values=("gpu",))])])
+        r, c = run(
+            [mknode("gpu-hdd", {"pool": "gpu", "disk": "hdd"}),
+             mknode("gpu-ssd", {"pool": "gpu", "disk": "ssd"}),
+             mknode("cpu-ssd", {"disk": "ssd"})],
+            [mkpod("p", node_selector={"disk": "ssd"})],
+            plugins=[plug],
+        )
+        assert r.bound["default/p"] == "gpu-ssd"
