@@ -1,0 +1,417 @@
+"""OverReserve / DiscardReserved cache state-machine tables.
+
+Mirrors the reference's cache test inventory case-by-case:
+- overreserve_test.go:135-520 (dirty marking, reserve-without-NRT,
+  release-none, reserve/release, flush generation semantics)
+- overreserve_test.go:520-1050 (resync gates: no fingerprint, interleaved
+  reservations, unknown/foreign nodes)
+- foreign_pods_test.go:28-209 (IsForeignPod decision table)
+- resourcerequests/exclusive.go:47-95 (IsExclusive decision table)
+- discardreserved_test.go:40-150 (reservation map lifecycle)
+"""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    NodeResourceTopology,
+    NUMAZone,
+    Pod,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+from scheduler_plugins_tpu.state.nrt_cache import (
+    DiscardReservedCache,
+    OverReserveCache,
+    compute_pod_fingerprint,
+    uses_exclusive_resources,
+)
+
+gib = 1 << 30
+
+
+def mknrt(node, cpu=(30_000, 22_000), fingerprint=""):
+    """Two-zone NRT shaped like makeDefaultTestTopology (overreserve_test.go)."""
+    return NodeResourceTopology(
+        node_name=node,
+        zones=[
+            NUMAZone(numa_id=i, available={CPU: c, MEMORY: 60 * gib})
+            for i, c in enumerate(cpu)
+        ],
+        policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+        pod_fingerprint=fingerprint,
+    )
+
+
+def guaranteed_pod(name, cpu=8000, mem=16 * gib, node=None, uid=None):
+    p = Pod(
+        name=name,
+        containers=[Container(requests={CPU: cpu, MEMORY: mem},
+                              limits={CPU: cpu, MEMORY: mem})],
+    )
+    p.node_name = node
+    if uid:
+        p.uid = uid
+    return p
+
+
+def zone_cpu(nrts, node):
+    nrt = next(n for n in nrts if n.node_name == node)
+    return [z.available[CPU] for z in nrt.zones]
+
+
+class TestDirtyMarking:
+    """overreserve_test.go:135-213."""
+
+    def test_reserve_on_pristine_cache_is_not_dirty(self):
+        cache = OverReserveCache()
+        for node in ("node-1", "node-4"):
+            cache.reserve(node, guaranteed_pod("p"))
+        assert cache.desynced_nodes() == set()
+
+    def test_mark_maybe_overreserved_sets_dirty(self):
+        cache = OverReserveCache()
+        for node in ("node-1", "node-4"):
+            cache.mark_maybe_overreserved(node)
+        assert cache.desynced_nodes() == {"node-1", "node-4"}
+
+    def test_reserve_does_not_unmark_dirty(self):
+        # only a flush clears the dirty flag (TestDirtyNodesNotUnmarkedOnReserve)
+        cache = OverReserveCache()
+        for node in ("node-1", "node-4"):
+            cache.update_nrt(mknrt(node))
+            cache.reserve(node, guaranteed_pod("p", node=node))
+            cache.mark_maybe_overreserved(node)
+        cache.reserve("node-4", guaranteed_pod("q"))
+        assert cache.desynced_nodes() == {"node-1", "node-4"}
+
+
+class TestReserveRelease:
+    """overreserve_test.go:214-424."""
+
+    def test_reserve_skips_without_nrt(self):
+        # reserving against a ghost node must not create a deduction, and
+        # must not disturb other nodes' views (TestReserveSkipsWithoutNRT)
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        cache.reserve("ghost-node", guaranteed_pod("test-pod"))
+        assert "ghost-node" not in cache.assumed
+        nrts, stale = cache.view()
+        assert not stale
+        assert all(n.node_name != "ghost-node" for n in nrts)
+        assert zone_cpu(nrts, "node1") == [30_000, 22_000]
+
+    def test_release_none_is_a_noop(self):
+        # unreserve without a prior reserve leaves the view untouched
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        cache.unreserve("node1", guaranteed_pod("test-pod"))
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [30_000, 22_000]
+
+    def test_reserve_then_release_restores_original(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        pod = guaranteed_pod("test-pod")
+        cache.reserve("node1", pod)
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [22_000, 14_000]  # every zone
+        cache.unreserve("node1", pod)
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [30_000, 22_000]
+
+    def test_two_reservations_stack(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        a = guaranteed_pod("a", cpu=2000, uid="uid-a")
+        b = guaranteed_pod("b", cpu=3000, uid="uid-b")
+        cache.reserve("node1", a)
+        cache.reserve("node1", b)
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [25_000, 17_000]
+        cache.unreserve("node1", a)
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [27_000, 19_000]
+
+
+class TestFlushGeneration:
+    """overreserve_test.go:425-519 — generation moves exactly once per
+    flushing resync pass, flush clears every dirty flag."""
+
+    def test_flush_bumps_generation_once_and_clears_dirty(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        pod = guaranteed_pod("p", node="node1")
+        cache.reserve("node1", pod)
+        cache.mark_maybe_overreserved("node1")
+        fp = compute_pod_fingerprint([("default", "p")])
+        cache.update_nrt(mknrt("node1", cpu=(30_000, 22_000), fingerprint=fp))
+        gen0 = cache.generation
+        assert cache.resync({"node1": [pod]}) == ["node1"]
+        assert cache.generation == gen0 + 1
+        assert cache.desynced_nodes() == set()
+        # resync again with nothing dirty: generation unchanged
+        assert cache.resync({"node1": [pod]}) == []
+        assert cache.generation == gen0 + 1
+
+    def test_multi_node_flush_is_one_generation(self):
+        cache = OverReserveCache()
+        pods = {}
+        for node in ("n1", "n2"):
+            cache.update_nrt(mknrt(node))
+            pod = guaranteed_pod("p-" + node, node=node)
+            pods[node] = [pod]
+            cache.reserve(node, pod)
+            cache.mark_maybe_overreserved(node)
+            fp = compute_pod_fingerprint([("default", "p-" + node)])
+            cache.update_nrt(mknrt(node, fingerprint=fp))
+        assert sorted(cache.resync(pods)) == ["n1", "n2"]
+        assert cache.generation == 1
+
+
+class TestResyncGates:
+    """overreserve_test.go:520-956."""
+
+    def test_no_fingerprint_refuses_flush(self):
+        # an agent report with no fingerprint cannot be validated: the node
+        # stays dirty and the cached (deducted) view stays in force
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        pod = guaranteed_pod("p", node="node1")
+        cache.reserve("node1", pod)
+        cache.mark_maybe_overreserved("node1")
+        cache.update_nrt(mknrt("node1", cpu=(10_000, 10_000)))  # no fp
+        assert cache.resync({"node1": [pod]}) == []
+        assert "node1" in cache.desynced_nodes()
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "node1") == [22_000, 14_000]  # old - assumed
+
+    def test_fingerprint_mismatch_keeps_node_dirty(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        pod = guaranteed_pod("p", node="node1")
+        cache.reserve("node1", pod)
+        cache.mark_maybe_overreserved("node1")
+        wrong = compute_pod_fingerprint([("default", "somebody-else")])
+        cache.update_nrt(mknrt("node1", cpu=(10_000, 10_000), fingerprint=wrong))
+        assert cache.resync({"node1": [pod]}) == []
+        assert "node1" in cache.desynced_nodes()
+        assert cache.generation == 0
+
+    def test_resync_reserve_interleaved(self):
+        # a reservation taken AFTER the agent's report arrived survives the
+        # flush (the agent couldn't have seen it; overreserve_test.go:798)
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        bound = guaranteed_pod("old", cpu=4000, node="node1", uid="uid-old")
+        cache.reserve("node1", bound)
+        cache.mark_maybe_overreserved("node1")
+        fp = compute_pod_fingerprint([("default", "old")])
+        cache.update_nrt(mknrt("node1", cpu=(26_000, 18_000), fingerprint=fp))
+        inflight = guaranteed_pod("new", cpu=2000, uid="uid-new")  # no node yet
+        cache.reserve("node1", inflight)
+        assert cache.resync({"node1": [bound]}) == ["node1"]
+        nrts, _ = cache.view()
+        # flushed report minus ONLY the in-flight reservation
+        assert zone_cpu(nrts, "node1") == [24_000, 16_000]
+
+    def test_unknown_node_with_foreign_pods_stays_dirty(self):
+        # foreign pod on a node we have no NRT for: dirty forever until an
+        # NRT shows up (TestUnknownNodeWithForeignPods)
+        cache = OverReserveCache()
+        alien = guaranteed_pod("alien", node="node-mystery")
+        alien.scheduler_name = "default-scheduler"
+        cache.track_pod(alien)
+        assert cache.desynced_nodes() == {"node-mystery"}
+        assert cache.resync({}) == []
+        assert cache.desynced_nodes() == {"node-mystery"}
+
+    def test_foreign_node_view_is_stale_but_present(self):
+        # TestOverresevedGetCachedNRTCopyWithForeignPods: the NRT data is
+        # still served, but marked not-fresh
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("node1"))
+        alien = guaranteed_pod("alien", node="node1")
+        alien.scheduler_name = "default-scheduler"
+        cache.track_pod(alien)
+        nrts, stale = cache.view()
+        assert zone_cpu(nrts, "node1") == [30_000, 22_000]
+        assert stale == {"node1"}
+
+
+class TestIsForeignPod:
+    """foreign_pods_test.go:28-209 decision table."""
+
+    def _is_foreign(self, pod, profiles):
+        cache = OverReserveCache(our_schedulers=set(profiles))
+        cache.track_pod(pod)
+        return bool(cache.foreign)
+
+    def test_no_node_is_never_foreign(self):
+        pod = guaranteed_pod("pod")
+        assert not self._is_foreign(pod, ["secondary-scheduler"])
+
+    def test_bound_app_container_pod_is_foreign(self):
+        pod = guaranteed_pod("pod", cpu=4000, mem=2 * gib, node="random-node")
+        pod.scheduler_name = "default-scheduler"
+        assert self._is_foreign(pod, ["secondary-scheduler"])
+
+    def test_bound_init_container_only_pod_is_foreign(self):
+        pod = Pod(name="pod", init_containers=[
+            Container(requests={CPU: 4000, MEMORY: 2 * gib},
+                      limits={CPU: 4000, MEMORY: 2 * gib})])
+        pod.node_name = "random-node"
+        pod.scheduler_name = "default-scheduler"
+        assert self._is_foreign(pod, ["secondary-scheduler"])
+
+    def test_device_only_pod_is_foreign(self):
+        pod = Pod(name="pod", containers=[
+            Container(requests={"veryfast.io/fpga": 1},
+                      limits={"veryfast.io/fpga": 1})])
+        pod.node_name = "random-node"
+        pod.scheduler_name = "default-scheduler"
+        assert self._is_foreign(pod, ["secondary-scheduler"])
+
+    def test_our_profile_is_not_foreign(self):
+        pod = guaranteed_pod("pod", node="random-node")
+        pod.scheduler_name = "secondary-scheduler"
+        assert not self._is_foreign(pod, ["secondary-scheduler"])
+
+    def test_multi_profile_match_is_not_foreign(self):
+        pod = guaranteed_pod("pod", node="random-node")
+        pod.scheduler_name = "secondary-scheduler-B"
+        assert not self._is_foreign(
+            pod,
+            ["secondary-scheduler-A", "secondary-scheduler-B", "fancy-scheduler"],
+        )
+
+
+class TestExclusiveResources:
+    """IsExclusive (resourcerequests/exclusive.go:73-95) decision table."""
+
+    def _pod(self, requests, limits=None, burstable=False):
+        limits = requests if limits is None else limits
+        if burstable:
+            limits = {}
+        return Pod(name="p", containers=[
+            Container(requests=dict(requests), limits=dict(limits))])
+
+    def test_guaranteed_integral_cpu_is_exclusive(self):
+        # (upstream Guaranteed implies cpu+memory limits, so memory also
+        # makes this exclusive — both IsExclusive branches agree)
+        assert uses_exclusive_resources(self._pod({CPU: 4000, MEMORY: gib}))
+
+    def test_guaranteed_memory_is_exclusive(self):
+        assert uses_exclusive_resources(self._pod({CPU: 500, MEMORY: gib}))
+
+    def test_burstable_hugepages_are_not_exclusive(self):
+        # hugepages exclusivity requires Guaranteed QoS (exclusive.go:80-83
+        # bails before the memory/hugepages branch)
+        assert not uses_exclusive_resources(
+            self._pod({CPU: 500, "hugepages-2Mi": 2 << 20}, burstable=True))
+
+    def test_burstable_cpu_memory_is_not_exclusive(self):
+        assert not uses_exclusive_resources(
+            self._pod({CPU: 4000, MEMORY: gib}, burstable=True))
+
+    def test_extended_resource_is_always_exclusive(self):
+        assert uses_exclusive_resources(
+            self._pod({"veryfast.io/fpga": 1}, burstable=True))
+
+    def test_kubernetes_io_prefix_is_native_not_device(self):
+        assert not uses_exclusive_resources(
+            self._pod({"kubernetes.io/batch-cpu": 1000}, burstable=True))
+
+    def test_non_restartable_init_container_ignored(self):
+        # a run-once init container's devices don't count in steady state
+        pod = Pod(name="p",
+                  init_containers=[Container(requests={"veryfast.io/fpga": 1},
+                                             restart_policy_always=False)],
+                  containers=[Container(requests={CPU: 100})])
+        assert not uses_exclusive_resources(pod)
+
+    def test_restartable_init_container_counts(self):
+        pod = Pod(name="p",
+                  init_containers=[Container(requests={"veryfast.io/fpga": 1},
+                                             restart_policy_always=True)],
+                  containers=[Container(requests={CPU: 100})])
+        assert uses_exclusive_resources(pod)
+
+
+class TestDiscardReservedLifecycle:
+    """discardreserved_test.go:40-150."""
+
+    def test_reserve_tracks_uid(self):
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("node1"))
+        cache.reserve("node1", guaranteed_pod("pod", uid="some-uid"))
+        assert cache.reservations == {"node1": {"some-uid"}}
+
+    def test_view_not_fresh_while_reserved(self):
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("node1"))
+        cache.reserve("node1", guaranteed_pod("pod", uid="some-uid"))
+        _, stale = cache.view()
+        assert stale == {"node1"}
+
+    def test_unreserve_unblocks(self):
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("node1"))
+        pod = guaranteed_pod("pod", uid="some-uid")
+        cache.reserve("node1", pod)
+        cache.unreserve("node1", pod)
+        _, stale = cache.view()
+        assert not stale
+        assert "node1" not in cache.reservations
+
+    def test_node_blocked_until_all_reservations_clear(self):
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("node1"))
+        a = guaranteed_pod("a", uid="uid-a")
+        b = guaranteed_pod("b", uid="uid-b")
+        cache.reserve("node1", a)
+        cache.reserve("node1", b)
+        cache.post_bind("node1", a)
+        _, stale = cache.view()
+        assert stale == {"node1"}  # b still in flight
+        cache.post_bind("node1", b)
+        _, stale = cache.view()
+        assert not stale
+
+    def test_foreign_pods_do_not_block(self):
+        # DiscardReserved has no foreign tracking: data served fresh
+        cache = DiscardReservedCache()
+        cache.update_nrt(mknrt("node1"))
+        nrts, stale = cache.view()
+        assert len(nrts) == 1 and not stale
+
+
+class TestAttrChanges:
+    """attr_watch_test.go:40-153 — kubelet config deltas force a resync."""
+
+    def test_scope_change_marks_dirty(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        changed = mknrt("n0")
+        changed.scope = TopologyManagerScope.POD
+        cache.update_nrt(changed)
+        assert "n0" in cache.desynced_nodes()
+
+    def test_same_config_update_is_clean(self):
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        cache.update_nrt(mknrt("n0", cpu=(28_000, 20_000)))
+        assert cache.desynced_nodes() == set()
+        nrts, _ = cache.view()
+        assert zone_cpu(nrts, "n0") == [28_000, 20_000]
+
+    def test_config_change_on_deducted_node_flushes_unconditionally(self):
+        # ConfigChanged nodes bypass the fingerprint gate (overreserve.go
+        # separate ConfigChanged loop)
+        cache = OverReserveCache()
+        cache.update_nrt(mknrt("n0"))
+        cache.reserve("n0", guaranteed_pod("p", node="n0"))
+        changed = mknrt("n0")  # fingerprint-less report
+        changed.policy = TopologyManagerPolicy.RESTRICTED
+        cache.update_nrt(changed)
+        assert cache.resync({"n0": []}) == ["n0"]
+        assert cache.nrts["n0"].policy == TopologyManagerPolicy.RESTRICTED
