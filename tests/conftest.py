@@ -19,3 +19,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def raw_plugin_scores(cluster, sched, pod):
+    """Drive ONE pending pod through a single-plugin profile up to the raw
+    (un-normalized) per-node Score vector — the unit-level harness several
+    decision-table suites share. Returns (scores ndarray, meta)."""
+    import numpy as np
+
+    pending = sched.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    sched.prepare(meta, cluster)
+    plugin = sched.profile.plugins[0]
+    plugin.bind_aux(plugin.aux())
+    plugin.bind_presolve(None)
+    state = sched.initial_state(snap)
+    i = meta.pod_names.index(pod.uid)
+    return np.asarray(plugin.score(state, snap, i)), meta

@@ -1,0 +1,177 @@
+"""LowRiskOverCommitment beta-distribution edge tables.
+
+Mirrors the reference's beta_test.go + lowriskovercommitment_test.go:
+- moment recursion goldens for beta(1,1)/(1,2)/(3,1) (beta_test.go:26-110):
+  the moment-matched fit must recover (alpha, beta) from (m1, m2).
+- DistributionFunction vectors for beta(2,2) (beta_test.go:236-330).
+- GetMaxVariance table (beta_test.go:329-375) via fit validity.
+- ComputeProbability degenerate branches (beta.go:173-191).
+- computeRisk goldens for node_A / nrla_A1 / nrla_A2
+  (lowriskovercommitment_test.go:245-392): 0.5 / 0.25 / 1.0 / 0.75.
+- the Score best-effort gate (lowriskovercommitment.go:122-129).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.ops.trimaran import (
+    _risk_one_resource,
+    compute_probability,
+)
+
+
+def prob(mu, sigma, threshold):
+    p, valid, alpha, beta = compute_probability(
+        jnp.float64(mu), jnp.float64(sigma), jnp.float64(threshold))
+    return float(p), bool(valid), float(alpha), float(beta)
+
+
+class TestMomentMatchedFit:
+    """NewBetaDistribution moment goldens: matching (m1, m2) recovers the
+    (alpha, beta) pair the reference tabulates (beta_test.go:26-110)."""
+
+    @pytest.mark.parametrize("alpha,beta,m1,m2", [
+        (1.0, 1.0, 0.5, 1.0 / 3.0),
+        (1.0, 2.0, 1.0 / 3.0, 1.0 / 6.0),
+        (3.0, 1.0, 0.75, 0.6),
+    ])
+    def test_fit_recovers_parameters(self, alpha, beta, m1, m2):
+        sigma = math.sqrt(m2 - m1 * m1)
+        # threshold in the open interval so no degenerate branch fires
+        _, valid, got_a, got_b = prob(m1, sigma, 0.42)
+        assert valid
+        assert got_a == pytest.approx(alpha, abs=1e-9)
+        assert got_b == pytest.approx(beta, abs=1e-9)
+
+
+class TestDistributionFunction:
+    """beta(2,2) CDF vectors (beta_test.go:236-330). beta(2,2): m1=0.5,
+    var = 4/(16*5) = 0.05."""
+
+    SIGMA = math.sqrt(0.05)
+
+    def test_cdf_at_half_is_half(self):
+        p, valid, a, b = prob(0.5, self.SIGMA, 0.5)
+        assert valid
+        assert (a, b) == (pytest.approx(2.0), pytest.approx(2.0))
+        assert p == pytest.approx(0.5, abs=1e-5)
+
+    def test_cdf_at_zero_is_zero(self):
+        p, _, _, _ = prob(0.5, self.SIGMA, 0.0)
+        assert p == 0.0
+
+    def test_cdf_at_one_is_one(self):
+        p, _, _, _ = prob(0.5, self.SIGMA, 1.0)
+        assert p == 1.0
+
+
+class TestComputeProbabilityEdges:
+    """ComputeProbability (beta.go:173-191)."""
+
+    def test_mu_zero_is_certain(self):
+        p, valid, _, _ = prob(0.0, 0.3, 0.1)
+        assert (p, valid) == (1.0, False)
+
+    def test_sigma_zero_below_threshold_is_certain(self):
+        p, valid, _, _ = prob(0.4, 0.0, 0.5)
+        assert (p, valid) == (1.0, False)
+
+    def test_sigma_zero_above_threshold_is_impossible(self):
+        p, valid, _, _ = prob(0.8, 0.0, 0.5)
+        assert (p, valid) == (0.0, False)
+
+    def test_moment_mismatch_returns_zero_invalid(self):
+        # variance beyond the beta maximum m1*(1-m1) cannot be matched
+        # (MatchMoments false -> ComputeProbability returns 0, nil)
+        sigma = math.sqrt(0.5 * 0.5) + 0.01
+        p, valid, _, _ = prob(0.5, sigma, 0.4)
+        assert (p, valid) == (0.0, False)
+
+    @pytest.mark.parametrize("m1", [0.0, 1.0, -1.0])
+    def test_max_variance_zero_ends_invalid(self, m1):
+        # GetMaxVariance(m1) == 0 at the boundaries (beta_test.go:329-375):
+        # any positive sigma then fails the fit
+        _, valid, _, _ = prob(m1, 0.1, 0.4)
+        assert not valid
+
+
+def risk(avg, std, cap, req, limit, req_minus, limit_minus,
+         weight=0.5, window=5):
+    out = _risk_one_resource(
+        jnp.asarray([avg], jnp.float64),
+        jnp.asarray([std], jnp.float64),
+        jnp.asarray([True]),
+        jnp.asarray([cap], jnp.int64),
+        jnp.asarray([req], jnp.int64),
+        jnp.asarray([limit], jnp.int64),
+        jnp.asarray([req_minus], jnp.int64),
+        jnp.asarray([limit_minus], jnp.int64),
+        window,
+        weight,
+    )
+    return float(np.asarray(out)[0])
+
+
+class TestComputeRiskGoldens:
+    """node_A (4000m, 4096 bytes; cpu avg 80/std 0, mem avg 25/std 0) with
+    nrla_A1/nrla_A2 (lowriskovercommitment_test.go:245-392)."""
+
+    def test_a1_cpu(self):
+        # riskLimit 0 (limit 3000 < cap), riskLoad 1 (mu .8 > thr .25)
+        assert risk(80, 0, 4000, 2000, 3000, 1000, 2000) == pytest.approx(0.5)
+
+    def test_a1_memory(self):
+        # riskLimit (6144-4096)/(6144-2048) = .5; zero-over-zero conditioning
+        # forces allocProb 1 -> riskLoad 0
+        assert risk(25, 0, 4096, 2048, 6144, 0, 0) == pytest.approx(0.25)
+
+    def test_a2_cpu(self):
+        # riskLimit (5000-4000)/(5000-4000) = 1; riskLoad 1 (mu .8 > thr .75)
+        assert risk(80, 0, 4000, 4000, 5000, 3000, 4000) == pytest.approx(1.0)
+
+    def test_a2_memory(self):
+        # riskLimit (7168-4096)/(7168-1024) = .5; riskLoad 1 (mu .25 > .125)
+        assert risk(25, 0, 4096, 1024, 7168, 512, 6144) == pytest.approx(0.75)
+
+    def test_risk_clamped_to_unit_interval(self):
+        assert 0.0 <= risk(100, 50, 4000, 8000, 16000, 8000, 16000) <= 1.0
+
+
+class TestScoreGates:
+    """Score early-outs (lowriskovercommitment.go:122-137)."""
+
+    def _snap(self, pod):
+        from conftest import raw_plugin_scores
+        from scheduler_plugins_tpu.api.objects import Node
+        from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.plugins import LowRiskOverCommitment
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        gib = 1 << 30
+        c = Cluster()
+        c.add_node(Node(name="node-1",
+                        allocatable={CPU: 1000, MEMORY: gib, PODS: 110}))
+        c.node_metrics = {"node-1": {"cpu_avg": 20.0}}
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[LowRiskOverCommitment()]))
+        raw, _ = raw_plugin_scores(c, sched, pod)
+        return raw
+
+    def test_best_effort_pod_scores_minimum(self):
+        # the reference's "new node" Score vector: empty pod -> score 0
+        from scheduler_plugins_tpu.api.objects import Container, Pod
+
+        raw = self._snap(Pod(name="p", containers=[Container()]))
+        assert int(raw[0]) == 0
+
+    def test_requesting_pod_scores_positive(self):
+        from scheduler_plugins_tpu.api.objects import Container, Pod
+        from scheduler_plugins_tpu.api.resources import CPU
+
+        raw = self._snap(Pod(name="p",
+                             containers=[Container(requests={CPU: 100})]))
+        assert int(raw[0]) > 0

@@ -1,0 +1,218 @@
+"""NetworkOverhead decision tables on the reference's own "basic" scenario.
+
+Mirrors networkoverhead_test.go:
+- node/zone/region layout + NetworkTopology costs from
+  GetNetworkTopologyCRBasic (:189-224) and the 8-node table (:580-598):
+  regions us-west-1 <-> us-east-1 cost 20; zones Z1<->Z2 cost 5,
+  Z3<->Z4 cost 10.
+- TestNetworkOverheadScore (:572-700): expected raw accumulated costs and
+  inverted-normalized scores for p1/p2/p3.
+- TestNetworkOverheadFilter (:1055-1200): satisfied/violated verdicts.
+- cost/count edge semantics from checkMaxNetworkCostRequirements /
+  getAccumulatedCost (networkoverhead.go:500-638): missing cost-map entries
+  count neither satisfied nor violated but cost MaxCost; label-less
+  dependency nodes are violated at MaxCost.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.ops.network import (
+    MAX_COST,
+    dependency_tallies,
+    placed_commit,
+)
+from scheduler_plugins_tpu.ops.normalize import peaks_normalize
+
+# zone codes: Z1=0 Z2=1 Z3=2 Z4=3; region codes: us-west-1=0 us-east-1=1
+ZONE_REGION = jnp.asarray([0, 0, 1, 1], jnp.int32)
+ZONE_COST = jnp.asarray(
+    [[-1, 5, -1, -1],
+     [5, -1, -1, -1],
+     [-1, -1, -1, 10],
+     [-1, -1, 10, -1]], jnp.int64)
+REGION_COST = jnp.asarray([[-1, 20], [20, -1]], jnp.int64)
+
+# n-1..n-8 (networkoverhead_test.go:580-598)
+NODE_ZONE = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3], jnp.int32)
+NODE_REGION = jnp.asarray([0, 0, 0, 0, 1, 1, 1, 1], jnp.int32)
+
+# workload codes: p1-deployment=0, p2-deployment=1, p3-deployment=2
+W, N = 3, 8
+
+
+def placed(**kwargs):
+    """placed(p1=node_idx, ...) -> (W, N) placed-pod count matrix."""
+    m = np.zeros((W, N), np.int64)
+    for wl, node in kwargs.items():
+        m[int(wl[1:]) - 1, node] += 1
+    return jnp.asarray(m)
+
+
+def tallies(dep_workloads, placed_node, max_costs=None,
+            node_zone=NODE_ZONE, node_region=NODE_REGION):
+    D = max(len(dep_workloads), 1)
+    wl = np.full(D, -1, np.int32)
+    mc = np.zeros(D, np.int64)
+    mask = np.zeros(D, bool)
+    for i, w in enumerate(dep_workloads):
+        wl[i], mask[i] = w, True
+        if max_costs is not None:
+            mc[i] = max_costs[i]
+    sat, vio, cost = dependency_tallies(
+        jnp.asarray(wl), jnp.asarray(mc), jnp.asarray(mask),
+        placed_node, node_zone, node_region,
+        ZONE_REGION, ZONE_COST, REGION_COST,
+    )
+    return np.asarray(sat), np.asarray(vio), np.asarray(cost)
+
+
+# score-test placements: p1@n-2, p2@n-5, p3@n-1 (:620-624)
+SCORE_PLACED = placed(p1=1, p2=4, p3=0)
+# filter-test placements: p1@n-2, p2@n-5, p3@n-8 (:1064-1067)
+FILTER_PLACED = placed(p1=1, p2=4, p3=7)
+
+
+class TestScoreGoldens:
+    """TestNetworkOverheadScore expected values, bit-for-bit."""
+
+    def test_p1_raw_costs(self):
+        # p1 depends on p2@n-5 (us-east-1, Z3)
+        _, _, cost = tallies([1], SCORE_PLACED)
+        assert cost.tolist() == [20, 20, 20, 20, 0, 1, 10, 10]
+
+    def test_p1_normalized_scores(self):
+        _, _, cost = tallies([1], SCORE_PLACED)
+        mask = jnp.ones(N, bool)
+        norm = np.asarray(peaks_normalize(jnp.asarray(cost), mask))
+        assert norm.tolist() == [0, 0, 0, 0, 100, 95, 50, 50]
+
+    def test_p2_raw_costs(self):
+        # p2 depends on p3@n-1 (us-west-1, Z1)
+        _, _, cost = tallies([2], SCORE_PLACED)
+        assert cost.tolist() == [0, 1, 5, 5, 20, 20, 20, 20]
+
+    def test_p2_normalized_scores(self):
+        _, _, cost = tallies([2], SCORE_PLACED)
+        norm = np.asarray(peaks_normalize(jnp.asarray(cost), jnp.ones(N, bool)))
+        assert norm.tolist() == [100, 95, 75, 75, 0, 0, 0, 0]
+
+    def test_p3_no_dependencies_all_zero(self):
+        _, _, cost = tallies([], SCORE_PLACED)
+        assert cost.tolist() == [0] * N
+        norm = np.asarray(peaks_normalize(jnp.asarray(cost), jnp.ones(N, bool)))
+        assert norm.tolist() == [0] * N
+
+
+class TestFilterVerdicts:
+    """TestNetworkOverheadFilter: reject iff violated > satisfied."""
+
+    def _verdicts(self, dep_workloads, max_costs=None):
+        sat, vio, _ = tallies(dep_workloads, FILTER_PLACED, max_costs)
+        return (vio <= sat).tolist()
+
+    def test_p1_n1_rejected_n6_accepted(self):
+        # p1 -> p2@n-5 (east, Z3), maxNetworkCost 0
+        ok = self._verdicts([1])
+        assert ok[0] is False   # n-1: west, region cost 20 > 0 -> violated
+        assert ok[5] is True    # n-6: same zone Z3 -> satisfied regardless
+        sat, vio, _ = tallies([1], FILTER_PLACED)
+        assert (sat[0], vio[0]) == (0, 1)  # the reference's message values
+
+    def test_p2_n5_rejected_n7_accepted(self):
+        # p2 -> p3@n-8 (east, Z4), maxNetworkCost 0
+        ok = self._verdicts([2])
+        assert ok[4] is False   # n-5: Z3 -> Z4 cost 10 > 0 -> violated
+        assert ok[6] is True    # n-7: same zone Z4 -> satisfied
+
+    def test_p3_no_dependencies_everywhere_ok(self):
+        assert self._verdicts([]) == [True] * N
+
+    def test_relaxed_max_cost_flips_verdict(self):
+        # maxNetworkCost 20 admits the cross-region dependency
+        ok = self._verdicts([1], max_costs=[20])
+        assert ok[0] is True
+        # ...but 19 still rejects
+        ok = self._verdicts([1], max_costs=[19])
+        assert ok[0] is False
+
+    def test_multiple_dependencies_tally_independently(self):
+        # p1 with deps on BOTH p2@n-5 and p3@n-8, maxNetworkCost 0:
+        # n-6 (east, Z3): p2 same zone satisfied; p3 via Z3->Z4 cost 10
+        # violated -> 1 vs 1, not rejected (strict > in the reference)
+        sat, vio, _ = tallies([1, 2], FILTER_PLACED)
+        assert (sat[5], vio[5]) == (1, 1)
+        assert bool(vio[5] <= sat[5])
+        # n-1 (west): both deps cross-region -> 0 vs 2 -> rejected
+        assert (sat[0], vio[0]) == (0, 2)
+
+
+class TestEdgeSemantics:
+    """networkoverhead.go:539-567 corner rules."""
+
+    def test_missing_cost_entry_counts_neither_but_costs_max(self):
+        # candidate in Z3 (east), dep in a zone of the same region with no
+        # Z3 entry: build a dep on p1 placed on an east node in Z4, then
+        # blank the Z3<->Z4 costs
+        zone_cost = ZONE_COST.at[2, 3].set(-1).at[3, 2].set(-1)
+        sat, vio, cost = (np.asarray(x) for x in dependency_tallies(
+            jnp.asarray([0], jnp.int32), jnp.asarray([100], jnp.int64),
+            jnp.asarray([True]),
+            placed(p1=7), NODE_ZONE, NODE_REGION,
+            ZONE_REGION, zone_cost, REGION_COST,
+        ))
+        # n-5 (Z3): lookup misses -> neither satisfied nor violated, MaxCost
+        assert (sat[4], vio[4], cost[4]) == (0, 0, MAX_COST)
+
+    def test_unlabeled_dependency_node_is_violated_at_max_cost(self):
+        # dep pod sits on a node with neither region nor zone
+        node_zone = NODE_ZONE.at[7].set(-1)
+        node_region = NODE_REGION.at[7].set(-1)
+        sat, vio, cost = tallies([0], placed(p1=7),
+                                 node_zone=node_zone, node_region=node_region)
+        # from any OTHER node the dependency is violated at MaxCost
+        assert (sat[0], vio[0], cost[0]) == (0, 1, MAX_COST)
+        # from the same node it is satisfied at cost 0 (hostname check
+        # precedes the label check, networkoverhead.go:521-525)
+        assert (sat[7], vio[7], cost[7]) == (1, 0, 0)
+
+    def test_region_only_nodes_compare_empty_zones_equal(self):
+        # both candidate and dep node have a region but no zone: the
+        # reference compares zone "" == "" -> same-zone satisfied, cost 1
+        node_zone = NODE_ZONE.at[4].set(-1).at[5].set(-1)
+        sat, vio, cost = tallies([0], placed(p1=4), node_zone=node_zone)
+        assert (sat[5], vio[5], cost[5]) == (1, 0, 1)
+        # a ZONED east candidate looks up destination "" -> miss: no count,
+        # MaxCost
+        assert (sat[6], vio[6], cost[6]) == (0, 0, MAX_COST)
+
+    def test_two_replicas_tally_twice(self):
+        two = placed(p1=4).at[0, 5].add(1)  # p1 replicas on n-5 and n-6
+        sat, vio, cost = tallies([0], two)
+        # n-5: one same-node (0) + one same-zone (1)
+        assert (sat[4], vio[4], cost[4]) == (2, 0, 1)
+        # n-1: both cross-region at cost 20
+        assert (sat[0], vio[0], cost[0]) == (0, 2, 40)
+
+
+class TestPlacedCommit:
+    def test_commit_adds_in_cycle_placement(self):
+        base = placed(p2=4)
+        after = placed_commit(base, jnp.asarray(0, jnp.int32),
+                              jnp.asarray(2, jnp.int32))
+        assert np.asarray(after)[0, 2] == 1
+        # the new placement is visible to subsequent tallies
+        _, _, cost = tallies([0], after)
+        assert cost[2] == 0  # same node now free
+
+    def test_commit_ignores_unplaced(self):
+        base = placed(p2=4)
+        after = placed_commit(base, jnp.asarray(0, jnp.int32),
+                              jnp.asarray(-1, jnp.int32))
+        assert np.asarray(after).tolist() == np.asarray(base).tolist()
+
+    def test_commit_ignores_groupless_pod(self):
+        base = placed(p2=4)
+        after = placed_commit(base, jnp.asarray(-1, jnp.int32),
+                              jnp.asarray(3, jnp.int32))
+        assert np.asarray(after).tolist() == np.asarray(base).tolist()

@@ -145,19 +145,11 @@ class TestScoreVectors:
         return c
 
     def _scores(self, c, pod):
-        import numpy as np
-        import jax.numpy as jnp
+        from conftest import raw_plugin_scores
+
         c.add_pod(pod)
         sched = Scheduler(Profile(plugins=[SySched()]))
-        pending = sched.sort_pending(c.pending_pods(), c)
-        snap, meta = c.snapshot(pending, now_ms=0)
-        sched.prepare(meta, c)
-        plugin = sched.profile.plugins[0]
-        plugin.bind_aux(plugin.aux())
-        plugin.bind_presolve(None)
-        state = sched.initial_state(snap)
-        i = meta.pod_names.index(pod.uid)
-        raw = np.asarray(plugin.score(state, snap, i))
+        raw, meta = raw_plugin_scores(c, sched, pod)
         return {meta.node_names[n]: int(raw[n])
                 for n in range(len(meta.node_names))}
 

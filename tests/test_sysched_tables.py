@@ -1,0 +1,202 @@
+"""SySched host-set maintenance + score decomposition tables.
+
+Mirrors the rest of the reference's sysched_test.go inventory:
+- TestGetHostSyscalls single/many (:449-510): per-node unions over the
+  node's pods only.
+- TestRemove (:99-149): removing a pod recomputes the host union without
+  its syscalls.
+- TestUpdateHostSyscalls (:510-600): a newly bound pod extends the union.
+- getSyscalls resolution merge (sysched.go:124-210): container + init
+  container + annotation references union together; bare names resolve in
+  the pod's namespace.
+- Score (sysched.go:234-279): the tensor decomposition
+  pod_count*|newHost| - sum_s newHost[s]*counts must equal the reference's
+  per-existing-pod set loop — checked by brute force on random clusters.
+"""
+
+import random
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    Pod,
+    SeccompProfile,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler
+from scheduler_plugins_tpu.plugins import SySched
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+Z_SET = frozenset({"read", "write"})
+X_SET = frozenset({"read", "write", "open", "close"})
+FULL_SET = frozenset({"read", "write", "open", "close", "mmap", "fork"})
+
+
+def base_cluster(nodes=("test", "test1")):
+    c = Cluster()
+    for n in nodes:
+        c.add_node(Node(name=n, allocatable={CPU: 10_000, MEMORY: 32 * gib,
+                                             PODS: 110}))
+    c.add_seccomp_profile(SeccompProfile(name="z-seccomp", syscalls=Z_SET))
+    c.add_seccomp_profile(SeccompProfile(name="x-seccomp", syscalls=X_SET))
+    c.add_seccomp_profile(SeccompProfile(name="full-seccomp",
+                                         syscalls=FULL_SET))
+    return c
+
+
+def prof_pod(name, profile, node=None, namespace="default"):
+    p = Pod(name=name, namespace=namespace,
+            containers=[Container(requests={CPU: 100},
+                                  seccomp_profile=profile)])
+    p.node_name = node
+    return p
+
+
+def host_union_size(c, node):
+    pending = [prof_pod("probe", "z-seccomp")]
+    c.add_pod(pending[0])
+    try:
+        snap, meta = c.snapshot(pending, now_ms=0)
+        ni = meta.node_names.index(node)
+        return int(np.asarray(snap.syscalls.host_sets[ni]).sum())
+    finally:
+        c.remove_pod("default/probe")
+
+
+class TestHostSyscallUnions:
+    def test_single_pod_union(self):
+        c = base_cluster()
+        c.add_pod(prof_pod("pod1", "z-seccomp", node="test"))
+        assert host_union_size(c, "test") == len(Z_SET)
+
+    def test_many_pods_union_excludes_other_nodes(self):
+        # pods 1+2 on "test" (z ∪ x), pod3 with the full profile on "test1"
+        c = base_cluster()
+        c.add_pod(prof_pod("pod1", "z-seccomp", node="test"))
+        c.add_pod(prof_pod("pod2", "x-seccomp", node="test"))
+        c.add_pod(prof_pod("pod3", "full-seccomp", node="test1"))
+        assert host_union_size(c, "test") == len(Z_SET | X_SET)
+        assert host_union_size(c, "test1") == len(FULL_SET)
+
+    def test_remove_recomputes_union(self):
+        c = base_cluster()
+        c.add_pod(prof_pod("pod1", "z-seccomp", node="test"))
+        c.add_pod(prof_pod("pod2", "x-seccomp", node="test"))
+        c.remove_pod("default/pod2")
+        assert host_union_size(c, "test") == len(Z_SET)
+        c.remove_pod("default/pod1")
+        assert host_union_size(c, "test") == 0
+
+    def test_new_binding_extends_union(self):
+        c = base_cluster()
+        c.add_pod(prof_pod("pod1", "z-seccomp", node="test"))
+        assert host_union_size(c, "test") == len(Z_SET)
+        c.add_pod(prof_pod("pod2", "x-seccomp", node="test"))
+        assert host_union_size(c, "test") == len(Z_SET | X_SET)
+
+
+class TestResolutionMerge:
+    def _pod_set_size(self, c, pod):
+        c.add_pod(pod)
+        snap, meta = c.snapshot([pod], now_ms=0)
+        i = meta.pod_names.index(pod.uid)
+        return (int(np.asarray(snap.syscalls.pod_sets[i]).sum()),
+                bool(np.asarray(snap.syscalls.has_profile[i])))
+
+    def test_container_and_annotation_references_union(self):
+        c = base_cluster()
+        pod = Pod(name="p", containers=[
+            Container(requests={CPU: 100}, seccomp_profile="z-seccomp")],
+            annotations={"container.seccomp.security.alpha.kubernetes.io/c":
+                         "localhost/operator/default/x-seccomp.json"})
+        assert self._pod_set_size(c, pod) == (len(Z_SET | X_SET), True)
+
+    def test_init_container_profile_counts(self):
+        c = base_cluster()
+        pod = Pod(name="p",
+                  containers=[Container(requests={CPU: 100})],
+                  init_containers=[Container(seccomp_profile="x-seccomp")])
+        assert self._pod_set_size(c, pod) == (len(X_SET), True)
+
+    def test_bare_name_resolves_in_pod_namespace(self):
+        c = base_cluster()
+        c.add_seccomp_profile(SeccompProfile(
+            name="z-seccomp", namespace="other", syscalls=frozenset({"mmap"})))
+        pod = prof_pod("p", "z-seccomp", namespace="other")
+        assert self._pod_set_size(c, pod) == (1, True)
+
+    def test_qualified_name_crosses_namespaces(self):
+        c = base_cluster()
+        pod = prof_pod("p", "default/x-seccomp", namespace="other")
+        assert self._pod_set_size(c, pod) == (len(X_SET), True)
+
+    def test_unresolvable_reference_without_default_is_unprofiled(self):
+        c = base_cluster()
+        pod = prof_pod("p", "no-such-profile")
+        assert self._pod_set_size(c, pod) == (0, False)
+
+
+def brute_force_scores(host_pods, pod_set, node_names):
+    """The reference's Score loop over real Python sets
+    (sysched.go:234-279)."""
+    scores = {}
+    for node in node_names:
+        sets_on_node = host_pods.get(node, [])
+        if not sets_on_node:
+            scores[node] = 0
+            continue
+        host = set().union(*sets_on_node)
+        total = len(host - pod_set)
+        new_host = host | pod_set
+        for existing in sets_on_node:
+            total += len(new_host - existing)
+        scores[node] = total
+    return scores
+
+
+class TestScoreDecompositionDifferential:
+    """The (counts, host_sets, host_pod_count) tensor decomposition equals
+    the reference's per-existing-pod set loop on randomized clusters."""
+
+    def test_random_clusters(self):
+        rng = random.Random(7)
+        universe = [f"sys{i}" for i in range(24)]
+        for trial in range(12):
+            c = Cluster()
+            node_names = [f"n{i}" for i in range(4)]
+            for n in node_names:
+                c.add_node(Node(name=n, allocatable={
+                    CPU: 100_000, MEMORY: 512 * gib, PODS: 500}))
+            profiles = {}
+            for pi in range(6):
+                syscalls = frozenset(
+                    rng.sample(universe, rng.randint(1, len(universe))))
+                name = f"prof{trial}-{pi}"
+                profiles[name] = syscalls
+                c.add_seccomp_profile(SeccompProfile(name=name,
+                                                     syscalls=syscalls))
+            host_pods = {}
+            for i in range(rng.randint(0, 12)):
+                prof = rng.choice(sorted(profiles))
+                node = rng.choice(node_names)
+                c.add_pod(prof_pod(f"bound{i}", prof, node=node))
+                host_pods.setdefault(node, []).append(set(profiles[prof]))
+
+            prof = rng.choice(sorted(profiles))
+            pod = prof_pod("pending", prof)
+            c.add_pod(pod)
+
+            from conftest import raw_plugin_scores
+
+            sched = Scheduler(Profile(plugins=[SySched()]))
+            raw, meta = raw_plugin_scores(c, sched, pod)
+
+            expected = brute_force_scores(host_pods, set(profiles[prof]),
+                                          meta.node_names)
+            got = {meta.node_names[n]: int(raw[n])
+                   for n in range(len(meta.node_names))}
+            assert got == expected, f"trial {trial}: {got} != {expected}"
