@@ -228,24 +228,50 @@ class PreferredSchedulingTerm:
 @dataclass
 class TopologySpreadConstraint:
     """core/v1 TopologySpreadConstraint (whenUnsatisfiable DoNotSchedule
-    filters, ScheduleAnyway scores). minDomains/nodeAffinityPolicy/
-    nodeTaintsPolicy refinements are not modeled."""
+    filters, ScheduleAnyway scores).
+
+    - `min_domains` (DoNotSchedule only): when fewer eligible domains than
+      this exist, the global minimum is treated as 0 (upstream
+      podtopologyspread minMatchNum).
+    - `match_label_keys`: label keys whose values are copied from the
+      incoming pod and merged into the selector as exact-match requirements
+      (keys the pod lacks are ignored, upstream semantics).
+    - node_affinity_policy / node_taints_policy: which nodes count for
+      domain/min computation — Honor (default for affinity) restricts to
+      nodes matching the pod's nodeSelector/affinity; Ignore (default for
+      taints) counts all.
+    """
 
     max_skew: int
     topology_key: str
     when_unsatisfiable: str = "DoNotSchedule"  # | ScheduleAnyway
     label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+    match_label_keys: tuple = ()
+    node_affinity_policy: str = "Honor"  # | Ignore
+    node_taints_policy: str = "Ignore"  # | Honor
 
 
 @dataclass
 class PodAffinityTerm:
     """core/v1 PodAffinityTerm: selector over pod labels, scoped to
-    `namespaces` (empty = the incoming pod's own namespace), co-location
+    `namespaces` plus any namespace matching `namespace_selector` (nil
+    selector adds none; EMPTY selector matches every namespace — metav1
+    semantics); both empty = the incoming pod's own namespace. Co-location
     judged by `topology_key` domains."""
 
     topology_key: str
     label_selector: Optional[LabelSelector] = None
     namespaces: tuple = ()
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace (labels only) — the namespaceSelector target."""
+
+    name: str
+    labels: Mapping[str, str] = field(default_factory=dict)
 
 
 @dataclass

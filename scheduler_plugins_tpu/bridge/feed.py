@@ -56,7 +56,11 @@ term}]} (term = {"match_expressions"/"match_fields":
 [{"max_skew","topology_key","when_unsatisfiable","label_selector"}], and
 "pod_affinity"/"pod_anti_affinity" {"required": [pterm], "preferred":
 [{"weight","term": pterm}]} (pterm = {"topology_key","label_selector",
-"namespaces"}; label_selector = {"match_labels","match_expressions"}).
+"namespaces","namespace_selector"}; label_selector =
+{"match_labels","match_expressions"}). Spread constraints also accept
+"min_domains", "match_label_keys", "node_affinity_policy" and
+"node_taints_policy"; {"op": "upsert_namespace", "name": ..., "labels":
+{...}} | "delete_namespace" carry the namespaceSelector targets.
 
 Every object event may carry "rv" — a per-object monotonic resource
 version; the server drops events at or below the last applied version
@@ -88,6 +92,7 @@ from scheduler_plugins_tpu.api.objects import (
     ElasticQuota,
     LabelSelector,
     LabelSelectorRequirement,
+    Namespace,
     NetworkTopology,
     Node,
     NodeResourceTopology,
@@ -146,7 +151,8 @@ def _pod_term(spec: dict) -> PodAffinityTerm:
     return PodAffinityTerm(
         topology_key=spec["topology_key"],
         label_selector=_label_selector(spec.get("label_selector")),
-        namespaces=tuple(spec.get("namespaces", ())),
+        namespaces=tuple(spec.get("namespaces") or ()),
+        namespace_selector=_label_selector(spec.get("namespace_selector")),
     )
 
 
@@ -191,6 +197,12 @@ def _pod_spec_fragments(event: dict) -> dict:
                     "when_unsatisfiable", "DoNotSchedule"
                 ),
                 label_selector=_label_selector(c.get("label_selector")),
+                min_domains=(
+                    int(c["min_domains"]) if c.get("min_domains") else None
+                ),
+                match_label_keys=tuple(c.get("match_label_keys") or ()),
+                node_affinity_policy=c.get("node_affinity_policy", "Honor"),
+                node_taints_policy=c.get("node_taints_policy", "Ignore"),
             )
             for c in event["topology_spread"]
         ]
@@ -231,6 +243,8 @@ _RV_KINDS = {
     "upsert_seccomp_profile": ("seccomp_profile", ("namespace", "name")),
     "delete_seccomp_profile": ("seccomp_profile", ("namespace", "name")),
     "upsert_priority_class": ("priority_class", ("name",)),
+    "upsert_namespace": ("namespace", ("name",)),
+    "delete_namespace": ("namespace", ("name",)),
     "delete_priority_class": ("priority_class", ("name",)),
     "upsert_pdb": ("pdb", ("namespace", "name")),
     "delete_pdb": ("pdb", ("namespace", "name")),
@@ -494,6 +508,12 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         )
     elif op == "delete_priority_class":
         cluster.priority_classes.pop(event["name"], None)
+    elif op == "upsert_namespace":
+        cluster.add_namespace(
+            Namespace(name=event["name"], labels=event.get("labels") or {})
+        )
+    elif op == "delete_namespace":
+        cluster.namespaces.pop(event["name"], None)
     elif op == "upsert_pdb":
         cluster.add_pdb(
             PodDisruptionBudget(

@@ -60,12 +60,18 @@ class SolverState:
     #: aggregates drop a nominee the moment it places (upstream removes
     #: assumed pods from the nominated set)
     placed_mask: Optional[jnp.ndarray] = None
-    #: (TR, D) live per-(track, topology-domain) pod counts (topology
-    #: spread / inter-pod affinity; track = unique (selector, topology key)
-    #: pair): base = assigned matches, in-cycle placements added by the
-    #: BUILT-IN commit (`ops.selectors.commit_tracks`) — not per-plugin,
-    #: because both consumers read the same carry
+    #: (TR, N) live per-(track, NODE) matching-pod counts (track = unique
+    #: (selector, topology key) pair): base = assigned matches, in-cycle
+    #: placements added by the BUILT-IN commit
+    #: (`ops.selectors.commit_tracks`). Node-level so PodTopologySpread's
+    #: node-inclusion policies can mask ineligible nodes per (pod,
+    #: constraint) at aggregation time.
     sel_counts: Optional[jnp.ndarray] = None
+    #: (TR, D) the same counts pre-aggregated per topology DOMAIN —
+    #: InterPodAffinity (no node-inclusion policy) reads this directly so
+    #: its per-pod checks stay O(1) row gathers; kept in lockstep by the
+    #: same built-in commit
+    sel_dom_counts: Optional[jnp.ndarray] = None
     #: (E, D) live anti-affinity domain presence: True when a pod carrying
     #: existing-anti term e occupies a node in domain d; built-in commit
     anti_domains: Optional[jnp.ndarray] = None

@@ -319,10 +319,18 @@ class Scheduler:
             else None
         )
         sel_counts = None
+        sel_dom_counts = None
         anti_domains = None
         if snap.scheduling is not None:
+            if (
+                snap.scheduling.track_node_base is not None
+                and snap.scheduling.spread_needs_node_counts
+            ):
+                # the node-level carry is only materialized when a spread
+                # eligibility row actually excludes a keyed node
+                sel_counts = jnp.asarray(snap.scheduling.track_node_base)
             if snap.scheduling.track_base is not None:
-                sel_counts = jnp.asarray(snap.scheduling.track_base)
+                sel_dom_counts = jnp.asarray(snap.scheduling.track_base)
             if snap.scheduling.exist_anti_base is not None:
                 anti_domains = jnp.asarray(snap.scheduling.exist_anti_base)
         return SolverState(
@@ -334,6 +342,7 @@ class Scheduler:
             numa_avail=numa_avail,
             placed_mask=placed_mask,
             sel_counts=sel_counts,
+            sel_dom_counts=sel_dom_counts,
             anti_domains=anti_domains,
         )
 

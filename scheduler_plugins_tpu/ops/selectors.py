@@ -1,11 +1,16 @@
 """Framework-maintained selector/topology-domain carries.
 
-The live per-(track, domain) pod counts (`SolverState.sel_counts`) and the
-anti-affinity domain-presence bits (`SolverState.anti_domains`) are read by
-BOTH PodTopologySpread and InterPodAffinity (plugins/intree.py) — so the
-commit is a single built-in step of the solve (like the built-in capacity
-Reserve), not a per-plugin `commit` that would double-apply when both
-plugins are enabled.
+Three live carries, kept in lockstep by ONE built-in commit step of the
+solve (like the built-in capacity Reserve — never per-plugin, which would
+double-apply when multiple consumers are enabled):
+
+- `SolverState.sel_counts` (TR, N): node-level matching-pod counts, read
+  by PodTopologySpread when its node-inclusion policies exclude some
+  keyed node (`spread_needs_node_counts`); otherwise not materialized.
+- `SolverState.sel_dom_counts` (TR, D): the same counts per topology
+  domain — read by InterPodAffinity always (no node-inclusion policy)
+  and by PodTopologySpread on its fast path.
+- `SolverState.anti_domains` (E, D): anti-affinity domain presence bits.
 
 Tables come from `state.scheduling.SchedulingState`:
     pend_match (S, P)  pod q matches selector group s
@@ -21,15 +26,27 @@ import jax.numpy as jnp
 
 def commit_tracks(state, sched, p, choice):
     """Fold pod `p`'s placement on `choice` (-1 = none) into the carries."""
-    if state.sel_counts is not None and sched.track_base is not None:
-        dom = sched.topo_code[sched.track_topo, choice]  # (TR,)
-        inc = sched.pend_match[sched.track_sel, p] & (choice >= 0) & (dom >= 0)
-        TR = state.sel_counts.shape[0]
-        state = state.replace(
-            sel_counts=state.sel_counts.at[
-                jnp.arange(TR), jnp.maximum(dom, 0)
-            ].add(inc.astype(state.sel_counts.dtype))
-        )
+    if sched.track_base is not None and (
+        state.sel_counts is not None or state.sel_dom_counts is not None
+    ):
+        inc = sched.pend_match[sched.track_sel, p] & (choice >= 0)  # (TR,)
+        TR = sched.track_base.shape[0]
+        if state.sel_counts is not None:
+            state = state.replace(
+                sel_counts=state.sel_counts.at[
+                    jnp.arange(TR), jnp.maximum(choice, 0)
+                ].add(inc.astype(state.sel_counts.dtype))
+            )
+        if state.sel_dom_counts is not None:
+            # domain-level mirror (key-less nodes have no domain: dom < 0
+            # contributes nothing)
+            dom = sched.topo_code[sched.track_topo, choice]  # (TR,)
+            inc_d = inc & (dom >= 0)
+            state = state.replace(
+                sel_dom_counts=state.sel_dom_counts.at[
+                    jnp.arange(TR), jnp.maximum(dom, 0)
+                ].add(inc_d.astype(state.sel_dom_counts.dtype))
+            )
     if state.anti_domains is not None and sched.exist_anti_sel is not None:
         dom = sched.topo_code[sched.exist_anti_topo, choice]  # (E,)
         mark = (

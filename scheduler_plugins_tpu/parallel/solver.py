@@ -266,7 +266,9 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
         # span nodes, so neither the per-wave re-filter nor the same-node
         # wave guard can see a same-wave cross-node conflict. Validators
         # re-check each wave's winners sequentially in queue order against
-        # the live carry (O(1) gathers per pod) inside the waterfill; their
+        # the live carry inside the waterfill (O(1) gathers per pod on the
+        # common fast path; a (CT,N)->(CT,D) scatter per pod only when a
+        # spread node-inclusion policy excludes a keyed node); their
         # carries commit per pod there, every other dyn carry batch-commits
         # on the kept winners.
         validators = tuple(

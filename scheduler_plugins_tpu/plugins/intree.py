@@ -20,13 +20,13 @@ small segment sums.
 - TaintToleration: Filter = no untolerated NoSchedule/NoExecute taint;
   Score = count of untolerated PreferNoSchedule taints, reverse-normalized
   (upstream tainttoleration.go CountIntolerableTaintsPreferNoSchedule).
-- PodTopologySpread: live per-selector counts carried through the solve
-  (`SolverState.sel_counts`); Filter enforces DoNotSchedule constraints
-  (matchNum + self − globalMin <= maxSkew over the constraint key's
-  domains); Score sums ScheduleAnyway match counts, reverse-normalized.
-  Not modeled: minDomains, nodeAffinityPolicy/nodeTaintsPolicy refinements
-  (upstream defaults approximated by counting over all ready nodes with the
-  key), matchLabelKeys.
+- PodTopologySpread: live per-selector NODE-level counts carried through
+  the solve (`SolverState.sel_counts`); Filter enforces DoNotSchedule
+  constraints (matchNum + self − globalMin <= maxSkew over the constraint
+  key's domains); Score sums ScheduleAnyway match counts,
+  reverse-normalized. minDomains, matchLabelKeys and nodeAffinityPolicy/
+  nodeTaintsPolicy are honored: counts aggregate into domains per (pod,
+  constraint) under the node-inclusion policies.
 """
 
 from __future__ import annotations
@@ -97,15 +97,16 @@ class NodeAffinity(Plugin):
 class PodTopologySpread(Plugin):
     """maxSkew spreading over topology domains.
 
-    Live counts are (TR, D) per (selector-track, domain), carried through
-    the solve; every check is a handful of gathers:
+    Live counts are (TR, N) per (selector-track, NODE), carried through the
+    solve and aggregated into (CT, D) domain counts per pod under the
+    node-inclusion policies; the check per node is then
 
-        matchNum(node) = counts[track, domain(node)]
+        matchNum(node) = dc[constraint, domain(node)]
         verdict(node)  = has_key(node)
-                         & (matchNum + selfMatch - min_domain <= maxSkew)
+                         & (matchNum + selfMatch - globalMin <= maxSkew)
 
-    with min_domain the minimum count over the key's existing domains
-    (upstream's global minimum). DoNotSchedule constraints filter;
+    with globalMin the minimum count over the constraint's ELIGIBLE domains
+    (0 when fewer than minDomains exist). DoNotSchedule constraints filter;
     ScheduleAnyway constraints score (summed match counts, fewer = better).
     """
 
@@ -116,29 +117,69 @@ class PodTopologySpread(Plugin):
     state_dependent_filter = True
 
     def _counts(self, state, snap):
+        """(TR, N) live node-level counts — materialized only when some
+        eligibility row actually excludes a keyed node."""
         if state is not None and state.sel_counts is not None:
             return state.sel_counts
+        return snap.scheduling.track_node_base
+
+    def _dom_counts(self, state, snap):
+        """(TR, D) live domain mirror — the O(1)-gather fast path."""
+        if state is not None and state.sel_dom_counts is not None:
+            return state.sel_dom_counts
         return snap.scheduling.track_base
 
     def _constraint_state(self, state, snap, p):
-        """Per-constraint (CT,) tensors shared by filter/score/validate:
-        live domain counts, the global per-constraint minimum, and masks."""
+        """Per-constraint live tensors shared by filter/score/validate:
+        (CT, D) eligible-node domain counts, the global minimum (minDomains
+        applied), and the (CT, N) code/has lookup rows.
+
+        Node inclusion mirrors upstream: a node's pods count toward a
+        constraint's domains/minimum only when the node carries all the
+        pod's constraint keys OF THE SAME CLASS (hard keys in the
+        PreFilter counting, soft keys in PreScore), matches the pod's
+        nodeSelector/required affinity (nodeAffinityPolicy Honor — the
+        default), and tolerates its NoSchedule/NoExecute taints
+        (nodeTaintsPolicy Honor; default Ignore). The masks are fully
+        static, so they are host-precomputed interned rows
+        (`spread_elig`); when NO row excludes a keyed node
+        (`spread_needs_node_counts` False — the common case) the counting
+        is provably identical to the (TR, D) domain mirror and this
+        reduces to row gathers."""
         s = snap.scheduling
-        counts = self._counts(state, snap)  # (TR, D)
-        track = s.spread_track[p]  # (CT,)
-        dc = counts[track]  # (CT, D)
-        exists = s.domain_exists[s.spread_topo[p]]  # (CT, D)
+        code = s.topo_code[s.spread_topo[p]]  # (CT, N)
+        has = s.topo_has[s.spread_topo[p]]  # (CT, N)
+        if s.spread_needs_node_counts:
+            counts = self._counts(state, snap)  # (TR, N)
+            dcn = counts[s.spread_track[p]]  # (CT, N)
+            elig = s.spread_elig[s.spread_elig_idx[p]] & (code >= 0)
+            CT, N = code.shape
+            D = s.domain_exists.shape[1]
+            rows = jnp.broadcast_to(jnp.arange(CT)[:, None], (CT, N))
+            col = jnp.maximum(code, 0)
+            dc = jnp.zeros((CT, D), counts.dtype).at[rows, col].add(
+                jnp.where(elig, dcn, 0)
+            )
+            exists = jnp.zeros((CT, D), bool).at[rows, col].max(elig)
+        else:
+            dc = self._dom_counts(state, snap)[s.spread_track[p]]  # (CT, D)
+            exists = s.domain_exists[s.spread_topo[p]]  # (CT, D)
         big = jnp.int64(1) << 62
+        # no eligible domain -> minimum stays `big` and the skew check
+        # passes trivially (upstream CriticalPaths stay MaxInt32)
         minm = jnp.min(jnp.where(exists, dc, big), axis=1)  # (CT,)
-        return s, dc, minm
+        # minDomains (upstream minMatchNum): fewer eligible domains than
+        # required -> the global minimum is treated as 0
+        dn = jnp.sum(exists, axis=1)  # (CT,)
+        md = s.spread_min_domains[p]
+        minm = jnp.where((md > 0) & (dn < md), 0, minm)
+        return s, dc, minm, code, has
 
     def filter(self, state, snap, p):
         s = snap.scheduling
         if s is None or s.spread_track is None:
             return None
-        s, dc, minm = self._constraint_state(state, snap, p)
-        code = s.topo_code[s.spread_topo[p]]  # (CT, N)
-        has = s.topo_has[s.spread_topo[p]]  # (CT, N)
+        s, dc, minm, code, has = self._constraint_state(state, snap, p)
         match_at = jnp.take_along_axis(
             dc, jnp.maximum(code, 0), axis=1
         )  # (CT, N)
@@ -154,9 +195,7 @@ class PodTopologySpread(Plugin):
         s = snap.scheduling
         if s is None or s.spread_track is None:
             return None
-        s, dc, _ = self._constraint_state(state, snap, p)
-        code = s.topo_code[s.spread_topo[p]]
-        has = s.topo_has[s.spread_topo[p]]
+        s, dc, _, code, has = self._constraint_state(state, snap, p)
         match_at = jnp.take_along_axis(dc, jnp.maximum(code, 0), axis=1)
         applies = (s.spread_mask[p] & ~s.spread_hard[p])[:, None] & has
         return jnp.sum(jnp.where(applies, match_at, 0), axis=0)
@@ -167,22 +206,22 @@ class PodTopologySpread(Plugin):
 
     def validate_at(self, state, snap, p, node):
         """Hard-constraint re-check at one node against the live carry —
-        O(CT x D), used by the batched solver's post-wave demotion scan
-        (domain constraints span nodes, so the same-node wave guard cannot
-        see them)."""
+        used by the batched solver's post-wave demotion scan (domain
+        constraints span nodes, so the same-node wave guard cannot see
+        them)."""
         s = snap.scheduling
         if s is None or s.spread_track is None:
             return jnp.bool_(True)
-        s, dc, minm = self._constraint_state(state, snap, p)
-        code = s.topo_code[s.spread_topo[p], node]  # (CT,)
-        has = s.topo_has[s.spread_topo[p], node]
+        s, dc, minm, code, has = self._constraint_state(state, snap, p)
+        code_n = code[:, node]  # (CT,)
+        has_n = has[:, node]
         match_at = jnp.take_along_axis(
-            dc, jnp.maximum(code, 0)[:, None], axis=1
+            dc, jnp.maximum(code_n, 0)[:, None], axis=1
         ).squeeze(1)
         selfm = s.spread_self[p].astype(jnp.int64)
         ok = match_at + selfm - minm <= s.spread_max_skew[p]
         applies = s.spread_mask[p] & s.spread_hard[p]
-        return jnp.all(jnp.where(applies, has & ok, True))
+        return jnp.all(jnp.where(applies, has_n & ok, True))
 
 
 class InterPodAffinity(Plugin):
@@ -205,16 +244,20 @@ class InterPodAffinity(Plugin):
     - preferred terms score weight x domain match count (anti negative),
       min-max normalized.
 
-    Not modeled: namespaceSelector, symmetric weighting of EXISTING pods'
-    preferred terms toward the incoming pod.
+    namespaceSelector resolves host-side against the cluster's Namespace
+    objects (empty selector = all namespaces). Not modeled: symmetric
+    weighting of EXISTING pods' preferred terms toward the incoming pod.
     """
 
     name = "InterPodAffinity"
     state_dependent_filter = True
 
     def _counts(self, state, snap):
-        if state is not None and state.sel_counts is not None:
-            return state.sel_counts
+        """(TR, D) domain-level counts — affinity has no node-inclusion
+        policy, so it reads the pre-aggregated mirror (O(1) row gathers
+        instead of per-pod node->domain scatters)."""
+        if state is not None and state.sel_dom_counts is not None:
+            return state.sel_dom_counts
         return snap.scheduling.track_base
 
     def _anti_domains(self, state, snap):
@@ -248,7 +291,7 @@ class InterPodAffinity(Plugin):
         # the incoming pod's own required anti terms
         codeb = s.topo_code[s.anti_topo[p]]
         hasb = s.topo_has[s.anti_topo[p]]
-        dcb = counts[s.anti_track[p]]
+        dcb = counts[s.anti_track[p]]  # (BT, D)
         match_b = jnp.take_along_axis(dcb, jnp.maximum(codeb, 0), axis=1)
         okb = ~hasb | (match_b == 0)
         verdict &= jnp.all(

@@ -40,6 +40,8 @@ class Cluster:
     seccomp_profiles: dict[str, SeccompProfile] = field(default_factory=dict)
     priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
     pdbs: dict[str, PodDisruptionBudget] = field(default_factory=dict)
+    #: Namespace objects (labels) — PodAffinityTerm.namespaceSelector targets
+    namespaces: dict[str, "Namespace"] = field(default_factory=dict)
     node_metrics: Optional[dict] = None
     #: TargetLoadPacking pod CPU-prediction parameters
     #: (multiplier, default-request millis) — installed by the plugin's
@@ -273,6 +275,9 @@ class Cluster:
     def add_priority_class(self, pc: PriorityClass):
         self.priority_classes[pc.name] = pc
 
+    def add_namespace(self, ns):
+        self.namespaces[ns.name] = ns
+
     def add_pdb(self, pdb: PodDisruptionBudget):
         self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
 
@@ -464,5 +469,6 @@ class Cluster:
             sysched_default_profile=getattr(
                 self, "sysched_default_profile", None
             ),
+            namespaces=list(self.namespaces.values()),
             **kwargs,
         )

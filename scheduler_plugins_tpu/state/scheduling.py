@@ -41,7 +41,12 @@ from typing import Optional, Sequence
 import numpy as np
 from flax import struct
 
-from scheduler_plugins_tpu.api.objects import Node, Pod
+from scheduler_plugins_tpu.api.objects import (
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    Pod,
+)
 
 I64 = np.int64
 I32 = np.int32
@@ -69,7 +74,14 @@ class SchedulingState:
     domain_exists: Optional[np.ndarray] = None  # (K, D) bool
     track_sel: Optional[np.ndarray] = None  # (TR,) int32 selector group
     track_topo: Optional[np.ndarray] = None  # (TR,) int32 key code
-    track_base: Optional[np.ndarray] = None  # (TR, D) int64 assigned matches
+    #: (TR, N) int64 matching ASSIGNED pods per NODE. Node-level (not
+    #: domain-level) so PodTopologySpread's nodeAffinityPolicy /
+    #: nodeTaintsPolicy can exclude ineligible nodes' pods per (pod,
+    #: constraint) at aggregation time.
+    track_node_base: Optional[np.ndarray] = None
+    #: (TR, D) the same counts per topology domain (nodes with the key
+    #: only) — InterPodAffinity's O(1)-gather view
+    track_base: Optional[np.ndarray] = None
     # per-pod spread constraints, padded to CT
     spread_track: Optional[np.ndarray] = None  # (P, CT) int32 track index
     spread_topo: Optional[np.ndarray] = None  # (P, CT) int32 key code
@@ -77,6 +89,27 @@ class SchedulingState:
     spread_hard: Optional[np.ndarray] = None  # (P, CT) bool DoNotSchedule
     spread_self: Optional[np.ndarray] = None  # (P, CT) bool pod matches own sel
     spread_mask: Optional[np.ndarray] = None  # (P, CT) bool
+    #: (P, CT) int64 minDomains (0 = unset): when fewer ELIGIBLE domains
+    #: than this exist, the global minimum is treated as 0 (upstream
+    #: podtopologyspread minMatchNum)
+    spread_min_domains: Optional[np.ndarray] = None
+    #: (P, CT) bool nodeAffinityPolicy == Honor: only nodes matching the
+    #: pod's nodeSelector/required affinity count toward domains/minimum
+    spread_policy_affinity: Optional[np.ndarray] = None
+    #: (P, CT) bool nodeTaintsPolicy == Honor: only nodes whose
+    #: NoSchedule/NoExecute taints the pod tolerates count
+    spread_policy_taints: Optional[np.ndarray] = None
+    #: (EL, N) bool interned node-eligibility rows (class-keys x policies),
+    #: fully static -> precomputed host-side; (P, CT) row index
+    spread_elig: Optional[np.ndarray] = None
+    spread_elig_idx: Optional[np.ndarray] = None
+    #: STATIC python bool (not a pytree leaf): True only when some (pod,
+    #: constraint) eligibility row actually excludes a node that carries
+    #: the constraint's key. False -> the spread plugin reads the O(1)
+    #: (TR, D) domain mirror and the (TR, N) node carry is not materialized
+    spread_needs_node_counts: bool = struct.field(
+        pytree_node=False, default=False
+    )
     # per-pod inter-pod affinity terms, padded to AT/BT/WT. `*_self` marks
     # the upstream first-pod special case: the term matches the incoming
     # pod itself, so an otherwise-empty cluster does not deadlock.
@@ -193,8 +226,11 @@ def build_scheduling(
     N: int,
     P: int,
     assigned: Sequence[Pod] = (),
+    namespaces: Sequence = (),
 ) -> Optional[SchedulingState]:
-    """Lower specs into `SchedulingState`; None when nothing is relevant."""
+    """Lower specs into `SchedulingState`; None when nothing is relevant.
+    `namespaces` are the cluster's Namespace objects — the
+    PodAffinityTerm.namespaceSelector targets."""
     if not relevant(nodes, pending, assigned):
         return None
 
@@ -265,11 +301,61 @@ def build_scheduling(
         tol_ok=tol_ok,
         tol_prefer=tol_prefer,
         pod_tol=pod_tol,
-        **_build_selector_tables(nodes, pending, assigned, N, P),
+        **_build_selector_tables(
+            nodes, pending, assigned, N, P, namespaces,
+            pod_aff_rows=node_term_ok[
+                np.where(pod_node_term < 0, T, pod_node_term)
+            ],
+            pod_tol_rows=tol_ok[pod_tol],
+        ),
     )
 
 
-def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
+def _merged_spread_selector(pod: Pod, tsc):
+    """matchLabelKeys (upstream podtopologyspread): the incoming pod's
+    values for the listed keys are appended to the selector as exact-match
+    requirements; keys the pod lacks are ignored; a nil selector stays nil
+    (matches nothing)."""
+    sel = tsc.label_selector
+    if sel is None or not tsc.match_label_keys:
+        return sel
+    extra = [
+        k for k in tsc.match_label_keys if k in pod.labels
+    ]
+    if not extra:
+        return sel
+    return LabelSelector(
+        match_labels=dict(sel.match_labels),
+        match_expressions=list(sel.match_expressions)
+        + [
+            LabelSelectorRequirement(k, "In", (pod.labels[k],))
+            for k in extra
+        ],
+    )
+
+
+def _term_scope(pod: Pod, term, namespaces) -> tuple:
+    """Effective namespace scope of a PodAffinityTerm: the explicit list
+    plus namespaces matching namespaceSelector (EMPTY selector matches
+    every namespace -> the "*" wildcard scope). The own-namespace fallback
+    applies ONLY when the list is empty AND the selector is nil — a
+    non-nil selector matching zero namespaces yields an empty scope that
+    matches nothing (upstream GetNamespaceLabelsSnapshot semantics)."""
+    scope = set(term.namespaces)
+    sel = getattr(term, "namespace_selector", None)
+    if sel is not None:
+        if not sel.match_labels and not sel.match_expressions:
+            return ("*",)
+        scope.update(ns.name for ns in namespaces if sel.matches(ns.labels))
+    elif not scope:
+        scope = {pod.namespace}
+    return tuple(sorted(scope))
+
+
+def _build_selector_tables(
+    nodes, pending, assigned, N, P, namespaces=(),
+    pod_aff_rows=None, pod_tol_rows=None,
+) -> dict:
     """Selector-group / topology-domain / track tables for PodTopologySpread
     and InterPodAffinity: a track = unique (selector group, topology key)
     pair; assigned pods aggregate into per-(track, domain) base counts;
@@ -303,7 +389,7 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
 
     def term_ids(pod: Pod, term) -> tuple[int, int, int]:
         """(sel, key, track) for a PodAffinityTerm scoped to the pod."""
-        scope = tuple(term.namespaces) or (pod.namespace,)
+        scope = _term_scope(pod, term, namespaces)
         s = sel_id(scope, term.label_selector)
         k = key_id(term.topology_key)
         return s, k, track_id(s, k)
@@ -315,18 +401,25 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
     spread_hard = np.zeros((P, CT), bool)
     spread_self = np.zeros((P, CT), bool)
     spread_mask = np.zeros((P, CT), bool)
+    spread_min_domains = np.zeros((P, CT), I64)
+    spread_policy_affinity = np.zeros((P, CT), bool)
+    spread_policy_taints = np.zeros((P, CT), bool)
     for i, pod in enumerate(pending):
         for c, tsc in enumerate(pod.topology_spread):
-            s = sel_id((pod.namespace,), tsc.label_selector)
+            sel = _merged_spread_selector(pod, tsc)
+            s = sel_id((pod.namespace,), sel)
             k = key_id(tsc.topology_key)
             spread_track[i, c] = track_id(s, k)
             spread_topo[i, c] = k
             spread_max_skew[i, c] = tsc.max_skew
             spread_hard[i, c] = tsc.when_unsatisfiable == "DoNotSchedule"
-            spread_self[i, c] = _sel_matches(
-                tsc.label_selector, (pod.namespace,), pod
-            )
+            spread_self[i, c] = _sel_matches(sel, (pod.namespace,), pod)
             spread_mask[i, c] = True
+            spread_min_domains[i, c] = tsc.min_domains or 0
+            spread_policy_affinity[i, c] = (
+                tsc.node_affinity_policy != "Ignore"
+            )
+            spread_policy_taints[i, c] = tsc.node_taints_policy == "Honor"
 
     # inter-pod affinity terms (incoming pod's own)
     AT = max((len(p.pod_affinity_required) for p in pending), default=1) or 1
@@ -372,7 +465,7 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
             aff_track[i, c] = t
             aff_topo[i, c] = k
             aff_self[i, c] = _sel_matches(
-                term.label_selector, tuple(term.namespaces) or (pod.namespace,), pod
+                term.label_selector, _term_scope(pod, term, namespaces), pod
             )
             aff_mask[i, c] = True
         for c, term in enumerate(pod.pod_anti_affinity_required):
@@ -404,7 +497,7 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
     assigned_carrier_terms: list[tuple[Pod, int]] = []
     for pod in assigned:
         for term in pod.pod_anti_affinity_required:
-            scope = tuple(term.namespaces) or (pod.namespace,)
+            scope = _term_scope(pod, term, namespaces)
             s = sel_id(scope, term.label_selector)
             k = key_id(term.topology_key)
             e = anti_term_id(s, k)
@@ -433,6 +526,50 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
         for code in dv.values():
             domain_exists[k, code] = True
 
+    # --- static spread node-eligibility rows (upstream node-inclusion:
+    # per-class all-keys presence, nodeAffinityPolicy, nodeTaintsPolicy).
+    # Interned: replicas share rows; the common all-true row is index 0.
+    elig_rows: dict = {}
+    elig_list: list = []
+    spread_elig_idx = np.zeros((P, CT), I32)
+    needs_node_counts = False
+
+    def elig_intern(row: np.ndarray) -> int:
+        key = row.tobytes()
+        if key not in elig_rows:
+            elig_rows[key] = len(elig_list)
+            elig_list.append(row)
+        return elig_rows[key]
+
+    elig_intern(np.ones(N, bool))  # row 0: no exclusions
+    any_taints = any(n.taints for n in nodes)
+    for i, pod in enumerate(pending):
+        if not pod.topology_spread:
+            continue
+        class_keys = {True: [], False: []}
+        for tsc in pod.topology_spread:
+            class_keys[tsc.when_unsatisfiable == "DoNotSchedule"].append(
+                keys[tsc.topology_key]
+            )
+        for c, tsc in enumerate(pod.topology_spread):
+            row = np.ones(N, bool)
+            hard = tsc.when_unsatisfiable == "DoNotSchedule"
+            for k in class_keys[hard]:
+                row &= topo_has[k]
+            if spread_policy_affinity[i, c] and (
+                pod.node_selector or pod.node_affinity_required
+            ):
+                # reuse the interned node-affinity verdict row
+                row &= pod_aff_rows[i]
+            if spread_policy_taints[i, c] and any_taints:
+                # reuse the interned untolerated-taint row
+                row &= pod_tol_rows[i]
+            spread_elig_idx[i, c] = elig_intern(row)
+            k = keys[tsc.topology_key]
+            if np.any(~row & (topo_code[k] >= 0)):
+                needs_node_counts = True
+    spread_elig = np.stack(elig_list)
+
     TR = max(len(tracks), 1)
     track_sel = np.zeros(TR, I32)
     track_topo = np.zeros(TR, I32)
@@ -441,16 +578,19 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
         track_topo[t] = k
 
     node_pos = {node.name: n for n, node in enumerate(nodes)}
+    track_node_base = np.zeros((TR, N), I64)
     track_base = np.zeros((TR, D), I64)
     for pod in assigned:
         n = node_pos.get(pod.node_name)
         if n is None:
             continue
         for (s, k), t in tracks.items():
-            code = topo_code[k, n]
             ns, selector = sel_objs[s]
-            if code >= 0 and _sel_matches(selector, ns, pod):
-                track_base[t, code] += 1
+            if _sel_matches(selector, ns, pod):
+                track_node_base[t, n] += 1
+                code = topo_code[k, n]
+                if code >= 0:
+                    track_base[t, code] += 1
     pend_match = np.zeros((S, P), bool)
     for i, pod in enumerate(pending):
         for s, (ns, selector) in enumerate(sel_objs):
@@ -463,6 +603,7 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
         domain_exists=domain_exists,
         track_sel=track_sel,
         track_topo=track_topo,
+        track_node_base=track_node_base if needs_node_counts else None,
         track_base=track_base,
         spread_track=spread_track,
         spread_topo=spread_topo,
@@ -470,6 +611,12 @@ def _build_selector_tables(nodes, pending, assigned, N, P) -> dict:
         spread_hard=spread_hard,
         spread_self=spread_self,
         spread_mask=spread_mask,
+        spread_min_domains=spread_min_domains,
+        spread_policy_affinity=spread_policy_affinity,
+        spread_policy_taints=spread_policy_taints,
+        spread_elig=spread_elig,
+        spread_elig_idx=spread_elig_idx,
+        spread_needs_node_counts=needs_node_counts,
         aff_track=aff_track,
         aff_topo=aff_topo,
         aff_self=aff_self,
@@ -521,7 +668,7 @@ def _sel_matches(selector, ns_scope, pod: Pod) -> bool:
     a tuple of namespaces (PodAffinityTerm.namespaces)."""
     if isinstance(ns_scope, str):
         ns_scope = (ns_scope,)
-    if pod.namespace not in ns_scope:
+    if "*" not in ns_scope and pod.namespace not in ns_scope:
         return False
     if selector is None:
         return False

@@ -345,6 +345,7 @@ def build_snapshot(
     native_nodes: Optional[dict] = None,
     tlp_prediction: tuple = (1.5, 1000),
     sysched_default_profile: Optional[str] = None,
+    namespaces: Sequence = (),
 ) -> tuple[ClusterSnapshot, SnapshotMeta]:
     """Lower host objects into a `ClusterSnapshot`.
 
@@ -797,7 +798,8 @@ def build_snapshot(
         if seccomp_profiles
         else None,
         scheduling=_sched.build_scheduling(
-            nodes, pending_pods, N, P, assigned=assigned_pods
+            nodes, pending_pods, N, P, assigned=assigned_pods,
+            namespaces=namespaces,
         ),
     )
     # hand jit-ready device arrays to callers (numpy is build-time only;

@@ -1,0 +1,344 @@
+"""PodTopologySpread minDomains / matchLabelKeys / node-inclusion policies
+and InterPodAffinity namespaceSelector — decision tables mirroring the
+upstream kube-scheduler semantics these fields have (calPreFilterState node
+inclusion, minMatchNum, matchLabelKeys selector merge, namespaceSelector
+scope resolution)."""
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    LabelSelector,
+    Namespace,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    Taint,
+    TopologySpreadConstraint,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import InterPodAffinity, PodTopologySpread
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def mknode(name, zone=None, labels=None, taints=None):
+    labels = dict(labels or {})
+    if zone is not None:
+        labels["zone"] = zone
+    return Node(
+        name=name,
+        allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110},
+        labels=labels,
+        taints=taints or [],
+    )
+
+
+def mkpod(name, labels=None, node=None, namespace="default", **kw):
+    p = Pod(
+        name=name,
+        namespace=namespace,
+        containers=[Container(requests={CPU: 100, MEMORY: gib})],
+        labels=labels or {},
+        **kw,
+    )
+    p.node_name = node
+    return p
+
+
+def spread(max_skew=1, **kw):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key="zone",
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+        **kw,
+    )
+
+
+def run(cluster, plugins):
+    sched = Scheduler(Profile(plugins=plugins))
+    return run_cycle(sched, cluster, now=1000)
+
+
+def two_zone_cluster(**node_kw):
+    c = Cluster()
+    c.add_node(mknode("a1", zone="z1"))
+    c.add_node(mknode("b1", zone="z2"))
+    c.add_pod(mkpod("e1", labels={"app": "x"}, node="a1"))
+    c.add_pod(mkpod("e2", labels={"app": "x"}, node="b1"))
+    return c
+
+
+class TestMinDomains:
+    def test_without_min_domains_balanced_domains_admit(self):
+        # 1 pod in each of 2 domains, maxSkew 1: global min 1, so a third
+        # pod lands anywhere (1 + 1 - 1 = 1 <= 1)
+        c = two_zone_cluster()
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[spread()]))
+        r = run(c, [PodTopologySpread()])
+        assert "default/p" in r.bound
+
+    def test_min_domains_unmet_forces_min_zero(self):
+        # minDomains 3 > the 2 existing domains: global min treated as 0,
+        # so every node shows skew 1 + 1 - 0 = 2 > maxSkew -> unschedulable
+        c = two_zone_cluster()
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[spread(min_domains=3)]))
+        r = run(c, [PodTopologySpread()])
+        assert r.failed == ["default/p"]
+
+    def test_min_domains_met_is_inert(self):
+        c = two_zone_cluster()
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[spread(min_domains=2)]))
+        r = run(c, [PodTopologySpread()])
+        assert "default/p" in r.bound
+
+
+class TestMatchLabelKeys:
+    def test_other_version_pods_do_not_count(self):
+        # existing pods are version v1; the incoming pod is v2 with
+        # matchLabelKeys ["version"]: the merged selector counts only v2
+        # pods -> all domains empty -> z1 admits despite hosting a v1 pod
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_pod(mkpod("e1", labels={"app": "x", "version": "v1"},
+                        node="a1"))
+        c.add_pod(mkpod("e2", labels={"app": "x", "version": "v1"},
+                        node="a1"))
+        c.add_pod(mkpod("p", labels={"app": "x", "version": "v2"},
+                        topology_spread=[
+                            spread(match_label_keys=("version",))]))
+        r = run(c, [PodTopologySpread()])
+        assert r.bound["default/p"] == "a1"
+
+    def test_same_version_pods_still_count(self):
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_node(mknode("b1", zone="z2"))
+        c.add_pod(mkpod("e1", labels={"app": "x", "version": "v2"},
+                        node="a1"))
+        c.add_pod(mkpod("p", labels={"app": "x", "version": "v2"},
+                        topology_spread=[
+                            spread(match_label_keys=("version",))]))
+        r = run(c, [PodTopologySpread()])
+        # z1 has 1 matching pod, z2 has 0 -> min 0 -> z1 skew 2 > 1
+        assert r.bound["default/p"] == "b1"
+
+    def test_key_missing_from_pod_is_ignored(self):
+        # the incoming pod lacks "version": the key contributes nothing
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_node(mknode("b1", zone="z2"))
+        c.add_pod(mkpod("e1", labels={"app": "x", "version": "v9"},
+                        node="a1"))
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[
+                            spread(match_label_keys=("version",))]))
+        r = run(c, [PodTopologySpread()])
+        assert r.bound["default/p"] == "b1"  # plain app=x counting
+
+
+class TestNodeInclusionPolicies:
+    def _cluster_with_ineligible_zone(self, taint=False):
+        # z1/z2 each host a matching pod; z3's only node is ineligible for
+        # the incoming pod (fails nodeSelector, or is tainted). If z3
+        # counted, its empty domain would drag the global min to 0 and
+        # z1/z2 would show skew 2 > 1.
+        c = two_zone_cluster()
+        if taint:
+            c.add_node(mknode(
+                "c1", zone="z3",
+                taints=[Taint(key="dedicated", value="infra",
+                              effect="NoSchedule")]))
+        else:
+            c.add_node(mknode("c1", zone="z3"))  # lacks disk=ssd
+            for n in ("a1", "b1"):
+                c.nodes[n].labels["disk"] = "ssd"
+        return c
+
+    def test_affinity_policy_honor_excludes_unmatched_nodes(self):
+        # default Honor: z3 (fails the pod's nodeSelector) is excluded from
+        # the min computation -> min 1 -> pod lands in z1 or z2
+        c = self._cluster_with_ineligible_zone()
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        node_selector={"disk": "ssd"},
+                        topology_spread=[spread()]))
+        from scheduler_plugins_tpu.plugins import NodeAffinity
+
+        r = run(c, [NodeAffinity(), PodTopologySpread()])
+        assert r.bound["default/p"] in ("a1", "b1")
+
+    def test_affinity_policy_ignore_counts_unmatched_nodes(self):
+        # Ignore: z3's empty domain counts -> min 0 -> z1/z2 skew 2 > 1;
+        # z3 itself is barred by the NodeAffinity filter -> unschedulable
+        c = self._cluster_with_ineligible_zone()
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        node_selector={"disk": "ssd"},
+                        topology_spread=[
+                            spread(node_affinity_policy="Ignore")]))
+        from scheduler_plugins_tpu.plugins import NodeAffinity
+
+        r = run(c, [NodeAffinity(), PodTopologySpread()])
+        assert r.failed == ["default/p"]
+
+    def test_taints_policy_default_ignore_counts_tainted_nodes(self):
+        # default Ignore: the tainted z3 node's empty domain drags min to
+        # 0 -> z1/z2 skew 2 > 1; z3 barred by TaintToleration -> fails
+        c = self._cluster_with_ineligible_zone(taint=True)
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[spread()]))
+        from scheduler_plugins_tpu.plugins import TaintToleration
+
+        r = run(c, [TaintToleration(), PodTopologySpread()])
+        assert r.failed == ["default/p"]
+
+    def test_taints_policy_honor_excludes_tainted_nodes(self):
+        c = self._cluster_with_ineligible_zone(taint=True)
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[
+                            spread(node_taints_policy="Honor")]))
+        from scheduler_plugins_tpu.plugins import TaintToleration
+
+        r = run(c, [TaintToleration(), PodTopologySpread()])
+        assert r.bound["default/p"] in ("a1", "b1")
+
+
+class TestMixedConstraintClasses:
+    def test_soft_key_absence_does_not_shrink_hard_counting(self):
+        # upstream counts hard (PreFilter) and soft (PreScore) constraint
+        # classes over separate node sets: a node lacking only the SOFT
+        # key still counts toward the hard constraint's domains
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))  # no rack label
+        c.add_node(mknode("b1", zone="z2", labels={"rack": "r1"}))
+        c.add_pod(mkpod("e1", labels={"app": "x"}, node="a1"))
+        c.add_pod(mkpod("e2", labels={"app": "x"}, node="b1"))
+        soft = TopologySpreadConstraint(
+            max_skew=1, topology_key="rack",
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": "x"}))
+        c.add_pod(mkpod("p", labels={"app": "x"},
+                        topology_spread=[spread(), soft]))
+        r = run(c, [PodTopologySpread()])
+        # if a1 (no rack) were excluded from the zone counting, z1 would
+        # read 0 matches with global min 0 while z2 reads 1 -> z2 would be
+        # rejected (1+1-0=2>1) and z1 admitted with understated skew.
+        # Correct per-class counting: both zones hold 1, min 1, both admit.
+        assert "default/p" in r.bound
+
+
+class TestNamespaceSelector:
+    def _cluster(self):
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_node(mknode("b1", zone="z2"))
+        c.add_namespace(Namespace(name="alpha", labels={"team": "a"}))
+        c.add_namespace(Namespace(name="beta", labels={"team": "b"}))
+        c.add_pod(mkpod("db", labels={"app": "db"}, namespace="alpha",
+                        node="a1"))
+        return c
+
+    def _aff_pod(self, ns_selector=None, namespaces=()):
+        return mkpod("web", labels={"app": "web"}, pod_affinity_required=[
+            PodAffinityTerm(
+                topology_key="zone",
+                label_selector=LabelSelector(match_labels={"app": "db"}),
+                namespaces=namespaces,
+                namespace_selector=ns_selector,
+            )])
+
+    def test_selector_matches_labeled_namespace(self):
+        c = self._cluster()
+        c.add_pod(self._aff_pod(
+            ns_selector=LabelSelector(match_labels={"team": "a"})))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] == "a1"
+
+    def test_nil_selector_scopes_to_own_namespace(self):
+        # no namespaces + nil selector = incoming pod's own namespace;
+        # the alpha db pod is invisible -> affinity unsatisfiable
+        c = self._cluster()
+        c.add_pod(self._aff_pod())
+        r = run(c, [InterPodAffinity()])
+        assert r.failed == ["default/web"]
+
+    def test_selector_matching_no_namespace_is_unsatisfiable(self):
+        c = self._cluster()
+        c.add_pod(self._aff_pod(
+            ns_selector=LabelSelector(match_labels={"team": "zz"})))
+        r = run(c, [InterPodAffinity()])
+        assert r.failed == ["default/web"]
+
+    def test_empty_selector_matches_all_namespaces(self):
+        c = self._cluster()
+        c.add_pod(self._aff_pod(ns_selector=LabelSelector()))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] == "a1"
+
+    def test_unmatched_selector_does_not_fall_back_to_own_namespace(self):
+        # upstream: a non-nil namespaceSelector matching zero namespaces
+        # scopes the term to NOTHING — an anti-affinity term must then not
+        # block same-namespace matches (the own-namespace fallback applies
+        # only when the selector is nil)
+        c = self._cluster()
+        c.add_pod(mkpod("blocker", labels={"app": "web"}, node="a1"))
+        c.add_pod(mkpod("web", labels={"app": "web"},
+                        pod_anti_affinity_required=[
+                            PodAffinityTerm(
+                                topology_key="zone",
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}),
+                                namespace_selector=LabelSelector(
+                                    match_labels={"team": "zz"}))]))
+        r = run(c, [InterPodAffinity()])
+        # the default-namespace blocker would match under the buggy
+        # fallback; with empty scope both zones stay feasible
+        assert "default/web" in r.bound
+
+    def test_self_match_escape_respects_selector_scope(self):
+        # the first-pod escape only applies when the pod matches its own
+        # term UNDER THE TERM'S SCOPE: a namespaceSelector excluding the
+        # pod's own namespace means the pod cannot satisfy the term via
+        # itself, so an empty cluster keeps it pending (upstream behavior)
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_namespace(Namespace(name="beta", labels={"team": "b"}))
+        c.add_pod(mkpod("web", labels={"app": "web"},
+                        pod_affinity_required=[
+                            PodAffinityTerm(
+                                topology_key="zone",
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}),
+                                namespace_selector=LabelSelector(
+                                    match_labels={"team": "b"}))]))
+        r = run(c, [InterPodAffinity()])
+        assert r.failed == ["default/web"]
+
+    def test_self_match_escape_with_wildcard_scope(self):
+        # an EMPTY namespaceSelector scopes to every namespace, so the pod
+        # matches its own term and the first-pod escape admits it
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_pod(mkpod("web", labels={"app": "web"},
+                        pod_affinity_required=[
+                            PodAffinityTerm(
+                                topology_key="zone",
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}),
+                                namespace_selector=LabelSelector())]))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] == "a1"
+
+    def test_explicit_namespaces_union_with_selector(self):
+        c = self._cluster()
+        c.add_pod(mkpod("cache", labels={"app": "db"}, namespace="beta",
+                        node="b1"))
+        # selector matches team=b (beta); explicit list adds alpha
+        c.add_pod(self._aff_pod(
+            ns_selector=LabelSelector(match_labels={"team": "b"}),
+            namespaces=("alpha",)))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] in ("a1", "b1")  # both satisfy
