@@ -81,7 +81,11 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
     "NodeAffinity": {"addedAffinity": "added_affinity"},
     "TaintToleration": {},
     "PodTopologySpread": {},
-    "InterPodAffinity": {},
+    "InterPodAffinity": {
+        "hardPodAffinityWeight": "hard_pod_affinity_weight",
+        "ignorePreferredTermsOfExistingPods":
+            "ignore_preferred_terms_of_existing_pods",
+    },
 }
 
 

@@ -75,6 +75,9 @@ class SolverState:
     #: (E, D) live anti-affinity domain presence: True when a pod carrying
     #: existing-anti term e occupies a node in domain d; built-in commit
     anti_domains: Optional[jnp.ndarray] = None
+    #: (E2, D) live symmetric-score carrier counts (existing pods'
+    #: preferred/required affinity terms per domain); built-in commit
+    sym_counts: Optional[jnp.ndarray] = None
 
 
 class Plugin:

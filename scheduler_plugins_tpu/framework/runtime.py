@@ -321,6 +321,7 @@ class Scheduler:
         sel_counts = None
         sel_dom_counts = None
         anti_domains = None
+        sym_counts = None
         if snap.scheduling is not None:
             if (
                 snap.scheduling.track_node_base is not None
@@ -333,6 +334,8 @@ class Scheduler:
                 sel_dom_counts = jnp.asarray(snap.scheduling.track_base)
             if snap.scheduling.exist_anti_base is not None:
                 anti_domains = jnp.asarray(snap.scheduling.exist_anti_base)
+            if snap.scheduling.sym_base is not None:
+                sym_counts = jnp.asarray(snap.scheduling.sym_base)
         return SolverState(
             free=free,
             eq_used=eq_used,
@@ -344,6 +347,7 @@ class Scheduler:
             sel_counts=sel_counts,
             sel_dom_counts=sel_dom_counts,
             anti_domains=anti_domains,
+            sym_counts=sym_counts,
         )
 
 

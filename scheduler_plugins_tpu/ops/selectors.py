@@ -1,6 +1,6 @@
 """Framework-maintained selector/topology-domain carries.
 
-Three live carries, kept in lockstep by ONE built-in commit step of the
+Four live carries, kept in lockstep by ONE built-in commit step of the
 solve (like the built-in capacity Reserve — never per-plugin, which would
 double-apply when multiple consumers are enabled):
 
@@ -11,6 +11,8 @@ double-apply when multiple consumers are enabled):
   domain — read by InterPodAffinity always (no node-inclusion policy)
   and by PodTopologySpread on its fast path.
 - `SolverState.anti_domains` (E, D): anti-affinity domain presence bits.
+- `SolverState.sym_counts` (E2, D): symmetric-score carrier counts
+  (existing pods' preferred/required affinity terms per domain).
 
 Tables come from `state.scheduling.SchedulingState`:
     pend_match (S, P)  pod q matches selector group s
@@ -47,6 +49,17 @@ def commit_tracks(state, sched, p, choice):
                     jnp.arange(TR), jnp.maximum(dom, 0)
                 ].add(inc_d.astype(state.sel_dom_counts.dtype))
             )
+    if state.sym_counts is not None and sched.sym_sel is not None:
+        dom = sched.topo_code[sched.sym_topo, choice]  # (E2,)
+        add = jnp.where(
+            (choice >= 0) & (dom >= 0), sched.sym_carrier[:, p], 0
+        )
+        E2 = state.sym_counts.shape[0]
+        state = state.replace(
+            sym_counts=state.sym_counts.at[
+                jnp.arange(E2), jnp.maximum(dom, 0)
+            ].add(add.astype(state.sym_counts.dtype))
+        )
     if state.anti_domains is not None and sched.exist_anti_sel is not None:
         dom = sched.topo_code[sched.exist_anti_topo, choice]  # (E,)
         mark = (

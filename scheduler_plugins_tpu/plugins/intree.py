@@ -245,12 +245,30 @@ class InterPodAffinity(Plugin):
       min-max normalized.
 
     namespaceSelector resolves host-side against the cluster's Namespace
-    objects (empty selector = all namespaces). Not modeled: symmetric
-    weighting of EXISTING pods' preferred terms toward the incoming pod.
+    objects (empty selector = all namespaces). Score is fully symmetric
+    (upstream PreScore): besides the incoming pod's own preferred terms,
+    every EXISTING pod's preferred (anti-)term whose selector matches the
+    incoming pod adds ±weight to the existing pod's domain, and its
+    REQUIRED affinity terms add `hard_pod_affinity_weight` (upstream
+    HardPodAffinityWeight arg, default 1); carrier counts are carried live
+    (`SolverState.sym_counts`) so in-cycle placements contribute.
     """
 
     name = "InterPodAffinity"
     state_dependent_filter = True
+
+    def __init__(self, hard_pod_affinity_weight: int = 1,
+                 ignore_preferred_terms_of_existing_pods: bool = False):
+        if not 0 <= hard_pod_affinity_weight <= 100:
+            raise ValueError(
+                "hardPodAffinityWeight must be in [0, 100], got "
+                f"{hard_pod_affinity_weight}"
+            )
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.ignore_preferred = ignore_preferred_terms_of_existing_pods
+
+    def static_key(self):
+        return (self.hard_pod_affinity_weight, self.ignore_preferred)
 
     def _counts(self, state, snap):
         """(TR, D) domain-level counts — affinity has no node-inclusion
@@ -324,7 +342,27 @@ class InterPodAffinity(Plugin):
             s.waff_weight[p][:, None] * match_at,
             0,
         )
-        return jnp.sum(contrib, axis=0)
+        total = jnp.sum(contrib, axis=0)
+        if s.sym_sel is not None:
+            # symmetric part: existing carriers' terms matching THIS pod
+            sym = (
+                state.sym_counts
+                if state is not None and state.sym_counts is not None
+                else s.sym_base
+            )  # (E2, D)
+            codee = s.topo_code[s.sym_topo]  # (E2, N)
+            at = jnp.take_along_axis(sym, jnp.maximum(codee, 0), axis=1)
+            at = jnp.where(codee >= 0, at, 0)
+            w_eff = jnp.where(
+                s.sym_hard,
+                self.hard_pod_affinity_weight * s.sym_weight,
+                0 if self.ignore_preferred else s.sym_weight,
+            )  # (E2,)
+            m = s.pend_match[s.sym_sel, p]  # (E2,)
+            total = total + jnp.sum(
+                jnp.where(m[:, None], w_eff[:, None] * at, 0), axis=0
+            )
+        return total
 
     def normalize(self, scores, feasible):
         from scheduler_plugins_tpu.ops.normalize import minmax_normalize

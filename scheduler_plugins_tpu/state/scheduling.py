@@ -138,6 +138,19 @@ class SchedulingState:
     exist_anti_carrier: Optional[np.ndarray] = None
     #: (E, P) which pending pods MATCH term e's selector (they get blocked)
     exist_anti_match: Optional[np.ndarray] = None
+    # Symmetric SCORE terms (upstream interpodaffinity PreScore): each
+    # existing pod's preferred (anti-)affinity terms add +-weight, and its
+    # REQUIRED affinity terms add HardPodAffinityWeight, to every node in
+    # the existing pod's domain when the term's selector matches the
+    # INCOMING pod. E2 axis = unique (selector, key, weight, hard) tuples.
+    sym_sel: Optional[np.ndarray] = None  # (E2,) int32 selector group
+    sym_topo: Optional[np.ndarray] = None  # (E2,) int32 key code
+    sym_weight: Optional[np.ndarray] = None  # (E2,) int64 (+-w; hard rows 1)
+    sym_hard: Optional[np.ndarray] = None  # (E2,) bool required-term rows
+    sym_base: Optional[np.ndarray] = None  # (E2, D) int64 carrier counts
+    #: (E2, P) how many of pending pod q's terms are row e2 — q's
+    #: placement adds that many carriers to its domain
+    sym_carrier: Optional[np.ndarray] = None
 
 
 def _node_filter_key(pod: Pod):
@@ -196,6 +209,8 @@ def _node_filter_matches(pod: Pod, node: Node) -> bool:
 
 
 def _has_selector_specs(pending, assigned) -> bool:
+    # assigned pods' terms matter too: required anti (symmetry blocks) and
+    # preferred/required affinity (symmetric score toward incoming pods)
     return any(
         p.topology_spread
         or p.pod_affinity_required
@@ -203,7 +218,13 @@ def _has_selector_specs(pending, assigned) -> bool:
         or p.pod_affinity_preferred
         or p.pod_anti_affinity_preferred
         for p in pending
-    ) or any(p.pod_anti_affinity_required for p in assigned)
+    ) or any(
+        p.pod_anti_affinity_required
+        or p.pod_affinity_required
+        or p.pod_affinity_preferred
+        or p.pod_anti_affinity_preferred
+        for p in assigned
+    )
 
 
 def relevant(nodes, pending, assigned=()) -> bool:
@@ -505,6 +526,49 @@ def _build_selector_tables(
                 pend_carriers.append([])
             assigned_carrier_terms.append((pod, e))
 
+    # --- symmetric score terms (E2 axis) --------------------------------
+    sym_terms: dict = {}  # (sel, key, weight, hard) -> e2
+    sym_rows: list = []
+
+    def sym_id(sel: int, k: int, weight: int, hard: bool) -> int:
+        key = (sel, k, weight, hard)
+        if key not in sym_terms:
+            sym_terms[key] = len(sym_rows)
+            sym_rows.append(key)
+        return sym_terms[key]
+
+    def pod_sym_terms(pod: Pod):
+        """(e2, count) pairs for one pod's score-symmetric terms."""
+        out_counts: dict = {}
+        for wt in pod.pod_affinity_preferred:
+            s2 = sel_id(_term_scope(pod, wt.term, namespaces),
+                        wt.term.label_selector)
+            e2 = sym_id(s2, key_id(wt.term.topology_key), wt.weight, False)
+            out_counts[e2] = out_counts.get(e2, 0) + 1
+        for wt in pod.pod_anti_affinity_preferred:
+            s2 = sel_id(_term_scope(pod, wt.term, namespaces),
+                        wt.term.label_selector)
+            e2 = sym_id(s2, key_id(wt.term.topology_key), -wt.weight, False)
+            out_counts[e2] = out_counts.get(e2, 0) + 1
+        for term in pod.pod_affinity_required:
+            s2 = sel_id(_term_scope(pod, term, namespaces),
+                        term.label_selector)
+            e2 = sym_id(s2, key_id(term.topology_key), 1, True)
+            out_counts[e2] = out_counts.get(e2, 0) + 1
+        return out_counts
+
+    assigned_sym: list[tuple[str, int, int]] = []  # (node name, e2, count)
+    for pod in assigned:
+        terms = pod_sym_terms(pod)
+        if terms and pod.node_name is not None:
+            assigned_sym.extend(
+                (pod.node_name, e2, c) for e2, c in terms.items()
+            )
+    pending_sym: list[tuple[int, int, int]] = []  # (pod idx, e2, count)
+    for i, pod in enumerate(pending):
+        for e2, c in pod_sym_terms(pod).items():
+            pending_sym.append((i, e2, c))
+
     S, K = len(sel_objs), max(len(key_names), 1)
     # topology domain codes per key (value interned per key)
     topo_code = np.full((K, N), -1, I32)
@@ -658,6 +722,34 @@ def _build_selector_tables(
             exist_anti_base=exist_anti_base,
             exist_anti_carrier=exist_anti_carrier,
             exist_anti_match=exist_anti_match,
+        )
+    if sym_rows:
+        E2 = len(sym_rows)
+        sym_sel = np.zeros(E2, I32)
+        sym_topo = np.zeros(E2, I32)
+        sym_weight = np.zeros(E2, I64)
+        sym_hard = np.zeros(E2, bool)
+        for e2, (s2, k, w, hard) in enumerate(sym_rows):
+            sym_sel[e2], sym_topo[e2] = s2, k
+            sym_weight[e2], sym_hard[e2] = w, hard
+        sym_base = np.zeros((E2, D), I64)
+        for node_name, e2, cnt in assigned_sym:
+            n = node_pos.get(node_name)
+            if n is None:
+                continue
+            code = topo_code[sym_topo[e2], n]
+            if code >= 0:
+                sym_base[e2, code] += cnt
+        sym_carrier = np.zeros((E2, P), I64)
+        for i, e2, cnt in pending_sym:
+            sym_carrier[e2, i] = cnt
+        out.update(
+            sym_sel=sym_sel,
+            sym_topo=sym_topo,
+            sym_weight=sym_weight,
+            sym_hard=sym_hard,
+            sym_base=sym_base,
+            sym_carrier=sym_carrier,
         )
     return out
 

@@ -342,3 +342,102 @@ class TestNamespaceSelector:
             namespaces=("alpha",)))
         r = run(c, [InterPodAffinity()])
         assert r.bound["default/web"] in ("a1", "b1")  # both satisfy
+
+
+class TestSymmetricScore:
+    """Upstream interpodaffinity PreScore symmetry: existing pods'
+    preferred terms matching the incoming pod pull (or push) it toward
+    their domains; required terms pull with HardPodAffinityWeight."""
+
+    def _base(self):
+        c = Cluster()
+        c.add_node(mknode("a1", zone="z1"))
+        c.add_node(mknode("b1", zone="z2"))
+        return c
+
+    def _carrier(self, name, node, term_attr, weight=None):
+        term = PodAffinityTerm(
+            topology_key="zone",
+            label_selector=LabelSelector(match_labels={"app": "web"}))
+        from scheduler_plugins_tpu.api.objects import WeightedPodAffinityTerm
+
+        kw = {term_attr: [WeightedPodAffinityTerm(weight=weight, term=term)]
+              if weight is not None else [term]}
+        return mkpod(name, labels={"app": name}, node=node, **kw)
+
+    def test_existing_preferred_term_attracts(self):
+        # the db pod on b1 PREFERS app=web pods in its zone; the incoming
+        # web pod has no terms of its own but is pulled to z2 — b1 is NOT
+        # the argmax tie-break winner, so this discriminates the pull
+        c = self._base()
+        c.add_pod(self._carrier("db", "b1", "pod_affinity_preferred",
+                                weight=50))
+        c.add_pod(mkpod("web", labels={"app": "web"}))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] == "b1"
+
+    def test_existing_preferred_anti_term_repels(self):
+        # repel away from the tie-break winner a1
+        c = self._base()
+        c.add_pod(self._carrier("db", "a1", "pod_anti_affinity_preferred",
+                                weight=50))
+        c.add_pod(mkpod("web", labels={"app": "web"}))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/web"] == "b1"
+
+    def test_existing_required_term_attracts_with_hard_weight(self):
+        c = self._base()
+        c.add_pod(self._carrier("db", "b1", "pod_affinity_required"))
+        c.add_pod(mkpod("web", labels={"app": "web"}))
+        r = run(c, [InterPodAffinity(hard_pod_affinity_weight=10)])
+        assert r.bound["default/web"] == "b1"
+        # weight 0 disables the symmetric hard pull -> tie-break wins
+        c2 = self._base()
+        c2.add_pod(self._carrier("db", "b1", "pod_affinity_required"))
+        c2.add_pod(mkpod("web", labels={"app": "web"}))
+        r2 = run(c2, [InterPodAffinity(hard_pod_affinity_weight=0)])
+        assert r2.bound["default/web"] == "a1"
+
+    def test_ignore_preferred_terms_arg(self):
+        c = self._base()
+        c.add_pod(self._carrier("db", "a1", "pod_affinity_preferred",
+                                weight=50))
+        # counter-signal: the incoming pod's OWN preference for z2
+        from scheduler_plugins_tpu.api.objects import WeightedPodAffinityTerm
+
+        own = WeightedPodAffinityTerm(weight=10, term=PodAffinityTerm(
+            topology_key="zone",
+            label_selector=LabelSelector(match_labels={"app": "anchor"})))
+        c.add_pod(mkpod("anchor", labels={"app": "anchor"}, node="b1"))
+        c.add_pod(mkpod("web", labels={"app": "web"},
+                        pod_affinity_preferred=[own]))
+        r = run(c, [InterPodAffinity(
+            ignore_preferred_terms_of_existing_pods=True)])
+        # symmetric pull to z1 ignored; own 10-weight preference wins
+        assert r.bound["default/web"] == "b1"
+
+    def test_in_cycle_placement_contributes_symmetric_pull(self):
+        # db (with a preferred term for web pods) schedules FIRST in the
+        # same cycle; web must then be pulled to db's zone
+        c = self._base()
+        db = self._carrier("db", None, "pod_affinity_preferred", weight=50)
+        db.node_name = None
+        db.priority = 10  # db places before web
+        db.node_selector = {"zone": "z2"}  # NOT the tie-break winner
+        c.add_pod(db)
+        c.add_pod(mkpod("web", labels={"app": "web"}))
+        from scheduler_plugins_tpu.plugins import NodeAffinity
+
+        r = run(c, [NodeAffinity(), InterPodAffinity()])
+        assert r.bound["default/db"] == "b1"
+        assert r.bound["default/web"] == "b1"
+
+    def test_unmatched_incoming_pod_unaffected(self):
+        # the carrier sits on b1; a pod its selector does NOT match gets
+        # no pull and falls back to the a1 tie-break
+        c = self._base()
+        c.add_pod(self._carrier("db", "b1", "pod_affinity_preferred",
+                                weight=50))
+        c.add_pod(mkpod("other", labels={"app": "other"}))
+        r = run(c, [InterPodAffinity()])
+        assert r.bound["default/other"] == "a1"
