@@ -376,11 +376,13 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
     elif op == "delete_node":
         cluster.remove_node(event["name"])
     elif op == "delete_quota":
-        cluster.quotas.pop(event.get("namespace", "default"), None)
+        if cluster.quotas.pop(event.get("namespace", "default"), None):
+            cluster.note_event("ElasticQuota/Delete")
     elif op == "delete_pod_group":
-        cluster.pod_groups.pop(
+        if cluster.pod_groups.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
-        )
+        ):
+            cluster.note_event("PodGroup/Delete")
     elif op == "upsert_quota":
         cluster.add_quota(
             ElasticQuota(
@@ -462,9 +464,10 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             )
         )
     elif op == "delete_app_group":
-        cluster.app_groups.pop(
+        if cluster.app_groups.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
-        )
+        ):
+            cluster.note_event("AppGroup/Delete")
     elif op == "upsert_network_topology":
         # (origin, dest) pairs ride as [orig, dest, cost] triples on the wire
         cluster.add_network_topology(
@@ -483,9 +486,10 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             )
         )
     elif op == "delete_network_topology":
-        cluster.network_topologies.pop(
+        if cluster.network_topologies.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
-        )
+        ):
+            cluster.note_event("NetworkTopology/Delete")
     elif op == "upsert_seccomp_profile":
         cluster.add_seccomp_profile(
             SeccompProfile(
@@ -495,9 +499,10 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             )
         )
     elif op == "delete_seccomp_profile":
-        cluster.seccomp_profiles.pop(
+        if cluster.seccomp_profiles.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
-        )
+        ):
+            cluster.note_event("SeccompProfile/Delete")
     elif op == "upsert_priority_class":
         cluster.add_priority_class(
             PriorityClass(
@@ -507,13 +512,15 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             )
         )
     elif op == "delete_priority_class":
-        cluster.priority_classes.pop(event["name"], None)
+        if cluster.priority_classes.pop(event["name"], None):
+            cluster.note_event("PriorityClass/Delete")
     elif op == "upsert_namespace":
         cluster.add_namespace(
             Namespace(name=event["name"], labels=event.get("labels") or {})
         )
     elif op == "delete_namespace":
-        cluster.namespaces.pop(event["name"], None)
+        if cluster.namespaces.pop(event["name"], None):
+            cluster.note_event("Namespace/Delete")
     elif op == "upsert_pdb":
         cluster.add_pdb(
             PodDisruptionBudget(
@@ -525,9 +532,10 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             )
         )
     elif op == "delete_pdb":
-        cluster.pdbs.pop(
+        if cluster.pdbs.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
-        )
+        ):
+            cluster.note_event("PodDisruptionBudget/Delete")
     elif op == "metrics":
         cluster.node_metrics = event["nodes"]
     elif op == "sync":

@@ -32,6 +32,9 @@ class CycleReport:
     bound: dict[str, str] = field(default_factory=dict)  # uid -> node
     reserved: dict[str, str] = field(default_factory=dict)
     failed: list[str] = field(default_factory=list)
+    #: pods parked unschedulable with no registered event since their last
+    #: failure (EnqueueExtensions gating) — excluded from this cycle's batch
+    skipped: list[str] = field(default_factory=list)
     rejected_gangs: list[str] = field(default_factory=list)
     expired_gangs: list[str] = field(default_factory=list)
     #: preemptor uid -> (nominated node, victim uids)
@@ -54,6 +57,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     _refresh_metrics(scheduler, cluster, now)
 
     pending = cluster.pending_pods()
+    pending = _requeue_eligible(scheduler, cluster, pending, now, report)
     if not pending:
         return report
     pending = scheduler.sort_pending(pending, cluster)
@@ -74,6 +78,7 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
         pg = cluster.pod_group_of(pod)
         if node_idx < 0 or not admitted[i]:
             report.failed.append(pod.uid)
+            cluster.mark_unschedulable(pod.uid, now)
             if pg is not None:
                 failed_by_gang.setdefault(pg.full_name, []).append(pod.uid)
             continue
@@ -122,6 +127,63 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
     obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
     return report
+
+
+def _requeue_eligible(scheduler, cluster, pending, now, report):
+    """EnqueueExtensions gating (upstream scheduling-queue semantics): a pod
+    parked unschedulable re-enters the batch only when
+
+    - a cluster event registered by an enabled plugin (or the built-in
+      resource fit's Node/Pod events) occurred after its last failure,
+    - it holds a live nomination (upstream nominated pods stay active),
+    - its flush deadline passed (podMaxInUnschedulablePodsDuration), or
+    - a gang sibling is eligible (upstream ActivateSiblings moves the whole
+      group together).
+
+    Pods never marked unschedulable (new arrivals, retried reservations)
+    always run. Reference: EventsToRegister registrations, e.g.
+    coscheduling.go:113-122, capacity_scheduling.go:194-203,
+    noderesourcetopology plugin.go:141-151."""
+    from scheduler_plugins_tpu.framework.plugin import BUILTIN_EVENTS
+
+    if not cluster.unschedulable_since:
+        return pending
+    registered = set(BUILTIN_EVENTS)
+    for plugin in scheduler.profile.plugins:
+        registered.update(plugin.events_to_register())
+
+    def eligible(pod):
+        rec = cluster.unschedulable_since.get(pod.uid)
+        if rec is None:
+            return True
+        seq, flush_at = rec
+        if pod.nominated_node_name is not None:
+            return True
+        if now >= flush_at:
+            return True
+        return any(
+            cluster.event_last.get(kind, 0) > seq for kind in registered
+        )
+
+    keep = [pod for pod in pending if eligible(pod)]
+    kept_uids = {p.uid for p in keep}
+    # gang activation: one eligible member activates its whole group
+    eligible_gangs = {
+        pg.full_name
+        for p in keep
+        if (pg := cluster.pod_group_of(p)) is not None
+    }
+    for pod in pending:
+        if pod.uid in kept_uids:
+            continue
+        pg = cluster.pod_group_of(pod)
+        if pg is not None and pg.full_name in eligible_gangs:
+            keep.append(pod)
+            kept_uids.add(pod.uid)
+    for pod in pending:
+        if pod.uid not in kept_uids:
+            report.skipped.append(pod.uid)
+    return keep
 
 
 def _run_preemption(scheduler, cluster, pending, report, now):
@@ -330,6 +392,10 @@ def _reject_gang(cluster: Cluster, pg, now: int, report: CycleReport, cosched, m
     for uid in cluster.gang_reservations(pg):
         cluster.release_reservation(uid)  # clears the pod's permit timer
         report.reserved.pop(uid, None)
+        # released siblings are parked too (upstream Permit-Reject moves
+        # waiting pods to the unschedulable queue) — without this the
+        # gang-activation rule would re-run the whole group every cycle
+        cluster.mark_unschedulable(uid, now)
     cluster.gang_last_failure_ms[pg.full_name] = now
     backoff_s = cosched.pod_group_backoff_seconds if cosched else 0
     if backoff_s > 0 and member_count >= pg.min_member:

@@ -80,6 +80,11 @@ class SolverState:
     sym_counts: Optional[jnp.ndarray] = None
 
 
+#: cluster events that can free capacity for the framework's built-in
+#: resource-fit Filter (upstream NodeResourcesFit EventsToRegister)
+BUILTIN_EVENTS = ("Node/Add", "Node/Update", "Pod/Delete")
+
+
 class Plugin:
     """Base plugin: every method is optional; `None` means "not implemented
     at this extension point" and costs nothing in the fused solve."""
@@ -124,6 +129,14 @@ class Plugin:
         """Called inside the traced solve with this plugin's prepare_solve
         result; tensor methods read `self._presolve`."""
         self._presolve = ctx
+
+    def events_to_register(self) -> tuple:
+        """EnqueueExtensions: cluster-event kinds ("Resource/Action") that
+        may make a pod THIS plugin failed schedulable again — the host loop
+        keeps failed pods out of the batch until a registered event (or the
+        periodic flush) occurs. Score-only plugins never fail a pod and
+        register nothing (upstream EventsToRegister)."""
+        return ()
 
     def static_key(self):
         """Hashable fingerprint of any PYTHON-LEVEL specialization this

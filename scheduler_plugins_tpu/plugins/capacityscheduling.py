@@ -20,6 +20,12 @@ from scheduler_plugins_tpu.ops.quota import quota_admit, quota_commit
 class CapacityScheduling(Plugin):
     name = "CapacityScheduling"
 
+    def events_to_register(self):
+        # freed capacity or quota growth (capacity_scheduling.go:194-203;
+        # the EQ event is ActionType All)
+        return ("Pod/Delete", "ElasticQuota/Add", "ElasticQuota/Update",
+                "ElasticQuota/Delete")
+
     def preemption_engine(self):
         """PostFilter = quota-aware preemption
         (capacity_scheduling.go:331-348 wraps the upstream evaluator with the

@@ -35,6 +35,11 @@ DEFAULT_REJECT_PERCENTAGE = 10
 class Coscheduling(Plugin):
     name = "Coscheduling"
 
+    def events_to_register(self):
+        # a new sibling or PodGroup change can complete the quorum
+        # (coscheduling.go:113-122)
+        return ("Pod/Add", "PodGroup/Add", "PodGroup/Update")
+
     def __init__(
         self,
         permit_waiting_seconds: int = DEFAULT_PERMIT_WAITING_SECONDS,

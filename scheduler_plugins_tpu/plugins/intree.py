@@ -40,6 +40,9 @@ from scheduler_plugins_tpu.ops.normalize import default_normalize
 class NodeAffinity(Plugin):
     name = "NodeAffinity"
 
+    def events_to_register(self):
+        return ("Node/Add", "Node/Update")
+
     def __init__(self, added_affinity=None):
         #: NodeAffinityArgs.AddedAffinity (upstream): per-profile extra
         #: REQUIRED node-selector terms (OR over terms) ANDed into every
@@ -111,6 +114,11 @@ class PodTopologySpread(Plugin):
     """
 
     name = "PodTopologySpread"
+
+    def events_to_register(self):
+        return ("Pod/Add", "Pod/Update", "Pod/Delete", "Node/Add",
+                "Node/Update")
+
     #: the filter reads the carried live counts — later placements change
     #: earlier verdicts, and domains SPAN nodes, so the batched path also
     #: re-validates placements sequentially (`validate_at`)
@@ -256,6 +264,10 @@ class InterPodAffinity(Plugin):
 
     name = "InterPodAffinity"
     state_dependent_filter = True
+
+    def events_to_register(self):
+        return ("Pod/Add", "Pod/Update", "Pod/Delete", "Node/Add",
+                "Node/Update", "Namespace/Add", "Namespace/Update")
 
     def __init__(self, hard_pod_affinity_weight: int = 1,
                  ignore_preferred_terms_of_existing_pods: bool = False):
@@ -416,6 +428,9 @@ class InterPodAffinity(Plugin):
 
 class TaintToleration(Plugin):
     name = "TaintToleration"
+
+    def events_to_register(self):
+        return ("Node/Add", "Node/Update")
 
     def filter(self, state, snap, p):
         if snap.scheduling is None:

@@ -37,6 +37,14 @@ DEFAULT_NETWORK_TOPOLOGY_NAME = "nt-default"
 
 class NetworkOverhead(Plugin):
     name = "NetworkOverhead"
+
+    def events_to_register(self):
+        # dependency placements/deletions and CR updates change the
+        # satisfied/violated tallies (no upstream EventsToRegister — the
+        # reference relies on the default rescan; these are the events its
+        # Filter verdict actually depends on)
+        return ("Pod/Add", "Pod/Delete", "AppGroup/Add", "AppGroup/Update",
+                "NetworkTopology/Add", "NetworkTopology/Update")
     #: Filter tallies read the carried in-cycle placement counts — the
     #: batched path re-evaluates it per wave (counting heuristic, not a
     #: resource-safety bound, so no within-wave guard is needed)

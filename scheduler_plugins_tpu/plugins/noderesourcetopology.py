@@ -48,6 +48,11 @@ STRATEGIES = (
 
 class NodeResourceTopologyMatch(Plugin):
     name = "NodeResourceTopologyMatch"
+
+    def events_to_register(self):
+        # plugin.go:141-151: Pod delete, node allocatable changes, NRT CRs
+        return ("Pod/Delete", "Node/Add", "Node/Update",
+                "NodeResourceTopology/Add", "NodeResourceTopology/Update")
     #: the Filter reads the carried zone availability (in-cycle pessimistic
     #: deductions) — the batched path must re-evaluate it per wave
     state_dependent_filter = True

@@ -20,5 +20,10 @@ from scheduler_plugins_tpu.framework.preemption import (
 class PreemptionToleration(Plugin):
     name = "PreemptionToleration"
 
+    def events_to_register(self):
+        # a victim's deletion admits the preemptor (upstream
+        # DefaultPreemption registers Pod/Delete)
+        return ("Pod/Delete",)
+
     def preemption_engine(self) -> PreemptionEngine:
         return PreemptionEngine(PreemptionMode.DEFAULT, toleration=True)

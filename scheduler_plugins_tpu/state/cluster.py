@@ -69,6 +69,28 @@ class Cluster:
     #: snapshot fast path must disengage while any exist, because the
     #: scheduling tables need the assigned pod objects it skips
     _selector_spec_pods: set = field(default_factory=set)
+    # EnqueueExtensions bookkeeping (upstream scheduling queue): a monotonic
+    # event counter, the last counter value per event kind, and per-pod
+    # unschedulable records (event counter at failure, flush deadline).
+    #: upstream podMaxInUnschedulablePodsDuration: failed pods re-enter the
+    #: batch unconditionally after this long even with no event
+    requeue_flush_ms: int = 5 * 60 * 1000
+    event_seq: int = field(default=0)
+    event_last: dict[str, int] = field(default_factory=dict)
+    unschedulable_since: dict[str, tuple[int, int]] = field(
+        default_factory=dict
+    )
+
+    def note_event(self, kind: str) -> None:
+        """Record a cluster event ("Resource/Action") for requeue gating."""
+        self.event_seq += 1
+        self.event_last[kind] = self.event_seq
+
+    def mark_unschedulable(self, uid: str, now_ms: int) -> None:
+        self.unschedulable_since[uid] = (
+            self.event_seq,
+            now_ms + self.requeue_flush_ms,
+        )
 
     # -- native mirror ----------------------------------------------------
     def attach_native_store(self):
@@ -186,12 +208,16 @@ class Cluster:
 
     # -- upserts ---------------------------------------------------------
     def add_node(self, node: Node):
+        self.note_event(
+            "Node/Update" if node.name in self.nodes else "Node/Add"
+        )
         self.nodes[node.name] = node
         if self.native is not None:
             self._native_upsert_node(node)
 
     def remove_node(self, name: str):
-        self.nodes.pop(name, None)
+        if self.nodes.pop(name, None) is not None:
+            self.note_event("Node/Delete")
         if self.native is not None:
             self._native_rebuild()
 
@@ -206,6 +232,9 @@ class Cluster:
         )
 
     def add_pod(self, pod: Pod):
+        self.note_event(
+            "Pod/Update" if pod.uid in self.pods else "Pod/Add"
+        )
         self.pods[pod.uid] = pod
         if self._has_selector_specs(pod):
             # spread/affinity tables need ASSIGNED pod objects at snapshot
@@ -221,7 +250,10 @@ class Cluster:
     def remove_pod(self, uid: str):
         self.release_reservation(uid)  # notifies the NRT cache too
         self._selector_spec_pods.discard(uid)
+        self.unschedulable_since.pop(uid, None)
         pod = self.pods.pop(uid, None)
+        if pod is not None:
+            self.note_event("Pod/Delete")
         if (
             pod is not None
             and pod.node_name is not None
@@ -242,16 +274,29 @@ class Cluster:
         if pod is None:
             return
         pod.deletion_ms = now_ms
+        self.note_event("Pod/Update")
         if self.native is not None:
             self._native_upsert_pod(pod)
 
     def add_pod_group(self, pg: PodGroup):
+        self.note_event(
+            "PodGroup/Update" if pg.full_name in self.pod_groups
+            else "PodGroup/Add"
+        )
         self.pod_groups[pg.full_name] = pg
 
     def add_quota(self, eq: ElasticQuota):
+        self.note_event(
+            "ElasticQuota/Update" if eq.namespace in self.quotas
+            else "ElasticQuota/Add"
+        )
         self.quotas[eq.namespace] = eq
 
     def add_nrt(self, nrt: NodeResourceTopology):
+        self.note_event(
+            "NodeResourceTopology/Update" if nrt.node_name in self.nrts
+            else "NodeResourceTopology/Add"
+        )
         self.nrts[nrt.node_name] = nrt
         if self.nrt_cache is not None:
             self.nrt_cache.update_nrt(nrt)
@@ -259,26 +304,56 @@ class Cluster:
     def remove_nrt(self, node_name: str):
         """NRT CR deleted: evict from the cache tier too, or the snapshot
         keeps building NUMA tables from the stale copy forever."""
+        if node_name in self.nrts:
+            self.note_event("NodeResourceTopology/Delete")
         self.nrts.pop(node_name, None)
         if self.nrt_cache is not None:
             self.nrt_cache.delete_nrt(node_name)
 
     def add_app_group(self, ag: AppGroup):
+        self.note_event(
+            "AppGroup/Update"
+            if f"{ag.namespace}/{ag.name}" in self.app_groups
+            else "AppGroup/Add"
+        )
         self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
 
     def add_network_topology(self, nt: NetworkTopology):
+        self.note_event(
+            "NetworkTopology/Update"
+            if f"{nt.namespace}/{nt.name}" in self.network_topologies
+            else "NetworkTopology/Add"
+        )
         self.network_topologies[f"{nt.namespace}/{nt.name}"] = nt
 
     def add_seccomp_profile(self, sp: SeccompProfile):
+        self.note_event(
+            "SeccompProfile/Update"
+            if sp.full_name in self.seccomp_profiles
+            else "SeccompProfile/Add"
+        )
         self.seccomp_profiles[sp.full_name] = sp
 
     def add_priority_class(self, pc: PriorityClass):
+        self.note_event(
+            "PriorityClass/Update" if pc.name in self.priority_classes
+            else "PriorityClass/Add"
+        )
         self.priority_classes[pc.name] = pc
 
     def add_namespace(self, ns):
+        self.note_event(
+            "Namespace/Update" if ns.name in self.namespaces
+            else "Namespace/Add"
+        )
         self.namespaces[ns.name] = ns
 
     def add_pdb(self, pdb: PodDisruptionBudget):
+        self.note_event(
+            "PodDisruptionBudget/Update"
+            if f"{pdb.namespace}/{pdb.name}" in self.pdbs
+            else "PodDisruptionBudget/Add"
+        )
         self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
 
     # -- derived ---------------------------------------------------------
@@ -325,6 +400,8 @@ class Cluster:
     def bind(self, uid: str, node_name: str, now_ms: int = 0):
         self.reserved.pop(uid, None)
         self.pod_deadline_ms.pop(uid, None)
+        self.unschedulable_since.pop(uid, None)
+        self.note_event("Pod/Update")  # assigned: spec.nodeName set
         self.pods[uid].node_name = node_name
         self.recent_bindings[uid] = (now_ms, node_name)
         if self.nrt_cache is not None:

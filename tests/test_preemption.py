@@ -217,10 +217,14 @@ class TestPreemptionToleration:
         sched = Scheduler(
             Profile(plugins=[NodeResourcesAllocatable(), PreemptionToleration()])
         )
+        # toleration expiry is time-based, not a cluster event, so the
+        # parked claimant re-enters via the periodic unschedulable flush
+        # (upstream podMaxInUnschedulablePodsDuration), shortened here
+        cluster.requeue_flush_ms = 10_000
         # within the 10s window: spared
         report = run_cycle(sched, cluster, now=5_000)
         assert not report.preempted
-        # after the window: preempted
+        # after the window (and flush deadline 5s + 10s): preempted
         report = run_cycle(sched, cluster, now=20_000)
         assert "default/claimant" in report.preempted
 

@@ -1,0 +1,135 @@
+"""EnqueueExtensions / requeue-hint gating (upstream scheduling-queue
+semantics): failed pods leave the batch until a registered cluster event,
+a live nomination, the periodic flush, or gang activation brings them back.
+
+Reference event registrations: coscheduling.go:113-122,
+capacity_scheduling.go:194-203, noderesourcetopology plugin.go:141-151.
+"""
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod, PodGroup
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import (
+    Coscheduling,
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+
+
+def mknode(name, cpu=4000):
+    return Node(name=name,
+                allocatable={CPU: cpu, MEMORY: 32 * gib, PODS: 110})
+
+
+def mkpod(name, cpu=1000, node=None, **kw):
+    p = Pod(name=name,
+            containers=[Container(requests={CPU: cpu, MEMORY: gib})], **kw)
+    p.node_name = node
+    return p
+
+
+def sched():
+    return Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+
+
+def full_cluster():
+    c = Cluster()
+    c.add_node(mknode("n0", cpu=4000))
+    c.add_pod(mkpod("resident", cpu=4000, node="n0"))
+    c.add_pod(mkpod("p", cpu=2000))
+    return c
+
+
+class TestEventGating:
+    def test_failed_pod_skipped_until_event(self):
+        c = full_cluster()
+        s = sched()
+        r1 = run_cycle(s, c, now=1000)
+        assert r1.failed == ["default/p"]
+        # nothing changed: the pod is parked, not retried
+        r2 = run_cycle(s, c, now=2000)
+        assert r2.skipped == ["default/p"]
+        assert not r2.failed and not r2.bound
+
+    def test_pod_delete_event_requeues(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        c.remove_pod("default/resident")  # Pod/Delete: capacity freed
+        r = run_cycle(s, c, now=2000)
+        assert r.bound["default/p"] == "n0"
+
+    def test_node_add_event_requeues(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        c.add_node(mknode("n1"))  # Node/Add
+        r = run_cycle(s, c, now=2000)
+        assert r.bound["default/p"] == "n1"
+
+    def test_unregistered_event_does_not_requeue(self):
+        from scheduler_plugins_tpu.api.objects import SeccompProfile
+
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        # no enabled plugin registers SeccompProfile events
+        c.add_seccomp_profile(SeccompProfile(name="x",
+                                             syscalls=frozenset({"read"})))
+        r = run_cycle(s, c, now=2000)
+        assert r.skipped == ["default/p"]
+
+    def test_flush_deadline_requeues(self):
+        c = full_cluster()
+        c.requeue_flush_ms = 5_000
+        s = sched()
+        run_cycle(s, c, now=1000)
+        r = run_cycle(s, c, now=3000)
+        assert r.skipped == ["default/p"]
+        r = run_cycle(s, c, now=6001)  # past 1000 + 5000
+        assert r.failed == ["default/p"]  # retried (and fails again)
+
+    def test_nominated_pod_always_retries(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        c.pods["default/p"].nominated_node_name = "n0"
+        r = run_cycle(s, c, now=2000)
+        assert "default/p" not in r.skipped
+
+    def test_fresh_pods_unaffected(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        c.add_pod(mkpod("q", cpu=500))
+        r = run_cycle(s, c, now=2000)
+        # the new pod runs; the parked one ALSO runs (Pod/Add is a
+        # built-in-registered event? no — but q's arrival IS an event only
+        # for plugins registering Pod/Add; the base profile does not, so
+        # p stays parked while q binds)
+        assert "default/q" in r.failed or "default/q" in r.bound
+        assert "default/p" in r.skipped
+
+
+class TestGangActivation:
+    def test_new_sibling_requeues_whole_gang(self):
+        c = Cluster()
+        c.add_node(mknode("n0", cpu=10_000))
+        c.add_pod_group(PodGroup(name="g", min_member=3))
+        for i in range(2):
+            c.add_pod(mkpod(f"m{i}", cpu=100,
+                            labels={"scheduling.x-k8s.io/pod-group": "g"}))
+        s = Scheduler(Profile(plugins=[NodeResourcesAllocatable(),
+                                       Coscheduling()]))
+        r1 = run_cycle(s, c, now=1000)
+        assert len(r1.failed) == 2  # below quorum: whole gang rejected
+        r2 = run_cycle(s, c, now=2000)
+        assert len(r2.skipped) == 2  # parked, no event
+        # the third member arrives: Pod/Add is registered by Coscheduling
+        # and activates every sibling
+        c.add_pod(mkpod("m2", cpu=100,
+                        labels={"scheduling.x-k8s.io/pod-group": "g"}))
+        r3 = run_cycle(s, c, now=3000)
+        assert len(r3.bound) == 3
