@@ -44,13 +44,14 @@ def _validate_metric_provider(metric_provider: Optional[dict]):
     mtype = metric_provider.get("type", "KubernetesMetricsServer")
     if mtype not in METRIC_PROVIDER_TYPES:
         raise ValueError(f"invalid metric provider type {mtype!r}")
-    if mtype != "Prometheus":
+    if mtype == "SignalFx":
         raise ValueError(
             f"metric provider type {mtype!r} needs an external SDK this "
-            "build does not bundle; configure watcherAddress or Prometheus"
+            "build does not bundle; configure watcherAddress, Prometheus "
+            "or KubernetesMetricsServer"
         )
     if not metric_provider.get("address"):
-        raise ValueError("Prometheus metric provider requires an address")
+        raise ValueError(f"{mtype} metric provider requires an address")
     return dict(metric_provider)
 
 

@@ -159,6 +159,22 @@ METRIC_PROVIDER_TYPES = (
 )
 
 
+def _authed_get(address: str, path_and_query: str, token: str,
+                insecure_skip_verify: bool, timeout_s: float) -> dict:
+    """One GET with optional bearer token / unverified TLS — the HTTP
+    plumbing both library-mode clients share."""
+    import ssl
+
+    req = urllib.request.Request(address + path_and_query)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    ctx = None
+    if insecure_skip_verify and address.startswith("https"):
+        ctx = ssl._create_unverified_context()
+    with urllib.request.urlopen(req, timeout=timeout_s, context=ctx) as resp:
+        return json.loads(resp.read())
+
+
 class PrometheusCollector:
     """Library-mode metrics client for `MetricProvider.Type: Prometheus` —
     the in-process equivalent of load-watcher's prometheus provider
@@ -186,20 +202,13 @@ class PrometheusCollector:
         self.timeout_s = timeout_s
 
     def _query(self, promql: str) -> dict[str, float]:
-        import ssl
         import urllib.parse
 
-        url = f"{self.address}/api/v1/query?query={urllib.parse.quote(promql)}"
-        req = urllib.request.Request(url)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        ctx = None
-        if self.insecure_skip_verify and url.startswith("https"):
-            ctx = ssl._create_unverified_context()
-        with urllib.request.urlopen(
-            req, timeout=self.timeout_s, context=ctx
-        ) as resp:
-            payload = json.loads(resp.read())
+        payload = _authed_get(
+            self.address,
+            f"/api/v1/query?query={urllib.parse.quote(promql)}",
+            self.token, self.insecure_skip_verify, self.timeout_s,
+        )
         out: dict[str, float] = {}
         for result in (payload.get("data") or {}).get("result", []):
             instance = (result.get("metric") or {}).get("instance", "")
@@ -224,12 +233,100 @@ class PrometheusCollector:
         return out
 
 
+_QUANTITY_SUFFIXES = {
+    # decimal (incl. the sub-unit suffixes metrics-server emits: real
+    # node CPU usage comes back in nanocores, e.g. "236786820n")
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "E": 10**18,
+    # binary
+    "Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40,
+    "Pi": 1 << 50, "Ei": 1 << 60,
+}
+
+
+def parse_quantity_millis(text: str) -> int:
+    """resource.Quantity string -> integer MILLI-units ("250m" -> 250,
+    "2" -> 2000, "236786820n" -> 236, "1Gi" -> 1024^3 * 1000). Shared by
+    cpu (millicores) and memory (millibytes — the caller divides
+    percentages, so the scale cancels)."""
+    text = str(text).strip()
+    for suffix, mult in sorted(
+        _QUANTITY_SUFFIXES.items(), key=lambda kv: -len(kv[0])
+    ):
+        if text.endswith(suffix):
+            return int(float(text[: -len(suffix)]) * mult * 1000)
+    return int(float(text) * 1000)
+
+
+class KubernetesMetricsServerCollector:
+    """Library-mode client for `MetricProvider.Type: KubernetesMetricsServer`
+    — the in-process equivalent of load-watcher's metrics-server provider
+    (/root/reference/pkg/trimaran/collector.go:63-73 NewLibraryClient).
+
+    Plain HTTP against the aggregated metrics API (no SDK):
+    `GET /apis/metrics.k8s.io/v1beta1/nodes` for usage and
+    `GET /api/v1/nodes` for capacity, both on the apiserver `address`;
+    utilisation lands as Average percentages like the other providers."""
+
+    METRICS_PATH = "/apis/metrics.k8s.io/v1beta1/nodes"
+    NODES_PATH = "/api/v1/nodes"
+
+    def __init__(self, address: str, token: str = "",
+                 insecure_skip_verify: bool = False, timeout_s: float = 5.0):
+        if not address:
+            raise ValueError(
+                "KubernetesMetricsServer metric provider requires an address"
+            )
+        self.address = address.rstrip("/")
+        self.token = token
+        self.insecure_skip_verify = insecure_skip_verify
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str) -> dict:
+        return _authed_get(self.address, path, self.token,
+                           self.insecure_skip_verify, self.timeout_s)
+
+    def fetch(self) -> dict[str, dict]:
+        usage = {
+            item["metadata"]["name"]: item.get("usage", {})
+            for item in self._get(self.METRICS_PATH).get("items", [])
+        }
+        capacity = {}
+        for item in self._get(self.NODES_PATH).get("items", []):
+            status = item.get("status", {})
+            capacity[item["metadata"]["name"]] = (
+                status.get("capacity") or status.get("allocatable") or {}
+            )
+        out: dict[str, dict] = {}
+        for node, use in usage.items():
+            cap = capacity.get(node)
+            if not cap:
+                continue
+            entry: dict = {}
+            for res, keys in (
+                ("cpu", ("cpu_avg", "cpu_tlp", "cpu_peaks")),
+                ("memory", ("mem_avg",)),
+            ):
+                if res not in use or res not in cap:
+                    continue
+                cap_m = parse_quantity_millis(cap[res])
+                if cap_m <= 0:
+                    continue
+                pct = 100.0 * parse_quantity_millis(use[res]) / cap_m
+                for key in keys:
+                    entry[key] = pct
+            if entry:
+                out[node] = entry
+        return out
+
+
 def make_metrics_client(watcher_address: Optional[str] = None,
                         metric_provider: Optional[dict] = None):
     """collector.go:60-73: a WatcherAddress selects the remote service
     client; otherwise the MetricProviderSpec selects an in-process library
-    client (Prometheus bundled; the metrics-server/SignalFx SDK clients are
-    not shipped in this build)."""
+    client (Prometheus and KubernetesMetricsServer bundled; the SignalFx
+    SDK client is not shipped in this build)."""
     if watcher_address:
         return LoadWatcherCollector(watcher_address)
     mp = metric_provider or {}
@@ -242,7 +339,14 @@ def make_metrics_client(watcher_address: Optional[str] = None,
             token=mp.get("token", ""),
             insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
         )
+    if mtype == "KubernetesMetricsServer":
+        return KubernetesMetricsServerCollector(
+            mp.get("address", ""),
+            token=mp.get("token", ""),
+            insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
+        )
     raise ValueError(
         f"metric provider type {mtype!r} needs an external SDK this build "
-        "does not bundle; configure watcherAddress or Prometheus"
+        "does not bundle; configure watcherAddress, Prometheus or "
+        "KubernetesMetricsServer"
     )

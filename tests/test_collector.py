@@ -202,12 +202,13 @@ class TestPrometheusCollector:
                          "value": [1700000000, str(value + 1)]},
                     ]},
                 }).encode()
+                # record auth BEFORE responding: the client may assert
+                # the moment the body arrives
+                Handler.last_auth = self.headers.get("Authorization")
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-                # record auth for the token assertion
-                Handler.last_auth = self.headers.get("Authorization")
 
             def log_message(self, *a):
                 pass
@@ -252,3 +253,101 @@ class TestPrometheusCollector:
             make_metrics_client(None, {"type": "SignalFx", "address": "x"})
         with pytest.raises(ValueError):
             make_metrics_client(None, {"type": "Prometheus"})  # no address
+
+
+class TestMetricsServerCollector:
+    """Library-mode client (MetricProvider.Type: KubernetesMetricsServer)
+    faked at the HTTP boundary: the aggregated metrics API + core nodes."""
+
+    def _serve(self):
+        import http.server
+        import json as _json
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/apis/metrics.k8s.io"):
+                    body = _json.dumps({"items": [
+                        {"metadata": {"name": "node-a"},
+                         "usage": {"cpu": "500m", "memory": "2Gi"}},
+                        {"metadata": {"name": "node-b"},
+                         "usage": {"cpu": "2", "memory": "512Mi"}},
+                        {"metadata": {"name": "ghost"},
+                         "usage": {"cpu": "1"}},
+                    ]}).encode()
+                else:
+                    body = _json.dumps({"items": [
+                        {"metadata": {"name": "node-a"},
+                         "status": {"capacity": {"cpu": "2",
+                                                 "memory": "8Gi"}}},
+                        {"metadata": {"name": "node-b"},
+                         "status": {"allocatable": {"cpu": "4",
+                                                    "memory": "4Gi"}}},
+                    ]}).encode()
+                Handler.last_auth = self.headers.get("Authorization")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, Handler, f"http://127.0.0.1:{server.server_port}"
+
+    def test_fetch_computes_percent_of_capacity(self):
+        from scheduler_plugins_tpu.state.collector import (
+            KubernetesMetricsServerCollector,
+        )
+
+        server, handler, addr = self._serve()
+        try:
+            c = KubernetesMetricsServerCollector(addr, token="sekret")
+            metrics = c.fetch()
+            # node-a: 500m of 2 cores = 25%; 2Gi of 8Gi = 25%
+            assert metrics["node-a"]["cpu_avg"] == 25.0
+            assert metrics["node-a"]["cpu_tlp"] == 25.0
+            assert metrics["node-a"]["cpu_peaks"] == 25.0
+            assert metrics["node-a"]["mem_avg"] == 25.0
+            # node-b: 2 of 4 cores = 50% (capacity falls back to
+            # allocatable); 512Mi of 4Gi = 12.5%
+            assert metrics["node-b"]["cpu_avg"] == 50.0
+            assert metrics["node-b"]["mem_avg"] == 12.5
+            # a node the core API does not know is skipped
+            assert "ghost" not in metrics
+            assert handler.last_auth == "Bearer sekret"
+        finally:
+            server.shutdown()
+
+    def test_quantity_parsing(self):
+        from scheduler_plugins_tpu.state.collector import (
+            parse_quantity_millis,
+        )
+
+        assert parse_quantity_millis("250m") == 250
+        assert parse_quantity_millis("236786820n") == 236
+        assert parse_quantity_millis("1500u") == 1
+        assert parse_quantity_millis("2") == 2000
+        assert parse_quantity_millis("1Ki") == 1024 * 1000
+        assert parse_quantity_millis("1Mi") == (1 << 20) * 1000
+        assert parse_quantity_millis("1G") == 10**9 * 1000
+        assert parse_quantity_millis("1.5Gi") == int(1.5 * (1 << 30)) * 1000
+
+    def test_factory_selects_metrics_server(self):
+        import pytest
+
+        from scheduler_plugins_tpu.state.collector import (
+            KubernetesMetricsServerCollector,
+            make_metrics_client,
+        )
+
+        assert isinstance(
+            make_metrics_client(None, {"type": "KubernetesMetricsServer",
+                                       "address": "http://apiserver:6443"}),
+            KubernetesMetricsServerCollector,
+        )
+        with pytest.raises(ValueError, match="SDK"):
+            make_metrics_client(None, {"type": "SignalFx",
+                                       "address": "http://sfx"})
