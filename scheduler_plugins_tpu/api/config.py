@@ -86,6 +86,7 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
         "ignorePreferredTermsOfExistingPods":
             "ignore_preferred_terms_of_existing_pods",
     },
+    "CrossNodePreemption": {"maxPool": "max_pool"},
 }
 
 
@@ -113,14 +114,16 @@ def _registry():
         "TaintToleration": p.TaintToleration,
         "PodTopologySpread": p.PodTopologySpread,
         "InterPodAffinity": p.InterPodAffinity,
+        "CrossNodePreemption": p.CrossNodePreemption,
     }
 
 
 def available_plugins() -> tuple[str, ...]:
     """The full plugin roster — the 14 plugins the reference compiles into
     its scheduler binary (/root/reference/cmd/scheduler/main.go:50-67;
-    CrossNodePreemption is registration-commented-out there and spec-only
-    here, see docs/PARITY.md) plus the in-tree companions (NodeAffinity,
+    CrossNodePreemption is registration-commented-out there and implemented
+    here as an opt-in spec mirror, see docs/PARITY.md) plus the in-tree
+    companions (NodeAffinity,
     TaintToleration, PodTopologySpread, InterPodAffinity) that real
     profiles combine them with."""
     return tuple(sorted(_registry()))

@@ -59,6 +59,11 @@ def encode_demand(index, pod: "Pod"):
 class PreemptionMode(enum.Enum):
     DEFAULT = "Default"
     CAPACITY = "CapacityScheduling"
+    #: brute-force multi-node victim search — the reference ships this
+    #: plugin fully commented out ("CAVEAT: don't use in production",
+    #: cross_node_preemption.go:19-224); implemented here as an opt-in
+    #: mirror of that spec
+    CROSS_NODE = "CrossNodePreemption"
 
 
 #: sentinel: the preemptor is currently INELIGIBLE (PodEligibleToPreemptOthers
@@ -78,10 +83,18 @@ class PreemptionEngine:
     #: evaluator samples candidates too, preemption_toleration.go:306-331)
     MAX_CANDIDATES = 100
 
+    #: CROSS_NODE pool bound: the reference enumerates ALL 2^n victim
+    #: subsets with no cap (its own caveat); we keep the exact DFS but bound
+    #: the pool to the lowest-priority pods so the search stays tractable
+    CROSS_NODE_MAX_POOL = 12
+
     def __init__(self, mode: PreemptionMode = PreemptionMode.DEFAULT,
-                 toleration: bool = False):
+                 toleration: bool = False,
+                 cross_node_max_pool: int | None = None):
         self.mode = mode
         self.toleration = toleration
+        if cross_node_max_pool is not None:
+            self.CROSS_NODE_MAX_POOL = cross_node_max_pool
 
     # -- exemption -------------------------------------------------------
     def exempted(self, victim: Pod, preemptor: Pod, cluster, now_ms: int) -> bool:
@@ -299,6 +312,10 @@ class PreemptionEngine:
             cluster, preemptor, snap, meta, nom_aggs, scheduler
         ):
             return GATED
+        if self.mode == PreemptionMode.CROSS_NODE:
+            return self._preempt_cross_node(
+                cluster, scheduler, preemptor, snap, meta, extra_reserved
+            )
 
         victims_all = [
             p
@@ -384,6 +401,98 @@ class PreemptionEngine:
             )
             if best is None or stats < best[0]:
                 best = (stats, int(n), final)
+        if best is None:
+            return None
+        _, chosen, final_victims = best
+        return PreemptionResult(
+            nominated_node=meta.node_names[chosen],
+            victims=[v.uid for v in final_victims],
+        )
+
+    def _preempt_cross_node(self, cluster, scheduler, preemptor, snap,
+                            meta, extra_reserved=None):
+        """Brute-force candidate search over victim SUBSETS spanning nodes —
+        the commented-out reference algorithm (cross_node_preemption.go:
+        144-208): collect every bound pod with lower priority, DFS all
+        subsets (pick-first order), and for each subset nominate any
+        victim-hosting node the preemptor now fits; the best candidate wins
+        by the upstream pickOneNode criteria (fewest PDB violations, lowest
+        highest-victim-priority, lowest priority sum, fewest victims).
+
+        Plugin Filter verdicts are evaluated against the CURRENT cache
+        state (the same approximation the sequential dry run documents) —
+        only the resource fit varies per subset."""
+        node_pos = {name: i for i, name in enumerate(meta.node_names)}
+        pool = [
+            v for v in cluster.pods.values()
+            if v.node_name in node_pos
+            and not v.terminating
+            and v.priority < preemptor.priority
+        ]
+        if not pool:
+            return None
+        # bound the exponential search: lowest-priority (most preemptable)
+        # pods first, stable by uid
+        pool.sort(key=lambda v: (v.priority, v.uid))
+        pool = pool[: self.CROSS_NODE_MAX_POOL]
+        n_pool = len(pool)
+
+        index = meta.index
+        R = len(index)
+        N = len(meta.node_names)
+        v_node = np.array([node_pos[v.node_name] for v in pool])
+        v_req = np.zeros((n_pool, R), np.int64)
+        for i, v in enumerate(pool):
+            v_req[i] = index.encode(v.effective_request())
+            v_req[i, index.position(PODS)] = 1
+
+        demand = encode_demand(index, preemptor)
+        free = np.asarray(snap.nodes.alloc - snap.nodes.requested)[:N]
+        if extra_reserved is not None:
+            free = free - extra_reserved[:N]
+        static_fit = np.asarray(snap.nodes.mask)[:N].copy()
+        if scheduler is not None and preemptor.uid in meta.pod_names:
+            p_idx = meta.pod_names.index(preemptor.uid)
+            static_fit &= np.asarray(scheduler.filter_verdicts(snap, p_idx))[:N]
+
+        pdbs = list(getattr(cluster, "pdbs", {}).values())
+        best = None
+        order = 0
+        # DFS leaf order: the reference explores "pick pod i" before "skip
+        # pod i" at every level, so leaf k of the counter (pod i at bit
+        # n_pool-1-i, CLEAR bit = picked) reproduces its enumeration order
+        for bits in range(1 << n_pool):
+            subset = [
+                i for i in range(n_pool)
+                if not (bits >> (n_pool - 1 - i)) & 1
+            ]
+            if not subset:
+                order += 1
+                continue
+            removed = np.zeros((N, R), np.int64)
+            np.add.at(removed, v_node[subset], v_req[subset])
+            hosting = np.unique(v_node[subset])
+            for n in hosting:
+                if not static_fit[n]:
+                    continue
+                if not np.all(free[n] + removed[n] >= demand):
+                    continue
+                victims = [pool[i] for i in subset]
+                violating, _ = self.partition_pdb_violations(
+                    list(enumerate(victims)), pdbs
+                )
+                violations = len(violating)
+                stats = (
+                    violations,
+                    max(v.priority for v in victims),
+                    sum(v.priority for v in victims),
+                    len(victims),
+                    int(n),
+                    order,
+                )
+                if best is None or stats < best[0]:
+                    best = (stats, int(n), victims)
+            order += 1
         if best is None:
             return None
         _, chosen, final_victims = best

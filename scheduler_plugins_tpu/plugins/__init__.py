@@ -22,6 +22,9 @@ from scheduler_plugins_tpu.plugins.capacityscheduling import (  # noqa: F401
     CapacityScheduling,
 )
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling  # noqa: F401
+from scheduler_plugins_tpu.plugins.crossnodepreemption import (  # noqa: F401
+    CrossNodePreemption,
+)
 from scheduler_plugins_tpu.plugins.noderesources import (  # noqa: F401
     NodeResourcesAllocatable,
 )

@@ -1,0 +1,40 @@
+"""CrossNodePreemption — brute-force multi-node victim search (PostFilter).
+
+The reference ships this plugin FULLY COMMENTED OUT with its registration
+disabled ("CAVEAT: don't use this in production env",
+/root/reference/pkg/crossnodepreemption/cross_node_preemption.go:19-224,
+cmd/scheduler/main.go registration commented). This build implements that
+spec as an OPT-IN extra: enabling the plugin selects the
+`PreemptionMode.CROSS_NODE` engine, which DFS-enumerates victim subsets
+spanning nodes exactly like the dead code's `dfs`/`dryRunOnePass` pair and
+ranks candidates by the upstream pickOneNode criteria. The pool is bounded
+to the lowest-priority pods (`max_pool`) so the 2^n search stays tractable
+— the one deliberate deviation from the uncapped reference spec.
+"""
+
+from __future__ import annotations
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+from scheduler_plugins_tpu.framework.preemption import (
+    PreemptionEngine,
+    PreemptionMode,
+)
+
+
+class CrossNodePreemption(Plugin):
+    name = "CrossNodePreemption"
+
+    def __init__(self, max_pool: int = 12):
+        if max_pool < 1:
+            raise ValueError(f"max_pool must be >= 1, got {max_pool}")
+        self.max_pool = max_pool
+
+    def events_to_register(self):
+        # a victim's deletion admits the preemptor (upstream
+        # DefaultPreemption registration)
+        return ("Pod/Delete",)
+
+    def preemption_engine(self):
+        return PreemptionEngine(
+            PreemptionMode.CROSS_NODE, cross_node_max_pool=self.max_pool
+        )
