@@ -119,7 +119,7 @@ def _registry():
 
 
 def available_plugins() -> tuple[str, ...]:
-    """The full plugin roster — the 14 plugins the reference compiles into
+    """The full plugin roster (19) — the 14 plugins the reference compiles into
     its scheduler binary (/root/reference/cmd/scheduler/main.go:50-67;
     CrossNodePreemption is registration-commented-out there and implemented
     here as an opt-in spec mirror, see docs/PARITY.md) plus the in-tree

@@ -43,7 +43,10 @@ class NetworkOverhead(Plugin):
         # satisfied/violated tallies (no upstream EventsToRegister — the
         # reference relies on the default rescan; these are the events its
         # Filter verdict actually depends on)
-        return ("Pod/Add", "Pod/Delete", "AppGroup/Add", "AppGroup/Update",
+        # Pod/Update included because cluster.bind() records bindings as
+        # Pod/Update — a dependency binding can flip violated>satisfied.
+        return ("Pod/Add", "Pod/Update", "Pod/Delete",
+                "AppGroup/Add", "AppGroup/Update",
                 "NetworkTopology/Add", "NetworkTopology/Update")
     #: Filter tallies read the carried in-cycle placement counts — the
     #: batched path re-evaluates it per wave (counting heuristic, not a

@@ -11,7 +11,9 @@ from scheduler_plugins_tpu.plugins import Coscheduling, TargetLoadPacking
 class TestLoadProfile:
     def test_full_roster_loads(self):
         profile = load_profile({"plugins": list(available_plugins())})
-        assert len(profile.plugins) == 18  # 14 reference + 4 in-tree companions
+        # 15 reference-side plugins (incl. opt-in CrossNodePreemption) + 4
+        # in-tree companions
+        assert len(profile.plugins) == 19
 
     def test_args_and_defaults(self):
         profile = load_profile(
