@@ -108,17 +108,71 @@ def python_baseline_pods_per_sec(cluster, sample=200):
     return len(pods) / elapsed
 
 
-def _emit(metric, pods_per_sec, detail, baseline):
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(pods_per_sec, 1),
-                "unit": f"pods/s ({detail})",
-                "vs_baseline": round(pods_per_sec / baseline, 2),
-            }
-        )
-    )
+def _backend_label():
+    """"backend/device-kind" of the default JAX backend, stamped into every
+    emitted line so capture replays can tell real on-chip numbers from CPU
+    fallback runs."""
+    try:
+        import jax
+
+        return f"{jax.default_backend()}/{jax.devices()[0].device_kind}"
+    except Exception:
+        return "unknown"
+
+
+def _emit(metric, pods_per_sec, detail, baseline, compiled=None):
+    """One JSON line. `vs_baseline` is the honest headline: measured against
+    the COMPILED reference-shaped loop (`bridge/ref_baseline.cc`) when it is
+    available — the reference is compiled Go, so a pure-Python denominator
+    flatters every multiplier. The Python-loop ratio stays as a secondary
+    column (`vs_python_baseline`)."""
+    line = {
+        "metric": metric,
+        "value": round(pods_per_sec, 1),
+        "unit": f"pods/s ({detail})",
+        "backend": _backend_label(),
+    }
+    if compiled is not None and compiled > 0:
+        line["vs_baseline"] = round(pods_per_sec / compiled, 2)
+        line["vs_compiled_baseline"] = round(pods_per_sec / compiled, 2)
+        line["compiled_baseline_pods_per_sec"] = round(compiled, 1)
+        line["vs_python_baseline"] = round(pods_per_sec / baseline, 2)
+    else:
+        line["vs_baseline"] = round(pods_per_sec / baseline, 2)
+        line["vs_python_baseline"] = round(pods_per_sec / baseline, 2)
+    print(json.dumps(line))
+
+
+def _compiled_baseline(config, snap, meta, weights=None, plugins=None):
+    """pods/s of the compiled reference-shaped loop for this config's
+    snapshot, or None when the native build is unavailable. Real (node, pod)
+    counts come from meta so the denominator scans the reference's cluster
+    shape, not the snapshot's padded buckets."""
+    try:
+        from scheduler_plugins_tpu.bridge import ref_baseline as rb
+
+        kw = dict(n_nodes=len(meta.node_names), n_pods=len(meta.pod_names))
+        if config in (1, 6):
+            rate, _, _ = rb.compiled_alloc_baseline(snap, weights, **kw)
+        elif config == 2:
+            rate, _, _ = rb.compiled_trimaran_baseline(snap, **kw)
+        elif config == 3:
+            rate, _, _ = rb.compiled_numa_baseline(snap, **kw)
+        elif config == 4:
+            rate, _, _ = rb.compiled_gang_quota_baseline(snap, weights, **kw)
+        elif config == 5:
+            net = next(
+                p for p in plugins if type(p).__name__ == "NetworkOverhead"
+            )
+            rate, _, _ = rb.compiled_network_baseline(
+                snap, net._zone_cost, net._region_cost, **kw
+            )
+        else:
+            return None
+        return rate
+    except Exception as exc:  # native toolchain unavailable: python-only
+        print(f"# compiled baseline unavailable: {exc}", file=sys.stderr)
+        return None
 
 
 def main(n_nodes=1024, n_pods=8192):
@@ -166,6 +220,7 @@ def main(n_nodes=1024, n_pods=8192):
         pods_per_sec,
         f"{n_nodes} nodes x {n_pods} pods, {placed} placed",
         baseline,
+        compiled=_compiled_baseline(1, snap, meta, weights=weights),
     )
 
 
@@ -235,6 +290,7 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
         n_pods / elapsed,
         f"{n_nodes} nodes x {n_pods} pods chunked x{chunk}, {placed} placed",
         baseline,
+        compiled=_compiled_baseline(6, snap, meta, weights=weights),
     )
 
 
@@ -266,6 +322,12 @@ def latest_capture(config: int, mode: str):
             if entry.get("config") != config or entry.get("error"):
                 continue
             if config in (2, 3, 4, 5) and entry.get("mode") != mode:
+                continue
+            # only replay real on-chip captures: a CPU-backend run must never
+            # masquerade as a TPU number (entries are stamped by _emit's
+            # "backend" field; axon is the tunneled TPU platform name)
+            backend = str(entry.get("backend", "")).lower()
+            if "tpu" not in backend and "axon" not in backend:
                 continue
             value, ts = entry.get("value", 0), entry.get("ts", 0)
             if not isinstance(value, (int, float)) or value <= 0:
@@ -324,6 +386,12 @@ def sequential_config(config: int, mode: str = "sequential"):
     n_pods = len(pending)
     snap, meta = cluster.snapshot(pending, now_ms=0)
     scheduler.prepare(meta, cluster)
+    import jax.numpy as jnp
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+    weights = jnp.asarray(
+        meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+    )
 
     if mode == "batch":
         from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
@@ -346,7 +414,10 @@ def sequential_config(config: int, mode: str = "sequential"):
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment >= 0).sum())
     baseline = python_baseline_pods_per_sec(cluster, sample=100)
-    _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed", baseline)
+    _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
+          baseline, compiled=_compiled_baseline(config, snap, meta,
+                                                weights=weights,
+                                                plugins=plugins))
 
 
 if __name__ == "__main__":

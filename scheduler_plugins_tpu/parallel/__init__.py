@@ -15,4 +15,5 @@ from scheduler_plugins_tpu.parallel.mesh import (  # noqa: F401
 )
 from scheduler_plugins_tpu.parallel.solver import (  # noqa: F401
     sharded_batch_solve,
+    sharded_profile_batch_solve,
 )

@@ -356,3 +356,25 @@ def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
     with jax.set_mesh(mesh):
         fn = jax.jit(lambda s, w: batch_solve(s, w, max_waves))
         return fn(snap, weights)
+
+
+def sharded_profile_batch_solve(scheduler, snap, mesh, max_waves: int = 8):
+    """`profile_batch_solve` (the FULL plugin roster: NUMA wave guards,
+    network thresholds, spread/affinity validators, trimaran scores — not
+    just the flagship allocatable solve) with the snapshot sharded over
+    `mesh`. Node-major tensors (free capacity, NUMA zone tables, score rows)
+    split over the "nodes" axis, pod-major tensors over "pods"; side tables
+    replicate, and XLA's sharding propagation inserts the cross-shard
+    collectives for the argmax/segment reductions — the multi-chip analog of
+    the reference runtime's 16-worker Filter/Score fan-out (SURVEY.md §2.9;
+    /root/reference/pkg/noderesourcetopology/filter.go:90-160 is the hot
+    loop that lands on the node-sharded axis).
+
+    Placement semantics are those of `profile_batch_solve` (sharding never
+    changes the math, only its partitioning); `tests/test_parallel.py`
+    asserts sharded == unsharded placements on an 8-device CPU mesh."""
+    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+
+    snap = shard_snapshot(snap, mesh)
+    with jax.set_mesh(mesh):
+        return profile_batch_solve(scheduler, snap, max_waves=max_waves)

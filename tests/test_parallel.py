@@ -257,6 +257,64 @@ class TestBatchedStateDependentFilters:
         assert int((an[:P] >= 0).sum()) == n_seq
 
 
+class TestShardedProfileSolve:
+    """VERDICT r2 item 2: the FULL plugin roster — NUMA wave guards, network
+    dependency thresholds, spread validators — must run under the
+    ("pods","nodes") mesh, not just the flagship allocatable solve; sharding
+    partitions the math without changing it."""
+
+    def _mixed_problem(self):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.models import mixed_scenario
+        from scheduler_plugins_tpu.plugins import (
+            NetworkOverhead,
+            NodeResourcesAllocatable,
+            NodeResourceTopologyMatch,
+            PodTopologySpread,
+        )
+
+        cluster = mixed_scenario(n_nodes=16, n_pods=32)
+        sched = Scheduler(Profile(plugins=[
+            NodeResourcesAllocatable(), NodeResourceTopologyMatch(),
+            NetworkOverhead(), PodTopologySpread()]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0,
+                                      pad_nodes=16, pad_pods=32)
+        sched.prepare(meta, cluster)
+        return sched, snap, len(pending)
+
+    def test_sharded_profile_matches_single_device(self):
+        from scheduler_plugins_tpu.parallel import (
+            sharded_profile_batch_solve,
+        )
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+        sched, snap, P = self._mixed_problem()
+        a1, adm1, w1 = profile_batch_solve(sched, snap)
+        a8, adm8, w8 = sharded_profile_batch_solve(sched, snap, make_mesh(8))
+        assert np.asarray(a1).tolist() == np.asarray(a8).tolist()
+        assert np.asarray(adm1).tolist() == np.asarray(adm8).tolist()
+        assert np.asarray(w1).tolist() == np.asarray(w8).tolist()
+
+    def test_sharded_profile_places_and_respects_capacity(self):
+        from scheduler_plugins_tpu.parallel import (
+            sharded_profile_batch_solve,
+        )
+
+        sched, snap, P = self._mixed_problem()
+        a8, _, _ = sharded_profile_batch_solve(sched, snap, make_mesh(8))
+        an = np.asarray(a8)[:P]
+        assert (an >= 0).sum() > 0
+        req = np.asarray(snap.pods.req)
+        alloc = np.asarray(snap.nodes.alloc)
+        used = np.zeros_like(alloc)
+        for i, n in enumerate(an):
+            if n >= 0:
+                used[n] += req[i]
+                used[n, 3] += 1
+        assert (used <= alloc).all()
+
+
 class TestMultiHostLaunch:
     """Single-process degenerate path of the multi-host recipe
     (parallel/launch.py); the driver's dryrun exercises the mesh itself."""

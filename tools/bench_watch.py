@@ -10,7 +10,8 @@ with a wall-clock time — to `BENCH_CAPTURES.jsonl` at the repo root.
 `bench.py` then uses the newest matching capture as a clearly-labeled
 fallback (`"stale_capture": true`, `"captured_unix": ...`) when the tunnel is
 dead at the moment the driver runs it, so the round artifact carries a real
-measured number either way.
+measured number either way. Every bench line is stamped with the JAX
+backend/device-kind; replay filters out non-TPU (CPU fallback) captures.
 
 Usage:  python tools/bench_watch.py [--interval 900] [--once] [--max-hours 11]
 """
