@@ -74,8 +74,14 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
         "defaultProfileNamespace": "default_profile_namespace",
         "defaultProfileName": "default_profile_name",
     },
-    "CapacityScheduling": {},
-    "PreemptionToleration": {},
+    "CapacityScheduling": {
+        "minCandidateNodesPercentage": "min_candidate_nodes_percentage",
+        "minCandidateNodesAbsolute": "min_candidate_nodes_absolute",
+    },
+    "PreemptionToleration": {
+        "minCandidateNodesPercentage": "min_candidate_nodes_percentage",
+        "minCandidateNodesAbsolute": "min_candidate_nodes_absolute",
+    },
     "PodState": {},
     "QOSSort": {},
     "NodeAffinity": {"addedAffinity": "added_affinity"},

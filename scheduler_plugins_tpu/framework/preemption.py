@@ -79,9 +79,13 @@ class PreemptionResult:
 
 
 class PreemptionEngine:
-    #: candidate-node cap for the exact per-node reprieve (the upstream
-    #: evaluator samples candidates too, preemption_toleration.go:306-331)
-    MAX_CANDIDATES = 100
+    #: upstream DefaultPreemptionArgs defaults (k/k defaults; the reference's
+    #: PreemptionTolerationArgs aliases them, apis/config/types.go
+    #: PreemptionTolerationArgs) — candidates = clamp(
+    #: numNodes*pct/100, >=absolute, <=numNodes),
+    #: preemption_toleration.go:306-331 calculateNumCandidates
+    DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE = 10
+    DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE = 100
 
     #: CROSS_NODE pool bound: the reference enumerates ALL 2^n victim
     #: subsets with no cap (its own caveat); we keep the exact DFS but bound
@@ -90,11 +94,78 @@ class PreemptionEngine:
 
     def __init__(self, mode: PreemptionMode = PreemptionMode.DEFAULT,
                  toleration: bool = False,
-                 cross_node_max_pool: int | None = None):
+                 cross_node_max_pool: int | None = None,
+                 min_candidate_nodes_percentage: int | None = None,
+                 min_candidate_nodes_absolute: int | None = None,
+                 candidate_rng=None):
         self.mode = mode
         self.toleration = toleration
         if cross_node_max_pool is not None:
             self.CROSS_NODE_MAX_POOL = cross_node_max_pool
+        pct, absolute = self.validate_sampling_args(
+            min_candidate_nodes_percentage, min_candidate_nodes_absolute
+        )
+        self.min_candidate_nodes_percentage = pct
+        self.min_candidate_nodes_absolute = absolute
+        import random as _random
+
+        # deterministic by default (seed 0): this repo's differential gates
+        # and bench runs need snapshot -> decision reproducibility, where
+        # upstream uses rand.Int31n; pass a Random for upstream-style jitter
+        self._candidate_rng = candidate_rng or _random.Random(0)
+
+    # -- candidate sampling ----------------------------------------------
+    @classmethod
+    def validate_sampling_args(cls, pct, absolute):
+        """Upstream ValidateDefaultPreemptionArgs: pct in [0, 100],
+        absolute >= 0, pair must yield a positive candidate count. Returns
+        the defaulted (pct, absolute)."""
+        if pct is None:
+            pct = cls.DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE
+        if absolute is None:
+            absolute = cls.DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE
+        if not 0 <= pct <= 100:
+            raise ValueError(
+                f"minCandidateNodesPercentage must be in [0, 100], got {pct}"
+            )
+        if absolute < 0:
+            raise ValueError(
+                f"minCandidateNodesAbsolute must be >= 0, got {absolute}"
+            )
+        if pct == 0 and absolute == 0:
+            raise ValueError(
+                "minCandidateNodesPercentage and minCandidateNodesAbsolute "
+                "cannot both be zero"
+            )
+        return pct, absolute
+
+    def calculate_num_candidates(self, num_nodes: int) -> int:
+        """calculateNumCandidates (preemption_toleration.go:318-331) over
+        the PREEMPTION-CANDIDATE pool size (upstream passes
+        len(potentialNodes), not the cluster node count):
+        max(n*pct/100, absolute) capped at n."""
+        n = (num_nodes * self.min_candidate_nodes_percentage) // 100
+        if n < self.min_candidate_nodes_absolute:
+            n = self.min_candidate_nodes_absolute
+        if n > num_nodes:
+            n = num_nodes
+        return n
+
+    def sample_candidates(self, fits, num_nodes: int):
+        """GetOffsetAndNumCandidates (preemption_toleration.go:306-309): a
+        random offset INTO THE FEASIBLE POOL, then a circular scan over the
+        pool until the calculated count is reached — bounding dry-run work
+        on big clusters without always favoring low-index nodes. Both the
+        offset draw and the candidate count run over the feasible pool, as
+        upstream draws over potentialNodes."""
+        import numpy as np
+
+        pool = np.nonzero(fits)[0]
+        if pool.size == 0:
+            return pool
+        want = self.calculate_num_candidates(int(pool.size))
+        offset = self._candidate_rng.randrange(int(pool.size))
+        return pool[(np.arange(pool.size) + offset) % pool.size][:want]
 
     # -- exemption -------------------------------------------------------
     def exempted(self, victim: Pod, preemptor: Pod, cluster, now_ms: int) -> bool:
@@ -378,11 +449,12 @@ class PreemptionEngine:
         if not fits.any():
             return None
 
-        # run the exact reprieve per candidate (bounded, like the upstream
-        # candidate sampling) and rank by the FINAL minimized victim sets —
-        # pickOneNode criteria: fewest PDB violations -> min highest victim
-        # priority -> min priority sum -> fewest victims -> lowest index
-        candidates = np.nonzero(fits)[0][: self.MAX_CANDIDATES]
+        # run the exact reprieve per candidate (sampled with the upstream
+        # offset/numCandidates rules) and rank by the FINAL minimized victim
+        # sets — pickOneNode criteria: fewest PDB violations -> min highest
+        # victim priority -> min priority sum -> fewest victims -> lowest
+        # index
+        candidates = self.sample_candidates(fits, N)
         pdbs = list(getattr(cluster, "pdbs", {}).values())
         best = None
         for n in candidates:

@@ -347,6 +347,55 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     return cache[key](snap, state0, auxes)
 
 
+def profile_initial_scores(scheduler, snap):
+    """(P, N) weighted normalized plugin score matrix and (P, N) feasibility
+    against the CYCLE-INITIAL state — the objective both solve modes rank
+    nodes by before placements start. Used to quantify the batched path's
+    placement-quality drift vs the sequential scan (VERDICT r2 item 8):
+    score_sum(assignment) = Σ_p scores[p, assignment[p]] is comparable
+    across modes because both optimize this same cycle-initial surface
+    (the sequential path then re-evaluates state-dependent filters as it
+    commits; scores stay cycle-initial in both, runtime.py step())."""
+    import jax
+
+    plugins = tuple(scheduler.profile.plugins)
+    state0 = scheduler.initial_state(snap)
+    auxes = tuple(p.aux() for p in plugins)
+    key = ("profile_scores",) + tuple(p.static_key() for p in plugins)
+    cache = scheduler._solve_cache
+    if key not in cache:
+
+        def scores_fn(snap, state0, auxes):
+            for plugin, aux in zip(plugins, auxes):
+                plugin.bind_aux(aux)
+            for plugin in plugins:
+                plugin.bind_presolve(plugin.prepare_solve(snap))
+
+            from scheduler_plugins_tpu.ops.fit import fits_one
+
+            def per_pod(p):
+                feasible = fits_one(
+                    snap.pods.req[p], state0.free, snap.nodes.mask
+                )
+                for plugin in plugins:
+                    mask = plugin.filter(state0, snap, p)
+                    if mask is not None:
+                        feasible &= mask
+                total = jnp.zeros(snap.num_nodes, jnp.int64)
+                for plugin in plugins:
+                    raw = plugin.score(state0, snap, p)
+                    if raw is not None:
+                        total = total + plugin.weight * plugin.normalize(
+                            raw, feasible
+                        )
+                return total, feasible
+
+            return jax.vmap(per_pod)(jnp.arange(snap.num_pods))
+
+        cache[key] = jax.jit(scores_fn)
+    return cache[key](snap, state0, auxes)
+
+
 def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
     """Jit `batch_solve` with the snapshot sharded over `mesh`; XLA inserts
     the cross-shard collectives."""

@@ -20,6 +20,22 @@ from scheduler_plugins_tpu.ops.quota import quota_admit, quota_commit
 class CapacityScheduling(Plugin):
     name = "CapacityScheduling"
 
+    def __init__(self, min_candidate_nodes_percentage: int = None,
+                 min_candidate_nodes_absolute: int = None):
+        #: candidate-sampling knobs of the upstream evaluator the reference
+        #: wraps (preemption.NewEvaluator consumes DefaultPreemptionArgs;
+        #: calculateNumCandidates preemption_toleration.go:318-331 is the
+        #: shared k/k implementation) — validated at engine construction
+        from scheduler_plugins_tpu.framework.preemption import (
+            PreemptionEngine,
+        )
+
+        PreemptionEngine.validate_sampling_args(  # fail fast at load time
+            min_candidate_nodes_percentage, min_candidate_nodes_absolute
+        )
+        self.min_candidate_nodes_percentage = min_candidate_nodes_percentage
+        self.min_candidate_nodes_absolute = min_candidate_nodes_absolute
+
     def events_to_register(self):
         # freed capacity or quota growth (capacity_scheduling.go:194-203;
         # the EQ event is ActionType All)
@@ -35,7 +51,11 @@ class CapacityScheduling(Plugin):
             PreemptionMode,
         )
 
-        return PreemptionEngine(PreemptionMode.CAPACITY)
+        return PreemptionEngine(
+            PreemptionMode.CAPACITY,
+            min_candidate_nodes_percentage=self.min_candidate_nodes_percentage,
+            min_candidate_nodes_absolute=self.min_candidate_nodes_absolute,
+        )
 
     def admit(self, state, snap, p):
         if snap.quota is None or state.eq_used is None:

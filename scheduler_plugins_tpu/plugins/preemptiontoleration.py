@@ -20,10 +20,25 @@ from scheduler_plugins_tpu.framework.preemption import (
 class PreemptionToleration(Plugin):
     name = "PreemptionToleration"
 
+    def __init__(self, min_candidate_nodes_percentage: int = None,
+                 min_candidate_nodes_absolute: int = None):
+        #: PreemptionTolerationArgs = upstream DefaultPreemptionArgs
+        #: (/root/reference/apis/config/types.go PreemptionTolerationArgs;
+        #: sampling preemption_toleration.go:306-331)
+        PreemptionEngine.validate_sampling_args(  # fail fast at load time
+            min_candidate_nodes_percentage, min_candidate_nodes_absolute
+        )
+        self.min_candidate_nodes_percentage = min_candidate_nodes_percentage
+        self.min_candidate_nodes_absolute = min_candidate_nodes_absolute
+
     def events_to_register(self):
         # a victim's deletion admits the preemptor (upstream
         # DefaultPreemption registers Pod/Delete)
         return ("Pod/Delete",)
 
     def preemption_engine(self) -> PreemptionEngine:
-        return PreemptionEngine(PreemptionMode.DEFAULT, toleration=True)
+        return PreemptionEngine(
+            PreemptionMode.DEFAULT, toleration=True,
+            min_candidate_nodes_percentage=self.min_candidate_nodes_percentage,
+            min_candidate_nodes_absolute=self.min_candidate_nodes_absolute,
+        )

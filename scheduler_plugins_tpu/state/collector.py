@@ -321,32 +321,153 @@ class KubernetesMetricsServerCollector:
         return out
 
 
+class SignalFxCollector:
+    """Library-mode client for `MetricProvider.Type: SignalFx` — the
+    in-process equivalent of load-watcher's SignalFx provider selected by
+    the reference's collector (/root/reference/pkg/trimaran/collector.go:
+    63-73 NewLibraryClient; type constant apis/config/types.go:77).
+
+    Plain HTTP against the SignalFx REST API (no SDK, same pattern as the
+    Prometheus / metrics-server clients):
+
+    - `GET /v1/timeserieswindow?query=sf_metric:"cpu.utilization"` (and
+      `memory.utilization`) with `X-SF-TOKEN` auth pulls the last window of
+      samples for every reporting time series;
+    - time-series ids resolve to their `host` dimension via ONE bulk
+      metadata query per metric (`GET /v2/metrictimeseries?query=...`),
+      falling back to per-tsid lookups only for ids the bulk result missed;
+      the tsid->host map is cached across fetches (tsids are stable, so
+      steady-state fetches cost two requests total).
+
+    Window samples average into an Average-operator percentage like the
+    other providers (cpu/memory utilization metrics are already percent of
+    capacity)."""
+
+    TIMESERIES_PATH = "/v1/timeserieswindow"
+    METADATA_PATH = "/v2/metrictimeseries/"
+    CPU_METRIC = "cpu.utilization"
+    MEM_METRIC = "memory.utilization"
+    WINDOW_MS = 10 * 60 * 1000
+
+    def __init__(self, address: str, token: str = "",
+                 insecure_skip_verify: bool = False, timeout_s: float = 5.0):
+        if not address:
+            raise ValueError("SignalFx metric provider requires an address")
+        self.address = address.rstrip("/")
+        self.token = token
+        self.insecure_skip_verify = insecure_skip_verify
+        self.timeout_s = timeout_s
+        self._tsid_host: dict[str, str] = {}
+
+    def _get(self, path_and_query: str) -> dict:
+        """SignalFx auth rides the X-SF-TOKEN header, not a Bearer token."""
+        import ssl
+
+        req = urllib.request.Request(self.address + path_and_query)
+        if self.token:
+            req.add_header("X-SF-TOKEN", self.token)
+        ctx = None
+        if self.insecure_skip_verify and self.address.startswith("https"):
+            ctx = ssl._create_unverified_context()
+        with urllib.request.urlopen(
+            req, timeout=self.timeout_s, context=ctx
+        ) as resp:
+            return json.loads(resp.read())
+
+    @staticmethod
+    def _meta_host(meta: dict) -> str:
+        return str((meta.get("dimensions") or {}).get("host", "")
+                   or meta.get("host", ""))
+
+    def _resolve_hosts(self, tsids, metric: str) -> None:
+        """Fill the tsid->host cache for any unresolved ids: one bulk
+        metadata query for the metric, then per-tsid fallback for stragglers
+        (avoids N serial lookups on a cold cache)."""
+        import urllib.parse
+
+        missing = [t for t in tsids if t not in self._tsid_host]
+        if not missing:
+            return
+        query = urllib.parse.quote(f'sf_metric:"{metric}"')
+        try:
+            bulk = self._get(
+                f"{self.METADATA_PATH.rstrip('/')}?query={query}"
+                f"&limit={max(len(missing) * 2, 1000)}"
+            )
+            for item in bulk.get("results", []):
+                tsid = str(item.get("id", ""))
+                if tsid:
+                    self._tsid_host[tsid] = self._meta_host(item)
+        except Exception:
+            pass  # fall through to per-tsid lookups
+        for tsid in missing:
+            if tsid in self._tsid_host:
+                continue
+            try:
+                meta = self._get(self.METADATA_PATH + tsid)
+            except Exception:
+                continue  # transient: retry next fetch, don't cache
+            self._tsid_host[tsid] = self._meta_host(meta)
+
+    def _metric_by_host(self, metric: str) -> dict[str, float]:
+        import time as _time
+        import urllib.parse
+
+        end_ms = int(_time.time() * 1000)
+        query = urllib.parse.quote(f'sf_metric:"{metric}"')
+        payload = self._get(
+            f"{self.TIMESERIES_PATH}?query={query}"
+            f"&startMs={end_ms - self.WINDOW_MS}&endMs={end_ms}"
+        )
+        series = {
+            tsid: [
+                float(point[1]) for point in samples
+                if isinstance(point, (list, tuple)) and len(point) >= 2
+            ]
+            for tsid, samples in (payload.get("data") or {}).items()
+        }
+        self._resolve_hosts([t for t, v in series.items() if v], metric)
+        out: dict[str, float] = {}
+        for tsid, values in series.items():
+            if not values:
+                continue
+            host = self._tsid_host.get(tsid) or None
+            if host:
+                out[host] = sum(values) / len(values)
+        return out
+
+    def fetch(self) -> dict[str, dict]:
+        cpu = self._metric_by_host(self.CPU_METRIC)
+        mem = self._metric_by_host(self.MEM_METRIC)
+        out: dict[str, dict] = {}
+        for node, value in cpu.items():
+            out.setdefault(node, {}).update(
+                {"cpu_avg": value, "cpu_tlp": value, "cpu_peaks": value}
+            )
+        for node, value in mem.items():
+            out.setdefault(node, {})["mem_avg"] = value
+        return out
+
+
 def make_metrics_client(watcher_address: Optional[str] = None,
                         metric_provider: Optional[dict] = None):
     """collector.go:60-73: a WatcherAddress selects the remote service
     client; otherwise the MetricProviderSpec selects an in-process library
-    client (Prometheus and KubernetesMetricsServer bundled; the SignalFx
-    SDK client is not shipped in this build)."""
+    client (Prometheus, KubernetesMetricsServer and SignalFx all bundled as
+    plain-HTTP clients)."""
     if watcher_address:
         return LoadWatcherCollector(watcher_address)
     mp = metric_provider or {}
     mtype = mp.get("type", "KubernetesMetricsServer")
     if mtype not in METRIC_PROVIDER_TYPES:
         raise ValueError(f"invalid metric provider type {mtype!r}")
-    if mtype == "Prometheus":
-        return PrometheusCollector(
-            mp.get("address", ""),
-            token=mp.get("token", ""),
-            insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
-        )
-    if mtype == "KubernetesMetricsServer":
-        return KubernetesMetricsServerCollector(
-            mp.get("address", ""),
-            token=mp.get("token", ""),
-            insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
-        )
-    raise ValueError(
-        f"metric provider type {mtype!r} needs an external SDK this build "
-        "does not bundle; configure watcherAddress, Prometheus or "
-        "KubernetesMetricsServer"
+    cls = {
+        "Prometheus": PrometheusCollector,
+        "KubernetesMetricsServer": KubernetesMetricsServerCollector,
+        "SignalFx": SignalFxCollector,
+    }[mtype]
+    return cls(
+        mp.get("address", ""),
+        token=mp.get("token", ""),
+        insecure_skip_verify=bool(mp.get("insecureSkipVerify", False)),
     )

@@ -250,9 +250,11 @@ class TestPrometheusCollector:
             PrometheusCollector,
         )
         with pytest.raises(ValueError):
-            make_metrics_client(None, {"type": "SignalFx", "address": "x"})
+            make_metrics_client(None, {"type": "Bogus", "address": "x"})
         with pytest.raises(ValueError):
             make_metrics_client(None, {"type": "Prometheus"})  # no address
+        with pytest.raises(ValueError):
+            make_metrics_client(None, {"type": "SignalFx"})  # no address
 
 
 class TestMetricsServerCollector:
@@ -336,8 +338,6 @@ class TestMetricsServerCollector:
         assert parse_quantity_millis("1.5Gi") == int(1.5 * (1 << 30)) * 1000
 
     def test_factory_selects_metrics_server(self):
-        import pytest
-
         from scheduler_plugins_tpu.state.collector import (
             KubernetesMetricsServerCollector,
             make_metrics_client,
@@ -348,6 +348,103 @@ class TestMetricsServerCollector:
                                        "address": "http://apiserver:6443"}),
             KubernetesMetricsServerCollector,
         )
-        with pytest.raises(ValueError, match="SDK"):
+
+
+class TestSignalFxCollector:
+    """Library-mode client (MetricProvider.Type: SignalFx) faked at the HTTP
+    boundary: timeserieswindow + metric-time-series metadata
+    (/root/reference/pkg/trimaran/collector.go:63-73 library-client path)."""
+
+    def _serve(self):
+        import http.server
+        import json as _json
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            requests = []
+
+            def do_GET(self):
+                Handler.requests.append(self.path)
+                Handler.last_token = self.headers.get("X-SF-TOKEN")
+                if self.path.startswith("/v1/timeserieswindow"):
+                    if "cpu.utilization" in self.path:
+                        body = _json.dumps({"data": {
+                            "tsid-a": [[1000, 30.0], [2000, 50.0]],
+                            "tsid-b": [[1000, 10.0]],
+                            "tsid-empty": [],
+                        }}).encode()
+                    else:
+                        body = _json.dumps({"data": {
+                            "tsid-a-mem": [[1000, 75.0]],
+                        }}).encode()
+                elif self.path.startswith("/v2/metrictimeseries?"):
+                    # bulk metadata: cpu bulk deliberately OMITS tsid-b so
+                    # the per-tsid fallback path is exercised too
+                    if "cpu.utilization" in self.path:
+                        results = [{"id": "tsid-a",
+                                    "dimensions": {"host": "node-a"}}]
+                    else:
+                        results = [{"id": "tsid-a-mem",
+                                    "dimensions": {"host": "node-a"}}]
+                    body = _json.dumps({"results": results}).encode()
+                elif self.path.startswith("/v2/metrictimeseries/"):
+                    tsid = self.path.rsplit("/", 1)[1]
+                    host = {"tsid-a": "node-a", "tsid-b": "node-b",
+                            "tsid-a-mem": "node-a"}.get(tsid, "")
+                    body = _json.dumps(
+                        {"dimensions": {"host": host}}
+                    ).encode()
+                else:
+                    body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, Handler, f"http://127.0.0.1:{server.server_port}"
+
+    def test_fetch_averages_window_and_resolves_hosts(self):
+        from scheduler_plugins_tpu.state.collector import SignalFxCollector
+
+        server, handler, addr = self._serve()
+        try:
+            c = SignalFxCollector(addr, token="sfx-token")
+            metrics = c.fetch()
+            assert metrics["node-a"]["cpu_avg"] == 40.0  # mean(30, 50)
+            assert metrics["node-a"]["cpu_tlp"] == 40.0
+            assert metrics["node-a"]["cpu_peaks"] == 40.0
+            assert metrics["node-a"]["mem_avg"] == 75.0
+            assert metrics["node-b"]["cpu_avg"] == 10.0
+            assert "mem_avg" not in metrics["node-b"]
+            assert handler.last_token == "sfx-token"
+            # the cold fetch resolves hosts with bulk queries (+ one
+            # per-tsid fallback for tsid-b, which the cpu bulk omits)
+            assert [p for p in handler.requests
+                    if p.startswith("/v2/metrictimeseries/")] == [
+                "/v2/metrictimeseries/tsid-b"
+            ]
+            # tsid->host metadata is cached: a second fetch adds only the
+            # two timeserieswindow calls
+            before = len(handler.requests)
+            c.fetch()
+            assert len(handler.requests) == before + 2
+        finally:
+            server.shutdown()
+
+    def test_factory_selects_signalfx(self):
+        from scheduler_plugins_tpu.state.collector import (
+            SignalFxCollector,
+            make_metrics_client,
+        )
+
+        assert isinstance(
             make_metrics_client(None, {"type": "SignalFx",
-                                       "address": "http://sfx"})
+                                       "address": "http://sfx",
+                                       "token": "t"}),
+            SignalFxCollector,
+        )

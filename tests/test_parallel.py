@@ -257,6 +257,102 @@ class TestBatchedStateDependentFilters:
         assert int((an[:P] >= 0).sum()) == n_seq
 
 
+class TestBatchedSequentialDrift:
+    """VERDICT r2 item 8: the batched path's cycle-initial-score trade-off
+    (parallel/solver.py profile_batch_solve docstring) gets a MEASURED bound
+    — on all five BASELINE profiles, batched placements must place as many
+    pods as the sequential parity path and score within 10% of it on the
+    shared cycle-initial objective."""
+
+    #: relative score-sum drift floor (batched may be at most 10% worse)
+    MAX_RELATIVE_SCORE_DRIFT = 0.10
+
+    def _drift(self, cluster, plugins):
+        import numpy as np
+
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.parallel.solver import (
+            profile_batch_solve,
+            profile_initial_scores,
+        )
+
+        sched = Scheduler(Profile(plugins=plugins))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        P = len(pending)
+        seq = np.asarray(sched.solve(snap).assignment)[:P]
+        bat = np.asarray(profile_batch_solve(sched, snap)[0])[:P]
+        scores, _ = profile_initial_scores(sched, snap)
+        scores = np.asarray(scores)[:P]
+
+        def score_sum(a):
+            placed = a >= 0
+            return int(scores[np.arange(P)[placed], a[placed]].sum())
+
+        s_seq, s_bat = score_sum(seq), score_sum(bat)
+        rel = (s_bat - s_seq) / max(abs(s_seq), 1)
+        return int((seq >= 0).sum()), int((bat >= 0).sum()), rel
+
+    def _assert_bounded(self, cluster, plugins):
+        placed_seq, placed_bat, rel = self._drift(cluster, plugins)
+        assert placed_bat >= placed_seq, (placed_seq, placed_bat)
+        assert rel >= -self.MAX_RELATIVE_SCORE_DRIFT, rel
+
+    def test_config1_allocatable(self):
+        from scheduler_plugins_tpu.models import allocatable_scenario
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        self._assert_bounded(
+            allocatable_scenario(128, 512), [NodeResourcesAllocatable()]
+        )
+
+    def test_config2_trimaran(self):
+        from scheduler_plugins_tpu.models import trimaran_scenario
+        from scheduler_plugins_tpu.plugins import (
+            LoadVariationRiskBalancing,
+            TargetLoadPacking,
+        )
+
+        self._assert_bounded(
+            trimaran_scenario(256, 256),
+            [TargetLoadPacking(), LoadVariationRiskBalancing()],
+        )
+
+    def test_config3_numa(self):
+        from scheduler_plugins_tpu.models import numa_scenario
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+
+        self._assert_bounded(
+            numa_scenario(64, 128, zones=4), [NodeResourceTopologyMatch()]
+        )
+
+    def test_config4_gang_quota(self):
+        from scheduler_plugins_tpu.models import gang_quota_scenario
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+            NodeResourcesAllocatable,
+        )
+
+        self._assert_bounded(
+            gang_quota_scenario(n_gangs=8, gang_size=16, n_nodes=64),
+            [NodeResourcesAllocatable(), Coscheduling(),
+             CapacityScheduling()],
+        )
+
+    def test_config5_network(self):
+        from scheduler_plugins_tpu.models import network_scenario
+        from scheduler_plugins_tpu.plugins import (
+            NetworkOverhead,
+            TopologicalSort,
+        )
+
+        self._assert_bounded(
+            network_scenario(64, 128), [NetworkOverhead(), TopologicalSort()]
+        )
+
+
 class TestShardedProfileSolve:
     """VERDICT r2 item 2: the FULL plugin roster — NUMA wave guards, network
     dependency thresholds, spread validators — must run under the

@@ -469,3 +469,107 @@ class TestHoldOrderIndependence:
         assert "default/w1" in report.preempted
         node, victims = report.preempted["default/w1"]
         assert node == "n0" and victims == ["default/low"]
+
+
+class TestCandidateSampling:
+    """calculateNumCandidates / GetOffsetAndNumCandidates decision table
+    (/root/reference/pkg/preemptiontoleration/preemption_toleration.go:
+    306-331, shared k/k implementation); args flow VERDICT r2 item 7."""
+
+    def _engine(self, pct=None, absolute=None, rng=None):
+        from scheduler_plugins_tpu.framework.preemption import (
+            PreemptionEngine,
+            PreemptionMode,
+        )
+
+        return PreemptionEngine(
+            PreemptionMode.DEFAULT,
+            min_candidate_nodes_percentage=pct,
+            min_candidate_nodes_absolute=absolute,
+            candidate_rng=rng,
+        )
+
+    def test_calculate_num_candidates_table(self):
+        # (numNodes, pct, absolute) -> expected, mirroring the Go arithmetic
+        table = [
+            (5000, 10, 100, 500),   # pct dominates
+            (500, 10, 100, 100),    # absolute floor wins
+            (80, 10, 100, 80),      # capped at numNodes
+            (100, 0, 7, 7),         # pct 0: absolute only
+            (10, 100, 1, 10),       # pct 100: everything
+            (0, 10, 100, 0),        # empty cluster
+        ]
+        for num_nodes, pct, absolute, want in table:
+            engine = self._engine(pct, absolute)
+            assert engine.calculate_num_candidates(num_nodes) == want, (
+                num_nodes, pct, absolute)
+
+    def test_validation_mirrors_upstream(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="minCandidateNodesPercentage"):
+            self._engine(pct=101)
+        with pytest.raises(ValueError, match="minCandidateNodesPercentage"):
+            self._engine(pct=-1)
+        with pytest.raises(ValueError, match="minCandidateNodesAbsolute"):
+            self._engine(absolute=-5)
+        with pytest.raises(ValueError, match="cannot both be zero"):
+            self._engine(pct=0, absolute=0)
+
+    def test_offset_sampling_is_circular_window(self):
+        import random
+
+        import numpy as np
+
+        # 10 nodes, all feasible; offset 7, want 4 -> 7,8,9,0
+        engine = self._engine(pct=40, absolute=1, rng=random.Random(0))
+        engine._candidate_rng = type("R", (), {
+            "randrange": staticmethod(lambda n: 7)
+        })()
+        fits = np.ones(10, bool)
+        assert engine.sample_candidates(fits, 10).tolist() == [7, 8, 9, 0]
+        # infeasible nodes leave the pool, and the candidate count is
+        # computed over the POOL size like upstream's len(potentialNodes):
+        # 9 feasible * 40% -> 3 candidates
+        fits[8] = False
+        assert engine.sample_candidates(fits, 10).tolist() == [7, 9, 0]
+
+    def test_args_flow_from_profile(self):
+        from scheduler_plugins_tpu.api.config import load_profile
+
+        profile = load_profile({
+            "plugins": ["CapacityScheduling"],
+            "pluginConfig": [{
+                "name": "CapacityScheduling",
+                "args": {"minCandidateNodesPercentage": 25,
+                         "minCandidateNodesAbsolute": 3},
+            }],
+        })
+        engine = profile.preemption
+        assert engine.min_candidate_nodes_percentage == 25
+        assert engine.min_candidate_nodes_absolute == 3
+        assert engine.calculate_num_candidates(40) == 10
+
+        profile = load_profile({
+            "plugins": ["PreemptionToleration"],
+            "pluginConfig": [{
+                "name": "PreemptionToleration",
+                "args": {"minCandidateNodesAbsolute": 1,
+                         "minCandidateNodesPercentage": 0},
+            }],
+        })
+        assert profile.preemption.calculate_num_candidates(1000) == 1
+
+    def test_invalid_args_rejected_at_load(self):
+        import pytest
+
+        from scheduler_plugins_tpu.api.config import load_profile
+
+        with pytest.raises(ValueError):
+            load_profile({
+                "plugins": ["PreemptionToleration"],
+                "pluginConfig": [{
+                    "name": "PreemptionToleration",
+                    "args": {"minCandidateNodesPercentage": 200},
+                }],
+            })
