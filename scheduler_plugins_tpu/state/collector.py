@@ -160,14 +160,17 @@ METRIC_PROVIDER_TYPES = (
 
 
 def _authed_get(address: str, path_and_query: str, token: str,
-                insecure_skip_verify: bool, timeout_s: float) -> dict:
-    """One GET with optional bearer token / unverified TLS — the HTTP
-    plumbing both library-mode clients share."""
+                insecure_skip_verify: bool, timeout_s: float,
+                auth_header: str = "Authorization",
+                auth_prefix: str = "Bearer ") -> dict:
+    """One GET with optional token auth / unverified TLS — the HTTP
+    plumbing all library-mode clients share (SignalFx overrides the header
+    to X-SF-TOKEN)."""
     import ssl
 
     req = urllib.request.Request(address + path_and_query)
     if token:
-        req.add_header("Authorization", f"Bearer {token}")
+        req.add_header(auth_header, f"{auth_prefix}{token}")
     ctx = None
     if insecure_skip_verify and address.startswith("https"):
         ctx = ssl._create_unverified_context()
@@ -361,18 +364,11 @@ class SignalFxCollector:
 
     def _get(self, path_and_query: str) -> dict:
         """SignalFx auth rides the X-SF-TOKEN header, not a Bearer token."""
-        import ssl
-
-        req = urllib.request.Request(self.address + path_and_query)
-        if self.token:
-            req.add_header("X-SF-TOKEN", self.token)
-        ctx = None
-        if self.insecure_skip_verify and self.address.startswith("https"):
-            ctx = ssl._create_unverified_context()
-        with urllib.request.urlopen(
-            req, timeout=self.timeout_s, context=ctx
-        ) as resp:
-            return json.loads(resp.read())
+        return _authed_get(
+            self.address, path_and_query, self.token,
+            self.insecure_skip_verify, self.timeout_s,
+            auth_header="X-SF-TOKEN", auth_prefix="",
+        )
 
     @staticmethod
     def _meta_host(meta: dict) -> str:
@@ -396,8 +392,12 @@ class SignalFxCollector:
             )
             for item in bulk.get("results", []):
                 tsid = str(item.get("id", ""))
-                if tsid:
-                    self._tsid_host[tsid] = self._meta_host(item)
+                host = self._meta_host(item)
+                # only cache RESOLVED hosts: a series whose metadata has no
+                # host dimension yet (indexing lag) must retry next fetch,
+                # not be suppressed forever
+                if tsid and host:
+                    self._tsid_host[tsid] = host
         except Exception:
             pass  # fall through to per-tsid lookups
         for tsid in missing:
@@ -407,7 +407,9 @@ class SignalFxCollector:
                 meta = self._get(self.METADATA_PATH + tsid)
             except Exception:
                 continue  # transient: retry next fetch, don't cache
-            self._tsid_host[tsid] = self._meta_host(meta)
+            host = self._meta_host(meta)
+            if host:
+                self._tsid_host[tsid] = host
 
     def _metric_by_host(self, metric: str) -> dict[str, float]:
         import time as _time
@@ -427,14 +429,19 @@ class SignalFxCollector:
             for tsid, samples in (payload.get("data") or {}).items()
         }
         self._resolve_hosts([t for t, v in series.items() if v], metric)
-        out: dict[str, float] = {}
+        # multiple tsids can resolve to one host (agent restart leaves the
+        # old and new series both inside the window) — pool their samples
+        by_host: dict[str, list] = {}
         for tsid, values in series.items():
             if not values:
                 continue
-            host = self._tsid_host.get(tsid) or None
+            host = self._tsid_host.get(tsid)
             if host:
-                out[host] = sum(values) / len(values)
-        return out
+                by_host.setdefault(host, []).extend(values)
+        return {
+            host: sum(values) / len(values)
+            for host, values in by_host.items()
+        }
 
     def fetch(self) -> dict[str, dict]:
         cpu = self._metric_by_host(self.CPU_METRIC)
