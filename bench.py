@@ -303,9 +303,16 @@ CONFIG_METRICS = {
 }
 
 
-def latest_capture(config: int, mode: str):
+#: replay cutoff: a capture older than this is too stale to stand in for
+#: "the round's number" (a round is ~12h; 48h allows the previous round's
+#: tail while excluding week-old numbers from a drifted codebase)
+CAPTURE_MAX_AGE_S = 48 * 3600
+
+
+def latest_capture(config: int, mode: str, max_age_s: float = CAPTURE_MAX_AGE_S):
     """Newest healthy on-chip capture for (config, mode) from
-    BENCH_CAPTURES.jsonl (written by tools/bench_watch.py), or None."""
+    BENCH_CAPTURES.jsonl (written by tools/bench_watch.py), or None.
+    Captures older than `max_age_s` are skipped."""
     import os
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -333,6 +340,8 @@ def latest_capture(config: int, mode: str):
             if not isinstance(value, (int, float)) or value <= 0:
                 continue
             if not isinstance(ts, (int, float)):
+                continue
+            if time.time() - ts > max_age_s:
                 continue
             if best is None or ts > best["ts"]:
                 entry["ts"] = ts

@@ -564,7 +564,8 @@ class ClusterAgent:
                 self.skipped += 1
                 continue
             self.send(event)
-            self.translated = sent = sent + 1
+            sent += 1
+            self.translated += 1
         return sent
 
     def replay_lines(self, lines: Iterable[str]) -> int:

@@ -151,21 +151,23 @@ class PreemptionEngine:
             n = num_nodes
         return n
 
-    def sample_candidates(self, fits, num_nodes: int):
+    def sample_candidates(self, fits):
         """GetOffsetAndNumCandidates (preemption_toleration.go:306-309): a
         random offset INTO THE FEASIBLE POOL, then a circular scan over the
-        pool until the calculated count is reached — bounding dry-run work
-        on big clusters without always favoring low-index nodes. Both the
-        offset draw and the candidate count run over the feasible pool, as
-        upstream draws over potentialNodes."""
+        pool. Both the offset draw and the candidate count run over the
+        feasible pool, as upstream draws over potentialNodes. Returns
+        (rotated_pool, num_candidates): the FULL rotation plus the cap —
+        the caller counts only victim-producing candidates toward the cap,
+        because upstream's dry run keeps scanning past nodes whose reprieve
+        yields no victims until numCandidates candidates are gathered."""
         import numpy as np
 
         pool = np.nonzero(fits)[0]
         if pool.size == 0:
-            return pool
+            return pool, 0
         want = self.calculate_num_candidates(int(pool.size))
         offset = self._candidate_rng.randrange(int(pool.size))
-        return pool[(np.arange(pool.size) + offset) % pool.size][:want]
+        return pool[(np.arange(pool.size) + offset) % pool.size], want
 
     # -- exemption -------------------------------------------------------
     def exempted(self, victim: Pod, preemptor: Pod, cluster, now_ms: int) -> bool:
@@ -454,16 +456,20 @@ class PreemptionEngine:
         # sets — pickOneNode criteria: fewest PDB violations -> min highest
         # victim priority -> min priority sum -> fewest victims -> lowest
         # index
-        candidates = self.sample_candidates(fits, N)
+        rotation, want = self.sample_candidates(fits)
         pdbs = list(getattr(cluster, "pdbs", {}).values())
         best = None
-        for n in candidates:
+        produced = 0
+        for n in rotation:
+            if produced >= want:
+                break
             final, violations = self._reprieve(
                 victims_all, v_node, v_req, v_pri, eligible, int(n),
                 free[int(n)], demand, preemptor, snap, meta, pdbs, nom_aggs,
             )
             if not final:
                 continue
+            produced += 1
             stats = (
                 violations,
                 max(v.priority for v in final),

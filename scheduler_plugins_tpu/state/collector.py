@@ -361,6 +361,7 @@ class SignalFxCollector:
         self.insecure_skip_verify = insecure_skip_verify
         self.timeout_s = timeout_s
         self._tsid_host: dict[str, str] = {}
+        self.last_error: Optional[str] = None
 
     def _get(self, path_and_query: str) -> dict:
         """SignalFx auth rides the X-SF-TOKEN header, not a Bearer token."""
@@ -369,6 +370,18 @@ class SignalFxCollector:
             self.insecure_skip_verify, self.timeout_s,
             auth_header="X-SF-TOKEN", auth_prefix="",
         )
+
+    def _warn_once(self, message: str) -> None:
+        """Record the FIRST metadata-resolution failure of the current fetch
+        in `last_error` and emit one warning for it; repeats within the same
+        fetch are counted by the caller retrying next fetch, not re-warned
+        (a bad address/token would otherwise flood — or, before this hook
+        existed, read as silently-empty metrics)."""
+        if self.last_error is None:
+            import warnings
+
+            warnings.warn(f"SignalFx collector: {message}", stacklevel=3)
+        self.last_error = message
 
     @staticmethod
     def _meta_host(meta: dict) -> str:
@@ -398,14 +411,19 @@ class SignalFxCollector:
                 # not be suppressed forever
                 if tsid and host:
                     self._tsid_host[tsid] = host
-        except Exception:
-            pass  # fall through to per-tsid lookups
+        except Exception as exc:
+            # fall through to per-tsid lookups, but surface the failure: a
+            # bad address/token would otherwise read as silently-empty
+            # metrics (warn once per fetch, not once per tsid)
+            self._warn_once(f"bulk metadata query failed: {exc!r}")
         for tsid in missing:
             if tsid in self._tsid_host:
                 continue
             try:
                 meta = self._get(self.METADATA_PATH + tsid)
-            except Exception:
+            except Exception as exc:
+                self._warn_once(f"metadata lookup for tsid {tsid} failed: "
+                                f"{exc!r}")
                 continue  # transient: retry next fetch, don't cache
             host = self._meta_host(meta)
             if host:
@@ -444,6 +462,7 @@ class SignalFxCollector:
         }
 
     def fetch(self) -> dict[str, dict]:
+        self.last_error = None
         cpu = self._metric_by_host(self.CPU_METRIC)
         mem = self._metric_by_host(self.MEM_METRIC)
         out: dict[str, dict] = {}

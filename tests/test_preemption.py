@@ -527,12 +527,17 @@ class TestCandidateSampling:
             "randrange": staticmethod(lambda n: 7)
         })()
         fits = np.ones(10, bool)
-        assert engine.sample_candidates(fits, 10).tolist() == [7, 8, 9, 0]
+        rotation, want = engine.sample_candidates(fits)
+        # full rotation returned; the cap limits victim-PRODUCING candidates
+        assert rotation.tolist() == [7, 8, 9, 0, 1, 2, 3, 4, 5, 6]
+        assert want == 4
         # infeasible nodes leave the pool, and the candidate count is
         # computed over the POOL size like upstream's len(potentialNodes):
         # 9 feasible * 40% -> 3 candidates
         fits[8] = False
-        assert engine.sample_candidates(fits, 10).tolist() == [7, 9, 0]
+        rotation, want = engine.sample_candidates(fits)
+        assert rotation.tolist() == [7, 9, 0, 1, 2, 3, 4, 5, 6]
+        assert want == 3
 
     def test_args_flow_from_profile(self):
         from scheduler_plugins_tpu.api.config import load_profile

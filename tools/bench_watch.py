@@ -26,6 +26,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CAPTURES = os.path.join(REPO, "BENCH_CAPTURES.jsonl")
 
+
+def log(msg):
+    """Every probe/sweep line carries a wall-clock timestamp so a dead round
+    is provable from the log alone (VERDICT r3: 8 untimestamped probes across
+    a whole round is not a serious attempt)."""
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(f"{stamp} {msg}", flush=True)
+
 #: (config, mode, per-run subprocess timeout seconds). Config 1 ignores mode.
 RUNS = [
     (1, "sequential", 900),
@@ -79,22 +87,21 @@ def cycle():
     for config, mode, timeout in RUNS:
         diagnosis = probe()
         if diagnosis is not None:
-            print(f"[watch] probe sick before config {config}: {diagnosis}",
-                  flush=True)
+            log(f"[watch] probe sick before config {config}: {diagnosis}")
             return good
         result = run_one(config, mode, timeout)
         entry = {"ts": time.time(), "config": config, "mode": mode, **result}
         append(entry)
         ok = "error" not in result and result.get("value", 0) > 0
         good += ok
-        print(f"[watch] config {config}/{mode}: "
-              f"{result.get('value', result.get('error'))}", flush=True)
+        log(f"[watch] config {config}/{mode}: "
+            f"{result.get('value', result.get('error'))}")
     return good
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--interval", type=int, default=900,
+    ap.add_argument("--interval", type=int, default=240,
                     help="seconds between probe attempts when sick / sweeps when healthy")
     ap.add_argument("--once", action="store_true")
     ap.add_argument("--max-hours", type=float, default=11.0)
@@ -104,13 +111,14 @@ def main():
     while time.time() < deadline:
         diagnosis = probe()
         if diagnosis is None:
+            log("[watch] tunnel HEALTHY — starting capture sweep")
             n = cycle()
             sweeps += 1
-            print(f"[watch] sweep {sweeps} done ({n} good captures)", flush=True)
+            log(f"[watch] sweep {sweeps} done ({n} good captures)")
             if args.once:
                 return
         else:
-            print(f"[watch] tunnel sick: {diagnosis}", flush=True)
+            log(f"[watch] tunnel sick: {diagnosis}")
         time.sleep(args.interval)
 
 
