@@ -170,6 +170,23 @@ class Plugin:
         """Score: (N,) int64 raw scores for pod `p`."""
         return None
 
+    def static_node_scores(self, snap: ClusterSnapshot):
+        """(N,) raw scores when this plugin's `score` is POD-INVARIANT
+        against the cycle-initial state — i.e. `score(state0, snap, p)`
+        returns the same vector for every p (the reference's allocatable
+        scorer rates allocatable capacity, not the pod,
+        resource_allocation.go:49-76). Implementing this lets the batched
+        solver take the targeted-waterfill fast path (O(P·R) waves, no
+        (P, N) score matrix). Must be called after `bind_aux`. Return None
+        (default) when scores depend on the pod.
+
+        CONTRACT: the fast path ranks nodes by this RAW vector and never
+        calls `normalize` or applies `weight` — only implement it when
+        your `normalize` is monotone non-decreasing in the raw score (e.g.
+        minmax_normalize) and your configured weight is positive, so the
+        raw ordering equals the normalized-weighted ordering."""
+        return None
+
     def normalize(self, scores, feasible):
         """NormalizeScore: transform (N,) raw scores over the feasible mask."""
         return scores

@@ -57,17 +57,32 @@ def _segment_prefix(values_sorted, first):
     return csum - base
 
 
-def _queue_order_admission(onehot, demand, free):
-    """(P,) bool: pod admitted iff its node still fits after all earlier
-    winners of the same wave on that node (exact sorted-segment prefix
-    sums in float64 — exact below 2^53)."""
-    P, N = onehot.shape
-    order, seg, first = _sorted_segments(onehot)
+def _queue_order_admission_choice(choice, demand, free):
+    """(P,) bool: pod admitted iff its chosen node still fits after all
+    earlier same-wave choosers of that node (exact sorted-segment prefix
+    sums in float64 — exact below 2^53). `choice` is (P,) int32 node
+    indices with -1 = no choice; never materializes a (P, N) onehot."""
+    P = choice.shape[0]
+    N = free.shape[0]
+    seg_choice = jnp.where(choice >= 0, choice, N)
+    order = jnp.argsort(
+        seg_choice.astype(jnp.int64) * P + jnp.arange(P)
+    )  # stable (choice, queue); int64 keys — N*P can exceed int32
+    seg = seg_choice[order]
+    first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
     dem_sorted = demand[order].astype(jnp.float64)  # (P, R)
     within = _segment_prefix(dem_sorted, first)  # inclusive per-segment
     free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)  # (P, R)
     ok_sorted = jnp.all(within <= free_row, axis=1) & (seg < N)
     return jnp.zeros(P, bool).at[order].set(ok_sorted)
+
+
+def _queue_order_admission(onehot, demand, free):
+    """`_queue_order_admission_choice` for callers holding a (P, N) onehot."""
+    choice = jnp.where(
+        onehot.any(axis=1), jnp.argmax(onehot, axis=1).astype(jnp.int32), -1
+    )
+    return _queue_order_admission_choice(choice, demand, free)
 
 
 def _pick(feasible, scores):
@@ -145,6 +160,7 @@ def waterfill_assign_stateful(
     validate_fn=None,
     validate_commit_fn=None,
     capacity_fns=(),
+    initial_batch=None,
 ):
     """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
     filters (NUMA zone availability, network placement tallies): the carries
@@ -177,6 +193,13 @@ def waterfill_assign_stateful(
       is a handful of gathers per pod — this is for O(1)-per-pod checks,
       not (N,)-wide filters.
 
+    ``initial_batch``: optional (feasible0 (P,N), scores0 (P,N)) — the
+    cycle-initial filter/score tensors the caller already computed (the
+    profile solver's per-pod pass evaluates every plugin filter against
+    state0 for normalization anyway). Wave 0 then reuses them instead of
+    paying ``batch_fn`` a second time on the unchanged initial state; waves
+    1+ always re-evaluate against the committed carry.
+
     Not jitted itself: designed to run inside a caller's jit (the closures
     are trace-local). Returns (assignment, free, state).
     """
@@ -184,10 +207,9 @@ def waterfill_assign_stateful(
     demand = pod_fit_demand(req)
     N = free0.shape[0]
 
-    def wave(free, assignment, state):
+    def wave_core(free, assignment, state, feasible, scores):
         active = (assignment == -1) & pod_mask
-        feasible, scores = batch_fn(free, state, active)
-        feasible &= active[:, None]
+        feasible = feasible & active[:, None]
         neg_inf = jnp.iinfo(scores.dtype).min // 2
         n_active = jnp.maximum(active.sum(), 1)
 
@@ -268,21 +290,206 @@ def waterfill_assign_stateful(
         return free - used, new_assignment, state, admitted.sum()
 
     def cond(loop_state):
-        _, _, _, wave_idx, progressed = loop_state
-        return (wave_idx < max_waves) & progressed
+        _, assignment, _, wave_idx, progressed = loop_state
+        # stop on wave budget, on a no-progress wave, or — cheaper — when
+        # nothing is left to place (otherwise a fully-placed batch pays one
+        # whole extra wave just to discover quiescence)
+        return (
+            (wave_idx < max_waves)
+            & progressed
+            & ((assignment == -1) & pod_mask).any()
+        )
+
+    def wave(free, assignment, state):
+        active = (assignment == -1) & pod_mask
+        feasible, scores = batch_fn(free, state, active)
+        return wave_core(free, assignment, state, feasible, scores)
 
     def body(loop_state):
         free, assignment, state, wave_idx, _ = loop_state
         free, assignment, state, n_admitted = wave(free, assignment, state)
         return free, assignment, state, wave_idx + 1, n_admitted > 0
 
-    free, assignment, state, _, _ = jax.lax.while_loop(
-        cond,
-        body,
-        (free0, jnp.full(P, -1, jnp.int32), state0, jnp.int32(0),
-         jnp.bool_(True)),
-    )
+    assignment0 = jnp.full(P, -1, jnp.int32)
+    if initial_batch is not None:
+        # wave 0 against the caller's precomputed cycle-initial tensors —
+        # batch_fn is first consulted on wave 1, after commits changed state
+        feasible0, scores0 = initial_batch
+        free_w, assignment_w, state_w, n0 = wave_core(
+            free0, assignment0, state0, feasible0, scores0
+        )
+        init = (free_w, assignment_w, state_w, jnp.int32(1), n0 > 0)
+    else:
+        init = (free0, assignment0, state0, jnp.int32(0), jnp.bool_(True))
+
+    free, assignment, state, _, _ = jax.lax.while_loop(cond, body, init)
     return assignment, free, state
+
+
+@partial(jax.jit, static_argnames=("max_waves",))
+def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
+                              max_waves: int = 8):
+    """Waterfill for STATIC per-node scores (the allocatable flagship and the
+    north-star scale): per wave, each active pod checks fit against ONE
+    target node — the capacity-bucket choice — in O(P·R) gathers, never
+    materializing the (P, N) feasibility/score matrix the generic waterfill
+    recomputes every wave. At 100k pods x 10k nodes that matrix is ~4B
+    int64 compares per wave; this path does ~400k.
+
+    Caller contract: `raw_scores` must already be the desired node ranking —
+    the caller's normalization must be MONOTONE in the raw score and its
+    weight positive (true of minmax_normalize and the single-scoring-plugin
+    fast-path gate in parallel.solver), because this path orders by the raw
+    vector and never runs normalize().
+
+    Correctness: scores are static, so the node ranking never changes.
+    Queue-order per-node admission is the same exact sorted-segment prefix
+    check the generic waterfill runs. A pod whose target fails (fit or
+    admission) retries next wave against shrunk capacities; when the lite
+    waves stop progressing, FULL waves take over: windows of up to K
+    stragglers get a dense (K, N) feasibility row, feasible ones spread
+    round-robin over their own feasible sets, and window pods with NO
+    feasible node are retired as hopeless (sound within one solve — free
+    capacity only shrinks here, so infeasible-now is infeasible-later), so
+    junk pods cannot starve the window for feasible stragglers behind them.
+    Completeness therefore matches `waterfill_assign` UP TO THE WAVE
+    BUDGET: each phase runs at most `max_waves` waves (2*max_waves total),
+    and every full wave either places a pod, retires a hopeless one, or is
+    the last. Hard constraints (fit, node queue-order admission) hold
+    identically in all cases.
+
+    Mirrors the reference's scoring semantics for allocatable
+    (/root/reference/pkg/noderesources/resource_allocation.go:49-76) at
+    wave granularity."""
+    P, R = req.shape
+    N = free0.shape[0]
+    demand = pod_fit_demand(req)
+    order_n = jnp.argsort(-raw_scores, stable=True)  # static node ranking
+
+    def bucket_target(free, active):
+        # cumulative-demand waterfill: pod p targets the first node (score
+        # order) whose CUMULATIVE free capacity covers p's inclusive
+        # cumulative demand, per resource (exact under heterogeneous
+        # demands, unlike a mean-demand pods-per-node estimate: a queue of
+        # small pods fills the preferred nodes first instead of stampeding
+        # the one big node, mirroring sequential packing order). R 1-D
+        # cumsums + R searchsorteds — float64 exact below 2^53.
+        charge = jnp.where(active[:, None], demand, 0).astype(jnp.float64)
+        cumdem = jnp.cumsum(charge, axis=0)  # (P, R) inclusive
+        cumfree = jnp.cumsum(
+            jnp.clip(free[order_n], 0, None).astype(jnp.float64), axis=0
+        )  # (N, R) in score order
+        pos = jnp.max(
+            jax.vmap(
+                lambda cf, cd: jnp.searchsorted(cf, cd, side="left"),
+                in_axes=(1, 1), out_axes=1,
+            )(cumfree, cumdem),
+            axis=1,
+        )  # (P,) first node index (score order) covering the prefix
+        return order_n[jnp.minimum(pos, N - 1)].astype(jnp.int32)
+
+    def lite_choice(free, active):
+        target = bucket_target(free, active)
+        # O(P*R): fit against the target row only
+        fit = jnp.all(demand <= free[target], axis=1)
+        # lite misses prove nothing about true feasibility: no hopeless delta
+        return jnp.where(active & fit, target, -1), jnp.zeros(P, bool)
+
+    #: rescue-wave window: dense feasibility is computed for at most this
+    #: many stragglers at a time ((K, N) work instead of (P, N); the wave
+    #: loop drains K per wave when more remain)
+    K = min(P, 512)
+
+    def full_choice(free, active):
+        # dense rescue wave: straggler k takes the (k mod |feasible_k|)-th
+        # best node of ITS OWN feasible set in score order. Plain argmax
+        # stampedes one tied-score node (admission then drains a node's
+        # worth per wave — O(stragglers/node-capacity) waves at the
+        # fragmented end-game); round-robin over each pod's feasible set
+        # drains the residue in O(1) dense waves. Rank 0 still gets its
+        # argmax, so the common one-straggler case keeps reference scoring.
+        # Compaction: only the first K stragglers (queue order) pay the
+        # dense row; later ones stay active for the next wave. Window pods
+        # with NO feasible node are reported hopeless so they stop
+        # occupying the window (free only shrinks within a solve, so the
+        # verdict cannot go stale).
+        sel = jnp.argsort(jnp.where(active, jnp.arange(P), P))[:K]
+        sel_active = active[sel]
+        feasible = jnp.all(
+            demand[sel][:, None, :] <= free[None, :, :], axis=2
+        ) & sel_active[:, None]
+        feas_sorted = feasible[:, order_n]  # score-desc node order
+        counts = jnp.cumsum(feas_sorted.astype(jnp.int32), axis=1)
+        total = counts[:, -1]
+        k = jnp.where(total > 0, jnp.arange(K) % jnp.maximum(total, 1), 0)
+        pos = jax.vmap(
+            lambda c, kk: jnp.searchsorted(c, kk, side="right")
+        )(counts, k)  # first score-ordered index with counts > k
+        choice_k = jnp.where(
+            sel_active & (total > 0),
+            order_n[jnp.minimum(pos, N - 1)].astype(jnp.int32),
+            -1,
+        )
+        choice = jnp.full(P, -1, jnp.int32).at[sel].set(choice_k)
+        hopeless_delta = jnp.zeros(P, bool).at[sel].set(
+            sel_active & (total == 0)
+        )
+        return choice, hopeless_delta
+
+    def wave(free, assignment, hopeless, choice_fn):
+        # O(P·R + P log P): admission runs on the (P,) choice vector via
+        # sorted segments (`_queue_order_admission_choice`) and commits via
+        # scatter-add — never the (P, N) onehot/winners matrices the
+        # generic waterfill builds (at north-star scale those are
+        # ~84M-element temporaries per wave)
+        active = (assignment == -1) & pod_mask & ~hopeless
+        choice, hopeless_delta = choice_fn(free, active)
+        admitted = (choice >= 0) & _queue_order_admission_choice(
+            choice, demand, free
+        )
+        new_assignment = jnp.where(admitted, choice, assignment)
+        used = jnp.zeros_like(free).at[jnp.where(admitted, choice, N - 1)].add(
+            jnp.where(admitted[:, None], demand, 0)
+        )
+        return (
+            free - used,
+            new_assignment,
+            hopeless | hopeless_delta,
+            admitted.sum() + hopeless_delta.sum(),
+        )
+
+    # two phases, EACH with its own max_waves budget (up to 2*max_waves
+    # waves total): lite waves to quiescence, then full waves to
+    # quiescence (full resolves any straggler the bucket heuristic
+    # starves; the dense window is only paid on those late waves)
+    def run(free, assignment, hopeless, choice_fn):
+        def cond(ls):
+            free, assignment, hopeless, wave_idx, progressed = ls
+            return (
+                (wave_idx < max_waves)
+                & progressed
+                & ((assignment == -1) & pod_mask & ~hopeless).any()
+            )
+
+        def body(ls):
+            free, assignment, hopeless, wave_idx, _ = ls
+            free, assignment, hopeless, n = wave(
+                free, assignment, hopeless, choice_fn
+            )
+            return free, assignment, hopeless, wave_idx + 1, n > 0
+
+        return jax.lax.while_loop(
+            cond, body,
+            (free, assignment, hopeless, jnp.int32(0), jnp.bool_(True)),
+        )
+
+    assignment0 = jnp.full(P, -1, jnp.int32)
+    hopeless0 = jnp.zeros(P, bool)
+    free, assignment, hopeless, _, _ = run(
+        free0, assignment0, hopeless0, lite_choice
+    )
+    free, assignment, _, _, _ = run(free, assignment, hopeless, full_choice)
+    return assignment, free
 
 
 @partial(jax.jit, static_argnames=("batch_fn", "max_waves"))

@@ -29,10 +29,9 @@ from scheduler_plugins_tpu.ops.allocatable import (
     allocatable_scores,
     demote_scores_int32,
 )
-from scheduler_plugins_tpu.ops.assign import waterfill_assign
+from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
 from scheduler_plugins_tpu.ops.fit import fits, free_capacity, pod_fit_demand
 from scheduler_plugins_tpu.ops.gang import gang_admit
-from scheduler_plugins_tpu.ops.normalize import minmax_normalize
 from scheduler_plugins_tpu.ops.quota import quota_admit
 
 
@@ -103,12 +102,18 @@ def batch_admission(snap, free, eq_used=None):
     return ok
 
 
-def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
+def _namespace_quota_prefix_ok_scan(assignment_order_ok, snap, eq_used):
     """(P,) queue-order quota admission, exact: a `lax.scan` threads admitted
     usage through the batch in queue order, so a pod is charged against Max
     (own namespace) and the aggregate-Min pool only if it was itself admitted
     — identical semantics to `quota_commit` threading through the sequential
-    scan (no over-approximation from rejected pods' requests)."""
+    scan (no over-approximation from rejected pods' requests).
+
+    Reference implementation: O(P) serial steps, which on TPU costs the
+    per-step scan latency P times over. The production path is the
+    reject-first-violator fixpoint below (`_namespace_quota_prefix_ok`),
+    which is bit-identical (tests/test_parallel.py gates it) with serial
+    depth = the number of actually-rejected pods instead of P."""
     quota = snap.quota
     agg_min = jnp.sum(jnp.where(quota.has_quota[:, None], quota.min, 0), axis=0)
     agg_used0 = jnp.sum(jnp.where(quota.has_quota[:, None], eq_used, 0), axis=0)
@@ -131,27 +136,108 @@ def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
     return ok
 
 
+def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
+    """(P,) queue-order quota admission as a reject-first-violator fixpoint —
+    the parallel reformulation of `_namespace_quota_prefix_ok_scan` with
+    identical outputs on every pod.
+
+    Why it is exact: evaluate every pod's Max/aggregate-Min checks against
+    prefix sums over the currently-assumed-admitted set. Pods before the
+    queue-FIRST violator see only truly-admitted pods in their prefixes (a
+    kept pod passing with an over-approximated prefix also passes with the
+    true, smaller one), so the first violator's own prefix is exact and its
+    rejection is final. Removing it only shrinks later prefixes, so
+    violators surface in increasing queue order and each `lax.while_loop`
+    trip resolves one true rejection with O(log P)-depth parallel work
+    (1-D float64 cumsums — exact below 2^53, the repo-wide quantity bound —
+    plus a sorted-segment rebase; no O(P) serial chain). Trip count is the
+    number of quota-rejected pods in the batch (typically ~0), worst case
+    the candidate count.
+
+    Mirrors /root/reference/pkg/capacityscheduling/capacity_scheduling.go
+    PreFilter semantics (208-282) applied in queue order at Reserve time."""
+    from scheduler_plugins_tpu.ops.assign import _segment_prefix
+
+    quota = snap.quota
+    ns = snap.pods.ns
+    P = ns.shape[0]
+    has_q = quota.has_quota[ns]
+    cand = assignment_order_ok & has_q
+    reqf = snap.pods.req.astype(jnp.float64)
+    used0_ns = eq_used[ns].astype(jnp.float64)
+    max_ns = quota.max[ns].astype(jnp.float64)
+    agg_min = jnp.sum(
+        jnp.where(quota.has_quota[:, None], quota.min, 0), axis=0
+    ).astype(jnp.float64)
+    agg_used0 = jnp.sum(
+        jnp.where(quota.has_quota[:, None], eq_used, 0), axis=0
+    ).astype(jnp.float64)
+
+    # static queue-stable namespace grouping: sort by (ns, queue index) so
+    # per-namespace prefixes are 1-D segment cumsums (CLAUDE.md: no 2-D int64
+    # cumsums on TPU; float64 is exact here)
+    order = jnp.argsort(ns.astype(jnp.int64) * P + jnp.arange(P))
+    ns_sorted = ns[order]
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), ns_sorted[1:] != ns_sorted[:-1]]
+    )
+    idx = jnp.arange(P)
+
+    def verdicts(admitted):
+        """(own_ok & agg_ok) per pod from EXCLUSIVE prefixes over `admitted`
+        — the scan's view at each pod's own step."""
+        charge = jnp.where(admitted[:, None], reqf, 0.0)
+        incl_own_sorted = _segment_prefix(charge[order], first)
+        excl_own = jnp.zeros_like(charge).at[order].set(
+            incl_own_sorted - charge[order]
+        )
+        excl_agg = jnp.cumsum(charge, axis=0) - charge
+        own_ok = jnp.all(used0_ns + excl_own + reqf <= max_ns, axis=1)
+        agg_ok = jnp.all(agg_used0 + excl_agg + reqf <= agg_min, axis=1)
+        return own_ok & agg_ok
+
+    def first_violator(admitted):
+        viol = admitted & ~verdicts(admitted)
+        return jnp.min(jnp.where(viol, idx, P))
+
+    def cond(carry):
+        _, v = carry
+        return v < P
+
+    def body(carry):
+        admitted, v = carry
+        admitted = admitted & (idx != v)
+        return admitted, first_violator(admitted)
+
+    admitted0 = cand
+    admitted, _ = jax.lax.while_loop(
+        cond, body, (admitted0, first_violator(admitted0))
+    )
+    return ~has_q | verdicts(admitted)
+
+
 def batch_solve(snap, weights, max_waves: int = 8):
     """Full batched step: admission -> fit -> allocatable score -> wave
     assignment -> quota prefix enforcement -> gang quorum.
-    Returns (assignment (P,), admitted (P,), wait (P,))."""
+    Returns (assignment (P,), admitted (P,), wait (P,)).
+
+    Allocatable scores are STATIC per node (the reference scores
+    allocatable, not free capacity — resource_allocation.go:49-76), so the
+    targeted waterfill applies: per-wave work is O(P·R) target-row gathers,
+    not the (P, N) feasibility/score matrix (which at north-star scale is
+    ~4B compares per wave). Unschedulable nodes are excluded by zeroing
+    their free capacity for the solve (a masked node can then never admit
+    any pod — pod demands include a pods-slot of 1)."""
     free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     admitted = batch_admission(snap, free0)
 
-    def batch_fn(free, active):
-        feasible = fits(
-            snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
-        )
-        raw = demote_scores_int32(
-            allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
-        )
-        scores = minmax_normalize(
-            jnp.broadcast_to(raw[None, :], feasible.shape), feasible
-        )
-        return feasible, scores
-
-    assignment, free = waterfill_assign(
-        batch_fn, snap.pods.req, admitted, free0, max_waves=max_waves
+    raw = demote_scores_int32(
+        allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+    )
+    solve_free0 = jnp.where(snap.nodes.mask[:, None], free0, 0)
+    assignment, _ = waterfill_assign_targeted(
+        raw.astype(jnp.int64), snap.pods.req, admitted, solve_free0,
+        max_waves=max_waves,
     )
 
     assignment, wait = finalize_assignment(assignment, snap)
@@ -206,6 +292,67 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     state0 = scheduler.initial_state(snap)
     auxes = tuple(p.aux() for p in plugins)
 
+    # ---- targeted fast path ------------------------------------------
+    # When the profile has NO per-(pod, node) filters and its single
+    # scoring plugin rates nodes pod-invariantly (static_node_scores),
+    # the whole (P, N) pipeline collapses: admission is a (P,) vmap, and
+    # placement is the targeted waterfill (O(P·R) waves against the one
+    # static node ranking). Gang quorum and the queue-order quota prefix
+    # still run exactly in finalize_assignment. This is the shape of the
+    # coscheduling/capacity profiles, where the reference spends its time
+    # in PreFilter bookkeeping, not Filter fan-out
+    # (capacity_scheduling.go:208-282). Ranking uses the plugin's RAW
+    # static scores — sound because the gate requires a SINGLE scoring
+    # plugin and static_node_scores' contract requires its normalize to
+    # be monotone with positive weight (framework/plugin.py).
+    scoring = tuple(
+        p for p in plugins if type(p).score is not _PluginBase.score
+    )
+    filtering = tuple(
+        p for p in plugins if type(p).filter is not _PluginBase.filter
+    )
+    fast = (
+        not dyn_plugins
+        and not filtering
+        and len(scoring) == 1
+        and type(scoring[0]).static_node_scores
+        is not _PluginBase.static_node_scores
+    )
+    if fast:
+
+        def fast_batch(snap, state0, auxes):
+            for plugin, aux in zip(plugins, auxes):
+                plugin.bind_aux(aux)
+            for plugin in plugins:
+                plugin.bind_presolve(plugin.prepare_solve(snap))
+
+            def admit_one(p):
+                ok = snap.pods.mask[p] & ~snap.pods.gated[p]
+                for plugin in plugins:
+                    verdict = plugin.admit(state0, snap, p)
+                    if verdict is not None:
+                        ok &= verdict
+                return ok
+
+            admitted = jax.vmap(admit_one)(jnp.arange(snap.num_pods))
+            raw = scoring[0].static_node_scores(snap).astype(jnp.int64)
+            assignment, _ = waterfill_assign_targeted(
+                raw, snap.pods.req, admitted,
+                jnp.where(snap.nodes.mask[:, None], state0.free, 0),
+                max_waves=max_waves,
+            )
+            assignment, wait = finalize_assignment(assignment, snap)
+            return assignment, admitted, wait
+
+        key = ("profile_batch_fast", max_waves) + tuple(
+            p.static_key() for p in plugins
+        )
+        cache = scheduler._solve_cache
+        if key not in cache:
+            cache[key] = jax.jit(fast_batch)
+        return cache[key](snap, state0, auxes)
+    # ------------------------------------------------------------------
+
     def batch(snap, state0, auxes):
         for plugin, aux in zip(plugins, auxes):
             plugin.bind_aux(aux)
@@ -244,9 +391,11 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 raw = plugin.score(state0, snap, p)
                 if raw is not None:
                     total = total + plugin.weight * plugin.normalize(raw, feasible)
-            return ok, static_feasible, total
+            return ok, static_feasible, feasible, total
 
-        admitted, static_feasible, scores0 = jax.vmap(per_pod)(jnp.arange(P))
+        admitted, static_feasible, feasible0, scores0 = jax.vmap(per_pod)(
+            jnp.arange(P)
+        )
 
         def batch_fn(free, state, active):
             feasible = fits(
@@ -334,6 +483,9 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             validate_fn=validate_fn,
             validate_commit_fn=validate_commit_fn,
             capacity_fns=capacity_fns,
+            # wave 0 reuses the cycle-initial filter pass per_pod already
+            # paid for (state is unchanged until the first commit)
+            initial_batch=(feasible0, scores0),
         )
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait

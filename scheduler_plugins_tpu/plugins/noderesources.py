@@ -58,5 +58,10 @@ class NodeResourcesAllocatable(Plugin):
     def score(self, state, snap, p):
         return allocatable_scores(snap.nodes.alloc, self._aux, self.mode_sign)
 
+    def static_node_scores(self, snap):
+        # allocatable scores rate the NODE, never the pod
+        # (resource_allocation.go:49-76) — the batched fast path applies
+        return allocatable_scores(snap.nodes.alloc, self._aux, self.mode_sign)
+
     def normalize(self, scores, feasible):
         return minmax_normalize(scores, feasible)
