@@ -581,44 +581,129 @@ class ClusterAgent:
     # -- live mode -----------------------------------------------------
     def list_then_watch(self, apiserver: str, path: str, token: str = "",
                         insecure_skip_verify: bool = False,
-                        max_events: Optional[int] = None) -> int:
-        """One LIST (emitted as ADDED events) then a streaming WATCH from
-        the list's resourceVersion — the informer bootstrap sequence
-        (client-go ListerWatcher). Plain HTTP; returns events sent (watch
-        runs until the stream closes or max_events)."""
+                        max_events: Optional[int] = None,
+                        max_failures: Optional[int] = 8,
+                        backoff_base_s: float = 0.25,
+                        backoff_cap_s: float = 30.0,
+                        timeout_s: float = 300.0,
+                        _sleep=None) -> int:
+        """client-go Reflector semantics over plain streaming HTTP
+        (ListAndWatch, the machinery behind
+        /root/reference/pkg/util/client_util.go:14-32):
+
+        - LIST (items emitted as ADDED events; the server's rv-fence
+          dedupes re-lists), then WATCH with ``allowWatchBookmarks=true``
+          from the list's resourceVersion.
+        - The resume point advances on EVERY event's object
+          resourceVersion, including BOOKMARKs (whose whole purpose is
+          advancing rv without payload traffic) — but only AFTER the event
+          was delivered downstream, so a send-side failure redelivers the
+          event on reconnect instead of silently dropping it.
+        - ``410 Gone`` — as an HTTP status or an ERROR watch event with
+          ``code: 410`` — means the rv is too old: relist. Relists count
+          toward the failure budget/backoff so a persistent 410 storm
+          (watch-cache compaction loops) cannot hammer the apiserver with
+          back-to-back full LISTs.
+        - An idle-stream read timeout (``timeout_s`` with no traffic) is
+          NOT a failure: a healthy-but-quiet watch re-connects from the
+          same rv without consuming the budget.
+        - Any other stream failure or clean close reconnects the WATCH
+          from the last delivered rv with exponential backoff
+          (``backoff_base_s * 2^k`` capped at ``backoff_cap_s``); the
+          failure counter resets whenever an event arrives.
+
+        Stops after ``max_events`` sends or ``max_failures`` consecutive
+        failures (None = retry forever). Returns events sent."""
         import ssl
+        import time as _time
+        import urllib.error
         import urllib.request
+
+        sleep = _sleep if _sleep is not None else _time.sleep
 
         def request(url):
             req = urllib.request.Request(url)
             if token:
                 req.add_header("Authorization", f"Bearer {token}")
             ctx = None
-            if insecure_skip_verify and url.startswith("https"):
-                ctx = ssl._create_unverified_context()
-            return urllib.request.urlopen(req, timeout=300, context=ctx)
+            if url.startswith("https"):
+                ctx = ssl.create_default_context()
+                if insecure_skip_verify:
+                    # public-API equivalent of the old private
+                    # _create_unverified_context
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
+            return urllib.request.urlopen(req, timeout=timeout_s, context=ctx)
 
         base = apiserver.rstrip("/") + path
-        with request(base) as resp:
-            listing = json.loads(resp.read())
-        sent = self.replay(
-            {"type": "ADDED", "object": {**item,
-                                         "kind": _list_item_kind(listing)}}
-            for item in listing.get("items", [])
-        )
-        rv = (listing.get("metadata") or {}).get("resourceVersion", "")
-        watch_url = f"{base}?watch=1"
-        if rv:
-            watch_url += f"&resourceVersion={rv}"
-        with request(watch_url) as stream:
-            for raw in stream:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line:
-                    continue
-                sent += self.replay([json.loads(line)])
-                if max_events is not None and sent >= max_events:
-                    break
-        return sent
+        sent = 0
+        rv: Optional[str] = None  # None -> (re)list before watching
+        failures = 0
+
+        while True:
+            try:
+                if rv is None:
+                    with request(base) as resp:
+                        listing = json.loads(resp.read())
+                    sent += self.replay(
+                        {"type": "ADDED",
+                         "object": {**item,
+                                    "kind": _list_item_kind(listing)}}
+                        for item in listing.get("items", [])
+                    )
+                    rv = str(
+                        (listing.get("metadata") or {})
+                        .get("resourceVersion", "")
+                    )
+                    failures = 0
+                    if max_events is not None and sent >= max_events:
+                        return sent
+                watch_url = f"{base}?watch=1&allowWatchBookmarks=true"
+                if rv:
+                    watch_url += f"&resourceVersion={rv}"
+                with request(watch_url) as stream:
+                    for raw in stream:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line:
+                            continue
+                        watch_event = json.loads(line)
+                        etype = watch_event.get("type")
+                        obj = watch_event.get("object") or {}
+                        if etype == "ERROR":
+                            if (obj.get("code") == 410
+                                    or obj.get("reason") == "Expired"):
+                                rv = None  # too old: relist
+                            break
+                        # deliver FIRST (replay skips BOOKMARK/unknown
+                        # kinds itself), advance the resume point after:
+                        # a send that raises must redeliver this event
+                        sent += self.replay([watch_event])
+                        new_rv = (obj.get("metadata") or {}).get(
+                            "resourceVersion"
+                        )
+                        if new_rv:
+                            rv = str(new_rv)
+                        failures = 0
+                        if max_events is not None and sent >= max_events:
+                            return sent
+            except TimeoutError:
+                # idle healthy stream: re-watch from rv, no budget burn
+                continue
+            except urllib.error.HTTPError as exc:
+                if exc.code == 410:
+                    rv = None  # relist (counted below like any failure)
+                failures += 1
+            except (urllib.error.URLError, OSError, ValueError):
+                # connection refused/reset, mid-line JSON truncation, ...
+                failures += 1
+            else:
+                # clean close or ERROR break: reconnect (relist when the
+                # ERROR was a 410); both count toward the backoff budget
+                failures += 1
+            if max_failures is not None and failures >= max_failures:
+                return sent
+            sleep(min(backoff_base_s * (2 ** (failures - 1)),
+                      backoff_cap_s))
 
 
 def _list_item_kind(listing: dict) -> str:

@@ -1,0 +1,131 @@
+"""A scriptable fake kube-apiserver on stdlib http.server.
+
+The integration analog of the reference's envtest control plane
+(/root/reference/test/integration/main_test.go:31-49): serves LIST JSON at
+resource paths and scripted streaming WATCH sessions (newline-JSON watch
+events, BOOKMARKs, ERROR/410 Status objects, truncated lines, clean
+closes), enforcing bearer auth — enough surface to drive
+``ClusterAgent.list_then_watch`` through bootstrap, resume and relist.
+
+Watch scripting: ``server.watch_scripts[path]`` is a queue of SESSIONS,
+one per accepted watch connection. A session is a list of actions:
+
+    ("event", {...})     write one watch event line
+    ("partial", "text")  write a truncated (non-JSON) fragment, then close
+    ("end",)             close the stream cleanly
+    ("reject", code)     answer the watch request with an HTTP error
+                         status instead of a stream (must be the session's
+                         first and only action)
+
+Every watch request's query string is appended to
+``server.watch_requests[path]`` so tests can assert the resume
+resourceVersion and ``allowWatchBookmarks`` made it to the wire. When the
+session queue is empty the watch closes immediately (the agent's failure
+budget then ends the loop).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.0"  # stream-until-close watch framing
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        server: FakeApiServer = self.server  # type: ignore[assignment]
+        parsed = urlparse(self.path)
+        path, query = parsed.path, parse_qs(parsed.query)
+        if server.expected_token:
+            auth = self.headers.get("Authorization", "")
+            if auth != f"Bearer {server.expected_token}":
+                self.send_response(401)
+                self.end_headers()
+                return
+        with server.lock:
+            server.requests.append(self.path)
+        if query.get("watch", ["0"])[0] in ("1", "true"):
+            self._serve_watch(server, path, parsed.query)
+        else:
+            self._serve_list(server, path)
+
+    def _serve_list(self, server, path):
+        listing = server.lists.get(path)
+        if listing is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = json.dumps(listing).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_watch(self, server, path, query):
+        with server.lock:
+            server.watch_requests.setdefault(path, []).append(query)
+            sessions = server.watch_scripts.get(path, [])
+            session = sessions.pop(0) if sessions else [("end",)]
+        if session and session[0][0] == "reject":
+            self.send_response(session[0][1])
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        for action in session:
+            kind = action[0]
+            if kind == "event":
+                self.wfile.write(
+                    (json.dumps(action[1]) + "\n").encode()
+                )
+                self.wfile.flush()
+            elif kind == "partial":
+                self.wfile.write(action[1].encode())
+                self.wfile.flush()
+                return  # close mid-line: client sees a truncated record
+            elif kind == "end":
+                return
+
+
+class FakeApiServer:
+    """`with FakeApiServer() as srv:` — srv.url is http://127.0.0.1:PORT."""
+
+    def __init__(self, expected_token: str = ""):
+        self.lists: dict[str, dict] = {}
+        self.watch_scripts: dict[str, list] = {}
+        self.watch_requests: dict[str, list] = {}
+        self.requests: list[str] = []
+        self.expected_token = expected_token
+        self.lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        httpd.lists = self.lists  # type: ignore[attr-defined]
+        httpd.watch_scripts = self.watch_scripts  # type: ignore[attr-defined]
+        httpd.watch_requests = self.watch_requests  # type: ignore[attr-defined]
+        httpd.requests = self.requests  # type: ignore[attr-defined]
+        httpd.expected_token = self.expected_token  # type: ignore[attr-defined]
+        httpd.lock = self.lock  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        return False
