@@ -120,7 +120,7 @@ def _backend_label():
         return "unknown"
 
 
-def _emit(metric, pods_per_sec, detail, baseline, compiled=None):
+def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None):
     """One JSON line. `vs_baseline` is the honest headline: measured against
     the COMPILED reference-shaped loop (`bridge/ref_baseline.cc`) when it is
     available — the reference is compiled Go, so a pure-Python denominator
@@ -140,6 +140,8 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None):
     else:
         line["vs_baseline"] = round(pods_per_sec / baseline, 2)
         line["vs_python_baseline"] = round(pods_per_sec / baseline, 2)
+    if extra:
+        line.update(extra)
     print(json.dumps(line))
 
 
@@ -421,10 +423,30 @@ def sequential_config(config: int, mode: str = "sequential"):
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment >= 0).sum())
     baseline = python_baseline_pods_per_sec(cluster, sample=100)
+    extra = None
+    if mode == "batch":
+        # placement-quality cost of the throughput path, surfaced per run
+        # (VERDICT r3 item 8): relative score-sum drift on the shared
+        # cycle-initial objective vs the bit-faithful sequential solve
+        # (untimed — quality metric, not part of the throughput number;
+        # same definition the drift-bound test asserts on)
+        from scheduler_plugins_tpu.parallel.solver import (
+            score_drift_vs_sequential,
+        )
+
+        seq = np.asarray(scheduler.solve(snap).assignment)
+        drift, placed_seq, _ = score_drift_vs_sequential(
+            scheduler, snap, seq, assignment
+        )
+        extra = {
+            "score_drift_vs_sequential": round(drift, 4),
+            "placed_sequential": placed_seq,
+        }
     _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
           baseline, compiled=_compiled_baseline(config, snap, meta,
                                                 weights=weights,
-                                                plugins=plugins))
+                                                plugins=plugins),
+          extra=extra)
 
 
 if __name__ == "__main__":

@@ -40,6 +40,22 @@ def _pod_stats(pods) -> tuple[int, int, int]:
     return running, succeeded, failed
 
 
+def _transition_event(pg: PodGroup, old_phase) -> list[str]:
+    """Recorder boundary: one event per phase transition — the
+    observability the reference gets from its status patches + manager
+    logs (podgroup_controller.go:104-139 phase switch; the recorder itself
+    upstream only carries the Timeout warning, line 87). Failure
+    transitions record as Warning like the Timeout event, so event-type
+    filters see gang failures."""
+    if pg.phase == old_phase:
+        return []
+    etype = "Warning" if pg.phase == PodGroupPhase.FAILED else "Normal"
+    return [
+        f"{etype} {str(pg.phase)} {pg.full_name}: "
+        f"phase transitioned from {str(old_phase) or 'unset'} to {str(pg.phase)}"
+    ]
+
+
 def _reconcile_one(cluster: Cluster, pg: PodGroup, now_ms: int) -> list[str]:
     if pg.phase in (PodGroupPhase.FINISHED, PodGroupPhase.FAILED):
         return []
@@ -50,6 +66,7 @@ def _reconcile_one(cluster: Cluster, pg: PodGroup, now_ms: int) -> list[str]:
     ):
         return [f"Warning Timeout {pg.full_name}: schedule time longer than 48 hours"]
 
+    old_phase = pg.phase
     pods = cluster.gang_members(pg)
     if pg.phase == PodGroupPhase.PENDING or pg.phase == "":
         pg.phase = PodGroupPhase.PENDING
@@ -58,12 +75,12 @@ def _reconcile_one(cluster: Cluster, pg: PodGroup, now_ms: int) -> list[str]:
             pg.schedule_start_ms = now_ms
             if pods:
                 pg.occupied_by = pods[0].uid
-        return []
+        return _transition_event(pg, old_phase)
 
     pg.running, pg.succeeded, pg.failed = _pod_stats(pods)
     if len(pods) < pg.min_member:
         pg.phase = PodGroupPhase.PENDING
-        return []
+        return _transition_event(pg, old_phase)
     if pg.succeeded + pg.running < pg.min_member:
         pg.phase = PodGroupPhase.SCHEDULING
     if pg.succeeded + pg.running >= pg.min_member:
@@ -72,4 +89,4 @@ def _reconcile_one(cluster: Cluster, pg: PodGroup, now_ms: int) -> list[str]:
         pg.phase = PodGroupPhase.FAILED
     if pg.succeeded >= pg.min_member:
         pg.phase = PodGroupPhase.FINISHED
-    return []
+    return _transition_event(pg, old_phase)

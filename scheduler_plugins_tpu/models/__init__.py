@@ -4,6 +4,7 @@ benchmark configurations and for tests."""
 from scheduler_plugins_tpu.models.scenarios import (  # noqa: F401
     allocatable_scenario,
     gang_quota_scenario,
+    metric_affinity_scenario,
     mixed_scenario,
     network_scenario,
     numa_scenario,

@@ -548,6 +548,29 @@ def profile_initial_scores(scheduler, snap):
     return cache[key](snap, state0, auxes)
 
 
+def score_drift_vs_sequential(scheduler, snap, seq_assignment,
+                              bat_assignment):
+    """Relative score-sum drift of the batched placements vs the sequential
+    parity path on the shared cycle-initial objective
+    (`profile_initial_scores`) — the single definition both the bench
+    metric and the drift-bound test report, so they always measure the
+    same quantity. Padded/unplaced slots carry assignment -1 and are
+    excluded. Returns (drift, placed_seq, placed_bat)."""
+    import numpy as np
+
+    scores = np.asarray(profile_initial_scores(scheduler, snap)[0])
+    seq = np.asarray(seq_assignment)
+    bat = np.asarray(bat_assignment)
+
+    def score_sum(a):
+        placed = a >= 0
+        return int(scores[np.nonzero(placed)[0], a[placed]].sum())
+
+    s_seq, s_bat = score_sum(seq), score_sum(bat)
+    drift = (s_bat - s_seq) / max(abs(s_seq), 1)
+    return drift, int((seq >= 0).sum()), int((bat >= 0).sum())
+
+
 def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
     """Jit `batch_solve` with the snapshot sharded over `mesh`; XLA inserts
     the cross-shard collectives."""

@@ -76,6 +76,48 @@ class TestPodGroupController:
         reconcile_pod_groups(c)
         assert pg.phase == PodGroupPhase.PENDING
 
+    def test_phase_transition_events(self):
+        """VERDICT r3 item 7: each phase transition emits a recorder event
+        (the reference's observability boundary, podgroup_controller.go's
+        status patch + recorder)."""
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0"))
+        # below MinMember: stays Pending (the default phase), no event
+        assert reconcile_pod_groups(c, now_ms=1) == []
+        c.add_pod(member("m1"))
+        assert reconcile_pod_groups(c, now_ms=2) == [
+            "Normal Scheduling default/g: "
+            "phase transitioned from Pending to Scheduling"
+        ]
+        for uid in ("default/m0", "default/m1"):
+            c.pods[uid].phase = PodPhase.RUNNING
+        assert reconcile_pod_groups(c, now_ms=3) == [
+            "Normal Running default/g: "
+            "phase transitioned from Scheduling to Running"
+        ]
+        # steady state: no event without a transition
+        assert reconcile_pod_groups(c, now_ms=4) == []
+        for uid in ("default/m0", "default/m1"):
+            c.pods[uid].phase = PodPhase.SUCCEEDED
+        assert reconcile_pod_groups(c, now_ms=5) == [
+            "Normal Finished default/g: "
+            "phase transitioned from Running to Finished"
+        ]
+
+    def test_failure_transition_event(self):
+        c = Cluster()
+        pg = PodGroup(name="g", min_member=2, phase=PodGroupPhase.SCHEDULING)
+        c.add_pod_group(pg)
+        c.add_pod(member("m0", PodPhase.FAILED))
+        c.add_pod(member("m1", PodPhase.RUNNING))
+        events = reconcile_pod_groups(c)
+        assert events == [
+            "Warning Failed default/g: "
+            "phase transitioned from Scheduling to Failed"
+        ]
+
     def test_stale_schedule_timeout_event(self):
         c = Cluster()
         pg = PodGroup(

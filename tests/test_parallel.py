@@ -400,7 +400,9 @@ class TestBatchedSequentialDrift:
     pods as the sequential parity path and score within 10% of it on the
     shared cycle-initial objective."""
 
-    #: relative score-sum drift floor (batched may be at most 10% worse)
+    #: two-sided relative score-sum drift bound: |drift| must stay within
+    #: 10% in BOTH directions (worse means lost quality; a large positive
+    #: drift would mean the modes optimize visibly different surfaces)
     MAX_RELATIVE_SCORE_DRIFT = 0.10
 
     def _drift(self, cluster, plugins):
@@ -409,31 +411,29 @@ class TestBatchedSequentialDrift:
         from scheduler_plugins_tpu.framework import Profile, Scheduler
         from scheduler_plugins_tpu.parallel.solver import (
             profile_batch_solve,
-            profile_initial_scores,
+            score_drift_vs_sequential,
         )
 
         sched = Scheduler(Profile(plugins=plugins))
         pending = sched.sort_pending(cluster.pending_pods(), cluster)
         snap, meta = cluster.snapshot(pending, now_ms=0)
         sched.prepare(meta, cluster)
-        P = len(pending)
-        seq = np.asarray(sched.solve(snap).assignment)[:P]
-        bat = np.asarray(profile_batch_solve(sched, snap)[0])[:P]
-        scores, _ = profile_initial_scores(sched, snap)
-        scores = np.asarray(scores)[:P]
-
-        def score_sum(a):
-            placed = a >= 0
-            return int(scores[np.arange(P)[placed], a[placed]].sum())
-
-        s_seq, s_bat = score_sum(seq), score_sum(bat)
-        rel = (s_bat - s_seq) / max(abs(s_seq), 1)
-        return int((seq >= 0).sum()), int((bat >= 0).sum()), rel
+        seq = np.asarray(sched.solve(snap).assignment)
+        bat = np.asarray(profile_batch_solve(sched, snap)[0])
+        # the shared definition bench.py emits per batch run
+        rel, placed_seq, placed_bat = score_drift_vs_sequential(
+            sched, snap, seq, bat
+        )
+        return placed_seq, placed_bat, rel
 
     def _assert_bounded(self, cluster, plugins):
         placed_seq, placed_bat, rel = self._drift(cluster, plugins)
         assert placed_bat >= placed_seq, (placed_seq, placed_bat)
-        assert rel >= -self.MAX_RELATIVE_SCORE_DRIFT, rel
+        # two-sided (VERDICT r3 item 8): the batched path may be at most
+        # 10% worse AND at most 10% "better" on the shared cycle-initial
+        # objective — a large positive drift would mean the two modes are
+        # optimizing visibly different surfaces, not trading ties
+        assert abs(rel) <= self.MAX_RELATIVE_SCORE_DRIFT, rel
 
     def test_config1_allocatable(self):
         from scheduler_plugins_tpu.models import allocatable_scenario
@@ -527,6 +527,47 @@ class TestShardedProfileSolve:
         assert np.asarray(a1).tolist() == np.asarray(a8).tolist()
         assert np.asarray(adm1).tolist() == np.asarray(adm8).tolist()
         assert np.asarray(w1).tolist() == np.asarray(w8).tolist()
+
+    def _metric_affinity_problem(self):
+        """The plugin families the round-3 sharded proof missed (VERDICT r3
+        item 6): trimaran metric-driven scores (TargetLoadPacking + LVRB),
+        InterPodAffinity's symmetric (E, domain) carry, and SySched's
+        syscall-set scores — one profile under the mesh
+        (models.metric_affinity_scenario, shared with dryrun_multichip)."""
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.models import metric_affinity_scenario
+        from scheduler_plugins_tpu.plugins import (
+            InterPodAffinity,
+            LoadVariationRiskBalancing,
+            SySched,
+            TargetLoadPacking,
+        )
+
+        c = metric_affinity_scenario(n_nodes=16, n_pods=32)
+        sched = Scheduler(Profile(plugins=[
+            TargetLoadPacking(), LoadVariationRiskBalancing(),
+            InterPodAffinity(), SySched()]))
+        for p in sched.profile.plugins:
+            p.configure_cluster(c)
+        pending = sched.sort_pending(c.pending_pods(), c)
+        snap, meta = c.snapshot(pending, now_ms=0, pad_nodes=16, pad_pods=32)
+        sched.prepare(meta, c)
+        return sched, snap, len(pending)
+
+    def test_sharded_metric_affinity_sysched_matches_single_device(self):
+        from scheduler_plugins_tpu.parallel import (
+            sharded_profile_batch_solve,
+        )
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+        sched, snap, P = self._metric_affinity_problem()
+        a1, adm1, w1 = profile_batch_solve(sched, snap)
+        a8, adm8, w8 = sharded_profile_batch_solve(sched, snap, make_mesh(8))
+        assert np.asarray(a1).tolist() == np.asarray(a8).tolist()
+        assert np.asarray(adm1).tolist() == np.asarray(adm8).tolist()
+        assert np.asarray(w1).tolist() == np.asarray(w8).tolist()
+        an = np.asarray(a8)[:P]
+        assert (an >= 0).sum() > 0  # the roster actually places
 
     def test_sharded_profile_places_and_respects_capacity(self):
         from scheduler_plugins_tpu.parallel import (
