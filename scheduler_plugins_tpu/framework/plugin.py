@@ -195,6 +195,22 @@ class Plugin:
         """Reserve: fold `choice` (node index or -1) into the carried state."""
         return state
 
+    # --- batched whole-matrix variants (parallel.solver) -----------------
+    def filter_batch(self, state: SolverState, snap: ClusterSnapshot):
+        """(P, N) Filter verdicts for the WHOLE batch against `state`, or
+        None to fall back to vmapping `filter` over pods. Implement when
+        per-pod verdicts collapse onto equivalence classes (e.g. every pod
+        of an AppGroup workload shares one dependency row) so the batched
+        solver does O(K·N) work + a gather instead of O(P·N·...). Must be
+        bit-identical to the vmapped `filter`."""
+        return None
+
+    def score_batch(self, state: SolverState, snap: ClusterSnapshot):
+        """(P, N) raw scores for the whole batch, or None to vmap `score`.
+        Same class-collapse rationale and bit-identity contract as
+        `filter_batch`; `normalize` still runs per pod row."""
+        return None
+
     # --- batched throughput path (parallel.solver) -----------------------
     def commit_batch(self, state: SolverState, snap: ClusterSnapshot,
                      placed, choice):

@@ -432,13 +432,6 @@ class PreemptionEngine:
         demand = encode_demand(index, preemptor)
         node_mask = np.asarray(snap.nodes.mask)[:N]
         fits = np.all(free + removed >= demand[None, :], axis=1) & node_mask
-        # plugin Filter chain (NUMA alignment, network violations, ...)
-        # for the preemptor, like RunFilterPluginsWithNominatedPods
-        if scheduler is not None and preemptor.uid in meta.pod_names:
-            p_idx = meta.pod_names.index(preemptor.uid)
-            fits &= np.asarray(
-                scheduler.filter_verdicts(snap, p_idx)
-            )[:N]
         has_victims = np.zeros(N, bool)
         has_victims[v_node[eligible]] = True
         fits &= has_victims  # nodes without victims are unresolvable
@@ -458,14 +451,43 @@ class PreemptionEngine:
         # index
         rotation, want = self.sample_candidates(fits)
         pdbs = list(getattr(cluster, "pdbs", {}).values())
+        # plugin Filter chain against hypothetical POST-EVICTION states:
+        # upstream removes victims from the NodeInfo before
+        # RunFilterPluginsWithNominatedPods and re-runs the chain as
+        # reprievePod re-adds each one (SelectVictimsOnNode), so
+        # affinity/spread/network filters must not see pods about to be
+        # evicted (and must notice a required-affinity target leaving).
+        # The NRT cache view stays as-is — upstream's TopologyMatch reads
+        # its own cache, which victim removal does not update either (see
+        # Cluster.post_eviction_tables). Computed once outside the loop:
+        has_filters = (
+            scheduler is not None and preemptor.uid in meta.pod_names
+        )
+        p_idx = meta.pod_names.index(preemptor.uid) if has_filters else -1
+        uids_by_node: dict[int, list] = {}
+        for i in np.nonzero(eligible)[0]:
+            uids_by_node.setdefault(int(v_node[i]), []).append(
+                victims_all[i].uid
+            )
         best = None
         produced = 0
         for n in rotation:
             if produced >= want:
                 break
+            victim_uids = uids_by_node.get(int(n), [])
+            filter_ok = None
+            if has_filters:
+                def filter_ok(evicted, _n=int(n)):
+                    return self._filters_pass(
+                        cluster, scheduler, snap, meta, p_idx, evicted, _n
+                    )
+
+                if not filter_ok(frozenset(victim_uids)):
+                    continue
             final, violations = self._reprieve(
                 victims_all, v_node, v_req, v_pri, eligible, int(n),
                 free[int(n)], demand, preemptor, snap, meta, pdbs, nom_aggs,
+                filter_ok=filter_ok,
             )
             if not final:
                 continue
@@ -579,6 +601,21 @@ class PreemptionEngine:
             victims=[v.uid for v in final_victims],
         )
 
+    def _filters_pass(self, cluster, scheduler, snap, meta, p_idx,
+                      evicted_uids, n) -> bool:
+        """Plugin Filter verdict for the preemptor (pending row `p_idx`) on
+        candidate node `n` against the hypothetical state with
+        `evicted_uids` evicted (pod-derived tables only; see
+        Cluster.post_eviction_tables)."""
+        hyp = snap
+        if (
+            evicted_uids
+            and (snap.scheduling is not None or snap.network is not None)
+            and hasattr(cluster, "post_eviction_tables")
+        ):
+            hyp = cluster.post_eviction_tables(snap, meta, evicted_uids)
+        return bool(np.asarray(scheduler.filter_verdicts(hyp, p_idx))[n])
+
     def _quota_gate(self, victims, v_node, v_req, eligible, preemptor, snap,
                     meta, N):
         """(N,) post-removal gates: own used+req <= Max and aggregate
@@ -643,11 +680,16 @@ class PreemptionEngine:
         return violating, non_violating
 
     def _reprieve(self, victims, v_node, v_req, v_pri, eligible, node, free_n,
-                  demand, preemptor, snap, meta, pdbs=(), nom_aggs=None):
+                  demand, preemptor, snap, meta, pdbs=(), nom_aggs=None,
+                  filter_ok=None):
         """Add back victims most-important-first while the preemptor still
         fits and quota gates hold (capacity_scheduling.go:632-670); PDB-
         violating candidates are reprieved FIRST so they get the best chance
         of surviving, and surviving violations are counted for pickOneNode.
+        `filter_ok(evicted_uids) -> bool`, when given, re-runs the plugin
+        Filter chain for each tentative reprieve (upstream's reprievePod
+        runs RunFilterPluginsWithNominatedPods with the pod re-added) — a
+        victim whose return would re-block the preemptor stays evicted.
         Returns (final_victims, num_violating)."""
         idxs = [i for i in np.nonzero(eligible)[0] if v_node[i] == node]
         # MoreImportantPod: higher priority, then earlier start
@@ -684,9 +726,13 @@ class PreemptionEngine:
 
         final = []
         num_violating = 0
+        evicted = {victims[i].uid for i in idxs}
         for i in idxs:
             candidate_free = free_after - v_req[i]
             fits = bool(np.all(candidate_free >= demand))
+            if fits and filter_ok is not None:
+                # re-adding this victim must not re-block the preemptor
+                fits = filter_ok(frozenset(evicted - {victims[i].uid}))
             quota_ok = True
             if use_quota and fits and p_ns >= 0 and has_q[p_ns]:
                 vec = meta.index.encode(victims[i].effective_request())
@@ -703,6 +749,7 @@ class PreemptionEngine:
             if fits and quota_ok:
                 # reprieved: stays on the node
                 free_after = candidate_free
+                evicted.discard(victims[i].uid)
                 if use_quota:
                     ns = ns_codes.get(victims[i].namespace, -1)
                     if ns >= 0 and has_q[ns]:

@@ -362,6 +362,26 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
 
         from scheduler_plugins_tpu.ops.fit import fits_one
 
+        # class-collapsed whole-batch tensors (plugin.filter_batch /
+        # score_batch): computed ONCE against state0, outside the per-pod
+        # vmap; rows are gathered per pod below. A plugin providing them
+        # does O(K·N) class work instead of O(P·N·...) vmapped work.
+        def _batch_filter(plugin, state):
+            if type(plugin).filter_batch is not _PluginBase.filter_batch:
+                return plugin.filter_batch(state, snap)
+            return None
+
+        filter0_rows = {
+            i: m for i, plugin in enumerate(plugins)
+            if (m := _batch_filter(plugin, state0)) is not None
+        }
+        score_rows = {}
+        for i, plugin in enumerate(plugins):
+            if type(plugin).score_batch is not _PluginBase.score_batch:
+                s = plugin.score_batch(state0, snap)
+                if s is not None:
+                    score_rows[i] = s
+
         def per_pod(p):
             ok = snap.pods.mask[p] & ~snap.pods.gated[p]
             for plugin in plugins:
@@ -373,7 +393,12 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             # sequential step uses (cycle-initial free capacity + the
             # cycle-initial view of the state-dependent filters)
             static_feasible = jnp.ones(snap.num_nodes, bool)
-            for plugin in static_plugins:
+            for i, plugin in enumerate(plugins):
+                if plugin not in static_plugins:
+                    continue
+                if i in filter0_rows:
+                    static_feasible &= filter0_rows[i][p]
+                    continue
                 mask = plugin.filter(state0, snap, p)
                 if mask is not None:
                     static_feasible &= mask
@@ -381,14 +406,22 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 fits_one(snap.pods.req[p], state0.free, snap.nodes.mask)
                 & static_feasible
             )
-            for plugin in dyn_plugins:
+            for i, plugin in enumerate(plugins):
+                if plugin not in dyn_plugins:
+                    continue
+                if i in filter0_rows:
+                    feasible &= filter0_rows[i][p]
+                    continue
                 mask = plugin.filter(state0, snap, p)
                 if mask is not None:
                     feasible &= mask
             feasible &= ok
             total = jnp.zeros(snap.num_nodes, jnp.int64)
-            for plugin in plugins:
-                raw = plugin.score(state0, snap, p)
+            for i, plugin in enumerate(plugins):
+                raw = (
+                    score_rows[i][p] if i in score_rows
+                    else plugin.score(state0, snap, p)
+                )
                 if raw is not None:
                     total = total + plugin.weight * plugin.normalize(raw, feasible)
             return ok, static_feasible, feasible, total
@@ -402,6 +435,11 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 snap.pods.req, free, pod_mask=active, node_mask=snap.nodes.mask
             ) & static_feasible
             for plugin in dyn_plugins:
+                # class-collapsed whole-matrix re-filter when offered
+                m = _batch_filter(plugin, state)
+                if m is not None:
+                    feasible &= m
+                    continue
                 def one(p, _pl=plugin):
                     return _pl.filter(state, snap, p)
                 # a filter can opt out (None) on Python-level layout checks;

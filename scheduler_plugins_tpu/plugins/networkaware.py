@@ -127,6 +127,49 @@ class NetworkOverhead(Plugin):
         score_equally = ~snap.network.dep_mask[p].any()
         return jnp.where(score_equally, 0, cost)
 
+    # -- class-collapsed whole-batch variants ---------------------------
+    # Every pod of a workload shares its AppGroup dependency row, so the
+    # (D, N) tallies run once per WORKLOAD class ((W, N) work) and pods
+    # gather their class row — bit-identical to the vmapped per-pod path
+    # (integer tallies over identical inputs), with P/W-fold less work on
+    # the batched solver's hot passes.
+    def _class_tallies(self, state, snap):
+        import jax
+
+        net = snap.network
+        placed = (
+            state.net_placed if state.net_placed is not None
+            else net.placed_node
+        )
+        zone_cost, region_cost = self._aux
+        return jax.vmap(
+            lambda dw, mc, dm: dependency_tallies(
+                dw, mc, dm, placed, snap.nodes.zone, snap.nodes.region,
+                net.zone_region, zone_cost, region_cost,
+            )
+        )(net.cls_dep_workload, net.cls_dep_max_cost, net.cls_dep_mask)
+
+    def filter_batch(self, state, snap):
+        if snap.network is None or self._zone_cost is None:
+            return None
+        net = snap.network
+        sat, vio, _ = self._class_tallies(state, snap)  # (W, N) each
+        cls = jnp.maximum(net.pod_workload, 0)
+        verdict = (vio <= sat)[cls]  # (P, N)
+        # pods without a workload or without dependencies score equally:
+        # filter passes (networkoverhead.go scoreEqually path)
+        score_equally = ~net.dep_mask.any(axis=1) | (net.pod_workload < 0)
+        return jnp.where(score_equally[:, None], True, verdict)
+
+    def score_batch(self, state, snap):
+        if snap.network is None or self._zone_cost is None:
+            return None
+        net = snap.network
+        _, _, cost = self._class_tallies(state, snap)  # (W, N)
+        cls = jnp.maximum(net.pod_workload, 0)
+        score_equally = ~net.dep_mask.any(axis=1) | (net.pod_workload < 0)
+        return jnp.where(score_equally[:, None], 0, cost[cls])
+
     def commit(self, state, snap, p, choice):
         if snap.network is None or state.net_placed is None:
             return state

@@ -479,6 +479,75 @@ class Cluster:
         return merged
 
     # -- snapshot --------------------------------------------------------
+    def _assigned_pods(self, exclude=frozenset()):
+        """Bound pods plus reserved (permit-waiting) pods materialized with
+        their held node — THE definition of 'assigned' for snapshot
+        lowering and the preemption dry-run's hypothetical rebuild (one
+        source so the two views cannot desynchronize)."""
+        import copy
+
+        assigned = [
+            p for p in self.pods.values()
+            if p.node_name is not None and p.uid not in exclude
+        ]
+        for uid, node in self.reserved.items():
+            pod = self.pods.get(uid)
+            if pod is not None and pod.node_name is None and uid not in exclude:
+                held = copy.copy(pod)
+                held.node_name = node
+                assigned.append(held)
+        return assigned
+
+    def post_eviction_tables(self, snap, meta, exclude_uids):
+        """Pod-derived side tables with `exclude_uids` treated as already
+        evicted: the preemption dry run's post-eviction filter view
+        (capacity_scheduling.go SelectVictimsOnNode removes victims from
+        the NodeInfo before RunFilterPluginsWithNominatedPods). Rebuilds
+        the scheduling track bases (affinity/anti-affinity/spread existing-
+        pod counts) and decrements the network placed-workload counts; the
+        NRT cache view is deliberately NOT touched — upstream's
+        TopologyMatch filter reads its own overreserve cache, which victim
+        removal does not update either. Returns a snapshot sharing every
+        other table with `snap`."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from scheduler_plugins_tpu.state import scheduling as _sched
+
+        excl = set(exclude_uids)
+        new_sched = snap.scheduling
+        if snap.scheduling is not None:
+            nodes = [self.nodes[n] for n in meta.node_names if n in self.nodes]
+            pending = [
+                self.pods[uid] for uid in meta.pod_names if uid in self.pods
+            ]
+            assigned = self._assigned_pods(exclude=excl)
+            new_sched = _sched.build_scheduling(
+                nodes, pending, snap.num_nodes, snap.num_pods,
+                assigned=assigned, namespaces=list(self.namespaces.values()),
+            )
+            if new_sched is not None:
+                new_sched = jax.tree.map(jnp.asarray, new_sched)
+        new_network = snap.network
+        if snap.network is not None and getattr(meta, "workloads", None):
+            placed = np.asarray(snap.network.placed_node).copy()
+            node_pos = {name: i for i, name in enumerate(meta.node_names)}
+            wl_pos = {name: i for i, name in enumerate(meta.workloads)}
+            for uid in excl:
+                pod = self.pods.get(uid)
+                if pod is None or pod.node_name not in node_pos:
+                    continue
+                sel = pod.workload_selector()
+                wc = wl_pos.get(f"{pod.namespace}/{sel}") if sel else None
+                if wc is not None:
+                    ni = node_pos[pod.node_name]
+                    placed[wc, ni] = max(placed[wc, ni] - 1, 0)
+            new_network = snap.network.replace(
+                placed_node=jnp.asarray(placed)
+            )
+        return snap.replace(scheduling=new_sched, network=new_network)
+
     def snapshot(self, pending: list[Pod], now_ms: int = 0, **kwargs):
         """Lower current state for the solver. Reserved (permit-waiting) pods
         count as assigned to their reserved node — they hold capacity and
@@ -506,17 +575,7 @@ class Cluster:
         if native_exports is not None:
             assigned = []
         else:
-            assigned = [
-                p for p in self.pods.values() if p.node_name is not None
-            ]
-            for uid, node in self.reserved.items():
-                pod = self.pods.get(uid)
-                if pod is not None and pod.node_name is None:
-                    import copy
-
-                    held = copy.copy(pod)
-                    held.node_name = node
-                    assigned.append(held)
+            assigned = self._assigned_pods()
         backed_off = [
             name
             for name, until in self.gang_backoff_until_ms.items()

@@ -271,6 +271,13 @@ class NetworkState:
     #: visible to later pods
     placed_node: np.ndarray
     zone_region: np.ndarray  # (ZC,) int32 region code of each zone (-1 unknown)
+    #: class-level dependency rows, one per WORKLOAD code: every pod of a
+    #: workload shares its dependency list, so batched filter/score tallies
+    #: run once per class ((W, N) work) and gather by `pod_workload`
+    #: instead of vmapping the (D, N) tallies over every pod
+    cls_dep_workload: np.ndarray = None  # (W, D) int32
+    cls_dep_max_cost: np.ndarray = None  # (W, D) int64
+    cls_dep_mask: np.ndarray = None  # (W, D) bool
 
 
 @dataclass
@@ -895,6 +902,15 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
             continue
         placed_node[wc, node_pos[pod.node_name]] += 1
 
+    cls_dep_workload = np.full((W, D), -1, I32)
+    cls_dep_max_cost = np.zeros((W, D), I64)
+    cls_dep_mask = np.zeros((W, D), bool)
+    for wc, deps in dep_lists.items():
+        for d, (dw, mc) in enumerate(deps):
+            cls_dep_workload[wc, d] = dw
+            cls_dep_max_cost[wc, d] = mc
+            cls_dep_mask[wc, d] = True
+
     return NetworkState(
         dep_workload=dep_workload,
         dep_max_cost=dep_max_cost,
@@ -902,6 +918,9 @@ def _build_network(app_groups, pending_pods, assigned_pods, node_pos, region, zo
         pod_workload=pod_workload,
         placed_node=placed_node,
         zone_region=zone_region,
+        cls_dep_workload=cls_dep_workload,
+        cls_dep_max_cost=cls_dep_max_cost,
+        cls_dep_mask=cls_dep_mask,
     )
 
 

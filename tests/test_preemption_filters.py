@@ -70,3 +70,95 @@ class TestPreemptionFilterChain:
         # has the LOWER priority); NUMA alignment forbids it -> "fat"
         node, victims = report.preempted["default/claimant"]
         assert node == "fat" and victims == ["default/v-fat"]
+
+
+class TestPostEvictionFilterView:
+    """The dry-run filter chain must see the HYPOTHETICAL post-eviction
+    state (SelectVictimsOnNode removes victims before
+    RunFilterPluginsWithNominatedPods): a victim that blocks the preemptor
+    via anti-affinity stops blocking once chosen for eviction, and a victim
+    the preemptor's required affinity depends on disqualifies its node."""
+
+    def _base(self):
+        from scheduler_plugins_tpu.api.objects import (
+            LabelSelector,
+            PodAffinityTerm,
+        )
+
+        cluster = Cluster()
+        cluster.add_node(Node(
+            name="n0", labels={"topology.kubernetes.io/zone": "z-a"},
+            allocatable={CPU: 4000, MEMORY: 32 * gib, PODS: 110}))
+        term = PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+        )
+        return cluster, term
+
+    def test_anti_affinity_victim_unblocks_on_eviction(self):
+        from scheduler_plugins_tpu.plugins import InterPodAffinity
+
+        cluster, term = self._base()
+        # the victim carries app=db and fills the node; the claimant has
+        # required ANTI-affinity against app=db. Current-state filtering
+        # rejects n0 outright; post-eviction filtering must nominate it.
+        victim = gpod("victim", 3500, priority=1, node="n0")
+        victim.labels = {"app": "db"}
+        cluster.add_pod(victim)
+        claimant = gpod("claimant", 3000, priority=10)
+        claimant.pod_anti_affinity_required = [term]
+        cluster.add_pod(claimant)
+        sched = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), InterPodAffinity()],
+            preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+        ))
+        report = run_cycle(sched, cluster, now=1000)
+        node, victims = report.preempted["default/claimant"]
+        assert node == "n0" and victims == ["default/victim"]
+
+    def test_reprieve_keeps_filter_load_bearing_victim_evicted(self):
+        """reprievePod parity: a victim whose return would re-block the
+        preemptor (anti-affinity carrier) must stay evicted even though
+        resources alone would let it survive — upstream re-runs the filter
+        chain per re-added pod (capacity_scheduling.go reprievePod)."""
+        from scheduler_plugins_tpu.plugins import InterPodAffinity
+
+        cluster, term = self._base()
+        # small db-labeled victim A (resources would let it survive) +
+        # large victim B; the claimant fits once B alone is evicted, but
+        # A's return would re-block it via anti-affinity
+        a = gpod("victim-a", 500, priority=1, node="n0")
+        a.labels = {"app": "db"}
+        cluster.add_pod(a)
+        b = gpod("victim-b", 3000, priority=1, node="n0")
+        cluster.add_pod(b)
+        claimant = gpod("claimant", 3000, priority=10)
+        claimant.pod_anti_affinity_required = [term]
+        cluster.add_pod(claimant)
+        sched = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), InterPodAffinity()],
+            preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+        ))
+        report = run_cycle(sched, cluster, now=1000)
+        node, victims = report.preempted["default/claimant"]
+        assert node == "n0"
+        assert set(victims) == {"default/victim-a", "default/victim-b"}
+
+    def test_required_affinity_on_victim_disqualifies_node(self):
+        from scheduler_plugins_tpu.plugins import InterPodAffinity
+
+        cluster, term = self._base()
+        # the ONLY app=db pod is the would-be victim: evicting it would
+        # break the claimant's required affinity, so no nomination
+        victim = gpod("victim", 3500, priority=1, node="n0")
+        victim.labels = {"app": "db"}
+        cluster.add_pod(victim)
+        claimant = gpod("claimant", 3000, priority=10)
+        claimant.pod_affinity_required = [term]
+        cluster.add_pod(claimant)
+        sched = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), InterPodAffinity()],
+            preemption=PreemptionEngine(PreemptionMode.DEFAULT),
+        ))
+        report = run_cycle(sched, cluster, now=1000)
+        assert "default/claimant" not in report.preempted
