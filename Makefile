@@ -19,6 +19,10 @@ bench-all:
 multichip:
 	$(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
+.PHONY: tpu-smoke
+tpu-smoke:
+	$(PY) bench.py --config 0
+
 .PHONY: verify
 verify: test multichip
 

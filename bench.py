@@ -294,12 +294,60 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     )
 
 
+def tpu_smoke(n_nodes=64, n_pods=256):
+    """Tiny-shape on-chip smoke (VERDICT r4 item 1a): one `batch_solve` at
+    64x256 through the tunnel — seconds, not minutes — so even a short
+    healthy window yields a verified on-chip artifact AND confirms the
+    targeted waterfill's argsort/cummax/scatter chains compile on TPU.
+    Same measurement discipline as the flagship (host-transfer timing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+    from scheduler_plugins_tpu.models import allocatable_scenario
+    from scheduler_plugins_tpu.parallel.solver import batch_solve
+
+    cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=n_pods)
+    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+
+    solve = jax.jit(lambda s, w: batch_solve(s, w, max_waves=8))
+    compile_start = time.perf_counter()
+    assignment, _, _ = solve(snap, weights)
+    np.asarray(assignment)
+    compile_s = time.perf_counter() - compile_start
+
+    times = []
+    assignment_np = None
+    for k in range(5):
+        snap_k = snap.replace(
+            pods=snap.pods.replace(req=snap.pods.req.at[0, 0].add(k % 3))
+        )
+        np.asarray(snap_k.pods.req[0, 0])
+        start = time.perf_counter()
+        assignment, _, _ = solve(snap_k, weights)
+        assignment_np = np.asarray(assignment)
+        times.append(time.perf_counter() - start)
+    elapsed = sorted(times)[len(times) // 2]
+    placed = int((assignment_np >= 0).sum())
+    baseline = python_baseline_pods_per_sec(cluster, sample=100)
+    _emit(
+        "tpu_smoke_pods_per_sec",
+        n_pods / elapsed,
+        f"{n_nodes} nodes x {n_pods} pods smoke, {placed} placed",
+        baseline,
+        extra={"compile_seconds": round(compile_s, 1)},
+    )
+
+
 #: one source of truth for the config -> metric-name mapping (the error
 #: path must emit the same names the success paths do)
 CONFIG_METRICS = {
     1: "pods_scheduled_per_sec", 2: "trimaran_pods_per_sec",
     3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
+    0: "tpu_smoke_pods_per_sec",
 }
 
 
@@ -453,10 +501,14 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=1,
                         help="BASELINE.md scenario (1-5; 6 = 10k-node x "
-                             "100k-pod north-star scale); default flagship")
+                             "100k-pod north-star scale; 0 = tiny-shape "
+                             "tpu smoke); default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
                         help="configs 2-5: bit-faithful scan or batched waves")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="dump a jax profiler trace of the timed runs to "
+                             "DIR (op-level data for tuning rounds)")
     args = parser.parse_args()
     apply_platform_override()
     diagnosis = backend_probe()
@@ -487,9 +539,19 @@ if __name__ == "__main__":
             "detail": diagnosis,
         }))
         sys.exit(0)
-    if args.config == 1:
-        main()
-    elif args.config == 6:
-        north_star()
-    else:
-        sequential_config(args.config, args.mode)
+    if args.trace:
+        import jax
+
+        jax.profiler.start_trace(args.trace)
+    try:
+        if args.config == 0:
+            tpu_smoke()
+        elif args.config == 1:
+            main()
+        elif args.config == 6:
+            north_star()
+        else:
+            sequential_config(args.config, args.mode)
+    finally:
+        if args.trace:
+            jax.profiler.stop_trace()

@@ -604,9 +604,13 @@ class ClusterAgent:
           toward the failure budget/backoff so a persistent 410 storm
           (watch-cache compaction loops) cannot hammer the apiserver with
           back-to-back full LISTs.
-        - An idle-stream read timeout (``timeout_s`` with no traffic) is
-          NOT a failure: a healthy-but-quiet watch re-connects from the
-          same rv without consuming the budget.
+        - An idle-stream read timeout (``timeout_s`` with no traffic) on
+          an ESTABLISHED watch stream is NOT a failure: a
+          healthy-but-quiet watch re-connects from the same rv without
+          consuming the budget. A timeout during LIST or while opening
+          the watch connection IS a failure (with backoff): an apiserver
+          that consistently times out must not hold ``max_failures``
+          callers in an unbounded relist loop.
         - Any other stream failure or clean close reconnects the WATCH
           from the last delivered rv with exponential backoff
           (``backoff_base_s * 2^k`` capped at ``backoff_cap_s``); the
@@ -640,8 +644,11 @@ class ClusterAgent:
         rv: Optional[str] = None  # None -> (re)list before watching
         failures = 0
 
+        stream_open = False  # True once the current watch stream is up
+
         while True:
             try:
+                stream_open = False
                 if rv is None:
                     with request(base) as resp:
                         listing = json.loads(resp.read())
@@ -662,6 +669,7 @@ class ClusterAgent:
                 if rv:
                     watch_url += f"&resourceVersion={rv}"
                 with request(watch_url) as stream:
+                    stream_open = True
                     for raw in stream:
                         line = raw.decode("utf-8", "replace").strip()
                         if not line:
@@ -687,8 +695,11 @@ class ClusterAgent:
                         if max_events is not None and sent >= max_events:
                             return sent
             except TimeoutError:
-                # idle healthy stream: re-watch from rv, no budget burn
-                continue
+                if stream_open:
+                    # idle healthy stream: re-watch from rv, no budget burn
+                    continue
+                # LIST/connect timeout: ordinary failure (ADVICE r4)
+                failures += 1
             except urllib.error.HTTPError as exc:
                 if exc.code == 410:
                     rv = None  # relist (counted below like any failure)

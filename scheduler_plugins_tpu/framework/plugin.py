@@ -211,6 +211,15 @@ class Plugin:
         `filter_batch`; `normalize` still runs per pod row."""
         return None
 
+    def batch_rows(self, state: SolverState, snap: ClusterSnapshot):
+        """(filter (P, N) bool | None, scores (P, N) | None) computed in ONE
+        pass, or None to fall back to `filter_batch`/`score_batch`.
+        Implement when both derive from one shared intermediate (e.g. the
+        network dependency tallies) so the batched solver's cycle-initial
+        pass pays for it once instead of twice. Each element carries the
+        same bit-identity contract as the split hooks."""
+        return None
+
     # --- batched throughput path (parallel.solver) -----------------------
     def commit_batch(self, state: SolverState, snap: ClusterSnapshot,
                      placed, choice):

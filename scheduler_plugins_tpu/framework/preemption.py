@@ -471,6 +471,11 @@ class PreemptionEngine:
             )
         best = None
         produced = 0
+        # memoized per evicted-set within this dry run: the reprieve re-adds
+        # victims one at a time, so the all-evicted pre-check set (and many
+        # intermediate sets) repeat across candidate nodes; each miss costs
+        # a full post-eviction side-table rebuild (ADVICE r4)
+        verdict_cache: dict[frozenset, np.ndarray] = {}
         for n in rotation:
             if produced >= want:
                 break
@@ -479,7 +484,8 @@ class PreemptionEngine:
             if has_filters:
                 def filter_ok(evicted, _n=int(n)):
                     return self._filters_pass(
-                        cluster, scheduler, snap, meta, p_idx, evicted, _n
+                        cluster, scheduler, snap, meta, p_idx, evicted, _n,
+                        verdict_cache,
                     )
 
                 if not filter_ok(frozenset(victim_uids)):
@@ -602,11 +608,17 @@ class PreemptionEngine:
         )
 
     def _filters_pass(self, cluster, scheduler, snap, meta, p_idx,
-                      evicted_uids, n) -> bool:
+                      evicted_uids, n, verdict_cache=None) -> bool:
         """Plugin Filter verdict for the preemptor (pending row `p_idx`) on
         candidate node `n` against the hypothetical state with
         `evicted_uids` evicted (pod-derived tables only; see
-        Cluster.post_eviction_tables)."""
+        Cluster.post_eviction_tables). The per-node (N,) verdict row is
+        memoized in `verdict_cache` keyed by the evicted set — the side
+        tables and the verdict row depend only on (snap, p_idx, evicted),
+        and the reprieve revisits the same sets across candidate nodes."""
+        key = frozenset(evicted_uids)
+        if verdict_cache is not None and key in verdict_cache:
+            return bool(verdict_cache[key][n])
         hyp = snap
         if (
             evicted_uids
@@ -614,7 +626,10 @@ class PreemptionEngine:
             and hasattr(cluster, "post_eviction_tables")
         ):
             hyp = cluster.post_eviction_tables(snap, meta, evicted_uids)
-        return bool(np.asarray(scheduler.filter_verdicts(hyp, p_idx))[n])
+        row = np.asarray(scheduler.filter_verdicts(hyp, p_idx))
+        if verdict_cache is not None:
+            verdict_cache[key] = row
+        return bool(row[n])
 
     def _quota_gate(self, victims, v_node, v_req, eligible, preemptor, snap,
                     meta, N):

@@ -33,18 +33,6 @@ from scheduler_plugins_tpu.ops.fit import pod_fit_demand
 #: signature: (free (N,R), pod_index int32) -> (feasible (N,) bool, score (N,) int64)
 StepFn = Callable
 
-def _sorted_segments(onehot):
-    """Queue-order segment layout for a wave's node choices: `order` sorts
-    pods by (chosen node, queue position) with "no choice" (sentinel N)
-    last; `seg` = sorted segment ids; `first` marks each segment's head."""
-    P, N = onehot.shape
-    choice = jnp.where(onehot.any(axis=1), jnp.argmax(onehot, axis=1), N)
-    order = jnp.argsort(choice * P + jnp.arange(P))  # stable (choice, queue)
-    seg = choice[order]
-    first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
-    return order, seg, first
-
-
 def _segment_prefix(values_sorted, first):
     """Inclusive per-segment prefix sums of NON-NEGATIVE (P, R) float values
     WITHOUT a (P, N) cumsum (int64 2-D cumsums lower to vmem-hungry
@@ -213,7 +201,11 @@ def waterfill_assign_stateful(
         neg_inf = jnp.iinfo(scores.dtype).min // 2
         n_active = jnp.maximum(active.sum(), 1)
 
-        mean_score = jnp.sum(jnp.where(active[:, None], scores, 0), axis=0)
+        # int64 accumulator over a possibly-int32 score matrix: exact, at
+        # half the (P, N) read traffic when the caller demoted scores
+        mean_score = jnp.sum(
+            jnp.where(active[:, None], scores, 0), axis=0, dtype=jnp.int64
+        )
         order_n = jnp.argsort(-mean_score, stable=True)  # (N,)
         mean_demand = (
             jnp.sum(jnp.where(active[:, None], demand, 0), axis=0) // n_active
@@ -248,10 +240,12 @@ def waterfill_assign_stateful(
         )
         choice = jnp.where(active, choice, -1)
 
-        onehot = (choice[:, None] == jnp.arange(N)[None, :]) & (
-            choice[:, None] >= 0
-        )
-        order, seg, first = _sorted_segments(onehot)
+        # queue-order segment layout straight from `choice` — never
+        # materializes the (P, N) onehot the selection math doesn't need
+        seg_choice = jnp.where(choice >= 0, choice, N)
+        order = jnp.argsort(seg_choice * P + jnp.arange(P))
+        seg = seg_choice[order]
+        first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
         dem_sorted = demand[order].astype(jnp.float64)
         within = _segment_prefix(dem_sorted, first)
         free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)
@@ -281,11 +275,13 @@ def waterfill_assign_stateful(
             admitted = kept
 
         new_assignment = jnp.where(admitted, choice, assignment)
-        winners = onehot & admitted[:, None]
-        used = jnp.stack(
-            [(winners * demand[:, r][:, None]).sum(axis=0) for r in range(R)],
-            axis=-1,
-        )
+        # (N, R) usage via a (P,)-row segment sum — R * (P, N) masked
+        # multiply passes collapse into one P*R-element scatter
+        used = jax.ops.segment_sum(
+            jnp.where(admitted[:, None], demand, 0),
+            jnp.where(admitted, choice, N),
+            num_segments=N + 1,
+        )[:N]
         state = commit_fn(state, admitted, choice)
         return free - used, new_assignment, state, admitted.sum()
 

@@ -52,20 +52,34 @@ def dependency_tallies(
     N = node_zone.shape[0]
     ZC = zone_cost.shape[0]
     RC = region_cost.shape[0]
+    zone_cost = jnp.asarray(zone_cost).astype(jnp.int32)
+    region_cost = jnp.asarray(region_cost).astype(jnp.int32)
+    dep_max_cost = jnp.asarray(dep_max_cost).astype(jnp.int32)
     w = jnp.maximum(dep_workload, 0)
-    placed = jnp.where(dep_mask[:, None], placed_node[w], 0)  # (D, N)
+    # int32 internals: every tally is bounded by MAX_COST * total placed
+    # pods, far inside int32; int64 doubles the memory traffic of the
+    # (D, N, ZC) broadcasts on the CPU backend and is MXU-hostile on TPU
+    placed = jnp.where(
+        dep_mask[:, None], placed_node[w], 0
+    ).astype(jnp.int32)  # (D, N)
 
-    # aggregate placed pods by location class
+    # aggregate placed pods by location class. One-hot matmuls, not
+    # scatter-adds: XLA lowers scatter serially on CPU (the former
+    # per-class `.at[:, zone].add` dominated the whole cfg5 batch pass)
+    # while a (D, N) x (N, ZC) dot is a single dense contraction that
+    # also rides the MXU on TPU. f32 is exact here (counts < 2^24).
     zoned = node_zone >= 0
     rnoz = (node_zone < 0) & (node_region >= 0)
     unloc = (node_zone < 0) & (node_region < 0)
-    D = placed.shape[0]
-    placed_zone = jnp.zeros((D, ZC), placed.dtype).at[
-        :, jnp.maximum(node_zone, 0)
-    ].add(jnp.where(zoned[None, :], placed, 0))
-    placed_rnoz = jnp.zeros((D, RC), placed.dtype).at[
-        :, jnp.maximum(node_region, 0)
-    ].add(jnp.where(rnoz[None, :], placed, 0))
+    zone_onehot = (
+        zoned[:, None] & (node_zone[:, None] == jnp.arange(ZC)[None, :])
+    ).astype(jnp.float32)  # (N, ZC)
+    rnoz_onehot = (
+        rnoz[:, None] & (node_region[:, None] == jnp.arange(RC)[None, :])
+    ).astype(jnp.float32)  # (N, RC)
+    placed_f = placed.astype(jnp.float32)
+    placed_zone = jnp.dot(placed_f, zone_onehot).astype(jnp.int32)  # (D, ZC)
+    placed_rnoz = jnp.dot(placed_f, rnoz_onehot).astype(jnp.int32)  # (D, RC)
     placed_unloc = jnp.sum(jnp.where(unloc[None, :], placed, 0), axis=1)  # (D,)
 
     nz = jnp.maximum(node_zone, 0)
@@ -158,6 +172,164 @@ def dependency_tallies(
         satisfied.astype(jnp.int64),
         violated.astype(jnp.int64),
         cost.astype(jnp.int64),
+    )
+
+
+def class_dependency_tallies(
+    cls_dep_workload,
+    cls_dep_max_cost,
+    cls_dep_mask,
+    placed_node,
+    node_zone,
+    node_region,
+    zone_region,
+    zone_cost,
+    region_cost,
+):
+    """(W, N) satisfied/violated/cost tallies for every workload class at
+    once — the matmul formulation of `dependency_tallies`.
+
+    Bit-identical to vmapping `dependency_tallies` over the class rows
+    (test-gated), but restructured around the tallies' LINEARITY in the
+    placed-pod counts: for a fixed candidate node n, every pair
+    contribution is `weight(n, zone) * count(dep, zone)`, so the zone sums
+    collapse into (W, ZC) x (ZC, N) matmuls against class-independent
+    (N, ZC) weight tables, plus one (W, N, ZC) threshold pass per
+    dependency slot (MaxNetworkCost compares are the only per-dep
+    weights). The naive path materializes a dozen (W, D, N, ZC) broadcast
+    tensors; this one touches (W, N, ZC) D times and (N, ZC) once —
+    the difference between ~50ms and ~5ms per batch solve on the CPU
+    backend, and MXU-shaped work instead of elementwise sprawl on TPU.
+
+    f32 contractions are exact: every accumulated value is an integer
+    bounded by MAX_COST * total placed pods (< 2^24 for any cluster this
+    path sees; the chunked north-star feeds < 2^24 too).
+
+    Reference semantics: networkoverhead.go:500-638 (same mapping as
+    `dependency_tallies`, which remains the per-pod/parity formulation).
+    """
+    N = node_zone.shape[0]
+    ZC = zone_cost.shape[0]
+    RC = region_cost.shape[0]
+    W, D = cls_dep_workload.shape
+    zone_cost = jnp.asarray(zone_cost).astype(jnp.int32)
+    region_cost = jnp.asarray(region_cost).astype(jnp.int32)
+    mc = jnp.asarray(cls_dep_max_cost).astype(jnp.int32)  # (W, D)
+
+    w = jnp.maximum(cls_dep_workload, 0)  # (W, D)
+    placed = jnp.where(
+        cls_dep_mask[:, :, None], placed_node[w], 0
+    ).astype(jnp.float32)  # (W, D, N)
+    placed_sum = jnp.sum(placed, axis=1)  # (W, N) f32
+
+    zoned = node_zone >= 0
+    rnoz = (node_zone < 0) & (node_region >= 0)
+    unloc = (node_zone < 0) & (node_region < 0)
+    nz = jnp.maximum(node_zone, 0)
+    nr = jnp.maximum(node_region, 0)
+
+    # location-class aggregates, one-hot matmuls (MXU-friendly)
+    zone_onehot = (
+        zoned[:, None] & (node_zone[:, None] == jnp.arange(ZC)[None, :])
+    ).astype(jnp.float32)  # (N, ZC)
+    rnoz_onehot = (
+        rnoz[:, None] & (node_region[:, None] == jnp.arange(RC)[None, :])
+    ).astype(jnp.float32)  # (N, RC)
+    placed_zone = jnp.einsum("wdn,nz->wdz", placed, zone_onehot)  # (W, D, ZC)
+    placed_rnoz = jnp.einsum("wdn,nr->wdr", placed, rnoz_onehot)  # (W, D, RC)
+    placed_unloc = placed @ unloc.astype(jnp.float32)  # (W, D)
+    PZ = jnp.sum(placed_zone, axis=1)  # (W, ZC)
+    PR = jnp.sum(placed_rnoz, axis=1)  # (W, RC)
+    PU = jnp.sum(placed_unloc, axis=1)  # (W,)
+
+    # class-independent (N, ZC) pair tables — identical to
+    # dependency_tallies' definitions (incl. the ""-label corner cases)
+    same_zone = node_zone[:, None] == jnp.arange(ZC)[None, :]
+    same_region = node_region[:, None] == zone_region[None, :]
+    zcost_row = jnp.where(zoned[:, None], zone_cost[nz], -1)
+    rcost_zone = region_cost[nr][:, jnp.maximum(zone_region, 0)]
+    rcost_zone = jnp.where(
+        (node_region >= 0)[:, None] & (zone_region[None, :] >= 0),
+        rcost_zone,
+        -1,
+    )
+    pair_cost = jnp.where(
+        same_zone,
+        SAME_ZONE_COST,
+        jnp.where(
+            same_region,
+            jnp.where(zcost_row >= 0, zcost_row, MAX_COST),
+            jnp.where(rcost_zone >= 0, rcost_zone, MAX_COST),
+        ),
+    )
+    pair_known = jnp.where(same_region, zcost_row >= 0, rcost_zone >= 0)
+    pair_lookup = jnp.where(same_region, zcost_row, rcost_zone)
+    kz = pair_known & ~same_zone  # (N, ZC)
+    kz_f = kz.astype(jnp.float32)
+
+    # zoned placed pods ------------------------------------------------
+    # same-zone term: sum_z sz * (placed_zone - own) collapses to a gather
+    # at the candidate's own zone minus its own-node contribution
+    t_sz = jnp.where(
+        zoned[None, :], PZ[:, nz] - placed_sum, 0.0
+    )  # (W, N)
+    # threshold term: sum_d sum_z kz * [lookup <= mc_d] * placed_zone_d —
+    # the only per-dependency weight; one (W, N, ZC) pass per dep slot
+    term_B = jnp.zeros((W, N), jnp.float32)
+    for d in range(D):
+        le = (
+            pair_lookup[None, :, :] <= mc[:, d, None, None]
+        )  # (W, N, ZC)
+        term_B = term_B + jnp.sum(
+            jnp.where(le, kz_f[None, :, :], 0.0)
+            * placed_zone[:, d, None, :],
+            axis=2,
+        )
+    KT = PZ @ kz_f.T  # (W, N): all known-non-same-zone pairs
+    cost_z = PZ @ pair_cost.astype(jnp.float32).T - jnp.where(
+        zoned[None, :], placed_sum * SAME_ZONE_COST, 0.0
+    )
+
+    # region-only placed pods ------------------------------------------
+    same_r = node_region[:, None] == jnp.arange(RC)[None, :]
+    rcost = jnp.where((node_region >= 0)[:, None], region_cost[nr], -1)
+    both_zoneless = (node_zone < 0)[:, None] & same_r
+    rn_cost = jnp.where(
+        both_zoneless,
+        SAME_ZONE_COST,
+        jnp.where(same_r, MAX_COST, jnp.where(rcost >= 0, rcost, MAX_COST)),
+    )
+    rn_known = ~same_r & (rcost >= 0)
+    rn_known_f = rn_known.astype(jnp.float32)
+    rcost_eff = jnp.where(rcost >= 0, rcost, MAX_COST)  # (N, RC)
+    t_bz = jnp.where(
+        rnoz[None, :], PR[:, nr] - placed_sum, 0.0
+    )
+    term_Br = jnp.zeros((W, N), jnp.float32)
+    for d in range(D):
+        le = rcost_eff[None, :, :] <= mc[:, d, None, None]  # (W, N, RC)
+        term_Br = term_Br + jnp.sum(
+            jnp.where(le, rn_known_f[None, :, :], 0.0)
+            * placed_rnoz[:, d, None, :],
+            axis=2,
+        )
+    KTr = PR @ rn_known_f.T
+    cost_r = PR @ rn_cost.astype(jnp.float32).T - jnp.where(
+        rnoz[None, :], placed_sum * SAME_ZONE_COST, 0.0
+    )
+
+    # unlocated placed pods --------------------------------------------
+    vu = PU[:, None] - jnp.where(unloc[None, :], placed_sum, 0.0)  # (W, N)
+
+    satisfied = t_sz + term_B + placed_sum + t_bz + term_Br
+    violated = (KT - term_B) + (KTr - term_Br) + vu
+    cost = cost_z + cost_r + MAX_COST * vu
+    # int32 rows (values <= MAX_COST * placed pods): downstream (P, N)
+    # gathers and normalize min/max passes run at half the int64 traffic
+    return (
+        satisfied.astype(jnp.int32),
+        violated.astype(jnp.int32),
+        cost.astype(jnp.int32),
     )
 
 

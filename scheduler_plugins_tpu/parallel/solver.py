@@ -317,6 +317,9 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
         and len(scoring) == 1
         and type(scoring[0]).static_node_scores
         is not _PluginBase.static_node_scores
+        # raw-order == normalized-weighted-order only holds for a positive
+        # weight; weight<=0 must fall back to the generic path (ADVICE r4)
+        and scoring[0].weight > 0
     )
     if fast:
 
@@ -371,12 +374,22 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 return plugin.filter_batch(state, snap)
             return None
 
-        filter0_rows = {
-            i: m for i, plugin in enumerate(plugins)
-            if (m := _batch_filter(plugin, state0)) is not None
-        }
-        score_rows = {}
+        filter0_rows, score_rows = {}, {}
         for i, plugin in enumerate(plugins):
+            # fused filter+score rows when offered: one shared-intermediate
+            # pass instead of two (networkaware tallies)
+            if type(plugin).batch_rows is not _PluginBase.batch_rows:
+                fused = plugin.batch_rows(state0, snap)
+                if fused is not None:
+                    f_row, s_row = fused
+                    if f_row is not None:
+                        filter0_rows[i] = f_row
+                    if s_row is not None:
+                        score_rows[i] = s_row
+                    continue
+            m = _batch_filter(plugin, state0)
+            if m is not None:
+                filter0_rows[i] = m
             if type(plugin).score_batch is not _PluginBase.score_batch:
                 s = plugin.score_batch(state0, snap)
                 if s is not None:
@@ -424,7 +437,10 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 )
                 if raw is not None:
                     total = total + plugin.weight * plugin.normalize(raw, feasible)
-            return ok, static_feasible, feasible, total
+            # int32 demotion: normalized scores are <= 100 * sum(weights),
+            # far inside int32 — halves the (P, N) score-matrix traffic in
+            # the waterfill's per-wave argmax/mean passes
+            return ok, static_feasible, feasible, total.astype(jnp.int32)
 
         admitted, static_feasible, feasible0, scores0 = jax.vmap(per_pod)(
             jnp.arange(P)

@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from scheduler_plugins_tpu.framework.plugin import Plugin
-from scheduler_plugins_tpu.ops.network import dependency_tallies, placed_commit
+from scheduler_plugins_tpu.ops.network import (
+    class_dependency_tallies,
+    dependency_tallies,
+    placed_commit,
+)
 from scheduler_plugins_tpu.ops.normalize import peaks_normalize
 
 DEFAULT_WEIGHTS_NAME = "UserDefined"  # defaults.go:232-244
@@ -134,41 +138,48 @@ class NetworkOverhead(Plugin):
     # (integer tallies over identical inputs), with P/W-fold less work on
     # the batched solver's hot passes.
     def _class_tallies(self, state, snap):
-        import jax
-
         net = snap.network
         placed = (
             state.net_placed if state.net_placed is not None
             else net.placed_node
         )
         zone_cost, region_cost = self._aux
-        return jax.vmap(
-            lambda dw, mc, dm: dependency_tallies(
-                dw, mc, dm, placed, snap.nodes.zone, snap.nodes.region,
-                net.zone_region, zone_cost, region_cost,
-            )
-        )(net.cls_dep_workload, net.cls_dep_max_cost, net.cls_dep_mask)
+        return class_dependency_tallies(
+            net.cls_dep_workload, net.cls_dep_max_cost, net.cls_dep_mask,
+            placed, snap.nodes.zone, snap.nodes.region,
+            net.zone_region, zone_cost, region_cost,
+        )
 
-    def filter_batch(self, state, snap):
-        if snap.network is None or self._zone_cost is None:
+    def batch_rows(self, state, snap):
+        """Fused filter+score: the (W, N) tallies are shared, so the
+        batched solver's cycle-initial pass pays for them once. The single
+        source of truth for the batched verdict/score expressions —
+        `filter_batch`/`score_batch` delegate here (XLA dead-code-
+        eliminates whichever half a caller drops)."""
+        if (snap.network is None or self._zone_cost is None
+                or snap.network.cls_dep_workload is None):
+            # class rows absent (e.g. a snapshot built by an export path
+            # predating them): fall back to the per-pod path (ADVICE r4)
             return None
         net = snap.network
-        sat, vio, _ = self._class_tallies(state, snap)  # (W, N) each
+        sat, vio, cost = self._class_tallies(state, snap)  # (W, N) each
         cls = jnp.maximum(net.pod_workload, 0)
-        verdict = (vio <= sat)[cls]  # (P, N)
         # pods without a workload or without dependencies score equally:
         # filter passes (networkoverhead.go scoreEqually path)
         score_equally = ~net.dep_mask.any(axis=1) | (net.pod_workload < 0)
-        return jnp.where(score_equally[:, None], True, verdict)
+        verdict = jnp.where(
+            score_equally[:, None], True, (vio <= sat)[cls]
+        )
+        scores = jnp.where(score_equally[:, None], 0, cost[cls])
+        return verdict, scores
+
+    def filter_batch(self, state, snap):
+        rows = self.batch_rows(state, snap)
+        return None if rows is None else rows[0]
 
     def score_batch(self, state, snap):
-        if snap.network is None or self._zone_cost is None:
-            return None
-        net = snap.network
-        _, _, cost = self._class_tallies(state, snap)  # (W, N)
-        cls = jnp.maximum(net.pod_workload, 0)
-        score_equally = ~net.dep_mask.any(axis=1) | (net.pod_workload < 0)
-        return jnp.where(score_equally[:, None], 0, cost[cls])
+        rows = self.batch_rows(state, snap)
+        return None if rows is None else rows[1]
 
     def commit(self, state, snap, p, choice):
         if snap.network is None or state.net_placed is None:

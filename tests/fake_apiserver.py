@@ -13,6 +13,8 @@ one per accepted watch connection. A session is a list of actions:
     ("event", {...})     write one watch event line
     ("partial", "text")  write a truncated (non-JSON) fragment, then close
     ("end",)             close the stream cleanly
+    ("stall", [secs])    go silent (default 1s) without closing — the
+                         client's read blocks until its socket timeout
     ("reject", code)     answer the watch request with an HTTP error
                          status instead of a stream (must be the session's
                          first and only action)
@@ -91,6 +93,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(action[1].encode())
                 self.wfile.flush()
                 return  # close mid-line: client sees a truncated record
+            elif kind == "stall":
+                import time
+
+                time.sleep(action[1] if len(action) > 1 else 1.0)
+                return
             elif kind == "end":
                 return
 

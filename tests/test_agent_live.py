@@ -237,3 +237,44 @@ class TestLiveEndToEnd:
             assert len(set(report.bound.values())) == 2
         finally:
             server.stop()
+
+
+class TestListPhaseTimeouts:
+    def test_list_timeout_consumes_failure_budget(self, monkeypatch):
+        """A timeout during the LIST bootstrap is an ordinary failure with
+        backoff — NOT the idle-watch exemption — so an apiserver that
+        consistently times out cannot hold a bounded caller in an
+        unbounded relist loop (ADVICE r4, agent.py list_then_watch)."""
+        import urllib.request
+
+        def always_times_out(req, timeout=None, context=None):
+            raise TimeoutError("simulated LIST stall")
+
+        monkeypatch.setattr(urllib.request, "urlopen", always_times_out)
+        sleeps = []
+        agent = ClusterAgent(lambda e: {})
+        sent = agent.list_then_watch(
+            "http://127.0.0.1:1", "/api/v1/pods", max_failures=3,
+            backoff_base_s=0.01, _sleep=sleeps.append)
+        assert sent == 0          # returned (bounded), did not hang
+        assert len(sleeps) == 2   # backed off between the 3 failures
+
+    def test_established_watch_timeout_is_exempt(self):
+        """The idle-watch exemption still holds: a read timeout on an
+        ESTABLISHED stream reconnects from the same rv without consuming
+        the failure budget."""
+        with FakeApiServer() as srv:
+            srv.lists["/api/v1/pods"] = _listing("PodList", [], rv=5)
+            srv.watch_scripts["/api/v1/pods"] = [
+                [("event", _watch("ADDED", _pod("a", rv=6))), ("stall",)],
+                [("event", _watch("ADDED", _pod("b", rv=7))), ("end",)],
+            ]
+            sleeps = []
+            agent = ClusterAgent(lambda e: {})
+            sent = agent.list_then_watch(
+                srv.url, "/api/v1/pods", max_events=2, timeout_s=0.2,
+                max_failures=1, backoff_base_s=0.01, _sleep=sleeps.append)
+            assert sent == 2
+            # the stalled stream's timeout burned no budget: with
+            # max_failures=1 a counted failure would have aborted before b
+            assert "resourceVersion=6" in srv.watch_requests["/api/v1/pods"][1]

@@ -658,3 +658,36 @@ class TestMultiHostLaunch:
         an = launch.distributed_solve(snap_b, mesh, weights)
         a_local, _, _ = solve(snap, weights)
         assert an.tolist() == np.asarray(a_local).tolist()
+
+
+class TestTargetedFastPathGate:
+    """The targeted fast path assumes raw static-score order equals the
+    normalized-weighted order — only sound for weight > 0 (ADVICE r4,
+    solver.py gate)."""
+
+    def _solve(self, weight):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.models import allocatable_scenario
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        cluster = allocatable_scenario(n_nodes=16, n_pods=32)
+        plugin = NodeResourcesAllocatable()
+        plugin.weight = weight
+        sched = Scheduler(Profile(plugins=[plugin]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        profile_batch_solve(sched, snap)
+        return sched
+
+    def test_positive_weight_takes_fast_path(self):
+        sched = self._solve(1)
+        assert any(k[0] == "profile_batch_fast"
+                   for k in sched._solve_cache)
+
+    def test_nonpositive_weight_falls_back_to_generic(self):
+        sched = self._solve(0)
+        assert not any(k[0] == "profile_batch_fast"
+                       for k in sched._solve_cache)
+        assert any(k[0] == "profile_batch" for k in sched._solve_cache)

@@ -35,7 +35,10 @@ def log(msg):
     print(f"{stamp} {msg}", flush=True)
 
 #: (config, mode, per-run subprocess timeout seconds). Config 1 ignores mode.
+#: Config 0 (tiny-shape smoke) runs FIRST: even a short healthy window then
+#: yields *a* verified on-chip artifact (VERDICT r4 item 1a).
 RUNS = [
+    (0, "sequential", 420),
     (1, "sequential", 900),
     (2, "sequential", 900),
     (3, "sequential", 900),
@@ -56,10 +59,12 @@ def probe(timeout=75):
     return bench.backend_probe(timeout=timeout)
 
 
-def run_one(config, mode, timeout):
+def run_one(config, mode, timeout, trace_dir=None):
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--config", str(config)]
     if config in (2, 3, 4, 5):
         cmd += ["--mode", mode]
+    if trace_dir:
+        cmd += ["--trace", trace_dir]
     try:
         proc = subprocess.run(
             cmd, timeout=timeout, capture_output=True, text=True, cwd=REPO
@@ -89,7 +94,18 @@ def cycle():
         if diagnosis is not None:
             log(f"[watch] probe sick before config {config}: {diagnosis}")
             return good
-        result = run_one(config, mode, timeout)
+        # on the first SUCCESSFUL flagship run, also dump a jax profiler
+        # trace (op-level data for the next tuning round — VERDICT r4 item
+        # 1b); a failed attempt removes its partial dir so the next cycle
+        # retries instead of being suppressed forever
+        trace_dir = os.path.join(REPO, ".profile_trace")
+        want_trace = config == 1 and not os.path.exists(trace_dir)
+        result = run_one(config, mode, timeout,
+                         trace_dir=trace_dir if want_trace else None)
+        if want_trace and ("error" in result or not result.get("value", 0)):
+            import shutil
+
+            shutil.rmtree(trace_dir, ignore_errors=True)
         entry = {"ts": time.time(), "config": config, "mode": mode, **result}
         append(entry)
         ok = "error" not in result and result.get("value", 0) > 0
