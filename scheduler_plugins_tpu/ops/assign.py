@@ -149,6 +149,8 @@ def waterfill_assign_stateful(
     validate_commit_fn=None,
     capacity_fns=(),
     initial_batch=None,
+    sub_batch_fn=None,
+    straggler_cap: int = 256,
 ):
     """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
     filters (NUMA zone availability, network placement tallies): the carries
@@ -188,15 +190,36 @@ def waterfill_assign_stateful(
     paying ``batch_fn`` a second time on the unchanged initial state; waves
     1+ always re-evaluate against the committed carry.
 
+    ``sub_batch_fn(free, state, idx (S,), act_sub (S,)) -> (feasible (S,N),
+    scores (S,N))``: optional SPARSE straggler waves — requires
+    ``initial_batch``. Waves after the dense wave 0 gather the first
+    ``straggler_cap`` still-unplaced pods (queue order) and re-filter only
+    those rows, so a straggler wave costs O(S·N), not O(P·N). Guard
+    prefixes, queue-order admission, and the validate scan all run inside
+    the subset — exact, because subset rows preserve queue order and a
+    wave admits only subset pods. A sparse wave that places NOTHING
+    escalates to one dense wave over all active pods (a head cohort of
+    more than ``straggler_cap`` infeasible pods must not starve placeable
+    pods behind it); only a stalled dense wave ends the loop early.
+
     Not jitted itself: designed to run inside a caller's jit (the closures
     are trace-local). Returns (assignment, free, state).
     """
     P, R = req.shape
     demand = pod_fit_demand(req)
     N = free0.shape[0]
+    S = min(straggler_cap, P)
+    if sub_batch_fn is not None and initial_batch is None:
+        raise ValueError("sub_batch_fn requires initial_batch (dense wave 0)")
 
-    def wave_core(free, assignment, state, feasible, scores):
-        active = (assignment == -1) & pod_mask
+    def wave_core(free, assignment, state, idx, feasible, scores):
+        """One wave over the pod rows `idx` (ascending = queue order);
+        `feasible`/`scores` are the (S, N) rows for those pods. The dense
+        wave passes idx = arange(P)."""
+        Ssub = idx.shape[0]
+        active_full = (assignment == -1) & pod_mask
+        active = active_full[idx]
+        dem = demand[idx]
         feasible = feasible & active[:, None]
         neg_inf = jnp.iinfo(scores.dtype).min // 2
         n_active = jnp.maximum(active.sum(), 1)
@@ -208,23 +231,23 @@ def waterfill_assign_stateful(
         )
         order_n = jnp.argsort(-mean_score, stable=True)  # (N,)
         mean_demand = (
-            jnp.sum(jnp.where(active[:, None], demand, 0), axis=0) // n_active
+            jnp.sum(jnp.where(active[:, None], dem, 0), axis=0) // n_active
         )
         cap = jnp.min(
             jnp.where(
                 mean_demand[None, :] > 0,
                 free // jnp.maximum(mean_demand[None, :], 1),
-                jnp.int64(P),
+                jnp.int64(Ssub),
             ),
             axis=1,
         )
         # plugin capacity refinements (NUMA zones, ...): bucketing must not
         # send a node more pods than its tightest constraint can admit
         for cap_fn in capacity_fns:
-            extra = cap_fn(state, active)
+            extra = cap_fn(state, active_full)
             if extra is not None:
                 cap = jnp.minimum(cap, extra.astype(cap.dtype))
-        cap = jnp.clip(cap, 0, P).astype(jnp.int32)
+        cap = jnp.clip(cap, 0, Ssub).astype(jnp.int32)
         ccap = jnp.cumsum(cap[order_n], dtype=jnp.int32)
         rank = jnp.cumsum(active, dtype=jnp.int32) - 1
         bucket = jnp.searchsorted(ccap, rank, side="right")
@@ -241,83 +264,144 @@ def waterfill_assign_stateful(
         choice = jnp.where(active, choice, -1)
 
         # queue-order segment layout straight from `choice` — never
-        # materializes the (P, N) onehot the selection math doesn't need
+        # materializes the (S, N) onehot the selection math doesn't need
         seg_choice = jnp.where(choice >= 0, choice, N)
-        order = jnp.argsort(seg_choice * P + jnp.arange(P))
+        order = jnp.argsort(
+            seg_choice.astype(jnp.int64) * Ssub + jnp.arange(Ssub)
+        )
         seg = seg_choice[order]
         first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
-        dem_sorted = demand[order].astype(jnp.float64)
+        dem_sorted = dem[order].astype(jnp.float64)
         within = _segment_prefix(dem_sorted, first)
         free_row = free[jnp.minimum(seg, N - 1)].astype(jnp.float64)
         ok_sorted = jnp.all(within <= free_row, axis=1) & (seg < N)
         node_sorted = jnp.minimum(seg, N - 1)
         for guard, gdem in zip(guards, guard_demands):
-            gd_sorted = gdem[order].astype(jnp.float64)
+            gd_sorted = gdem[idx][order].astype(jnp.float64)
             g_within = _segment_prefix(gd_sorted, first)
             g_excl = g_within - gd_sorted  # exclusive: earlier choosers only
             ok_sorted &= jax.vmap(
-                lambda p, n, pre: guard(state, p, n, pre)
+                lambda j, n, pre: guard(state, idx[j], n, pre)
             )(order, node_sorted, g_excl)
-        admitted = (choice >= 0) & jnp.zeros(P, bool).at[order].set(ok_sorted)
+        admitted = (choice >= 0) & jnp.zeros(Ssub, bool).at[order].set(
+            ok_sorted
+        )
 
         if validate_fn is not None:
             # cross-node hard constraints: sequential queue-order re-check
             # of this wave's winners against the live carry; kept pods
             # commit immediately so later pods in the same wave see them
-            def vstep(vstate, q):
-                act = admitted[q]
-                ok = act & validate_fn(vstate, q, choice[q])
-                kept_choice = jnp.where(ok, choice[q], jnp.int32(-1))
-                vstate = validate_commit_fn(vstate, q, kept_choice)
+            def vstep(vstate, j):
+                act = admitted[j]
+                ok = act & validate_fn(vstate, idx[j], choice[j])
+                kept_choice = jnp.where(ok, choice[j], jnp.int32(-1))
+                vstate = validate_commit_fn(vstate, idx[j], kept_choice)
                 return vstate, ok
 
-            state, kept = jax.lax.scan(vstep, state, jnp.arange(P))
+            state, kept = jax.lax.scan(vstep, state, jnp.arange(Ssub))
             admitted = kept
 
-        new_assignment = jnp.where(admitted, choice, assignment)
-        # (N, R) usage via a (P,)-row segment sum — R * (P, N) masked
-        # multiply passes collapse into one P*R-element scatter
+        new_assignment = assignment.at[idx].set(
+            jnp.where(admitted, choice, assignment[idx])
+        )
+        # (N, R) usage via an (S,)-row segment sum — R * (S, N) masked
+        # multiply passes collapse into one S*R-element scatter
         used = jax.ops.segment_sum(
-            jnp.where(admitted[:, None], demand, 0),
+            jnp.where(admitted[:, None], dem, 0),
             jnp.where(admitted, choice, N),
             num_segments=N + 1,
         )[:N]
-        state = commit_fn(state, admitted, choice)
+        placed_full = jnp.zeros(P, bool).at[idx].set(admitted)
+        choice_full = jnp.full(P, -1, jnp.int32).at[idx].set(choice)
+        state = commit_fn(state, placed_full, choice_full)
         return free - used, new_assignment, state, admitted.sum()
 
+    dense_idx = jnp.arange(P)
+
+    def dense_wave(free, assignment, state):
+        active = (assignment == -1) & pod_mask
+        feasible, scores = batch_fn(free, state, active)
+        return wave_core(free, assignment, state, dense_idx, feasible, scores)
+
+    def sparse_wave(free, assignment, state):
+        active = (assignment == -1) & pod_mask
+        # first S active pods in queue order (stable argsort: inactive
+        # rows sink with key P)
+        idx = jnp.argsort(jnp.where(active, dense_idx, P))[:S]
+        feasible, scores = sub_batch_fn(free, state, idx, active[idx])
+        return wave_core(free, assignment, state, idx, feasible, scores)
+
+    assignment0 = jnp.full(P, -1, jnp.int32)
+
+    if sub_batch_fn is None:
+        def cond(loop_state):
+            _, assignment, _, wave_idx, progressed = loop_state
+            # stop on wave budget, on a no-progress wave, or — cheaper —
+            # when nothing is left to place (otherwise a fully-placed
+            # batch pays one whole extra wave to discover quiescence)
+            return (
+                (wave_idx < max_waves)
+                & progressed
+                & ((assignment == -1) & pod_mask).any()
+            )
+
+        def body(loop_state):
+            free, assignment, state, wave_idx, _ = loop_state
+            free, assignment, state, n = dense_wave(free, assignment, state)
+            return free, assignment, state, wave_idx + 1, n > 0
+
+        if initial_batch is not None:
+            feasible0, scores0 = initial_batch
+            free_w, assignment_w, state_w, n0 = wave_core(
+                free0, assignment0, state0, dense_idx, feasible0, scores0
+            )
+            init = (free_w, assignment_w, state_w, jnp.int32(1), n0 > 0)
+        else:
+            init = (free0, assignment0, state0, jnp.int32(0), jnp.bool_(True))
+        free, assignment, state, _, _ = jax.lax.while_loop(cond, body, init)
+        return assignment, free, state
+
+    # sparse mode machine: 0 = sparse straggler wave, 1 = dense retry,
+    # 2 = stop. A stalled sparse wave does NOT end the loop — a head
+    # cohort of >straggler_cap infeasible pods would otherwise starve
+    # placeable pods behind it — it escalates to one dense wave over ALL
+    # active pods; only a stalled dense wave proves quiescence. A
+    # productive wave of either kind returns to sparse.
+    MODE_SPARSE, MODE_DENSE, MODE_STOP = jnp.int32(0), jnp.int32(1), jnp.int32(2)
+
     def cond(loop_state):
-        _, assignment, _, wave_idx, progressed = loop_state
-        # stop on wave budget, on a no-progress wave, or — cheaper — when
-        # nothing is left to place (otherwise a fully-placed batch pays one
-        # whole extra wave just to discover quiescence)
+        _, assignment, _, wave_idx, mode = loop_state
         return (
             (wave_idx < max_waves)
-            & progressed
+            & (mode < MODE_STOP)
             & ((assignment == -1) & pod_mask).any()
         )
 
-    def wave(free, assignment, state):
-        active = (assignment == -1) & pod_mask
-        feasible, scores = batch_fn(free, state, active)
-        return wave_core(free, assignment, state, feasible, scores)
-
     def body(loop_state):
-        free, assignment, state, wave_idx, _ = loop_state
-        free, assignment, state, n_admitted = wave(free, assignment, state)
-        return free, assignment, state, wave_idx + 1, n_admitted > 0
-
-    assignment0 = jnp.full(P, -1, jnp.int32)
-    if initial_batch is not None:
-        # wave 0 against the caller's precomputed cycle-initial tensors —
-        # batch_fn is first consulted on wave 1, after commits changed state
-        feasible0, scores0 = initial_batch
-        free_w, assignment_w, state_w, n0 = wave_core(
-            free0, assignment0, state0, feasible0, scores0
+        free, assignment, state, wave_idx, mode = loop_state
+        free, assignment, state, n = jax.lax.cond(
+            mode == MODE_SPARSE,
+            lambda args: sparse_wave(*args),
+            lambda args: dense_wave(*args),
+            (free, assignment, state),
         )
-        init = (free_w, assignment_w, state_w, jnp.int32(1), n0 > 0)
-    else:
-        init = (free0, assignment0, state0, jnp.int32(0), jnp.bool_(True))
+        new_mode = jnp.where(
+            n > 0,
+            MODE_SPARSE,
+            jnp.where(mode == MODE_SPARSE, MODE_DENSE, MODE_STOP),
+        )
+        return free, assignment, state, wave_idx + 1, new_mode
 
+    # wave 0 is always dense (initial_batch is required with sub_batch_fn)
+    feasible0, scores0 = initial_batch
+    free_w, assignment_w, state_w, n0 = wave_core(
+        free0, assignment0, state0, dense_idx, feasible0, scores0
+    )
+    # a stalled dense wave 0 already proves quiescence
+    init = (
+        free_w, assignment_w, state_w, jnp.int32(1),
+        jnp.where(n0 > 0, MODE_SPARSE, MODE_STOP),
+    )
     free, assignment, state, _, _ = jax.lax.while_loop(cond, body, init)
     return assignment, free, state
 

@@ -26,6 +26,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 MAX_COST = 100  # networkoverhead.go MaxCost
+
+#: all tally contractions are integer-valued f32 counts/costs (< 2^24) and
+#: feed HARD filter verdicts: force full-f32 accumulation — TPU default
+#: matmul precision multiplies in bf16, which rounds any count >= 257
+import jax.lax as _lax  # noqa: E402
+
+_EXACT = _lax.Precision.HIGHEST
 SAME_ZONE_COST = 1
 SAME_HOST_COST = 0
 
@@ -78,8 +85,12 @@ def dependency_tallies(
         rnoz[:, None] & (node_region[:, None] == jnp.arange(RC)[None, :])
     ).astype(jnp.float32)  # (N, RC)
     placed_f = placed.astype(jnp.float32)
-    placed_zone = jnp.dot(placed_f, zone_onehot).astype(jnp.int32)  # (D, ZC)
-    placed_rnoz = jnp.dot(placed_f, rnoz_onehot).astype(jnp.int32)  # (D, RC)
+    placed_zone = jnp.dot(
+        placed_f, zone_onehot, precision=_EXACT
+    ).astype(jnp.int32)  # (D, ZC)
+    placed_rnoz = jnp.dot(
+        placed_f, rnoz_onehot, precision=_EXACT
+    ).astype(jnp.int32)  # (D, RC)
     placed_unloc = jnp.sum(jnp.where(unloc[None, :], placed, 0), axis=1)  # (D,)
 
     nz = jnp.maximum(node_zone, 0)
@@ -235,9 +246,15 @@ def class_dependency_tallies(
     rnoz_onehot = (
         rnoz[:, None] & (node_region[:, None] == jnp.arange(RC)[None, :])
     ).astype(jnp.float32)  # (N, RC)
-    placed_zone = jnp.einsum("wdn,nz->wdz", placed, zone_onehot)  # (W, D, ZC)
-    placed_rnoz = jnp.einsum("wdn,nr->wdr", placed, rnoz_onehot)  # (W, D, RC)
-    placed_unloc = placed @ unloc.astype(jnp.float32)  # (W, D)
+    placed_zone = jnp.einsum(
+        "wdn,nz->wdz", placed, zone_onehot, precision=_EXACT
+    )  # (W, D, ZC)
+    placed_rnoz = jnp.einsum(
+        "wdn,nr->wdr", placed, rnoz_onehot, precision=_EXACT
+    )  # (W, D, RC)
+    placed_unloc = jnp.dot(
+        placed, unloc.astype(jnp.float32), precision=_EXACT
+    )  # (W, D)
     PZ = jnp.sum(placed_zone, axis=1)  # (W, ZC)
     PR = jnp.sum(placed_rnoz, axis=1)  # (W, RC)
     PU = jnp.sum(placed_unloc, axis=1)  # (W,)
@@ -285,8 +302,10 @@ def class_dependency_tallies(
             * placed_zone[:, d, None, :],
             axis=2,
         )
-    KT = PZ @ kz_f.T  # (W, N): all known-non-same-zone pairs
-    cost_z = PZ @ pair_cost.astype(jnp.float32).T - jnp.where(
+    KT = jnp.dot(PZ, kz_f.T, precision=_EXACT)  # (W, N): known-non-same-zone
+    cost_z = jnp.dot(
+        PZ, pair_cost.astype(jnp.float32).T, precision=_EXACT
+    ) - jnp.where(
         zoned[None, :], placed_sum * SAME_ZONE_COST, 0.0
     )
 
@@ -313,8 +332,10 @@ def class_dependency_tallies(
             * placed_rnoz[:, d, None, :],
             axis=2,
         )
-    KTr = PR @ rn_known_f.T
-    cost_r = PR @ rn_cost.astype(jnp.float32).T - jnp.where(
+    KTr = jnp.dot(PR, rn_known_f.T, precision=_EXACT)
+    cost_r = jnp.dot(
+        PR, rn_cost.astype(jnp.float32).T, precision=_EXACT
+    ) - jnp.where(
         rnoz[None, :], placed_sum * SAME_ZONE_COST, 0.0
     )
 

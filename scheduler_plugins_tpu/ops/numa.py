@@ -37,7 +37,7 @@ from scheduler_plugins_tpu.api.resources import (
     MEMORY,
     ResourceIndex,
 )
-from scheduler_plugins_tpu.utils.intmath import floordiv_exact
+from scheduler_plugins_tpu.utils.intmath import floordiv_exact, floordiv_recip
 
 MAX_NODE_SCORE = 100
 MAX_DISTANCE = 255.0  # least_numa.go:32
@@ -220,11 +220,16 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
         capf = cap.astype(dt)
         reqf = req[None, :].astype(dt)
         numer = (capf - reqf) if strategy == LEAST_ALLOCATED else reqf
+        # reciprocal-multiply floor division: `capf` is pod-invariant, so
+        # under the batched solver's vmap the reciprocal is computed once
+        # while the division would run per (pod, node, zone, resource) —
+        # the dominant op of the NUMA score pass on both backends
+        safe_cap = jnp.maximum(capf, 1)
         per = jnp.where(
             (capf == 0) | (reqf > capf),
             0.0,
-            floordiv_exact(
-                numer * float(MAX_NODE_SCORE), jnp.maximum(capf, 1)
+            floordiv_recip(
+                numer * float(MAX_NODE_SCORE), safe_cap, 1.0 / safe_cap
             ),
         )
         scores = _weighted_zone_score(per, relevant, weights)

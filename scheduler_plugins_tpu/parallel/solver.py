@@ -395,6 +395,18 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                 if s is not None:
                     score_rows[i] = s
 
+        # plugins with batched score rows AND the base identity normalize
+        # contribute a feasibility-independent weighted sum — fold them
+        # into ONE whole-matrix total outside the per-pod vmap
+        pre_total = None
+        pre_ids = {
+            i for i in score_rows
+            if type(plugins[i]).normalize is _PluginBase.normalize
+        }
+        for i in pre_ids:
+            term = plugins[i].weight * score_rows[i].astype(jnp.int32)
+            pre_total = term if pre_total is None else pre_total + term
+
         def per_pod(p):
             ok = snap.pods.mask[p] & ~snap.pods.gated[p]
             for plugin in plugins:
@@ -431,6 +443,8 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             feasible &= ok
             total = jnp.zeros(snap.num_nodes, jnp.int64)
             for i, plugin in enumerate(plugins):
+                if i in pre_ids:
+                    continue  # folded into pre_total outside the vmap
                 raw = (
                     score_rows[i][p] if i in score_rows
                     else plugin.score(state0, snap, p)
@@ -440,7 +454,10 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             # int32 demotion: normalized scores are <= 100 * sum(weights),
             # far inside int32 — halves the (P, N) score-matrix traffic in
             # the waterfill's per-wave argmax/mean passes
-            return ok, static_feasible, feasible, total.astype(jnp.int32)
+            total = total.astype(jnp.int32)
+            if pre_total is not None:
+                total = total + pre_total[p]
+            return ok, static_feasible, feasible, total
 
         admitted, static_feasible, feasible0, scores0 = jax.vmap(per_pod)(
             jnp.arange(P)
@@ -464,6 +481,28 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
                     continue
                 feasible &= jax.vmap(one)(jnp.arange(P))
             return feasible, scores0
+
+        def sub_batch_fn(free, state, idx, act_sub):
+            """Sparse straggler re-filter: (S, N) rows for the `idx` pods
+            only — a straggler wave re-runs the dyn filters on <=256 pods
+            instead of the whole batch."""
+            feasible = fits(
+                snap.pods.req[idx], free,
+                pod_mask=act_sub, node_mask=snap.nodes.mask,
+            ) & static_feasible[idx]
+            for plugin in dyn_plugins:
+                m = _batch_filter(plugin, state)
+                if m is not None:
+                    # class-collapsed rows: XLA folds the row gather into
+                    # the (W, N) -> (P, N) class gather
+                    feasible &= m[idx]
+                    continue
+                def one(p, _pl=plugin):
+                    return _pl.filter(state, snap, p)
+                if one(jnp.int32(0)) is None:
+                    continue
+                feasible &= jax.vmap(one)(idx)
+            return feasible, scores0[idx]
 
         # hard DOMAIN constraints (topology spread, inter-pod anti-affinity)
         # span nodes, so neither the per-wave re-filter nor the same-node
@@ -540,6 +579,7 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
             # wave 0 reuses the cycle-initial filter pass per_pod already
             # paid for (state is unchanged until the first commit)
             initial_batch=(feasible0, scores0),
+            sub_batch_fn=sub_batch_fn,
         )
         assignment, wait = finalize_assignment(assignment, snap)
         return assignment, admitted, wait

@@ -28,8 +28,10 @@ from scheduler_plugins_tpu.ops.normalize import peaks_normalize
 from scheduler_plugins_tpu.ops.trimaran import (
     lroc_score,
     lvrb_score,
+    lvrb_score_batch,
     peaks_score,
     tlp_score,
+    tlp_score_batch,
 )
 
 
@@ -115,6 +117,20 @@ class TargetLoadPacking(Plugin):
             self.target,
         )
 
+    def score_batch(self, state, snap):
+        """Batched piecewise curve (f32 broadcast stage; +/-1 rounding vs
+        the parity path at knife edges — see ops.trimaran)."""
+        if snap.metrics is None:
+            return None
+        return tlp_score_batch(
+            snap.metrics.cpu_tlp,
+            snap.metrics.cpu_tlp_valid,
+            snap.metrics.missing_cpu_millis,
+            snap.nodes.capacity[:, CPU_I],
+            snap.pods.predicted_cpu_millis,
+            self.target,
+        )
+
 
 class LoadVariationRiskBalancing(Plugin):
     """Risk = (mu + margin*sigma^(1/sensitivity))/2 over cpu+memory
@@ -143,6 +159,21 @@ class LoadVariationRiskBalancing(Plugin):
             snap.nodes.alloc[:, MEMORY_I],
             snap.pods.req[p, CPU_I],
             snap.pods.req[p, MEMORY_I],
+            self.margin,
+            self.sensitivity,
+        )
+
+    def score_batch(self, state, snap):
+        """Batched risk curve (f32 broadcast stage; +/-1 rounding vs the
+        parity path at knife edges — see ops.trimaran)."""
+        if snap.metrics is None:
+            return None
+        return lvrb_score_batch(
+            snap.metrics,
+            snap.nodes.alloc[:, CPU_I],
+            snap.nodes.alloc[:, MEMORY_I],
+            snap.pods.req[:, CPU_I],
+            snap.pods.req[:, MEMORY_I],
             self.margin,
             self.sensitivity,
         )

@@ -691,3 +691,73 @@ class TestTargetedFastPathGate:
         assert not any(k[0] == "profile_batch_fast"
                        for k in sched._solve_cache)
         assert any(k[0] == "profile_batch" for k in sched._solve_cache)
+
+
+class TestSparseStragglerWaves:
+    """Regression tests for the stateful waterfill's sparse straggler waves
+    (r5 code review): cordoned nodes must stay unreachable in waves 1+, and
+    a head cohort of > straggler_cap infeasible pods must not starve
+    placeable pods behind it."""
+
+    def _solve(self, cluster, plugins):
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+        sched = Scheduler(Profile(plugins=plugins))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        assignment = np.asarray(profile_batch_solve(sched, snap)[0])
+        return {
+            p.uid: (meta.node_names[assignment[i]] if assignment[i] >= 0
+                    else None)
+            for i, p in enumerate(pending)
+        }
+
+    def _plugins(self):
+        # two scoring plugins -> generic stateful path, not the targeted
+        # single-plugin fast path
+        from scheduler_plugins_tpu.plugins import (
+            NodeResourcesAllocatable,
+            PodState,
+        )
+
+        return [NodeResourcesAllocatable(), PodState()]
+
+    def test_cordoned_node_unreachable_in_straggler_waves(self):
+        # n0 fits ONE pod; n1 is cordoned with plenty of room. Both pods
+        # choose n0 in wave 0 (only schedulable node); queue-order
+        # admission rejects the second, which retries in a sparse
+        # straggler wave — where the cordoned node must STILL be masked.
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={CPU: 1500, MEMORY: 4 * gib, PODS: 10}))
+        c.add_node(Node(name="cordoned", allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 110},
+                        unschedulable=True))
+        for name in ("a", "b"):
+            c.add_pod(Pod(uid=f"default/{name}", name=name,
+                          containers=[Container(requests={CPU: 1000})]))
+        placed = self._solve(c, self._plugins())
+        assert placed["default/a"] == "n0"
+        assert placed["default/b"] is None, placed  # NOT the cordoned node
+
+    def test_head_cohort_does_not_starve_tail_pod(self):
+        # 256+ infeasible pods at the queue head fill the straggler window;
+        # a placeable pod that lost its wave-0 queue-order collision sits
+        # behind them. The stalled sparse wave must escalate to a dense
+        # retry that places it.
+        c = Cluster()
+        # n0 scores higher under Least (smaller allocatable); fits one pod
+        c.add_node(Node(name="n0", allocatable={CPU: 1500, MEMORY: 4 * gib, PODS: 10}))
+        c.add_node(Node(name="n1", allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 110}))
+        for j in range(260):  # infeasible head cohort (> straggler_cap)
+            c.add_pod(Pod(uid=f"default/huge{j}", name=f"huge{j}", priority=100,
+                          creation_ms=j,
+                          containers=[Container(requests={CPU: 1_000_000})]))
+        for name in ("a", "b"):  # placeable tail pods, both prefer n0
+            c.add_pod(Pod(uid=f"default/{name}", name=name, priority=0,
+                          creation_ms=10_000,
+                          containers=[Container(requests={CPU: 1000})]))
+        placed = self._solve(c, self._plugins())
+        assert placed["default/a"] == "n0"
+        assert placed["default/b"] == "n1", placed  # dense retry rescued it
+        assert all(placed[f"default/huge{j}"] is None for j in range(260))

@@ -333,3 +333,53 @@ class TestTLPReferenceVectors:
     def test_missing_cache_compensation_counts(self):
         # 0% measured but 400m recently bound & unreported -> predicted 40%
         assert self._score(0, True, 0, missing=400) == 100
+
+
+class TestBatchScoreCurves:
+    """tlp_score_batch / lvrb_score_batch (the throughput path's f32
+    select+FMA stage) vs the vmapped f64 parity scores: equal everywhere
+    except round-half-away knife edges, where f32 may shift by 1."""
+
+    def _snap(self):
+        import jax.numpy as jnp
+
+        from scheduler_plugins_tpu.framework import Profile, Scheduler
+        from scheduler_plugins_tpu.models import trimaran_scenario
+        from scheduler_plugins_tpu.plugins import (
+            LoadVariationRiskBalancing,
+            TargetLoadPacking,
+        )
+
+        cluster = trimaran_scenario(n_nodes=64, n_pods=96)
+        tlp, lvrb = TargetLoadPacking(), LoadVariationRiskBalancing()
+        sched = Scheduler(Profile(plugins=[tlp, lvrb]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        state0 = sched.initial_state(snap)
+        return tlp, lvrb, snap, state0, jnp
+
+    def test_tlp_batch_within_one(self):
+        import jax
+
+        tlp, _, snap, state0, jnp = self._snap()
+        per_pod = jax.vmap(lambda p: tlp.score(state0, snap, p))(
+            jnp.arange(snap.num_pods)
+        )
+        batch = tlp.score_batch(state0, snap)
+        diff = np.abs(np.asarray(per_pod) - np.asarray(batch))
+        assert diff.max() <= 1, diff.max()
+        # knife edges are rare: the curves must agree almost everywhere
+        assert (diff > 0).mean() < 0.01
+
+    def test_lvrb_batch_within_one(self):
+        import jax
+
+        _, lvrb, snap, state0, jnp = self._snap()
+        per_pod = jax.vmap(lambda p: lvrb.score(state0, snap, p))(
+            jnp.arange(snap.num_pods)
+        )
+        batch = lvrb.score_batch(state0, snap)
+        diff = np.abs(np.asarray(per_pod) - np.asarray(batch))
+        assert diff.max() <= 1, diff.max()
+        assert (diff > 0).mean() < 0.01
