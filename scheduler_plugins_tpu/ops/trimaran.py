@@ -133,14 +133,18 @@ def lvrb_score(
 _CURVE_CHUNK = 128
 
 
-def _chunked_over_pods(curve_fn, pod_scalars, P):
-    """Apply `curve_fn((C,) pod scalars) -> (C, N)` over pod chunks via
-    lax.map; pads P to a chunk multiple and trims."""
+def _chunked_over_pods(curve_fn, pod_values, P):
+    """Apply `curve_fn((C, ...) pod rows) -> (C, N)` over pod chunks via
+    lax.map; pads axis 0 of `pod_values` (any trailing dims) to a chunk
+    multiple and trims the output."""
     import jax
 
     C = min(_CURVE_CHUNK, P)
     padded = ((P + C - 1) // C) * C
-    xs = jnp.pad(pod_scalars, (0, padded - P)).reshape(-1, C)
+    pad_widths = [(0, padded - P)] + [(0, 0)] * (pod_values.ndim - 1)
+    xs = jnp.pad(pod_values, pad_widths).reshape(
+        (-1, C) + pod_values.shape[1:]
+    )
     out = jax.lax.map(curve_fn, xs)  # (P//C, C, N)
     return out.reshape(padded, -1)[:P]
 
@@ -253,12 +257,7 @@ def lvrb_score_batch(
         )
         return _round_half_away_f32(total)
 
-    import jax
-
-    C = min(_CURVE_CHUNK, P)
-    padded = ((P + C - 1) // C) * C
-    xs = jnp.pad(pods2, ((0, padded - P), (0, 0))).reshape(-1, C, 2)
-    return jax.lax.map(curve, xs).reshape(padded, -1)[:P]
+    return _chunked_over_pods(curve, pods2, P)
 
 
 # ---------------------------------------------------------------------------

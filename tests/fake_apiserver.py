@@ -57,6 +57,29 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._serve_list(server, path)
 
+    def do_POST(self):
+        """Record POSTed subresources (pod bindings) — asserted by the
+        daemon e2e test; the binding POST is the reference scheduler's
+        bind process boundary (SURVEY.md §3.2)."""
+        server: FakeApiServer = self.server  # type: ignore[assignment]
+        if server.expected_token:
+            auth = self.headers.get("Authorization", "")
+            if auth != f"Bearer {server.expected_token}":
+                self.send_response(401)
+                self.end_headers()
+                return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            payload = {"raw": body.decode("utf-8", "replace")}
+        with server.lock:
+            server.posts.append((self.path, payload))
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def _serve_list(self, server, path):
         listing = server.lists.get(path)
         if listing is None:
@@ -110,6 +133,7 @@ class FakeApiServer:
         self.watch_scripts: dict[str, list] = {}
         self.watch_requests: dict[str, list] = {}
         self.requests: list[str] = []
+        self.posts: list[tuple[str, dict]] = []
         self.expected_token = expected_token
         self.lock = threading.Lock()
         self._httpd = None
@@ -121,6 +145,7 @@ class FakeApiServer:
         httpd.watch_scripts = self.watch_scripts  # type: ignore[attr-defined]
         httpd.watch_requests = self.watch_requests  # type: ignore[attr-defined]
         httpd.requests = self.requests  # type: ignore[attr-defined]
+        httpd.posts = self.posts  # type: ignore[attr-defined]
         httpd.expected_token = self.expected_token  # type: ignore[attr-defined]
         httpd.lock = self.lock  # type: ignore[attr-defined]
         self._httpd = httpd

@@ -761,3 +761,61 @@ class TestSparseStragglerWaves:
         assert placed["default/a"] == "n0"
         assert placed["default/b"] == "n1", placed  # dense retry rescued it
         assert all(placed[f"default/huge{j}"] is None for j in range(260))
+
+
+class TestTwoProcessDistributed:
+    """A REAL 2-process jax.distributed run (VERDICT r4 item 5): two forked
+    interpreters join one coordinator, host 0 owns the snapshot,
+    `broadcast_snapshot` + `distributed_solve` replicate the result — and
+    placements must equal the single-process solve of host 0's snapshot
+    (host 1's copy is deliberately corrupted pre-broadcast)."""
+
+    def test_two_processes_match_single_process(self, tmp_path):
+        import os
+        import socket
+        import subprocess
+        import sys
+        import json as _json
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with socket.socket() as s:  # free coordinator port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env["PYTHONPATH"] = repo
+        procs, outs = [], []
+        for pid in range(2):
+            out = tmp_path / f"host{pid}.json"
+            outs.append(out)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(repo, "tests", "multihost_child.py"),
+                 str(pid), str(port), str(out)],
+                cwd=repo, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        errs = []
+        for p in procs:
+            try:
+                _, err = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _, err = p.communicate()
+            errs.append(err)
+        assert all(p.returncode == 0 for p in procs), errs
+        results = [_json.loads(o.read_text()) for o in outs]
+        assert all(r["processes"] == 2 and r["devices"] == 8 for r in results)
+        # both hosts hold the SAME replicated assignment
+        assert results[0]["assignment"] == results[1]["assignment"]
+
+        # ... and it matches the single-process solve of host 0's snapshot
+        # (ONE source of truth: the children's own construction)
+        from tests.multihost_child import build_snapshot
+
+        snap, meta = build_snapshot()
+        weights = jnp.asarray(
+            meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
+        local, _, _ = solve(snap, weights)
+        assert results[0]["assignment"] == np.asarray(local).tolist()
+        placed = sum(1 for a in results[0]["assignment"] if a >= 0)
+        assert placed == 32
