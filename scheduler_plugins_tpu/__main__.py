@@ -70,6 +70,9 @@ def parse_args(argv=None):
                          "without it the daemon is feed-driven only)")
     ap.add_argument("--token-file", default=None,
                     help="bearer token file for --apiserver")
+    ap.add_argument("--ca-file", default=None,
+                    help="CA bundle to trust for --apiserver TLS "
+                         "(in-cluster: the serviceaccount ca.crt)")
     ap.add_argument("--insecure-skip-verify", action="store_true")
     ap.add_argument("--watch-paths", default=None,
                     help="comma-separated resource paths to watch "
@@ -194,16 +197,31 @@ class Daemon:
             self.args.apiserver, path,
             token=self.token,
             insecure_skip_verify=self.args.insecure_skip_verify,
+            ca_file=self.args.ca_file,
             max_failures=None,  # the daemon retries for its lifetime
         )
 
-    def _post_binding(self, uid: str, node: str):
+    def _ssl_context(self):
+        import ssl
+
+        if not self.args.apiserver.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(cafile=self.args.ca_file)
+        if self.args.insecure_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def _post_binding(self, uid: str, node: str) -> bool:
         """POST the upstream Binding shape back to the apiserver
-        (the bind goroutine's process boundary, SURVEY.md §3.2)."""
+        (the bind goroutine's process boundary, SURVEY.md §3.2). Returns
+        True when the retry-queue entry should be dropped — success, or a
+        pod that no longer exists in the store (deleted since binding:
+        nothing left to bind)."""
         with self.feed.locked():
             pod = self.cluster.pods.get(uid)
             if pod is None:
-                return
+                return True
             ns, name = pod.namespace, pod.name
         url = (f"{self.args.apiserver.rstrip('/')}"
                f"/api/v1/namespaces/{ns}/pods/{name}/binding")
@@ -219,7 +237,9 @@ class Daemon:
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            urllib.request.urlopen(req, timeout=10).close()
+            urllib.request.urlopen(
+                req, timeout=3, context=self._ssl_context()
+            ).close()
         except Exception as exc:
             obs.logger.warning("binding POST failed for %s: %s", uid, exc)
             return False
@@ -238,11 +258,19 @@ class Daemon:
             # the local store binds immediately; the apiserver POST is the
             # process boundary and can fail transiently — keep unacked
             # bindings in a retry queue until the POST lands (the local
-            # pod is no longer pending, so no re-schedule would re-emit it)
+            # pod is no longer pending, so no re-schedule would re-emit
+            # it). Retries are capped per tick: during an apiserver
+            # outage each attempt burns its connect timeout, and the
+            # scheduling loop must keep its cadence
             self._unposted.update(report.bound)
+            failures = 0
             for uid, node in list(self._unposted.items()):
+                if failures >= 2:  # outage: stop burning connect timeouts
+                    break
                 if self._post_binding(uid, node):
                     del self._unposted[uid]
+                else:
+                    failures += 1
         self.cycles += 1
         self.bound_total += len(report.bound)
         return report

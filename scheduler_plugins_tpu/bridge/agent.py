@@ -581,6 +581,7 @@ class ClusterAgent:
     # -- live mode -----------------------------------------------------
     def list_then_watch(self, apiserver: str, path: str, token: str = "",
                         insecure_skip_verify: bool = False,
+                        ca_file: Optional[str] = None,
                         max_events: Optional[int] = None,
                         max_failures: Optional[int] = 8,
                         backoff_base_s: float = 0.25,
@@ -631,7 +632,9 @@ class ClusterAgent:
                 req.add_header("Authorization", f"Bearer {token}")
             ctx = None
             if url.startswith("https"):
-                ctx = ssl.create_default_context()
+                # `ca_file` trusts a private CA (in-cluster: the
+                # serviceaccount ca.crt) without disabling verification
+                ctx = ssl.create_default_context(cafile=ca_file)
                 if insecure_skip_verify:
                     # public-API equivalent of the old private
                     # _create_unverified_context

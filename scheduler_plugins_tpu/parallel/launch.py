@@ -93,22 +93,22 @@ def make_multihost_mesh() -> jax.sharding.Mesh:
     if n_proc <= 1:
         return make_mesh()
     per_host = jax.local_device_count()
+    from jax.experimental import mesh_utils
+
     try:
         # TPU pod slices: hybrid mesh for the best ICI ordering per host
-        from jax.experimental import mesh_utils
-
         grid = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(1, per_host),  # within a host: all chips on "nodes"
             dcn_mesh_shape=(n_proc, 1),  # across hosts: "pods"
         )
     except ValueError:
         # backends without slice topology info (multi-process CPU — the
-        # 2-process test tier): the process boundary IS the DCN boundary,
-        # so group devices by process explicitly
-        import numpy as _np
-
-        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-        grid = _np.array(devs).reshape(n_proc, per_host)
+        # 2-process test tier): the process boundary IS the DCN boundary
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, per_host),
+            dcn_mesh_shape=(n_proc, 1),
+            process_is_granule=True,
+        )
     return jax.sharding.Mesh(grid, (PODS_AXIS, NODES_AXIS))
 
 

@@ -140,3 +140,56 @@ class TestDaemonE2E:
         assert proc.returncode == 0, proc.stderr
         summary = json.loads(proc.stdout.strip().splitlines()[-1])
         assert summary["daemon_exit"] and summary["cycles"] == 3
+
+
+class TestComposeDemoRecipe:
+    """The deploy/docker-compose.yaml wiring, minus docker: the demo
+    control plane (tools/demo_apiserver.py) + the daemon subprocess with
+    the exact compose service arguments must bind the whole demo
+    workload."""
+
+    def test_demo_workload_fully_bound(self, tmp_path):
+        sys.path.insert(0, REPO)
+        from tools.demo_apiserver import DemoApiServer
+
+        srv = DemoApiServer("127.0.0.1", 0, n_nodes=4, n_pods=12)
+        srv.start_background()
+        try:
+            # the exact profile the compose demo mounts
+            profile = tmp_path / "profile.yaml"
+            with open(os.path.join(REPO, "deploy", "profile.yaml")) as f:
+                profile.write_text(f.read())
+            env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+            host, port = srv.address
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "scheduler_plugins_tpu",
+                 "--profile", str(profile),
+                 "--apiserver", f"http://{host}:{port}",
+                 "--watch-paths", "/api/v1/nodes,/api/v1/pods",
+                 "--bind-back", "--cycle-interval-s", "0.2",
+                 "--health-port", "-1"],
+                cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            try:
+                ready = proc.stdout.readline()
+                assert ready.startswith("daemon ready "), ready
+
+                def all_bound():
+                    with srv.lock:
+                        return len(srv.bindings) >= 12
+
+                assert _wait(all_bound, timeout=60), (
+                    srv.bindings, proc.stderr.read() if proc.poll() else "")
+                with srv.lock:
+                    assert all(node.startswith("demo-node-")
+                               for node in srv.bindings.values())
+                proc.send_signal(signal.SIGTERM)
+                _, err = proc.communicate(timeout=30)
+                assert proc.returncode == 0, err
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.communicate()
+        finally:
+            srv.stop()

@@ -1,0 +1,155 @@
+"""Standalone demo control plane for the compose/packaging recipe.
+
+A stdlib HTTP server speaking just enough kube-apiserver: LIST endpoints
+for nodes and pending pods (synthetic demo workload), WATCH endpoints that
+hold the stream open with periodic BOOKMARKs, and the pod `binding`
+subresource POST — which it logs and records, flipping the pod to bound so
+a relist converges. The deploy/docker-compose.yaml demo points the
+scheduler daemon at this process; `docker compose logs demo-apiserver`
+then shows every binding the scheduler made.
+
+Usage: python tools/demo_apiserver.py [--port 8001] [--nodes 8] [--pods 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def make_state(n_nodes: int, n_pods: int):
+    nodes = [{
+        "kind": "Node", "apiVersion": "v1",
+        "metadata": {"name": f"demo-node-{i}", "uid": f"node-{i}",
+                     "resourceVersion": str(10 + i),
+                     "labels": {"topology.kubernetes.io/zone": f"z{i % 2}"}},
+        "status": {"allocatable": {"cpu": "8", "memory": "32Gi",
+                                   "pods": "110"}},
+    } for i in range(n_nodes)]
+    pods = [{
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": f"demo-pod-{j}", "namespace": "default",
+                     "uid": f"pod-{j}",
+                     "resourceVersion": str(100 + j)},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {
+            "cpu": f"{250 + 50 * (j % 4)}m", "memory": "512Mi"}}}]},
+        "status": {"phase": "Pending"},
+    } for j in range(n_pods)]
+    return nodes, pods
+
+
+class DemoApiServer:
+    def __init__(self, host: str, port: int, n_nodes: int, n_pods: int):
+        self.lock = threading.Lock()
+        self.nodes, self.pods = make_state(n_nodes, n_pods)
+        self.bindings: dict[str, str] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, payload, code=200):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                watching = parse_qs(parsed.query).get(
+                    "watch", ["0"])[0] in ("1", "true")
+                if watching:
+                    return self._watch()
+                with outer.lock:
+                    if parsed.path == "/api/v1/nodes":
+                        items = list(outer.nodes)
+                        kind = "NodeList"
+                    elif parsed.path == "/api/v1/pods":
+                        items = [p for p in outer.pods
+                                 if p["metadata"]["uid"]
+                                 not in outer.bindings]
+                        kind = "PodList"
+                    else:
+                        return self._json({"kind": "Status", "code": 404},
+                                          code=404)
+                self._json({"kind": kind, "apiVersion": "v1",
+                            "metadata": {"resourceVersion": "1000"},
+                            "items": items})
+
+            def _watch(self):
+                # hold the stream open with periodic bookmarks; the
+                # reflector resumes from them after any disconnect
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                try:
+                    for k in range(3600):
+                        time.sleep(10)
+                        self.wfile.write((json.dumps({
+                            "type": "BOOKMARK",
+                            "object": {"kind": "Pod", "metadata": {
+                                "resourceVersion": str(2000 + k)}},
+                        }) + "\n").encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.endswith("/binding"):
+                    name = self.path.rsplit("/pods/", 1)[1].split("/")[0]
+                    node = body.get("target", {}).get("name", "?")
+                    with outer.lock:
+                        for p in outer.pods:
+                            if p["metadata"]["name"] == name:
+                                outer.bindings[p["metadata"]["uid"]] = node
+                    print(f"BOUND {name} -> {node}", flush=True)
+                    self.send_response(201)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+
+    def serve_forever(self):
+        print(f"demo apiserver on http://{self.address[0]}:{self.address[1]} "
+              f"({len(self.nodes)} nodes, {len(self.pods)} pending pods)",
+              flush=True)
+        self._httpd.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8001)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--pods", type=int, default=24)
+    args = ap.parse_args(argv)
+    DemoApiServer(args.host, args.port, args.nodes, args.pods).serve_forever()
+
+
+if __name__ == "__main__":
+    main()
