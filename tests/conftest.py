@@ -32,7 +32,9 @@ def raw_plugin_scores(cluster, sched, pod):
     sched.prepare(meta, cluster)
     plugin = sched.profile.plugins[0]
     plugin.bind_aux(plugin.aux())
-    plugin.bind_presolve(None)
+    # bind the per-solve precompute exactly as the solvers do
+    # (framework/runtime + parallel/solver both prepare_solve first)
+    plugin.bind_presolve(plugin.prepare_solve(snap))
     state = sched.initial_state(snap)
     i = meta.pod_names.index(pod.uid)
     return np.asarray(plugin.score(state, snap, i)), meta

@@ -368,3 +368,74 @@ class TestF32Packing:
         c = self._mixed_cluster(odd_memory=True)
         _, snap = self._solve(c)
         assert snap.numa.pack_scales is None
+
+
+class TestReferenceScoreGoldens:
+    """Exact score values from score_test.go TestNodeResourceScorePlugin
+    (:110-145 — Most=70@Node2, Balanced=100@Node3, Least=73@Node1 on the
+    defaultNUMANodes fixture :643-705) and
+    TestNodeResourceScorePluginLeastNUMA container-scope cases (:196-250 —
+    normalizeScore = 100 - zones*12 (+6 at optimal distance),
+    least_numa.go:91-100)."""
+
+    MI = 1 << 20
+
+    def _fixture(self, policy):
+        # Node1: 2 zones x (4 cores, 500Mi); Node2: 2 x (2, 50Mi);
+        # Node3: 2 x (6, 60Mi)
+        return cluster_with([
+            nrt("Node1", [{CPU: 4000, MEMORY: 500 * self.MI}] * 2, policy=policy),
+            nrt("Node2", [{CPU: 2000, MEMORY: 50 * self.MI}] * 2, policy=policy),
+            nrt("Node3", [{CPU: 6000, MEMORY: 60 * self.MI}] * 2, policy=policy),
+        ])
+
+    def _scores(self, cluster, pod, strategy):
+        from tests.conftest import raw_plugin_scores
+
+        cluster.add_pod(pod)
+        sched = Scheduler(Profile(
+            plugins=[NodeResourceTopologyMatch(scoring_strategy=strategy)]
+        ))
+        raw, meta = raw_plugin_scores(cluster, sched, pod)
+        return {meta.node_names[i]: int(raw[i])
+                for i in range(len(meta.node_names))}
+
+    def _pod(self, cpu, mem):
+        return guaranteed_pod("p1", cpu, mem)
+
+    def test_most_allocated_node2_is_70(self):
+        # cpu 2/2 = 100%, mem 20M/50Mi = 40% -> (100+40)/2 = 70
+        s = self._scores(
+            self._fixture(TopologyManagerPolicy.SINGLE_NUMA_NODE),
+            self._pod(2000, 20 * 1024 * 1024), "MostAllocated")
+        assert s["Node2"] == 70
+        assert max(s, key=s.get) == "Node2"
+
+    def test_least_allocated_node1_is_73(self):
+        # cpu (4-2)/4 = 50, mem (500Mi-20M)/500Mi = 96 -> (50+96)/2 = 73
+        s = self._scores(
+            self._fixture(TopologyManagerPolicy.SINGLE_NUMA_NODE),
+            self._pod(2000, 20 * 1024 * 1024), "LeastAllocated")
+        assert s["Node1"] == 73
+        assert max(s, key=s.get) == "Node1"
+
+    def test_balanced_allocation_node3_is_100(self):
+        # cpu 2/6 = mem 20M/60Mi = 1/3 -> variance 0 -> 100
+        s = self._scores(
+            self._fixture(TopologyManagerPolicy.SINGLE_NUMA_NODE),
+            self._pod(2000, 20 * 1024 * 1024), "BalancedAllocation")
+        assert s["Node3"] == 100
+        assert max(s, key=s.get) == "Node3"
+
+    def test_least_numa_one_container_cases(self):
+        # normalizeScore: 100 - zones*(100//8) + (100//8)//2 at optimal
+        # distance -> one zone 94, two zones 82, no fit 0
+        for cpu, want in (
+            (2000, {"Node1": 94, "Node2": 94, "Node3": 94}),
+            (4000, {"Node1": 94, "Node2": 82, "Node3": 94}),
+            (6000, {"Node1": 82, "Node2": 0, "Node3": 94}),
+        ):
+            s = self._scores(
+                self._fixture(TopologyManagerPolicy.BEST_EFFORT),
+                self._pod(cpu, 50 * self.MI), "LeastNUMANodes")
+            assert {k: s[k] for k in want} == want, (cpu, s)

@@ -578,3 +578,76 @@ class TestCandidateSampling:
                     "args": {"minCandidateNodesPercentage": 200},
                 }],
             })
+
+
+class TestTolerationPolicyParseCorners:
+    """Annotation-parse decision table mirroring
+    preemption_toleration_policy_test.go:26-105 — the policy corners the
+    `exempted()` predicate must reproduce (default values, unparsable
+    ints, negative toleration)."""
+
+    def _exempted(self, annotations, pc_value=1, preemptor_priority=50,
+                  now_ms=5_000, victim_created=0):
+        from scheduler_plugins_tpu.framework.preemption import (
+            PreemptionEngine,
+            PreemptionMode,
+        )
+
+        cluster = Cluster()
+        cluster.add_priority_class(PriorityClass(
+            name="pc", value=pc_value, annotations=annotations))
+        victim = mkpod("victim", 100, priority=pc_value, node="n0", pc="pc",
+                       created=victim_created)
+        preemptor = mkpod("claimant", 100, priority=preemptor_priority)
+        engine = PreemptionEngine(PreemptionMode.DEFAULT, toleration=True)
+        return engine.exempted(victim, preemptor, cluster, now_ms)
+
+    def test_default_values_no_annotations(self):
+        # reference parse defaults: MinimumPreemptablePriority = value+1,
+        # TolerationSeconds = 0. Exercise a preemptor BELOW that default
+        # threshold (priority 50 < value 100 + 1): the zero-second window
+        # has always elapsed for a scheduled victim, so still not exempt —
+        # which is why the implementation's missing-annotation
+        # short-circuit (framework/preemption.py) is behaviorally
+        # equivalent for engine victims (always scheduled/bound)
+        assert self._exempted({}, pc_value=100,
+                              preemptor_priority=50) is False
+
+    def test_both_values_in_window(self):
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "100",
+            ANNOTATION_TOLERATION_SECONDS: "10",
+        }, now_ms=5_000) is True
+
+    def test_both_values_window_elapsed(self):
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "100",
+            ANNOTATION_TOLERATION_SECONDS: "10",
+        }, now_ms=20_000) is False
+
+    def test_unparsable_minimum_preemptable_means_no_toleration(self):
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "a",
+            ANNOTATION_TOLERATION_SECONDS: "-1",
+        }) is False
+
+    def test_unparsable_toleration_seconds_poisons_whole_policy(self):
+        # the reference parses the policy as a unit: one bad int means NO
+        # toleration even though MinimumPreemptablePriority alone would
+        # have spared the victim
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "100",
+            ANNOTATION_TOLERATION_SECONDS: "a",
+        }) is False
+
+    def test_negative_toleration_tolerates_forever(self):
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "100",
+            ANNOTATION_TOLERATION_SECONDS: "-1",
+        }, now_ms=10**12) is True
+
+    def test_preemptor_at_threshold_not_exempt(self):
+        assert self._exempted({
+            ANNOTATION_MIN_PREEMPTABLE: "100",
+            ANNOTATION_TOLERATION_SECONDS: "-1",
+        }, preemptor_priority=100) is False
