@@ -21,10 +21,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def raw_plugin_scores(cluster, sched, pod):
-    """Drive ONE pending pod through a single-plugin profile up to the raw
-    (un-normalized) per-node Score vector — the unit-level harness several
-    decision-table suites share. Returns (scores ndarray, meta)."""
+def _eval_plugin(cluster, sched, pod, method):
+    """Drive ONE pending pod through a single-plugin profile up to a raw
+    per-node plugin vector (Score or Filter) — the unit-level harness the
+    decision-table suites share, binding aux/presolve exactly as the
+    solvers do (framework/runtime + parallel/solver both prepare_solve
+    first). Returns (vector ndarray, meta)."""
     import numpy as np
 
     pending = sched.sort_pending(cluster.pending_pods(), cluster)
@@ -32,9 +34,17 @@ def raw_plugin_scores(cluster, sched, pod):
     sched.prepare(meta, cluster)
     plugin = sched.profile.plugins[0]
     plugin.bind_aux(plugin.aux())
-    # bind the per-solve precompute exactly as the solvers do
-    # (framework/runtime + parallel/solver both prepare_solve first)
     plugin.bind_presolve(plugin.prepare_solve(snap))
     state = sched.initial_state(snap)
     i = meta.pod_names.index(pod.uid)
-    return np.asarray(plugin.score(state, snap, i)), meta
+    return np.asarray(getattr(plugin, method)(state, snap, i)), meta
+
+
+def raw_plugin_scores(cluster, sched, pod):
+    """Raw (un-normalized) per-node Score vector for one pending pod."""
+    return _eval_plugin(cluster, sched, pod, "score")
+
+
+def raw_plugin_filter(cluster, sched, pod):
+    """(N,) Filter verdicts for one pending pod."""
+    return _eval_plugin(cluster, sched, pod, "filter")

@@ -439,3 +439,135 @@ class TestReferenceScoreGoldens:
                 self._fixture(TopologyManagerPolicy.BEST_EFFORT),
                 self._pod(cpu, 50 * self.MI), "LeastNUMANodes")
             assert {k: s[k] for k in want} == want, (cpu, s)
+
+
+class TestReferenceFilterVectors:
+    """Device/extended-resource Filter decision table ported from
+    filter_test.go (:60-610): zone-reported device resources constrain
+    ALL QoS classes (only cpu/memory/hugepages are skipped for
+    non-guaranteed pods, numaresources.go:137-142); host-level extended
+    resources unreported by any zone bypass NUMA affinity; zero-quantity
+    requests are ignored."""
+
+    NIC = "vendor/nic1"
+    NIC_HOST = "vendor.com/old-nic-model"
+    EXT = "namespace/extended"
+    HP = "hugepages-2Mi"
+    MI = 1 << 20
+
+    def _cluster(self):
+        c = Cluster()
+
+        def add(name, zones, scope, extra_alloc=None, zone_cap_cpu=50_000):
+            alloc = {CPU: zone_cap_cpu, MEMORY: 16 * gib, PODS: 110}
+            for z in zones:
+                for r, q in z.items():
+                    if r not in (CPU, MEMORY):
+                        # node-level allocatable must cover the zone's
+                        # availability; 6x is arbitrary headroom (the
+                        # reference's zone CAPACITY exceeds available too)
+                        alloc[r] = alloc.get(r, 0) + 6 * q
+            alloc.update(extra_alloc or {})
+            c.add_node(Node(name=name, allocatable=alloc))
+            c.add_nrt(nrt(name, zones, scope=scope))
+
+        # node1 (container scope): cpu 4/8 cores, mem 8Gi/8Gi, nic 10/10
+        add("node1", [
+            {CPU: 4000, MEMORY: 8 * gib, self.NIC: 10},
+            {CPU: 8000, MEMORY: 8 * gib, self.NIC: 10},
+        ], TopologyManagerScope.CONTAINER)
+        # node2 (container): cpu 2/4, mem 4Gi/4Gi, hugepages 128Mi/128Mi,
+        # nic 5/2; plus a host-level (zone-unreported) old nic model
+        add("node2", [
+            {CPU: 2000, MEMORY: 4 * gib, self.HP: 128 * self.MI, self.NIC: 5},
+            {CPU: 4000, MEMORY: 4 * gib, self.HP: 128 * self.MI, self.NIC: 2},
+        ], TopologyManagerScope.CONTAINER, extra_alloc={self.NIC_HOST: 4})
+        # node3 (pod scope): cpu 2/4, mem 4Gi/4Gi, nic 5/2
+        add("node3", [
+            {CPU: 2000, MEMORY: 4 * gib, self.NIC: 5},
+            {CPU: 4000, MEMORY: 4 * gib, self.NIC: 2},
+        ], TopologyManagerScope.POD)
+        # "extended" node (container): nic 10/10 + host-level extended=1
+        add("extended", [
+            {CPU: 4000, MEMORY: 8 * gib, self.NIC: 10},
+            {CPU: 8000, MEMORY: 8 * gib, self.NIC: 10},
+        ], TopologyManagerScope.CONTAINER, extra_alloc={self.EXT: 1})
+        return c
+
+    def _verdicts(self, pod):
+        from tests.conftest import raw_plugin_filter
+
+        c = self._cluster()
+        c.add_pod(pod)
+        sched = Scheduler(Profile(plugins=[NodeResourceTopologyMatch()]))
+        v, meta = raw_plugin_filter(c, sched, pod)
+        return {meta.node_names[i]: bool(v[i])
+                for i in range(len(meta.node_names))}
+
+    def _pod(self, requests, limits=None):
+        return Pod(name="p", containers=[
+            Container(requests=requests, limits=limits or {})])
+
+    def test_best_effort_empty_pod_fits_everywhere(self):
+        v = self._verdicts(self._pod({}))
+        assert all(v.values()), v
+
+    def test_device_only_pod_scope(self):
+        # nic 5 fits node3's zone-0 exactly; nic 20 fits no zone anywhere
+        assert self._verdicts(self._pod({self.NIC: 5}))["node3"] is True
+        v = self._verdicts(self._pod({self.NIC: 20}))
+        assert v["node3"] is False and v["node1"] is False, v
+
+    def test_device_only_container_scope(self):
+        assert self._verdicts(self._pod({self.NIC: 5}))["node2"] is True
+        assert self._verdicts(self._pod({self.NIC: 20}))["node1"] is False
+
+    def test_host_level_extended_bypasses_numa(self):
+        # extended=1 is allocatable at node level but reported by no zone:
+        # host-level bypass; the zone-reported nic still constrains
+        v = self._verdicts(self._pod({self.EXT: 1, self.NIC: 10}))
+        assert v["extended"] is True, v
+
+    def test_burstable_devices_not_enough_container_scope(self):
+        # cpu/mem skipped for non-guaranteed, but nic 11 > max zone 5
+        v = self._verdicts(self._pod(
+            {CPU: 3000, MEMORY: 3 * gib, self.NIC: 11},
+            {CPU: 4000, MEMORY: 4 * gib, self.NIC: 11}))
+        assert v["node2"] is False
+
+    def test_burstable_devices_not_enough_pod_scope(self):
+        v = self._verdicts(self._pod(
+            {CPU: 1000, MEMORY: 1 * gib, self.NIC: 6},
+            {CPU: 2000, MEMORY: 2 * gib, self.NIC: 6}))
+        assert v["node3"] is False
+
+    def test_burstable_cpu_exceeds_zone_but_devices_fit(self):
+        # THE key non-guaranteed semantics: 19 cores dwarf every zone but
+        # cpu is NUMA-affine-skipped for burstable; nic 5 fits zone 0
+        v = self._verdicts(self._pod(
+            {CPU: 19_000, MEMORY: 5 * gib, self.NIC: 5},
+            {CPU: 20_000, MEMORY: 6 * gib, self.NIC: 5}))
+        assert v["node3"] is True
+        v = self._verdicts(self._pod(
+            {CPU: 5000, MEMORY: 5 * gib, self.NIC: 5},
+            {CPU: 6000, MEMORY: 6 * gib, self.NIC: 5}))
+        assert v["node2"] is True
+
+    def test_guaranteed_minimal_and_zone_saturating(self):
+        g = lambda req: self._pod(req, req)
+        assert self._verdicts(g({CPU: 2000, MEMORY: 2 * gib}))["node1"] is True
+        # exactly zone 1's availability
+        assert self._verdicts(g({CPU: 8000, MEMORY: 8 * gib}))["node1"] is True
+
+    def test_guaranteed_zero_quantity_of_absent_resource_ignored(self):
+        g = self._pod(
+            {CPU: 2000, MEMORY: 2 * gib, self.HP: 0, self.NIC: 3},
+            {CPU: 2000, MEMORY: 2 * gib, self.HP: 0, self.NIC: 3})
+        assert self._verdicts(g)["node1"] is True
+
+    def test_guaranteed_hugepages(self):
+        g = lambda hp: self._pod(
+            {CPU: 1000, MEMORY: 1 * gib, self.HP: hp},
+            {CPU: 1000, MEMORY: 1 * gib, self.HP: hp})
+        assert self._verdicts(g(64 * self.MI))["node2"] is True
+        assert self._verdicts(g(256 * self.MI))["node2"] is False
