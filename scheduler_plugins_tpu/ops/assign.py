@@ -406,9 +406,10 @@ def waterfill_assign_stateful(
     return assignment, free, state
 
 
-@partial(jax.jit, static_argnames=("max_waves",))
+@partial(jax.jit, static_argnames=("max_waves", "rescue_window"))
 def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
-                              max_waves: int = 8):
+                              max_waves: int = 8,
+                              rescue_window: int = 512):
     """Waterfill for STATIC per-node scores (the allocatable flagship and the
     north-star scale): per wave, each active pod checks fit against ONE
     target node — the capacity-bucket choice — in O(P·R) gathers, never
@@ -475,10 +476,13 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
         # lite misses prove nothing about true feasibility: no hopeless delta
         return jnp.where(active & fit, target, -1), jnp.zeros(P, bool)
 
-    #: rescue-wave window: dense feasibility is computed for at most this
-    #: many stragglers at a time ((K, N) work instead of (P, N); the wave
-    #: loop drains K per wave when more remain)
-    K = min(P, 512)
+    # rescue-wave window: dense feasibility is computed for at most this
+    # many stragglers at a time ((K, N) work instead of (P, N); the wave
+    # loop drains K per wave when more remain). Full-phase completeness
+    # capacity is max_waves * K placements-or-retires — callers trading
+    # window size for throughput (the north-star chunk loop passes 256,
+    # halving its dominant (K, N) cumsum cost) shrink that ceiling too
+    K = min(P, rescue_window)
 
     def full_choice(free, active):
         # dense rescue wave: straggler k takes the (k mod |feasible_k|)-th
