@@ -65,6 +65,26 @@ def tlp_score(
     return jnp.where(cpu_valid, score, 0).astype(jnp.int64)
 
 
+def _root_power(sigma, sensitivity):
+    """sigma^(1/sensitivity) with Go math.Pow parity: Pow special-cases
+    y == 0.5 to Sqrt (and y == 1/2-integer cases reduce exactly), which can
+    differ from a generic pow by 1 ulp — enough to flip an int truncation
+    at a score boundary (caught by the analysis_test.go vectors). Negative
+    sensitivity skips the root (analysis.go:48-50); 0 means Pow(x, +Inf)."""
+    if sensitivity == 0:
+        return jnp.where(sigma >= 1.0, 1.0, 0.0)
+    if sensitivity < 0:
+        return sigma
+    exponent = 1.0 / sensitivity
+    if exponent == 1.0:
+        return sigma
+    if exponent == 0.5:
+        return jnp.sqrt(sigma)
+    if exponent == 2.0:
+        return sigma * sigma
+    return jnp.power(sigma, exponent)
+
+
 def _risk_component(avg_pct, std_pct, capacity, req, margin, sensitivity):
     """computeScore (analysis.go:41-69) in [0, 100], float64."""
     cap = capacity.astype(jnp.float64)
@@ -73,11 +93,7 @@ def _risk_component(avg_pct, std_pct, capacity, req, margin, sensitivity):
     req = jnp.maximum(jnp.asarray(req, jnp.float64), 0.0)
     mu = jnp.clip((used + req) / jnp.maximum(cap, 1.0), 0.0, 1.0)
     sigma = jnp.clip(stdev / jnp.maximum(cap, 1.0), 0.0, 1.0)
-    if sensitivity == 0:
-        # Go semantics: 1/0 = +Inf, Pow(sigma, +Inf) = 0 for sigma < 1, 1 at 1
-        sigma = jnp.where(sigma >= 1.0, 1.0, 0.0)
-    elif sensitivity > 0:
-        sigma = jnp.power(sigma, 1.0 / sensitivity)
+    sigma = _root_power(sigma, sensitivity)
     sigma = jnp.clip(sigma * margin, 0.0, 1.0)
     risk = (mu + sigma) / 2.0
     score = (1.0 - risk) * MAX_SCORE
@@ -203,10 +219,7 @@ def _risk_curve_coeffs(avg_pct, std_pct, capacity, margin, sensitivity):
     used = jnp.clip(avg_pct / 100.0 * cap, 0.0, cap)
     stdev = jnp.clip(std_pct / 100.0 * cap, 0.0, cap)
     sigma = jnp.clip(stdev / jnp.maximum(cap, 1.0), 0.0, 1.0)
-    if sensitivity == 0:
-        sigma = jnp.where(sigma >= 1.0, 1.0, 0.0)
-    elif sensitivity > 0:
-        sigma = jnp.power(sigma, 1.0 / sensitivity)
+    sigma = _root_power(sigma, sensitivity)
     sigma = jnp.clip(sigma * margin, 0.0, 1.0)
     inv = (1.0 / jnp.maximum(cap, 1.0)).astype(jnp.float32)
     used32 = used.astype(jnp.float32)

@@ -383,3 +383,51 @@ class TestBatchScoreCurves:
         diff = np.abs(np.asarray(per_pod) - np.asarray(batch))
         assert diff.max() <= 1, diff.max()
         assert (diff > 0).mean() < 0.01
+
+
+class TestComputeScoreReferenceVectors:
+    """analysis_test.go TestComputeScore (:30-160) ported against
+    `_risk_component` (the computeScore mirror): input clamping (negative/
+    over-capacity usage and stdev), negative margin clamps sigma to 0,
+    NEGATIVE sensitivity skips the root entirely (analysis.go:48-50),
+    sensitivity 0 = Pow(sigma, +Inf)."""
+
+    def _score(self, avg, std, margin=1.0, sensitivity=1.0, cap=100,
+               req=10):
+        from scheduler_plugins_tpu.ops.trimaran import _risk_component
+        from scheduler_plugins_tpu.utils.intmath import round_half_away
+
+        s = _risk_component(
+            jnp.asarray([float(avg)]), jnp.asarray([float(std)]),
+            jnp.asarray([cap]), req, margin, sensitivity,
+        )
+        # the reference test compares int64(math.Round(score)) — and the
+        # plugin's NodeScore is round_half_away(score) too
+        return int(np.asarray(round_half_away(s))[0])
+
+    def test_valid_data(self):
+        assert self._score(40, 36, 1, 1) == 57
+
+    def test_zero_capacity(self):
+        assert self._score(40, 36, 1, 2, cap=0) == 0
+
+    def test_negative_used_avg_clamped(self):
+        assert self._score(-40, 36, 1, 2) == 65
+
+    def test_large_used_avg_clamped(self):
+        assert self._score(200, 36, 1, 2) == 20
+
+    def test_negative_used_stdev_clamped(self):
+        assert self._score(40, -36, 1, 2) == 75
+
+    def test_large_used_stdev_clamped(self):
+        assert self._score(40, 120, 1, 2) == 25
+
+    def test_negative_margin_clamps_sigma_to_zero(self):
+        assert self._score(40, 36, -1, 1) == 75
+
+    def test_negative_sensitivity_skips_root(self):
+        assert self._score(40, 36, 1, -1) == 57
+
+    def test_zero_sensitivity_power_infinity(self):
+        assert self._score(40, 36, 1, 0) == 75
