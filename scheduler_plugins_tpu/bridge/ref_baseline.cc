@@ -141,7 +141,9 @@ int64_t ref_seq_trimaran(int64_t N, int64_t P, int64_t R,
       double mu_c = cpu_avg[n] / 100.0, sg_c = cpu_std[n] / 100.0;
       double mu_m = mem_avg[n] / 100.0, sg_m = mem_std[n] / 100.0;
       auto risk = [&](double mu, double sg) {
-        double s = sensitivity > 0 ? __builtin_pow(sg, 1.0 / sensitivity) : sg;
+        // Go analysis.go:48-50: the root applies for sensitivity >= 0
+        // (1/0 = +Inf, pow(x, inf) = 0 for x < 1); negative skips it
+        double s = sensitivity >= 0 ? __builtin_pow(sg, 1.0 / sensitivity) : sg;
         double v = (mu + s * margin) / 2.0;
         return v < 0 ? 0.0 : (v > 1 ? 1.0 : v);
       };
