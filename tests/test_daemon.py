@@ -193,3 +193,37 @@ class TestComposeDemoRecipe:
                     proc.communicate()
         finally:
             srv.stop()
+
+
+class TestDaemonErrors:
+    def test_unknown_plugin_fails_fast(self, tmp_path):
+        profile = tmp_path / "p.yaml"
+        profile.write_text("plugins:\n  - NoSuchPlugin\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "scheduler_plugins_tpu",
+             "--profile", str(profile), "--max-cycles", "1",
+             "--health-port", "-1"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "NoSuchPlugin" in proc.stderr
+
+    def test_kube_scheduler_configuration_wrapper_accepted(self, tmp_path):
+        # profiles: [first] wrapper (KubeSchedulerConfiguration shape)
+        profile = tmp_path / "p.yaml"
+        profile.write_text(
+            "apiVersion: kubescheduler.config.k8s.io/v1\n"
+            "kind: KubeSchedulerConfiguration\n"
+            "profiles:\n"
+            "  - plugins:\n"
+            "      - NodeResourcesAllocatable\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "scheduler_plugins_tpu",
+             "--profile", str(profile), "--max-cycles", "1",
+             "--cycle-interval-s", "0.01", "--health-port", "-1"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
