@@ -65,6 +65,10 @@ def parse_args(argv=None):
     ap.add_argument("--feed-host", default="127.0.0.1")
     ap.add_argument("--feed-port", type=int, default=0,
                     help="TCP port for the event feed (0 = ephemeral)")
+    ap.add_argument("--grpc-port", type=int, default=None,
+                    help="also serve the event feed over real gRPC/HTTP2 "
+                         "on this port (requires grpcio; shares the store "
+                         "lock and rv fence with the TCP feed)")
     ap.add_argument("--apiserver", default=None,
                     help="kube-apiserver base URL to LIST+WATCH (optional; "
                          "without it the daemon is feed-driven only)")
@@ -161,6 +165,22 @@ class Daemon:
         self.feed = FeedServer(
             self.cluster, host=args.feed_host, port=args.feed_port
         ).start()
+        self.grpc_feed = None
+        if args.grpc_port is not None:
+            from scheduler_plugins_tpu.bridge.grpc_feed import GrpcFeedServer
+
+            # same lock + rv fence: redundant TCP/gRPC agents stay coherent
+            self.grpc_feed = GrpcFeedServer(
+                self.cluster, host=args.feed_host, port=args.grpc_port,
+                lock=self.feed.lock, rv_table=self.feed.rv_table,
+            ).start()
+            if not self.grpc_feed.port:
+                # grpc's add_insecure_port reports a bind failure as port
+                # 0 instead of raising — fail fast like any bad config
+                raise SystemExit(
+                    f"--grpc-port {args.grpc_port}: bind failed "
+                    "(port in use?)"
+                )
         self.cycles = 0
         self.bound_total = 0
         self.last_pending = 0
@@ -286,6 +306,8 @@ class Daemon:
 
         host, port = self.feed.address
         status = {"feed": f"{host}:{port}"}
+        if self.grpc_feed is not None:
+            status["grpc"] = f"{self.grpc_feed.host}:{self.grpc_feed.port}"
         if self.health:
             status["health"] = "http://%s:%d/healthz" % self.health.address
         print("daemon ready " + json.dumps(status), flush=True)
@@ -304,6 +326,8 @@ class Daemon:
         finally:
             if self.health:
                 self.health.stop()
+            if self.grpc_feed is not None:
+                self.grpc_feed.stop()
             self.feed.stop()
             print(json.dumps({
                 "daemon_exit": True,

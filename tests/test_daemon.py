@@ -106,12 +106,13 @@ class TestDaemonE2E:
                 assert binding["target"]["name"] in ("n0", "n1")
 
                 # health endpoint reports progress
-                host, port = status["health"].split("//")[1].split("/")[0].split(":")
+                health_url = status["health"]
                 health = json.loads(urllib.request.urlopen(
-                    f"http://{host}:{port}/healthz", timeout=5).read())
+                    health_url, timeout=5).read())
                 assert health["ok"] and health["bound_total"] >= 2
                 metrics = json.loads(urllib.request.urlopen(
-                    f"http://{host}:{port}/metrics", timeout=5).read())
+                    health_url.replace("/healthz", "/metrics"),
+                    timeout=5).read())
                 assert metrics.get("scheduler_pods_bound_total", 0) >= 2
 
                 # clean SIGTERM: summary line + rc 0
@@ -227,3 +228,62 @@ class TestDaemonErrors:
             cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
         )
         assert proc.returncode == 0, proc.stderr
+
+
+class TestDaemonGrpcFeed:
+    def test_grpc_port_serves_the_same_store(self, tmp_path):
+        """--grpc-port exposes the event feed over real gRPC sharing the
+        TCP feed's lock and rv fence; events pushed via gRPC schedule in
+        the next cycle."""
+        import socket
+
+        import pytest
+
+        pytest.importorskip("grpc")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            grpc_port = s.getsockname()[1]
+        profile = tmp_path / "p.json"
+        profile.write_text(json.dumps({"plugins": ["NodeResourcesAllocatable"]}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "scheduler_plugins_tpu",
+             "--profile", str(profile),
+             "--grpc-port", str(grpc_port),
+             "--cycle-interval-s", "0.1", "--health-port", "0"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            ready = proc.stdout.readline()
+            assert ready.startswith("daemon ready "), ready
+            status = json.loads(ready[len("daemon ready "):])
+
+            from scheduler_plugins_tpu.bridge.grpc_feed import GrpcFeedClient
+
+            client = GrpcFeedClient("127.0.0.1", grpc_port)
+            acks = client.send_batch([
+                {"op": "upsert_node", "name": "g0", "rv": 1,
+                 "allocatable": {"cpu": 4000, "memory": 8 << 30,
+                                 "pods": 110}},
+                {"op": "upsert_pod", "namespace": "default", "name": "w",
+                 "uid": "default/w", "rv": 2,
+                 "containers": [{"requests": {"cpu": 500}}]},
+            ])
+            assert all(a.get("ok") for a in acks), acks
+
+            health_url = status["health"]
+
+            def bound():
+                health = json.loads(urllib.request.urlopen(
+                    health_url, timeout=5).read())
+                return health["bound_total"] >= 1
+
+            assert _wait(bound, timeout=30)
+            proc.send_signal(signal.SIGTERM)
+            _, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
