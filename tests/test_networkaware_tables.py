@@ -216,3 +216,61 @@ class TestPlacedCommit:
         after = placed_commit(base, jnp.asarray(-1, jnp.int32),
                               jnp.asarray(3, jnp.int32))
         assert np.asarray(after).tolist() == np.asarray(base).tolist()
+
+
+class TestClassTalliesRandomizedDifferential:
+    """`class_dependency_tallies` (matmul formulation) vs vmapped
+    `dependency_tallies` (broadcast formulation) on RANDOM inputs — the
+    two are independent derivations of networkoverhead.go:500-638, so
+    agreement over adversarial shapes (multiple dependency slots, masked
+    slots, unlabeled/region-only/unlocated nodes, missing cost pairs,
+    zero and duplicate placements) is a real differential gate, not an
+    echo. Scenario data only exercises D=1 and fully-labeled nodes."""
+
+    def test_random_shapes_bit_identical(self):
+        import jax
+
+        from scheduler_plugins_tpu.ops.network import (
+            class_dependency_tallies,
+        )
+
+        rng = np.random.default_rng(7)
+        for trial in range(6):
+            W = int(rng.integers(1, 6))     # workload classes
+            D = int(rng.integers(1, 4))     # dependency slots
+            N = int(rng.integers(4, 24))    # nodes
+            ZC = int(rng.integers(1, 6))    # zones
+            RC = int(rng.integers(1, 4))    # regions
+
+            zone_region = rng.integers(-1, RC, ZC).astype(np.int32)
+            zone_cost = rng.integers(-1, 30, (ZC, ZC)).astype(np.int64)
+            region_cost = rng.integers(-1, 30, (RC, RC)).astype(np.int64)
+            # node labels: mix of zoned / region-only / unlocated
+            node_zone = rng.integers(-1, ZC, N).astype(np.int32)
+            node_region = np.where(
+                rng.random(N) < 0.2, -1, rng.integers(0, RC, N)
+            ).astype(np.int32)
+            placed_node = rng.integers(0, 4, (W, N)).astype(np.int64)
+
+            cls_dep_workload = rng.integers(-1, W, (W, D)).astype(np.int32)
+            cls_dep_max_cost = rng.integers(0, 25, (W, D)).astype(np.int64)
+            cls_dep_mask = rng.random((W, D)) < 0.7
+
+            args = (
+                jnp.asarray(placed_node), jnp.asarray(node_zone),
+                jnp.asarray(node_region), jnp.asarray(zone_region),
+                jnp.asarray(zone_cost), jnp.asarray(region_cost),
+            )
+            per_class = jax.vmap(
+                lambda dw, mc, dm: dependency_tallies(dw, mc, dm, *args)
+            )(jnp.asarray(cls_dep_workload), jnp.asarray(cls_dep_max_cost),
+              jnp.asarray(cls_dep_mask))
+            batched = class_dependency_tallies(
+                jnp.asarray(cls_dep_workload), jnp.asarray(cls_dep_max_cost),
+                jnp.asarray(cls_dep_mask), *args,
+            )
+            for k, (a, b) in enumerate(zip(per_class, batched)):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                    trial, ("satisfied", "violated", "cost")[k],
+                    np.asarray(a), np.asarray(b),
+                )
