@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -84,6 +85,16 @@ def parse_args(argv=None):
     ap.add_argument("--bind-back", action="store_true",
                     help="POST bindings back to --apiserver "
                          "(pods/<name>/binding, the upstream bind shape)")
+    ap.add_argument("--leader-elect", action="store_true",
+                    help="coordination.k8s.io Lease leader election via "
+                         "--apiserver: schedule only while holding the "
+                         "lease (reflectors keep syncing on standby)")
+    ap.add_argument("--lease-name", default="scheduler-plugins-tpu")
+    ap.add_argument("--lease-namespace", default="kube-system")
+    ap.add_argument("--lease-duration-s", type=float, default=15.0)
+    ap.add_argument("--identity", default=None,
+                    help="leader-election holder identity "
+                         "(default hostname_pid)")
     ap.add_argument("--cycle-interval-s", type=float, default=1.0)
     ap.add_argument("--health-port", type=int, default=0,
                     help="HTTP health/metrics port (0 = ephemeral; "
@@ -125,13 +136,17 @@ class HealthServer:
                     # lock-free: a probe must answer while a cycle (incl.
                     # first-compile) holds the feed lock; `last_pending`
                     # is the previous tick's cached count
-                    body = json.dumps({
+                    payload = {
                         "ok": True,
                         "cycles": outer.cycles,
                         "bound_total": outer.bound_total,
                         "pending": outer.last_pending,
                         "feed_address": list(outer.feed.address),
-                    }).encode()
+                    }
+                    if outer.elector is not None:
+                        payload["leader"] = outer.elector.is_leader
+                        payload["holder"] = outer.elector.observed_holder
+                    body = json.dumps(payload).encode()
                 elif self.path.startswith("/metrics"):
                     body = json.dumps(obs.metrics.snapshot()).encode()
                 else:
@@ -182,9 +197,11 @@ class Daemon:
                     "(port in use?)"
                 )
         self.cycles = 0
+        self.ticks = 0
         self.bound_total = 0
         self.last_pending = 0
         self._unposted: dict[str, str] = {}
+        self.elector = None  # before HealthServer: /healthz reads it
         self.stop_event = threading.Event()
         self.health = None
         if args.health_port >= 0:
@@ -193,6 +210,28 @@ class Daemon:
         if args.token_file:
             with open(args.token_file) as f:
                 self.token = f.read().strip()
+        if args.leader_elect:
+            if not args.apiserver:
+                raise SystemExit("--leader-elect requires --apiserver")
+            import socket as _socket
+
+            from scheduler_plugins_tpu.bridge.leader import LeaseElector
+
+            identity = args.identity or (
+                f"{_socket.gethostname()}_{os.getpid()}"
+            )
+            self.elector = LeaseElector(
+                args.apiserver, identity,
+                name=args.lease_name, namespace=args.lease_namespace,
+                lease_duration_s=args.lease_duration_s,
+                renew_period_s=max(args.lease_duration_s / 3.0, 0.05),
+                token=self.token, ca_file=args.ca_file,
+                insecure_skip_verify=args.insecure_skip_verify,
+            )
+            threading.Thread(
+                target=self.elector.run, args=(self.stop_event,),
+                daemon=True,
+            ).start()
         self._agent_threads = []
         if args.apiserver:
             paths = (
@@ -222,15 +261,10 @@ class Daemon:
         )
 
     def _ssl_context(self):
-        import ssl
+        from scheduler_plugins_tpu.utils.httptls import ssl_context
 
-        if not self.args.apiserver.startswith("https"):
-            return None
-        ctx = ssl.create_default_context(cafile=self.args.ca_file)
-        if self.args.insecure_skip_verify:
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-        return ctx
+        return ssl_context(self.args.apiserver, self.args.ca_file,
+                           self.args.insecure_skip_verify)
 
     def _post_binding(self, uid: str, node: str) -> bool:
         """POST the upstream Binding shape back to the apiserver
@@ -266,6 +300,13 @@ class Daemon:
         return True
 
     def tick(self):
+        if self.elector is not None and not self.elector.is_leader:
+            # standby: reflectors keep the store warm, scheduling waits
+            # (client-go leaderelection semantics — informers run, the
+            # scheduling/reconcile loops gate on leadership)
+            with self.feed.locked():
+                self.last_pending = len(self.cluster.pending_pods())
+            return None
         now_ms = int(time.time() * 1000)
         report = self.feed.run_cycle(self.scheduler, now=now_ms)
         with self.feed.locked():
@@ -316,7 +357,10 @@ class Daemon:
             while not self.stop_event.is_set():
                 started = time.monotonic()
                 self.tick()
-                if args.max_cycles and self.cycles >= args.max_cycles:
+                self.ticks += 1
+                # ticks, not scheduling cycles: a bounded run must also
+                # terminate when leader-election standby skips every cycle
+                if args.max_cycles and self.ticks >= args.max_cycles:
                     break
                 remaining = args.cycle_interval_s - (
                     time.monotonic() - started
@@ -324,6 +368,8 @@ class Daemon:
                 if remaining > 0:
                     self.stop_event.wait(remaining)
         finally:
+            if self.elector is not None:
+                self.elector.release()  # ReleaseOnCancel (idempotent)
             if self.health:
                 self.health.stop()
             if self.grpc_feed is not None:

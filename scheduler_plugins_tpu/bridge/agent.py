@@ -619,10 +619,11 @@ class ClusterAgent:
 
         Stops after ``max_events`` sends or ``max_failures`` consecutive
         failures (None = retry forever). Returns events sent."""
-        import ssl
         import time as _time
         import urllib.error
         import urllib.request
+
+        from scheduler_plugins_tpu.utils.httptls import ssl_context
 
         sleep = _sleep if _sleep is not None else _time.sleep
 
@@ -630,16 +631,7 @@ class ClusterAgent:
             req = urllib.request.Request(url)
             if token:
                 req.add_header("Authorization", f"Bearer {token}")
-            ctx = None
-            if url.startswith("https"):
-                # `ca_file` trusts a private CA (in-cluster: the
-                # serviceaccount ca.crt) without disabling verification
-                ctx = ssl.create_default_context(cafile=ca_file)
-                if insecure_skip_verify:
-                    # public-API equivalent of the old private
-                    # _create_unverified_context
-                    ctx.check_hostname = False
-                    ctx.verify_mode = ssl.CERT_NONE
+            ctx = ssl_context(url, ca_file, insecure_skip_verify)
             return urllib.request.urlopen(req, timeout=timeout_s, context=ctx)
 
         base = apiserver.rstrip("/") + path

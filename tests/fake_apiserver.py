@@ -76,11 +76,76 @@ class _Handler(BaseHTTPRequestHandler):
             payload = {"raw": body.decode("utf-8", "replace")}
         with server.lock:
             server.posts.append((self.path, payload))
+            # kube create semantics on COLLECTION URLs (leases): the
+            # object is stored under <collection>/<metadata.name> with
+            # rv=1; creating an existing object is 409 AlreadyExists
+            if self.path.endswith("/leases"):
+                name = (payload.get("metadata") or {}).get("name", "")
+                obj_path = f"{self.path}/{name}"
+                if obj_path in server.objects:
+                    self.send_response(409)
+                    self.end_headers()
+                    return
+                payload.setdefault("metadata", {})["resourceVersion"] = "1"
+                server.objects[obj_path] = payload
         self.send_response(201)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_PUT(self):
+        """Conditional replace of a stored object (leases for leader
+        election) with kube's optimistic concurrency: a PUT carrying a
+        stale metadata.resourceVersion gets 409 Conflict; success bumps
+        the stored rv."""
+        server: FakeApiServer = self.server  # type: ignore[assignment]
+        if server.expected_token:
+            auth = self.headers.get("Authorization", "")
+            if auth != f"Bearer {server.expected_token}":
+                self.send_response(401)
+                self.end_headers()
+                return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except ValueError:
+            self.send_response(400)
+            self.end_headers()
+            return
+        with server.lock:
+            stored = server.objects.get(self.path)
+            if stored is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            stored_rv = (stored.get("metadata") or {}).get(
+                "resourceVersion")
+            sent_rv = (payload.get("metadata") or {}).get(
+                "resourceVersion")
+            if sent_rv is not None and sent_rv != stored_rv:
+                self.send_response(409)
+                self.end_headers()
+                return
+            payload.setdefault("metadata", {})["resourceVersion"] = str(
+                int(stored_rv or 0) + 1)
+            server.objects[self.path] = payload
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _serve_list(self, server, path):
+        with server.lock:
+            obj = server.objects.get(path)
+        if obj is not None:
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         listing = server.lists.get(path)
         if listing is None:
             self.send_response(404)
@@ -134,6 +199,7 @@ class FakeApiServer:
         self.watch_requests: dict[str, list] = {}
         self.requests: list[str] = []
         self.posts: list[tuple[str, dict]] = []
+        self.objects: dict[str, dict] = {}
         self.expected_token = expected_token
         self.lock = threading.Lock()
         self._httpd = None
@@ -146,6 +212,7 @@ class FakeApiServer:
         httpd.watch_requests = self.watch_requests  # type: ignore[attr-defined]
         httpd.requests = self.requests  # type: ignore[attr-defined]
         httpd.posts = self.posts  # type: ignore[attr-defined]
+        httpd.objects = self.objects  # type: ignore[attr-defined]
         httpd.expected_token = self.expected_token  # type: ignore[attr-defined]
         httpd.lock = self.lock  # type: ignore[attr-defined]
         self._httpd = httpd

@@ -1,0 +1,193 @@
+"""Lease-based leader election (bridge/leader.py) — client-go
+leaderelection semantics over coordination.k8s.io/v1 Leases (the analog of
+/root/reference/cmd/controller/app/server.go:56-58): acquire-on-absent,
+standby while fresh, takeover on staleness with a leaseTransitions bump,
+release-on-cancel — plus a two-daemon failover e2e against the fake
+apiserver."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from scheduler_plugins_tpu.bridge.leader import LeaseElector
+
+from tests.fake_apiserver import FakeApiServer
+from tests.test_agent import _node, _pod
+from tests.test_daemon import REPO, _listing, _wait
+
+
+class TestLeaseElector:
+    def test_acquires_absent_lease(self):
+        with FakeApiServer() as srv:
+            e = LeaseElector(srv.url, "me", lease_duration_s=15)
+            assert e.step(now=1000.0) is True
+            assert e.is_leader
+            lease = next(iter(srv.objects.values()))
+            assert lease["spec"]["holderIdentity"] == "me"
+            assert lease["spec"]["leaseTransitions"] == 0
+
+    def test_standby_while_other_holds_fresh(self):
+        with FakeApiServer() as srv:
+            a = LeaseElector(srv.url, "a", lease_duration_s=15)
+            b = LeaseElector(srv.url, "b", lease_duration_s=15)
+            assert a.step(now=1000.0) is True
+            assert b.step(now=1005.0) is False  # renewed 5s ago, fresh
+            assert b.observed_holder == "a"
+            # a renews; b still standby
+            assert a.step(now=1010.0) is True
+            assert b.step(now=1012.0) is False
+
+    def test_takeover_on_stale_bumps_transitions(self):
+        with FakeApiServer() as srv:
+            a = LeaseElector(srv.url, "a", lease_duration_s=15)
+            b = LeaseElector(srv.url, "b", lease_duration_s=15)
+            assert a.step(now=1000.0) is True
+            # a vanishes; 15s after its last renewTime the lease is stale
+            assert b.step(now=1016.0) is True
+            lease = next(iter(srv.objects.values()))
+            assert lease["spec"]["holderIdentity"] == "b"
+            assert lease["spec"]["leaseTransitions"] == 1
+            # the deposed leader observes the new holder and demotes
+            assert a.step(now=1017.0) is False
+            assert a.observed_holder == "b"
+
+    def test_release_clears_holder(self):
+        with FakeApiServer() as srv:
+            a = LeaseElector(srv.url, "a", lease_duration_s=15)
+            b = LeaseElector(srv.url, "b", lease_duration_s=15)
+            assert a.step(now=1000.0) is True
+            a.release()
+            lease = next(iter(srv.objects.values()))
+            assert lease["spec"]["holderIdentity"] is None
+            # released lease is immediately acquirable
+            assert b.step(now=1001.0) is True
+
+    def test_apiserver_error_demotes(self):
+        e = LeaseElector("http://127.0.0.1:1", "me")
+        e.is_leader = True
+        assert e.step(now=1000.0) is False
+        assert e.is_leader is False
+
+
+class TestLeaderElectedDaemons:
+    def test_standby_takes_over_after_leader_dies(self, tmp_path):
+        """Two daemons, one lease: only the leader schedules; killing it
+        hands the workload to the standby within the lease duration."""
+        with FakeApiServer() as srv:
+            srv.lists["/api/v1/nodes"] = _listing(
+                "NodeList", [_node("n0", cpu="8", rv=1)], rv=2)
+            srv.lists["/api/v1/pods"] = _listing(
+                "PodList", [_pod("a", cpu="500m", rv=3)], rv=3)
+            srv.watch_scripts["/api/v1/pods"] = [
+                [("stall", 60)], [("stall", 60)],
+                [("event", {"type": "ADDED",
+                            "object": _pod("b", cpu="500m", rv=4)}),
+                 ("stall", 60)],
+                [("event", {"type": "ADDED",
+                            "object": _pod("b", cpu="500m", rv=4)}),
+                 ("stall", 60)],
+            ]
+            srv.watch_scripts["/api/v1/nodes"] = [
+                [("stall", 60)] for _ in range(4)
+            ]
+            profile = tmp_path / "p.json"
+            profile.write_text(json.dumps(
+                {"plugins": ["NodeResourcesAllocatable"]}))
+            env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+            def start(identity):
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "scheduler_plugins_tpu",
+                     "--profile", str(profile),
+                     "--apiserver", srv.url,
+                     "--watch-paths", "/api/v1/nodes,/api/v1/pods",
+                     "--bind-back", "--cycle-interval-s", "0.1",
+                     "--leader-elect", "--lease-duration-s", "1.5",
+                     "--identity", identity, "--health-port", "-1"],
+                    cwd=REPO, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True,
+                )
+                ready = proc.stdout.readline()
+                assert ready.startswith("daemon ready "), ready
+                return proc
+
+            first = start("first")
+            try:
+                def holder():
+                    with srv.lock:
+                        for path, obj in srv.objects.items():
+                            if "/leases/" in path:
+                                return (obj.get("spec") or {}).get(
+                                    "holderIdentity")
+                    return None
+
+                def bound_count():
+                    with srv.lock:
+                        return sum(
+                            1 for p, _ in srv.posts
+                            if p.endswith("/binding"))
+
+                assert _wait(lambda: holder() == "first", timeout=30)
+                assert _wait(lambda: bound_count() >= 1, timeout=30)
+
+                second = start("second")
+                try:
+                    # standby does not steal a fresh lease
+                    import time
+
+                    time.sleep(1.0)
+                    assert holder() == "first"
+
+                    first.kill()
+                    first.communicate()
+                    # stale after lease_duration: standby takes over and
+                    # schedules pod b
+                    assert _wait(lambda: holder() == "second",
+                                 timeout=30), holder()
+                    assert _wait(lambda: bound_count() >= 2, timeout=30), (
+                        srv.posts)
+                    second.send_signal(signal.SIGTERM)
+                    _, err = second.communicate(timeout=30)
+                    assert second.returncode == 0, err
+                    # clean shutdown released the lease
+                    assert holder() is None
+                finally:
+                    if second.poll() is None:
+                        second.kill()
+                        second.communicate()
+            finally:
+                if first.poll() is None:
+                    first.kill()
+                    first.communicate()
+
+
+class TestConditionalUpdateRace:
+    def test_interleaved_takeover_loses_on_conflict(self):
+        """Two standbys race a STALE lease: the second PUT carries the
+        pre-race resourceVersion and gets 409 Conflict — split brain is
+        structurally impossible (the client-go conditional-update
+        guarantee the elector mirrors)."""
+        with FakeApiServer() as srv:
+            holder = LeaseElector(srv.url, "old", lease_duration_s=1)
+            assert holder.step(now=1000.0) is True
+
+            rival = LeaseElector(srv.url, "rival", lease_duration_s=1)
+
+            class Racer(LeaseElector):
+                def _request(self, method, url, body=None):
+                    out = LeaseElector._request(self, method, url, body)
+                    if method == "GET" and rival.is_leader is False:
+                        # rival sneaks in between our GET and PUT
+                        assert rival.step(now=2000.0) is True
+                    return out
+
+            racer = Racer(srv.url, "racer", lease_duration_s=1)
+            # both see the lease stale at t=2000; rival wins the PUT race
+            assert racer.step(now=2000.0) is False
+            assert racer.is_leader is False
+            lease = next(iter(srv.objects.values()))
+            assert lease["spec"]["holderIdentity"] == "rival"
+            assert lease["spec"]["leaseTransitions"] == 1
