@@ -85,6 +85,10 @@ def parse_args(argv=None):
     ap.add_argument("--bind-back", action="store_true",
                     help="POST bindings back to --apiserver "
                          "(pods/<name>/binding, the upstream bind shape)")
+    ap.add_argument("--scheduler-name", action="append", default=None,
+                    help="profile name(s) this scheduler owns (repeatable; "
+                         "default tpu-scheduler): only pods whose "
+                         "spec.schedulerName matches are scheduled")
     ap.add_argument("--leader-elect", action="store_true",
                     help="coordination.k8s.io Lease leader election via "
                          "--apiserver: schedule only while holding the "
@@ -177,6 +181,8 @@ class Daemon:
         self.profile = load_profile_file(args.profile)
         self.scheduler = Scheduler(self.profile)
         self.cluster = Cluster()
+        if args.scheduler_name:
+            self.cluster.scheduler_names = set(args.scheduler_name)
         self.feed = FeedServer(
             self.cluster, host=args.feed_host, port=args.feed_port
         ).start()

@@ -51,6 +51,14 @@ class Cluster:
     #: optional NRT cache policy (state.nrt_cache); when set, snapshots read
     #: the cache's adjusted zone view instead of the raw NRT objects
     nrt_cache: Optional[object] = None
+    #: profile names THIS scheduler owns: only pods whose
+    #: spec.schedulerName matches enter the queue (the upstream scheduler
+    #: dequeues per-profile; a second-scheduler deployment must never
+    #: steal default-scheduler pods). Other pods still count for capacity,
+    #: gang membership and NRT foreign-pod tracking.
+    scheduler_names: set = field(
+        default_factory=lambda: {"tpu-scheduler"}
+    )
 
     # scheduling-runtime bookkeeping (host-only)
     reserved: dict[str, str] = field(default_factory=dict)  # uid -> node
@@ -378,7 +386,9 @@ class Cluster:
 
     def pending_pods(self) -> list[Pod]:
         """Schedulable queue: gated pods stay out (upstream keeps them off
-        activeQ entirely — they are neither attempted nor reported failed)."""
+        activeQ entirely — they are neither attempted nor reported failed),
+        and only pods addressed to one of `scheduler_names` enter (the
+        upstream per-profile dequeue)."""
         return [
             p
             for p in self.pods.values()
@@ -387,6 +397,7 @@ class Cluster:
             and p.phase == PodPhase.PENDING
             and not p.terminating
             and not p.scheduling_gated
+            and p.scheduler_name in self.scheduler_names
         ]
 
     def gated_pods(self) -> list[Pod]:

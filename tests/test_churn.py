@@ -195,3 +195,52 @@ class TestExclusiveForeign:
             pod.scheduler_name = "default-scheduler"
             cache.track_pod(pod)
         assert cache.foreign == {"b"}
+
+
+class TestSchedulerNameOwnership:
+    """Per-profile dequeue: a pod addressed to another scheduler
+    (spec.schedulerName) must never be scheduled by this one, while its
+    resource usage still counts once bound (the upstream multi-scheduler
+    contract; foreign tracking in state/nrt_cache.py uses the same
+    field)."""
+
+    def test_foreign_scheduler_pod_not_scheduled(self):
+        from scheduler_plugins_tpu.framework import (
+            Profile,
+            Scheduler,
+            run_cycle,
+        )
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={
+            CPU: 8000, MEMORY: 32 << 30, PODS: 110}))
+        c.add_pod(Pod(uid="default/ours", name="ours",
+                      containers=[Container(requests={CPU: 500})]))
+        c.add_pod(Pod(uid="default/theirs", name="theirs",
+                      scheduler_name="default-scheduler",
+                      containers=[Container(requests={CPU: 500})]))
+        r = run_cycle(Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable()])), c, now=1000)
+        assert "default/ours" in r.bound
+        assert "default/theirs" not in r.bound
+        assert "default/theirs" not in r.failed  # not attempted at all
+
+    def test_extra_profile_names_widen_ownership(self):
+        from scheduler_plugins_tpu.framework import (
+            Profile,
+            Scheduler,
+            run_cycle,
+        )
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        c = Cluster()
+        c.scheduler_names = {"tpu-scheduler", "batch-scheduler"}
+        c.add_node(Node(name="n0", allocatable={
+            CPU: 8000, MEMORY: 32 << 30, PODS: 110}))
+        c.add_pod(Pod(uid="default/batch", name="batch",
+                      scheduler_name="batch-scheduler",
+                      containers=[Container(requests={CPU: 500})]))
+        r = run_cycle(Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable()])), c, now=1000)
+        assert r.bound["default/batch"] == "n0"
