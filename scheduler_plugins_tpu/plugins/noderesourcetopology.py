@@ -119,9 +119,11 @@ class NodeResourceTopologyMatch(Plugin):
             self.cache_resync_method,
         )
 
-    def make_cache(self):
+    def make_cache(self, scheduler_names=None):
         """Cache-tier selection exactly as initNodeTopologyInformer does it
-        (pluginhelpers.go:55-66)."""
+        (pluginhelpers.go:55-66). `scheduler_names` seeds the foreign-pod
+        registry (cache/foreign_pods.go's profile-name registry) so
+        ownership and foreign tracking stay consistent."""
         from scheduler_plugins_tpu.state import nrt_cache as caches
 
         if self.discard_reserved_nodes:
@@ -133,6 +135,8 @@ class NodeResourceTopologyMatch(Plugin):
             informer_mode=self.cache_informer_mode,
             resync_method=self.cache_resync_method,
         )
+        if scheduler_names:
+            cache.our_schedulers = set(scheduler_names)
         cache.resync_period_ms = self.cache_resync_period_seconds * 1000
         return cache
 
@@ -141,7 +145,9 @@ class NodeResourceTopologyMatch(Plugin):
             return
         if getattr(cluster, "_nrt_cache_config", None) == self._cache_signature():
             return
-        cache = self.make_cache()
+        cache = self.make_cache(
+            scheduler_names=getattr(cluster, "scheduler_names", None)
+        )
         for nrt in cluster.nrts.values():
             cache.update_nrt(nrt)
         if hasattr(cache, "track_pod"):

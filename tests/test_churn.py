@@ -244,3 +244,20 @@ class TestSchedulerNameOwnership:
         r = run_cycle(Scheduler(Profile(
             plugins=[NodeResourcesAllocatable()])), c, now=1000)
         assert r.bound["default/batch"] == "n0"
+
+    def test_nrt_cache_ownership_follows_scheduler_names(self):
+        """make_cache seeds the foreign-pod registry from the cluster's
+        scheduler_names: a renamed scheduler's own bound pods must not
+        mark their nodes foreign (r5 review finding)."""
+        from scheduler_plugins_tpu.plugins import NodeResourceTopologyMatch
+
+        c = Cluster()
+        c.scheduler_names = {"batch-scheduler"}
+        plugin = NodeResourceTopologyMatch(cache_resync_period_seconds=5)
+        plugin.configure_cluster(c)
+        assert c.nrt_cache.our_schedulers == {"batch-scheduler"}
+        own = Pod(uid="default/mine", name="mine", node_name="n0",
+                  scheduler_name="batch-scheduler",
+                  containers=[Container(requests={CPU: 500})])
+        c.nrt_cache.track_pod(own)
+        assert "n0" not in c.nrt_cache.foreign
