@@ -468,3 +468,66 @@ class TestQueueSortLessVectors:
             requests={CPU: 100, MEMORY: gib},
             limits={CPU: 100, MEMORY: gib})])
         assert self._order([lo, hi], plugins=[QOSSort()]) == ["hi", "lo"]
+
+
+class TestElasticQuotaComparatorVectors:
+    """usedOverMinWith / usedOverMaxWith corners from elasticquota_test.go
+    (:158-360) at the end-to-end admission surface: a requested scalar
+    ABSENT from Min counts as over-min (min defaults to 0); a quota with
+    no Max is unbounded; ephemeral-storage participates like any
+    resource."""
+
+    GPU = "example.com/gpu"
+
+    def _admitted(self, eq_min, eq_max, used_pod_req, pod_req):
+        from scheduler_plugins_tpu.api.objects import ElasticQuota
+
+        c = Cluster()
+        c.add_node(Node(name="n0", allocatable={
+            CPU: 64_000, MEMORY: 64 << 30, PODS: 110, self.GPU: 64,
+            "ephemeral-storage": 1 << 40}))
+        c.add_quota(ElasticQuota(
+            namespace="ns1", name="eq", min=eq_min, max=eq_max))
+        if used_pod_req:
+            c.add_pod(Pod(uid="ns1/used", name="used", namespace="ns1",
+                          node_name="n0",
+                          containers=[Container(requests=used_pod_req)]))
+        c.add_pod(Pod(uid="ns1/p", name="p", namespace="ns1",
+                      containers=[Container(requests=pod_req)]))
+        sched = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), CapacityScheduling()]))
+        r = run_cycle(sched, c, now=1000)
+        return "ns1/p" in r.bound
+
+    def test_requested_scalar_absent_from_min_is_over_min(self):
+        # used/min have no GPU entry; pod requests 5 GPU -> min defaults
+        # to 0, so the aggregate-over-min gate rejects (expected true in
+        # the reference comparator = over min = unschedulable here)
+        assert self._admitted(
+            eq_min={CPU: 3000, MEMORY: 100 << 20},
+            eq_max={CPU: 64_000, MEMORY: 64 << 30, self.GPU: 64},
+            used_pod_req={CPU: 10, MEMORY: 10 << 20},
+            pod_req={CPU: 10, MEMORY: 10 << 20, self.GPU: 5},
+        ) is False
+
+    def test_within_min_admits_with_ephemeral_storage(self):
+        assert self._admitted(
+            eq_min={CPU: 3000, MEMORY: 100 << 20,
+                    "ephemeral-storage": 100 << 20},
+            eq_max={CPU: 64_000, MEMORY: 64 << 30,
+                    "ephemeral-storage": 1 << 40},
+            used_pod_req={CPU: 10, MEMORY: 10 << 20,
+                          "ephemeral-storage": 10 << 20},
+            pod_req={CPU: 10, MEMORY: 10 << 20,
+                     "ephemeral-storage": 10 << 20},
+        ) is True
+
+    def test_no_max_is_unbounded(self):
+        # max absent entirely: usedOverMaxWith can never fire; admission
+        # is governed by the min pool alone
+        assert self._admitted(
+            eq_min={CPU: 3000, MEMORY: 1 << 30},
+            eq_max={},  # absent Max entries -> unbounded
+            used_pod_req=None,
+            pod_req={CPU: 2000, MEMORY: 100 << 20},
+        ) is True
