@@ -279,6 +279,7 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     start = time.perf_counter()
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     placed = 0
+    chunk_done_s = []  # completion time of each chunk since submission
     for lo in range(0, padded, chunk):
         a, free = solve_chunk(
             snap.pods.req[lo:lo + chunk], snap.pods.mask[lo:lo + chunk], free
@@ -286,7 +287,14 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
         # per-chunk host sync: chaining chunks device-side balloons the
         # in-flight working set through the tunneled backend
         placed += int((np.asarray(a) >= 0).sum())
+        chunk_done_s.append(time.perf_counter() - start)
     elapsed = time.perf_counter() - start
+    # BASELINE.json names p99 scheduling latency alongside throughput: a
+    # pod's decision latency is its chunk's completion time since the
+    # batch was submitted (pods stream through in queue order), so the
+    # per-pod latency distribution is the chunk completion times weighted
+    # by chunk size
+    pod_latency_s = np.repeat(chunk_done_s, chunk)[:n_pods]
     baseline = python_baseline_pods_per_sec(cluster, sample=40)
     _emit(
         "north_star_pods_per_sec",
@@ -294,6 +302,12 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
         f"{n_nodes} nodes x {n_pods} pods chunked x{chunk}, {placed} placed",
         baseline,
         compiled=_compiled_baseline(6, snap, meta, weights=weights),
+        extra={
+            "pod_latency_p50_ms": round(
+                float(np.percentile(pod_latency_s, 50)) * 1000, 1),
+            "pod_latency_p99_ms": round(
+                float(np.percentile(pod_latency_s, 99)) * 1000, 1),
+        },
     )
 
 
