@@ -287,3 +287,93 @@ class TestDaemonGrpcFeed:
             if proc.poll() is None:
                 proc.kill()
                 proc.communicate()
+
+
+class TestApiserverOutageRecovery:
+    def test_daemon_survives_apiserver_restart(self, tmp_path):
+        """The reflector threads retry forever (max_failures=None): kill
+        the control plane mid-run, bring a new one up on the SAME port
+        with more work, and the daemon relists and schedules it — the
+        restart-resilience contract of client-go informers."""
+        import socket
+
+        with socket.socket() as s:  # pick a reusable port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        def make_server():
+            srv = FakeApiServer(expected_token="sekrit")
+            srv.__enter__()
+            return srv
+
+        srv1 = FakeApiServer(expected_token="sekrit")
+        # rebind the fixed port by constructing the inner server manually
+        from http.server import ThreadingHTTPServer
+
+        import tests.fake_apiserver as fa
+
+        def start_on(srv, port):
+            httpd = ThreadingHTTPServer(("127.0.0.1", port), fa._Handler)
+            for attr in ("lists", "watch_scripts", "watch_requests",
+                         "requests", "posts", "objects",
+                         "expected_token", "lock"):
+                setattr(httpd, attr, getattr(srv, attr))
+            srv._httpd = httpd
+            import threading as _t
+
+            srv._thread = _t.Thread(target=httpd.serve_forever, daemon=True)
+            srv._thread.start()
+            srv.url = f"http://127.0.0.1:{port}"
+            return srv
+
+        start_on(srv1, port)
+        srv1.lists["/api/v1/nodes"] = _listing(
+            "NodeList", [_node("n0", cpu="8", rv=1)], rv=2)
+        srv1.lists["/api/v1/pods"] = _listing(
+            "PodList", [_pod("a", cpu="500m", rv=3)], rv=3)
+        srv1.watch_scripts["/api/v1/pods"] = [[("stall", 60)]]
+        srv1.watch_scripts["/api/v1/nodes"] = [[("stall", 60)]]
+
+        proc, _ = _start_daemon(tmp_path, f"http://127.0.0.1:{port}")
+        try:
+            def bound_names(srv):
+                with srv.lock:
+                    return {
+                        p.rsplit("/pods/", 1)[1].split("/")[0]
+                        for p, _ in srv.posts if p.endswith("/binding")
+                    }
+
+            assert _wait(lambda: "a" in bound_names(srv1), timeout=30)
+
+            # control-plane outage
+            srv1._httpd.shutdown()
+            srv1._httpd.server_close()
+            time.sleep(1.0)
+
+            # new control plane, same port, new workload
+            srv2 = FakeApiServer(expected_token="sekrit")
+            start_on(srv2, port)
+            srv2.lists["/api/v1/nodes"] = _listing(
+                "NodeList", [_node("n0", cpu="8", rv=10)], rv=11)
+            srv2.lists["/api/v1/pods"] = _listing(
+                "PodList", [_pod("c", cpu="500m", rv=12)], rv=12)
+            # a fresh control plane doesn't know the old rv history:
+            # it answers the resumed watch with 410 Gone, forcing the
+            # reflector relist (the client-go resync contract)
+            gone = {"type": "ERROR", "object": {
+                "kind": "Status", "code": 410, "reason": "Expired"}}
+            srv2.watch_scripts["/api/v1/pods"] = (
+                [[("event", gone)]] + [[("stall", 60)]] * 3)
+            srv2.watch_scripts["/api/v1/nodes"] = (
+                [[("event", gone)]] + [[("stall", 60)]] * 3)
+            try:
+                assert _wait(lambda: "c" in bound_names(srv2),
+                             timeout=60), (
+                    srv2.posts, proc.stderr.read() if proc.poll() else "")
+            finally:
+                srv2._httpd.shutdown()
+                srv2._httpd.server_close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
