@@ -314,7 +314,11 @@ class Daemon:
                 self.last_pending = len(self.cluster.pending_pods())
             return None
         now_ms = int(time.time() * 1000)
+        cycle_started = time.monotonic()
         report = self.feed.run_cycle(self.scheduler, now=now_ms)
+        obs.metrics.observe_ms(
+            "scheduler_cycle", (time.monotonic() - cycle_started) * 1000
+        )
         with self.feed.locked():
             events = reconcile_pod_groups(self.cluster, now_ms=now_ms)
             events += reconcile_elastic_quotas(self.cluster)

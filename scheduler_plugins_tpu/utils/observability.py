@@ -32,6 +32,17 @@ class Metrics:
     def inc(self, name: str, value: int = 1) -> None:
         self._counts[name] += value
 
+    def observe_ms(self, name: str, ms: float) -> None:
+        """Duration observation -> `<name>_ms_total` / `<name>_count` /
+        `<name>_ms_max` counters (the prometheus summary shape without
+        quantile sketches — enough for rate() and mean/max panels)."""
+        ms_int = int(ms)
+        self._counts[f"{name}_ms_total"] += ms_int
+        self._counts[f"{name}_count"] += 1
+        key = f"{name}_ms_max"
+        if ms_int > self._counts[key]:
+            self._counts[key] = ms_int
+
     def get(self, name: str) -> int:
         return self._counts[name]
 

@@ -114,6 +114,10 @@ class TestDaemonE2E:
                     health_url.replace("/healthz", "/metrics"),
                     timeout=5).read())
                 assert metrics.get("scheduler_pods_bound_total", 0) >= 2
+                # cycle-latency summary counters (ops surface)
+                assert metrics.get("scheduler_cycle_count", 0) >= 1
+                assert "scheduler_cycle_ms_total" in metrics
+                assert "scheduler_cycle_ms_max" in metrics
 
                 # clean SIGTERM: summary line + rc 0
                 proc.send_signal(signal.SIGTERM)
