@@ -110,3 +110,32 @@ class TestQOSSortOrdering:
         sched = Scheduler(Profile(plugins=[QOSSort()]))
         order = sched.sort_pending([best_effort, burstable, guaranteed, higher])
         assert [p.name for p in order] == ["hi", "gu", "bu", "be"]
+
+
+class TestPodStateReferenceVectors:
+    """pod_state_test.go:50-75 exact normalized scores for
+    (terminating, nominated) node tables."""
+
+    def _scores(self, rows):
+        import jax.numpy as jnp
+
+        from scheduler_plugins_tpu.ops.normalize import minmax_normalize
+
+        raw = jnp.asarray([t - n for t, n in rows], jnp.int64)
+        mask = jnp.ones(len(rows), bool)
+        return np.asarray(minmax_normalize(raw, mask)).tolist()
+
+    def test_terminating_only(self):
+        assert self._scores([(6, 0), (3, 0), (0, 0)]) == [100, 50, 0]
+
+    def test_nominated_only(self):
+        assert self._scores([(0, 2), (0, 1), (0, 0)]) == [0, 50, 100]
+
+    def test_difference_ranks(self):
+        assert self._scores([(5, 2), (3, 1)]) == [100, 0]
+        assert self._scores([(5, 4), (3, 1)]) == [0, 100]
+
+    def test_negative_difference_four_nodes(self):
+        # raw 5, 2, 1, -1 -> minmax over range 6: 100, 50, 33, 0
+        assert self._scores([(5, 0), (3, 1), (2, 1), (0, 1)]) == [
+            100, 50, 33, 0]
