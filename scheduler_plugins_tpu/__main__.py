@@ -325,6 +325,11 @@ class Daemon:
             self.last_pending = len(self.cluster.pending_pods())
         for line in events:
             obs.logger.info("controller: %s", line)
+        if report.bound or report.failed:
+            obs.logger.info(
+                "cycle %d: bound %d, unschedulable %d",
+                self.cycles + 1, len(report.bound), len(report.failed),
+            )
         if self.args.apiserver and self.args.bind_back:
             # the local store binds immediately; the apiserver POST is the
             # process boundary and can fail transiently — keep unacked
