@@ -200,3 +200,22 @@ class TestScoreDecompositionDifferential:
             got = {meta.node_names[n]: int(raw[n])
                    for n in range(len(meta.node_names))}
             assert got == expected, f"trial {trial}: {got} != {expected}"
+
+
+class TestNormalizeReferenceVectors:
+    """sysched_test.go TestNormalizeScore exact vectors (reversed
+    DefaultNormalizeScore)."""
+
+    def test_normalize_vectors(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from scheduler_plugins_tpu.ops.normalize import default_normalize
+
+        mask = jnp.ones(2, bool)
+        out = default_normalize(
+            jnp.asarray([100, 200], jnp.int64), mask, reverse=True)
+        assert np.asarray(out).tolist() == [50, 0]
+        out = default_normalize(
+            jnp.asarray([0, 200], jnp.int64), mask, reverse=True)
+        assert np.asarray(out).tolist() == [100, 0]
