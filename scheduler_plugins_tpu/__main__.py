@@ -85,6 +85,12 @@ def parse_args(argv=None):
     ap.add_argument("--bind-back", action="store_true",
                     help="POST bindings back to --apiserver "
                          "(pods/<name>/binding, the upstream bind shape)")
+    ap.add_argument("--native-store", action="store_true",
+                    help="mirror hot node columns into the C++ columnar "
+                         "store (bridge/snapshot_store.cc) — snapshots "
+                         "read memcpy exports instead of per-cycle Python "
+                         "accumulation (requires the compiled .so, "
+                         "`make native`)")
     ap.add_argument("--scheduler-name", action="append", default=None,
                     help="profile name(s) this scheduler owns (repeatable; "
                          "default tpu-scheduler): only pods whose "
@@ -183,6 +189,13 @@ class Daemon:
         self.cluster = Cluster()
         if args.scheduler_name:
             self.cluster.scheduler_names = set(args.scheduler_name)
+        if args.native_store:
+            try:
+                self.cluster.attach_native_store()
+            except Exception as exc:
+                raise SystemExit(
+                    f"--native-store: {exc} (build it with `make native`)"
+                )
         self.feed = FeedServer(
             self.cluster, host=args.feed_host, port=args.feed_port
         ).start()

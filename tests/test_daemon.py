@@ -131,13 +131,14 @@ class TestDaemonE2E:
 
     def test_max_cycles_feed_driven_exit(self, tmp_path):
         """Without --apiserver the daemon is feed-driven; --max-cycles
-        bounds the loop (scriptable batch mode)."""
+        bounds the loop (scriptable batch mode). --native-store engages
+        the C++ columnar mirror on the same run (built by make native)."""
         profile = tmp_path / "p.json"
         profile.write_text(json.dumps({"plugins": ["NodeResourcesAllocatable"]}))
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
         proc = subprocess.run(
             [sys.executable, "-m", "scheduler_plugins_tpu",
-             "--profile", str(profile),
+             "--profile", str(profile), "--native-store",
              "--cycle-interval-s", "0.01", "--max-cycles", "3",
              "--health-port", "-1"],
             cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
