@@ -12,23 +12,27 @@ library composes them (VERDICT r4 item 2):
 
 Wiring per tick:
 
-    apiserver (LIST+WATCH, bearer auth)        [--apiserver URL]
+    apiserver (LIST+WATCH, bearer auth/ca)     [--apiserver URL]
         -> ClusterAgent reflector threads (one per watch path)
-        -> FeedServer (rv-fenced event protocol over TCP, shared lock)
-        -> Cluster store
-    cycle loop:  run_cycle (QueueSort..Bind, collector ticks, NRT resync)
+        -> FeedServer (rv-fenced event protocol over TCP; --grpc-port
+           serves the same events over real gRPC/HTTP2; --native-store
+           mirrors hot node columns into the C++ columnar store)
+        -> Cluster store  (--scheduler-name gates the queue per profile)
+    cycle loop:  [--leader-elect: only while holding the Lease]
+                 run_cycle (QueueSort..Bind, collector ticks, NRT resync)
                  reconcile_pod_groups / reconcile_elastic_quotas
                  bindings POSTed back to the apiserver [--bind-back]
-    health:      GET /healthz  -> liveness + cycle/bound counters
-                 GET /metrics  -> the prometheus-style counter registry
+    health:      GET /healthz  -> liveness + cycle/bound/leader status
+                 GET /metrics  -> counters incl. cycle-latency summary
 
 Without --apiserver the daemon is feed-driven: external agents (the Go/C++
 sidecar shape, bridge/feed.py clients) push events to --feed-port and the
 cycle loop schedules whatever arrives.
 
-`--max-cycles N` exits after N cycles (e2e tests); default runs until
-SIGTERM/SIGINT, which stops cleanly (agents are daemon threads; the feed
-server and health server shut down, a final summary line is printed).
+`--max-cycles N` exits after N ticks (e2e tests; leader-election standby
+ticks count, so bounded runs terminate either way); default runs until
+SIGTERM/SIGINT, which stops cleanly (agents are daemon threads; the lease
+is released, the feed/health servers shut down, a summary line prints).
 """
 
 from __future__ import annotations
