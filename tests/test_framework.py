@@ -531,3 +531,49 @@ class TestElasticQuotaComparatorVectors:
             used_pod_req=None,
             pod_req={CPU: 2000, MEMORY: 100 << 20},
         ) is True
+
+
+class TestQOSSortReferenceVectors:
+    """queue_sort_test.go Less() table: priority desc, then QoS class
+    (Guaranteed > Burstable > BestEffort), then queue time asc."""
+
+    def _less(self, p1, p2):
+        from scheduler_plugins_tpu.plugins import QOSSort
+
+        plugin = QOSSort()
+        return plugin.queue_key(p1, None) < plugin.queue_key(p2, None)
+
+    def _pod(self, name, priority=0, qos="besteffort", created=0):
+        kw = dict(uid=f"default/{name}", name=name, priority=priority,
+                  creation_ms=created)
+        if qos == "guaranteed":
+            kw["containers"] = [Container(
+                requests={CPU: 100, MEMORY: 1 << 20},
+                limits={CPU: 100, MEMORY: 1 << 20})]
+        elif qos == "burstable":
+            kw["containers"] = [Container(requests={CPU: 100})]
+        else:
+            kw["containers"] = [Container()]
+        return Pod(**kw)
+
+    def test_priority_dominates(self):
+        assert self._less(self._pod("a", priority=2),
+                          self._pod("b", priority=1)) is True
+        assert self._less(self._pod("a", priority=1),
+                          self._pod("b", priority=2)) is False
+
+    def test_best_efforts_tie_break_on_queue_time(self):
+        assert self._less(self._pod("a", created=10),
+                          self._pod("b", created=5)) is False
+
+    def test_qos_class_ordering(self):
+        assert self._less(self._pod("a", qos="besteffort"),
+                          self._pod("b", qos="guaranteed")) is False
+        assert self._less(self._pod("a", qos="burstable"),
+                          self._pod("b", qos="guaranteed")) is False
+        assert self._less(self._pod("a", qos="guaranteed"),
+                          self._pod("b", qos="burstable")) is True
+
+    def test_burstable_tie_break_on_queue_time(self):
+        assert self._less(self._pod("a", qos="burstable", created=10),
+                          self._pod("b", qos="burstable", created=5)) is False
