@@ -23,8 +23,27 @@ multichip:
 tpu-smoke:
 	$(PY) bench.py --config 0
 
+# verify composes the READ-ONLY gate (tpu-lower-check): it must never
+# rewrite the committed manifest as a side effect — refreshing digests is
+# the explicit `make tpu-lower`
 .PHONY: verify
-verify: test multichip
+verify: test multichip lint tpu-lower-check
+
+.PHONY: lint
+lint:
+	$(PY) tools/graft_lint.py
+
+# AOT-lower every bench program + both sharded solves + entry() to TPU
+# StableHLO, scan for CLAUDE.md landmines, refresh docs/tpu_lowering.json
+.PHONY: tpu-lower
+tpu-lower:
+	$(PY) tools/tpu_lower.py
+
+# read-only CI gate: lowering + landmines + digest drift vs the committed
+# manifest (digest equality enforced only under the manifest's jax version)
+.PHONY: tpu-lower-check
+tpu-lower-check:
+	$(PY) tools/tpu_lower.py --check
 
 .PHONY: native
 native:

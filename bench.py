@@ -34,11 +34,23 @@ def apply_platform_override():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
-def backend_probe(timeout=90):
+def backend_probe(timeout=None):
     """CLAUDE.md tunnel probe: an 8x8 matmul must round-trip through a host
     transfer before anything else runs. In a subprocess so a dead axon tunnel
     (which blocks forever at 0% CPU) cannot hang the bench itself; returns
-    None when healthy, else a short diagnosis string."""
+    None when healthy, else a short diagnosis string.
+
+    The timeout is SHORT by design (default 45s, `SPT_PROBE_TIMEOUT_S`
+    overrides): the driver runs each config under a ~90s budget, so a sick
+    backend must be stamped `tpu-backend-unavailable` in half the budget
+    instead of burning all of it per config. Not shorter: a HEALTHY cold
+    tunnel pays jax import + first TPU compile (~20-40s observed) before
+    the matmul answers — a 20s probe would misclassify exactly the healthy
+    windows the north star needs."""
+    import os
+
+    if timeout is None:
+        timeout = float(os.environ.get("SPT_PROBE_TIMEOUT_S", 45))
     # self-contained (no `import bench`: the subprocess inherits the caller's
     # cwd, which need not be the repo root)
     code = (
@@ -120,17 +132,28 @@ def _backend_label():
         return "unknown"
 
 
-def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None):
+def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
+          drift=None):
     """One JSON line. `vs_baseline` is the honest headline: measured against
     the COMPILED reference-shaped loop (`bridge/ref_baseline.cc`) when it is
     available — the reference is compiled Go, so a pure-Python denominator
     flatters every multiplier. The Python-loop ratio stays as a secondary
-    column (`vs_python_baseline`)."""
+    column (`vs_python_baseline`).
+
+    `drift` is the placement-quality column, present in EVERY line (null
+    only when no anchor exists, e.g. native build unavailable): relative
+    score-sum drift of the emitted placements vs the BIT-FAITHFUL
+    sequential semantics on the shared cycle-initial objective. Sequential
+    configs anchor at 0.0 by definition; the batched modes report their
+    measured trade (cfg2's f32 curve knife edges); the flagship configs
+    (0/1/6) anchor on the compiled alloc loop, which is placement-identical
+    to the sequential path on the allocatable profile."""
     line = {
         "metric": metric,
         "value": round(pods_per_sec, 1),
         "unit": f"pods/s ({detail})",
         "backend": _backend_label(),
+        "drift": None if drift is None else round(drift, 4),
     }
     if compiled is not None and compiled > 0:
         line["vs_baseline"] = round(pods_per_sec / compiled, 2)
@@ -146,44 +169,65 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None):
 
 
 def _compiled_baseline(config, snap, meta, weights=None, plugins=None):
-    """pods/s of the compiled reference-shaped loop for this config's
-    snapshot, or None when the native build is unavailable. Real (node, pod)
-    counts come from meta so the denominator scans the reference's cluster
-    shape, not the snapshot's padded buckets."""
+    """(pods/s, placements) of the compiled reference-shaped loop for this
+    config's snapshot, or (None, None) when the native build is unavailable.
+    Real (node, pod) counts come from meta so the denominator scans the
+    reference's cluster shape, not the snapshot's padded buckets. The
+    placements feed the per-line `drift` column."""
     try:
         from scheduler_plugins_tpu.bridge import ref_baseline as rb
 
         kw = dict(n_nodes=len(meta.node_names), n_pods=len(meta.pod_names))
-        if config in (1, 6):
-            rate, _, _ = rb.compiled_alloc_baseline(snap, weights, **kw)
+        if config in (0, 1, 6):
+            rate, _, out = rb.compiled_alloc_baseline(snap, weights, **kw)
         elif config == 2:
-            rate, _, _ = rb.compiled_trimaran_baseline(snap, **kw)
+            rate, _, out = rb.compiled_trimaran_baseline(snap, **kw)
         elif config == 3:
-            rate, _, _ = rb.compiled_numa_baseline(snap, **kw)
+            rate, _, out = rb.compiled_numa_baseline(snap, **kw)
         elif config == 4:
-            rate, _, _ = rb.compiled_gang_quota_baseline(snap, weights, **kw)
+            rate, _, out = rb.compiled_gang_quota_baseline(snap, weights, **kw)
         elif config == 5:
             net = next(
                 p for p in plugins if type(p).__name__ == "NetworkOverhead"
             )
-            rate, _, _ = rb.compiled_network_baseline(
+            rate, _, out = rb.compiled_network_baseline(
                 snap, net._zone_cost, net._region_cost, **kw
             )
         else:
-            return None
-        return rate
+            return None, None
+        return rate, out
     except Exception as exc:  # native toolchain unavailable: python-only
         print(f"# compiled baseline unavailable: {exc}", file=sys.stderr)
+        return None, None
+
+
+def _score_sum_drift(scores, ours, ref):
+    """Relative score-sum drift of `ours` vs `ref` placements on the
+    flagship's pod-invariant (N,) static allocatable objective (the
+    profile-general (P, N) form lives in
+    `parallel.solver.score_drift_vs_sequential`); unplaced/padded slots
+    carry -1 and contribute nothing. None when there are no reference
+    placements to compare against."""
+    if ref is None:
         return None
+    ref = np.asarray(ref)
+    ours = np.asarray(ours)[: len(ref)]
+
+    def ssum(a):
+        return int(scores[a[a >= 0]].sum())
+
+    s_ref = ssum(ref)
+    return (ssum(ours) - s_ref) / max(abs(s_ref), 1)
 
 
-def main(n_nodes=1024, n_pods=8192):
-    import jax
+def alloc_problem(n_nodes, n_pods):
+    """(cluster, snap, meta, weights) for the allocatable-profile configs —
+    the single construction bench and the AOT gate (tools/tpu_lower.py)
+    share."""
     import jax.numpy as jnp
 
     from scheduler_plugins_tpu.api.resources import CPU, MEMORY
     from scheduler_plugins_tpu.models import allocatable_scenario
-    from scheduler_plugins_tpu.parallel.solver import batch_solve
 
     cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=n_pods)
     pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
@@ -191,11 +235,30 @@ def main(n_nodes=1024, n_pods=8192):
     weights = jnp.asarray(
         meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
     )
+    return cluster, snap, meta, weights
 
-    solve = jax.jit(lambda s, w: batch_solve(s, w, max_waves=8))
-    # warmup/compile
+
+def flagship_solve(snap, weights):
+    """The flagship jitted step (configs 0/1): the full batched solve."""
+    from scheduler_plugins_tpu.parallel.solver import batch_solve
+
+    return batch_solve(snap, weights, max_waves=8)
+
+
+def main(n_nodes=None, n_pods=None):
+    import jax
+
+    n_nodes = n_nodes or FLAGSHIP_SHAPE["n_nodes"]
+    n_pods = n_pods or FLAGSHIP_SHAPE["n_pods"]
+    cluster, snap, meta, weights = alloc_problem(n_nodes, n_pods)
+
+    solve = jax.jit(flagship_solve)
+    # warmup/compile; host transfer, not block_until_ready — the latter can
+    # return early through the tunneled backend (CLAUDE.md). The warmup
+    # solves the UNPERTURBED snapshot: its placements anchor the drift
+    # column (the timed runs perturb one request for cache busting)
     assignment, admitted, wait = solve(snap, weights)
-    assignment.block_until_ready()
+    warm_np = np.asarray(assignment)
 
     # median of fully-synchronized runs with perturbed inputs; completion is
     # forced by a host transfer of the assignment (block_until_ready can
@@ -217,23 +280,63 @@ def main(n_nodes=1024, n_pods=8192):
     pods_per_sec = n_pods / elapsed
 
     baseline = python_baseline_pods_per_sec(cluster)
+    compiled, ref_out = _compiled_baseline(1, snap, meta, weights=weights)
     _emit(
         "pods_scheduled_per_sec",
         pods_per_sec,
         f"{n_nodes} nodes x {n_pods} pods, {placed} placed",
         baseline,
-        compiled=_compiled_baseline(1, snap, meta, weights=weights),
+        compiled=compiled,
+        drift=_score_sum_drift(
+            _alloc_objective(snap, weights), warm_np, ref_out
+        ),
     )
 
 
-def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
-    """The BASELINE.json headline scale: 10k nodes x 100k pending pods.
+def _alloc_objective(snap, weights):
+    """(N,) static allocatable node scores — the flagship's pod-invariant
+    cycle-initial objective (the reference scores allocatable, not free
+    capacity), shared by the drift column of configs 0/1/6."""
+    from scheduler_plugins_tpu.ops.allocatable import (
+        MODE_LEAST,
+        allocatable_scores,
+    )
 
-    Pods stream through the batched waterfill in queue-order chunks with
-    free capacity carried between chunks (chunk boundaries preserve the
-    queue order the sequential semantics define), bounding the (P, N)
-    working set to one chunk."""
-    import jax
+    return np.asarray(allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST))
+
+
+#: the north-star chunk-loop shapes (BASELINE.json headline scale) — shared
+#: with the AOT compile-readiness gate (tools/tpu_lower.py) so the program
+#: it certifies is the program this file ships
+NORTH_STAR_SHAPE = dict(n_nodes=10_240, n_pods=102_400, chunk=8192)
+FLAGSHIP_SHAPE = dict(n_nodes=1024, n_pods=8192)
+SMOKE_SHAPE = dict(n_nodes=64, n_pods=256)
+
+
+def north_star_solve_chunk(raw, node_mask, req_chunk, mask_chunk, free0):
+    """One north-star chunk: static allocatable scores -> targeted
+    waterfill, O(P*R) per lite wave instead of the (P, N) matrix (masked
+    nodes fit nothing with zeroed free capacity). rescue_window=256 halves
+    the end-game (K, N) rescue cost at this scale (63k -> 114k pods/s;
+    8 waves x 256 slots still drains every straggler, all pods placed).
+
+    Chunk-invariant tensors (raw scores, node mask) are ARGUMENTS, not jit
+    closure captures, so the compiled program is exactly the one
+    tools/tpu_lower.py lowers and digests."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
+
+    return waterfill_assign_targeted(
+        raw, req_chunk, mask_chunk,
+        jnp.where(node_mask[:, None], free0, 0), max_waves=8,
+        rescue_window=256,
+    )
+
+
+def north_star_problem(n_nodes, n_pods, chunk):
+    """(snap, meta, weights, raw, padded) for the chunked north-star run —
+    the single construction bench and the AOT gate share."""
     import jax.numpy as jnp
 
     from scheduler_plugins_tpu.api.resources import CPU, MEMORY
@@ -243,8 +346,6 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
         allocatable_scores,
         demote_scores_int32,
     )
-    from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
-    from scheduler_plugins_tpu.ops.fit import free_capacity
 
     cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=n_pods)
     pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
@@ -252,41 +353,54 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     padded = ((n_pods + chunk - 1) // chunk) * chunk
     snap, meta = cluster.snapshot(pending, now_ms=0, pad_pods=padded)
     weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
-
     raw = demote_scores_int32(
         allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
     ).astype(jnp.int64)
+    return cluster, snap, meta, weights, raw, padded
+
+
+def north_star(n_nodes=None, n_pods=None, chunk=None):
+    """The BASELINE.json headline scale: 10k nodes x 100k pending pods.
+
+    Pods stream through the batched waterfill in queue-order chunks with
+    free capacity carried between chunks (chunk boundaries preserve the
+    queue order the sequential semantics define), bounding the (P, N)
+    working set to one chunk."""
+    import jax
+
+    from scheduler_plugins_tpu.ops.fit import free_capacity
+
+    n_nodes = n_nodes or NORTH_STAR_SHAPE["n_nodes"]
+    n_pods = n_pods or NORTH_STAR_SHAPE["n_pods"]
+    chunk = chunk or NORTH_STAR_SHAPE["chunk"]
+    cluster, snap, meta, weights, raw, padded = north_star_problem(
+        n_nodes, n_pods, chunk
+    )
     node_mask = snap.nodes.mask
 
-    def solve_chunk(req_chunk, mask_chunk, free0):
-        # static allocatable scores -> targeted waterfill: O(P*R) per lite
-        # wave instead of the (P, N) matrix (masked nodes fit nothing with
-        # zeroed free capacity). rescue_window=256 halves the end-game
-        # (K, N) rescue cost at this scale (63k -> 114k pods/s; 8 waves x
-        # 256 slots still drains every straggler, all pods placed)
-        return waterfill_assign_targeted(
-            raw, req_chunk, mask_chunk,
-            jnp.where(node_mask[:, None], free0, 0), max_waves=8,
-            rescue_window=256,
-        )
-
-    solve_chunk = jax.jit(solve_chunk)
+    solve_chunk = jax.jit(north_star_solve_chunk)
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     # warm up compile on the first chunk shape
-    a, f = solve_chunk(snap.pods.req[:chunk], snap.pods.mask[:chunk], free)
+    a, f = solve_chunk(
+        raw, node_mask, snap.pods.req[:chunk], snap.pods.mask[:chunk], free
+    )
     np.asarray(a)
 
     start = time.perf_counter()
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     placed = 0
     chunk_done_s = []  # completion time of each chunk since submission
+    chunk_assignments = []
     for lo in range(0, padded, chunk):
         a, free = solve_chunk(
+            raw, node_mask,
             snap.pods.req[lo:lo + chunk], snap.pods.mask[lo:lo + chunk], free
         )
         # per-chunk host sync: chaining chunks device-side balloons the
         # in-flight working set through the tunneled backend
-        placed += int((np.asarray(a) >= 0).sum())
+        a_np = np.asarray(a)
+        chunk_assignments.append(a_np)
+        placed += int((a_np >= 0).sum())
         chunk_done_s.append(time.perf_counter() - start)
     elapsed = time.perf_counter() - start
     # BASELINE.json names p99 scheduling latency alongside throughput: a
@@ -296,12 +410,18 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     # by chunk size
     pod_latency_s = np.repeat(chunk_done_s, chunk)[:n_pods]
     baseline = python_baseline_pods_per_sec(cluster, sample=40)
+    compiled, ref_out = _compiled_baseline(6, snap, meta, weights=weights)
     _emit(
         "north_star_pods_per_sec",
         n_pods / elapsed,
         f"{n_nodes} nodes x {n_pods} pods chunked x{chunk}, {placed} placed",
         baseline,
-        compiled=_compiled_baseline(6, snap, meta, weights=weights),
+        compiled=compiled,
+        drift=_score_sum_drift(
+            _alloc_objective(snap, weights),
+            np.concatenate(chunk_assignments)[:n_pods],
+            ref_out,
+        ),
         extra={
             "pod_latency_p50_ms": round(
                 float(np.percentile(pod_latency_s, 50)) * 1000, 1),
@@ -311,28 +431,22 @@ def north_star(n_nodes=10_240, n_pods=102_400, chunk=8192):
     )
 
 
-def tpu_smoke(n_nodes=64, n_pods=256):
+def tpu_smoke(n_nodes=None, n_pods=None):
     """Tiny-shape on-chip smoke (VERDICT r4 item 1a): one `batch_solve` at
     64x256 through the tunnel — seconds, not minutes — so even a short
     healthy window yields a verified on-chip artifact AND confirms the
     targeted waterfill's argsort/cummax/scatter chains compile on TPU.
     Same measurement discipline as the flagship (host-transfer timing)."""
     import jax
-    import jax.numpy as jnp
 
-    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
-    from scheduler_plugins_tpu.models import allocatable_scenario
-    from scheduler_plugins_tpu.parallel.solver import batch_solve
+    n_nodes = n_nodes or SMOKE_SHAPE["n_nodes"]
+    n_pods = n_pods or SMOKE_SHAPE["n_pods"]
+    cluster, snap, meta, weights = alloc_problem(n_nodes, n_pods)
 
-    cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=n_pods)
-    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
-    snap, meta = cluster.snapshot(pending, now_ms=0)
-    weights = jnp.asarray(meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64)
-
-    solve = jax.jit(lambda s, w: batch_solve(s, w, max_waves=8))
+    solve = jax.jit(flagship_solve)
     compile_start = time.perf_counter()
     assignment, _, _ = solve(snap, weights)
-    np.asarray(assignment)
+    warm_np = np.asarray(assignment)  # unperturbed placements: drift anchor
     compile_s = time.perf_counter() - compile_start
 
     times = []
@@ -349,11 +463,16 @@ def tpu_smoke(n_nodes=64, n_pods=256):
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment_np >= 0).sum())
     baseline = python_baseline_pods_per_sec(cluster, sample=100)
+    compiled, ref_out = _compiled_baseline(0, snap, meta, weights=weights)
     _emit(
         "tpu_smoke_pods_per_sec",
         n_pods / elapsed,
         f"{n_nodes} nodes x {n_pods} pods smoke, {placed} placed",
         baseline,
+        compiled=compiled,
+        drift=_score_sum_drift(
+            _alloc_objective(snap, weights), warm_np, ref_out
+        ),
         extra={"compile_seconds": round(compile_s, 1)},
     )
 
@@ -421,12 +540,10 @@ def metric_name(config: int, mode: str = "sequential") -> str:
     return metric
 
 
-def sequential_config(config: int, mode: str = "sequential"):
-    """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
-    profile-generic batched throughput mode (--mode batch)."""
-    import jax  # noqa: F401
-
-    from scheduler_plugins_tpu.framework import Profile, Scheduler
+def config_problem(config: int):
+    """(cluster, plugins, detail) — the BASELINE config 2-5 scenario/roster
+    table. The ONE copy of these shapes: bench runs them and the AOT gate
+    (tools/tpu_lower.py) lowers them, so they cannot drift apart."""
     from scheduler_plugins_tpu.models import (
         gang_quota_scenario,
         network_scenario,
@@ -453,6 +570,17 @@ def sequential_config(config: int, mode: str = "sequential"):
         detail = "1024 nodes multi-region, sequential"
     else:
         raise SystemExit(f"unknown config {config}")
+    return cluster, plugins, detail
+
+
+def sequential_config(config: int, mode: str = "sequential"):
+    """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
+    profile-generic batched throughput mode (--mode batch)."""
+    import jax  # noqa: F401
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+
+    cluster, plugins, detail = config_problem(config)
     metric = metric_name(config, mode)
 
     scheduler = Scheduler(Profile(plugins=plugins))
@@ -488,6 +616,12 @@ def sequential_config(config: int, mode: str = "sequential"):
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment >= 0).sum())
     baseline = python_baseline_pods_per_sec(cluster, sample=100)
+    compiled, _ = _compiled_baseline(
+        config, snap, meta, weights=weights, plugins=plugins
+    )
+    # sequential mode IS the bit-faithful quality anchor: drift 0 by
+    # definition; batch mode reports its measured drift below
+    drift = 0.0
     extra = None
     if mode == "batch":
         # placement-quality cost of the throughput path, surfaced per run
@@ -508,10 +642,7 @@ def sequential_config(config: int, mode: str = "sequential"):
             "placed_sequential": placed_seq,
         }
     _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
-          baseline, compiled=_compiled_baseline(config, snap, meta,
-                                                weights=weights,
-                                                plugins=plugins),
-          extra=extra)
+          baseline, compiled=compiled, drift=drift, extra=extra)
 
 
 if __name__ == "__main__":
@@ -552,7 +683,8 @@ if __name__ == "__main__":
         # one parseable line, rc=0 — the environment is sick, not the code
         print(json.dumps({
             "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
-            "vs_baseline": 0.0, "error": "tpu-backend-unavailable",
+            "vs_baseline": 0.0, "drift": None,
+            "error": "tpu-backend-unavailable",
             "detail": diagnosis,
         }))
         sys.exit(0)

@@ -49,7 +49,15 @@ class QOSClass(enum.IntEnum):
     GUARANTEED = 2
 
 
-class PodPhase(enum.StrEnum):
+if hasattr(enum, "StrEnum"):  # 3.11+
+    _StrEnum = enum.StrEnum
+else:  # 3.10 fallback with StrEnum's str()/format() semantics
+    class _StrEnum(str, enum.Enum):
+        __str__ = str.__str__
+        __format__ = str.__format__
+
+
+class PodPhase(_StrEnum):
     PENDING = "Pending"
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
@@ -486,7 +494,7 @@ class Node:
 # ---------------------------------------------------------------------------
 
 
-class PodGroupPhase(enum.StrEnum):
+class PodGroupPhase(_StrEnum):
     """PodGroup status phase machine
     (/root/reference/apis/scheduling/v1alpha1/types.go:120-150)."""
 

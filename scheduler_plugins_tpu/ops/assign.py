@@ -291,14 +291,25 @@ def waterfill_assign_stateful(
             # cross-node hard constraints: sequential queue-order re-check
             # of this wave's winners against the live carry; kept pods
             # commit immediately so later pods in the same wave see them
-            def vstep(vstate, j):
+            # explicit int32-counter while_loop, not lax.scan: with x64 on,
+            # scan lowers its xs-slicing/ys-stacking through an i64 loop
+            # counter, and an i64 dynamic-slice start on these POD-SHARDED
+            # rows trips older XLA spmd partitioners (s64 index vs s32
+            # shard-offset compare fails the HLO verifier)
+            def vstep(carry):
+                vstate, kept, j = carry
                 act = admitted[j]
-                ok = act & validate_fn(vstate, idx[j], choice[j])
+                q = idx[j].astype(jnp.int32)
+                ok = act & validate_fn(vstate, q, choice[j])
                 kept_choice = jnp.where(ok, choice[j], jnp.int32(-1))
-                vstate = validate_commit_fn(vstate, idx[j], kept_choice)
-                return vstate, ok
+                vstate = validate_commit_fn(vstate, q, kept_choice)
+                return vstate, kept.at[j].set(ok), j + 1
 
-            state, kept = jax.lax.scan(vstep, state, jnp.arange(Ssub))
+            state, kept, _ = jax.lax.while_loop(
+                lambda c: c[2] < Ssub,
+                vstep,
+                (state, jnp.zeros(Ssub, bool), jnp.int32(0)),
+            )
             admitted = kept
 
         new_assignment = assignment.at[idx].set(

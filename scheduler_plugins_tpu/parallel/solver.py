@@ -245,7 +245,20 @@ def batch_solve(snap, weights, max_waves: int = 8):
 
 
 def profile_batch_solve(scheduler, snap, max_waves: int = 8):
-    """Throughput mode for an ARBITRARY plugin profile: the same plugin
+    """Run `profile_batch_fn`'s jitted solve — see that docstring for the
+    semantics contract vs the sequential parity path."""
+    fn, args = profile_batch_fn(scheduler, snap, max_waves=max_waves)
+    return fn(*args)
+
+
+def profile_batch_fn(scheduler, snap, max_waves: int = 8):
+    """(jitted_fn, args) for the batched profile solve on `snap`, WITHOUT
+    invoking it — the AOT seam: `tools/tpu_lower.py` exports exactly the
+    callable the runtime executes (same trace-cache, same fast-path gate),
+    so compile-readiness evidence covers the shipped program, not a
+    re-derivation of it.
+
+    Throughput mode for an ARBITRARY plugin profile: the same plugin
     tensor methods the sequential scan fuses are vmapped over the pod batch,
     then placed wave-parallel.
 
@@ -353,7 +366,7 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
         cache = scheduler._solve_cache
         if key not in cache:
             cache[key] = jax.jit(fast_batch)
-        return cache[key](snap, state0, auxes)
+        return cache[key], (snap, state0, auxes)
     # ------------------------------------------------------------------
 
     def batch(snap, state0, auxes):
@@ -590,7 +603,7 @@ def profile_batch_solve(scheduler, snap, max_waves: int = 8):
     cache = scheduler._solve_cache
     if key not in cache:
         cache[key] = jax.jit(batch)
-    return cache[key](snap, state0, auxes)
+    return cache[key], (snap, state0, auxes)
 
 
 def profile_initial_scores(scheduler, snap):
@@ -668,10 +681,10 @@ def score_drift_vs_sequential(scheduler, snap, seq_assignment,
 def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
     """Jit `batch_solve` with the snapshot sharded over `mesh`; XLA inserts
     the cross-shard collectives."""
-    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+    from scheduler_plugins_tpu.parallel.mesh import ambient_mesh, shard_snapshot
 
     snap = shard_snapshot(snap, mesh)
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         fn = jax.jit(lambda s, w: batch_solve(s, w, max_waves))
         return fn(snap, weights)
 
@@ -691,8 +704,8 @@ def sharded_profile_batch_solve(scheduler, snap, mesh, max_waves: int = 8):
     Placement semantics are those of `profile_batch_solve` (sharding never
     changes the math, only its partitioning); `tests/test_parallel.py`
     asserts sharded == unsharded placements on an 8-device CPU mesh."""
-    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+    from scheduler_plugins_tpu.parallel.mesh import ambient_mesh, shard_snapshot
 
     snap = shard_snapshot(snap, mesh)
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return profile_batch_solve(scheduler, snap, max_waves=max_waves)

@@ -1,0 +1,21 @@
+"""Golden-bad: plugin config array read directly in a jitted tensor method
+instead of flowing through the aux() channel (GL001)."""
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.framework.plugin import Plugin
+
+
+class ClosureCapturePlugin(Plugin):
+    name = "ClosureCapturePlugin"
+
+    def prepare(self, meta):
+        self._cost_table = jnp.asarray([[1, 2], [3, 4]])
+
+    def aux(self):
+        return self._cost_table
+
+    def score(self, state, snap, p):
+        # BAD: reads the host-built array inside the traced solve — jit
+        # constant-folds it per shape and it silently goes stale
+        return self._cost_table[snap.pods.ns[p]]

@@ -1,0 +1,9 @@
+"""Golden-bad: 2-D int64 cumsum — vmem-hungry reduce_window on TPU (GL002)."""
+
+import jax.numpy as jnp
+
+
+def prefix_usage(charge):
+    charge64 = charge.astype(jnp.int64)
+    # BAD: multi-axis int64 cumsum lowers to an i64 reduce_window on TPU
+    return jnp.cumsum(charge64, axis=0)

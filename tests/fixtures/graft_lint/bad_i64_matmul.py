@@ -1,0 +1,15 @@
+"""Golden-bad: int64 matmul — unsupported dot_general on TPU (GL003)."""
+
+import jax.numpy as jnp
+
+
+def nominated_aggregates(mask, req):
+    # BAD: s64 dot_general does not lower on TPU
+    return mask.astype(jnp.int64).T @ req.astype(jnp.int64)
+
+
+def explicit_dot(a, b):
+    a64 = jnp.asarray(a, jnp.int64)
+    b64 = b.astype(jnp.int64)
+    # BAD: same landmine through jnp.dot on int64 locals
+    return jnp.dot(a64, b64)

@@ -1,0 +1,12 @@
+"""Golden-bad: hardcoded resource-axis slot indices — the axis order is
+owned by api.resources.CANONICAL and mirrored by the C++ bridge (GL005)."""
+
+
+def pods_slot_demand(req):
+    # BAD: slot 3 is "pods" only while CANONICAL says so
+    return req[:, 3]
+
+
+def cpu_weight(weights):
+    # BAD: slot 0 is "cpu" by convention, not by contract
+    return weights[0]

@@ -1,0 +1,53 @@
+"""Clean counterpart: every landmine's sanctioned idiom in one file."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_plugins_tpu.api.resources import CANONICAL
+from scheduler_plugins_tpu.framework.plugin import Plugin
+
+_PODS_I = CANONICAL.index("pods")
+
+
+def nominated_aggregates(mask, req):
+    # float64 matmul: exact below 2^53, lowers on TPU
+    return (
+        mask.astype(jnp.float64).T @ req.astype(jnp.float64)
+    ).astype(jnp.int64)
+
+
+def prefix_usage(charge):
+    # float64 multi-axis cumsum (exact) and 1-D int64 cumsum are both fine
+    return jnp.cumsum(charge.astype(jnp.float64), axis=0)
+
+
+def prefix_1d(flags):
+    return jnp.cumsum(flags.astype(jnp.int64))
+
+
+def pods_slot_demand(req):
+    return req[:, _PODS_I]
+
+
+def bench_step(solve, snap):
+    start = time.perf_counter()
+    out = solve(snap)
+    np.asarray(out)  # host transfer forces completion
+    return time.perf_counter() - start
+
+
+class AuxPlugin(Plugin):
+    name = "AuxPlugin"
+
+    def prepare(self, meta):
+        self._cost_table = jnp.asarray([[1, 2], [3, 4]])
+
+    def aux(self):
+        return self._cost_table
+
+    def score(self, state, snap, p):
+        if self._cost_table is None:  # presence check: trace-time config
+            return None
+        return self._aux[snap.pods.ns[p]]

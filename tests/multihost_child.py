@@ -61,14 +61,21 @@ def main(proc_id: int, port: str, out_path: str) -> None:
         # corrupt the non-owner's copy: the broadcast must win
         snap = snap.replace(pods=snap.pods.replace(req=snap.pods.req * 0 + 1))
 
-    snap = launch.broadcast_snapshot(snap)
-    mesh = launch.make_multihost_mesh()
-    assert mesh.devices.size == 8 and jax.process_count() == 2
+    try:
+        snap = launch.broadcast_snapshot(snap)
+        mesh = launch.make_multihost_mesh()
+        assert mesh.devices.size == 8 and jax.process_count() == 2
 
-    weights = jnp.asarray(
-        meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
-    )
-    assignment = launch.distributed_solve(snap, mesh, weights)
+        weights = jnp.asarray(
+            meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+        )
+        assignment = launch.distributed_solve(snap, mesh, weights)
+    except Exception as exc:  # jaxlib capability gap, not a code bug
+        if "Multiprocess computations aren't implemented" in str(exc):
+            # older jaxlib CPU backends have no cross-process collectives;
+            # exit with the sentinel the parent test maps to pytest.skip
+            sys.exit(42)
+        raise
 
     with open(out_path, "w") as f:
         json.dump({

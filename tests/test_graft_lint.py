@@ -1,0 +1,151 @@
+"""Linter tests (tools/graft_lint.py): each golden-bad fixture must be
+flagged with its rule, the clean fixture and the current source tree must
+pass, and suppression comments must work."""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.graft_lint import DEFAULT_PATHS, REPO, lint_paths
+
+FIXTURES = Path(__file__).parent / "fixtures" / "graft_lint"
+
+
+def rules_for(path):
+    return {f.rule for f in lint_paths([path])}
+
+
+class TestGoldenBad:
+    @pytest.mark.parametrize(
+        "fixture, rule",
+        [
+            ("bad_i64_matmul.py", "GL003"),
+            ("bad_i64_cumsum2d.py", "GL002"),
+            ("bad_closure_config.py", "GL001"),
+            ("bad_resource_slot.py", "GL005"),
+            ("bad_block_timing.py", "GL004"),
+        ],
+    )
+    def test_flagged(self, fixture, rule):
+        assert rule in rules_for(FIXTURES / fixture)
+
+    def test_matmul_fixture_flags_both_sites(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_i64_matmul.py"])
+            if f.rule == "GL003"
+        ]
+        assert len(findings) == 2  # the @ operator AND the jnp.dot call
+
+
+class TestClean:
+    def test_good_fixture_clean(self):
+        assert lint_paths([FIXTURES / "good_clean.py"]) == []
+
+    def test_source_tree_clean(self):
+        findings = lint_paths([str(REPO / p) for p in DEFAULT_PATHS])
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+class TestSuppression:
+    def test_ignore_comment(self, tmp_path):
+        f = tmp_path / "suppressed.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def g(a, b):
+                a64 = a.astype(jnp.int64)
+                return a64 @ b  # graft-lint: ignore[GL003]
+        """))
+        assert lint_paths([f]) == []
+
+    def test_ignore_other_rule_does_not_suppress(self, tmp_path):
+        f = tmp_path / "wrong_rule.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def g(a, b):
+                a64 = a.astype(jnp.int64)
+                return a64 @ b  # graft-lint: ignore[GL001]
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL003"}
+
+
+class TestConservatism:
+    """Unknown dtypes must never fire (the lint is evidence-based)."""
+
+    def test_unknown_dtype_matmul_not_flagged(self, tmp_path):
+        f = tmp_path / "unknown.py"
+        f.write_text(textwrap.dedent("""\
+            def g(a, b):
+                return a @ b
+        """))
+        assert lint_paths([f]) == []
+
+    def test_positional_axis_i64_cumsum_flagged(self, tmp_path):
+        # regression: axis passed positionally must not evade GL002
+        f = tmp_path / "pos_axis.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def g(x):
+                x64 = x.astype(jnp.int64)
+                return jnp.cumsum(x64, 1)
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL002"}
+
+    def test_explicit_axis_none_i64_cumsum_not_flagged(self, tmp_path):
+        # axis=None flattens — the benign 1-D form, keyword-explicit
+        f = tmp_path / "axis_none.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def g(x):
+                x64 = x.astype(jnp.int64)
+                return jnp.cumsum(x64, axis=None)
+        """))
+        assert lint_paths([f]) == []
+
+    def test_int32_cumsum_with_axis_not_flagged(self, tmp_path):
+        f = tmp_path / "i32.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def g(x):
+                return jnp.cumsum(x.astype(jnp.int64), axis=1,
+                                  dtype=jnp.int32)
+        """))
+        assert lint_paths([f]) == []
+
+    def test_nested_scope_shadowing_not_flagged(self, tmp_path):
+        # an enclosing int64 local must not taint a nested function's
+        # shadowing parameter of the same name
+        f = tmp_path / "nested.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def outer(x, fs):
+                a = x.astype(jnp.int64)
+                def inner(a, b):
+                    return a @ b
+                return inner(fs, fs), a
+        """))
+        assert lint_paths([f]) == []
+
+    def test_nested_scope_finding_reported_once(self, tmp_path):
+        f = tmp_path / "nested_bad.py"
+        f.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+
+            def outer(x, y):
+                def inner():
+                    x64 = x.astype(jnp.int64)
+                    return x64 @ y
+                return inner()
+        """))
+        findings = lint_paths([f])
+        assert len(findings) == 1 and findings[0].rule == "GL003"
+
+    def test_presence_check_not_flagged(self):
+        # good_clean.AuxPlugin.score tests `self._cost_table is None`
+        assert "GL001" not in rules_for(FIXTURES / "good_clean.py")

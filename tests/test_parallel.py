@@ -802,6 +802,10 @@ class TestTwoProcessDistributed:
                 p.kill()
                 _, err = p.communicate()
             errs.append(err)
+        if any(p.returncode == 42 for p in procs):
+            import pytest
+
+            pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
         assert all(p.returncode == 0 for p in procs), errs
         results = [_json.loads(o.read_text()) for o in outs]
         assert all(r["processes"] == 2 and r["devices"] == 8 for r in results)

@@ -143,8 +143,11 @@ class TestPeaks:
             jnp.array([1.0, 2.0]), jnp.array([0.1, 0.1]),
         )
         expected0 = math.trunc((math.exp(1.5) - math.exp(1.0)) * 1e15)
-        assert int(s[0]) == expected0
-        assert int(s[1]) == 2 * expected0
+        # XLA's exp and host libm may differ in the last ulp depending on
+        # jaxlib/cpu; at the 1e15 scale that is |delta| <= 2 after trunc —
+        # the ordering (which is what Peaks ranks on) is unaffected
+        assert abs(int(s[0]) - expected0) <= 2
+        assert abs(int(s[1]) - 2 * expected0) <= 4
         from scheduler_plugins_tpu.ops.normalize import peaks_normalize
 
         norm = peaks_normalize(s[None, :], jnp.ones((1, 2), bool))

@@ -1,0 +1,554 @@
+#!/usr/bin/env python
+"""AST lint enforcing the CLAUDE.md invariants that only bite at
+compile/runtime today (pure stdlib — no jax import, no tracing):
+
+- **GL001 aux-closure-capture** — plugin config arrays must flow through the
+  `aux()` channel (read back as `self._aux` after `bind_aux`), never read
+  directly inside jitted tensor methods: jit caches the traced program by
+  shape, so a closure-captured array is constant-folded and silently goes
+  stale when config or name<->code layouts change between cycles.
+- **GL002 i64-2d-cumsum** — no `jnp.cumsum` on int64 arrays with an `axis=`
+  argument (the 2-D form): it lowers to vmem-hungry reduce-windows on TPU
+  and can hang compiles. Use 1-D scans over sorted segments, float64
+  (exact < 2^53), or an explicit int32 dtype.
+- **GL003 i64-matmul** — no `@` / `jnp.dot` / `jnp.matmul` /
+  `lax.dot_general` on int64 operands: int64 `dot_general` is unsupported
+  on TPU.
+- **GL004 block-until-ready-timing** — no `block_until_ready()` in a
+  function that also reads a wall clock: it can return early through the
+  axon tunnel; force completion with a host transfer (`np.asarray(x)`).
+- **GL005 resource-slot-literal** — resource-axis positions must come from
+  `api.resources.CANONICAL` / `meta.index.position(...)`, never hardcoded
+  slot integers: the C++ bridge (`bridge/snapshot_store.cc`) hardcodes the
+  same slots, so silent drift is silent data corruption.
+
+Dtype inference is deliberately conservative: a rule fires only when an
+operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
+array constructor, a local name assigned from one, or a known int64
+snapshot field like `.req`/`.alloc`). Unknown dtypes never fire.
+
+Suppress a finding with a trailing `# graft-lint: ignore[GLxxx]` comment.
+
+Usage: python tools/graft_lint.py [paths...]   (default: the source tree)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: default lint scope: the package plus the two driver entry files
+DEFAULT_PATHS = ("scheduler_plugins_tpu", "bench.py", "__graft_entry__.py")
+
+INT64, INT32, FLOAT, BOOL, UNKNOWN = "int64", "int32", "float", "bool", None
+
+#: jitted tensor methods of the Plugin trait (framework/plugin.py) — code in
+#: these runs under trace, so host-built jnp arrays read here are closure
+#: captures. aux()/bind_aux and prepare_solve()/bind_presolve are the
+#: sanctioned channels.
+TENSOR_METHODS = frozenset({
+    "admit", "filter", "score", "normalize", "commit", "static_node_scores",
+    "filter_batch", "score_batch", "batch_rows", "wave_guard",
+    "wave_guard_demand", "wave_capacity", "validate_at", "commit_batch",
+    "prepare_solve",
+})
+#: host-side methods where building jnp arrays is fine (they run BEFORE the
+#: trace; arrays built here must then travel via aux()).
+HOST_BUILD_METHODS = frozenset({
+    "__init__", "prepare", "prepare_cluster", "configure_cluster",
+})
+#: attribute reads sanctioned inside tensor methods
+SANCTIONED_ATTRS = frozenset({"_aux", "_presolve"})
+
+#: jnp array constructors
+ARRAY_CTORS = frozenset({
+    "asarray", "array", "zeros", "ones", "full", "arange", "stack",
+    "concatenate", "eye", "linspace",
+})
+
+#: snapshot fields that are int64 by construction (state/snapshot.py lowers
+#: quantities as int64 in reference units)
+INT64_ATTRS = frozenset({"req", "alloc", "requested", "nom_req"})
+
+#: names that denote a (R,)-shaped resource vector — a literal-int subscript
+#: on these is a hardcoded resource slot
+RESOURCE_VECTOR_NAMES = re.compile(r"^(weights|w_res|resource_weights)$")
+#: names/attrs denoting (..., R)-shaped resource tensors — a literal int in
+#: the LAST position of a multi-axis subscript is a hardcoded resource slot
+RESOURCE_TENSOR_NAMES = re.compile(
+    r"^(req|reqs|quota_req|alloc|allocatable|free|free0|requested|capacity"
+    r"|demand|dem|usage|used|eq_used|q_min|q_max)$"
+)
+RESOURCE_TENSOR_ATTRS = frozenset({"req", "alloc", "requested", "nom_req"})
+
+MAX_CANONICAL_SLOT = 3  # cpu, memory, ephemeral-storage, pods
+
+
+class Finding:
+    def __init__(self, path, node, rule, message):
+        self.path = path
+        self.line = getattr(node, "lineno", 0)
+        self.col = getattr(node, "col_offset", 0)
+        self.rule = rule
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# dtype inference
+# ---------------------------------------------------------------------------
+
+
+def _dtype_from_dtype_expr(node):
+    """jnp.int64 / np.float64 / "int64" -> lattice tag."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    if name is None:
+        return UNKNOWN
+    if name in ("int64", "uint64"):
+        return INT64
+    if name in ("int32", "int16", "int8", "uint32", "uint16", "uint8"):
+        return INT32
+    if name.startswith("float") or name.startswith("bfloat"):
+        return FLOAT
+    if name.startswith("bool"):
+        return BOOL
+    return UNKNOWN
+
+
+def _call_dtype(node, env):
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "astype" and node.args:
+            return _dtype_from_dtype_expr(node.args[0])
+        if func.attr in ARRAY_CTORS:
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_from_dtype_expr(kw.value)
+            # positional dtype: asarray/array(x, D), full(shape, v, D),
+            # zeros/ones/arange(shape, D)
+            pos = {"asarray": 1, "array": 1, "zeros": 1, "ones": 1,
+                   "full": 2, "arange": None, "eye": None}.get(func.attr, None)
+            if pos is not None and len(node.args) > pos:
+                return _dtype_from_dtype_expr(node.args[pos])
+            if func.attr in ("asarray", "array") and len(node.args) >= 1:
+                return infer_dtype(node.args[0], env)
+            return UNKNOWN
+        if func.attr in ("cumsum", "cumprod", "where", "sum", "prod",
+                         "maximum", "minimum", "clip"):
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_from_dtype_expr(kw.value)
+            if func.attr == "where" and len(node.args) == 3:
+                return _combine(
+                    infer_dtype(node.args[1], env),
+                    infer_dtype(node.args[2], env),
+                )
+            if node.args:
+                return infer_dtype(node.args[0], env)
+        if func.attr in ("transpose", "reshape", "ravel", "squeeze", "copy"):
+            return infer_dtype(func.value, env)
+    return UNKNOWN
+
+
+def _combine(a, b):
+    if a == b:
+        return a
+    if FLOAT in (a, b):
+        # int64 + float -> float; but unknown + float stays unknown-float?
+        # conservative: float wins only when both sides are known
+        return FLOAT if UNKNOWN not in (a, b) else UNKNOWN
+    if UNKNOWN in (a, b):
+        return UNKNOWN
+    if INT64 in (a, b):
+        return INT64
+    return UNKNOWN
+
+
+def infer_dtype(node, env):
+    """Conservative dtype lattice walk; UNKNOWN when not provable."""
+    if isinstance(node, ast.Call):
+        return _call_dtype(node, env)
+    if isinstance(node, ast.Name):
+        return env.get(node.id, UNKNOWN)
+    if isinstance(node, ast.Attribute):
+        if node.attr == "T":
+            return infer_dtype(node.value, env)
+        if node.attr in INT64_ATTRS:
+            return INT64
+        return UNKNOWN
+    if isinstance(node, ast.Subscript):
+        return infer_dtype(node.value, env)
+    if isinstance(node, ast.UnaryOp):
+        return infer_dtype(node.operand, env)
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.MatMult):
+            return UNKNOWN
+        return _combine(
+            infer_dtype(node.left, env), infer_dtype(node.right, env)
+        )
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool):
+            return BOOL
+        if isinstance(node.value, float):
+            return FLOAT
+        return UNKNOWN  # python ints adopt the other operand's dtype
+    return UNKNOWN
+
+
+def build_env(fn_node):
+    """name -> dtype for single-dtype local assignments in one function."""
+    seen: dict[str, set] = {}
+    for node in _walk_scope(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                seen.setdefault(t.id, set()).add(
+                    infer_dtype(node.value, {})
+                )
+    env = {}
+    for name, dts in seen.items():
+        dts.discard(UNKNOWN)
+        if len(dts) == 1:
+            env[name] = next(iter(dts))
+    # second pass so names defined from other names resolve one level deep
+    for node in _walk_scope(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id not in env:
+                dt = infer_dtype(node.value, env)
+                if dt is not UNKNOWN:
+                    env[t.id] = dt
+    return env
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _is_jnp_call(node, names):
+    """Call like jnp.X / np.X / lax.X / jax.lax.X with X in names."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return isinstance(f, ast.Attribute) and f.attr in names
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield node
+
+
+def _walk_scope(fn):
+    """Walk one function's nodes WITHOUT descending into nested
+    function/lambda scopes: each nested scope is visited by its own
+    `_functions` pass with its own env, so an enclosing `a = x.astype(
+    jnp.int64)` cannot taint a nested function's shadowing parameter `a`
+    (and findings inside nested scopes aren't reported twice)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_matmul(path, tree, findings):
+    """GL003: int64 @ / dot / matmul / dot_general."""
+    for fn in _functions(tree):
+        env = build_env(fn)
+        for node in _walk_scope(fn):
+            operands = None
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                operands = (node.left, node.right)
+            elif _is_jnp_call(node, {"dot", "matmul", "dot_general", "vdot",
+                                     "tensordot", "einsum"}):
+                operands = tuple(node.args[:3])
+            if operands is None:
+                continue
+            for op in operands:
+                if infer_dtype(op, env) == INT64:
+                    findings.append(Finding(
+                        path, node, "GL003",
+                        "int64 matmul/dot_general: unsupported on TPU — "
+                        "cast to float64 (exact < 2^53) or float32",
+                    ))
+                    break
+
+
+def check_cumsum(path, tree, findings):
+    """GL002: jnp.cumsum on int64 with axis= (the 2-D form)."""
+    for fn in _functions(tree):
+        env = build_env(fn)
+        for node in _walk_scope(fn):
+            if not _is_jnp_call(node, {"cumsum"}):
+                continue
+            kw = {k.arg: k.value for k in node.keywords}
+            # cumsum(a, axis, dtype): axis/dtype may be positional
+            axis = kw.get("axis") or (node.args[1] if len(node.args) > 1 else None)
+            dtype = kw.get("dtype") or (node.args[2] if len(node.args) > 2 else None)
+            if isinstance(axis, ast.Constant) and axis.value is None:
+                axis = None  # explicit axis=None flattens: the 1-D form
+            if axis is None:
+                continue  # 1-D cumsum: fine on TPU
+            if dtype is not None:
+                if _dtype_from_dtype_expr(dtype) != INT64:
+                    continue
+                dt = INT64
+            else:
+                dt = infer_dtype(node.args[0], env) if node.args else UNKNOWN
+            if dt == INT64:
+                findings.append(Finding(
+                    path, node, "GL002",
+                    "multi-axis int64 cumsum: lowers to vmem-hungry "
+                    "reduce_window on TPU — use 1-D sorted-segment scans, "
+                    "float64, or int32",
+                ))
+
+
+def check_block_until_ready(path, tree, findings):
+    """GL004: block_until_ready in a wall-clock-reading function."""
+    for fn in _functions(tree):
+        if isinstance(fn, ast.Lambda):
+            continue
+        reads_clock = False
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "perf_counter", "monotonic", "time", "perf_counter_ns"
+            ):
+                base = node.value
+                if isinstance(base, ast.Name) and base.id == "time":
+                    reads_clock = True
+        if not reads_clock:
+            continue
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "block_until_ready":
+                findings.append(Finding(
+                    path, node, "GL004",
+                    "block_until_ready() in a timing function: it can "
+                    "return early through the axon tunnel — force "
+                    "completion with a host transfer (np.asarray)",
+                ))
+
+
+def _plugin_classes(trees):
+    """Transitive Plugin subclasses across all parsed files."""
+    bases = {}
+    for _, tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                names = []
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        names.append(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        names.append(b.attr)
+                bases[node.name] = names
+    plugins = {"Plugin"}
+    changed = True
+    while changed:
+        changed = False
+        for cls, bs in bases.items():
+            if cls not in plugins and any(b in plugins for b in bs):
+                plugins.add(cls)
+                changed = True
+    return plugins
+
+
+def check_aux_capture(path, tree, plugin_classes, findings):
+    """GL001: tensor methods reading host-built jnp array attributes."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or node.name not in plugin_classes:
+            continue
+        captured = set()
+        for meth in node.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            if meth.name not in HOST_BUILD_METHODS:
+                continue
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        # RHS builds or contains a jnp array?
+                        for c in ast.walk(sub.value):
+                            if _is_jnp_call(c, ARRAY_CTORS) and isinstance(
+                                c.func.value, ast.Name
+                            ) and c.func.value.id in ("jnp", "jax"):
+                                captured.add(t.attr)
+                                break
+        if not captured:
+            continue
+        for meth in node.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            if meth.name not in TENSOR_METHODS:
+                continue
+            # `self.X is None` presence checks are trace-time CONFIG
+            # branches, not value captures: flipping presence changes the
+            # aux() pytree structure, which retraces — sanctioned idiom
+            presence_checks = set()
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops
+                ):
+                    for side in (sub.left, *sub.comparators):
+                        presence_checks.add(id(side))
+            for sub in ast.walk(meth):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr in captured
+                    and sub.attr not in SANCTIONED_ATTRS
+                    and id(sub) not in presence_checks
+                    and not isinstance(getattr(sub, "ctx", None), ast.Store)
+                ):
+                    findings.append(Finding(
+                        path, sub, "GL001",
+                        f"jitted {meth.name}() reads host-built array "
+                        f"self.{sub.attr}: a jit closure capture is "
+                        "constant-folded per shape and goes stale — route "
+                        "it through aux()/bind_aux (read self._aux)",
+                    ))
+
+
+def check_resource_slots(path, tree, findings):
+    """GL005: hardcoded resource-axis slot integers."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        base = node.value
+        # unwrap .at[...] indexing
+        if isinstance(base, ast.Attribute) and base.attr == "at":
+            base = base.value
+        idx = node.slice
+        is_vector = (
+            isinstance(base, ast.Name)
+            and RESOURCE_VECTOR_NAMES.match(base.id) is not None
+        )
+        resourceish = is_vector or (
+            isinstance(base, ast.Name)
+            and RESOURCE_TENSOR_NAMES.match(base.id) is not None
+        ) or (
+            isinstance(base, ast.Attribute)
+            and base.attr in RESOURCE_TENSOR_ATTRS
+        )
+        if not resourceish:
+            continue
+        slot = None
+        if is_vector and isinstance(idx, ast.Constant) and isinstance(
+            idx.value, int
+        ) and not isinstance(idx.value, bool):
+            slot = idx.value
+        elif isinstance(idx, ast.Tuple) and idx.elts:
+            last = idx.elts[-1]
+            leading_sliced = any(
+                isinstance(e, ast.Slice)
+                or (isinstance(e, ast.Constant) and e.value is Ellipsis)
+                for e in idx.elts[:-1]
+            )
+            if leading_sliced and isinstance(last, ast.Constant) and isinstance(
+                last.value, int
+            ) and not isinstance(last.value, bool):
+                slot = last.value
+        if slot is not None and 0 <= slot <= MAX_CANONICAL_SLOT:
+            findings.append(Finding(
+                path, node, "GL005",
+                f"hardcoded resource slot [{slot}]: the axis order is "
+                "owned by api.resources.CANONICAL (and mirrored by the "
+                "C++ bridge) — use CANONICAL.index(...) / "
+                "meta.index.position(...)",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_IGNORE_RE = re.compile(r"#\s*graft-lint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+
+def _suppressed(finding, source_lines):
+    if 0 < finding.line <= len(source_lines):
+        m = _IGNORE_RE.search(source_lines[finding.line - 1])
+        if m:
+            rules = m.group(1)
+            return rules is None or finding.rule in re.split(r"[,\s]+", rules)
+    return False
+
+
+def lint_file(path: Path) -> tuple[list, object, str]:
+    """(findings, ast tree, source) for one file — the tree/source feed the
+    cross-file plugin-hierarchy pass and suppression filter in lint_paths."""
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    findings: list[Finding] = []
+    rel = path
+    check_matmul(rel, tree, findings)
+    check_cumsum(rel, tree, findings)
+    check_block_until_ready(rel, tree, findings)
+    check_resource_slots(rel, tree, findings)
+    return findings, tree, source
+
+
+def lint_paths(paths) -> list[Finding]:
+    files = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    all_findings, trees, sources = [], [], {}
+    for f in files:
+        findings, tree, source = lint_file(f)
+        all_findings.extend(findings)
+        trees.append((f, tree))
+        sources[f] = source.splitlines()
+    plugin_classes = _plugin_classes(trees)
+    for f, tree in trees:
+        extra: list[Finding] = []
+        check_aux_capture(f, tree, plugin_classes, extra)
+        all_findings.extend(extra)
+    return [
+        fi for fi in all_findings
+        if not _suppressed(fi, sources.get(fi.path, []))
+    ]
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    paths = args or [str(REPO / p) for p in DEFAULT_PATHS]
+    findings = lint_paths(paths)
+    for f in sorted(findings, key=lambda f: (str(f.path), f.line)):
+        print(f)
+    if findings:
+        print(f"graft-lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("graft-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

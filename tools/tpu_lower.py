@@ -1,0 +1,517 @@
+#!/usr/bin/env python
+"""AOT TPU compile-readiness gate: StableHLO lowering + landmine scan.
+
+The north star is on-chip throughput, but the axon tunnel can be dead for
+whole rounds (CLAUDE.md) — so "runs on TPU" needs evidence that does not
+require hardware. This tool cross-lowers every hot program to TPU StableHLO
+via `jax.export` on the CPU backend (no TPU needed: lowering is the
+platform-specific trace, compilation is not run) and then scans the emitted
+module text for the landmine patterns CLAUDE.md documents:
+
+- `dot`/`dot_general` on i64 operands (int64 matmul is unsupported on TPU);
+- `reduce_window` over i64 (the vmem-hungry lowering 2-D int64 `jnp.cumsum`
+  takes on TPU — can hang compiles);
+- convolutions fed by i64 operands.
+
+Programs covered (the full bench surface + the sharded solves + the graft
+entry): bench configs 0-6 — including the north-star chunk loop — both
+sharded solves in `parallel/solver.py`, and `__graft_entry__.entry()`.
+
+A digest manifest (`docs/tpu_lowering.json`: program -> StableHLO SHA-256 +
+op histogram, loc-metadata stripped) is committed so program regressions
+show up as diffs. Hash equality is only enforced when the running jax
+version matches the manifest's (StableHLO text is jax-version-dependent);
+on a different jax the gate still enforces the program set, lowering
+success, and zero landmines.
+
+Usage:
+    python tools/tpu_lower.py              # lower all, scan, write manifest
+    python tools/tpu_lower.py --check     # read-only verify against manifest
+    python tools/tpu_lower.py --programs entry bench_cfg0_tpu_smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+from collections import Counter
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+MANIFEST = REPO / "docs" / "tpu_lowering.json"
+
+if str(REPO) not in sys.path:  # `python tools/tpu_lower.py` from anywhere
+    sys.path.insert(0, str(REPO))
+
+#: TPU platform string passed to jax.export.
+TARGET_PLATFORM = "tpu"
+
+
+def bootstrap(n_devices: int = 8) -> None:
+    """Force an n-device virtual CPU platform BEFORE the first backend touch
+    (the environment pins `jax_platforms=axon` via config, which beats env
+    vars and blocks forever when the tunnel is down). Delegates to
+    `__graft_entry__._force_cpu_platform`, which also UPGRADES a
+    pre-existing smaller `--xla_force_host_platform_device_count` in
+    XLA_FLAGS — a stale 4-device export must not starve the 8-way sharded
+    programs. Idempotent; must run before any jnp array is created."""
+    import __graft_entry__
+
+    __graft_entry__._force_cpu_platform(n_devices)
+
+
+# ---------------------------------------------------------------------------
+# StableHLO landmine scanner (pure text analysis — no jax required)
+# ---------------------------------------------------------------------------
+
+_OP_RE = re.compile(r'"?stablehlo\.([a-z_0-9]+)"?')
+#: element-type i64 inside a tensor type: `tensor<8x8xi64>` / `tensor<i64>`
+#: (`ui64` deliberately not matched: the landmines are signed-i64 ops).
+_I64_ELT_RE = re.compile(r"(?:x|<)i64>")
+#: ops where i64 operands are TPU landmines
+_MATMUL_OPS = ("dot_general", "dot", "convolution")
+
+
+def op_histogram(text: str) -> dict[str, int]:
+    """{stablehlo op name: count} over the module text."""
+    return dict(Counter(m.group(1) for m in _OP_RE.finditer(text)))
+
+
+def _operand_signature(
+    text: str, start: int, region_op: bool = False, window: int = 6000
+) -> str:
+    """The `(operand types)` of the op starting at `start`.
+
+    Plain one-line ops (dot/dot_general/convolution) carry
+    ` : (types) -> ...` or ` : type` on their OWN line — that form must be
+    read first, or a nearby region op's closing signature gets
+    mis-attributed. Region ops (reduce_window) close with
+    `}) : (types) -> ...` a few lines down. Returns "" when not found."""
+    chunk = text[start : start + window]
+    if region_op:
+        m = re.search(r"\}\)?\s*:\s*\(([^)]*)\)", chunk)
+        return m.group(1) if m else ""
+    line = chunk.split("\n", 1)[0]
+    m = re.search(r":\s*\(([^)]*)\)", line)
+    if m is None:
+        m = re.search(r":\s*(tensor<[^>]*>)", line)
+    return m.group(1) if m else ""
+
+
+def scan_landmines(text: str) -> list[dict]:
+    """CLAUDE.md TPU landmines in a StableHLO module: i64 `dot`/
+    `dot_general`/`convolution` operands, and `reduce_window` over i64
+    (what 2-D int64 cumsum lowers to on TPU). Returns finding dicts with
+    the op name and its operand signature."""
+    findings = []
+    for m in _OP_RE.finditer(text):
+        op = m.group(1)
+        if op in _MATMUL_OPS:
+            sig = _operand_signature(text, m.start())
+            if _I64_ELT_RE.search(sig):
+                findings.append(
+                    {"op": op, "signature": sig.strip(), "offset": m.start()}
+                )
+        elif op == "reduce_window":
+            sig = _operand_signature(text, m.start(), region_op=True)
+            if _I64_ELT_RE.search(sig) and _max_tensor_rank(sig) >= 2:
+                # 1-D i64 reduce_window is the standard TPU cumsum lowering
+                # and benign; the CLAUDE.md landmine is the MULTI-DIM form
+                # (2-D int64 cumsum), whose windows go vmem-pathological
+                findings.append(
+                    {"op": op, "signature": sig.strip(), "offset": m.start()}
+                )
+    return findings
+
+
+def _max_tensor_rank(signature: str) -> int:
+    """Highest tensor rank among `tensor<...>` types in a signature."""
+    rank = 0
+    for m in re.finditer(r"tensor<([^>]*)>", signature):
+        dims = m.group(1).split("x")
+        rank = max(rank, len(dims) - 1)  # last element is the dtype
+    return rank
+
+
+def canonical_text(text: str) -> str:
+    """Module text with loc metadata stripped, so the digest tracks the
+    PROGRAM (ops + types + structure) — not source line numbers, and not
+    the process-global #locN counter (which shifts with whatever else was
+    traced earlier in the process and made naive digests order-dependent).
+
+    `loc(...)` attributes nest parens (`loc("f"(#loc3))`), so a balanced
+    scanner removes them; any remaining bare #locN tokens and #locN
+    definition lines are dropped too."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        j = text.find("loc(", i)
+        # only strip the attribute form: start-of-token boundary
+        while j > 0 and j < n and (text[j - 1].isalnum() or text[j - 1] == "_"):
+            j = text.find("loc(", j + 1)
+        if j == -1:
+            out.append(text[i:])
+            break
+        out.append(text[i:j].rstrip(" "))
+        depth, k = 0, j + 3
+        while k < n:
+            if text[k] == "(":
+                depth += 1
+            elif text[k] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        i = k + 1
+    text = "".join(out)
+    text = re.sub(r"#loc\d*", "", text)
+    return "\n".join(
+        line.rstrip()
+        for line in text.splitlines()
+        if line.strip() not in ("", "=")
+    )
+
+
+def stablehlo_digest(text: str) -> str:
+    return hashlib.sha256(canonical_text(text).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Program registry: name -> builder returning (jitted_fn, args, mesh|None)
+# ---------------------------------------------------------------------------
+
+
+def _batch_solve_program(shape):
+    """Configs 0/1: `bench.flagship_solve` on `bench.alloc_problem` — the
+    exact construction + jitted fn bench ships."""
+    import jax
+
+    import bench
+
+    _, snap, _, weights = bench.alloc_problem(**shape)
+    return jax.jit(bench.flagship_solve), (snap, weights), None
+
+
+def build_entry():
+    import jax
+
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    return jax.jit(fn), args, None
+
+
+def build_cfg0_tpu_smoke():
+    import bench
+
+    return _batch_solve_program(bench.SMOKE_SHAPE)
+
+
+def build_cfg1_flagship():
+    import bench
+
+    return _batch_solve_program(bench.FLAGSHIP_SHAPE)
+
+
+def _sequential_program(config):
+    """Configs 2-5: the bit-faithful sequential solve on
+    `bench.config_problem`'s scenario/roster table (the one copy of those
+    shapes), traced with the TPU-path scan unroll (runtime._scan_unroll
+    returns 8 on TPU device kinds — mirror that here so the digest covers
+    the program the chip would run, not the CPU test trace)."""
+    import bench
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+
+    cluster, plugins, _ = bench.config_problem(config)
+    scheduler = Scheduler(Profile(plugins=plugins))
+    pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    scheduler.prepare(meta, cluster)
+    state0 = scheduler.initial_state(snap)
+    auxes = tuple(p.aux() for p in scheduler.profile.plugins)
+    fn = scheduler._make_solve(unroll=8)  # already jitted
+    return fn, (snap, state0, auxes), None
+
+
+def build_cfg2_trimaran_sequential():
+    return _sequential_program(2)
+
+
+def build_cfg3_numa_sequential():
+    return _sequential_program(3)
+
+
+def build_cfg4_gang_quota_sequential():
+    return _sequential_program(4)
+
+
+def build_cfg5_network_sequential():
+    return _sequential_program(5)
+
+
+def build_cfg6_north_star_chunk():
+    """The north-star chunk loop body — `bench.north_star_solve_chunk`
+    itself, at the real node-count/chunk shapes from
+    `bench.NORTH_STAR_SHAPE`, with the chunk-invariant tensors as
+    arguments exactly as bench jits it (one pod chunk of cluster build
+    suffices: every chunk shares this one compiled program)."""
+    import jax
+
+    import bench
+    from scheduler_plugins_tpu.ops.fit import free_capacity
+
+    shape = bench.NORTH_STAR_SHAPE
+    chunk = shape["chunk"]
+    _, snap, meta, weights, raw, _ = bench.north_star_problem(
+        shape["n_nodes"], chunk, chunk
+    )
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    args = (
+        raw,
+        snap.nodes.mask,
+        snap.pods.req[:chunk],
+        snap.pods.mask[:chunk],
+        free,
+    )
+    return jax.jit(bench.north_star_solve_chunk), args, None
+
+
+def _mesh8():
+    from scheduler_plugins_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def build_sharded_batch_solve():
+    """`parallel.solver.sharded_batch_solve`'s jitted program on an 8-way
+    ("pods", "nodes") mesh — the gang+quota allocatable flagship with the
+    snapshot sharded per `snapshot_shardings` (the dryrun_multichip layout;
+    XLA inserts the cross-shard collectives)."""
+    import jax
+
+    import __graft_entry__
+    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+    from scheduler_plugins_tpu.parallel.solver import batch_solve
+
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+    mesh = _mesh8()
+    pods_dim, nodes_dim = mesh.devices.shape
+    scheduler, snap, meta = __graft_entry__._build_problem(
+        n_nodes=16, n_pods=32, pad_nodes=16, pad_pods=32
+    )
+    assert 16 % nodes_dim == 0 and 32 % pods_dim == 0
+    snap = shard_snapshot(snap, mesh)
+    weights = jnp.asarray(
+        meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+    )
+    fn = jax.jit(lambda s, w: batch_solve(s, w, 8))
+    return fn, (snap, weights), mesh
+
+
+def build_sharded_profile_batch_solve():
+    """`parallel.solver.sharded_profile_batch_solve`'s jitted program: the
+    mixed plugin roster (allocatable + NUMA + network + topology-spread
+    validators) under the same 8-way mesh — the full-roster multi-chip
+    path, not just the flagship."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.models import mixed_scenario
+    from scheduler_plugins_tpu.parallel.mesh import shard_snapshot
+    from scheduler_plugins_tpu.parallel.solver import profile_batch_fn
+    from scheduler_plugins_tpu.plugins import (
+        NetworkOverhead,
+        NodeResourcesAllocatable,
+        NodeResourceTopologyMatch,
+        PodTopologySpread,
+    )
+
+    mesh = _mesh8()
+    cluster = mixed_scenario(n_nodes=16, n_pods=32)
+    sched = Scheduler(
+        Profile(
+            plugins=[
+                NodeResourcesAllocatable(),
+                NodeResourceTopologyMatch(),
+                NetworkOverhead(),
+                PodTopologySpread(),
+            ]
+        )
+    )
+    for p in sched.profile.plugins:
+        p.configure_cluster(cluster)
+    pending = sched.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=0, pad_nodes=16, pad_pods=32)
+    sched.prepare(meta, cluster)
+    snap = shard_snapshot(snap, mesh)
+    fn, args = profile_batch_fn(sched, snap, max_waves=8)
+    return fn, args, mesh
+
+
+PROGRAMS = {
+    "entry": build_entry,
+    "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
+    "bench_cfg1_flagship": build_cfg1_flagship,
+    "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
+    "bench_cfg3_numa_sequential": build_cfg3_numa_sequential,
+    "bench_cfg4_gang_quota_sequential": build_cfg4_gang_quota_sequential,
+    "bench_cfg5_network_sequential": build_cfg5_network_sequential,
+    "bench_cfg6_north_star_chunk": build_cfg6_north_star_chunk,
+    "sharded_batch_solve": build_sharded_batch_solve,
+    "sharded_profile_batch_solve": build_sharded_profile_batch_solve,
+}
+
+
+def lower_program(name: str) -> str:
+    """Build + AOT-lower one registered program to TPU StableHLO text."""
+    import jax
+    import jax.export
+
+    from scheduler_plugins_tpu.parallel.mesh import ambient_mesh
+
+    fn, args, mesh = PROGRAMS[name]()
+    if mesh is not None:
+        with ambient_mesh(mesh):
+            exported = jax.export.export(fn, platforms=(TARGET_PLATFORM,))(
+                *args
+            )
+    else:
+        exported = jax.export.export(fn, platforms=(TARGET_PLATFORM,))(*args)
+    return exported.mlir_module()
+
+
+def analyze(name: str) -> dict:
+    text = lower_program(name)
+    findings = scan_landmines(text)
+    hist = op_histogram(text)
+    return {
+        "sha256": stablehlo_digest(text),
+        "stablehlo_bytes": len(canonical_text(text)),
+        "ops": {k: hist[k] for k in sorted(hist)},
+        "landmines": findings,
+    }
+
+
+def run(names, check: bool) -> int:
+    import jax
+
+    prior = {}
+    if MANIFEST.exists():
+        prior = json.loads(MANIFEST.read_text())
+    results, failures = {}, []
+    for name in names:
+        print(f"[tpu-lower] {name} ...", flush=True)
+        try:
+            results[name] = analyze(name)
+        except Exception as exc:  # lowering failure IS the gate tripping
+            failures.append(f"{name}: lowering failed: {exc!r}")
+            continue
+        mines = results[name]["landmines"]
+        if mines:
+            for f in mines:
+                failures.append(
+                    f"{name}: TPU landmine {f['op']} on ({f['signature']})"
+                )
+        print(
+            f"[tpu-lower] {name}: "
+            f"{results[name]['stablehlo_bytes']} bytes, "
+            f"{sum(results[name]['ops'].values())} ops, "
+            f"{len(mines)} landmines",
+            flush=True,
+        )
+
+    manifest = {
+        "jax": jax.__version__,
+        "platform": TARGET_PLATFORM,
+        "programs": {
+            n: {
+                "sha256": r["sha256"],
+                "stablehlo_bytes": r["stablehlo_bytes"],
+                "landmines": len(r["landmines"]),
+                "ops": r["ops"],
+            }
+            for n, r in sorted(results.items())
+        },
+    }
+
+    if check and not prior:
+        # the gate must fail CLOSED: a missing/deleted manifest means there
+        # is nothing to verify drift against
+        failures.append(
+            "docs/tpu_lowering.json missing: run `python tools/tpu_lower.py` "
+            "and commit it"
+        )
+    if check and prior:
+        prior_programs = prior.get("programs", {})
+        # any checked program absent from the manifest is a coverage gap —
+        # also for --programs subsets (a new program must not check green
+        # before its digest is committed)
+        missing = [n for n in names if n in PROGRAMS and n not in prior_programs]
+        if missing:
+            failures.append(
+                f"manifest missing programs {missing}: run "
+                "`python tools/tpu_lower.py` and commit docs/tpu_lowering.json"
+            )
+        if prior.get("jax") == jax.__version__:
+            for n, r in results.items():
+                want = prior_programs.get(n, {}).get("sha256")
+                if want and want != r["sha256"]:
+                    failures.append(
+                        f"{n}: StableHLO digest drift "
+                        f"(manifest {want[:12]}.., now {r['sha256'][:12]}..) "
+                        "— intended? re-run `python tools/tpu_lower.py` and "
+                        "commit the manifest diff"
+                    )
+        else:
+            print(
+                f"[tpu-lower] note: manifest was written under jax "
+                f"{prior.get('jax')}, running {jax.__version__}; digest "
+                "equality not enforced (lowering text is version-dependent), "
+                "landmine/coverage gates still apply"
+            )
+
+    if not check and set(names) == set(PROGRAMS) and not failures:
+        MANIFEST.write_text(json.dumps(manifest, indent=1, sort_keys=True) + "\n")
+        print(f"[tpu-lower] wrote {MANIFEST.relative_to(REPO)}")
+    elif not check:
+        # a failed or partial run must never clobber the last-good manifest
+        reason = "failures" if failures else "partial program set"
+        print(f"[tpu-lower] {reason}: manifest NOT rewritten")
+
+    for f in failures:
+        print(f"[tpu-lower] FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(
+            f"[tpu-lower] OK: {len(results)}/{len(names)} programs lower to "
+            f"TPU StableHLO with zero landmines"
+        )
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="read-only: verify against the committed manifest "
+        "(digest equality enforced only under the manifest's jax version)",
+    )
+    parser.add_argument(
+        "--programs",
+        nargs="+",
+        choices=sorted(PROGRAMS),
+        default=sorted(PROGRAMS),
+        help="subset of programs (default: all)",
+    )
+    args = parser.parse_args(argv)
+    bootstrap()
+    return run(args.programs, check=args.check)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
