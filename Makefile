@@ -23,6 +23,14 @@ multichip:
 tpu-smoke:
 	$(PY) bench.py --config 0
 
+# CI perf gate: reduced-shape batch-vs-sequential comparison on the CPU
+# backend — the batched throughput mode must never lose to its own
+# sequential parity path (>= 0.9x pods/s absorbs runner timing noise;
+# ISSUE 2 reversed the measured 0.83-0.89x split on the NUMA config)
+.PHONY: bench-smoke
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --smoke-compare 2,3
+
 # verify composes the READ-ONLY gate (tpu-lower-check): it must never
 # rewrite the committed manifest as a side effect — refreshing digests is
 # the explicit `make tpu-lower`

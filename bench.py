@@ -238,11 +238,35 @@ def alloc_problem(n_nodes, n_pods):
     return cluster, snap, meta, weights
 
 
-def flagship_solve(snap, weights):
-    """The flagship jitted step (configs 0/1): the full batched solve."""
+def flagship_solve_stats(snap, weights):
+    """The flagship jitted step (configs 0/1): the full batched solve with
+    per-wave occupancy stats — the program bench ships AND the one the AOT
+    gate lowers, so perf PRs can see whether wave count or per-wave cost
+    moved."""
     from scheduler_plugins_tpu.parallel.solver import batch_solve
 
-    return batch_solve(snap, weights, max_waves=8)
+    return batch_solve(snap, weights, max_waves=8, collect_stats=True)
+
+
+def _trim_occupancy(occ, waves=None):
+    """JSON-ready admitted-per-wave list: clipped to the executed wave
+    count when known, trailing never-run zero slots dropped either way —
+    the ONE formatting rule for every bench line's `wave_occupancy`."""
+    occ = [int(x) for x in occ]
+    if waves is not None:
+        occ = occ[: max(waves, 1)]
+    while len(occ) > 1 and occ[-1] == 0:
+        occ.pop()
+    return occ
+
+
+def _wave_extra(stats):
+    """JSON-ready per-wave occupancy from a waterfill stats dict."""
+    waves = int(stats["waves"])
+    return {
+        "waves": waves,
+        "wave_occupancy": _trim_occupancy(stats["occupancy"], waves),
+    }
 
 
 def main(n_nodes=None, n_pods=None):
@@ -252,12 +276,12 @@ def main(n_nodes=None, n_pods=None):
     n_pods = n_pods or FLAGSHIP_SHAPE["n_pods"]
     cluster, snap, meta, weights = alloc_problem(n_nodes, n_pods)
 
-    solve = jax.jit(flagship_solve)
+    solve = jax.jit(flagship_solve_stats)
     # warmup/compile; host transfer, not block_until_ready — the latter can
     # return early through the tunneled backend (CLAUDE.md). The warmup
     # solves the UNPERTURBED snapshot: its placements anchor the drift
     # column (the timed runs perturb one request for cache busting)
-    assignment, admitted, wait = solve(snap, weights)
+    assignment, admitted, wait, stats = solve(snap, weights)
     warm_np = np.asarray(assignment)
 
     # median of fully-synchronized runs with perturbed inputs; completion is
@@ -272,7 +296,7 @@ def main(n_nodes=None, n_pods=None):
         )
         np.asarray(snap_k.pods.req[0, 0])  # perturbation settled
         start = time.perf_counter()
-        assignment, _, _ = solve(snap_k, weights)
+        assignment, _, _, stats = solve(snap_k, weights)
         assignment_np = np.asarray(assignment)
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
@@ -290,6 +314,7 @@ def main(n_nodes=None, n_pods=None):
         drift=_score_sum_drift(
             _alloc_objective(snap, weights), warm_np, ref_out
         ),
+        extra=_wave_extra(stats),
     )
 
 
@@ -317,21 +342,33 @@ def north_star_solve_chunk(raw, node_mask, req_chunk, mask_chunk, free0):
     """One north-star chunk: static allocatable scores -> targeted
     waterfill, O(P*R) per lite wave instead of the (P, N) matrix (masked
     nodes fit nothing with zeroed free capacity). rescue_window=256 halves
-    the end-game (K, N) rescue cost at this scale (63k -> 114k pods/s;
-    8 waves x 256 slots still drains every straggler, all pods placed).
+    the end-game (K, N) rescue cost at this scale (8 waves x 256 slots
+    still drains every straggler, all pods placed).
 
-    Chunk-invariant tensors (raw scores, node mask) are ARGUMENTS, not jit
-    closure captures, so the compiled program is exactly the one
-    tools/tpu_lower.py lowers and digests."""
+    Returns ((assignment, wave_stats), free) — the pipeline calling
+    convention (`parallel.pipeline.run_chunk_pipeline`): the free carry is
+    DONATED at the jit boundary (`donated_chunk_solver`) so it threads
+    chunk to chunk in place. Chunk-invariant tensors (raw scores, node
+    mask) are ARGUMENTS, not jit closure captures, so the compiled program
+    is exactly the one tools/tpu_lower.py lowers and digests."""
     import jax.numpy as jnp
 
     from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
 
-    return waterfill_assign_targeted(
+    assignment, free, stats = waterfill_assign_targeted(
         raw, req_chunk, mask_chunk,
         jnp.where(node_mask[:, None], free0, 0), max_waves=8,
-        rescue_window=256,
+        rescue_window=256, collect_stats=True,
     )
+    return (assignment, stats), free
+
+
+def north_star_chunk_solver():
+    """The jitted, carry-donating chunk program bench ships (and the AOT
+    gate lowers): one constructor so the two cannot drift apart."""
+    from scheduler_plugins_tpu.parallel.pipeline import donated_chunk_solver
+
+    return donated_chunk_solver(north_star_solve_chunk, carry_argnum=4)
 
 
 def north_star_problem(n_nodes, n_pods, chunk):
@@ -365,10 +402,15 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
     Pods stream through the batched waterfill in queue-order chunks with
     free capacity carried between chunks (chunk boundaries preserve the
     queue order the sequential semantics define), bounding the (P, N)
-    working set to one chunk."""
-    import jax
-
+    working set to one chunk. The chunk loop is the donated, double-
+    buffered pipeline (`parallel.pipeline.run_chunk_pipeline`): chunk
+    k+1's inputs stage host->device and chunk k-1's assignments return
+    device->host while chunk k solves, with the free carry donated in
+    place — the device never idles at a chunk boundary, and the host
+    stays at most one chunk behind (bounded in-flight window through the
+    tunneled backend)."""
     from scheduler_plugins_tpu.ops.fit import free_capacity
+    from scheduler_plugins_tpu.parallel.pipeline import run_chunk_pipeline
 
     n_nodes = n_nodes or NORTH_STAR_SHAPE["n_nodes"]
     n_pods = n_pods or NORTH_STAR_SHAPE["n_pods"]
@@ -378,31 +420,32 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
     )
     node_mask = snap.nodes.mask
 
-    solve_chunk = jax.jit(north_star_solve_chunk)
+    solve_chunk = north_star_chunk_solver()
+    # pod chunks as host buffers: the pipeline's H2D ingest is part of the
+    # timed run (streaming arrival), staged one chunk ahead of the solve
+    req_np = np.asarray(snap.pods.req)
+    mask_np = np.asarray(snap.pods.mask)
+    chunk_inputs = [
+        (req_np[lo:lo + chunk], mask_np[lo:lo + chunk])
+        for lo in range(0, padded, chunk)
+    ]
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
-    # warm up compile on the first chunk shape
-    a, f = solve_chunk(
-        raw, node_mask, snap.pods.req[:chunk], snap.pods.mask[:chunk], free
-    )
+    # warm up compile on the first chunk shape (the free buffer is donated
+    # by the warmup call; the timed loop below rebuilds it)
+    (a, _), _ = solve_chunk(raw, node_mask, *chunk_inputs[0], free)
     np.asarray(a)
 
-    start = time.perf_counter()
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
-    placed = 0
-    chunk_done_s = []  # completion time of each chunk since submission
-    chunk_assignments = []
-    for lo in range(0, padded, chunk):
-        a, free = solve_chunk(
-            raw, node_mask,
-            snap.pods.req[lo:lo + chunk], snap.pods.mask[lo:lo + chunk], free
-        )
-        # per-chunk host sync: chaining chunks device-side balloons the
-        # in-flight working set through the tunneled backend
-        a_np = np.asarray(a)
-        chunk_assignments.append(a_np)
-        placed += int((a_np >= 0).sum())
-        chunk_done_s.append(time.perf_counter() - start)
+    start = time.perf_counter()
+    results, free, chunk_done_s = run_chunk_pipeline(
+        solve_chunk, (raw, node_mask), chunk_inputs, free
+    )
     elapsed = time.perf_counter() - start
+    chunk_assignments = [a for a, _ in results]
+    placed = int(sum((a >= 0).sum() for a in chunk_assignments))
+    waves = sum(int(stats["waves"]) for _, stats in results)
+    occ = np.sum([np.asarray(stats["occupancy"]) for _, stats in results],
+                 axis=0)
     # BASELINE.json names p99 scheduling latency alongside throughput: a
     # pod's decision latency is its chunk's completion time since the
     # batch was submitted (pods stream through in queue order), so the
@@ -427,6 +470,9 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
                 float(np.percentile(pod_latency_s, 50)) * 1000, 1),
             "pod_latency_p99_ms": round(
                 float(np.percentile(pod_latency_s, 99)) * 1000, 1),
+            "chunks": len(chunk_inputs),
+            "waves": waves,
+            "wave_occupancy": _trim_occupancy(occ),
         },
     )
 
@@ -443,9 +489,9 @@ def tpu_smoke(n_nodes=None, n_pods=None):
     n_pods = n_pods or SMOKE_SHAPE["n_pods"]
     cluster, snap, meta, weights = alloc_problem(n_nodes, n_pods)
 
-    solve = jax.jit(flagship_solve)
+    solve = jax.jit(flagship_solve_stats)
     compile_start = time.perf_counter()
-    assignment, _, _ = solve(snap, weights)
+    assignment, _, _, stats = solve(snap, weights)
     warm_np = np.asarray(assignment)  # unperturbed placements: drift anchor
     compile_s = time.perf_counter() - compile_start
 
@@ -457,7 +503,7 @@ def tpu_smoke(n_nodes=None, n_pods=None):
         )
         np.asarray(snap_k.pods.req[0, 0])
         start = time.perf_counter()
-        assignment, _, _ = solve(snap_k, weights)
+        assignment, _, _, stats = solve(snap_k, weights)
         assignment_np = np.asarray(assignment)
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
@@ -473,7 +519,7 @@ def tpu_smoke(n_nodes=None, n_pods=None):
         drift=_score_sum_drift(
             _alloc_objective(snap, weights), warm_np, ref_out
         ),
-        extra={"compile_seconds": round(compile_s, 1)},
+        extra={"compile_seconds": round(compile_s, 1), **_wave_extra(stats)},
     )
 
 
@@ -540,10 +586,12 @@ def metric_name(config: int, mode: str = "sequential") -> str:
     return metric
 
 
-def config_problem(config: int):
+def config_problem(config: int, shape: dict | None = None):
     """(cluster, plugins, detail) — the BASELINE config 2-5 scenario/roster
     table. The ONE copy of these shapes: bench runs them and the AOT gate
-    (tools/tpu_lower.py) lowers them, so they cannot drift apart."""
+    (tools/tpu_lower.py) lowers them, so they cannot drift apart. `shape`
+    overrides the scenario size (the smoke-compare gate runs the same
+    scenario generators at reduced N)."""
     from scheduler_plugins_tpu.models import (
         gang_quota_scenario,
         network_scenario,
@@ -553,21 +601,25 @@ def config_problem(config: int):
     from scheduler_plugins_tpu import plugins as P
 
     if config == 2:
-        cluster = trimaran_scenario(n_nodes=5000, n_pods=2048)
+        kw = shape or dict(n_nodes=5000, n_pods=2048)
+        cluster = trimaran_scenario(**kw)
         plugins = [P.TargetLoadPacking(), P.LoadVariationRiskBalancing()]
-        detail = "5000 nodes, TLP+LVRB, sequential"
+        detail = f"{kw['n_nodes']} nodes, TLP+LVRB, sequential"
     elif config == 3:
-        cluster = numa_scenario(n_nodes=1024, n_pods=512, zones=8)
+        kw = shape or dict(n_nodes=1024, n_pods=512, zones=8)
+        cluster = numa_scenario(**kw)
         plugins = [P.NodeResourceTopologyMatch()]
-        detail = "1024 nodes x 8 zones, sequential"
+        detail = f"{kw['n_nodes']} nodes x {kw.get('zones', 8)} zones, sequential"
     elif config == 4:
-        cluster = gang_quota_scenario(n_gangs=32, gang_size=64, n_nodes=1024)
+        kw = shape or dict(n_gangs=32, gang_size=64, n_nodes=1024)
+        cluster = gang_quota_scenario(**kw)
         plugins = [P.NodeResourcesAllocatable(), P.Coscheduling(), P.CapacityScheduling()]
-        detail = "32 gangs x 64, 1024 nodes, sequential"
+        detail = f"{kw['n_gangs']} gangs x {kw['gang_size']}, {kw['n_nodes']} nodes, sequential"
     elif config == 5:
-        cluster = network_scenario(n_nodes=1024, n_pods=1024)
+        kw = shape or dict(n_nodes=1024, n_pods=1024)
+        cluster = network_scenario(**kw)
         plugins = [P.NetworkOverhead(), P.TopologicalSort()]
-        detail = "1024 nodes multi-region, sequential"
+        detail = f"{kw['n_nodes']} nodes multi-region, sequential"
     else:
         raise SystemExit(f"unknown config {config}")
     return cluster, plugins, detail
@@ -595,13 +647,16 @@ def sequential_config(config: int, mode: str = "sequential"):
         meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
     )
 
+    wave_stats = {}
     if mode == "batch":
         from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
 
         detail = detail.replace("sequential", "batched")
 
         def run():
-            return profile_batch_solve(scheduler, snap)[0]
+            out = profile_batch_solve(scheduler, snap, collect_stats=True)
+            wave_stats["stats"] = out[3]
+            return out[0]
     else:
         def run():
             return scheduler.solve(snap).assignment
@@ -640,9 +695,67 @@ def sequential_config(config: int, mode: str = "sequential"):
         extra = {
             "score_drift_vs_sequential": round(drift, 4),
             "placed_sequential": placed_seq,
+            **_wave_extra(wave_stats["stats"]),
         }
     _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
           baseline, compiled=compiled, drift=drift, extra=extra)
+
+
+#: reduced scenario shapes for the CI smoke gate (compile time bounded on
+#: 2-core runners; same generators/rosters as the full configs)
+SMOKE_COMPARE_SHAPES = {
+    2: dict(n_nodes=1024, n_pods=512),
+    3: dict(n_nodes=256, n_pods=256, zones=8),
+}
+
+
+def smoke_compare(configs, noise_floor=0.9, runs=5):
+    """CI gate (`make bench-smoke`): on reduced config shapes, the batched
+    throughput mode must schedule at least `noise_floor` x the sequential
+    parity path's pods/s — the batched mode is the scale default, so a
+    change that flips the batch-vs-sequential split must fail the build;
+    the 10% floor absorbs small-runner timing noise. One JSON line per
+    config; rc 1 on any failure."""
+    import jax  # noqa: F401
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+    failed = False
+    for config in configs:
+        cluster, plugins, _ = config_problem(
+            config, shape=SMOKE_COMPARE_SHAPES.get(config)
+        )
+        scheduler = Scheduler(Profile(plugins=plugins))
+        pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+        n_pods = len(pending)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        scheduler.prepare(meta, cluster)
+
+        def timed(fn):
+            np.asarray(fn())  # compile
+            times = []
+            for _ in range(runs):
+                start = time.perf_counter()
+                np.asarray(fn())  # host transfer forces completion
+                times.append(time.perf_counter() - start)
+            return n_pods / sorted(times)[len(times) // 2]
+
+        seq = timed(lambda: scheduler.solve(snap).assignment)
+        bat = timed(lambda: profile_batch_solve(scheduler, snap)[0])
+        ratio = bat / seq
+        ok = bool(ratio >= noise_floor)
+        failed |= not ok
+        print(json.dumps({
+            "metric": f"bench_smoke_cfg{config}",
+            "sequential_pods_per_sec": round(seq, 1),
+            "batch_pods_per_sec": round(bat, 1),
+            "ratio": round(ratio, 3),
+            "noise_floor": noise_floor,
+            "backend": _backend_label(),
+            "ok": ok,
+        }))
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":
@@ -657,8 +770,19 @@ if __name__ == "__main__":
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="dump a jax profiler trace of the timed runs to "
                              "DIR (op-level data for tuning rounds)")
+    parser.add_argument("--smoke-compare", default=None, metavar="CFGS",
+                        help="CI gate: comma-separated configs (e.g. 2,3) "
+                             "run at reduced shapes in BOTH modes; fails "
+                             "when batch < 0.9x sequential pods/s")
     args = parser.parse_args()
     apply_platform_override()
+    if args.smoke_compare:
+        # CPU-backend CI gate: no tunnel probe (the Makefile target pins
+        # JAX_PLATFORMS=cpu), no capture replay — this compares the two
+        # modes against each other, not against history
+        sys.exit(smoke_compare(
+            [int(c) for c in args.smoke_compare.split(",") if c]
+        ))
     diagnosis = backend_probe()
     if diagnosis is not None:
         # The environment is sick, not the code. The axon tunnel dies for

@@ -28,6 +28,16 @@ from scheduler_plugins_tpu.utils import observability as obs
 
 
 @dataclass
+class SolveResultView:
+    """The (assignment, admitted, wait) triple the cycle consumes — what the
+    streamed pipeline solve returns (no SolverState carry to surface)."""
+
+    assignment: object
+    admitted: object
+    wait: object
+
+
+@dataclass
 class CycleReport:
     bound: dict[str, str] = field(default_factory=dict)  # uid -> node
     reserved: dict[str, str] = field(default_factory=dict)
@@ -41,7 +51,15 @@ class CycleReport:
     preempted: dict[str, tuple[str, list[str]]] = field(default_factory=dict)
 
 
-def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) -> CycleReport:
+def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
+              stream_chunk: int | None = None) -> CycleReport:
+    """One daemon cycle. `stream_chunk` opts the solve into the donated,
+    double-buffered chunk pipeline (`parallel.pipeline.streamed_profile_solve`)
+    when the profile qualifies for the targeted fast path — huge pending
+    queues then stream through bounded chunks instead of one (P, N) solve,
+    with wave-path placement semantics (hard constraints exact, soft
+    tie-breaking may differ from the sequential scan). Profiles that don't
+    qualify fall back to `scheduler.solve` unchanged."""
     if now is None:
         now = _now_ms()
     report = CycleReport()
@@ -66,7 +84,19 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None) ->
     with obs.flow("cycle", generation=generation, pending=len(pending)):
         snap, meta = cluster.snapshot(pending, now_ms=now)
         scheduler.prepare(meta, cluster)
-        result = scheduler.solve(snap)
+        result = None
+        if stream_chunk:
+            from scheduler_plugins_tpu.parallel.pipeline import (
+                streamed_profile_solve,
+            )
+
+            streamed = streamed_profile_solve(
+                scheduler, snap, chunk=stream_chunk
+            )
+            if streamed is not None:
+                result = SolveResultView(*streamed)
+        if result is None:
+            result = scheduler.solve(snap)
 
     assignment = np.asarray(result.assignment)
     admitted = np.asarray(result.admitted)

@@ -211,6 +211,16 @@ class Plugin:
         `filter_batch`; `normalize` still runs per pod row."""
         return None
 
+    def filter_rows(self, state: SolverState, snap: ClusterSnapshot, idx):
+        """(S, N) Filter verdicts for the `idx` pod rows only against
+        `state`, or None to fall back to `filter_batch`/vmapped `filter`.
+        Implement when the whole-matrix `filter_batch` is NOT class-
+        collapsed (its cost scales with P): a sparse straggler wave then
+        re-filters S rows at S/P of the dense cost instead of recomputing
+        the full matrix and gathering. Same bit-identity contract as
+        `filter` on the selected rows."""
+        return None
+
     def batch_rows(self, state: SolverState, snap: ClusterSnapshot):
         """(filter (P, N) bool | None, scores (P, N) | None) computed in ONE
         pass, or None to fall back to `filter_batch`/`score_batch`.

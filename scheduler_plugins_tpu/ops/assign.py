@@ -45,6 +45,29 @@ def _segment_prefix(values_sorted, first):
     return csum - base
 
 
+def _cumulative_demand_positions(dem, free, order_n):
+    """(W,) first score-ordered node index whose CUMULATIVE free capacity
+    covers each row's inclusive cumulative demand, per resource (max over
+    R) — the cumulative-demand waterfill bucketing shared by the generic
+    wave core and the targeted lite waves (exact under heterogeneous
+    demands, unlike a mean-demand pods-per-node estimate: a queue of small
+    pods fills the preferred nodes first instead of stampeding the one big
+    node, mirroring sequential packing order). `dem` must already be
+    masked to the active/window rows (inactive rows charge 0). R 1-D
+    float64 cumsums + R searchsorteds — exact below 2^53."""
+    cumdem = jnp.cumsum(dem.astype(jnp.float64), axis=0)  # (W, R) inclusive
+    cumfree = jnp.cumsum(
+        jnp.clip(free[order_n], 0, None).astype(jnp.float64), axis=0
+    )  # (N, R) in score order
+    return jnp.max(
+        jax.vmap(
+            lambda cf, cd: jnp.searchsorted(cf, cd, side="left"),
+            in_axes=(1, 1), out_axes=1,
+        )(cumfree, cumdem),
+        axis=1,
+    )
+
+
 def _queue_order_admission_choice(choice, demand, free):
     """(P,) bool: pod admitted iff its chosen node still fits after all
     earlier same-wave choosers of that node (exact sorted-segment prefix
@@ -151,6 +174,7 @@ def waterfill_assign_stateful(
     initial_batch=None,
     sub_batch_fn=None,
     straggler_cap: int = 256,
+    collect_stats: bool = False,
 ):
     """`waterfill_assign` with a plugin-state carry for STATE-DEPENDENT
     filters (NUMA zone availability, network placement tallies): the carries
@@ -202,8 +226,15 @@ def waterfill_assign_stateful(
     more than ``straggler_cap`` infeasible pods must not starve placeable
     pods behind it); only a stalled dense wave ends the loop early.
 
+    ``collect_stats``: also return per-wave occupancy — a
+    ``{"occupancy": (max_waves,) int32 admitted-per-wave, "waves": int32
+    executed-wave-count}`` dict (wave 0 is slot 0) — so perf work can see
+    whether wave count or per-wave cost moved. Adds one O(max_waves)
+    scatter per wave; placements are unchanged.
+
     Not jitted itself: designed to run inside a caller's jit (the closures
-    are trace-local). Returns (assignment, free, state).
+    are trace-local). Returns (assignment, free, state), plus the stats
+    dict when ``collect_stats``.
     """
     P, R = req.shape
     demand = pod_fit_demand(req)
@@ -222,7 +253,6 @@ def waterfill_assign_stateful(
         dem = demand[idx]
         feasible = feasible & active[:, None]
         neg_inf = jnp.iinfo(scores.dtype).min // 2
-        n_active = jnp.maximum(active.sum(), 1)
 
         # int64 accumulator over a possibly-int32 score matrix: exact, at
         # half the (P, N) read traffic when the caller demoted scores
@@ -230,28 +260,26 @@ def waterfill_assign_stateful(
             jnp.where(active[:, None], scores, 0), axis=0, dtype=jnp.int64
         )
         order_n = jnp.argsort(-mean_score, stable=True)  # (N,)
-        mean_demand = (
-            jnp.sum(jnp.where(active[:, None], dem, 0), axis=0) // n_active
-        )
-        cap = jnp.min(
-            jnp.where(
-                mean_demand[None, :] > 0,
-                free // jnp.maximum(mean_demand[None, :], 1),
-                jnp.int64(Ssub),
-            ),
-            axis=1,
-        )
-        # plugin capacity refinements (NUMA zones, ...): bucketing must not
-        # send a node more pods than its tightest constraint can admit
+        # cumulative-demand bucketing (`_cumulative_demand_positions`, the
+        # targeted waterfill's exact formulation): a mean-demand
+        # pods-per-node estimate misroutes heterogeneous big/small queues
+        # and leaves stragglers for extra re-filtered waves
+        pos = _cumulative_demand_positions(
+            jnp.where(active[:, None], dem, 0), free, order_n
+        )  # (S,) first score-ordered node covering the demand prefix
+        # plugin capacity refinements (NUMA zones, ...): pods-per-node caps
+        # the resource cumsums cannot see — bucket pod rank against the
+        # cumulative cap and take the more conservative position
+        rank = jnp.cumsum(active, dtype=jnp.int32) - 1
         for cap_fn in capacity_fns:
             extra = cap_fn(state, active_full)
             if extra is not None:
-                cap = jnp.minimum(cap, extra.astype(cap.dtype))
-        cap = jnp.clip(cap, 0, Ssub).astype(jnp.int32)
-        ccap = jnp.cumsum(cap[order_n], dtype=jnp.int32)
-        rank = jnp.cumsum(active, dtype=jnp.int32) - 1
-        bucket = jnp.searchsorted(ccap, rank, side="right")
-        target = order_n[jnp.minimum(bucket, N - 1)]
+                cap = jnp.clip(extra.astype(jnp.int32), 0, Ssub)
+                ccap = jnp.cumsum(cap[order_n], dtype=jnp.int32)
+                pos = jnp.maximum(
+                    pos, jnp.searchsorted(ccap, rank, side="right")
+                )
+        target = order_n[jnp.minimum(pos, N - 1)]
         target_ok = jnp.take_along_axis(
             feasible, target[:, None], axis=1
         ).squeeze(1)
@@ -343,10 +371,11 @@ def waterfill_assign_stateful(
         return wave_core(free, assignment, state, idx, feasible, scores)
 
     assignment0 = jnp.full(P, -1, jnp.int32)
+    occ0 = jnp.zeros(max_waves, jnp.int32)
 
     if sub_batch_fn is None:
         def cond(loop_state):
-            _, assignment, _, wave_idx, progressed = loop_state
+            _, assignment, _, wave_idx, progressed, _ = loop_state
             # stop on wave budget, on a no-progress wave, or — cheaper —
             # when nothing is left to place (otherwise a fully-placed
             # batch pays one whole extra wave to discover quiescence)
@@ -357,19 +386,30 @@ def waterfill_assign_stateful(
             )
 
         def body(loop_state):
-            free, assignment, state, wave_idx, _ = loop_state
+            free, assignment, state, wave_idx, _, occ = loop_state
             free, assignment, state, n = dense_wave(free, assignment, state)
-            return free, assignment, state, wave_idx + 1, n > 0
+            return (
+                free, assignment, state, wave_idx + 1, n > 0,
+                occ.at[wave_idx].set(n.astype(jnp.int32)),
+            )
 
         if initial_batch is not None:
             feasible0, scores0 = initial_batch
             free_w, assignment_w, state_w, n0 = wave_core(
                 free0, assignment0, state0, dense_idx, feasible0, scores0
             )
-            init = (free_w, assignment_w, state_w, jnp.int32(1), n0 > 0)
+            init = (
+                free_w, assignment_w, state_w, jnp.int32(1), n0 > 0,
+                occ0.at[0].set(n0.astype(jnp.int32)),
+            )
         else:
-            init = (free0, assignment0, state0, jnp.int32(0), jnp.bool_(True))
-        free, assignment, state, _, _ = jax.lax.while_loop(cond, body, init)
+            init = (free0, assignment0, state0, jnp.int32(0),
+                    jnp.bool_(True), occ0)
+        free, assignment, state, waves, _, occ = jax.lax.while_loop(
+            cond, body, init
+        )
+        if collect_stats:
+            return assignment, free, state, {"occupancy": occ, "waves": waves}
         return assignment, free, state
 
     # sparse mode machine: 0 = sparse straggler wave, 1 = dense retry,
@@ -381,7 +421,7 @@ def waterfill_assign_stateful(
     MODE_SPARSE, MODE_DENSE, MODE_STOP = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
     def cond(loop_state):
-        _, assignment, _, wave_idx, mode = loop_state
+        _, assignment, _, wave_idx, mode, _ = loop_state
         return (
             (wave_idx < max_waves)
             & (mode < MODE_STOP)
@@ -389,7 +429,7 @@ def waterfill_assign_stateful(
         )
 
     def body(loop_state):
-        free, assignment, state, wave_idx, mode = loop_state
+        free, assignment, state, wave_idx, mode, occ = loop_state
         free, assignment, state, n = jax.lax.cond(
             mode == MODE_SPARSE,
             lambda args: sparse_wave(*args),
@@ -401,7 +441,10 @@ def waterfill_assign_stateful(
             MODE_SPARSE,
             jnp.where(mode == MODE_SPARSE, MODE_DENSE, MODE_STOP),
         )
-        return free, assignment, state, wave_idx + 1, new_mode
+        return (
+            free, assignment, state, wave_idx + 1, new_mode,
+            occ.at[wave_idx].set(n.astype(jnp.int32)),
+        )
 
     # wave 0 is always dense (initial_batch is required with sub_batch_fn)
     feasible0, scores0 = initial_batch
@@ -412,21 +455,30 @@ def waterfill_assign_stateful(
     init = (
         free_w, assignment_w, state_w, jnp.int32(1),
         jnp.where(n0 > 0, MODE_SPARSE, MODE_STOP),
+        occ0.at[0].set(n0.astype(jnp.int32)),
     )
-    free, assignment, state, _, _ = jax.lax.while_loop(cond, body, init)
+    free, assignment, state, waves, _, occ = jax.lax.while_loop(
+        cond, body, init
+    )
+    if collect_stats:
+        return assignment, free, state, {"occupancy": occ, "waves": waves}
     return assignment, free, state
 
 
-@partial(jax.jit, static_argnames=("max_waves", "rescue_window"))
+@partial(jax.jit,
+         static_argnames=("max_waves", "rescue_window", "lite_window",
+                          "collect_stats"))
 def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
                               max_waves: int = 8,
-                              rescue_window: int = 512):
+                              rescue_window: int = 512,
+                              lite_window: int = 1024,
+                              collect_stats: bool = False):
     """Waterfill for STATIC per-node scores (the allocatable flagship and the
-    north-star scale): per wave, each active pod checks fit against ONE
-    target node — the capacity-bucket choice — in O(P·R) gathers, never
-    materializing the (P, N) feasibility/score matrix the generic waterfill
-    recomputes every wave. At 100k pods x 10k nodes that matrix is ~4B
-    int64 compares per wave; this path does ~400k.
+    north-star scale): per wave, each active pod checks fit against a
+    handful of target nodes — the capacity-bucket choice plus next-fit
+    probes — in O(W*R) gathers, never materializing the (P, N)
+    feasibility/score matrix the generic waterfill recomputes every wave.
+    At 100k pods x 10k nodes that matrix is ~4B int64 compares per wave.
 
     Caller contract: `raw_scores` must already be the desired node ranking —
     the caller's normalization must be MONOTONE in the raw score and its
@@ -434,21 +486,30 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
     fast-path gate in parallel.solver), because this path orders by the raw
     vector and never runs normalize().
 
+    Wave structure (every retry wave runs on a bounded straggler WINDOW —
+    the first W still-active pods in queue order via `jnp.nonzero(size=W)`
+    — so late waves sort/scan W elements, not P; at north-star scale the
+    per-wave queue-order admission sort over the full 8k-pod chunk was the
+    dominant fixed cost of the ~7-wave tail):
+
+    1. one whole-queue lite wave: cumulative-demand bucket targets + next-
+       fit probes, O(P·R);
+    2. sparse lite waves (`lite_window` pods each) to quiescence;
+    3. sparse rescue waves (`rescue_window` pods each): a dense (K, N)
+       feasibility row per window pod, feasible ones spread round-robin
+       over their own feasible sets, and window pods with NO feasible node
+       are retired as hopeless (sound within one solve — free capacity
+       only shrinks here, so infeasible-now is infeasible-later), so junk
+       pods cannot starve the window for feasible stragglers behind them.
+
     Correctness: scores are static, so the node ranking never changes.
     Queue-order per-node admission is the same exact sorted-segment prefix
-    check the generic waterfill runs. A pod whose target fails (fit or
-    admission) retries next wave against shrunk capacities; when the lite
-    waves stop progressing, FULL waves take over: windows of up to K
-    stragglers get a dense (K, N) feasibility row, feasible ones spread
-    round-robin over their own feasible sets, and window pods with NO
-    feasible node are retired as hopeless (sound within one solve — free
-    capacity only shrinks here, so infeasible-now is infeasible-later), so
-    junk pods cannot starve the window for feasible stragglers behind them.
-    Completeness therefore matches `waterfill_assign` UP TO THE WAVE
-    BUDGET: each phase runs at most `max_waves` waves (2*max_waves total),
-    and every full wave either places a pod, retires a hopeless one, or is
-    the last. Hard constraints (fit, node queue-order admission) hold
-    identically in all cases.
+    check the generic waterfill runs — exact on a window because only
+    window pods choose in that wave and window order IS queue order.
+    Completeness matches `waterfill_assign` UP TO THE WAVE BUDGET: phases
+    2 and 3 each run at most `max_waves` waves (2*max_waves + 1 total),
+    draining at least their window per productive wave. Hard constraints
+    (fit, node queue-order admission) hold identically in all cases.
 
     Mirrors the reference's scoring semantics for allocatable
     (/root/reference/pkg/noderesources/resource_allocation.go:49-76) at
@@ -458,44 +519,38 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
     demand = pod_fit_demand(req)
     order_n = jnp.argsort(-raw_scores, stable=True)  # static node ranking
 
-    def bucket_target(free, active):
-        # cumulative-demand waterfill: pod p targets the first node (score
-        # order) whose CUMULATIVE free capacity covers p's inclusive
-        # cumulative demand, per resource (exact under heterogeneous
-        # demands, unlike a mean-demand pods-per-node estimate: a queue of
-        # small pods fills the preferred nodes first instead of stampeding
-        # the one big node, mirroring sequential packing order). R 1-D
-        # cumsums + R searchsorteds — float64 exact below 2^53.
-        charge = jnp.where(active[:, None], demand, 0).astype(jnp.float64)
-        cumdem = jnp.cumsum(charge, axis=0)  # (P, R) inclusive
-        cumfree = jnp.cumsum(
-            jnp.clip(free[order_n], 0, None).astype(jnp.float64), axis=0
-        )  # (N, R) in score order
-        pos = jnp.max(
-            jax.vmap(
-                lambda cf, cd: jnp.searchsorted(cf, cd, side="left"),
-                in_axes=(1, 1), out_axes=1,
-            )(cumfree, cumdem),
-            axis=1,
-        )  # (P,) first node index (score order) covering the prefix
-        return order_n[jnp.minimum(pos, N - 1)].astype(jnp.int32)
+    #: next-fit probe depth per lite wave: a pod whose bucket node cannot
+    #: fit it individually (fragmentation — cumulative coverage is
+    #: necessary, not sufficient) probes the next few score-ordered nodes
+    #: in the SAME O(W*R) wave instead of stalling into the dense rescue
+    #: phase.
+    LITE_PROBES = 4
 
-    def lite_choice(free, active):
-        target = bucket_target(free, active)
-        # O(P*R): fit against the target row only
-        fit = jnp.all(demand <= free[target], axis=1)
+    def window_of(free, assignment, hopeless, W):
+        """First W still-active pods in queue order: (idx (W,), valid (W,),
+        dem (W, R)) — `jnp.nonzero(size=)` compaction, no P-length sort."""
+        active = (assignment == -1) & pod_mask & ~hopeless
+        idx = jnp.nonzero(active, size=W, fill_value=P)[0]
+        valid = idx < P
+        dem_w = jnp.where(
+            valid[:, None], demand[jnp.minimum(idx, P - 1)], 0
+        )
+        return idx, valid, dem_w
+
+    def lite_choice(free, idx, valid, dem_w):
+        # cumulative-demand waterfill over the window (the shared
+        # `_cumulative_demand_positions` bucketing; dem_w rows are already
+        # masked to valid window pods)
+        pos = _cumulative_demand_positions(dem_w, free, order_n)
+        choice = jnp.full(idx.shape[0], -1, jnp.int32)
+        for probe in range(LITE_PROBES):
+            cand = order_n[jnp.minimum(pos + probe, N - 1)].astype(jnp.int32)
+            fit = jnp.all(dem_w <= free[cand], axis=1)
+            choice = jnp.where((choice < 0) & valid & fit, cand, choice)
         # lite misses prove nothing about true feasibility: no hopeless delta
-        return jnp.where(active & fit, target, -1), jnp.zeros(P, bool)
+        return choice, jnp.zeros(idx.shape[0], bool)
 
-    # rescue-wave window: dense feasibility is computed for at most this
-    # many stragglers at a time ((K, N) work instead of (P, N); the wave
-    # loop drains K per wave when more remain). Full-phase completeness
-    # capacity is max_waves * K placements-or-retires — callers trading
-    # window size for throughput (the north-star chunk loop passes 256,
-    # halving its dominant (K, N) cumsum cost) shrink that ceiling too
-    K = min(P, rescue_window)
-
-    def full_choice(free, active):
+    def rescue_choice(free, idx, valid, dem_w):
         # dense rescue wave: straggler k takes the (k mod |feasible_k|)-th
         # best node of ITS OWN feasible set in score order. Plain argmax
         # stampedes one tied-score node (admission then drains a node's
@@ -503,87 +558,114 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
         # fragmented end-game); round-robin over each pod's feasible set
         # drains the residue in O(1) dense waves. Rank 0 still gets its
         # argmax, so the common one-straggler case keeps reference scoring.
-        # Compaction: only the first K stragglers (queue order) pay the
-        # dense row; later ones stay active for the next wave. Window pods
-        # with NO feasible node are reported hopeless so they stop
-        # occupying the window (free only shrinks within a solve, so the
-        # verdict cannot go stale).
-        sel = jnp.argsort(jnp.where(active, jnp.arange(P), P))[:K]
-        sel_active = active[sel]
+        W = idx.shape[0]
         feasible = jnp.all(
-            demand[sel][:, None, :] <= free[None, :, :], axis=2
-        ) & sel_active[:, None]
+            dem_w[:, None, :] <= free[None, :, :], axis=2
+        ) & valid[:, None]
         feas_sorted = feasible[:, order_n]  # score-desc node order
         counts = jnp.cumsum(feas_sorted.astype(jnp.int32), axis=1)
         total = counts[:, -1]
-        k = jnp.where(total > 0, jnp.arange(K) % jnp.maximum(total, 1), 0)
+        k = jnp.where(total > 0, jnp.arange(W) % jnp.maximum(total, 1), 0)
         pos = jax.vmap(
             lambda c, kk: jnp.searchsorted(c, kk, side="right")
         )(counts, k)  # first score-ordered index with counts > k
-        choice_k = jnp.where(
-            sel_active & (total > 0),
+        choice = jnp.where(
+            valid & (total > 0),
             order_n[jnp.minimum(pos, N - 1)].astype(jnp.int32),
             -1,
         )
-        choice = jnp.full(P, -1, jnp.int32).at[sel].set(choice_k)
-        hopeless_delta = jnp.zeros(P, bool).at[sel].set(
-            sel_active & (total == 0)
-        )
-        return choice, hopeless_delta
+        # window pods with NO feasible node retire as hopeless so they stop
+        # occupying the window (free only shrinks within a solve, so the
+        # verdict cannot go stale)
+        return choice, valid & (total == 0)
 
-    def wave(free, assignment, hopeless, choice_fn):
-        # O(P·R + P log P): admission runs on the (P,) choice vector via
-        # sorted segments (`_queue_order_admission_choice`) and commits via
-        # scatter-add — never the (P, N) onehot/winners matrices the
-        # generic waterfill builds (at north-star scale those are
-        # ~84M-element temporaries per wave)
-        active = (assignment == -1) & pod_mask & ~hopeless
-        choice, hopeless_delta = choice_fn(free, active)
-        admitted = (choice >= 0) & _queue_order_admission_choice(
-            choice, demand, free
+    def wave(free, assignment, hopeless, W, choice_fn):
+        # O(W·R + W log W): admission runs on the (W,) window choice vector
+        # via sorted segments (`_queue_order_admission_choice`) — exact,
+        # because only window pods choose and window order is queue order —
+        # and commits via scatter-add; never the (P, N) onehot/winners
+        # matrices (at north-star scale ~84M-element temporaries per wave)
+        idx, valid, dem_w = window_of(free, assignment, hopeless, W)
+        choice_w, hopeless_w = choice_fn(free, idx, valid, dem_w)
+        admitted = (choice_w >= 0) & _queue_order_admission_choice(
+            choice_w, dem_w, free
         )
-        new_assignment = jnp.where(admitted, choice, assignment)
-        used = jnp.zeros_like(free).at[jnp.where(admitted, choice, N - 1)].add(
-            jnp.where(admitted[:, None], demand, 0)
+        # scatter-ADD commits (not set-with-drop): adds of zero from the
+        # clamped fill rows are harmless under duplication AND partition
+        # cleanly when the pod axis is sharded (the SPMD partitioner
+        # mishandles windowed set-scatters)
+        safe_idx = jnp.minimum(idx, P - 1)
+        placed_plus = jnp.zeros(P, jnp.int32).at[safe_idx].add(
+            jnp.where(admitted, choice_w + 1, 0)
+        )
+        assignment = jnp.where(placed_plus > 0, placed_plus - 1, assignment)
+        hop_add = jnp.zeros(P, jnp.int32).at[safe_idx].add(
+            hopeless_w.astype(jnp.int32)
+        )
+        hopeless = hopeless | (hop_add > 0)
+        used = jnp.zeros_like(free).at[jnp.where(admitted, choice_w, N - 1)].add(
+            jnp.where(admitted[:, None], dem_w, 0)
         )
         return (
             free - used,
-            new_assignment,
-            hopeless | hopeless_delta,
-            admitted.sum() + hopeless_delta.sum(),
+            assignment,
+            hopeless,
+            admitted.sum(),
+            hopeless_w.sum(),
         )
 
-    # two phases, EACH with its own max_waves budget (up to 2*max_waves
-    # waves total): lite waves to quiescence, then full waves to
-    # quiescence (full resolves any straggler the bucket heuristic
-    # starves; the dense window is only paid on those late waves)
-    def run(free, assignment, hopeless, choice_fn):
+    # `occ` records ADMITTED pods per executed wave (whole-queue wave in
+    # slot 0, then lite/rescue waves in execution order); retirements count
+    # as progress but not occupancy.
+    def run(free, assignment, hopeless, W, choice_fn, occ, base, budget):
         def cond(ls):
-            free, assignment, hopeless, wave_idx, progressed = ls
+            free, assignment, hopeless, wave_idx, progressed, _ = ls
             return (
-                (wave_idx < max_waves)
+                (wave_idx < budget)
                 & progressed
                 & ((assignment == -1) & pod_mask & ~hopeless).any()
             )
 
         def body(ls):
-            free, assignment, hopeless, wave_idx, _ = ls
-            free, assignment, hopeless, n = wave(
-                free, assignment, hopeless, choice_fn
+            free, assignment, hopeless, wave_idx, _, occ = ls
+            free, assignment, hopeless, adm, retired = wave(
+                free, assignment, hopeless, W, choice_fn
             )
-            return free, assignment, hopeless, wave_idx + 1, n > 0
+            return (
+                free, assignment, hopeless, wave_idx + 1,
+                (adm + retired) > 0,
+                occ.at[base + wave_idx].set(adm.astype(jnp.int32)),
+            )
 
         return jax.lax.while_loop(
             cond, body,
-            (free, assignment, hopeless, jnp.int32(0), jnp.bool_(True)),
+            (free, assignment, hopeless, jnp.int32(0), jnp.bool_(True), occ),
         )
 
     assignment0 = jnp.full(P, -1, jnp.int32)
     hopeless0 = jnp.zeros(P, bool)
-    free, assignment, hopeless, _, _ = run(
-        free0, assignment0, hopeless0, lite_choice
+    occ0 = jnp.zeros(2 * max_waves + 1, jnp.int32)
+    Wl = min(P, lite_window)
+    K = min(P, rescue_window)
+    # phase 1: one whole-queue lite wave
+    free, assignment, hopeless, adm0, _ = wave(
+        free0, assignment0, hopeless0, P, lite_choice
     )
-    free, assignment, _, _, _ = run(free, assignment, hopeless, full_choice)
+    occ = occ0.at[0].set(adm0.astype(jnp.int32))
+    # phase 2: sparse lite waves over straggler windows
+    free, assignment, hopeless, w_lite, _, occ = run(
+        free, assignment, hopeless, Wl, lite_choice, occ, jnp.int32(1),
+        max_waves,
+    )
+    # phase 3: sparse rescue waves
+    free, assignment, _, w_full, _, occ = run(
+        free, assignment, hopeless, K, rescue_choice, occ, 1 + w_lite,
+        max_waves,
+    )
+    if collect_stats:
+        return assignment, free, {
+            "occupancy": occ, "waves": 1 + w_lite + w_full
+        }
     return assignment, free
 
 

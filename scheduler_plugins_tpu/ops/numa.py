@@ -148,6 +148,39 @@ def feasible_zones(avail, reported, zone_mask, node_alloc, guaranteed, req,
     )
 
 
+def batch_request_fit(avail, reported, zone_mask, node_alloc, guaranteed,
+                      reqs, affine, host_level):
+    """(P, N) single-request feasibility — the whole-batch form of
+    `feasible_zones(...)[1]`: one fused (P, N, Z, R) compare + boolean
+    reduction instead of a per-pod vmap of per-node kernels, with every
+    pod-invariant tensor (reported zones, zone masks, host-level masks,
+    node-presence bits) hoisted out of the pod axis. Bit-identical to
+    vmapping `feasible_zones` over nodes then pods.
+
+    avail: (N, Z, R) float live availability; reqs: (P, R) requests in the
+    same quantity domain; guaranteed: (P,) bool QoS bits.
+    """
+    relevant = reqs > 0  # (P, R)
+    present = node_alloc > 0  # (N, R)
+    early_reject = jnp.any(
+        relevant[:, None, :] & ~present[None, :, :], axis=2
+    )  # (P, N)
+    reported_z = reported & zone_mask[:, :, None]  # (N, Z, R)
+    has_affinity = jnp.any(reported_z, axis=1)  # (N, R)
+    suitable = (
+        (~guaranteed[:, None] & affine[None, :])[:, None, None, :]
+        | (avail[None] >= reqs[:, None, None, :])
+    )  # (P, N, Z, R)
+    per_resource = reported_z[None] & suitable
+    constrain = relevant[:, None, :] & ~(
+        ~has_affinity[None] & host_level[None, None, :]
+    )  # (P, N, R)
+    feasible = jnp.all(
+        jnp.where(constrain[:, :, None, :], per_resource, True), axis=3
+    ) & zone_mask[None]  # (P, N, Z)
+    return ~early_reject & feasible.any(axis=2)
+
+
 def single_numa_fit(avail, reported, zone_mask, node_alloc, guaranteed,
                     creq, is_init, cmask, affine, host_level):
     """Container-scope single-numa-node Filter verdict for one node.
@@ -188,18 +221,39 @@ BALANCED_ALLOCATION = "BalancedAllocation"
 LEAST_NUMA_NODES = "LeastNUMANodes"
 
 
-def _weighted_zone_score(per_resource_f, relevant, weights):
+def _weighted_zone_score(per_resource_f, relevant, weights,
+                         out_dtype=jnp.int64):
     """sum_r score_r * w_r / sum_r w_r over the requested resources, in the
     caller's float dtype (callers guarantee exactness: per-resource scores
-    are <= 100, so the weighted sum stays < 2^24 for f32 / 2^53 for f64)."""
+    are <= 100, so the weighted sum stays < 2^24 for f32 / 2^53 for f64).
+    The quotient is <= MAX_NODE_SCORE, so `out_dtype=jnp.int32` is always
+    exact — the batched score path demotes (the `demote_scores_int32`
+    pattern) to halve the (P, N, Z) traffic."""
     w = jnp.where(relevant, weights, 0).astype(per_resource_f.dtype)
     wsum = jnp.maximum(jnp.sum(w), 1.0)
     return floordiv_exact(
         jnp.sum(per_resource_f * w, axis=-1), wsum
-    ).astype(jnp.int64)
+    ).astype(out_dtype)
 
 
-def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
+def precompute_zone_scales(avail):
+    """Pod-invariant zone scale tensors for the Least/Most strategies:
+    (capf, safe_cap, recip) in `avail`'s float dtype. The reciprocal is the
+    precomputed-scale half of `floordiv_recip`; hoisting it to one per-solve
+    computation (instead of per pod under the batched vmap) is what turns
+    the per-element integer-division inner loop into multiplies."""
+    dt = (
+        avail.dtype
+        if jnp.issubdtype(avail.dtype, jnp.floating)
+        else jnp.float64
+    )
+    capf = avail.astype(dt)
+    safe_cap = jnp.maximum(capf, 1)
+    return capf, safe_cap, 1.0 / safe_cap
+
+
+def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights,
+                         scales=None, out_dtype=jnp.int64):
     """(Z,) per-zone scores for one request on one node.
 
     The integer divisions of least_allocated.go:45-55 / most_allocated.go are
@@ -209,6 +263,11 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
     dominant cost on both backends. BalancedAllocation keeps its ratio math
     in f64: the reference computes it in Go float64, and f64 division of the
     (scale-invariant) rational reproduces its rounding bit-for-bit.
+
+    `scales`: optional precomputed `precompute_zone_scales(avail)` triple —
+    callers scoring a whole batch against one availability tensor hoist it
+    out of their pod loop/vmap. `out_dtype` demotes the (always <= 100)
+    zone scores where the caller wants int32 tensors.
     """
     cap = avail  # zone "allocatable" = published available (pluginhelpers.go)
     dt = (
@@ -217,22 +276,24 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
         else jnp.float64
     )
     if strategy in (LEAST_ALLOCATED, MOST_ALLOCATED):
-        capf = cap.astype(dt)
+        if scales is None:
+            scales = precompute_zone_scales(cap)
+        capf, safe_cap, recip = scales
         reqf = req[None, :].astype(dt)
         numer = (capf - reqf) if strategy == LEAST_ALLOCATED else reqf
-        # reciprocal-multiply floor division: `capf` is pod-invariant, so
-        # under the batched solver's vmap the reciprocal is computed once
-        # while the division would run per (pod, node, zone, resource) —
-        # the dominant op of the NUMA score pass on both backends
-        safe_cap = jnp.maximum(capf, 1)
+        # reciprocal-multiply floor division with the precomputed scale:
+        # `capf` is pod-invariant, so the reciprocal is computed once per
+        # solve while the division would run per (pod, node, zone,
+        # resource) — the dominant op of the NUMA score pass on both
+        # backends
         per = jnp.where(
             (capf == 0) | (reqf > capf),
             0.0,
             floordiv_recip(
-                numer * float(MAX_NODE_SCORE), safe_cap, 1.0 / safe_cap
+                numer * float(MAX_NODE_SCORE), safe_cap, recip
             ),
         )
-        scores = _weighted_zone_score(per, relevant, weights)
+        scores = _weighted_zone_score(per, relevant, weights, out_dtype)
     elif strategy == BALANCED_ALLOCATION:
         cap = cap.astype(jnp.float64)
         # fractionOfCapacity (balanced_allocation.go:50-55): req/capacity
@@ -255,7 +316,7 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
         # gonum stat.Variance is the unbiased sample variance (N-1 divisor)
         variance = jnp.where(n > 1, sq / jnp.maximum(n - 1, 1), 0.0)
         scores = jnp.where(
-            over, 0, jnp.trunc((1.0 - variance) * MAX_NODE_SCORE).astype(jnp.int64)
+            over, 0, jnp.trunc((1.0 - variance) * MAX_NODE_SCORE).astype(out_dtype)
         )
     else:  # pragma: no cover
         raise ValueError(f"illegal scoring strategy {strategy}")
@@ -264,10 +325,53 @@ def zone_strategy_scores(strategy, req, avail, zone_mask, relevant, weights):
 
 def min_over_zones(scores, zone_mask):
     """Zero-skipping min (score.go:110-124): zones scoring 0 are ignored by
-    the kubelet, so 0 only results when every zone scored 0."""
+    the kubelet, so 0 only results when every zone scored 0. The sentinel is
+    dtype-aware so the int32-demoted batched path stays int32 end to end."""
     nonzero = zone_mask & (scores != 0)
-    min_nonzero = jnp.min(jnp.where(nonzero, scores, jnp.int64(2**62)))
+    sentinel = scores.dtype.type(jnp.iinfo(scores.dtype).max // 2)
+    min_nonzero = jnp.min(jnp.where(nonzero, scores, sentinel))
     return jnp.where(nonzero.any(), min_nonzero, 0)
+
+
+def batch_strategy_node_scores(strategy, reqs, avail, zone_mask, weights,
+                               scales=None):
+    """(P, N) zero-skip-min node scores for a batch of single (R,) requests
+    — the whole-batch form of `zone_strategy_scores` + `min_over_zones`:
+    the pod-invariant zone scales are hoisted and computed ONCE per solve
+    (not per pod under the vmap), and the zone-score arithmetic runs
+    int32-demoted (always exact — weighted zone scores are <=
+    MAX_NODE_SCORE). Values are identical to the per-pod path; only the
+    output dtype narrows."""
+    if strategy in (LEAST_ALLOCATED, MOST_ALLOCATED):
+        if scales is None:
+            scales = precompute_zone_scales(avail)
+
+        def per_pod(r):
+            relevant = r > 0
+
+            def node(avail_n, zmask_n, scales_n):
+                zs = zone_strategy_scores(
+                    strategy, r, avail_n, zmask_n, relevant, weights,
+                    scales=scales_n, out_dtype=jnp.int32,
+                )
+                return min_over_zones(zs, zmask_n)
+
+            return jax.vmap(node)(avail, zone_mask, scales)
+    else:
+
+        def per_pod(r):
+            relevant = r > 0
+
+            def node(avail_n, zmask_n):
+                zs = zone_strategy_scores(
+                    strategy, r, avail_n, zmask_n, relevant, weights,
+                    out_dtype=jnp.int32,
+                )
+                return min_over_zones(zs, zmask_n)
+
+            return jax.vmap(node)(avail, zone_mask)
+
+    return jax.vmap(per_pod)(reqs)
 
 
 # ---------------------------------------------------------------------------

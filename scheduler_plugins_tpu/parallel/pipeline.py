@@ -1,0 +1,164 @@
+"""Donated, double-buffered chunk pipeline.
+
+The north-star solve streams pods through the chunked targeted waterfill
+with free capacity carried between chunks (queue order preserved across
+chunk boundaries). The naive loop serializes three phases per chunk —
+host->device transfer of the next chunk's inputs, the solve, and the
+device->host transfer of the previous chunk's assignments — leaving the
+device idle during both transfers and the host blocked during the solve.
+
+`run_chunk_pipeline` overlaps all three with a one-chunk lag:
+
+    dispatch solve(k)            # async — device starts immediately
+    device_put(chunk k+1 inputs) # H2D overlaps solve(k)
+    collect(result k-1)          # D2H blocks only until solve(k-1) done
+
+so the device is never idle between chunks and the host is never more
+than one chunk behind (the bounded in-flight window matters through the
+tunneled TPU backend, where chaining everything device-side balloons the
+working set — CLAUDE.md). The chunk solver DONATES its carry argument
+(`donated_chunk_solver`), so the free-capacity tensor threads chunk to
+chunk in place instead of being copied at every dispatch boundary.
+
+Consumers: `bench.py north_star` (the 10,240x102,400 headline run) and the
+daemon cycle loop (`framework.cycle.run_cycle(stream_chunk=...)`) via
+`streamed_profile_solve` below.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def donated_chunk_solver(fn, carry_argnum: int):
+    """Jit `fn` with its carry argument donated — the pipeline's calling
+    convention. Callers must treat the carry they pass in as CONSUMED
+    (rebind it from the call's result; `tools/graft_lint.py` GL006 flags
+    reuse of a donated buffer after the donating call)."""
+    return jax.jit(fn, donate_argnums=(carry_argnum,))
+
+
+def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
+                       clock=None):
+    """Stream `chunk_inputs` through `solve_chunk`, double-buffered.
+
+    - ``solve_chunk(*invariant_args, *chunk_dev, carry) -> (result, carry)``
+      — typically a `donated_chunk_solver`; `result` may be any pytree
+      (e.g. ``(assignment, wave_stats)``).
+    - ``chunk_inputs``: sequence of per-chunk argument tuples (host numpy
+      or device arrays; they are `jax.device_put` one chunk ahead).
+    - ``carry``: the threaded state (free capacity); returned updated.
+    - ``clock``: optional ``time.perf_counter``-like callable for the
+      completion stamps (injectable for tests).
+
+    Returns ``(results, carry, done_s)`` where ``results[k]`` is chunk k's
+    `result` pytree fetched to host and ``done_s[k]`` its completion time
+    (seconds since the pipeline started) — the per-chunk decision-latency
+    stamps the north-star p50/p99 derive from. Completion of chunk k is
+    observed one dispatch later (lag-1), so the stamps are conservative by
+    at most one dispatch overhead, never optimistic.
+    """
+    clock = clock or time.perf_counter
+    n = len(chunk_inputs)
+    results, done_s = [], []
+    start = clock()
+    pending = None
+    dev = tuple(jax.device_put(a) for a in chunk_inputs[0]) if n else ()
+    for k in range(n):
+        result, carry = solve_chunk(*invariant_args, *dev, carry)
+        if k + 1 < n:
+            # H2D for chunk k+1 overlaps solve(k)
+            dev = tuple(jax.device_put(a) for a in chunk_inputs[k + 1])
+        if pending is not None:
+            # D2H for chunk k-1: blocks only until ITS solve finished
+            results.append(jax.device_get(pending))
+            done_s.append(clock() - start)
+        pending = result
+    if pending is not None:
+        results.append(jax.device_get(pending))
+        done_s.append(clock() - start)
+    return results, carry, done_s
+
+
+# ---------------------------------------------------------------------------
+# Streamed profile solve (the cycle loop's adoption point)
+# ---------------------------------------------------------------------------
+
+
+def _targeted_fast_gate(scheduler):
+    """The profile shape the chunked targeted waterfill supports — THE gate
+    is `parallel.solver.fast_path_scoring`, shared with
+    `profile_batch_fn`'s fast branch so the two paths cannot drift."""
+    from scheduler_plugins_tpu.parallel.solver import fast_path_scoring
+
+    plugins = tuple(scheduler.profile.plugins)
+    return fast_path_scoring(plugins), plugins
+
+
+def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
+                           max_waves: int = 8, rescue_window: int = 256):
+    """Chunked, double-buffered variant of the targeted fast-path solve:
+    admission and the static node ranking are computed once, then pod
+    chunks stream through the donated targeted waterfill with free capacity
+    carried chunk to chunk; gang quorum and the queue-order quota prefix
+    run once over the full batch at the end (`finalize_assignment` needs
+    whole-batch queue order, and chunk boundaries preserve it).
+
+    Returns (assignment, admitted, wait) like `profile_batch_solve`, or
+    None when the profile does not qualify (callers fall back). Placements
+    match the unchunked targeted waterfill up to wave-budget effects; hard
+    constraints (fit, queue-order admission, quota caps, gang quorum) hold
+    identically.
+    """
+    from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
+    from scheduler_plugins_tpu.parallel.solver import finalize_assignment
+
+    scoring, plugins = _targeted_fast_gate(scheduler)
+    if scoring is None:
+        return None
+    P = snap.num_pods
+    chunk = min(chunk, P)
+    if P % chunk != 0:
+        return None  # snapshot padding didn't land on a chunk multiple
+
+    state0 = scheduler.initial_state(snap)
+    auxes = tuple(p.aux() for p in plugins)
+
+    cache = scheduler._solve_cache
+    key = ("streamed_head",) + tuple(p.static_key() for p in plugins)
+    if key not in cache:
+        from scheduler_plugins_tpu.parallel.solver import fast_solve_head
+
+        def head(snap, state0, auxes):
+            # the shared traced head of the targeted fast path (admission
+            # vmap + raw static ranking + masked initial free)
+            return fast_solve_head(plugins, scoring, snap, state0, auxes)
+
+        cache[key] = jax.jit(head)
+    admitted, raw, free0 = cache[key](snap, state0, auxes)
+
+    ckey = ("streamed_chunk", chunk, max_waves, rescue_window)
+    if ckey not in cache:
+
+        def solve_one(raw, req_chunk, mask_chunk, free):
+            return waterfill_assign_targeted(
+                raw, req_chunk, mask_chunk, free,
+                max_waves=max_waves, rescue_window=rescue_window,
+            )
+
+        cache[ckey] = donated_chunk_solver(solve_one, carry_argnum=3)
+
+    chunk_inputs = [
+        (snap.pods.req[lo:lo + chunk], admitted[lo:lo + chunk])
+        for lo in range(0, P, chunk)
+    ]
+    parts, free, _ = run_chunk_pipeline(
+        cache[ckey], (raw,), chunk_inputs, free0
+    )
+    assignment = jnp.concatenate([jnp.asarray(a) for a in parts])
+    assignment, wait = finalize_assignment(assignment, snap)
+    return assignment, admitted, wait

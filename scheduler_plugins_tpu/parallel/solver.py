@@ -216,10 +216,12 @@ def _namespace_quota_prefix_ok(assignment_order_ok, snap, eq_used):
     return ~has_q | verdicts(admitted)
 
 
-def batch_solve(snap, weights, max_waves: int = 8):
+def batch_solve(snap, weights, max_waves: int = 8, collect_stats: bool = False):
     """Full batched step: admission -> fit -> allocatable score -> wave
     assignment -> quota prefix enforcement -> gang quorum.
-    Returns (assignment (P,), admitted (P,), wait (P,)).
+    Returns (assignment (P,), admitted (P,), wait (P,)), plus the per-wave
+    occupancy stats dict when `collect_stats` (see
+    `ops.assign.waterfill_assign_stateful`).
 
     Allocatable scores are STATIC per node (the reference scores
     allocatable, not free capacity — resource_allocation.go:49-76), so the
@@ -235,23 +237,134 @@ def batch_solve(snap, weights, max_waves: int = 8):
         allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
     )
     solve_free0 = jnp.where(snap.nodes.mask[:, None], free0, 0)
-    assignment, _ = waterfill_assign_targeted(
+    out = waterfill_assign_targeted(
         raw.astype(jnp.int64), snap.pods.req, admitted, solve_free0,
-        max_waves=max_waves,
+        max_waves=max_waves, collect_stats=collect_stats,
     )
+    assignment = out[0]
 
     assignment, wait = finalize_assignment(assignment, snap)
+    if collect_stats:
+        return assignment, admitted, wait, out[2]
     return assignment, admitted, wait
 
 
-def profile_batch_solve(scheduler, snap, max_waves: int = 8):
+def profile_batch_solve(scheduler, snap, max_waves: int = 8,
+                        collect_stats: bool = False):
     """Run `profile_batch_fn`'s jitted solve — see that docstring for the
     semantics contract vs the sequential parity path."""
-    fn, args = profile_batch_fn(scheduler, snap, max_waves=max_waves)
+    fn, args = profile_batch_fn(
+        scheduler, snap, max_waves=max_waves, collect_stats=collect_stats
+    )
     return fn(*args)
 
 
-def profile_batch_fn(scheduler, snap, max_waves: int = 8):
+#: sparse straggler-wave window for the profile solvers: re-filter rows per
+#: straggler wave. 128 (vs the generic default 256) halves the dominant
+#: (S, N, Z, R) NUMA re-filter cost per wave; wider straggler cohorts just
+#: drain over more (cheaper) waves, and a stalled sparse wave still
+#: escalates to one dense wave (ops.assign starvation guard).
+PROFILE_STRAGGLER_CAP = 128
+
+
+def fast_path_scoring(plugins):
+    """The single scoring plugin of the targeted fast path, or None when
+    the profile doesn't qualify — THE one copy of the gate (ISSUE 2
+    review): no per-(pod, node) filters, no state-dependent plugins, ONE
+    scoring plugin rating nodes pod-invariantly (`static_node_scores`)
+    with positive weight (raw order == normalized-weighted order only
+    holds for a positive weight — ADVICE r4). Shared by
+    `profile_batch_fn`'s fast branch and the streamed pipeline solve
+    (`parallel.pipeline.streamed_profile_solve`) so the two paths cannot
+    gate differently."""
+    from scheduler_plugins_tpu.framework.plugin import Plugin as _PluginBase
+
+    plugins = tuple(plugins)
+    scoring = tuple(
+        p for p in plugins if type(p).score is not _PluginBase.score
+    )
+    filtering = tuple(
+        p for p in plugins if type(p).filter is not _PluginBase.filter
+    )
+    ok = (
+        not any(p.state_dependent_filter for p in plugins)
+        and not filtering
+        and len(scoring) == 1
+        and type(scoring[0]).static_node_scores
+        is not _PluginBase.static_node_scores
+        and scoring[0].weight > 0
+    )
+    return scoring[0] if ok else None
+
+
+def fast_solve_head(plugins, scoring, snap, state0, auxes):
+    """Traced head shared by the targeted fast paths: bind aux/presolve,
+    vmapped PreFilter admission, the raw static node ranking, and the
+    masked initial free capacity. Returns (admitted (P,), raw (N,) int64,
+    free0 (N, R))."""
+    for plugin, aux in zip(plugins, auxes):
+        plugin.bind_aux(aux)
+    for plugin in plugins:
+        plugin.bind_presolve(plugin.prepare_solve(snap))
+
+    def admit_one(p):
+        ok = snap.pods.mask[p] & ~snap.pods.gated[p]
+        for plugin in plugins:
+            verdict = plugin.admit(state0, snap, p)
+            if verdict is not None:
+                ok &= verdict
+        return ok
+
+    admitted = jax.vmap(admit_one)(jnp.arange(snap.num_pods))
+    raw = scoring.static_node_scores(snap).astype(jnp.int64)
+    free0 = jnp.where(snap.nodes.mask[:, None], state0.free, 0)
+    return admitted, raw, free0
+
+
+def _wrap_donated(fn):
+    """Silence jax's "Some donated buffers were not usable" lowering
+    warning for the profile solves ONLY: the state argument is donated as a
+    whole, and the (N, R)/(N, Z, R) carries intentionally have no
+    same-shape output to alias — XLA still releases them early (peak-memory
+    win); the warning would otherwise fire on every first compile."""
+    import functools
+    import warnings
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def _donation_safe_state(state0):
+    """SolverState with the leaves that may ALIAS snapshot tensors copied
+    (eq_used is snap.quota.used; net_placed is snap.network.placed_node;
+    the scheduling carries come from jnp.asarray over snapshot bases):
+    the jitted profile solves donate the state argument, and a donated
+    buffer that is also reachable through the non-donated snapshot
+    argument would be written under the snapshot's feet. The copied
+    tensors are side tables — (Q, R)/(W, N) — not the (N, ...) carries."""
+
+    def copy(x):
+        return None if x is None else jnp.asarray(x).copy()
+
+    return state0.replace(
+        eq_used=copy(state0.eq_used),
+        net_placed=copy(state0.net_placed),
+        sel_counts=copy(state0.sel_counts),
+        sel_dom_counts=copy(state0.sel_dom_counts),
+        anti_domains=copy(state0.anti_domains),
+        sym_counts=copy(state0.sym_counts),
+    )
+
+
+def profile_batch_fn(scheduler, snap, max_waves: int = 8,
+                     collect_stats: bool = False):
     """(jitted_fn, args) for the batched profile solve on `snap`, WITHOUT
     invoking it — the AOT seam: `tools/tpu_lower.py` exports exactly the
     callable the runtime executes (same trace-cache, same fast-path gate),
@@ -278,6 +391,14 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
       computed once against the cycle-initial state, so tie-breaking and
       score-driven packing order may differ from the sequential scan —
       the wave trade-off documented in ops.assign.waterfill_assign.
+
+    The jitted solve DONATES the state argument (`donate_argnums`): the
+    SolverState carries (free, eq_used, gang_inflight, numa_avail) threaded
+    through the wave loops update in place instead of holding a second
+    copy of every carry alive across the dispatch. `args` is therefore
+    single-shot — `profile_batch_fn` builds a fresh state per call, and a
+    caller holding on to `args` must not invoke the returned fn twice with
+    the same tuple (tools/graft_lint.py GL006 flags such reuse).
     """
     import jax
 
@@ -302,7 +423,7 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
                 f"{p.name}: state_dependent_filter requires commit_batch "
                 "or validate_at"
             )
-    state0 = scheduler.initial_state(snap)
+    state0 = _donation_safe_state(scheduler.initial_state(snap))
     auxes = tuple(p.aux() for p in plugins)
 
     # ---- targeted fast path ------------------------------------------
@@ -315,57 +436,32 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
     # coscheduling/capacity profiles, where the reference spends its time
     # in PreFilter bookkeeping, not Filter fan-out
     # (capacity_scheduling.go:208-282). Ranking uses the plugin's RAW
-    # static scores — sound because the gate requires a SINGLE scoring
-    # plugin and static_node_scores' contract requires its normalize to
-    # be monotone with positive weight (framework/plugin.py).
-    scoring = tuple(
-        p for p in plugins if type(p).score is not _PluginBase.score
-    )
-    filtering = tuple(
-        p for p in plugins if type(p).filter is not _PluginBase.filter
-    )
-    fast = (
-        not dyn_plugins
-        and not filtering
-        and len(scoring) == 1
-        and type(scoring[0]).static_node_scores
-        is not _PluginBase.static_node_scores
-        # raw-order == normalized-weighted-order only holds for a positive
-        # weight; weight<=0 must fall back to the generic path (ADVICE r4)
-        and scoring[0].weight > 0
-    )
-    if fast:
+    # static scores — sound because the gate (`fast_path_scoring`, shared
+    # with the streamed pipeline solve) requires a SINGLE scoring plugin
+    # and static_node_scores' contract requires its normalize to be
+    # monotone with positive weight (framework/plugin.py).
+    scoring_p = fast_path_scoring(plugins)
+    if scoring_p is not None:
 
         def fast_batch(snap, state0, auxes):
-            for plugin, aux in zip(plugins, auxes):
-                plugin.bind_aux(aux)
-            for plugin in plugins:
-                plugin.bind_presolve(plugin.prepare_solve(snap))
-
-            def admit_one(p):
-                ok = snap.pods.mask[p] & ~snap.pods.gated[p]
-                for plugin in plugins:
-                    verdict = plugin.admit(state0, snap, p)
-                    if verdict is not None:
-                        ok &= verdict
-                return ok
-
-            admitted = jax.vmap(admit_one)(jnp.arange(snap.num_pods))
-            raw = scoring[0].static_node_scores(snap).astype(jnp.int64)
-            assignment, _ = waterfill_assign_targeted(
-                raw, snap.pods.req, admitted,
-                jnp.where(snap.nodes.mask[:, None], state0.free, 0),
-                max_waves=max_waves,
+            admitted, raw, free0 = fast_solve_head(
+                plugins, scoring_p, snap, state0, auxes
             )
-            assignment, wait = finalize_assignment(assignment, snap)
+            out = waterfill_assign_targeted(
+                raw, snap.pods.req, admitted, free0,
+                max_waves=max_waves, collect_stats=collect_stats,
+            )
+            assignment, wait = finalize_assignment(out[0], snap)
+            if collect_stats:
+                return assignment, admitted, wait, out[2]
             return assignment, admitted, wait
 
-        key = ("profile_batch_fast", max_waves) + tuple(
+        key = ("profile_batch_fast", max_waves, collect_stats) + tuple(
             p.static_key() for p in plugins
         )
         cache = scheduler._solve_cache
         if key not in cache:
-            cache[key] = jax.jit(fast_batch)
+            cache[key] = _wrap_donated(jax.jit(fast_batch, donate_argnums=(1,)))
         return cache[key], (snap, state0, auxes)
     # ------------------------------------------------------------------
 
@@ -497,13 +593,21 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
 
         def sub_batch_fn(free, state, idx, act_sub):
             """Sparse straggler re-filter: (S, N) rows for the `idx` pods
-            only — a straggler wave re-runs the dyn filters on <=256 pods
-            instead of the whole batch."""
+            only — a straggler wave re-runs the dyn filters on a small
+            window instead of the whole batch."""
             feasible = fits(
                 snap.pods.req[idx], free,
                 pod_mask=act_sub, node_mask=snap.nodes.mask,
             ) & static_feasible[idx]
             for plugin in dyn_plugins:
+                # row-sliced re-filter when offered (NUMA): S rows at S/P
+                # of the whole-matrix cost — the whole-matrix form would
+                # recompute (P, N, Z, R) per straggler wave
+                if type(plugin).filter_rows is not _PluginBase.filter_rows:
+                    r = plugin.filter_rows(state, snap, idx)
+                    if r is not None:
+                        feasible &= r
+                        continue
                 m = _batch_filter(plugin, state)
                 if m is not None:
                     # class-collapsed rows: XLA folds the row gather into
@@ -576,7 +680,7 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
 
         from scheduler_plugins_tpu.ops.assign import waterfill_assign_stateful
 
-        assignment, _, _ = waterfill_assign_stateful(
+        out = waterfill_assign_stateful(
             batch_fn,
             commit_fn,
             tuple(guards),
@@ -593,16 +697,20 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8):
             # paid for (state is unchanged until the first commit)
             initial_batch=(feasible0, scores0),
             sub_batch_fn=sub_batch_fn,
+            straggler_cap=PROFILE_STRAGGLER_CAP,
+            collect_stats=collect_stats,
         )
-        assignment, wait = finalize_assignment(assignment, snap)
+        assignment, wait = finalize_assignment(out[0], snap)
+        if collect_stats:
+            return assignment, admitted, wait, out[3]
         return assignment, admitted, wait
 
-    key = ("profile_batch", max_waves) + tuple(
+    key = ("profile_batch", max_waves, collect_stats) + tuple(
         p.static_key() for p in plugins
     )
     cache = scheduler._solve_cache
     if key not in cache:
-        cache[key] = jax.jit(batch)
+        cache[key] = _wrap_donated(jax.jit(batch, donate_argnums=(1,)))
     return cache[key], (snap, state0, auxes)
 
 

@@ -301,6 +301,128 @@ class NodeResourceTopologyMatch(Plugin):
         skip = (snap.pods.qos[p] == int(QOSClass.BEST_EFFORT)) & ~non_native
         return jnp.where(skip, True, verdict)
 
+    # -- batched Filter/Score (the wave path's hot kernels) ---------------
+    def _single_request_rows(self, snap):
+        """(P, R) single-request rows in the live-quantity domain when the
+        whole-batch NUMA kernels apply — uniform pod scope, or uniform
+        container scope with one container slot (no sequential subtraction
+        to thread). None selects the per-pod vmap fallback."""
+        pre = getattr(self, "_presolve", None)
+        if self._uniform_scope == int(TopologyManagerScope.POD):
+            if pre is not None:
+                return pre["req"]
+            return numa_ops.scale_qty(snap.numa, snap.pods.req)
+        if (
+            self._uniform_scope == int(TopologyManagerScope.CONTAINER)
+            and snap.pods.container_req.shape[1] == 1
+        ):
+            creq = (
+                pre["creq"] if pre is not None
+                else numa_ops.scale_qty(snap.numa, snap.pods.container_req)
+            )
+            return creq[:, 0, :]
+        return None
+
+    def _batch_single_fit(self, state, snap, sel=None):
+        """(S, N) Filter verdicts for the whole batch (or the `sel` rows)
+        via `ops.numa.batch_request_fit` — one fused (S, N, Z, R) pass with
+        every pod-invariant tensor hoisted, replacing the per-pod vmap of
+        per-node kernels on the batched path. Bit-identical to `filter`."""
+        numa = snap.numa
+        affine, host_level, host_extended, _ = self._aux
+        rows = self._single_request_rows(snap)
+        if rows is None:
+            return None
+        qos = snap.pods.qos
+        req_raw = snap.pods.req
+        if sel is not None:
+            rows, qos, req_raw = rows[sel], qos[sel], req_raw[sel]
+        guaranteed = qos == int(QOSClass.GUARANTEED)
+        avail = self._numa_avail(state, snap)  # (N, Z, R) float
+        ok = numa_ops.batch_request_fit(
+            avail, numa.reported, numa.zone_mask, snap.nodes.alloc,
+            guaranteed, rows, affine, host_level,
+        )
+        # only single-numa-node policy filters (filter.go:230-241); stale
+        # cache views reject regardless (filter.go:194-197)
+        applies = numa.has_nrt & (
+            numa.policy == int(TopologyManagerPolicy.SINGLE_NUMA_NODE)
+        )
+        verdict = jnp.where(applies[None, :], ok, True) & numa.fresh[None, :]
+        non_native = jnp.any((req_raw > 0) & host_extended[None, :], axis=1)
+        skip = (qos == int(QOSClass.BEST_EFFORT)) & ~non_native
+        return jnp.where(skip[:, None], True, verdict)
+
+    def filter_batch(self, state, snap):
+        if snap.numa is None:
+            return None
+        return self._batch_single_fit(state, snap)
+
+    def filter_rows(self, state, snap, idx):
+        if snap.numa is None:
+            return None
+        return self._batch_single_fit(state, snap, sel=idx)
+
+    def score_batch(self, state, snap):
+        """(P, N) int32 raw scores with the pod-invariant zone scales
+        computed once per solve (`ops.numa.precompute_zone_scales`) —
+        value-identical to the vmapped per-pod `score`, demoted to int32
+        (exact: node scores are <= MAX_NODE_SCORE). LeastNUMANodes and
+        mixed-scope clusters fall back to the per-pod path."""
+        if snap.numa is None or self.strategy == LEAST_NUMA_NODES:
+            return None
+        numa = snap.numa
+        scope = self._uniform_scope
+        if scope not in (
+            int(TopologyManagerScope.POD), int(TopologyManagerScope.CONTAINER)
+        ):
+            return None
+        _, _, _, weights = self._aux
+        available = self._numa_avail(state, snap)
+        if available.dtype == jnp.float32 and not self._weights_f32_ok():
+            available = available.astype(jnp.float64)
+        pre = getattr(self, "_presolve", None)
+        if scope == int(TopologyManagerScope.POD):
+            reqs = (
+                pre["req"] if pre is not None
+                else numa_ops.scale_qty(snap.numa, snap.pods.req)
+            )
+            raw = numa_ops.batch_strategy_node_scores(
+                self.strategy, reqs, available, numa.zone_mask, weights
+            )
+        else:
+            creq = (
+                pre["creq"] if pre is not None
+                else numa_ops.scale_qty(snap.numa, snap.pods.container_req)
+            )
+            C = creq.shape[1]
+            cmask = snap.pods.container_mask
+            count = jnp.maximum(jnp.sum(cmask, axis=1), 1)
+            scales = (
+                numa_ops.precompute_zone_scales(available)
+                if self.strategy in (LEAST_ALLOCATED, MOST_ALLOCATED)
+                else None
+            )
+            # mean over containers, float, truncated (score.go:152-165) —
+            # the batched form of node_container_scope's static C loop
+            total = jnp.zeros((snap.num_pods, snap.num_nodes), jnp.float64)
+            for c in range(C):
+                s_c = numa_ops.batch_strategy_node_scores(
+                    self.strategy, creq[:, c], available, numa.zone_mask,
+                    weights, scales=scales,
+                )
+                total = total + jnp.where(
+                    cmask[:, c][:, None], s_c.astype(jnp.float64), 0.0
+                )
+            raw = jnp.trunc(
+                total / count[:, None].astype(jnp.float64)
+            ).astype(jnp.int32)
+        guaranteed = snap.pods.qos == int(QOSClass.GUARANTEED)
+        raw = jnp.where((numa.has_nrt & numa.fresh)[None, :], raw, 0)
+        return jnp.where(
+            guaranteed[:, None], raw, jnp.int32(numa_ops.MAX_NODE_SCORE)
+        )
+
     def commit(self, state, snap, p, choice):
         """Reserve: pessimistically deduct the placed pod's request from
         EVERY reported zone of the chosen node (ReserveNodeResources +

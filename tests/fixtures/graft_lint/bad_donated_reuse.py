@@ -1,0 +1,15 @@
+"""GL006 golden-bad: reading a buffer after donating it to a jitted call."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, x: (s + x, s * x), donate_argnums=(0,))
+
+
+def drive(s, xs):
+    total = jnp.zeros(())
+    for x in xs:
+        s2, y = step(s, x)
+        total = total + y + s.sum()  # s was donated to step() above
+        s = s2
+    return total

@@ -296,3 +296,157 @@ class TestDifferential:
             for a in np.asarray(result.assignment)[: len(pods)]
         ]
         assert got == expected
+
+
+class TestBatchedNumaGangHardConstraintParity:
+    """ISSUE 2 satellite: the rewritten batched NUMA path vs the sequential
+    parity path on a cfg-2-shaped cluster (NRT zones + gangs) — hard
+    constraints (resource fit, single-NUMA feasibility, gang quorum) must
+    hold IDENTICALLY in both modes across >= 3 seeds, with independent
+    numpy replay oracles (no jax code on the oracle side)."""
+
+    ZONES = 4
+
+    def _cluster(self, rng, n_nodes=96, n_gangs=6, gang_size=8, n_singles=48):
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL,
+            NodeResourceTopology,
+            NUMAZone,
+            PodGroup,
+            TopologyManagerPolicy,
+        )
+
+        cluster = Cluster()
+        per_zone_cpu = 16_000 // self.ZONES
+        for i in range(n_nodes):
+            cluster.add_node(Node(
+                name=f"n{i:03d}",
+                allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 32},
+            ))
+            cluster.add_nrt(NodeResourceTopology(
+                node_name=f"n{i:03d}",
+                policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+                zones=[
+                    NUMAZone(
+                        numa_id=z,
+                        available={CPU: per_zone_cpu, MEMORY: 16 * gib},
+                    )
+                    for z in range(self.ZONES)
+                ],
+            ))
+
+        def guaranteed(name, order, cpu, labels=None):
+            return Pod(
+                name=name, creation_ms=order,
+                containers=[Container(
+                    requests={CPU: cpu, MEMORY: 1 * gib},
+                    limits={CPU: cpu, MEMORY: 1 * gib},
+                )],
+                labels=labels or {},
+            )
+
+        order = 0
+        for g in range(n_gangs):
+            cluster.add_pod_group(
+                PodGroup(name=f"gang-{g}", min_member=gang_size)
+            )
+            for m in range(gang_size):
+                cluster.add_pod(guaranteed(
+                    f"gang-{g}-m{m}", order,
+                    int(rng.integers(200, per_zone_cpu // 2)),
+                    labels={POD_GROUP_LABEL: f"gang-{g}"},
+                ))
+                order += 1
+        for s in range(n_singles):
+            cluster.add_pod(guaranteed(
+                f"single-{s}", order,
+                int(rng.integers(200, per_zone_cpu)),
+            ))
+            order += 1
+        return cluster
+
+    # -- numpy replay oracles (independent of the jax kernels) -----------
+    def _fit_ok(self, an, snap):
+        req = np.asarray(snap.pods.req)
+        alloc = np.asarray(snap.nodes.alloc)
+        used = np.zeros_like(alloc)
+        for p, n in enumerate(an):
+            if n >= 0:
+                used[n] += req[p]
+                used[n, -1] += 0  # pods slot already in req encoding
+        return bool((used <= alloc).all())
+
+    def _numa_ok(self, an, snap):
+        """Queue-order pessimistic replay: every placed pod had a fitting
+        zone at its own placement time (all-reported-zone deduction)."""
+        req = np.asarray(snap.pods.req)
+        avail = np.asarray(snap.numa.available).astype(np.int64).copy()
+        reported = np.asarray(snap.numa.reported)
+        zmask = np.asarray(snap.numa.zone_mask)
+        for p in np.argsort(np.arange(len(an))):  # queue order
+            n = an[p]
+            if n < 0:
+                continue
+            fit = any(
+                zmask[n, z] and all(
+                    not (req[p, r] > 0 and reported[n, z, r]
+                         and avail[n, z, r] < req[p, r])
+                    for r in range(req.shape[1])
+                )
+                for z in range(avail.shape[1])
+            )
+            if not fit:
+                return False
+            avail[n][reported[n]] -= np.broadcast_to(
+                req[p][None, :], avail[n].shape
+            )[reported[n]]
+        return True
+
+    def _gang_quorum_ok(self, an, wait, snap):
+        """No gang binds below quorum: members placed WITHOUT a Permit-Wait
+        flag only exist when the gang's placed count reaches min_member."""
+        gang = np.asarray(snap.pods.gang)
+        min_member = np.asarray(snap.gangs.min_member)
+        assigned = np.asarray(snap.gangs.assigned)
+        placed = an >= 0
+        for g in range(len(min_member)):
+            members = gang == g
+            bound = int((members & placed & ~wait).sum())
+            total = int((members & placed).sum()) + int(assigned[g])
+            if bound > 0 and total < int(min_member[g]):
+                return False
+        return True
+
+    def _solve_modes(self, cluster):
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+        from scheduler_plugins_tpu.plugins import (
+            Coscheduling,
+            NodeResourceTopologyMatch,
+        )
+
+        sched = Scheduler(Profile(plugins=[
+            NodeResourceTopologyMatch(), Coscheduling(),
+        ]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        seq = sched.solve(snap)
+        a_seq = np.asarray(seq.assignment)
+        w_seq = np.asarray(seq.wait)
+        a_bat, _, w_bat = profile_batch_solve(sched, snap)
+        return snap, a_seq, w_seq, np.asarray(a_bat), np.asarray(w_bat)
+
+    def test_hard_constraint_parity_across_seeds(self):
+        for seed in (0, 1, 2):
+            rng = np.random.default_rng(seed)
+            cluster = self._cluster(rng)
+            snap, a_seq, w_seq, a_bat, w_bat = self._solve_modes(cluster)
+            for mode, an, wait in (
+                ("sequential", a_seq, w_seq), ("batch", a_bat, w_bat)
+            ):
+                assert self._fit_ok(an, snap), (seed, mode)
+                assert self._numa_ok(an, snap), (seed, mode)
+                assert self._gang_quorum_ok(an, wait, snap), (seed, mode)
+            # completeness parity: the throughput mode must not place fewer
+            # pods than the bit-faithful path
+            assert int((a_bat >= 0).sum()) >= int((a_seq >= 0).sum()), seed

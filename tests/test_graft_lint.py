@@ -25,6 +25,7 @@ class TestGoldenBad:
             ("bad_closure_config.py", "GL001"),
             ("bad_resource_slot.py", "GL005"),
             ("bad_block_timing.py", "GL004"),
+            ("bad_donated_reuse.py", "GL006"),
         ],
     )
     def test_flagged(self, fixture, rule):
@@ -149,3 +150,142 @@ class TestConservatism:
     def test_presence_check_not_flagged(self):
         # good_clean.AuxPlugin.score tests `self._cost_table is None`
         assert "GL001" not in rules_for(FIXTURES / "good_clean.py")
+
+
+class TestDonatedReuse:
+    """GL006: donated-buffer reuse is flagged; the carry-rebind idiom and
+    unrelated names stay clean."""
+
+    def test_carry_rebind_idiom_clean(self, tmp_path):
+        # the pipeline idiom: the donated carry is rebound in the SAME
+        # statement as the donating call — never read stale
+        f = tmp_path / "rebind.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            solve = jax.jit(lambda raw, free: (raw, free + 1),
+                            donate_argnums=(1,))
+
+            def drive(raw, free, chunks):
+                out = []
+                for _ in range(chunks):
+                    a, free = solve(raw, free)
+                    out.append(a)
+                return out, free
+        """))
+        assert lint_paths([f]) == []
+
+    def test_reassignment_revives(self, tmp_path):
+        f = tmp_path / "revive.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+            def g(s):
+                y = step(s)
+                s = jnp.zeros_like(y)
+                return s.sum() + y.sum()
+        """))
+        assert lint_paths([f]) == []
+
+    def test_donated_chunk_solver_constructor_tracked(self, tmp_path):
+        f = tmp_path / "pipe.py"
+        f.write_text(textwrap.dedent("""\
+            from scheduler_plugins_tpu.parallel.pipeline import (
+                donated_chunk_solver,
+            )
+
+            def body(raw, req, free):
+                return req, free
+
+            solve = donated_chunk_solver(body, carry_argnum=2)
+
+            def g(raw, req, free):
+                a, f2 = solve(raw, req, free)
+                return free  # donated at position 2 above
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL006"}
+
+    def test_non_donating_jit_not_tracked(self, tmp_path):
+        f = tmp_path / "plain.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            step = jax.jit(lambda s: s + 1)
+
+            def g(s):
+                y = step(s)
+                return s.sum() + y.sum()
+        """))
+        assert lint_paths([f]) == []
+
+    def test_suppression_comment(self, tmp_path):
+        f = tmp_path / "supp.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+            def g(s):
+                y = step(s)
+                return s.sum() + y.sum()  # graft-lint: ignore[GL006]
+        """))
+        assert lint_paths([f]) == []
+
+    def test_loop_carried_reuse_flagged(self, tmp_path):
+        # the chunk-loop bug class GL006 exists for: the carry is donated
+        # each iteration but never rebound — iteration k+1 passes a dead
+        # buffer. Caught via the loop-body double sweep.
+        f = tmp_path / "loop_reuse.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            solve = jax.jit(lambda raw, free: (raw, free + 1),
+                            donate_argnums=(1,))
+
+            def drive(raw, free, chunks):
+                out = []
+                for _ in range(chunks):
+                    a = solve(raw, free)  # free donated, never rebound
+                    out.append(a)
+                return out
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL006"}
+
+    def test_branch_donation_no_false_positive(self, tmp_path):
+        # a donate+rebind in one branch must not poison the other branch's
+        # read (branches sweep on copies)
+        f = tmp_path / "branch.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            solve = jax.jit(lambda raw, free: (raw, free + 1),
+                            donate_argnums=(1,))
+
+            def g(raw, free, flag):
+                if flag:
+                    a, free = solve(raw, free)
+                else:
+                    a = free.sum()
+                return a, free
+        """))
+        assert lint_paths([f]) == []
+
+    def test_loop_target_donation_no_false_positive(self, tmp_path):
+        # a donated PER-ITERATION input rebinds via the for target every
+        # iteration — the back-edge sweep must re-revive it
+        f = tmp_path / "loop_target.py"
+        f.write_text(textwrap.dedent("""\
+            import jax
+
+            step = jax.jit(lambda a, x: a + x, donate_argnums=(1,))
+
+            def drive(a, xs):
+                out = []
+                for x in xs:
+                    out.append(step(a, x))
+                return out
+        """))
+        assert lint_paths([f]) == []

@@ -3,6 +3,7 @@ unit tests (filter_test.go, score_test.go, least_numa_test.go patterns)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from scheduler_plugins_tpu.api.objects import (
     Container,
@@ -571,3 +572,83 @@ class TestReferenceFilterVectors:
             {CPU: 1000, MEMORY: 1 * gib, self.HP: hp})
         assert self._verdicts(g(64 * self.MI))["node2"] is True
         assert self._verdicts(g(256 * self.MI))["node2"] is False
+
+
+class TestNumaBatchedRows:
+    """ISSUE 2: the fused whole-batch NUMA kernels (`filter_batch`,
+    `filter_rows`, `score_batch` — hoisted pod-invariant tensors,
+    precomputed zone scales, int32-demoted zone scores) must be
+    BIT-IDENTICAL to the vmapped per-pod `filter`/`score` the sequential
+    parity path uses, across strategies and QoS mixes."""
+
+    def _problem(self, strategy, seed=0, n_nodes=24, n_pods=40, zones=4):
+        import jax
+
+        from scheduler_plugins_tpu.models import numa_scenario
+
+        rng = np.random.default_rng(seed)
+        cluster = numa_scenario(n_nodes=n_nodes, n_pods=n_pods, zones=zones,
+                                seed=seed)
+        # mix in burstable/best-effort pods so the QoS gates are exercised
+        for i in range(8):
+            cluster.add_pod(Pod(
+                name=f"burst-{i}", creation_ms=10_000 + i,
+                containers=[Container(
+                    requests={CPU: int(rng.integers(100, 900)),
+                              MEMORY: 1 * gib},
+                )],
+            ))
+        plugin = NodeResourceTopologyMatch(scoring_strategy=strategy)
+        sched = Scheduler(Profile(plugins=[plugin]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        state0 = sched.initial_state(snap)
+
+        def rows(snap, state0, aux):
+            plugin.bind_aux(aux)
+            plugin.bind_presolve(plugin.prepare_solve(snap))
+            f_b = plugin.filter_batch(state0, snap)
+            s_b = plugin.score_batch(state0, snap)
+            f_p = jax.vmap(lambda p: plugin.filter(state0, snap, p))(
+                jnp.arange(snap.num_pods)
+            )
+            s_p = jax.vmap(lambda p: plugin.score(state0, snap, p))(
+                jnp.arange(snap.num_pods)
+            )
+            idx = jnp.arange(1, snap.num_pods, 3)
+            f_r = plugin.filter_rows(state0, snap, idx)
+            return f_b, s_b, f_p, s_p, f_r, idx
+
+        return jax.jit(rows)(snap, state0, plugin.aux())
+
+    @pytest.mark.parametrize("strategy", [
+        numa_ops.LEAST_ALLOCATED,
+        numa_ops.MOST_ALLOCATED,
+        numa_ops.BALANCED_ALLOCATION,
+    ])
+    def test_batched_rows_bit_identical(self, strategy):
+        f_b, s_b, f_p, s_p, f_r, idx = self._problem(strategy)
+        assert np.array_equal(np.asarray(f_b), np.asarray(f_p))
+        assert np.array_equal(
+            np.asarray(s_b).astype(np.int64), np.asarray(s_p)
+        )
+        assert np.array_equal(
+            np.asarray(f_r), np.asarray(f_b)[np.asarray(idx)]
+        )
+
+    def test_least_numa_falls_back_to_per_pod(self):
+        from scheduler_plugins_tpu.models import numa_scenario
+
+        cluster = numa_scenario(n_nodes=8, n_pods=8, zones=2)
+        plugin = NodeResourceTopologyMatch(
+            scoring_strategy=numa_ops.LEAST_NUMA_NODES
+        )
+        sched = Scheduler(Profile(plugins=[plugin]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        state0 = sched.initial_state(snap)
+        plugin.bind_aux(plugin.aux())
+        plugin.bind_presolve(None)
+        assert plugin.score_batch(state0, snap) is None

@@ -21,6 +21,15 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   `api.resources.CANONICAL` / `meta.index.position(...)`, never hardcoded
   slot integers: the C++ bridge (`bridge/snapshot_store.cc`) hardcodes the
   same slots, so silent drift is silent data corruption.
+- **GL006 donated-buffer-reuse** — a buffer passed in a DONATED position of
+  a jitted call (`jax.jit(..., donate_argnums=...)` or
+  `parallel.pipeline.donated_chunk_solver`) is dead after the call: XLA may
+  have reused its memory for the outputs, and reading it raises (or, through
+  a tunneled backend, can return garbage). Rebind the name from the call's
+  results (`a, free = solve(..., free)`) before any further read. The check
+  is lexical and conservative: only Name operands at literal donated
+  positions are tracked, reassignment revives, and loop back-edges are not
+  followed.
 
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
@@ -52,7 +61,7 @@ INT64, INT32, FLOAT, BOOL, UNKNOWN = "int64", "int32", "float", "bool", None
 #: sanctioned channels.
 TENSOR_METHODS = frozenset({
     "admit", "filter", "score", "normalize", "commit", "static_node_scores",
-    "filter_batch", "score_batch", "batch_rows", "wave_guard",
+    "filter_batch", "score_batch", "filter_rows", "batch_rows", "wave_guard",
     "wave_guard_demand", "wave_capacity", "validate_at", "commit_batch",
     "prepare_solve",
 })
@@ -482,6 +491,169 @@ def check_resource_slots(path, tree, findings):
             ))
 
 
+def _donate_positions(node):
+    """Literal int positions from a donate_argnums/carry_argnum value."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+        return vals or None
+    return None
+
+
+def _donating_jits(tree):
+    """name -> donated arg positions, from `x = jax.jit(f, donate_argnums=
+    ...)` and `x = donated_chunk_solver(f, carry_argnum=k)` assignments
+    (module- or function-level). Only literal positions are tracked."""
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        fname = (
+            call.func.attr if isinstance(call.func, ast.Attribute)
+            else getattr(call.func, "id", None)
+        )
+        pos = None
+        if fname == "jit":
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    pos = _donate_positions(kw.value)
+        elif fname == "donated_chunk_solver":
+            for kw in call.keywords:
+                if kw.arg == "carry_argnum":
+                    pos = _donate_positions(kw.value)
+            if pos is None and len(call.args) > 1:
+                pos = _donate_positions(call.args[1])
+        if pos:
+            out[t.id] = pos
+    return out
+
+
+def _sweep_unit(unit, extra_stores, donating, poisoned, report):
+    """One statement unit: check loads against the poisoned set FIRST
+    (passing an already-donated buffer anywhere is a read), then the
+    unit's donating calls poison their donated Name operands, then the
+    unit's assignment targets revive — so the chunk-carry idiom
+    `a, free = solve(..., free)` is clean."""
+    loads, stores, calls = [], list(extra_stores or ()), []
+    for node in ast.walk(unit):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.append(node)
+            elif isinstance(node.ctx, ast.Store):
+                stores.append(node.id)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id in donating:
+            calls.append(node)
+    for name_node in loads:
+        if name_node.id in poisoned:
+            report(name_node, poisoned[name_node.id])
+    for call in calls:
+        for k in donating[call.func.id]:
+            if k < len(call.args) and isinstance(call.args[k], ast.Name):
+                poisoned[call.args[k].id] = call.func.id
+    for name in stores:
+        poisoned.pop(name, None)
+
+
+def _sweep_body(body, donating, poisoned, report):
+    """Sweep a statement list in source order, mutating `poisoned`.
+    Loop bodies are swept TWICE — the second pass carries the poison from
+    the end of the first, so a carry donated in iteration k and read (not
+    rebound) at the top of iteration k+1 is caught. If/try branches sweep
+    on copies and union their surviving poison (either branch may have
+    run); nested function/class definitions are their own scope."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [
+                n.id for n in ast.walk(stmt.target)
+                if isinstance(n, ast.Name)
+            ]
+            _sweep_unit(stmt.iter, targets, donating, poisoned, report)
+            for _ in range(2):  # second pass: loop back-edge
+                # the loop TARGET rebinds at the top of every iteration —
+                # revive it before each pass, or a donated per-iteration
+                # input (`for x in xs: step(a, x)`) false-positives on the
+                # back-edge sweep
+                for name in targets:
+                    poisoned.pop(name, None)
+                _sweep_body(stmt.body, donating, poisoned, report)
+            _sweep_body(stmt.orelse, donating, poisoned, report)
+        elif isinstance(stmt, ast.While):
+            _sweep_unit(stmt.test, [], donating, poisoned, report)
+            for _ in range(2):
+                _sweep_body(stmt.body, donating, poisoned, report)
+            _sweep_body(stmt.orelse, donating, poisoned, report)
+        elif isinstance(stmt, ast.If):
+            _sweep_unit(stmt.test, [], donating, poisoned, report)
+            then_p, else_p = dict(poisoned), dict(poisoned)
+            _sweep_body(stmt.body, donating, then_p, report)
+            _sweep_body(stmt.orelse, donating, else_p, report)
+            poisoned.clear()
+            poisoned.update(then_p)
+            poisoned.update(else_p)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                names = []
+                if item.optional_vars is not None:
+                    names = [
+                        n.id for n in ast.walk(item.optional_vars)
+                        if isinstance(n, ast.Name)
+                    ]
+                _sweep_unit(item.context_expr, names, donating, poisoned,
+                            report)
+            _sweep_body(stmt.body, donating, poisoned, report)
+        elif isinstance(stmt, ast.Try):
+            _sweep_body(stmt.body, donating, poisoned, report)
+            for handler in stmt.handlers:
+                _sweep_body(handler.body, donating, poisoned, report)
+            _sweep_body(stmt.orelse, donating, poisoned, report)
+            _sweep_body(stmt.finalbody, donating, poisoned, report)
+        else:
+            _sweep_unit(stmt, None, donating, poisoned, report)
+
+
+def check_donated_reuse(path, tree, findings):
+    """GL006: a Name read after being passed in a donated position of a
+    jitted call, without an intervening rebind — including across loop
+    iterations (the chunk-loop bug class: `for ...: a = solve(raw, free)`
+    without rebinding `free`). Findings are deduplicated per site so the
+    loop double-sweep reports each read once."""
+    donating = _donating_jits(tree)
+    if not donating:
+        return
+    for fn in _functions(tree):
+        if isinstance(fn, ast.Lambda):
+            continue
+        seen = set()
+
+        def report(name_node, callee):
+            key = (name_node.lineno, name_node.col_offset, name_node.id)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                path, name_node, "GL006",
+                f"read of {name_node.id!r} after it was donated to "
+                f"{callee!r}(): the donated buffer may have been reused "
+                "for outputs — rebind it from the call's results first",
+            ))
+
+        _sweep_body(fn.body, donating, {}, report)
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -509,6 +681,7 @@ def lint_file(path: Path) -> tuple[list, object, str]:
     check_cumsum(rel, tree, findings)
     check_block_until_ready(rel, tree, findings)
     check_resource_slots(rel, tree, findings)
+    check_donated_reuse(rel, tree, findings)
     return findings, tree, source
 
 

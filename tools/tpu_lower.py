@@ -186,14 +186,15 @@ def stablehlo_digest(text: str) -> str:
 
 
 def _batch_solve_program(shape):
-    """Configs 0/1: `bench.flagship_solve` on `bench.alloc_problem` — the
-    exact construction + jitted fn bench ships."""
+    """Configs 0/1: `bench.flagship_solve_stats` on `bench.alloc_problem` —
+    the exact construction + jitted fn bench ships (wave-occupancy stats
+    included: the timed program is the certified program)."""
     import jax
 
     import bench
 
     _, snap, _, weights = bench.alloc_problem(**shape)
-    return jax.jit(bench.flagship_solve), (snap, weights), None
+    return jax.jit(bench.flagship_solve_stats), (snap, weights), None
 
 
 def build_entry():
@@ -254,13 +255,12 @@ def build_cfg5_network_sequential():
 
 
 def build_cfg6_north_star_chunk():
-    """The north-star chunk loop body — `bench.north_star_solve_chunk`
-    itself, at the real node-count/chunk shapes from
-    `bench.NORTH_STAR_SHAPE`, with the chunk-invariant tensors as
-    arguments exactly as bench jits it (one pod chunk of cluster build
+    """The north-star chunk loop body — `bench.north_star_chunk_solver()`
+    (the DONATED jit: donation changes the exported calling convention, so
+    the certified program must carry it), at the real node-count/chunk
+    shapes from `bench.NORTH_STAR_SHAPE`, with the chunk-invariant tensors
+    as arguments exactly as bench jits it (one pod chunk of cluster build
     suffices: every chunk shares this one compiled program)."""
-    import jax
-
     import bench
     from scheduler_plugins_tpu.ops.fit import free_capacity
 
@@ -277,7 +277,7 @@ def build_cfg6_north_star_chunk():
         snap.pods.mask[:chunk],
         free,
     )
-    return jax.jit(bench.north_star_solve_chunk), args, None
+    return bench.north_star_chunk_solver(), args, None
 
 
 def _mesh8():
