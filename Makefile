@@ -31,15 +31,35 @@ tpu-smoke:
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --smoke-compare 2,3
 
-# verify composes the READ-ONLY gate (tpu-lower-check): it must never
-# rewrite the committed manifest as a side effect — refreshing digests is
-# the explicit `make tpu-lower`
+# verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
+# it must never rewrite the committed manifests as a side effect —
+# refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke
 
 .PHONY: lint
 lint:
 	$(PY) tools/graft_lint.py
+
+# trace every registered program (bench cfgs 0-6, both sharded solves,
+# entry()) to closed jaxprs, run the JA001-JA004 invariant rules, refresh
+# docs/jaxpr_audit.json
+.PHONY: jaxpr-audit
+jaxpr-audit:
+	$(PY) tools/jaxpr_audit.py
+
+# read-only CI gate: rule verdicts + manifest coverage + census drift
+# (census equality enforced only under the manifest's jax version)
+.PHONY: jaxpr-audit-check
+jaxpr-audit-check:
+	$(PY) tools/jaxpr_audit.py --check
+
+# CI sanitizer gate: reduced cfg-2/cfg-3 shapes + the donated chunk
+# pipeline + entry() under SPT_SANITIZE=1 checkify instrumentation —
+# fails on ANY index-OOB/NaN/div-by-zero finding
+.PHONY: sanitize-smoke
+sanitize-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --sanitize-smoke 2,3
 
 # AOT-lower every bench program + both sharded solves + entry() to TPU
 # StableHLO, scan for CLAUDE.md landmines, refresh docs/tpu_lowering.json

@@ -758,6 +758,90 @@ def smoke_compare(configs, noise_floor=0.9, runs=5):
     return 1 if failed else 0
 
 
+def sanitize_smoke(configs, chunk_shape=(64, 256, 128)):
+    """CI gate (`make sanitize-smoke`): run the checkify-instrumented
+    solvers (SPT_SANITIZE=1, utils.sanitize) at reduced shapes and fail on
+    ANY checkify error — index OOB on the commit scatters, NaN, or
+    div-by-zero that the production jits would silently clamp or
+    propagate. Coverage spans the three sanitizer wrap points: the batched
+    profile solve per config, the donated chunk pipeline (reduced
+    north-star shape), and the checkified `entry()` program. One JSON line
+    per program; rc 1 on any error."""
+    import os
+
+    os.environ["SPT_SANITIZE"] = "1"
+    import jax  # noqa: F401
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+    from scheduler_plugins_tpu.utils import sanitize
+
+    assert sanitize.enabled()
+    failed = False
+
+    def flush(program, detail):
+        nonlocal failed
+        reports = sanitize.drain()
+        errors = [r for r in reports if not r["ok"]]
+        failed |= bool(errors) or not reports
+        print(json.dumps({
+            "metric": f"sanitize_smoke_{program}",
+            "detail": detail,
+            "checked_calls": len(reports),
+            "checkify_errors": [r.get("error") for r in errors],
+            "backend": _backend_label(),
+            "ok": bool(reports) and not errors,
+        }))
+
+    for config in configs:
+        cluster, plugins, detail = config_problem(
+            config, shape=SMOKE_COMPARE_SHAPES.get(config)
+        )
+        # the gate exercises the BATCHED checkified solver, not the
+        # sequential parity path config_problem's detail string names
+        detail = detail.replace("sequential", "batched")
+        scheduler = Scheduler(Profile(plugins=plugins))
+        pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        scheduler.prepare(meta, cluster)
+        out = profile_batch_solve(scheduler, snap)
+        placed = int((np.asarray(out[0]) >= 0).sum())
+        flush(f"cfg{config}", f"{detail}, {placed}/{len(pending)} placed")
+
+    # donated chunk pipeline (the north-star loop body) at reduced shape
+    from scheduler_plugins_tpu.ops.fit import free_capacity
+    from scheduler_plugins_tpu.parallel.pipeline import run_chunk_pipeline
+
+    n_nodes, n_pods, chunk = chunk_shape
+    _, snap, meta, weights, raw, padded = north_star_problem(
+        n_nodes, n_pods, chunk
+    )
+    solve_chunk = north_star_chunk_solver()  # sanitized under SPT_SANITIZE
+    req_np = np.asarray(snap.pods.req)
+    mask_np = np.asarray(snap.pods.mask)
+    chunk_inputs = [
+        (req_np[lo:lo + chunk], mask_np[lo:lo + chunk])
+        for lo in range(0, padded, chunk)
+    ]
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    results, _, _ = run_chunk_pipeline(
+        solve_chunk, (raw, snap.nodes.mask), chunk_inputs, free
+    )
+    placed = int(sum((np.asarray(a) >= 0).sum() for a, _ in results))
+    flush("chunk_pipeline",
+          f"{n_nodes} nodes x {n_pods} pods chunked x{chunk}, {placed} placed")
+
+    # the checkified entry() program ((error, result) contract)
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    err, result = jax.jit(fn)(*args)
+    sanitize.report("entry", err)
+    placed = int((np.asarray(result.assignment) >= 0).sum())
+    flush("entry", f"fused solve, {placed} placed")
+    return 1 if failed else 0
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=1,
@@ -774,8 +858,18 @@ if __name__ == "__main__":
                         help="CI gate: comma-separated configs (e.g. 2,3) "
                              "run at reduced shapes in BOTH modes; fails "
                              "when batch < 0.9x sequential pods/s")
+    parser.add_argument("--sanitize-smoke", default=None, metavar="CFGS",
+                        help="CI gate: comma-separated configs run at "
+                             "reduced shapes under SPT_SANITIZE=1 "
+                             "(checkify); fails on any checkify error")
     args = parser.parse_args()
     apply_platform_override()
+    if args.sanitize_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # correctness instrumentation, not a timing run — no tunnel probe
+        sys.exit(sanitize_smoke(
+            [int(c) for c in args.sanitize_smoke.split(",") if c]
+        ))
     if args.smoke_compare:
         # CPU-backend CI gate: no tunnel probe (the Makefile target pins
         # JAX_PLATFORMS=cpu), no capture replay — this compares the two

@@ -26,6 +26,9 @@ import jax
 
 # Quota/score math must be int64 (memory is in *bytes*; allocatable-score
 # weights go up to 1<<20) — see /root/reference/pkg/noderesources/resource_allocation.go:36.
-jax.config.update("jax_enable_x64", True)
+# The ONE sanctioned in-package config mutation: x64 is part of the
+# package's import contract (every consumer needs it before the first
+# array), so the precision config is owned here rather than per-entrypoint.
+jax.config.update("jax_enable_x64", True)  # graft-lint: ignore[GL007]
 
 __version__ = "0.1.0"

@@ -49,6 +49,15 @@ class CycleReport:
     expired_gangs: list[str] = field(default_factory=list)
     #: preemptor uid -> (nominated node, victim uids)
     preempted: dict[str, tuple[str, list[str]]] = field(default_factory=dict)
+    #: checkify findings from this cycle's solve when the sanitizer mode is
+    #: on (SPT_SANITIZE=1, utils.sanitize). Read together with
+    #: `sanitize_checked`: empty errors are only "all checks passed" when
+    #: checked calls actually ran — a cycle whose solve took an
+    #: uninstrumented path (sequential fallback) reports 0 checked calls
+    sanitize_errors: list[dict] = field(default_factory=list)
+    #: number of checkify-instrumented solve invocations this cycle (None
+    #: when sanitize mode is off; 0 means the solve path was uninstrumented)
+    sanitize_checked: int | None = None
 
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
@@ -80,6 +89,13 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         return report
     pending = scheduler.sort_pending(pending, cluster)
 
+    from scheduler_plugins_tpu.utils import sanitize
+
+    if sanitize.enabled():
+        # discard reports left by solves OUTSIDE this cycle (warmups,
+        # other schedulers): the post-solve drain below must attribute
+        # only THIS cycle's checked calls to this report
+        sanitize.drain()
     generation = getattr(cluster.nrt_cache, "generation", None)
     with obs.flow("cycle", generation=generation, pending=len(pending)):
         snap, meta = cluster.snapshot(pending, now_ms=now)
@@ -97,6 +113,15 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
                 result = SolveResultView(*streamed)
         if result is None:
             result = scheduler.solve(snap)
+
+    if sanitize.enabled():
+        # surface this cycle's checkify findings on the report (the solve
+        # paths above report into the sanitizer's buffer as they run);
+        # checked-call count kept so "no errors" cannot be mistaken for
+        # "checks ran" when the solve fell back to an uninstrumented path
+        reports = sanitize.drain()
+        report.sanitize_checked = len(reports)
+        report.sanitize_errors = [r for r in reports if not r["ok"]]
 
     assignment = np.asarray(result.assignment)
     admitted = np.asarray(result.admitted)

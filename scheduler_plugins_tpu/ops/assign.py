@@ -487,8 +487,9 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
     vector and never runs normalize().
 
     Wave structure (every retry wave runs on a bounded straggler WINDOW —
-    the first W still-active pods in queue order via `jnp.nonzero(size=W)`
-    — so late waves sort/scan W elements, not P; at north-star scale the
+    the first W still-active pods in queue order via a rank-compaction
+    scatter — so late waves sort/scan W elements, not P; at north-star
+    scale the
     per-wave queue-order admission sort over the full 8k-pod chunk was the
     dominant fixed cost of the ~7-wave tail):
 
@@ -528,9 +529,18 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
 
     def window_of(free, assignment, hopeless, W):
         """First W still-active pods in queue order: (idx (W,), valid (W,),
-        dem (W, R)) — `jnp.nonzero(size=)` compaction, no P-length sort."""
+        dem (W, R)) — rank-compaction scatter into a W+1 buffer (slot W is
+        the overflow trash slot), no P-length sort. Deliberately NOT
+        `jnp.nonzero(size=)`: jax pads that via a bincount scatter whose
+        out-of-bounds writes rely on drop semantics, which the
+        SPT_SANITIZE checkify gate rightly flags; this form is in-bounds
+        by construction at the same O(P) scatter cost."""
         active = (assignment == -1) & pod_mask & ~hopeless
-        idx = jnp.nonzero(active, size=W, fill_value=P)[0]
+        rank = jnp.cumsum(active) - 1  # (P,) inclusive rank among active
+        slot = jnp.where(active & (rank < W), rank, W).astype(jnp.int32)
+        idx = jnp.full(W + 1, P, jnp.int32).at[slot].min(
+            jnp.arange(P, dtype=jnp.int32)
+        )[:W]
         valid = idx < P
         dem_w = jnp.where(
             valid[:, None], demand[jnp.minimum(idx, P - 1)], 0

@@ -206,10 +206,16 @@ def tlp_score_batch(
 
 
 def _round_half_away_f32(x):
-    """`round_half_away` staying in f32/int32 (batch stage)."""
-    return jnp.where(
-        x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)
-    ).astype(jnp.int32)
+    """`round_half_away` staying in f32/int32 (batch stage) — the same
+    exact fractional-part compare as the f64 parity version: `x + 0.5`
+    itself rounds in f32 too (the largest f32 below 0.5 plus 0.5 is 1.0),
+    and `x - floor(x)` is exact in any binary float format (Sterbenz for
+    x >= 1, floor == 0 below)."""
+    f = jnp.floor(x)
+    pos = jnp.where(x - f >= 0.5, f + 1, f)
+    c = jnp.ceil(x)
+    neg = jnp.where(c - x >= 0.5, c - 1, c)
+    return jnp.where(x >= 0, pos, neg).astype(jnp.int32)
 
 
 def _risk_curve_coeffs(avg_pct, std_pct, capacity, margin, sensitivity):

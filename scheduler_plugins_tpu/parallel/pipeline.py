@@ -38,7 +38,18 @@ def donated_chunk_solver(fn, carry_argnum: int):
     """Jit `fn` with its carry argument donated — the pipeline's calling
     convention. Callers must treat the carry they pass in as CONSUMED
     (rebind it from the call's result; `tools/graft_lint.py` GL006 flags
-    reuse of a donated buffer after the donating call)."""
+    reuse of a donated buffer after the donating call).
+
+    Under `SPT_SANITIZE=1` (utils.sanitize) the chunk program is built as a
+    checkify-instrumented jit with the donation DROPPED (debug mode: the
+    carry stays readable, checkify errors surface as structured JSON); the
+    calling convention — rebind the carry from the result — is unchanged.
+    """
+    from scheduler_plugins_tpu.utils import sanitize
+
+    if sanitize.enabled():
+        name = getattr(fn, "__name__", "solve_chunk")
+        return sanitize.checkified(fn, program=f"chunk:{name}")
     return jax.jit(fn, donate_argnums=(carry_argnum,))
 
 
@@ -141,7 +152,10 @@ def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
         cache[key] = jax.jit(head)
     admitted, raw, free0 = cache[key](snap, state0, auxes)
 
-    ckey = ("streamed_chunk", chunk, max_waves, rescue_window)
+    from scheduler_plugins_tpu.utils import sanitize
+
+    ckey = ("streamed_chunk", chunk, max_waves, rescue_window,
+            sanitize.enabled())
     if ckey not in cache:
 
         def solve_one(raw, req_chunk, mask_chunk, free):

@@ -399,8 +399,16 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
     single-shot — `profile_batch_fn` builds a fresh state per call, and a
     caller holding on to `args` must not invoke the returned fn twice with
     the same tuple (tools/graft_lint.py GL006 flags such reuse).
+
+    Under `SPT_SANITIZE=1` (utils.sanitize) the solve is instead built as a
+    checkify-instrumented jit — index OOB on the commit scatters, NaN,
+    div-by-zero — with donation dropped (debug mode) and errors reported as
+    structured JSON; the cache key carries the mode so toggling the env var
+    never reuses a differently-instrumented program.
     """
     import jax
+
+    from scheduler_plugins_tpu.utils import sanitize
 
     plugins = tuple(scheduler.profile.plugins)
     static_plugins = tuple(
@@ -456,12 +464,20 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
                 return assignment, admitted, wait, out[2]
             return assignment, admitted, wait
 
-        key = ("profile_batch_fast", max_waves, collect_stats) + tuple(
+        key = ("profile_batch_fast", max_waves, collect_stats,
+               sanitize.enabled()) + tuple(
             p.static_key() for p in plugins
         )
         cache = scheduler._solve_cache
         if key not in cache:
-            cache[key] = _wrap_donated(jax.jit(fast_batch, donate_argnums=(1,)))
+            if sanitize.enabled():
+                cache[key] = sanitize.checkified(
+                    fast_batch, program="profile_batch_fast"
+                )
+            else:
+                cache[key] = _wrap_donated(
+                    jax.jit(fast_batch, donate_argnums=(1,))
+                )
         return cache[key], (snap, state0, auxes)
     # ------------------------------------------------------------------
 
@@ -705,12 +721,16 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
             return assignment, admitted, wait, out[3]
         return assignment, admitted, wait
 
-    key = ("profile_batch", max_waves, collect_stats) + tuple(
+    key = ("profile_batch", max_waves, collect_stats,
+           sanitize.enabled()) + tuple(
         p.static_key() for p in plugins
     )
     cache = scheduler._solve_cache
     if key not in cache:
-        cache[key] = _wrap_donated(jax.jit(batch, donate_argnums=(1,)))
+        if sanitize.enabled():
+            cache[key] = sanitize.checkified(batch, program="profile_batch")
+        else:
+            cache[key] = _wrap_donated(jax.jit(batch, donate_argnums=(1,)))
     return cache[key], (snap, state0, auxes)
 
 

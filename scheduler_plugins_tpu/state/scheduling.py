@@ -51,6 +51,20 @@ from scheduler_plugins_tpu.api.objects import (
 I64 = np.int64
 I32 = np.int32
 
+#: Static scheduling-table bases with a LIVE SolverState carry counterpart
+#: (pytree path relative to the snapshot root -> carry field name) — the
+#: selector/topology-domain counts seeded host-side and then carried through
+#: in-cycle placements. Companion map to
+#: `state.snapshot.CARRY_COUNTERPARTS`; consumed by `tools/jaxpr_audit.py`
+#: rule JA001 (a compiled solve must not derive live counts from these
+#: static bases while the carry is dead).
+TRACK_CARRY_COUNTERPARTS = {
+    ".scheduling.track_node_base": "sel_counts",
+    ".scheduling.track_base": "sel_dom_counts",
+    ".scheduling.exist_anti_base": "anti_domains",
+    ".scheduling.sym_base": "sym_counts",
+}
+
 
 @struct.dataclass
 class SchedulingState:

@@ -54,6 +54,23 @@ I64 = np.int64
 I32 = np.int32
 F64 = np.float64
 
+#: Static snapshot tensors that have a LIVE SolverState carry counterpart
+#: (keyed by pytree path relative to the snapshot root -> carry field name,
+#: `framework.plugin.SolverState`). The CLAUDE.md invariant — in-cycle
+#: mutations flow through carries, never through re-reads of the static
+#: snapshot — is machine-checked on the COMPILED programs by
+#: `tools/jaxpr_audit.py` (rule JA001): a traced solve whose outputs depend
+#: on one of these tensors while the carry counterpart is dead in the jaxpr
+#: has bypassed the carry. The scheduling-table counterparts live in
+#: `state.scheduling.TRACK_CARRY_COUNTERPARTS`.
+CARRY_COUNTERPARTS = {
+    ".nodes.requested": "free",
+    ".quota.used": "eq_used",
+    ".gangs.assigned": "gang_scheduled",
+    ".network.placed_node": "net_placed",
+    ".numa.available": "numa_avail",
+}
+
 
 @struct.dataclass
 class NodeState:

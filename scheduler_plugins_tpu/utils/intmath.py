@@ -16,10 +16,19 @@ import jax.numpy as jnp
 
 
 def go_div(a, b):
-    """Integer division truncating toward zero (Go semantics), b > 0."""
+    """Integer division truncating toward zero (Go semantics), b > 0.
+
+    Floor division plus a remainder correction, NOT the abs-based form
+    (`-(|a| // b)`): `abs(INT64_MIN)` wraps to itself, so that form
+    returned +2^62-range garbage at the int64 lower boundary (found by the
+    property suite against the Go oracle). `q * b` may wrap when `a` is
+    within `b` of INT64_MIN, but two's-complement wraparound makes the
+    subtraction self-correcting: `a - (q*b mod 2^64) mod 2^64` is the true
+    remainder (0 <= r < b)."""
     a = jnp.asarray(a)
-    q = jnp.abs(a) // b
-    return jnp.where(a < 0, -q, q).astype(a.dtype)
+    q = a // b
+    r = a - q * b
+    return jnp.where((a < 0) & (r != 0), q + 1, q).astype(a.dtype)
 
 
 def floordiv_exact(a, b):
@@ -70,9 +79,21 @@ def floordiv_recip(a, b, brecip):
 
 
 def round_half_away(x):
-    """Go `math.Round`: round half away from zero, as int64."""
+    """Go `math.Round`: round half away from zero, as int64 (exact for
+    |x| < 2^53).
+
+    Compares the EXACT fractional part against 0.5 instead of the
+    `floor(x + 0.5)` idiom: `x + 0.5` itself rounds (the largest double
+    below 0.5 plus 0.5 is exactly 1.0), so the idiom rounds UP values Go's
+    bit-exact math.Round rounds down — caught by the property suite.
+    `x - floor(x)` is exact (Sterbenz for x >= 1, floor == 0 below), so the
+    half-boundary compare here is exact at every magnitude."""
     x = jnp.asarray(x)
-    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
+    f = jnp.floor(x)
+    pos = jnp.where(x - f >= 0.5, f + 1, f)
+    c = jnp.ceil(x)
+    neg = jnp.where(c - x >= 0.5, c - 1, c)
+    return jnp.where(x >= 0, pos, neg).astype(jnp.int64)
 
 
 def _dtype_bounds(dtype):

@@ -1,0 +1,17 @@
+"""Golden-bad GL007: library code mutating jax config. Platform/precision
+config is owned by the entrypoints and tests/conftest.py — a library-level
+update's effect depends on import order and fights their platform pinning
+(the environment pins jax_platforms via config, which beats env vars)."""
+
+import jax
+from jax import config
+
+
+def ensure_fast_math():
+    # BUG: a library module flipping global config at call time
+    jax.config.update("jax_enable_x64", False)
+
+
+def ensure_cpu():
+    # BUG: the `from jax import config` spelling of the same mutation
+    config.update("jax_platforms", "cpu")

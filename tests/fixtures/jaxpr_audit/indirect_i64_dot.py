@@ -1,0 +1,25 @@
+"""Golden-bad JA003: an int64 dot_general reached through indirection the
+source-AST dtype lattice cannot resolve — the i64 casts travel through a
+dict and a helper function, so graft-lint GL003 stays silent (its
+conservative inference reports UNKNOWN), while the traced program plainly
+contains an i64 dot_general (unsupported on TPU)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _scores(tbl):
+    # operand dtypes are invisible here at the AST level: they were cast in
+    # the caller and arrive via subscripts of an UNKNOWN-typed dict
+    return tbl["req"] @ tbl["w"]
+
+
+def build():
+    req = jnp.ones((4, 4), jnp.int32)
+    w = jnp.ones((4, 4), jnp.int32)
+
+    def solve(req, w):
+        tbl = {"req": (req * 2).astype("int64"), "w": w.astype("int64")}
+        return jax.vmap(lambda i: _scores(tbl)[i])(jnp.arange(4)).sum()
+
+    return solve, (req, w), None

@@ -1,0 +1,26 @@
+"""Golden-bad JA002: a chunk carry donated to a jitted solver and then
+passed AGAIN to the same solver — routed through a helper so the lexical
+GL006 sweep (which tracks only direct Name calls of known donating jits)
+cannot see it; at jaxpr level both calls are pjit equations with
+`donated_invars` consuming the same var."""
+
+import jax
+import jax.numpy as jnp
+
+_step = jax.jit(lambda carry, x: carry + x, donate_argnums=(0,))
+
+
+def _advance(step, carry, x):
+    """Helper indirection: hides the donating call from the AST sweep."""
+    return step(carry, x)
+
+
+def build():
+    def pipeline(carry, xs):
+        a = _advance(_step, carry, xs[0])
+        # BUG: `carry` was donated by the first call — XLA may have reused
+        # its buffer for `a`; this second consume reads freed memory
+        b = _advance(_step, carry, xs[1])
+        return a + b
+
+    return pipeline, (jnp.zeros(4), jnp.ones((2, 4))), None

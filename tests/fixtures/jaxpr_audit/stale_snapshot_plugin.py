@@ -1,0 +1,41 @@
+"""Golden-bad JA001: a toy solve whose admission charges the STATIC
+snapshot quota usage while the live SolverState carry counterpart
+(`eq_used`) is an input but dead — the carry-bypass bug class the
+batched-NUMA/donation rewrites made possible and an AST lint cannot see
+(the read is a plain attribute access; only compiled dataflow shows the
+carry never participates)."""
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class _Quota:
+    used: object  # (Q, R) static usage — the cycle-initial base
+
+
+@struct.dataclass
+class _Snap:
+    quota: _Quota
+
+
+@struct.dataclass
+class _State:
+    free: object  # (N, R) live capacity carry
+    eq_used: object  # (Q, R) live usage carry — dead below: the bug
+
+
+def build():
+    snap = _Snap(quota=_Quota(used=jnp.ones((2, 4), jnp.int64)))
+    state = _State(
+        free=jnp.full((3, 4), 8, jnp.int64),
+        eq_used=jnp.ones((2, 4), jnp.int64),
+    )
+
+    def solve(snap, state):
+        # BUG: quota admission reads the static snapshot usage; in-cycle
+        # placements carried in state.eq_used are invisible to it
+        ok = jnp.all(snap.quota.used.sum(axis=0) + 1 <= 100)
+        return jnp.where(ok, state.free.sum(), jnp.int64(-1))
+
+    return solve, (snap, state), ("snap", "state")

@@ -1,0 +1,23 @@
+"""Golden-bad JA004: unordered host effects inside a solve program — a
+debug print and an `io_callback(ordered=False)`. Solve programs must be
+replayable and deterministic; unordered callbacks interleave arbitrarily
+across waves/chunks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+
+def build():
+    def solve(free, req):
+        jax.debug.print("placing demand {x}", x=req.sum())
+        observed = io_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct(free.shape, free.dtype),
+            free,
+            ordered=False,
+        )
+        return observed - req
+
+    return solve, (jnp.ones(4), jnp.ones(4)), None

@@ -5,7 +5,13 @@ import numpy as np
 import pytest
 
 from scheduler_plugins_tpu.api.objects import Container, Node, Pod
-from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS, ResourceIndex
+from scheduler_plugins_tpu.api.resources import (
+    CANONICAL,
+    CPU,
+    MEMORY,
+    PODS,
+    ResourceIndex,
+)
 from scheduler_plugins_tpu.state.snapshot import build_snapshot
 
 bridge = pytest.importorskip("scheduler_plugins_tpu.bridge")
@@ -286,14 +292,15 @@ class TestNativeCycle:
             bound_total += len(report.bound)
             # replay invariant: store columns == object truth
             exports = c._native.export_nodes()
+            cpu_i, pods_i = CANONICAL.index(CPU), CANONICAL.index(PODS)
             used = np.zeros((8, 4), np.int64)
             for pod in c.pods.values():
                 if pod.node_name is not None:
                     row = c._native_node_ids[pod.node_name]
-                    used[row, 0] += pod.effective_request().get(CPU, 0)
-                    used[row, 3] += 1
-            assert (exports["requested"][:, 0] == used[:, 0]).all()
-            assert (exports["requested"][:, 3] == used[:, 3]).all()
+                    used[row, cpu_i] += pod.effective_request().get(CPU, 0)
+                    used[row, pods_i] += 1
+            assert (exports["requested"][:, cpu_i] == used[:, cpu_i]).all()
+            assert (exports["requested"][:, pods_i] == used[:, pods_i]).all()
             for pod in list(c.pods.values()):
                 if pod.node_name and rng.random() < 0.3:
                     c.remove_pod(pod.uid)

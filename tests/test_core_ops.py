@@ -19,9 +19,15 @@ from scheduler_plugins_tpu.ops.normalize import (
     peaks_normalize,
 )
 
-# resource axis: cpu, memory, ephemeral, pods
+# resource axis: cpu, memory, ephemeral, pods (= api.resources.CANONICAL)
 def vec(cpu=0, mem=0, eph=0, pods=0):
     return [cpu, mem, eph, pods]
+
+
+from scheduler_plugins_tpu.api.resources import CANONICAL, CPU, PODS  # noqa: E402
+
+CPU_I = CANONICAL.index(CPU)
+PODS_I = CANONICAL.index(PODS)
 
 
 class TestFit:
@@ -123,7 +129,7 @@ def simple_step_fn(req, node_mask):
         from scheduler_plugins_tpu.ops.fit import fits_one
 
         feasible = fits_one(req[p], free, node_mask)
-        return feasible, free[:, 0]
+        return feasible, free[:, CPU_I]
 
     return step
 
@@ -152,9 +158,10 @@ class TestAssign:
 
         def batch_fn(free, active):
             ok = jnp.all(
-                req.at[:, 3].set(1)[:, None, :] <= free[None, :, :], axis=-1
+                req.at[:, PODS_I].set(1)[:, None, :] <= free[None, :, :],
+                axis=-1,
             )
-            scores = jnp.broadcast_to(free[None, :, 0], ok.shape)
+            scores = jnp.broadcast_to(free[None, :, CPU_I], ok.shape)
             return ok, scores
 
         assignment, free = wave_assign(batch_fn, req, jnp.ones(2, bool), free0)
@@ -168,7 +175,8 @@ class TestAssign:
 
         def batch_fn(free, active):
             ok = jnp.all(
-                req.at[:, 3].set(1)[:, None, :] <= free[None, :, :], axis=-1
+                req.at[:, PODS_I].set(1)[:, None, :] <= free[None, :, :],
+                axis=-1,
             )
             return ok, jnp.zeros(ok.shape, jnp.int64)
 

@@ -26,10 +26,18 @@ class TestGoldenBad:
             ("bad_resource_slot.py", "GL005"),
             ("bad_block_timing.py", "GL004"),
             ("bad_donated_reuse.py", "GL006"),
+            ("bad_config_update.py", "GL007"),
         ],
     )
     def test_flagged(self, fixture, rule):
         assert rule in rules_for(FIXTURES / fixture)
+
+    def test_config_update_fixture_flags_both_spellings(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_config_update.py"])
+            if f.rule == "GL007"
+        ]
+        assert len(findings) == 2  # jax.config.update AND bare config.update
 
     def test_matmul_fixture_flags_both_sites(self):
         findings = [
@@ -44,8 +52,94 @@ class TestClean:
         assert lint_paths([FIXTURES / "good_clean.py"]) == []
 
     def test_source_tree_clean(self):
+        # DEFAULT_PATHS covers tests/ and tools/ too; the known-bad fixture
+        # corpora are excluded via the pyproject config (not path hacks)
         findings = lint_paths([str(REPO / p) for p in DEFAULT_PATHS])
         assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_default_scope_covers_tests_and_tools(self):
+        assert "tests" in DEFAULT_PATHS and "tools" in DEFAULT_PATHS
+
+
+class TestConfig:
+    def test_fixture_corpus_excluded_from_directory_sweep(self):
+        # sweeping the tests/ DIRECTORY skips the known-bad corpus...
+        sweep = lint_paths([FIXTURES.parent.parent])  # tests/
+        assert [f for f in sweep if "fixtures" in str(f.path)] == []
+        # ...while naming a corpus file explicitly still lints it
+        assert rules_for(FIXTURES / "bad_i64_matmul.py") == {"GL003"}
+
+    def test_config_owners_sanction_gl007(self):
+        # conftest.py pins the test platform via jax.config.update and is a
+        # sanctioned owner; the same code outside the owner list fires
+        conftest = REPO / "tests" / "conftest.py"
+        assert "GL007" not in {f.rule for f in lint_paths([str(REPO / "tests")])}
+        from tools.graft_lint import lint_file
+
+        findings, _, _ = lint_file(conftest)  # direct call: NOT owned
+        assert "GL007" in {f.rule for f in findings}
+
+    def test_load_config_parses_lists(self):
+        from tools.graft_lint import load_config
+
+        cfg = load_config()
+        assert "tests/fixtures/graft_lint" in cfg["exclude"]
+        assert any(o.startswith("tests/conftest") for o in
+                   cfg["config-update-owners"])
+
+    def test_load_config_tolerates_comment_lines_in_lists(self, monkeypatch,
+                                                          tmp_path):
+        import tools.graft_lint as G
+
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+            [tool.graft-lint]
+            exclude = [
+             # the known-bad corpus
+             "tests/fixtures/graft_lint",
+            ]
+        """))
+        monkeypatch.setattr(G, "REPO", tmp_path)
+        assert G.load_config()["exclude"] == ["tests/fixtures/graft_lint"]
+
+    def test_load_config_strips_inline_comments(self, monkeypatch, tmp_path):
+        # an inline comment on a one-line list must not cascade into
+        # swallowing the NEXT key (the '#' once commented out everything
+        # up to the following list's closing bracket)
+        import tools.graft_lint as G
+
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+            [tool.graft-lint]
+            exclude = ["tests/fixtures/graft_lint"]  # known-bad corpus
+            config-update-owners = [
+             "bench.py",
+            ]
+        """))
+        monkeypatch.setattr(G, "REPO", tmp_path)
+        cfg = G.load_config()
+        assert cfg["exclude"] == ["tests/fixtures/graft_lint"]
+        assert cfg["config-update-owners"] == ["bench.py"]
+
+    def test_load_config_fails_loudly_on_malformed_list(self, monkeypatch,
+                                                        tmp_path):
+        import tools.graft_lint as G
+
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.graft-lint]\nexclude = [\n oops,\n]\n"
+        )
+        monkeypatch.setattr(G, "REPO", tmp_path)
+        with pytest.raises(SystemExit):
+            G.load_config()
+
+    def test_gl007_ignores_plain_dict_named_config(self, tmp_path):
+        # bare `config.update` fires only when `config` is bound FROM jax
+        f = tmp_path / "plain_dict.py"
+        f.write_text(textwrap.dedent("""\
+            config = {}
+
+            def merge(extra):
+                config.update(extra)
+        """))
+        assert lint_paths([f]) == []
 
 
 class TestSuppression:

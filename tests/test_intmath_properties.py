@@ -1,0 +1,202 @@
+"""Property-based tests for utils/intmath.py against Go-semantics oracles.
+
+Go truncating division (`go_div`) and `math.Round` half-away rounding
+(`round_half_away`) are the bit-parity primitives every placement score
+flows through; these tests compare them against exact big-int / Decimal
+oracles over adversarial domains — negative operands, int64 boundary
+values, and the half-boundary doubles where the naive `floor(x + 0.5)`
+idiom double-rounds.
+
+Runs under `hypothesis` when installed (CI does); in environments without
+it, a deterministic fallback sweep (seeded numpy sampling + the explicit
+boundary corpus) exercises the same properties, so the suite never
+silently thins out.
+"""
+
+import decimal
+import math
+
+import numpy as np
+import pytest
+
+import scheduler_plugins_tpu  # noqa: F401  (enables x64: quantities are int64)
+
+import jax.numpy as jnp
+
+from scheduler_plugins_tpu.utils.intmath import (
+    floordiv_exact,
+    go_div,
+    round_half_away,
+)
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # container image without hypothesis: fallback sweeps
+    HAVE_HYPOTHESIS = False
+
+I64_MIN = -(2**63)
+I64_MAX = 2**63 - 1
+EXACT53 = 2**53  # repo-wide exactness bound for float64 quantity math
+
+
+# ---------------------------------------------------------------------------
+# oracles (pure python bignum / Decimal — exact by construction)
+# ---------------------------------------------------------------------------
+
+
+def go_div_oracle(a: int, b: int) -> int:
+    """Go `/` on int64: truncation toward zero (b > 0), wrapped to int64
+    like Go's fixed-width arithmetic would."""
+    q = -((-a) // b) if a < 0 else a // b
+    return ((q + 2**63) % 2**64) - 2**63
+
+
+def round_oracle(x: float) -> int:
+    """Go `math.Round`: exact round-half-away-from-zero of the double
+    (Decimal conversion of a float is exact)."""
+    return int(
+        decimal.Decimal(x).quantize(
+            decimal.Decimal(1), rounding=decimal.ROUND_HALF_UP
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# property checks (shared by the hypothesis and fallback drivers)
+# ---------------------------------------------------------------------------
+
+
+def check_go_div(a: int, b: int):
+    got = int(go_div(jnp.int64(a), jnp.int64(b)))
+    assert got == go_div_oracle(a, b), (a, b, got, go_div_oracle(a, b))
+
+
+def check_round(x: float):
+    got = int(round_half_away(jnp.float64(x)))
+    assert got == round_oracle(x), (x, got, round_oracle(x))
+
+
+def check_floordiv_exact(a: int, b: int):
+    got = int(floordiv_exact(jnp.float64(a), jnp.float64(b)))
+    assert got == a // b, (a, b, got, a // b)
+
+
+# explicit adversarial corpus: int64 boundaries, the wraparound band under
+# INT64_MIN + b, and the half-boundary doubles where floor(x + 0.5) rounds
+# twice
+GO_DIV_CASES = [
+    (I64_MIN, 1), (I64_MIN, 2), (I64_MIN, 3), (I64_MIN + 1, 2),
+    (I64_MIN, I64_MAX), (I64_MAX, 1), (I64_MAX, 2), (I64_MAX, I64_MAX),
+    (-7, 2), (7, 2), (-7, 7), (-1, 2), (1, 2), (0, 5), (-6, 3), (6, 3),
+    (-(2**62) - 1, 2**31), (2**62 + 1, 2**31),
+]
+
+ROUND_CASES = [
+    0.49999999999999994,  # largest double < 0.5: x + 0.5 rounds to 1.0
+    -0.49999999999999994,
+    0.5, -0.5, 1.5, -1.5, 2.5, -2.5, 0.0, -0.0,
+    4503599627370495.5,   # largest half-integer double (2^52 - 0.5)
+    -4503599627370495.5,
+    float(2**52), float(-(2**52)), float(2**52) + 1.0,
+    1e15 + 0.5, -(1e15 + 0.5), 123456789.499999, -123456789.499999,
+]
+
+FLOORDIV_CASES = [
+    (EXACT53 - 1, 1), (EXACT53 - 1, 3), (-(EXACT53 - 1), 3),
+    (-(EXACT53 - 1), 1), (7, 2), (-7, 2), (0, 9), (2**40 + 7, 2**20),
+    (-(2**40) - 7, 2**20),
+]
+
+
+class TestBoundaryCorpus:
+    """The explicit adversarial corpus always runs, hypothesis or not."""
+
+    @pytest.mark.parametrize("a,b", GO_DIV_CASES)
+    def test_go_div_boundaries(self, a, b):
+        check_go_div(a, b)
+
+    @pytest.mark.parametrize("x", ROUND_CASES)
+    def test_round_half_away_boundaries(self, x):
+        check_round(x)
+
+    @pytest.mark.parametrize("a,b", FLOORDIV_CASES)
+    def test_floordiv_exact_boundaries(self, a, b):
+        check_floordiv_exact(a, b)
+
+    def test_go_div_int64_min_not_abs_garbage(self):
+        # the regression the suite found: abs(INT64_MIN) wraps, so the old
+        # abs-based formulation returned +2^62 instead of -2^62
+        assert int(go_div(jnp.int64(I64_MIN), jnp.int64(2))) == -(2**62)
+
+    def test_round_vectorized_matches_scalar(self):
+        xs = jnp.asarray(ROUND_CASES, jnp.float64)
+        got = np.asarray(round_half_away(xs))
+        want = np.asarray([round_oracle(x) for x in ROUND_CASES])
+        np.testing.assert_array_equal(got, want)
+
+
+class TestFallbackSweep:
+    """Deterministic randomized sweep — the property coverage floor for
+    environments without hypothesis (same generators, fixed seed)."""
+
+    def test_go_div_sweep(self):
+        rng = np.random.RandomState(20260803)
+        a = rng.randint(I64_MIN, I64_MAX, size=500, dtype=np.int64)
+        b = rng.randint(1, I64_MAX, size=500, dtype=np.int64)
+        # bias a band toward the boundaries where wraparound lurks
+        a[:50] = I64_MIN + rng.randint(0, 1000, size=50)
+        a[50:100] = I64_MAX - rng.randint(0, 1000, size=50)
+        b[:25] = rng.randint(1, 5, size=25)
+        for ai, bi in zip(a.tolist(), b.tolist()):
+            check_go_div(ai, bi)
+
+    def test_round_sweep(self):
+        rng = np.random.RandomState(20260803)
+        mags = 10.0 ** rng.uniform(-3, 15, size=300)
+        signs = rng.choice([-1.0, 1.0], size=300)
+        xs = list(mags * signs)
+        # exact half-integers (representable below 2^52) stress the tie rule
+        halves = rng.randint(0, 2**51, size=100).astype(np.float64) + 0.5
+        xs += list(halves * rng.choice([-1.0, 1.0], size=100))
+        for x in xs:
+            check_round(float(x))
+
+    def test_floordiv_exact_sweep(self):
+        rng = np.random.RandomState(20260803)
+        a = rng.randint(-(EXACT53 - 1), EXACT53 - 1, size=300)
+        b = rng.randint(1, 2**31, size=300)
+        for ai, bi in zip(a.tolist(), b.tolist()):
+            check_floordiv_exact(int(ai), int(bi))
+
+
+if HAVE_HYPOTHESIS:
+
+    class TestHypothesis:
+        @settings(deadline=None, max_examples=200)
+        @given(
+            st.integers(min_value=I64_MIN, max_value=I64_MAX),
+            st.integers(min_value=1, max_value=I64_MAX),
+        )
+        def test_go_div(self, a, b):
+            check_go_div(a, b)
+
+        @settings(deadline=None, max_examples=200)
+        @given(
+            st.floats(
+                min_value=-1e15, max_value=1e15,
+                allow_nan=False, allow_infinity=False,
+            )
+        )
+        def test_round_half_away(self, x):
+            check_round(x)
+
+        @settings(deadline=None, max_examples=200)
+        @given(
+            st.integers(min_value=-(EXACT53 - 1), max_value=EXACT53 - 1),
+            st.integers(min_value=1, max_value=2**31),
+        )
+        def test_floordiv_exact(self, a, b):
+            check_floordiv_exact(a, b)

@@ -121,6 +121,79 @@ class TestRunChunkPipeline:
         assert np.asarray(free2).shape == np.asarray(free0).shape
 
 
+class TestSanitizeMode:
+    """SPT_SANITIZE=1 (utils.sanitize): donated_chunk_solver builds a
+    checkify-instrumented, donation-free program that reports structured
+    errors — and actually catches an index OOB a production jit would
+    silently clamp."""
+
+    def test_clean_chunk_reports_ok(self, monkeypatch):
+        monkeypatch.setenv("SPT_SANITIZE", "1")
+        from scheduler_plugins_tpu.parallel.pipeline import (
+            donated_chunk_solver,
+        )
+        from scheduler_plugins_tpu.utils import sanitize
+
+        sanitize.drain()
+        # named distinctly from the donating `solve` jits other tests build:
+        # GL006's lexical donating-name map is module-wide by design
+        sanitized = donated_chunk_solver(
+            lambda c, x: (c + x, c - x), carry_argnum=0
+        )
+        out, carry = sanitized(jnp.ones(4), jnp.ones(4))
+        # sanitize mode drops donation: the carry argument stays readable
+        np.testing.assert_array_equal(np.asarray(out), 2.0)
+        reports = sanitize.drain()
+        assert len(reports) == 1 and reports[0]["ok"]
+
+    def test_oob_scatter_is_caught(self, monkeypatch):
+        monkeypatch.setenv("SPT_SANITIZE", "1")
+        from scheduler_plugins_tpu.parallel.pipeline import (
+            donated_chunk_solver,
+        )
+        from scheduler_plugins_tpu.utils import sanitize
+
+        sanitize.drain()
+
+        def bad_solve(carry, idx):
+            return carry[idx], carry  # idx may exceed the carry length
+
+        sanitized = donated_chunk_solver(bad_solve, carry_argnum=0)
+        sanitized(jnp.ones(4), jnp.int32(7))
+        reports = sanitize.drain()
+        assert len(reports) == 1 and not reports[0]["ok"]
+        assert "out-of-bounds" in reports[0]["error"]
+
+    def test_cycle_does_not_adopt_foreign_sanitize_reports(self, monkeypatch):
+        # reports from solves OUTSIDE a cycle (warmups, other schedulers)
+        # must not be attributed to the next cycle's report
+        monkeypatch.setenv("SPT_SANITIZE", "1")
+        from scheduler_plugins_tpu.framework.cycle import run_cycle
+        from scheduler_plugins_tpu.utils import sanitize
+
+        sanitize.drain()
+        sanitize._REPORTS.append(
+            {"sanitize": "foreign", "ok": False, "error": "stale OOB"}
+        )
+        cluster = _alloc_problem(n_nodes=4, n_pods=8)
+        sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+        report = run_cycle(sched, cluster, now=0, stream_chunk=8)
+        assert report.sanitize_errors == []
+        assert not any(
+            r["sanitize"] == "foreign"
+            for r in report.sanitize_errors
+        )
+
+    def test_cycle_report_surfaces_sanitize_errors_field(self):
+        from scheduler_plugins_tpu.framework.cycle import CycleReport
+
+        report = CycleReport()
+        assert report.sanitize_errors == []
+        # None (not 0): "no errors" must be distinguishable from "no
+        # instrumented calls ran" when sanitize mode is off
+        assert report.sanitize_checked is None
+
+
 class TestStreamedProfileSolve:
     def test_matches_batch_solve_constraints(self):
         from scheduler_plugins_tpu.parallel.pipeline import (

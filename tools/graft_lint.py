@@ -30,6 +30,11 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   is lexical and conservative: only Name operands at literal donated
   positions are tracked, reassignment revives, and loop back-edges are not
   followed.
+- **GL007 library-config-update** — no `jax.config.update(...)` outside the
+  sanctioned owner files (`config-update-owners` in the pyproject config):
+  platform/precision config is owned by the entrypoints and the test
+  bootstrap (`tests/conftest.py`); a library-level update fights their
+  platform pinning and its effect depends on import order.
 
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
@@ -38,7 +43,16 @@ snapshot field like `.req`/`.alloc`). Unknown dtypes never fire.
 
 Suppress a finding with a trailing `# graft-lint: ignore[GLxxx]` comment.
 
-Usage: python tools/graft_lint.py [paths...]   (default: the source tree)
+Config (`pyproject.toml [tool.graft-lint]`, parsed with a tiny stdlib
+TOML subset — flat string / string-list keys only):
+- `exclude`: repo-relative path prefixes skipped when EXPANDING directory
+  arguments (the known-bad fixture corpora); a file named explicitly on
+  the command line is always linted.
+- `config-update-owners`: repo-relative path prefixes where GL007 is
+  sanctioned.
+
+Usage: python tools/graft_lint.py [paths...]   (default: the source tree
+plus tests/ and tools/)
 """
 
 from __future__ import annotations
@@ -50,8 +64,89 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-#: default lint scope: the package plus the two driver entry files
-DEFAULT_PATHS = ("scheduler_plugins_tpu", "bench.py", "__graft_entry__.py")
+#: default lint scope: the package, the driver entry files, and the test +
+#: tool trees (known-bad fixture corpora are excluded via the pyproject
+#: config, not path hacks)
+DEFAULT_PATHS = (
+    "scheduler_plugins_tpu", "bench.py", "__graft_entry__.py", "tests",
+    "tools",
+)
+
+
+def load_config() -> dict:
+    """`[tool.graft-lint]` from pyproject.toml. Deliberately tiny TOML
+    subset (the repo stays stdlib-only on py3.10, no tomllib): flat
+    `key = "str"` / `key = ["str", ...]` entries inside the one section,
+    values parsed as Python literals (valid for TOML strings/string
+    lists)."""
+    import ast as _ast
+
+    cfg = {"exclude": [], "config-update-owners": []}
+    path = REPO / "pyproject.toml"
+    if not path.exists():
+        return cfg
+    def strip_comment(s: str) -> str:
+        """Drop a trailing `# ...` TOML comment, respecting quoted strings
+        (a `#` inside quotes is content, not a comment)."""
+        quote = None
+        for i, ch in enumerate(s):
+            if quote:
+                if ch == quote:
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+            elif ch == "#":
+                return s[:i].rstrip()
+        return s
+
+    section, key, buf = None, None, None
+    for raw in path.read_text().splitlines():
+        line = strip_comment(raw.strip())
+        if buf is not None:
+            if not line:
+                continue  # blank/comment-only lines inside a list
+            buf += " " + line
+            if line.endswith("]"):
+                try:
+                    cfg[key] = list(_ast.literal_eval(buf))
+                except (ValueError, SyntaxError):
+                    # a malformed list must fail LOUDLY: silently dropping
+                    # `exclude` would sweep the known-bad fixture corpora
+                    # into make lint with findings that look real
+                    raise SystemExit(
+                        f"graft-lint: unparseable [tool.graft-lint] value "
+                        f"for {key!r} in pyproject.toml: {buf!r}"
+                    )
+                buf = None
+            continue
+        if line.startswith("["):
+            section = line.strip("[]").strip()
+            continue
+        if section != "tool.graft-lint" or not line or line.startswith("#"):
+            continue
+        if "=" in line:
+            key, _, val = line.partition("=")
+            key, val = key.strip(), val.strip()
+            if val.startswith("[") and not val.endswith("]"):
+                buf = val
+                continue
+            try:
+                parsed = _ast.literal_eval(val)
+            except (ValueError, SyntaxError):
+                continue
+            cfg[key] = (
+                list(parsed) if isinstance(parsed, (list, tuple)) else parsed
+            )
+    return cfg
+
+
+def _rel_to_repo(path: Path):
+    """Repo-relative POSIX path of `path`, or None when outside the repo
+    (tmp-dir test files: never excluded, never GL007-sanctioned)."""
+    try:
+        return Path(path).resolve().relative_to(REPO).as_posix()
+    except ValueError:
+        return None
 
 INT64, INT32, FLOAT, BOOL, UNKNOWN = "int64", "int32", "float", "bool", None
 
@@ -491,6 +586,47 @@ def check_resource_slots(path, tree, findings):
             ))
 
 
+def check_config_update(path, tree, findings):
+    """GL007: `jax.config.update(...)` (or `config.update` from
+    `from jax import config`) outside the sanctioned owner files. The
+    bare-name form only fires when the module actually binds `config`
+    FROM jax — a local dict named `config` being .update()d is not a
+    finding."""
+    jax_config_imported = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "jax"
+        and any((alias.asname or alias.name) == "config"
+                and alias.name == "config" for alias in node.names)
+        for node in ast.walk(tree)
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "update"):
+            continue
+        base = f.value
+        is_jax_config = (
+            isinstance(base, ast.Attribute)
+            and base.attr == "config"
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "jax"
+        ) or (
+            isinstance(base, ast.Name)
+            and base.id == "config"
+            and jax_config_imported
+        )
+        if not is_jax_config:
+            continue
+        findings.append(Finding(
+            path, node, "GL007",
+            "jax.config.update in library code: platform/precision config "
+            "is owned by the entrypoints and tests/conftest.py "
+            "(config-update-owners in pyproject [tool.graft-lint]) — a "
+            "library-level update fights their platform pinning",
+        ))
+
+
 def _donate_positions(node):
     """Literal int positions from a donate_argnums/carry_argnum value."""
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
@@ -670,9 +806,11 @@ def _suppressed(finding, source_lines):
     return False
 
 
-def lint_file(path: Path) -> tuple[list, object, str]:
+def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str]:
     """(findings, ast tree, source) for one file — the tree/source feed the
-    cross-file plugin-hierarchy pass and suppression filter in lint_paths."""
+    cross-file plugin-hierarchy pass and suppression filter in lint_paths.
+    `config_owner` marks a sanctioned GL007 owner file (platform/precision
+    config allowed); direct callers default to NOT owned."""
     source = path.read_text()
     tree = ast.parse(source, filename=str(path))
     findings: list[Finding] = []
@@ -682,20 +820,37 @@ def lint_file(path: Path) -> tuple[list, object, str]:
     check_block_until_ready(rel, tree, findings)
     check_resource_slots(rel, tree, findings)
     check_donated_reuse(rel, tree, findings)
+    if not config_owner:
+        check_config_update(rel, tree, findings)
     return findings, tree, source
 
 
 def lint_paths(paths) -> list[Finding]:
+    cfg = load_config()
+    exclude = tuple(cfg.get("exclude", ()))
+    owners = tuple(cfg.get("config-update-owners", ()))
+
+    def excluded(f):
+        rel = _rel_to_repo(f)
+        return rel is not None and any(rel.startswith(e) for e in exclude)
+
+    def owned(f):
+        rel = _rel_to_repo(f)
+        return rel is not None and any(rel.startswith(o) for o in owners)
+
     files = []
     for p in paths:
         p = Path(p)
         if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
+            # config exclusions apply when EXPANDING directories only —
+            # a file named explicitly is always linted (the fixture tests
+            # point the linter straight at the known-bad corpus)
+            files.extend(f for f in sorted(p.rglob("*.py")) if not excluded(f))
         else:
             files.append(p)
     all_findings, trees, sources = [], [], {}
     for f in files:
-        findings, tree, source = lint_file(f)
+        findings, tree, source = lint_file(f, config_owner=owned(f))
         all_findings.extend(findings)
         trees.append((f, tree))
         sources[f] = source.splitlines()

@@ -58,9 +58,17 @@ def bootstrap(n_devices: int = 8) -> None:
     `__graft_entry__._force_cpu_platform`, which also UPGRADES a
     pre-existing smaller `--xla_force_host_platform_device_count` in
     XLA_FLAGS — a stale 4-device export must not starve the 8-way sharded
-    programs. Idempotent; must run before any jnp array is created."""
+    programs. Idempotent; must run before any jnp array is created.
+
+    Also clears SPT_SANITIZE: program construction branches on it
+    (checkify-instrumented solver builds), and the certification tools —
+    this one and tools/jaxpr_audit.py, which shares this bootstrap — must
+    always trace/lower the SHIPPED programs, never instrumented variants
+    (a stray `export SPT_SANITIZE=1` would otherwise silently regenerate
+    the committed manifests from the wrong programs)."""
     import __graft_entry__
 
+    os.environ.pop("SPT_SANITIZE", None)
     __graft_entry__._force_cpu_platform(n_devices)
 
 
