@@ -31,11 +31,20 @@ tpu-smoke:
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --smoke-compare 2,3
 
+# CI observability gate: the cycle tracer must emit a Perfetto-loadable
+# trace (pipeline H2D/solve/D2H rows per buffer, framework extension-point
+# spans, failure attribution populated) and its enabled-path overhead must
+# stay within max(2%, the run's own timing jitter) on a reduced
+# north-star shape
+.PHONY: trace-smoke
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke
 
 .PHONY: lint
 lint:

@@ -120,6 +120,15 @@ def python_baseline_pods_per_sec(cluster, sample=200):
     return len(pods) / elapsed
 
 
+def _bench_span(name, **args):
+    """Tracer span on the "bench" row (no-op unless `--trace out.json`
+    enabled the global tracer) — so every config's timed phases land in
+    the exported timeline, not just the chunk pipeline's rows."""
+    from scheduler_plugins_tpu.utils import observability as obs
+
+    return obs.tracer.span(name, tid="bench", **args)
+
+
 def _backend_label():
     """"backend/device-kind" of the default JAX backend, stamped into every
     emitted line so capture replays can tell real on-chip numbers from CPU
@@ -296,8 +305,9 @@ def main(n_nodes=None, n_pods=None):
         )
         np.asarray(snap_k.pods.req[0, 0])  # perturbation settled
         start = time.perf_counter()
-        assignment, _, _, stats = solve(snap_k, weights)
-        assignment_np = np.asarray(assignment)
+        with _bench_span(f"flagship solve run {k}", pods=n_pods):
+            assignment, _, _, stats = solve(snap_k, weights)
+            assignment_np = np.asarray(assignment)
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment_np >= 0).sum())
@@ -435,11 +445,26 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
     (a, _), _ = solve_chunk(raw, node_mask, *chunk_inputs[0], free)
     np.asarray(a)
 
+    # calibration: ONE synchronous chunk solve (compile already paid),
+    # completion forced by host transfer — the device-busy yardstick the
+    # pipeline-bubble metric scales by the per-chunk wave counters
+    # (device time is never read from inside jit; CLAUDE.md / GL008)
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    cal_start = time.perf_counter()
+    with _bench_span("calibration chunk", chunk=chunk):
+        (a_cal, cal_stats), _ = solve_chunk(
+            raw, node_mask, *chunk_inputs[0], free
+        )
+        np.asarray(a_cal)
+    cal_s = time.perf_counter() - cal_start
+    cal_waves = max(1, int(np.asarray(cal_stats["waves"])))
+
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     start = time.perf_counter()
-    results, free, chunk_done_s = run_chunk_pipeline(
-        solve_chunk, (raw, node_mask), chunk_inputs, free
-    )
+    with _bench_span("north-star pipeline", chunks=len(chunk_inputs)):
+        results, free, chunk_done_s, timeline = run_chunk_pipeline(
+            solve_chunk, (raw, node_mask), chunk_inputs, free
+        )
     elapsed = time.perf_counter() - start
     chunk_assignments = [a for a, _ in results]
     placed = int(sum((a >= 0).sum() for a in chunk_assignments))
@@ -452,6 +477,10 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
     # per-pod latency distribution is the chunk completion times weighted
     # by chunk size
     pod_latency_s = np.repeat(chunk_done_s, chunk)[:n_pods]
+    # device-busy estimate: calibration chunk's synchronous solve time
+    # scaled by the wave counters -> the pipeline-overlap report
+    solve_est_ms = cal_s * 1000.0 * (waves / cal_waves)
+    overlap = timeline.summary(solve_ms=solve_est_ms)
     baseline = python_baseline_pods_per_sec(cluster, sample=40)
     compiled, ref_out = _compiled_baseline(6, snap, meta, weights=weights)
     _emit(
@@ -473,6 +502,10 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
             "chunks": len(chunk_inputs),
             "waves": waves,
             "wave_occupancy": _trim_occupancy(occ),
+            "pipeline_bubble_ms": overlap["pipeline_bubble_ms"],
+            "overlap_efficiency": overlap["overlap_efficiency"],
+            "h2d_overlap_efficiency": overlap["h2d_overlap_efficiency"],
+            "d2h_overlap_efficiency": overlap["d2h_overlap_efficiency"],
         },
     )
 
@@ -503,8 +536,9 @@ def tpu_smoke(n_nodes=None, n_pods=None):
         )
         np.asarray(snap_k.pods.req[0, 0])
         start = time.perf_counter()
-        assignment, _, _, stats = solve(snap_k, weights)
-        assignment_np = np.asarray(assignment)
+        with _bench_span(f"smoke solve run {k}", pods=n_pods):
+            assignment, _, _, stats = solve(snap_k, weights)
+            assignment_np = np.asarray(assignment)
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment_np >= 0).sum())
@@ -664,9 +698,10 @@ def sequential_config(config: int, mode: str = "sequential"):
     np.asarray(run())  # compile
     times = []
     assignment = None
-    for _ in range(3):
+    for k in range(3):
         start = time.perf_counter()
-        assignment = np.asarray(run())  # forces completion
+        with _bench_span(f"{metric} run {k}", pods=n_pods):
+            assignment = np.asarray(run())  # forces completion
         times.append(time.perf_counter() - start)
     elapsed = sorted(times)[len(times) // 2]
     placed = int((assignment >= 0).sum())
@@ -824,7 +859,7 @@ def sanitize_smoke(configs, chunk_shape=(64, 256, 128)):
         for lo in range(0, padded, chunk)
     ]
     free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
-    results, _, _ = run_chunk_pipeline(
+    results, _, _, _ = run_chunk_pipeline(
         solve_chunk, (raw, snap.nodes.mask), chunk_inputs, free
     )
     placed = int(sum((np.asarray(a) >= 0).sum() for a, _ in results))
@@ -851,9 +886,14 @@ if __name__ == "__main__":
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
                         help="configs 2-5: bit-faithful scan or batched waves")
-    parser.add_argument("--trace", default=None, metavar="DIR",
-                        help="dump a jax profiler trace of the timed runs to "
-                             "DIR (op-level data for tuning rounds)")
+    parser.add_argument("--trace", default=None, metavar="OUT",
+                        help="OUT ending in .json: record the cycle tracer "
+                             "(utils.observability) and write a Perfetto-"
+                             "loadable Chrome-trace JSON with the host "
+                             "extension-point spans and the chunk "
+                             "pipeline's H2D/solve/D2H rows; otherwise a "
+                             "directory for a jax profiler trace "
+                             "(op-level data for tuning rounds)")
     parser.add_argument("--smoke-compare", default=None, metavar="CFGS",
                         help="CI gate: comma-separated configs (e.g. 2,3) "
                              "run at reduced shapes in BOTH modes; fails "
@@ -906,7 +946,12 @@ if __name__ == "__main__":
             "detail": diagnosis,
         }))
         sys.exit(0)
-    if args.trace:
+    trace_json = bool(args.trace) and args.trace.endswith(".json")
+    if trace_json:
+        from scheduler_plugins_tpu.utils import observability as obs
+
+        obs.tracer.start()
+    elif args.trace:
         import jax
 
         jax.profiler.start_trace(args.trace)
@@ -920,5 +965,8 @@ if __name__ == "__main__":
         else:
             sequential_config(args.config, args.mode)
     finally:
-        if args.trace:
+        if trace_json:
+            obs.tracer.stop()
+            obs.tracer.write(args.trace)
+        elif args.trace:
             jax.profiler.stop_trace()

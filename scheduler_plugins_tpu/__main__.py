@@ -22,8 +22,11 @@ Wiring per tick:
                  run_cycle (QueueSort..Bind, collector ticks, NRT resync)
                  reconcile_pod_groups / reconcile_elastic_quotas
                  bindings POSTed back to the apiserver [--bind-back]
-    health:      GET /healthz  -> liveness + cycle/bound/leader status
-                 GET /metrics  -> counters incl. cycle-latency summary
+    health:      GET /healthz      -> liveness + cycle/bound/leader status
+                 GET /metrics      -> prometheus text format (counters incl.
+                                      per-plugin unschedulable attribution +
+                                      cycle/plugin latency histograms)
+                 GET /metrics.json -> the flat JSON counter snapshot
 
 Without --apiserver the daemon is feed-driven: external agents (the Go/C++
 sidecar shape, bridge/feed.py clients) push events to --feed-port and the
@@ -132,9 +135,12 @@ def load_profile_file(path: str):
 
 
 class HealthServer:
-    """GET /healthz (liveness + loop counters) and /metrics (the counter
-    registry) — the probe/metrics surface of cmd/controller/app/server.go
-    :52-58, minus the prometheus wire format."""
+    """GET /healthz (liveness + loop counters), /metrics (prometheus text
+    exposition 0.0.4: counters incl. per-plugin unschedulable attribution,
+    plus real `_bucket{le=...}`/`_sum`/`_count` histograms for cycle and
+    per-extension-point plugin latency) and /metrics.json (the flat debug
+    snapshot) — the probe/metrics surface of cmd/controller/app/server.go
+    :52-58, now speaking the prometheus wire format."""
 
     def __init__(self, daemon, host: str, port: int):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -161,8 +167,19 @@ class HealthServer:
                         payload["leader"] = outer.elector.is_leader
                         payload["holder"] = outer.elector.observed_holder
                     body = json.dumps(payload).encode()
-                elif self.path.startswith("/metrics"):
+                elif self.path.startswith("/metrics.json"):
                     body = json.dumps(obs.metrics.snapshot()).encode()
+                elif self.path.startswith("/metrics"):
+                    body = obs.metrics.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -30,11 +30,14 @@ from scheduler_plugins_tpu.utils import observability as obs
 @dataclass
 class SolveResultView:
     """The (assignment, admitted, wait) triple the cycle consumes — what the
-    streamed pipeline solve returns (no SolverState carry to surface)."""
+    streamed pipeline solve returns (no SolverState carry to surface).
+    `failed_plugin` stays None: attribution for streamed solves is reduced
+    from the cycle-initial per-plugin masks (`Scheduler.attribution_codes`)."""
 
     assignment: object
     admitted: object
     wait: object
+    failed_plugin: object = None
 
 
 @dataclass
@@ -42,6 +45,14 @@ class CycleReport:
     bound: dict[str, str] = field(default_factory=dict)  # uid -> node
     reserved: dict[str, str] = field(default_factory=dict)
     failed: list[str] = field(default_factory=list)
+    #: uid -> plugin name that made the pod unschedulable (the upstream
+    #: `UnschedulablePlugins` attribution): the first plugin in profile
+    #: order whose PreFilter rejected it or whose Filter emptied the
+    #: feasible node set; "NodeResourcesFit" for built-in fit/capacity
+    #: failures. Exact against the carried state on the sequential parity
+    #: path (`SolveResult.failed_plugin`), reduced from the cycle-initial
+    #: per-plugin masks for batched/streamed solves.
+    failed_by: dict[str, str] = field(default_factory=dict)
     #: pods parked unschedulable with no registered event since their last
     #: failure (EnqueueExtensions gating) — excluded from this cycle's batch
     skipped: list[str] = field(default_factory=list)
@@ -79,12 +90,16 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
 
     for plugin in scheduler.profile.plugins:
         plugin.configure_cluster(cluster)
-    _expire_gangs(cluster, now, report)
-    _resync_nrt_cache(cluster, now)
-    _refresh_metrics(scheduler, cluster, now)
+    with obs.tracer.span("ExpireGangs", tid="cycle"):
+        _expire_gangs(cluster, now, report)
+    with obs.tracer.span("NRTResync", tid="cycle"):
+        _resync_nrt_cache(cluster, now)
+    with obs.tracer.span("Collectors", tid="cycle"):
+        _refresh_metrics(scheduler, cluster, now)
 
     pending = cluster.pending_pods()
-    pending = _requeue_eligible(scheduler, cluster, pending, now, report)
+    with obs.tracer.span("Requeue", tid="cycle"):
+        pending = _requeue_eligible(scheduler, cluster, pending, now, report)
     if not pending:
         return report
     pending = scheduler.sort_pending(pending, cluster)
@@ -98,21 +113,35 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         sanitize.drain()
     generation = getattr(cluster.nrt_cache, "generation", None)
     with obs.flow("cycle", generation=generation, pending=len(pending)):
-        snap, meta = cluster.snapshot(pending, now_ms=now)
+        with obs.tracer.span("Snapshot", tid="cycle", pending=len(pending)):
+            snap, meta = cluster.snapshot(pending, now_ms=now)
         scheduler.prepare(meta, cluster)
         result = None
-        if stream_chunk:
-            from scheduler_plugins_tpu.parallel.pipeline import (
-                streamed_profile_solve,
-            )
+        # the Solve span covers dispatch AND completion (np.asarray host
+        # transfers below force it) for the sequential path; the streamed
+        # path's device-side overlap shows up as pipeline rows emitted by
+        # run_chunk_pipeline itself
+        with obs.extension_span(
+            "Solve", scheduler.profile.name, pending=len(pending)
+        ):
+            if stream_chunk:
+                from scheduler_plugins_tpu.parallel.pipeline import (
+                    streamed_profile_solve,
+                )
 
-            streamed = streamed_profile_solve(
-                scheduler, snap, chunk=stream_chunk
-            )
-            if streamed is not None:
-                result = SolveResultView(*streamed)
-        if result is None:
-            result = scheduler.solve(snap)
+                streamed = streamed_profile_solve(
+                    scheduler, snap, chunk=stream_chunk
+                )
+                if streamed is not None:
+                    result = SolveResultView(*streamed)
+            if result is None:
+                result = scheduler.solve(snap)
+            # host transfers force completion (block_until_ready can
+            # return early through the tunneled backend — CLAUDE.md), so
+            # the Solve span/histogram covers the full device round-trip
+            assignment = np.asarray(result.assignment)
+            admitted = np.asarray(result.admitted)
+            wait = np.asarray(result.wait)
 
     if sanitize.enabled():
         # surface this cycle's checkify findings on the report (the solve
@@ -123,39 +152,41 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         report.sanitize_checked = len(reports)
         report.sanitize_errors = [r for r in reports if not r["ok"]]
 
-    assignment = np.asarray(result.assignment)
-    admitted = np.asarray(result.admitted)
-    wait = np.asarray(result.wait)
-
     failed_by_gang: dict[str, list[str]] = {}
-    for i, pod in enumerate(pending):
-        node_idx = int(assignment[i])
-        pg = cluster.pod_group_of(pod)
-        if node_idx < 0 or not admitted[i]:
-            report.failed.append(pod.uid)
-            cluster.mark_unschedulable(pod.uid, now)
-            if pg is not None:
-                failed_by_gang.setdefault(pg.full_name, []).append(pod.uid)
-            continue
-        node_name = meta.node_names[node_idx]
-        if wait[i]:
-            cluster.reserve(pod.uid, node_name)
-            report.reserved[pod.uid] = node_name
-            # per-POD waiting timer from THIS pod's reservation time
-            # (upstream waitingPods, coscheduling.go:227-235;
-            # GetWaitTimeDuration: ScheduleTimeoutSeconds else
-            # PermitWaitingTimeSeconds)
-            timeout_s = pg.schedule_timeout_seconds if pg is not None else None
-            if timeout_s is None and cosched is not None:
-                timeout_s = cosched.permit_waiting_seconds
-            cluster.pod_deadline_ms[pod.uid] = now + 1000 * (timeout_s or 0)
-        else:
-            cluster.bind(pod.uid, node_name, now)
-            report.bound[pod.uid] = node_name
+    failed_idx: list[tuple[int, str]] = []
+    with obs.tracer.span("Bind", tid="cycle"):
+        for i, pod in enumerate(pending):
+            node_idx = int(assignment[i])
+            pg = cluster.pod_group_of(pod)
+            if node_idx < 0 or not admitted[i]:
+                report.failed.append(pod.uid)
+                failed_idx.append((i, pod.uid))
+                cluster.mark_unschedulable(pod.uid, now)
+                if pg is not None:
+                    failed_by_gang.setdefault(pg.full_name, []).append(pod.uid)
+                continue
+            node_name = meta.node_names[node_idx]
+            if wait[i]:
+                cluster.reserve(pod.uid, node_name)
+                report.reserved[pod.uid] = node_name
+                # per-POD waiting timer from THIS pod's reservation time
+                # (upstream waitingPods, coscheduling.go:227-235;
+                # GetWaitTimeDuration: ScheduleTimeoutSeconds else
+                # PermitWaitingTimeSeconds)
+                timeout_s = pg.schedule_timeout_seconds if pg is not None else None
+                if timeout_s is None and cosched is not None:
+                    timeout_s = cosched.permit_waiting_seconds
+                cluster.pod_deadline_ms[pod.uid] = now + 1000 * (timeout_s or 0)
+            else:
+                cluster.bind(pod.uid, node_name, now)
+                report.bound[pod.uid] = node_name
+
+    _attribute_failures(scheduler, snap, result, failed_idx, report)
 
     # Permit Allow fan-out: quorum reached this cycle releases waiting siblings
-    for pg in list(cluster.pod_groups.values()):
-        _maybe_release_gang(cluster, pg, report, now)
+    with obs.tracer.span("Permit", tid="cycle"):
+        for pg in list(cluster.pod_groups.values()):
+            _maybe_release_gang(cluster, pg, report, now)
 
     # PostFilter: whole-gang rejection (coscheduling.go:160-209)
     for gang_name in failed_by_gang:
@@ -177,11 +208,46 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         _reject_gang(cluster, pg, now, report, cosched, len(members))
 
     _mark_overreserved_on_failures(cluster, report)
-    _run_preemption(scheduler, cluster, pending, report, now)
+    engine = scheduler.profile.preemption
+    with obs.extension_span(
+        "PostFilter", type(engine).__name__ if engine else "none",
+        failed=len(report.failed),
+    ):
+        _run_preemption(scheduler, cluster, pending, report, now)
     obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
     obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
     obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
     return report
+
+
+def _attribute_failures(scheduler, snap, result, failed_idx, report):
+    """Fill `CycleReport.failed_by` and the
+    `scheduler_unschedulable_by_plugin_total{plugin}` counters — the
+    upstream UnschedulablePlugins attribution. The sequential parity path
+    carries exact per-pod codes out of the solve
+    (`SolveResult.failed_plugin`, evaluated against the carried state);
+    batched/streamed solves reduce the same per-plugin masks cycle-
+    initially (`Scheduler.attribution_codes`). Codes <= 0 (built-in fit,
+    gates, or in-cycle capacity exhaustion) decode to "NodeResourcesFit"."""
+    if not failed_idx:
+        return
+    with obs.tracer.span("Attribution", tid="cycle", failed=len(failed_idx)):
+        codes = getattr(result, "failed_plugin", None)
+        if codes is not None:
+            # sequential parity path: (P,) in-solve codes, pod-indexed
+            codes_np = np.asarray(codes)
+            per_failure = [codes_np[i] for i, _ in failed_idx]
+        else:
+            # batched/streamed: reduce the failed rows only (S, N work)
+            per_failure = scheduler.attribution_codes(
+                snap, [i for i, _ in failed_idx]
+            )
+        names = scheduler.fail_plugin_names()
+        for (_, uid), code in zip(failed_idx, per_failure):
+            code = int(code)
+            name = names[code] if code > 0 else names[0]
+            report.failed_by[uid] = name
+            obs.metrics.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin=name)
 
 
 def _requeue_eligible(scheduler, cluster, pending, now, report):

@@ -27,6 +27,12 @@ from flax import struct
 from scheduler_plugins_tpu.framework.plugin import Plugin, SolverState
 from scheduler_plugins_tpu.ops.fit import fits_one, free_capacity, pod_fit_demand
 from scheduler_plugins_tpu.state.snapshot import ClusterSnapshot, SnapshotMeta
+from scheduler_plugins_tpu.utils import observability as obs
+
+#: attribution name for failures owned by the FRAMEWORK, not a profile
+#: plugin: scheduling gates, resource-fit exhaustion, wave-capacity
+#: exhaustion in the batched path (the upstream built-in fit plugin name)
+BUILTIN_FIT = "NodeResourcesFit"
 
 
 def _is_tpu_backend() -> bool:
@@ -49,6 +55,76 @@ class SolveResult:
     admitted: jnp.ndarray  # (P,) bool PreFilter verdict
     wait: jnp.ndarray  # (P,) bool Permit said Wait (gang quorum unmet)
     state: SolverState  # final carried state
+    #: (P,) int32 unschedulability attribution, the upstream
+    #: `UnschedulablePlugins` signal per pod: -1 = placed; 0 = built-in
+    #: (gated, or resource fit exhausted against the carried free
+    #: capacity); 1+i = profile plugin i (its PreFilter rejected the pod,
+    #: or its Filter first emptied the remaining feasible node set in
+    #: profile order). Decoded via `Scheduler.fail_plugin_names`.
+    failed_plugin: Optional[jnp.ndarray] = None
+
+
+def _admit_with_attribution(plugins, state, snap, p, ok0):
+    """PreFilter sweep with attribution: (ok, admit_code) where
+    `admit_code` is the FIRST plugin (profile order) whose verdict flipped
+    the pod inadmissible, -1 when none did — the upstream
+    UnschedulablePlugins attribution at PreFilter. THE one copy of the
+    attribution ordering, shared by the sequential scan and the batched
+    reduction (`Scheduler.attribution_codes`) so the two cannot drift."""
+    ok = ok0
+    admit_code = jnp.int32(-1)
+    for i, plugin in enumerate(plugins):
+        verdict = plugin.admit(state, snap, p)
+        if verdict is not None:
+            admit_code = jnp.where(
+                (admit_code < 0) & ok & ~verdict, jnp.int32(i), admit_code
+            )
+            ok &= verdict
+    return ok, admit_code
+
+
+def _filter_with_attribution(plugins, state, snap, p, fit0):
+    """Filter chain with attribution: (feasible, filter_code) where
+    `filter_code` is the first plugin whose Filter emptied the
+    still-feasible node set, -1 when none did. Shared like
+    `_admit_with_attribution`."""
+    feasible = fit0
+    alive = fit0.any()
+    filter_code = jnp.int32(-1)
+    for i, plugin in enumerate(plugins):
+        mask = plugin.filter(state, snap, p)
+        if mask is not None:
+            feasible &= mask
+            now_alive = feasible.any()
+            filter_code = jnp.where(
+                (filter_code < 0) & alive & ~now_alive,
+                jnp.int32(i), filter_code,
+            )
+            alive = now_alive
+    return feasible, filter_code
+
+
+def _encode_fail(ok0, admit_code, fit0_any, filter_code, fallback):
+    """Merge the stage attributions into one code (see
+    `SolveResult.failed_plugin`): PreFilter rejections name their plugin
+    first (upstream runs PreFilter before the node sweep), then built-in
+    fit, then the first Filter plugin that emptied the feasible set, then
+    `fallback` (0 = built-in for the sequential scan, where reaching it
+    means in-cycle capacity exhaustion; -1 = "feasible cycle-initially"
+    for the batched reduction)."""
+    return jnp.where(
+        ~ok0,
+        jnp.int32(0),
+        jnp.where(
+            admit_code >= 0,
+            admit_code + 1,
+            jnp.where(
+                ~fit0_any,
+                jnp.int32(0),
+                jnp.where(filter_code >= 0, filter_code + 1, fallback),
+            ),
+        ),
+    )
 
 
 @dataclass
@@ -100,43 +176,48 @@ class Scheduler:
         Plugins exposing a pairwise `queue_compare` (TopologicalSort) are
         used via cmp_to_key, preserving exact Less() semantics."""
         qs = self.profile.queue_sort
-        if qs is not None and hasattr(qs, "queue_compare"):
-            import functools
+        qs_name = qs.name if qs is not None else "PrioritySort"
+        with obs.extension_span("QueueSort", qs_name, pods=len(pods)):
+            if qs is not None and hasattr(qs, "queue_compare"):
+                import functools
 
-            return sorted(
-                pods,
-                key=functools.cmp_to_key(
-                    lambda a, b: qs.queue_compare(a, b, cluster)
-                ),
-            )
+                return sorted(
+                    pods,
+                    key=functools.cmp_to_key(
+                        lambda a, b: qs.queue_compare(a, b, cluster)
+                    ),
+                )
 
-        def key(pod):
-            if qs is not None:
-                k = qs.queue_key(pod, cluster)
-                if k is not None:
-                    return k
-            return (-pod.priority, pod.creation_ms, f"{pod.namespace}/{pod.name}")
+            def key(pod):
+                if qs is not None:
+                    k = qs.queue_key(pod, cluster)
+                    if k is not None:
+                        return k
+                return (
+                    -pod.priority, pod.creation_ms,
+                    f"{pod.namespace}/{pod.name}",
+                )
 
-        return sorted(pods, key=key)
+            return sorted(pods, key=key)
 
     # -- solve ----------------------------------------------------------
     def prepare(self, meta: SnapshotMeta, cluster=None):
         for plugin in self.profile.plugins:
-            plugin.prepare(meta)
-            if hasattr(plugin, "prepare_cluster"):
-                plugin.prepare_cluster(meta, cluster)
+            with obs.extension_span("Prepare", plugin.name):
+                plugin.prepare(meta)
+                if hasattr(plugin, "prepare_cluster"):
+                    plugin.prepare_cluster(meta, cluster)
 
     def _make_solve(self, unroll: int):
         plugins = tuple(self.profile.plugins)
 
         def step(carry, p, snap: ClusterSnapshot):
             state = carry
-            # PreFilter
-            ok = snap.pods.mask[p] & ~snap.pods.gated[p]
-            for plugin in plugins:
-                verdict = plugin.admit(state, snap, p)
-                if verdict is not None:
-                    ok &= verdict
+            # PreFilter, with per-plugin attribution (shared helper)
+            ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]
+            ok, admit_code = _admit_with_attribution(
+                plugins, state, snap, p, ok0
+            )
             # Filter: built-in resource fit + plugin filters. Nominated
             # pods' demand holds capacity against lower-or-equal-priority
             # pods (upstream AddNominatedPods: priority >= evaluated pod,
@@ -158,11 +239,12 @@ class Scheduler:
                     jnp.maximum(nm.node, 0)
                 ].add(jnp.where(live[:, None], nm.demand, 0))
                 free_eff = state.free - hold
-            feasible = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
-            for plugin in plugins:
-                mask = plugin.filter(state, snap, p)
-                if mask is not None:
-                    feasible &= mask
+            fit0 = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
+            # Filter chain with attribution (shared helper) — exact
+            # against the CARRIED state: the parity path's ground truth
+            feasible, filter_code = _filter_with_attribution(
+                plugins, state, snap, p, fit0
+            )
             feasible &= ok
             # Score + Normalize, weighted sum
             total = jnp.zeros(state.free.shape[0], jnp.int64)
@@ -193,7 +275,16 @@ class Scheduler:
                 state = commit_tracks(state, snap.scheduling, p, choice)
             for plugin in plugins:
                 state = plugin.commit(state, snap, p, choice)
-            return state, (choice, ok)
+            # attribution code (SolveResult.failed_plugin); fallback 0:
+            # a failed pod that no stage rejected lost to in-cycle
+            # capacity consumption -> built-in fit
+            fail_code = jnp.where(
+                choice >= 0,
+                jnp.int32(-1),
+                _encode_fail(ok0, admit_code, fit0.any(), filter_code,
+                             jnp.int32(0)),
+            )
+            return state, (choice, ok, fail_code)
 
         def solve(
             snap: ClusterSnapshot, state0: SolverState, auxes
@@ -206,7 +297,7 @@ class Scheduler:
             for plugin in plugins:
                 plugin.bind_presolve(plugin.prepare_solve(snap))
             P = snap.num_pods
-            state, (assignment, admitted) = jax.lax.scan(
+            state, (assignment, admitted, failed_plugin) = jax.lax.scan(
                 lambda c, p: step(c, p, snap), state0, jnp.arange(P),
                 unroll=unroll,
             )
@@ -222,7 +313,8 @@ class Scheduler:
                 )
                 wait = (assignment >= 0) & ~pod_quorum
             return SolveResult(
-                assignment=assignment, admitted=admitted, wait=wait, state=state
+                assignment=assignment, admitted=admitted, wait=wait,
+                state=state, failed_plugin=failed_plugin,
             )
 
         return jax.jit(solve)
@@ -294,6 +386,75 @@ class Scheduler:
         return self._solve_cache[key](
             snap, self.initial_state(snap), auxes, pod_index
         )
+
+    # -- attribution ----------------------------------------------------
+    def fail_plugin_names(self) -> list:
+        """Decoder for attribution codes (`SolveResult.failed_plugin` /
+        `attribution_codes`): code 0 (and any negative code on a failed
+        pod) -> the built-in fit, code 1+i -> profile plugin i."""
+        return [BUILTIN_FIT] + [p.name for p in self.profile.plugins]
+
+    def attribution_codes(self, snap: ClusterSnapshot, indices):
+        """(len(indices),) int32 unschedulability attribution for the
+        `indices` pod rows against the CYCLE-INITIAL state — the batched
+        paths' reduction of the per-plugin PreFilter verdicts and Filter
+        masks they already evaluate (profile_batch_fn's per_pod pass
+        computes exactly these masks; this entry re-derives them through
+        the SAME shared helpers as the sequential scan so the two cannot
+        drift). Only the failed rows are evaluated — the working set is
+        (S, N) for S failures, never the (P, N) batch the streamed
+        pipeline exists to avoid — and the row index vector is padded to
+        a power-of-two bucket so jit retraces stay bounded.
+
+        Encoding matches `SolveResult.failed_plugin`, except -1 here means
+        "feasible cycle-initially": a failed pod with code -1 lost to
+        in-cycle capacity consumption and decodes to the built-in fit
+        (cycle.py maps code <= 0 -> built-in). For the sequential parity
+        path the in-solve codes (exact against the carried state) take
+        precedence; this entry is the fallback for solve paths without
+        one."""
+        import numpy as np
+
+        plugins = tuple(self.profile.plugins)
+        idx = np.asarray(indices, np.int32)
+        if idx.size == 0:
+            return np.zeros(0, np.int32)
+        bucket = 1 << int(idx.size - 1).bit_length()
+        idx_padded = np.full(bucket, idx[0], np.int32)
+        idx_padded[: idx.size] = idx
+        key = ("attribution",) + tuple(p.static_key() for p in plugins)
+        if key not in self._solve_cache:
+
+            def codes(snap, state0, auxes, idx):
+                for plugin, aux in zip(plugins, auxes):
+                    plugin.bind_aux(aux)
+                for plugin in plugins:
+                    plugin.bind_presolve(plugin.prepare_solve(snap))
+
+                def one(p):
+                    ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]
+                    ok, admit_code = _admit_with_attribution(
+                        plugins, state0, snap, p, ok0
+                    )
+                    fit0 = fits_one(
+                        snap.pods.req[p], state0.free, snap.nodes.mask
+                    )
+                    feasible, filter_code = _filter_with_attribution(
+                        plugins, state0, snap, p, fit0
+                    )
+                    return _encode_fail(
+                        ok0, admit_code, fit0.any(), filter_code,
+                        jnp.int32(-1),
+                    )
+
+                return jax.vmap(one)(idx)
+
+            self._solve_cache[key] = jax.jit(codes)
+        auxes = tuple(plugin.aux() for plugin in plugins)
+        out = self._solve_cache[key](
+            snap, self.initial_state(snap), auxes, jnp.asarray(idx_padded)
+        )
+        return np.asarray(out)[: idx.size]
 
     def initial_state(self, snap: ClusterSnapshot) -> SolverState:
         free = free_capacity(snap.nodes.alloc, snap.nodes.requested)

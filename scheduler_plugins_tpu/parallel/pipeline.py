@@ -28,10 +28,158 @@ daemon cycle loop (`framework.cycle.run_cycle(stream_chunk=...)`) via
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from scheduler_plugins_tpu.utils import observability as obs
+
+
+@dataclass
+class PipelineTimeline:
+    """Host-sync stamps of one `run_chunk_pipeline` run.
+
+    Every number here comes from HOST-observable boundaries — the async
+    dispatch returning, `jax.device_put` ENQUEUE (the host-side staging
+    cost; the transfer itself completes asynchronously and is only known
+    to be done when the next dispatch consumes the buffers) and
+    `jax.device_get` (D2H) actually completing — never from wall clocks
+    inside jit (CLAUDE.md; GL008). The "h2d" stamps therefore measure
+    host staging exposure, not wire time; only the D2H stamps are true
+    completion fences. With the lag-1 window the host observes chunk k's
+    completion only at its D2H, so per-chunk device busy time is NOT
+    directly observable;
+    `summary(solve_ms=...)` therefore takes a device-busy ESTIMATE the
+    caller derives from a synchronously-timed calibration solve scaled by
+    the per-chunk `collect_stats` wave counters (bench.north_star does
+    exactly this), and charges the remainder of the wall time as the
+    pipeline bubble.
+    """
+
+    n_chunks: int = 0
+    #: [{stage: dispatch|h2d|d2h, chunk, start_s, end_s}] on the caller's
+    #: clock (seconds); start_s/end_s are relative to nothing in
+    #: particular — only differences matter
+    events: list = field(default_factory=list)
+    start_s: float = 0.0
+    end_s: float = 0.0
+    #: tracer-clock ns at pipeline start when the tracer was enabled
+    #: (aligns replayed rows with live spans), else None
+    _anchor_ns: int | None = None
+
+    def open(self, start_s: float) -> None:
+        self.start_s = start_s
+        if obs.tracer.enabled:
+            self._anchor_ns = obs.tracer.now_ns()
+
+    def add(self, stage: str, chunk: int, start_s: float, end_s: float) -> None:
+        self.events.append(
+            {"stage": stage, "chunk": chunk,
+             "start_s": start_s, "end_s": end_s}
+        )
+
+    def close(self, end_s: float) -> None:
+        self.end_s = end_s
+
+    def stage_ms(self, stage: str) -> float:
+        return sum(
+            (e["end_s"] - e["start_s"]) * 1000.0
+            for e in self.events if e["stage"] == stage
+        )
+
+    @property
+    def elapsed_ms(self) -> float:
+        return (self.end_s - self.start_s) * 1000.0
+
+    def summary(self, solve_ms: float | None = None) -> dict:
+        """Pipeline-overlap report. `solve_ms` is the caller's estimate of
+        TOTAL device busy time (calibration solve x wave-counter scaling);
+        without it only the raw stage totals are reported.
+
+        - `pipeline_bubble_ms` = wall time the device was NOT solving
+          (elapsed - solve_ms, floored at 0): the un-overlapped remainder
+          the double buffering exists to eliminate.
+        - `overlap_efficiency` = solve_ms / elapsed (capped at 1): the
+          fraction of the wall clock the device was busy.
+        - per-stage `*_overlap_efficiency` = the fraction of that host
+          stage's time hidden behind device work, attributing the bubble
+          to host stages pro-rata by their time share (an estimate — the
+          lag-1 window cannot observe which stage exposed which gap, and
+          the h2d stage total is the ENQUEUE cost, not wire time: on an
+          async backend an exposed in-flight transfer shows up in the
+          bubble, not in `h2d_ms`).
+        """
+        h2d = self.stage_ms("h2d")
+        d2h = self.stage_ms("d2h")
+        dispatch = self.stage_ms("dispatch")
+        out = {
+            "elapsed_ms": round(self.elapsed_ms, 3),
+            "chunks": self.n_chunks,
+            "h2d_ms": round(h2d, 3),
+            "d2h_ms": round(d2h, 3),
+            "dispatch_ms": round(dispatch, 3),
+            "pipeline_bubble_ms": None,
+            "overlap_efficiency": None,
+            "h2d_overlap_efficiency": None,
+            "d2h_overlap_efficiency": None,
+        }
+        if solve_ms is None or self.elapsed_ms <= 0:
+            return out
+        bubble = max(0.0, self.elapsed_ms - solve_ms)
+        out["pipeline_bubble_ms"] = round(bubble, 3)
+        out["overlap_efficiency"] = round(
+            min(1.0, solve_ms / self.elapsed_ms), 4
+        )
+        host_total = h2d + d2h + dispatch
+        for key, stage_total in (("h2d_overlap_efficiency", h2d),
+                                 ("d2h_overlap_efficiency", d2h)):
+            if stage_total <= 0 or host_total <= 0:
+                out[key] = 1.0
+                continue
+            exposed = min(stage_total, bubble * stage_total / host_total)
+            out[key] = round(1.0 - exposed / stage_total, 4)
+        return out
+
+    def emit_trace(self, tracer=None) -> None:
+        """Replay the stamps as Perfetto rows: H2D/solve/D2H per buffer
+        (buffers alternate chunk parity under the double buffering). The
+        solve row for chunk k spans dispatch-return to D2H-complete — a
+        conservative envelope (the host cannot observe the device-side
+        start/finish tighter than its own sync points)."""
+        tracer = tracer or obs.tracer
+        if not tracer.enabled or self._anchor_ns is None:
+            return
+
+        def ns(t_s: float) -> int:
+            return self._anchor_ns + int((t_s - self.start_s) * 1e9)
+
+        dispatch_end = {}
+        d2h_end = {}
+        for e in self.events:
+            if e["stage"] == "dispatch":
+                dispatch_end[e["chunk"]] = e["end_s"]
+            elif e["stage"] == "d2h":
+                d2h_end[e["chunk"]] = e["end_s"]
+            tracer.complete(
+                f'{e["stage"]} chunk {e["chunk"]}',
+                ns(e["start_s"]),
+                int((e["end_s"] - e["start_s"]) * 1e9),
+                tid=f'pipeline/{e["stage"]}/buf{e["chunk"] % 2}',
+                args={"chunk": e["chunk"]},
+            )
+        for k, disp_end in sorted(dispatch_end.items()):
+            end = d2h_end.get(k)
+            if end is None:
+                continue
+            tracer.complete(
+                f"solve chunk {k}",
+                ns(disp_end),
+                int((end - disp_end) * 1e9),
+                tid=f"pipeline/solve/buf{k % 2}",
+                args={"chunk": k, "envelope": "dispatch->d2h (conservative)"},
+            )
 
 
 def donated_chunk_solver(fn, carry_argnum: int):
@@ -66,33 +214,56 @@ def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
     - ``clock``: optional ``time.perf_counter``-like callable for the
       completion stamps (injectable for tests).
 
-    Returns ``(results, carry, done_s)`` where ``results[k]`` is chunk k's
-    `result` pytree fetched to host and ``done_s[k]`` its completion time
-    (seconds since the pipeline started) — the per-chunk decision-latency
-    stamps the north-star p50/p99 derive from. Completion of chunk k is
-    observed one dispatch later (lag-1), so the stamps are conservative by
-    at most one dispatch overhead, never optimistic.
+    Returns ``(results, carry, done_s, timeline)`` where ``results[k]`` is
+    chunk k's `result` pytree fetched to host and ``done_s[k]`` its
+    completion time (seconds since the pipeline started) — the per-chunk
+    decision-latency stamps the north-star p50/p99 derive from. Completion
+    of chunk k is observed one dispatch later (lag-1), so the stamps are
+    conservative by at most one dispatch overhead, never optimistic.
+    ``timeline`` is a `PipelineTimeline` of the host-sync stamps (dispatch,
+    H2D, D2H per chunk): `timeline.summary(solve_ms=...)` computes the
+    `pipeline_bubble_ms` / overlap-efficiency metrics, and when the global
+    tracer is enabled the stamps are replayed as Perfetto H2D/solve/D2H
+    rows per buffer automatically.
     """
     clock = clock or time.perf_counter
     n = len(chunk_inputs)
     results, done_s = [], []
+    timeline = PipelineTimeline(n_chunks=n)
     start = clock()
+    timeline.open(start)
     pending = None
-    dev = tuple(jax.device_put(a) for a in chunk_inputs[0]) if n else ()
+    dev = ()
+    if n:
+        t0 = clock()
+        dev = tuple(jax.device_put(a) for a in chunk_inputs[0])
+        timeline.add("h2d", 0, t0, clock())
     for k in range(n):
+        t0 = clock()
         result, carry = solve_chunk(*invariant_args, *dev, carry)
+        timeline.add("dispatch", k, t0, clock())
         if k + 1 < n:
             # H2D for chunk k+1 overlaps solve(k)
+            t0 = clock()
             dev = tuple(jax.device_put(a) for a in chunk_inputs[k + 1])
+            timeline.add("h2d", k + 1, t0, clock())
         if pending is not None:
             # D2H for chunk k-1: blocks only until ITS solve finished
+            t0 = clock()
             results.append(jax.device_get(pending))
-            done_s.append(clock() - start)
+            t1 = clock()
+            timeline.add("d2h", k - 1, t0, t1)
+            done_s.append(t1 - start)
         pending = result
     if pending is not None:
+        t0 = clock()
         results.append(jax.device_get(pending))
-        done_s.append(clock() - start)
-    return results, carry, done_s
+        t1 = clock()
+        timeline.add("d2h", n - 1, t0, t1)
+        done_s.append(t1 - start)
+    timeline.close(clock())
+    timeline.emit_trace()
+    return results, carry, done_s, timeline
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +341,7 @@ def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
         (snap.pods.req[lo:lo + chunk], admitted[lo:lo + chunk])
         for lo in range(0, P, chunk)
     ]
-    parts, free, _ = run_chunk_pipeline(
+    parts, free, _, _ = run_chunk_pipeline(
         cache[ckey], (raw,), chunk_inputs, free0
     )
     assignment = jnp.concatenate([jnp.asarray(a) for a in parts])

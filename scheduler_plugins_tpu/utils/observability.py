@@ -1,20 +1,35 @@
-"""Observability: leveled flow-correlated logging and scheduling metrics.
+"""Observability: flow logging, metrics with histograms, and a cycle tracer.
 
 Mirrors the reference's observability surface (SURVEY.md §5):
 - contextual leveled logging with FlowBegin/FlowEnd markers, subsystem names
   and a cache GENERATION attached to every line so a scheduling decision can
   be cross-correlated with the resync that produced its data
   (/root/reference/pkg/noderesourcetopology/logging/logging.go:30-56);
-- prometheus-style counters the reference increments (preemption attempts,
-  scheduling cycle stats; cmd/scheduler/main.go:23-24,
-  capacity_scheduling.go:333).
+- prometheus-style counters AND fixed-bucket histograms the reference
+  registers (plugin execution latency per extension point, unschedulable
+  attribution; cmd/scheduler/main.go:23-24, capacity_scheduling.go:333 and
+  the upstream framework's `plugin_execution_duration_seconds` /
+  `UnschedulablePlugins` shape), rendered in prometheus text format by
+  `Metrics.prometheus_text` (the daemon's `/metrics`);
+- a `Tracer` recording host-side spans as Chrome-trace-event / Perfetto
+  JSON ("traceEvents" with X complete events + M thread-name metadata), so
+  one scheduling cycle or one chunk-pipeline run loads as a timeline in
+  ui.perfetto.dev. Device-side numbers always come from host-transfer
+  timestamps — never wall clocks inside jit-traced code (CLAUDE.md; lint
+  rule GL008 enforces this).
+
+Everything here is host-side and must stay cheap: the tracer is OFF by
+default and its disabled spans short-circuit before taking any timestamp.
 """
 
 from __future__ import annotations
 
+import bisect
+import json
 import logging
+import os
+import threading
 import time
-from collections import Counter
 from contextlib import contextmanager
 
 logger = logging.getLogger("scheduler_plugins_tpu")
@@ -22,35 +37,163 @@ logger = logging.getLogger("scheduler_plugins_tpu")
 FLOW_BEGIN = "FlowBegin"
 FLOW_END = "FlowEnd"
 
+#: fixed histogram buckets in milliseconds (upper bounds; +Inf implicit) —
+#: the upstream scheduler-latency bucket ladder, in ms instead of seconds
+HIST_BUCKETS_MS = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
 
-class Metrics:
-    """Process-wide scheduling counters (the scheduler_perf surface)."""
+
+def _label_items(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _escape_label(value) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _render_labels(items) -> str:
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+class _Histogram:
+    __slots__ = ("counts", "sum", "count", "max")
 
     def __init__(self):
-        self._counts: Counter[str] = Counter()
+        self.counts = [0] * (len(HIST_BUCKETS_MS) + 1)  # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self.max = 0.0
 
-    def inc(self, name: str, value: int = 1) -> None:
-        self._counts[name] += value
+    def observe(self, ms: float) -> None:
+        self.counts[bisect.bisect_left(HIST_BUCKETS_MS, ms)] += 1
+        self.sum += ms
+        self.count += 1
+        if ms > self.max:
+            self.max = ms
 
-    def observe_ms(self, name: str, ms: float) -> None:
-        """Duration observation -> `<name>_ms_total` / `<name>_count` /
-        `<name>_ms_max` counters (the prometheus summary shape without
-        quantile sketches — enough for rate() and mean/max panels)."""
-        ms_int = int(ms)
-        self._counts[f"{name}_ms_total"] += ms_int
-        self._counts[f"{name}_count"] += 1
-        key = f"{name}_ms_max"
-        if ms_int > self._counts[key]:
-            self._counts[key] = ms_int
 
-    def get(self, name: str) -> int:
-        return self._counts[name]
+class Metrics:
+    """Process-wide scheduling counters + histograms (the scheduler_perf
+    surface). Counters and histograms accept prometheus-style labels as
+    keyword args: `metrics.inc(UNSCHEDULABLE_BY_PLUGIN, plugin="Coscheduling")`.
+
+    `observe_ms` keeps the legacy `<name>_ms_total` / `<name>_count` /
+    `<name>_ms_max` counter keys for UNLABELED names (existing tests and
+    panels read them) while also feeding a fixed-bucket histogram
+    (`HIST_BUCKETS_MS`) that `prometheus_text` renders as
+    `_bucket{le=...}` / `_sum` / `_count` series."""
+
+    def __init__(self):
+        # (name, sorted label items) -> value; single source of truth
+        self._counters: dict[tuple[str, tuple], int] = {}
+        self._hists: dict[tuple[str, tuple], _Histogram] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, value: int = 1, **labels) -> None:
+        key = (name, _label_items(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def _set_max(self, name: str, value: int, items: tuple = ()) -> None:
+        key = (name, items)
+        if value > self._counters.get(key, 0):
+            self._counters[key] = value
+
+    def observe_ms(self, name: str, ms: float, **labels) -> None:
+        """Duration observation: fixed-bucket histogram plus (for unlabeled
+        names) the legacy `_ms_total`/`_count`/`_ms_max` summary counters."""
+        items = _label_items(labels)
+        key = (name, items)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram()
+            hist.observe(ms)
+            if not items:
+                ms_int = int(ms)
+                self._counters[(f"{name}_ms_total", ())] = (
+                    self._counters.get((f"{name}_ms_total", ()), 0) + ms_int
+                )
+                self._counters[(f"{name}_count", ())] = (
+                    self._counters.get((f"{name}_count", ()), 0) + 1
+                )
+                self._set_max(f"{name}_ms_max", ms_int)
+
+    def get(self, name: str, **labels) -> int:
+        return self._counters.get((name, _label_items(labels)), 0)
 
     def snapshot(self) -> dict[str, int]:
-        return dict(self._counts)
+        """Flat debug map: rendered `name{k="v"}` keys -> counter values."""
+        with self._lock:
+            return {
+                f"{name}{_render_labels(items)}": value
+                for (name, items), value in self._counters.items()
+            }
+
+    def histograms(self) -> dict[str, dict]:
+        """Rendered-key -> {buckets, counts, sum, count, max} debug view."""
+        with self._lock:
+            return {
+                f"{name}{_render_labels(items)}": {
+                    "buckets": list(HIST_BUCKETS_MS),
+                    "counts": list(h.counts),
+                    "sum": h.sum,
+                    "count": h.count,
+                    "max": h.max,
+                }
+                for (name, items), h in self._hists.items()
+            }
 
     def reset(self) -> None:
-        self._counts.clear()
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4: counters as counters,
+        histograms as cumulative `_bucket{le=...}` + `_sum` + `_count`.
+        The legacy `<name>_count` summary counter `observe_ms` keeps for
+        unlabeled names is the SAME sample the histogram's `_count` child
+        renders — it is skipped here (the JSON snapshot still carries it)
+        so a scrape never contains duplicate samples."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            hists = sorted(self._hists.items(), key=lambda kv: kv[0])
+        hist_count_names = {f"{name}_count" for (name, _), _h in hists}
+        lines: list[str] = []
+        typed: set[str] = set()
+        for (name, items), value in counters:
+            if name in hist_count_names:
+                continue  # rendered as the histogram's _count child below
+            if name not in typed:
+                typed.add(name)
+                kind = "counter" if name.endswith(("_total", "_count")) else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name}{_render_labels(items)} {value}")
+        for (name, items), hist in hists:
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} histogram")
+            cumulative = 0
+            for bound, count in zip(HIST_BUCKETS_MS, hist.counts):
+                cumulative += count
+                le = _render_labels(items + (("le", f"{bound:g}"),))
+                lines.append(f"{name}_bucket{le} {cumulative}")
+            le = _render_labels(items + (("le", "+Inf"),))
+            lines.append(f"{name}_bucket{le} {hist.count}")
+            lines.append(f"{name}_sum{_render_labels(items)} {hist.sum:g}")
+            lines.append(f"{name}_count{_render_labels(items)} {hist.count}")
+        return "\n".join(lines) + "\n"
 
 
 #: global registry, like the upstream prometheus default registry
@@ -64,21 +207,174 @@ PREEMPTION_ATTEMPTS = "scheduler_preemption_attempts_total"
 PREEMPTION_VICTIMS = "scheduler_preemption_victims_total"
 GANG_REJECTIONS = "scheduler_gang_rejections_total"
 CACHE_RESYNC_FLUSHES = "scheduler_nrt_cache_flushes_total"
+#: per-plugin attribution (labels: plugin) — the upstream
+#: `UnschedulablePlugins` signal: which plugin made each pod unschedulable
+UNSCHEDULABLE_BY_PLUGIN = "scheduler_unschedulable_by_plugin_total"
+#: per-plugin, per-extension-point latency histogram (labels: plugin,
+#: extension_point) — the upstream plugin_execution_duration_seconds shape
+PLUGIN_EXECUTION = "scheduler_plugin_execution_ms"
+
+
+# ---------------------------------------------------------------------------
+# Tracer: Chrome-trace-event / Perfetto JSON spans
+# ---------------------------------------------------------------------------
+
+
+class Tracer:
+    """Host-side span recorder exporting Chrome trace-event JSON (the
+    "traceEvents" array Perfetto and chrome://tracing load).
+
+    - Spans are complete "X" events: name, pid, tid, ts/dur in MICROSECONDS
+      (trace-event convention) derived from `time.perf_counter_ns` relative
+      to `start()`.
+    - tids are logical row names ("cycle", "pipeline/h2d/buf0", ...) mapped
+      to small ints, with "M" thread_name metadata events naming each row.
+    - OFF by default; `span()` short-circuits to a no-op context (no clock
+      read, no allocation beyond the generator frame) when disabled, so
+      always-instrumented code paths stay within the ≤2% overhead budget.
+    - Device work is NEVER timed from inside jit: spans bracket host-sync
+      points — dispatch returns, `device_put` enqueues (host staging cost;
+      the transfer itself is async), and `device_get`/`np.asarray`
+      completion fences — the only honest clocks through the tunneled TPU
+      backend (CLAUDE.md; GL004/GL008).
+    """
+
+    def __init__(self):
+        self._enabled = False
+        self._events: list[dict] = []
+        self._tids: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._origin_ns = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def start(self, clear: bool = True) -> None:
+        with self._lock:
+            if clear:
+                self._events.clear()
+                self._tids.clear()
+            self._origin_ns = time.perf_counter_ns()
+            self._enabled = True
+
+    def stop(self) -> None:
+        self._enabled = False
+
+    def now_ns(self) -> int:
+        """Current timestamp on the tracer clock (ns since `start()`)."""
+        return time.perf_counter_ns() - self._origin_ns
+
+    def _tid(self, name: str) -> int:
+        tid = self._tids.get(name)
+        if tid is None:
+            tid = self._tids[name] = len(self._tids) + 1
+        return tid
+
+    def complete(self, name: str, start_ns: int, dur_ns: int,
+                 tid: str = "host", args: dict | None = None) -> None:
+        """Record one complete ("X") event from explicit tracer-clock
+        stamps (ns since `start()`), e.g. replayed pipeline timelines."""
+        if not self._enabled:
+            return
+        event = {
+            "name": name,
+            "ph": "X",
+            "ts": start_ns / 1000.0,
+            "dur": max(dur_ns, 0) / 1000.0,
+            "pid": os.getpid(),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            event["tid"] = self._tid(tid)
+            self._events.append(event)
+
+    @contextmanager
+    def span(self, name: str, tid: str = "host", **args):
+        if not self._enabled:
+            yield
+            return
+        start_ns = self.now_ns()
+        try:
+            yield
+        finally:
+            self.complete(
+                name, start_ns, self.now_ns() - start_ns, tid=tid,
+                args=args or None,
+            )
+
+    def export(self) -> dict:
+        """{"traceEvents": [...]} — X spans plus M thread_name metadata."""
+        with self._lock:
+            events = list(self._events)
+            tids = dict(self._tids)
+        pid = os.getpid()
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": row},
+            }
+            for row, tid in sorted(tids.items(), key=lambda kv: kv[1])
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.export(), f)
+
+
+#: global tracer, off by default (`bench.py --trace out.json` and
+#: `tools/trace_smoke.py` turn it on around their runs)
+tracer = Tracer()
+
+
+@contextmanager
+def extension_span(extension_point: str, plugin: str, **args):
+    """One extension-point execution: a tracer span on the "framework" row
+    plus a `scheduler_plugin_execution_ms{plugin,extension_point}` histogram
+    observation — the upstream per-plugin, per-extension-point latency
+    metric (frameworkruntime plugin_execution_duration_seconds)."""
+    with tracer.span(
+        f"{extension_point}/{plugin}", tid="framework", **args
+    ):
+        start = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            metrics.observe_ms(
+                PLUGIN_EXECUTION,
+                (time.perf_counter_ns() - start) / 1e6,
+                plugin=plugin,
+                extension_point=extension_point,
+            )
 
 
 @contextmanager
 def flow(subsystem: str, generation: int | None = None, **ctx):
     """Flow-correlated log span: emits FlowBegin/FlowEnd with the subsystem,
-    optional cache generation and contextual key/values, plus duration."""
+    optional cache generation and contextual key/values, plus duration.
+    An exception inside the span marks the FlowEnd line `status=error
+    error=<ExceptionType>` (and re-raises) so a failed flow is
+    distinguishable from a completed one in the log stream."""
     fields = " ".join(f"{k}={v}" for k, v in ctx.items())
     gen = f" generation={generation}" if generation is not None else ""
     logger.debug("%s subsystem=%s%s %s", FLOW_BEGIN, subsystem, gen, fields)
     start = time.perf_counter()
     try:
         yield
-    finally:
+    except BaseException as exc:
         logger.debug(
-            "%s subsystem=%s%s %s durationMs=%.2f",
-            FLOW_END, subsystem, gen, fields,
+            "%s subsystem=%s%s %s status=error error=%s durationMs=%.2f",
+            FLOW_END, subsystem, gen, fields, type(exc).__name__,
             (time.perf_counter() - start) * 1000,
         )
+        raise
+    logger.debug(
+        "%s subsystem=%s%s %s status=ok durationMs=%.2f",
+        FLOW_END, subsystem, gen, fields,
+        (time.perf_counter() - start) * 1000,
+    )

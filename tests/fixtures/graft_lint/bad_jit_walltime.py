@@ -1,0 +1,43 @@
+"""Golden-bad fixture for GL008: wall-clock reads inside jit-traced
+functions. The timestamps are trace-time constants — the compiled program
+re-runs with the clock value baked in, measuring nothing."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def solve_chunk(req, free):
+    start = time.perf_counter()  # GL008: baked at trace time
+    assignment = jnp.argmax(free - req, axis=0)
+    elapsed = time.perf_counter() - start  # GL008
+    return assignment, elapsed
+
+
+solve = jax.jit(solve_chunk)
+
+
+@jax.jit
+def decorated_step(x):
+    return x * time.time()  # GL008: decorator form
+
+
+def outer_traced(x):
+    def inner():
+        return time.monotonic()  # GL008: nested scope traces too
+
+    return x + inner()
+
+
+stepped = jax.jit(outer_traced)
+
+
+def host_side_timing(fn, args):
+    # NOT flagged: this function is never jit-traced — host-side wall
+    # clocks around a host-sync transfer are the sanctioned idiom
+    import numpy as np
+
+    start = time.perf_counter()
+    np.asarray(fn(*args))
+    return time.perf_counter() - start

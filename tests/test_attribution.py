@@ -1,0 +1,296 @@
+"""Per-plugin unschedulability attribution (`CycleReport.failed_by`, the
+upstream UnschedulablePlugins signal) — a decision table covering EVERY
+plugin with a Filter plus the built-in fit and a PreFilter rejection, each
+asserting (a) the sequential parity path names the responsible plugin and
+(b) the batched reduction (`Scheduler.attribution_codes`, what streamed /
+batched solves use) decodes to the same name."""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import (
+    AppGroup,
+    AppGroupDependency,
+    AppGroupWorkload,
+    Container,
+    LabelSelector,
+    NetworkTopology,
+    Node,
+    NodeResourceTopology,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NUMAZone,
+    Pod,
+    PodGroup,
+    PodAffinityTerm,
+    Taint,
+    TopologyManagerPolicy,
+    TopologyManagerScope,
+    TopologySpreadConstraint,
+    APP_GROUP_LABEL,
+    POD_GROUP_LABEL,
+    REGION_LABEL,
+    WORKLOAD_SELECTOR_LABEL,
+    ZONE_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.framework.runtime import BUILTIN_FIT
+from scheduler_plugins_tpu.plugins import (
+    Coscheduling,
+    InterPodAffinity,
+    NetworkOverhead,
+    NodeAffinity,
+    NodeResourcesAllocatable,
+    NodeResourceTopologyMatch,
+    PodTopologySpread,
+    TaintToleration,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+
+gib = 1 << 30
+ZONE = "topology.kubernetes.io/zone"
+
+
+def mknode(name, labels=None, taints=None, cpu=8000):
+    return Node(
+        name=name,
+        allocatable={CPU: cpu, MEMORY: 32 * gib, PODS: 110},
+        labels=labels or {},
+        taints=taints or [],
+    )
+
+
+def mkpod(name, cpu=100, **kw):
+    return Pod(
+        name=name,
+        containers=[Container(requests={CPU: cpu, MEMORY: gib})],
+        **kw,
+    )
+
+
+def _node_affinity_case():
+    c = Cluster()
+    c.add_node(mknode("a", {"disk": "hdd"}))
+    c.add_pod(mkpod("p", node_selector={"disk": "ssd"}))
+    plugins = [NodeResourcesAllocatable(), NodeAffinity(), TaintToleration()]
+    return c, plugins, "default/p", "NodeAffinity"
+
+
+def _taint_case():
+    c = Cluster()
+    c.add_node(mknode("a", taints=[Taint(key="dedicated", value="gpu")]))
+    c.add_pod(mkpod("p"))
+    plugins = [NodeResourcesAllocatable(), NodeAffinity(), TaintToleration()]
+    return c, plugins, "default/p", "TaintToleration"
+
+
+def _spread_case():
+    # both schedulable nodes sit in z-a holding 2 matching pods; the empty
+    # z-b domain (its node cordoned) pins the global min at 0, so maxSkew 1
+    # blocks z-a — PodTopologySpread empties the feasible set
+    c = Cluster()
+    c.add_node(mknode("n0", {ZONE: "z-a"}))
+    c.add_node(mknode("n1", {ZONE: "z-a"}))
+    blocked = mknode("n2", {ZONE: "z-b"})
+    blocked.unschedulable = True
+    c.add_node(blocked)
+    for i in range(2):
+        existing = Pod(name=f"e{i}", labels={"app": "web"},
+                       containers=[Container(requests={CPU: 100})])
+        existing.node_name = "n0"
+        c.add_pod(existing)
+    c.add_pod(Pod(
+        name="p", labels={"app": "web"},
+        containers=[Container(requests={CPU: 100, MEMORY: gib})],
+        topology_spread=[TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )],
+    ))
+    plugins = [NodeResourcesAllocatable(), PodTopologySpread()]
+    return c, plugins, "default/p", "PodTopologySpread"
+
+
+def _inter_pod_affinity_case():
+    # required affinity toward app=db with no db pod anywhere (and no
+    # self-match): InterPodAffinity filters every node
+    c = Cluster()
+    c.add_node(mknode("n0", {ZONE: "z-a"}))
+    c.add_node(mknode("n1", {ZONE: "z-b"}))
+    c.add_pod(Pod(
+        name="web", labels={"app": "web"},
+        containers=[Container(requests={CPU: 100})],
+        pod_affinity_required=[PodAffinityTerm(
+            topology_key=ZONE,
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+        )],
+    ))
+    plugins = [NodeResourcesAllocatable(), InterPodAffinity()]
+    return c, plugins, "default/web", "InterPodAffinity"
+
+
+def _network_case():
+    # the only uncordoned node violates the dependency's maxNetworkCost
+    def net_node(name, region, zone):
+        return Node(
+            name=name,
+            allocatable={CPU: 10_000, MEMORY: 32 * gib, PODS: 110},
+            labels={REGION_LABEL: region, ZONE_LABEL: zone},
+        )
+
+    c = Cluster()
+    c.add_node(net_node("na1", "r-a", "z-a1"))
+    c.add_node(net_node("nb1", "r-b", "z-b1"))
+    c.nodes["na1"].unschedulable = True
+    c.add_app_group(AppGroup(
+        name="ag",
+        workloads=[
+            AppGroupWorkload(selector="db"),
+            AppGroupWorkload(selector="web", dependencies=[
+                AppGroupDependency(workload_selector="db",
+                                   max_network_cost=5),
+            ]),
+        ],
+        topology_order={"db": 1, "web": 2},
+    ))
+    c.add_network_topology(NetworkTopology(weights={
+        "UserDefined": {
+            "region": {("r-a", "r-b"): 50, ("r-b", "r-a"): 50},
+        }
+    }))
+    db = Pod(name="db-0", containers=[Container(requests={CPU: 100})],
+             labels={APP_GROUP_LABEL: "ag", WORKLOAD_SELECTOR_LABEL: "db"})
+    db.node_name = "na1"
+    c.add_pod(db)
+    c.add_pod(Pod(
+        name="web-0", containers=[Container(requests={CPU: 100})],
+        labels={APP_GROUP_LABEL: "ag", WORKLOAD_SELECTOR_LABEL: "web"},
+    ))
+    plugins = [NetworkOverhead()]
+    return c, plugins, "default/web-0", "NetworkOverhead"
+
+
+def _numa_case():
+    # 5 cores fit the node total but no single NUMA zone: the topology
+    # match filter rejects while the built-in fit passes
+    c = Cluster()
+    c.add_node(Node(name="n0", allocatable={CPU: 8000, MEMORY: 32 * gib,
+                                            PODS: 110}))
+    c.add_nrt(NodeResourceTopology(
+        node_name="n0",
+        zones=[
+            NUMAZone(numa_id=i,
+                     available={CPU: 4000, MEMORY: 16 * gib},
+                     costs={0: 10 if i == 0 else 20,
+                            1: 10 if i == 1 else 20})
+            for i in range(2)
+        ],
+        policy=TopologyManagerPolicy.SINGLE_NUMA_NODE,
+        scope=TopologyManagerScope.CONTAINER,
+    ))
+    c.add_pod(Pod(name="p", containers=[Container(
+        requests={CPU: 5000, MEMORY: 8 * gib},
+        limits={CPU: 5000, MEMORY: 8 * gib},
+    )]))
+    plugins = [NodeResourceTopologyMatch()]
+    return c, plugins, "default/p", "NodeResourceTopologyMatch"
+
+
+def _builtin_fit_case():
+    c = Cluster()
+    c.add_node(mknode("a"))
+    c.add_pod(mkpod("huge", cpu=99_000))
+    plugins = [NodeResourcesAllocatable()]
+    return c, plugins, "default/huge", BUILTIN_FIT
+
+
+def _coscheduling_prefilter_case():
+    # gang of minMember 3 with a single member present: Coscheduling's
+    # PreFilter (membership sweep) rejects before any node is considered
+    c = Cluster()
+    c.add_node(mknode("a"))
+    c.add_pod_group(PodGroup(name="g", namespace="default", min_member=3,
+                             creation_ms=0))
+    c.add_pod(mkpod("p", labels={POD_GROUP_LABEL: "g"}))
+    plugins = [NodeResourcesAllocatable(), Coscheduling()]
+    return c, plugins, "default/p", "Coscheduling"
+
+
+CASES = [
+    _node_affinity_case,
+    _taint_case,
+    _spread_case,
+    _inter_pod_affinity_case,
+    _network_case,
+    _numa_case,
+    _builtin_fit_case,
+    _coscheduling_prefilter_case,
+]
+
+
+class TestFailedByDecisionTable:
+    @pytest.mark.parametrize("case", CASES, ids=lambda c: c.__name__)
+    def test_sequential_cycle_names_responsible_plugin(self, case):
+        cluster, plugins, uid, expected = case()
+        report = run_cycle(Scheduler(Profile(plugins=plugins)), cluster,
+                           now=1000)
+        assert uid in report.failed
+        assert report.failed_by[uid] == expected
+
+    @pytest.mark.parametrize("case", CASES, ids=lambda c: c.__name__)
+    def test_batched_reduction_matches_sequential(self, case):
+        # the batched/streamed attribution (cycle-initial per-plugin mask
+        # reduction) must decode to the same plugin the sequential parity
+        # path's in-solve codes name
+        cluster, plugins, uid, expected = case()
+        sched = Scheduler(Profile(plugins=plugins))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=1000)
+        sched.prepare(meta, cluster)
+        seq_codes = np.asarray(sched.solve(snap).failed_plugin)
+        names = sched.fail_plugin_names()
+        uid_idx = next(
+            i for i, p in enumerate(pending)
+            if f"{p.namespace}/{p.name}" == uid
+        )
+        red_codes = sched.attribution_codes(snap, [uid_idx])
+        assert red_codes.shape == (1,)  # failed rows only, unpadded
+        assert seq_codes[uid_idx] >= 0  # the pod failed in the scan
+        decode = lambda code: names[code] if code > 0 else names[0]
+        assert decode(int(seq_codes[uid_idx])) == expected
+        assert decode(int(red_codes[0])) == expected
+
+    def test_placed_pods_carry_no_attribution(self):
+        cluster, plugins, uid, _ = _builtin_fit_case()
+        cluster.add_pod(mkpod("fits", cpu=100))
+        report = run_cycle(Scheduler(Profile(plugins=plugins)), cluster,
+                           now=1000)
+        assert "default/fits" in report.bound
+        assert "default/fits" not in report.failed_by
+        assert set(report.failed_by) == {uid}
+
+    def test_metrics_counter_populated(self):
+        from scheduler_plugins_tpu.utils import observability as obs
+
+        obs.metrics.reset()
+        cluster, plugins, uid, expected = _taint_case()
+        run_cycle(Scheduler(Profile(plugins=plugins)), cluster, now=1000)
+        assert obs.metrics.get(obs.UNSCHEDULABLE_BY_PLUGIN,
+                               plugin=expected) == 1
+
+    def test_streamed_cycle_attributes_failures(self):
+        # the streamed chunk-pipeline solve returns no per-pod codes; the
+        # cycle must fall back to the batched reduction
+        c = Cluster()
+        for i in range(4):
+            c.add_node(mknode(f"n{i}"))
+        for p in range(7):
+            c.add_pod(mkpod(f"p{p}", cpu=100))
+        c.add_pod(mkpod("huge", cpu=99_000))
+        report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])), c,
+            now=1000, stream_chunk=4,
+        )
+        assert report.failed_by["default/huge"] == BUILTIN_FIT

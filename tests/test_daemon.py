@@ -73,7 +73,11 @@ class TestDaemonE2E:
                 [_node("n0", cpu="4", rv=1), _node("n1", cpu="4", rv=1)],
                 rv=2)
             srv.lists["/api/v1/pods"] = _listing(
-                "PodList", [_pod("a", cpu="500m", rv=3)], rv=3)
+                "PodList",
+                # "huge" can never fit: populates the per-plugin
+                # unschedulable attribution counter on /metrics
+                [_pod("a", cpu="500m", rv=3), _pod("huge", cpu="99", rv=3)],
+                rv=3)
             # a second pod arrives over the WATCH after bootstrap
             srv.watch_scripts["/api/v1/pods"] = [
                 [("event", {"type": "ADDED",
@@ -110,8 +114,38 @@ class TestDaemonE2E:
                 health = json.loads(urllib.request.urlopen(
                     health_url, timeout=5).read())
                 assert health["ok"] and health["bound_total"] >= 2
+                # /metrics speaks prometheus text format 0.0.4 with real
+                # histogram buckets and per-plugin attribution
+                resp = urllib.request.urlopen(
+                    health_url.replace("/healthz", "/metrics"), timeout=5)
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+                samples = {}
+                for line in text.splitlines():
+                    if line.startswith("#") or not line.strip():
+                        continue
+                    key, _, value = line.rpartition(" ")
+                    samples[key] = float(value)
+                assert samples["scheduler_pods_bound_total"] >= 2
+                assert samples["scheduler_pods_unschedulable_total"] >= 1
+                # which plugin made the pod unschedulable (the upstream
+                # UnschedulablePlugins signal; built-in fit here)
+                assert samples[
+                    'scheduler_unschedulable_by_plugin_total'
+                    '{plugin="NodeResourcesFit"}'
+                ] >= 1
+                # cycle latency is a real fixed-bucket histogram
+                assert samples['scheduler_cycle_bucket{le="+Inf"}'] >= 1
+                assert "scheduler_cycle_sum" in samples
+                assert "# TYPE scheduler_cycle histogram" in text
+                # per-plugin, per-extension-point execution histograms
+                assert any(
+                    k.startswith("scheduler_plugin_execution_ms_bucket")
+                    for k in samples
+                )
+                # the flat JSON snapshot moved to /metrics.json (legacy keys)
                 metrics = json.loads(urllib.request.urlopen(
-                    health_url.replace("/healthz", "/metrics"),
+                    health_url.replace("/healthz", "/metrics.json"),
                     timeout=5).read())
                 assert metrics.get("scheduler_pods_bound_total", 0) >= 2
                 # cycle-latency summary counters (ops surface)

@@ -27,10 +27,20 @@ class TestGoldenBad:
             ("bad_block_timing.py", "GL004"),
             ("bad_donated_reuse.py", "GL006"),
             ("bad_config_update.py", "GL007"),
+            ("bad_jit_walltime.py", "GL008"),
         ],
     )
     def test_flagged(self, fixture, rule):
         assert rule in rules_for(FIXTURES / fixture)
+
+    def test_jit_walltime_fixture_flags_all_traced_sites(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_jit_walltime.py"])
+            if f.rule == "GL008"
+        ]
+        # two in solve_chunk, one decorated, one in the nested scope — the
+        # host-side timing helper stays clean
+        assert len(findings) == 4
 
     def test_config_update_fixture_flags_both_spellings(self):
         findings = [
@@ -138,6 +148,67 @@ class TestConfig:
 
             def merge(extra):
                 config.update(extra)
+        """))
+        assert lint_paths([f]) == []
+
+
+class TestJitWalltime:
+    """GL008: wall clocks only fire inside provably jit-traced scopes."""
+
+    def test_donated_chunk_solver_arg_flagged(self, tmp_path):
+        f = tmp_path / "chunk_clock.py"
+        f.write_text(textwrap.dedent("""\
+            import time
+
+            from scheduler_plugins_tpu.parallel.pipeline import (
+                donated_chunk_solver,
+            )
+
+            def body(raw, req, free):
+                t = time.perf_counter_ns()
+                return req + t, free
+
+            solve = donated_chunk_solver(body, carry_argnum=2)
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL008"}
+
+    def test_plugin_tensor_method_flagged(self, tmp_path):
+        f = tmp_path / "plugin_clock.py"
+        f.write_text(textwrap.dedent("""\
+            import time
+
+            from scheduler_plugins_tpu.framework.plugin import Plugin
+
+            class ClockPlugin(Plugin):
+                def score(self, state, snap, p):
+                    return state.free[:, 0] + int(time.time())
+        """))
+        assert {x.rule for x in lint_paths([f])} == {"GL008"}
+
+    def test_host_function_not_flagged(self, tmp_path):
+        # an un-jitted function reading the clock is the sanctioned
+        # host-transfer timing idiom, not a finding
+        f = tmp_path / "host_clock.py"
+        f.write_text(textwrap.dedent("""\
+            import time
+
+            def timed(fn, x):
+                start = time.perf_counter()
+                out = fn(x)
+                return out, time.perf_counter() - start
+        """))
+        assert lint_paths([f]) == []
+
+    def test_suppression_comment(self, tmp_path):
+        f = tmp_path / "supp_clock.py"
+        f.write_text(textwrap.dedent("""\
+            import time
+
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x + time.time()  # graft-lint: ignore[GL008]
         """))
         assert lint_paths([f]) == []
 

@@ -1,6 +1,11 @@
-"""Observability tests: metrics counters and flow-correlated logging."""
+"""Observability tests: metrics counters + histograms, prometheus text
+exposition, flow-correlated logging, and the cycle tracer (span pairing,
+Perfetto-loadable export, per-tid monotonicity)."""
 
+import json
 import logging
+
+import pytest
 
 from scheduler_plugins_tpu.api.objects import Container, Node, Pod
 from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
@@ -8,8 +13,15 @@ from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
 from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
 from scheduler_plugins_tpu.state.cluster import Cluster
 from scheduler_plugins_tpu.utils import observability as obs
+from tools.trace_smoke import validate_trace
 
 gib = 1 << 30
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off():
+    yield
+    obs.tracer.stop()
 
 
 class TestMetrics:
@@ -33,3 +45,186 @@ class TestMetrics:
         text = caplog.text
         assert "FlowBegin" in text and "FlowEnd" in text
         assert "generation=7" in text and "durationMs" in text
+        assert "status=ok" in text
+
+    def test_flow_failure_marked_on_flow_end(self, caplog):
+        # an exception inside the span must NOT look like a clean FlowEnd
+        with caplog.at_level(logging.DEBUG, logger="scheduler_plugins_tpu"):
+            with pytest.raises(ValueError):
+                with obs.flow("resync", generation=3):
+                    raise ValueError("boom")
+        end_line = next(
+            r.getMessage() for r in caplog.records
+            if obs.FLOW_END in r.getMessage()
+        )
+        assert "status=error" in end_line
+        assert "error=ValueError" in end_line
+        assert "durationMs" in end_line
+
+
+class TestHistograms:
+    def test_observe_keeps_legacy_summary_keys(self):
+        m = obs.Metrics()
+        m.observe_ms("scheduler_cycle", 12.4)
+        m.observe_ms("scheduler_cycle", 3.2)
+        snap = m.snapshot()
+        assert snap["scheduler_cycle_ms_total"] == 15
+        assert snap["scheduler_cycle_count"] == 2
+        assert snap["scheduler_cycle_ms_max"] == 12
+
+    def test_bucket_counts_cumulative_in_text(self):
+        m = obs.Metrics()
+        for ms in (0.5, 3.0, 30.0, 20_000.0):
+            m.observe_ms("lat", ms)
+        text = m.prometheus_text()
+        samples = dict(
+            line.rsplit(" ", 1)
+            for line in text.splitlines()
+            if line and not line.startswith("#")
+        )
+        assert samples['lat_bucket{le="1"}'] == "1"
+        assert samples['lat_bucket{le="5"}'] == "2"
+        assert samples['lat_bucket{le="50"}'] == "3"
+        assert samples['lat_bucket{le="10000"}'] == "3"
+        assert samples['lat_bucket{le="+Inf"}'] == "4"
+        assert samples["lat_count"] == "4"
+        assert float(samples["lat_sum"]) == pytest.approx(20_033.5)
+        assert "# TYPE lat histogram" in text
+
+    def test_labeled_histograms_and_counters(self):
+        m = obs.Metrics()
+        m.observe_ms(obs.PLUGIN_EXECUTION, 7.0, plugin="Coscheduling",
+                     extension_point="QueueSort")
+        m.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin="NodeAffinity")
+        m.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin="NodeAffinity")
+        assert m.get(obs.UNSCHEDULABLE_BY_PLUGIN, plugin="NodeAffinity") == 2
+        text = m.prometheus_text()
+        assert (
+            'scheduler_unschedulable_by_plugin_total{plugin="NodeAffinity"} 2'
+            in text
+        )
+        assert (
+            'scheduler_plugin_execution_ms_bucket{extension_point='
+            '"QueueSort",plugin="Coscheduling",le="10"} 1' in text
+        )
+
+    def test_label_values_escaped(self):
+        m = obs.Metrics()
+        m.inc("weird_total", plugin='a"b\\c')
+        assert '{plugin="a\\"b\\\\c"}' in m.prometheus_text()
+
+    def test_counter_type_lines(self):
+        m = obs.Metrics()
+        m.inc("x_total", 3)
+        text = m.prometheus_text()
+        assert "# TYPE x_total counter" in text
+        assert "x_total 3" in text
+
+    def test_no_duplicate_samples_for_observed_names(self):
+        # the legacy <name>_count summary counter and the histogram's
+        # _count child are the SAME sample: a scrape must contain each
+        # sample key exactly once or prometheus rejects it wholesale
+        m = obs.Metrics()
+        m.observe_ms("scheduler_cycle", 4.2)
+        m.inc("scheduler_pods_bound_total", 2)
+        lines = [
+            line for line in m.prometheus_text().splitlines()
+            if line and not line.startswith("#")
+        ]
+        keys = [line.rsplit(" ", 1)[0] for line in lines]
+        assert len(keys) == len(set(keys)), keys
+        assert keys.count("scheduler_cycle_count") == 1
+        # ...while the JSON snapshot keeps the legacy key for panels
+        assert m.snapshot()["scheduler_cycle_count"] == 1
+
+
+class TestTracer:
+    def test_disabled_tracer_records_nothing(self):
+        t = obs.Tracer()
+        with t.span("work", tid="row"):
+            pass
+        t.complete("late", 0, 10)
+        assert t.export()["traceEvents"] == []
+
+    def test_span_records_complete_event_with_thread_name(self):
+        t = obs.Tracer()
+        t.start()
+        with t.span("solve", tid="cycle", pods=3):
+            pass
+        t.stop()
+        trace = t.export()
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert len(xs) == 1 and xs[0]["name"] == "solve"
+        assert xs[0]["args"] == {"pods": 3}
+        assert xs[0]["ts"] >= 0 and xs[0]["dur"] >= 0
+        assert ms[0]["name"] == "thread_name"
+        assert ms[0]["args"]["name"] == "cycle"
+        assert ms[0]["tid"] == xs[0]["tid"]
+
+    def test_start_clears_previous_run(self):
+        t = obs.Tracer()
+        t.start()
+        with t.span("old"):
+            pass
+        t.start()
+        t.stop()
+        assert t.export()["traceEvents"] == []
+
+    def test_export_is_perfetto_valid(self):
+        t = obs.Tracer()
+        t.start()
+        with t.span("outer", tid="cycle"):
+            with t.span("inner", tid="cycle"):
+                pass
+        with t.span("other-row", tid="pipeline/h2d/buf0"):
+            pass
+        t.stop()
+        assert validate_trace(t.export()) == []
+
+
+class TestCycleTrace:
+    def _cluster(self):
+        c = Cluster()
+        c.add_node(Node(name="n0",
+                        allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110}))
+        c.add_pod(Pod(name="ok", creation_ms=1,
+                      containers=[Container(requests={CPU: 100})]))
+        c.add_pod(Pod(name="huge", creation_ms=2,
+                      containers=[Container(requests={CPU: 99_000})]))
+        return c
+
+    def test_traced_cycle_exports_loadable_timeline(self, tmp_path):
+        obs.tracer.start()
+        run_cycle(Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+                  self._cluster(), now=1000)
+        obs.tracer.stop()
+        out = tmp_path / "cycle.json"
+        obs.tracer.write(str(out))
+        trace = json.loads(out.read_text())
+        assert validate_trace(trace) == []
+        events = trace["traceEvents"]
+        # only Perfetto-loadable chrome-trace phases
+        assert {e["ph"] for e in events} <= {"X", "B", "E", "M"}
+        names = {e["name"] for e in events if e["ph"] == "X"}
+        # extension points QueueSort -> Bind appear as spans
+        for expected in ("QueueSort/PrioritySort",
+                         "Prepare/NodeResourcesAllocatable",
+                         "Solve/tpu-scheduler", "Bind", "Attribution"):
+            assert expected in names, (expected, sorted(names))
+        # per-tid timestamps are monotonic in record order
+        by_tid = {}
+        for e in events:
+            if e["ph"] == "X":
+                by_tid.setdefault(e["tid"], []).append(e["ts"] + e["dur"])
+        for ends in by_tid.values():
+            assert all(b >= a for a, b in zip(ends, ends[1:]))
+
+    def test_untraced_cycle_is_clean_and_silent(self):
+        # tracing off (the default): the same cycle runs without touching
+        # the tracer event buffer (stale events from earlier traced runs
+        # stay untouched until the next start(clear=True))
+        before = len(obs.tracer.export()["traceEvents"])
+        run_cycle(Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+                  self._cluster(), now=1000)
+        assert len(obs.tracer.export()["traceEvents"]) == before

@@ -35,6 +35,17 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   platform/precision config is owned by the entrypoints and the test
   bootstrap (`tests/conftest.py`); a library-level update fights their
   platform pinning and its effect depends on import order.
+- **GL008 jit-walltime** — no wall-clock reads (`time.perf_counter`,
+  `time.perf_counter_ns`, `time.time`, `time.monotonic`, ...) inside
+  jit-traced functions: trace-time Python runs ONCE per compile, so the
+  "timestamp" is a baked constant that measures nothing — and through the
+  tunneled backend even host-side `block_until_ready` timing lies (GL004).
+  Device work is timed by bracketing HOST-SYNC transfers
+  (`np.asarray(result)`); see `utils/observability.py` Tracer. Functions
+  count as jit-traced when decorated with / passed to `jax.jit`,
+  `parallel.pipeline.donated_chunk_solver`, `utils.sanitize.checkified`,
+  or when they are Plugin tensor methods (which run under the fused
+  solve's trace).
 
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
@@ -627,6 +638,97 @@ def check_config_update(path, tree, findings):
         ))
 
 
+#: wall-clock reads that are meaningless (trace-time constants) inside a
+#: jit-traced function
+WALL_CLOCK_ATTRS = frozenset({
+    "perf_counter", "perf_counter_ns", "time", "time_ns", "monotonic",
+    "monotonic_ns", "process_time", "process_time_ns",
+})
+
+#: callables whose function argument gets jit-traced
+JIT_WRAPPERS = frozenset({"jit", "donated_chunk_solver", "checkified"})
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _jitted_function_nodes(tree):
+    """Function/lambda nodes in `tree` that get jit-traced: decorated with
+    jit (bare, `jax.jit`, or `partial(jax.jit, ...)`), or passed (by name
+    or inline lambda) as the first argument of `jax.jit` /
+    `donated_chunk_solver` / `checkified`. Name references resolve to
+    every same-named def in the file — conservative in the right
+    direction for a lint that flags wall clocks."""
+    defs_by_name: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    jitted = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = _callee_name(target)
+                if name == "jit":
+                    jitted.append(node)
+                elif name == "partial" and isinstance(dec, ast.Call) and any(
+                    _callee_name(a) == "jit"
+                    for a in dec.args
+                    if isinstance(a, (ast.Name, ast.Attribute))
+                ):
+                    jitted.append(node)
+        elif isinstance(node, ast.Call):
+            if _callee_name(node.func) not in JIT_WRAPPERS or not node.args:
+                continue
+            fn_arg = node.args[0]
+            if isinstance(fn_arg, ast.Lambda):
+                jitted.append(fn_arg)
+            elif isinstance(fn_arg, ast.Name):
+                jitted.extend(defs_by_name.get(fn_arg.id, ()))
+    return jitted
+
+
+def check_jit_walltime(path, tree, plugin_classes, findings):
+    """GL008: wall-clock reads inside jit-traced functions (including
+    Plugin tensor methods and functions nested inside a traced scope)."""
+    traced = list(_jitted_function_nodes(tree))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in plugin_classes:
+            traced.extend(
+                meth for meth in node.body
+                if isinstance(meth, ast.FunctionDef)
+                and meth.name in TENSOR_METHODS
+            )
+    seen = set()
+    for fn in traced:
+        # descend into NESTED defs too: code defined inside a traced
+        # function traces with it
+        for sub in ast.walk(fn):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in WALL_CLOCK_ATTRS
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "time"):
+                continue
+            key = (sub.lineno, sub.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                path, sub, "GL008",
+                f"time.{sub.func.attr}() inside a jit-traced function: "
+                "trace-time Python runs once per compile, so this is a "
+                "baked constant, not a measurement — time device work by "
+                "bracketing host-sync transfers (np.asarray) outside the "
+                "jit (GL004's rule; see utils/observability.py)",
+            ))
+
+
 def _donate_positions(node):
     """Literal int positions from a donate_argnums/carry_argnum value."""
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
@@ -858,6 +960,7 @@ def lint_paths(paths) -> list[Finding]:
     for f, tree in trees:
         extra: list[Finding] = []
         check_aux_capture(f, tree, plugin_classes, extra)
+        check_jit_walltime(f, tree, plugin_classes, extra)
         all_findings.extend(extra)
     return [
         fi for fi in all_findings

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Trace smoke gate (`make trace-smoke`): the cycle tracer must (a) emit a
+Perfetto-loadable trace covering the framework extension-point spans AND
+the chunk pipeline's H2D/solve/D2H rows, and (b) cost ≤ the overhead bound
+when enabled.
+
+Two measured series on a REDUCED north-star shape (the same
+`bench.north_star_chunk_solver` program, smaller tensors), interleaved
+tracing-off / tracing-on so drift hits both equally; medians compared.
+The bound is `max(SPT_TRACE_BOUND_PCT [default 2%], the tracing-off
+series' own p10-p90 spread)` — the 2% target is the acceptance criterion
+at north-star scale, and the spread floor keeps a sub-100ms CI-runner run
+from failing on scheduler jitter the tracer didn't cause. Overhead here is
+strictly conservative vs the north star: the reduced shape does LESS
+device work per span, so the tracer's per-span cost is a LARGER fraction
+of the wall clock than it is at 10k x 102k.
+
+Trace validation (`validate_trace`, reused by tests/test_observability.py):
+JSON with a `traceEvents` list, phases only X/B/E/M (Perfetto's
+chrome-trace subset), numeric non-negative ts/dur, B/E stack-paired per
+tid, and per-tid X spans either disjoint or properly nested — plus the
+pipeline rows and at least one framework extension-point span present.
+
+One JSON line on stdout; rc 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # `python tools/trace_smoke.py` from anywhere
+    sys.path.insert(0, str(REPO))
+
+#: reduced north-star shape: big enough that a run is not pure dispatch
+#: overhead, small enough for a 2-core CI runner
+SMOKE_SHAPE = dict(n_nodes=256, n_pods=4096, chunk=512)
+RUNS = 9
+
+
+# ---------------------------------------------------------------------------
+# trace validation (shared with tests)
+# ---------------------------------------------------------------------------
+
+
+def validate_trace(trace) -> list[str]:
+    """Structural errors in a Chrome-trace-event / Perfetto JSON dict
+    (empty list = valid)."""
+    errors: list[str] = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    open_stacks: dict = {}
+    spans_per_tid: dict = {}
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        if ph not in ("X", "B", "E", "M"):
+            errors.append(f"event {i}: phase {ph!r} not in X/B/E/M")
+            continue
+        if "name" not in e or "pid" not in e or "tid" not in e:
+            errors.append(f"event {i}: missing name/pid/tid")
+            continue
+        if ph == "M":
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        key = (e["pid"], e["tid"])
+        if ph == "B":
+            open_stacks.setdefault(key, []).append(e["name"])
+        elif ph == "E":
+            stack = open_stacks.get(key)
+            if not stack:
+                errors.append(f"event {i}: E without matching B on {key}")
+            else:
+                stack.pop()
+        else:  # X
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"event {i}: bad dur {dur!r}")
+                continue
+            spans_per_tid.setdefault(key, []).append((ts, ts + dur, e["name"]))
+    for key, stack in open_stacks.items():
+        if stack:
+            errors.append(f"unclosed B events on {key}: {stack}")
+    # per-tid spans must be timeline-renderable: sorted by start they are
+    # pairwise either disjoint or properly nested (no partial overlap)
+    for key, spans in spans_per_tid.items():
+        spans.sort()
+        active: list[tuple] = []
+        for start, end, name in spans:
+            while active and active[-1][1] <= start:
+                active.pop()
+            if active and end > active[-1][1]:
+                errors.append(
+                    f"tid {key}: span {name!r} [{start},{end}] partially "
+                    f"overlaps {active[-1][2]!r} [{active[-1][0]},"
+                    f"{active[-1][1]}]"
+                )
+            active.append((start, end, name))
+    return errors
+
+
+def required_rows(trace) -> list[str]:
+    """Rows the tentpole promises: pipeline H2D/solve/D2H per buffer and a
+    framework extension-point row. Returns the MISSING row names."""
+    names = {
+        e["args"]["name"]
+        for e in trace.get("traceEvents", ())
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    missing = [
+        row
+        for row in (
+            "pipeline/h2d/buf0", "pipeline/h2d/buf1",
+            "pipeline/solve/buf0", "pipeline/solve/buf1",
+            "pipeline/d2h/buf0", "pipeline/d2h/buf1",
+            "framework",
+        )
+        if row not in names
+    ]
+    return missing
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_run(solve_chunk, raw, node_mask, chunk_inputs, snap):
+    """One pipeline pass over the reduced shape; returns (elapsed_s,
+    timeline). The free carry is rebuilt per run (it is DONATED)."""
+    from scheduler_plugins_tpu.ops.fit import free_capacity
+    from scheduler_plugins_tpu.parallel.pipeline import run_chunk_pipeline
+
+    free = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    start = time.perf_counter()
+    results, free, _, timeline = run_chunk_pipeline(
+        solve_chunk, (raw, node_mask), chunk_inputs, free
+    )
+    # pipeline results are already host numpy (device_get)
+    return time.perf_counter() - start, timeline, results
+
+
+def main(out_path=None, bound_pct=None):
+    import numpy as np
+
+    import bench
+    from scheduler_plugins_tpu.utils import observability as obs
+
+    bench.apply_platform_override()
+    if bound_pct is None:
+        bound_pct = float(os.environ.get("SPT_TRACE_BOUND_PCT", 2.0))
+    out_path = out_path or os.environ.get(
+        "SPT_TRACE_OUT", "/tmp/trace_smoke.json"
+    )
+
+    shape = SMOKE_SHAPE
+    _, snap, meta, weights, raw, padded = bench.north_star_problem(
+        shape["n_nodes"], shape["n_pods"], shape["chunk"]
+    )
+    node_mask = snap.nodes.mask
+    solve_chunk = bench.north_star_chunk_solver()
+    req_np = np.asarray(snap.pods.req)
+    mask_np = np.asarray(snap.pods.mask)
+    chunk = shape["chunk"]
+    chunk_inputs = [
+        (req_np[lo:lo + chunk], mask_np[lo:lo + chunk])
+        for lo in range(0, padded, chunk)
+    ]
+
+    obs.tracer.stop()
+    _pipeline_run(solve_chunk, raw, node_mask, chunk_inputs, snap)  # compile
+
+    off, on = [], []
+    final_trace = None
+    for _ in range(RUNS):
+        obs.tracer.stop()
+        t, _, _ = _pipeline_run(solve_chunk, raw, node_mask, chunk_inputs,
+                                snap)
+        off.append(t)
+        obs.tracer.start(clear=True)
+        t, _, _ = _pipeline_run(solve_chunk, raw, node_mask, chunk_inputs,
+                                snap)
+        on.append(t)
+        final_trace = None  # events live in the tracer until exported
+
+    # one traced scheduling cycle on a tiny cluster adds the framework
+    # extension-point rows to the exported trace (tracer still running)
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    cluster = Cluster()
+    for i in range(8):
+        cluster.add_node(Node(
+            name=f"n{i}",
+            allocatable={CPU: 16000, MEMORY: 64 * gib, PODS: 110},
+        ))
+    for p in range(32):
+        cluster.add_pod(Pod(
+            name=f"p{p}", creation_ms=p,
+            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+        ))
+    cluster.add_pod(Pod(
+        name="too-big", creation_ms=99,
+        containers=[Container(requests={CPU: 10 ** 9})],
+    ))
+    report = run_cycle(
+        Scheduler(Profile(plugins=[NodeResourcesAllocatable()])), cluster,
+        now=0,
+    )
+    obs.tracer.stop()
+    obs.tracer.write(out_path)
+    with open(out_path) as f:
+        final_trace = json.load(f)
+
+    median_off = sorted(off)[len(off) // 2]
+    median_on = sorted(on)[len(on) // 2]
+    overhead_pct = 100.0 * (median_on - median_off) / median_off
+    # noise floor: the tracing-off series' own p10-p90 spread — overhead
+    # below the run-to-run jitter is not attributable to the tracer
+    off_sorted = sorted(off)
+    spread_pct = 100.0 * (
+        off_sorted[int(0.9 * (len(off) - 1))]
+        - off_sorted[int(0.1 * (len(off) - 1))]
+    ) / median_off
+    bound = max(bound_pct, spread_pct)
+
+    errors = validate_trace(final_trace)
+    missing = required_rows(final_trace)
+    attribution_ok = (
+        bool(report.failed_by)
+        and set(report.failed_by.values()) == {"NodeResourcesFit"}
+    )
+    ok = (
+        not errors
+        and not missing
+        and overhead_pct <= bound
+        and attribution_ok
+    )
+    print(json.dumps({
+        "metric": "trace_smoke",
+        "off_pods_per_sec": round(shape["n_pods"] / median_off, 1),
+        "on_pods_per_sec": round(shape["n_pods"] / median_on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": round(bound, 2),
+        "noise_floor_pct": round(spread_pct, 2),
+        "trace_events": len(final_trace.get("traceEvents", ())),
+        "trace_errors": errors[:5],
+        "missing_rows": missing,
+        "attribution_ok": attribution_ok,
+        "trace_path": out_path,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
