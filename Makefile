@@ -40,11 +40,20 @@ bench-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
 
+# CI record/replay gate: a recorded cycle (real run_cycle hooks) must
+# replay bit-identically through the sequential parity path, the explain
+# JSON must validate (per-plugin columns summing to the solver's total),
+# and recorder-enabled overhead must stay within max(2%, the run's own
+# off-recorder jitter)
+.PHONY: replay-smoke
+replay-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/replay.py smoke
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke
 
 .PHONY: lint
 lint:

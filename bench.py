@@ -659,9 +659,12 @@ def config_problem(config: int, shape: dict | None = None):
     return cluster, plugins, detail
 
 
-def sequential_config(config: int, mode: str = "sequential"):
+def sequential_config(config: int, mode: str = "sequential",
+                      record_dir: str | None = None):
     """BASELINE configs 2-5 on the bit-faithful sequential solve, or the
-    profile-generic batched throughput mode (--mode batch)."""
+    profile-generic batched throughput mode (--mode batch). `record_dir`
+    saves the measured cycle as a flight-recorder bundle (full solver
+    inputs + outputs + drift; replay with tools/replay.py)."""
     import jax  # noqa: F401
 
     from scheduler_plugins_tpu.framework import Profile, Scheduler
@@ -732,8 +735,42 @@ def sequential_config(config: int, mode: str = "sequential"):
             "placed_sequential": placed_seq,
             **_wave_extra(wave_stats["stats"]),
         }
+    if record_dir:
+        _record_bench_cycle(scheduler, snap, meta, mode, record_dir, drift)
     _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
           baseline, compiled=compiled, drift=drift, extra=extra)
+
+
+def _record_bench_cycle(scheduler, snap, meta, mode, record_dir, drift):
+    """`--record dir/`: persist the measured cycle's full solver inputs +
+    outputs as a flight-recorder bundle (the solves are cached — this
+    re-invokes the already-compiled program once, outside the timing)."""
+    from scheduler_plugins_tpu.utils import flightrec
+
+    flightrec.recorder.start(capacity=1)
+    flightrec.recorder.seed = 0  # config_problem scenarios are seed-0
+    rec = flightrec.recorder.begin(now_ms=0, profile=scheduler.profile.name)
+    rec.capture_inputs(snap, meta, scheduler)
+    if mode == "batch":
+        from scheduler_plugins_tpu.parallel.solver import profile_batch_solve
+
+        # collect_stats=True matches the timed run's jit-cache key — this
+        # re-invokes the SAME compiled program the emitted numbers came from
+        a, admitted, wait = profile_batch_solve(
+            scheduler, snap, collect_stats=True
+        )[:3]
+        rec.capture_outputs("batch", a, admitted, wait)
+    else:
+        result = scheduler.solve(snap)
+        rec.capture_outputs(
+            "sequential", result.assignment, result.admitted, result.wait,
+            failed_plugin=result.failed_plugin,
+        )
+    rec.commit(drift=drift)
+    summary = flightrec.recorder.save(record_dir)
+    flightrec.recorder.stop()
+    print(f"# flight recorder bundle: {json.dumps(summary)}",
+          file=sys.stderr)
 
 
 #: reduced scenario shapes for the CI smoke gate (compile time bounded on
@@ -894,6 +931,11 @@ if __name__ == "__main__":
                              "pipeline's H2D/solve/D2H rows; otherwise a "
                              "directory for a jax profiler trace "
                              "(op-level data for tuning rounds)")
+    parser.add_argument("--record", default=None, metavar="DIR",
+                        help="configs 2-5: save the measured cycle as a "
+                             "flight-recorder bundle under DIR (full "
+                             "solver inputs + placements; replay/explain "
+                             "offline with tools/replay.py)")
     parser.add_argument("--smoke-compare", default=None, metavar="CFGS",
                         help="CI gate: comma-separated configs (e.g. 2,3) "
                              "run at reduced shapes in BOTH modes; fails "
@@ -963,7 +1005,12 @@ if __name__ == "__main__":
         elif args.config == 6:
             north_star()
         else:
-            sequential_config(args.config, args.mode)
+            sequential_config(args.config, args.mode,
+                              record_dir=args.record)
+        if args.record and args.config in (0, 1, 6):
+            print("# --record applies to plugin-profile configs 2-5 "
+                  "(the flagship/north-star solves run no plugin "
+                  "profile); nothing recorded", file=sys.stderr)
     finally:
         if trace_json:
             obs.tracer.stop()

@@ -118,20 +118,39 @@ def parse_args(argv=None):
                          "-1 disables)")
     ap.add_argument("--max-cycles", type=int, default=0,
                     help="exit after N cycles (0 = run until SIGTERM)")
+    ap.add_argument("--record", type=int, default=0, metavar="N",
+                    help="flight recorder: keep the last N scheduling "
+                         "cycles' full solver inputs+outputs in a ring "
+                         "buffer (utils.flightrec; 0 = off). Enables "
+                         "GET /explain?uid=<pod-uid> on the health port "
+                         "(per-plugin score table for any recorded pod)")
+    ap.add_argument("--record-dir", default=None, metavar="DIR",
+                    help="with --record: persist the ring as a replayable "
+                         "bundle under DIR on shutdown (crash-safe "
+                         "temp+rename writes; replay offline with "
+                         "tools/replay.py). NOTE: bundles carry full pod "
+                         "specs — handle like an apiserver dump")
     return ap.parse_args(argv)
 
 
-def load_profile_file(path: str):
-    """YAML/JSON profile file -> Profile. Accepts either the flat
-    {plugins, pluginConfig} mapping `api.config.load_profile` takes or a
-    KubeSchedulerConfiguration-style {profiles: [first]} wrapper."""
+def decode_profile_file(path: str) -> dict:
+    """YAML/JSON profile file -> the flat {plugins, pluginConfig} mapping
+    `api.config.load_profile` takes. Accepts a KubeSchedulerConfiguration
+    -style {profiles: [first]} wrapper. Shared by startup profile loading
+    and the flight recorder's exact-config capture, so the recorded
+    config can never diverge from the profile the daemon actually runs."""
     import yaml
 
     with open(path) as f:
         config = yaml.safe_load(f) or {}
     if "profiles" in config:
         config = (config.get("profiles") or [{}])[0]
-    return load_profile(config)
+    return config
+
+
+def load_profile_file(path: str):
+    """YAML/JSON profile file -> Profile."""
+    return load_profile(decode_profile_file(path))
 
 
 class HealthServer:
@@ -151,6 +170,14 @@ class HealthServer:
             def log_message(self, *args):
                 pass
 
+            def _json_reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path.startswith("/healthz"):
                     # lock-free: a probe must answer while a cycle (incl.
@@ -167,6 +194,43 @@ class HealthServer:
                         payload["leader"] = outer.elector.is_leader
                         payload["holder"] = outer.elector.observed_holder
                     body = json.dumps(payload).encode()
+                elif self.path.startswith("/explain"):
+                    # per-plugin score table for a recorded pod (flight
+                    # recorder ring; 404 when off or uid not recorded)
+                    from urllib.parse import parse_qs, urlparse
+
+                    from scheduler_plugins_tpu.utils import flightrec
+
+                    query = parse_qs(urlparse(self.path).query)
+                    uid = (query.get("uid") or [""])[0]
+                    cycle = query.get("cycle")
+                    try:
+                        top_k = int((query.get("top") or [5])[0])
+                        cycle_n = int(cycle[0]) if cycle else None
+                    except ValueError as exc:
+                        self._json_reply(
+                            400, {"error": f"bad query parameter: {exc}"}
+                        )
+                        return
+                    rec = flightrec.recorder.find(uid, cycle=cycle_n)
+                    if not uid or rec is None:
+                        detail = (
+                            "flight recorder off (--record N)"
+                            if not flightrec.recorder.enabled
+                            else f"uid {uid!r} not in the recorded ring"
+                        )
+                        self._json_reply(404, {"error": detail})
+                        return
+                    try:
+                        body = json.dumps(
+                            flightrec.explain_record(rec, uid, top_k=top_k)
+                        ).encode()
+                    except Exception as exc:
+                        self._json_reply(
+                            500,
+                            {"error": f"{type(exc).__name__}: {exc}"},
+                        )
+                        return
                 elif self.path.startswith("/metrics.json"):
                     body = json.dumps(obs.metrics.snapshot()).encode()
                 elif self.path.startswith("/metrics"):
@@ -207,6 +271,15 @@ class Daemon:
         self.args = args
         self.profile = load_profile_file(args.profile)
         self.scheduler = Scheduler(self.profile)
+        if args.record:
+            from scheduler_plugins_tpu.utils import flightrec
+
+            flightrec.recorder.start(capacity=args.record)
+            # the daemon knows its EXACT profile config — record that
+            # instead of the best-effort attribute export
+            flightrec.recorder.profile_config = decode_profile_file(
+                args.profile
+            )
         self.cluster = Cluster()
         if args.scheduler_name:
             self.cluster.scheduler_names = set(args.scheduler_name)
@@ -417,6 +490,14 @@ class Daemon:
                 if remaining > 0:
                     self.stop_event.wait(remaining)
         finally:
+            if self.args.record and self.args.record_dir:
+                from scheduler_plugins_tpu.utils import flightrec
+
+                try:
+                    summary = flightrec.recorder.save(self.args.record_dir)
+                    obs.logger.info("flight recorder bundle: %s", summary)
+                except Exception as exc:
+                    obs.logger.warning("flight recorder save failed: %s", exc)
             if self.elector is not None:
                 self.elector.release()  # ReleaseOnCancel (idempotent)
             if self.health:

@@ -135,6 +135,75 @@ def available_plugins() -> tuple[str, ...]:
     return tuple(sorted(_registry()))
 
 
+#: plugin-specific arg exporters for constructor args NOT stored under the
+#: kwarg's own attribute name (the common case IS the attribute name —
+#: `profile_spec` tries that first)
+_SPEC_OVERRIDES = {
+    "NodeResourcesAllocatable": lambda p: {
+        "resources": [list(r) for r in p.resources],
+        "mode": "Least" if p.mode_sign < 0 else "Most",
+    },
+    "NodeResourceTopologyMatch": lambda p: {
+        "scoringStrategy": p.strategy,
+        "resources": [list(r) for r in p.resources],
+    },
+}
+
+
+def _json_safe(value):
+    """`value` lowered to JSON-encodable form, or None when it isn't
+    (tuples become lists; objects are dropped — lossy export is flagged by
+    the replay's static_key/aux cross-checks, not silently trusted)."""
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    if isinstance(value, (tuple, list)):
+        items = [_json_safe(v) for v in value]
+        return items if all(
+            v is not None or o is None for v, o in zip(items, value)
+        ) else None
+    if isinstance(value, Mapping):
+        out = {}
+        for k, v in value.items():
+            if not isinstance(k, str):
+                return None
+            safe = _json_safe(v)
+            if safe is None and v is not None:
+                return None
+            out[k] = safe
+        return out
+    return None
+
+
+def profile_spec(profile: Profile) -> dict:
+    """Best-effort inverse of `load_profile`: a {plugins, pluginConfig}
+    mapping that reconstructs `profile`'s plugin roster — the flight
+    recorder's profile record (`utils.flightrec`) when the caller has no
+    original config file. Args are exported from the constructor-kwarg
+    attributes plugins keep (plus `_SPEC_OVERRIDES` for renamed ones);
+    anything non-JSON-able (e.g. NodeAffinity `addedAffinity` objects) is
+    omitted — the replayer detects the loss via the recorded
+    `static_key`/aux digests instead of failing the export."""
+    names = []
+    plugin_config = []
+    for plugin in profile.plugins:
+        cls = type(plugin).__name__
+        names.append(cls)
+        override = _SPEC_OVERRIDES.get(cls)
+        args = dict(override(plugin)) if override else {}
+        for camel, kwarg in _ARG_MAPS.get(cls, {}).items():
+            if camel in args:
+                continue
+            value = _json_safe(getattr(plugin, kwarg, None))
+            if value is not None:
+                args[camel] = value
+        if args:
+            plugin_config.append({"name": cls, "args": args})
+    spec = {"profileName": profile.name, "plugins": names}
+    if plugin_config:
+        spec["pluginConfig"] = plugin_config
+    return spec
+
+
 def load_profile(config: Mapping) -> Profile:
     """Lower a configuration mapping into a Profile.
 

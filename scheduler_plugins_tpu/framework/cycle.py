@@ -20,11 +20,18 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+import os
+from collections import deque
+
 from scheduler_plugins_tpu.framework.preemption import GATED, encode_demand
-from scheduler_plugins_tpu.framework.runtime import Scheduler, now_ms as _now_ms
+from scheduler_plugins_tpu.framework.runtime import (
+    Scheduler,
+    SolveResult,
+    now_ms as _now_ms,
+)
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
 from scheduler_plugins_tpu.state.cluster import Cluster
-from scheduler_plugins_tpu.utils import observability as obs
+from scheduler_plugins_tpu.utils import flightrec, observability as obs
 
 
 @dataclass
@@ -70,6 +77,66 @@ class CycleReport:
     #: when sanitize mode is off; 0 means the solve path was uninstrumented)
     sanitize_checked: int | None = None
 
+    def explain(self, uid: str, top_k: int = 5) -> dict:
+        """The "why this node" score table for one pod of THIS cycle's
+        pending batch (see `utils.flightrec.explain_solver`): top-k
+        candidate nodes with per-plugin weighted normalized score columns,
+        the built-in fit margin and the winner gap — the upstream
+        `--v=10` score dump, per pod, on demand. Works for placed AND
+        failed pods; raises KeyError for a uid outside the batch and
+        RuntimeError when the cycle never reached a solve (no pending) or
+        when the context has been released (only the most recent
+        SPT_EXPLAIN_RETAIN cycle reports keep their snapshot — retaining
+        every report must not pin every snapshot ever solved)."""
+        ctx = getattr(self, "_explain_ctx", None)
+        if ctx is _CTX_RELEASED:
+            raise RuntimeError(
+                f"explain context released: only the most recent "
+                f"{_explain_retain()} cycle reports keep their snapshot "
+                "(SPT_EXPLAIN_RETAIN; 0 disables explain entirely); use "
+                "the flight recorder for postmortems beyond that window"
+            )
+        if ctx is None:
+            raise RuntimeError(
+                "this cycle ran no solve (empty pending batch) — nothing "
+                "to explain"
+            )
+        scheduler, snap, meta, assignment, auxes = ctx
+        return flightrec.explain_solver(
+            scheduler, snap, meta, uid, top_k=top_k, assignment=assignment,
+            auxes=auxes,
+        )
+
+
+#: sentinel on `CycleReport._explain_ctx`: distinguishes "released by the
+#: retention window" from "this cycle never solved"
+_CTX_RELEASED = object()
+
+#: reports whose explain context (scheduler/snapshot/meta/assignment refs)
+#: is still attached, most recent last — a full `ClusterSnapshot` hangs off
+#: each ctx, so a caller retaining every report must not pin every
+#: snapshot ever solved
+_EXPLAIN_RING: deque = deque()
+
+
+def _explain_retain() -> int:
+    try:
+        return int(os.environ.get("SPT_EXPLAIN_RETAIN", "8"))
+    except ValueError:
+        return 8
+
+
+def _attach_explain_ctx(report: CycleReport, ctx: tuple) -> None:
+    retain = _explain_retain()
+    if retain <= 0:
+        # explain disabled: pin nothing, not even this cycle's snapshot
+        report._explain_ctx = _CTX_RELEASED
+        return
+    report._explain_ctx = ctx
+    _EXPLAIN_RING.append(report)
+    while len(_EXPLAIN_RING) > retain:
+        _EXPLAIN_RING.popleft()._explain_ctx = _CTX_RELEASED
+
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
               stream_chunk: int | None = None) -> CycleReport:
@@ -112,10 +179,19 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         # only THIS cycle's checked calls to this report
         sanitize.drain()
     generation = getattr(cluster.nrt_cache, "generation", None)
+    rec = flightrec.recorder.begin(now_ms=now, profile=scheduler.profile.name)
     with obs.flow("cycle", generation=generation, pending=len(pending)):
         with obs.tracer.span("Snapshot", tid="cycle", pending=len(pending)):
             snap, meta = cluster.snapshot(pending, now_ms=now)
         scheduler.prepare(meta, cluster)
+        if rec is not None:
+            # inputs land in the ring BEFORE the solve: the cycle that
+            # crashes the solver is exactly the one worth replaying
+            with obs.tracer.span("Record", tid="cycle"):
+                rec.capture_inputs(
+                    snap, meta, scheduler, stream_chunk=stream_chunk,
+                    profile_config=flightrec.recorder.profile_config,
+                )
         result = None
         # the Solve span covers dispatch AND completion (np.asarray host
         # transfers below force it) for the sequential path; the streamed
@@ -142,6 +218,27 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
             assignment = np.asarray(result.assignment)
             admitted = np.asarray(result.admitted)
             wait = np.asarray(result.wait)
+        if rec is not None:
+            with obs.tracer.span("Record", tid="cycle"):
+                codes = getattr(result, "failed_plugin", None)
+                rec.capture_outputs(
+                    "sequential" if isinstance(result, SolveResult)
+                    else "streamed",
+                    assignment, admitted, wait,
+                    failed_plugin=(
+                        None if codes is None else np.asarray(codes)
+                    ),
+                )
+    # cheap refs, not copies: lets `report.explain(uid)` rebuild the
+    # per-plugin score table for any pod of this batch after the fact;
+    # retention-bounded so old reports release their snapshot. The aux
+    # pytrees are frozen HERE — a later cycle's prepare() rebinds the
+    # shared plugins, and explaining an old report against the live
+    # aux() would score cycle K's snapshot with cycle K+n's config
+    _attach_explain_ctx(report, (
+        scheduler, snap, meta, assignment,
+        tuple(p.aux() for p in scheduler.profile.plugins),
+    ))
 
     if sanitize.enabled():
         # surface this cycle's checkify findings on the report (the solve
@@ -217,6 +314,8 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
     obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
     obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
+    if rec is not None:
+        rec.commit(report)
     return report
 
 

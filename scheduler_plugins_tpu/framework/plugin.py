@@ -125,6 +125,20 @@ class Plugin:
         Return a pytree (read back via `self._presolve`) or None."""
         return None
 
+    def host_state(self):
+        """Cluster-derived host state that `prepare_cluster` bakes into the
+        trace and that a flight-recorder bundle cannot rebuild (bundles
+        carry the snapshot tensors, not the Cluster object). The recorder
+        packs this per plugin at capture time; replay restores it via
+        `restore_host_state` after `prepare(meta, None)` so the rebuilt
+        plugin traces the SAME specialization the recorded solve did.
+        Return a pytree of arrays/scalars or None (nothing to restore)."""
+        return None
+
+    def restore_host_state(self, state) -> None:
+        """Inverse of `host_state`: re-bake a recorded specialization into
+        a rebuilt plugin (utils.flightrec replay/explain paths)."""
+
     def bind_presolve(self, ctx) -> None:
         """Called inside the traced solve with this plugin's prepare_solve
         result; tensor methods read `self._presolve`."""

@@ -83,16 +83,24 @@ def _admit_with_attribution(plugins, state, snap, p, ok0):
     return ok, admit_code
 
 
-def _filter_with_attribution(plugins, state, snap, p, fit0):
+def _filter_with_attribution(plugins, state, snap, p, fit0, rows=None):
     """Filter chain with attribution: (feasible, filter_code) where
     `filter_code` is the first plugin whose Filter emptied the
     still-feasible node set, -1 when none did. Shared like
-    `_admit_with_attribution`."""
+    `_admit_with_attribution`. `rows` (plugin position -> precomputed
+    (P, N) verdict rows, the batched solver's class-collapsed
+    `filter_batch`/`batch_rows` outputs) substitutes `rows[i][p]` for the
+    per-pod `filter` call — how `parallel.solver.batch_explain_rows`
+    derives the batched explain through THIS same chain, so the two
+    explain paths cannot drift."""
     feasible = fit0
     alive = fit0.any()
     filter_code = jnp.int32(-1)
     for i, plugin in enumerate(plugins):
-        mask = plugin.filter(state, snap, p)
+        if rows is not None and i in rows:
+            mask = rows[i][p]
+        else:
+            mask = plugin.filter(state, snap, p)
         if mask is not None:
             feasible &= mask
             now_alive = feasible.any()
@@ -102,6 +110,138 @@ def _filter_with_attribution(plugins, state, snap, p, fit0):
             )
             alive = now_alive
     return feasible, filter_code
+
+
+def _free_with_nominee_holds(state, snap, p):
+    """Effective free capacity pod `p`'s built-in fit sees: nominated
+    pods' demand holds capacity against lower-or-equal-priority pods
+    (upstream AddNominatedPods; the pod's own batch row excluded, and a
+    batch nominee stops holding once placed). Shared by the sequential
+    solve step and the explain body (`_explain_one`) so the explain
+    surface reproduces exactly the fit the parity path enforced."""
+    if snap.nominees is None:
+        return state.free
+    nm = snap.nominees
+    live = (
+        nm.mask
+        & (nm.priority >= snap.pods.priority[p])
+        & (nm.batch_idx != p)
+    )
+    if state.placed_mask is not None:
+        placed_in_batch = (nm.batch_idx >= 0) & state.placed_mask[
+            jnp.maximum(nm.batch_idx, 0)
+        ]
+        live &= ~placed_in_batch
+    hold = jnp.zeros_like(state.free).at[
+        jnp.maximum(nm.node, 0)
+    ].add(jnp.where(live[:, None], nm.demand, 0))
+    return state.free - hold
+
+
+def _score_columns(plugins, state, snap, p, feasible, rows=None):
+    """((L, N) int64 per-plugin weighted normalized score columns,
+    (N,) int64 total) for pod `p` — THE one copy of the explain score
+    decomposition. Each column is exactly the `weight * normalize(raw,
+    feasible)` term the solve step folds into its total, so the columns
+    sum to the solver's node score by construction; plugins without a
+    Score contribute a zero column (the upstream score dump lists every
+    scoring plugin). `rows` substitutes the batched solver's
+    class-collapsed `score_batch`/`batch_rows` rows for the per-pod
+    `score` call (same drift guarantee as `_filter_with_attribution`)."""
+    N = state.free.shape[0]
+    cols = []
+    total = jnp.zeros(N, jnp.int64)
+    for i, plugin in enumerate(plugins):
+        if rows is not None and i in rows:
+            raw = rows[i][p]
+        else:
+            raw = plugin.score(state, snap, p)
+        if raw is None:
+            cols.append(jnp.zeros(N, jnp.int64))
+            continue
+        col = (plugin.weight * plugin.normalize(raw, feasible)).astype(
+            jnp.int64
+        )
+        cols.append(col)
+        total = total + col
+    return jnp.stack(cols), total
+
+
+def _explain_one(plugins, state0, snap, p, filter_rows=None, score_rows=None):
+    """Explain body for one pod against the cycle-initial state: admit +
+    attribution, built-in fit + margin, the filter chain, and the
+    per-plugin score columns — shared (via the `*_rows` overrides) by the
+    sequential and batched explain entries."""
+    ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]
+    ok, admit_code = _admit_with_attribution(plugins, state0, snap, p, ok0)
+    demand = pod_fit_demand(snap.pods.req[p])
+    # built-in fit margin: the binding resource's headroom (min over the
+    # axis of effective free - demand, nominee holds included — the same
+    # capacity the solve step fits against); masked nodes get the sentinel
+    free_eff = _free_with_nominee_holds(state0, snap, p)
+    margin = jnp.min(free_eff - demand[None, :], axis=1)
+    margin = jnp.where(snap.nodes.mask, margin, jnp.int64(-(2 ** 62)))
+    fit0 = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
+    feasible, filter_code = _filter_with_attribution(
+        plugins, state0, snap, p, fit0, rows=filter_rows
+    )
+    feasible &= ok
+    columns, total = _score_columns(
+        plugins, state0, snap, p, feasible, rows=score_rows
+    )
+    fail_code = _encode_fail(
+        ok0, admit_code, fit0.any(), filter_code, jnp.int32(-1)
+    )
+    return ok, fail_code, feasible, margin, columns, total
+
+
+def run_explain_rows(scheduler, snap, indices, auxes, program, explain_fn):
+    """Shared plumbing for the two explain entries (`Scheduler
+    .explain_rows` sequential, `parallel.solver.batch_explain_rows`
+    batched): power-of-two index-bucket padding (bounded retraces, like
+    `attribution_codes`), the per-static_key jit cache with compile
+    attribution, aux binding defaults, and the host transfer + slice-to-S
+    packaging of `_explain_one`'s outputs. The entries define ONLY
+    `explain_fn(snap, state0, auxes, idx)` — where the per-plugin rows
+    come from — so their output contract cannot drift."""
+    import numpy as np
+
+    plugins = tuple(scheduler.profile.plugins)
+    idx = np.asarray(indices, np.int32)
+    if idx.size == 0:
+        N = snap.num_nodes
+        L = max(len(plugins), 1)
+        return {
+            "admitted": np.zeros(0, bool),
+            "fail_code": np.zeros(0, np.int32),
+            "feasible": np.zeros((0, N), bool),
+            "fit_margin": np.zeros((0, N), np.int64),
+            "columns": np.zeros((0, L, N), np.int64),
+            "total": np.zeros((0, N), np.int64),
+        }
+    bucket = 1 << int(idx.size - 1).bit_length()
+    idx_padded = np.full(bucket, idx[0], np.int32)
+    idx_padded[: idx.size] = idx
+    key = (program,) + tuple(p.static_key() for p in plugins)
+    cache = scheduler._solve_cache
+    if key not in cache:
+        cache[key] = obs.compile_watch(jax.jit(explain_fn), program=program)
+    if auxes is None:
+        auxes = tuple(p.aux() for p in plugins)
+    out = cache[key](
+        snap, scheduler.initial_state(snap), auxes, jnp.asarray(idx_padded)
+    )
+    ok, fail, feasible, margin, columns, total = (
+        np.asarray(x)[: idx.size] for x in out
+    )
+    return {
+        "admitted": ok,
+        "fail_code": fail,
+        "feasible": feasible,
+        "fit_margin": margin,
+        "columns": columns,
+        "total": total,
+    }
 
 
 def _encode_fail(ok0, admit_code, fit0_any, filter_code, fallback):
@@ -218,27 +358,9 @@ class Scheduler:
             ok, admit_code = _admit_with_attribution(
                 plugins, state, snap, p, ok0
             )
-            # Filter: built-in resource fit + plugin filters. Nominated
-            # pods' demand holds capacity against lower-or-equal-priority
-            # pods (upstream AddNominatedPods: priority >= evaluated pod,
-            # same UID excluded); a batch nominee stops holding once placed.
-            free_eff = state.free
-            if snap.nominees is not None:
-                nm = snap.nominees
-                live = (
-                    nm.mask
-                    & (nm.priority >= snap.pods.priority[p])
-                    & (nm.batch_idx != p)
-                )
-                if state.placed_mask is not None:
-                    placed_in_batch = (nm.batch_idx >= 0) & state.placed_mask[
-                        jnp.maximum(nm.batch_idx, 0)
-                    ]
-                    live &= ~placed_in_batch
-                hold = jnp.zeros_like(state.free).at[
-                    jnp.maximum(nm.node, 0)
-                ].add(jnp.where(live[:, None], nm.demand, 0))
-                free_eff = state.free - hold
+            # Filter: built-in resource fit (nominee capacity holds
+            # included — see _free_with_nominee_holds) + plugin filters
+            free_eff = _free_with_nominee_holds(state, snap, p)
             fit0 = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
             # Filter chain with attribution (shared helper) — exact
             # against the CARRIED state: the parity path's ground truth
@@ -341,17 +463,25 @@ class Scheduler:
             raise ValueError(f"SPT_SCAN_UNROLL must be >= 1, got {unroll}")
         return unroll
 
-    def solve(self, snap: ClusterSnapshot, state0: Optional[SolverState] = None):
-        """Run the fused plugin pipeline over the snapshot's pending batch."""
+    def solve(self, snap: ClusterSnapshot, state0: Optional[SolverState] = None,
+              auxes=None):
+        """Run the fused plugin pipeline over the snapshot's pending batch.
+        `auxes` overrides the per-plugin traced aux pytrees (normally
+        recomputed from the prepared plugins) — the flight-recorder replay
+        path (`tools/replay.py`) force-binds the RECORDED arrays so the
+        solve consumes exactly what the recorded cycle saw."""
         if state0 is None:
             state0 = self.initial_state(snap)
-        auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
+        if auxes is None:
+            auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
         unroll = self._scan_unroll()
         key = ("solve", unroll) + tuple(
             plugin.static_key() for plugin in self.profile.plugins
         )
         if key not in self._solve_cache:
-            self._solve_cache[key] = self._make_solve(unroll)
+            self._solve_cache[key] = obs.compile_watch(
+                self._make_solve(unroll), program="solve"
+            )
         return self._solve_cache[key](snap, state0, auxes)
 
     def filter_verdicts(self, snap: ClusterSnapshot, pod_index: int):
@@ -381,13 +511,15 @@ class Scheduler:
                         feasible &= mask
                 return feasible
 
-            self._solve_cache[key] = jax.jit(verdicts)
+            self._solve_cache[key] = obs.compile_watch(
+                jax.jit(verdicts), program="filter_verdicts"
+            )
         auxes = tuple(plugin.aux() for plugin in plugins)
         return self._solve_cache[key](
             snap, self.initial_state(snap), auxes, pod_index
         )
 
-    # -- attribution ----------------------------------------------------
+    # -- attribution / explain ------------------------------------------
     def fail_plugin_names(self) -> list:
         """Decoder for attribution codes (`SolveResult.failed_plugin` /
         `attribution_codes`): code 0 (and any negative code on a failed
@@ -449,12 +581,55 @@ class Scheduler:
 
                 return jax.vmap(one)(idx)
 
-            self._solve_cache[key] = jax.jit(codes)
+            self._solve_cache[key] = obs.compile_watch(
+                jax.jit(codes), program="attribution"
+            )
         auxes = tuple(plugin.aux() for plugin in plugins)
         out = self._solve_cache[key](
             snap, self.initial_state(snap), auxes, jnp.asarray(idx_padded)
         )
         return np.asarray(out)[: idx.size]
+
+    def explain_rows(self, snap: ClusterSnapshot, indices, auxes=None):
+        """Per-plugin score decomposition for the `indices` pod rows
+        against the CYCLE-INITIAL state — the "why this node" surface
+        behind `CycleReport.explain`, the daemon's `/explain?uid=` and
+        `tools/replay.py explain` (the upstream `--v=10` per-plugin score
+        dump). Row work is (S, N) for S requested rows, padded to a
+        power-of-two bucket like `attribution_codes` so retraces stay
+        bounded; `auxes` force-binds recorded config arrays on replay.
+
+        Returns host numpy arrays (each sliced to len(indices)):
+        `admitted` (S,), `fail_code` (S,) int32 (`_encode_fail` encoding,
+        -1 = feasible cycle-initially), `feasible` (S, N), `fit_margin`
+        (S, N) int64 (min over resources of effective free - demand,
+        nominee capacity holds included — `_free_with_nominee_holds`, the
+        same fit the solve step enforces; -2^62 on masked nodes),
+        `columns` (S, L, N) int64 weighted normalized
+        per-plugin scores in profile order, `total` (S, N) int64 = the
+        column sum, which reproduces the solve step's weighted node score
+        (`_score_columns` is the same code path).
+
+        Scores are cycle-initial — the objective both solve modes rank by
+        (`parallel.solver.profile_initial_scores`); in-cycle carry effects
+        on later pods' scores are a sequential-scan refinement this
+        surface deliberately does not chase (the batched/streamed solves
+        never see them either). `parallel.solver.batch_explain_rows`
+        computes these same outputs through the batched solver's
+        class-collapsed row hooks; tests/test_explain.py gates the two
+        for agreement."""
+        plugins = tuple(self.profile.plugins)
+
+        def explain(snap, state0, auxes, idx):
+            for plugin, aux in zip(plugins, auxes):
+                plugin.bind_aux(aux)
+            for plugin in plugins:
+                plugin.bind_presolve(plugin.prepare_solve(snap))
+            return jax.vmap(
+                lambda p: _explain_one(plugins, state0, snap, p)
+            )(idx)
+
+        return run_explain_rows(self, snap, indices, auxes, "explain", explain)
 
     def initial_state(self, snap: ClusterSnapshot) -> SolverState:
         free = free_capacity(snap.nodes.alloc, snap.nodes.requested)

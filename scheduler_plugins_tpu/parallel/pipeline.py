@@ -195,10 +195,12 @@ def donated_chunk_solver(fn, carry_argnum: int):
     """
     from scheduler_plugins_tpu.utils import sanitize
 
+    name = getattr(fn, "__name__", "solve_chunk")
     if sanitize.enabled():
-        name = getattr(fn, "__name__", "solve_chunk")
-        return sanitize.checkified(fn, program=f"chunk:{name}")
-    return jax.jit(fn, donate_argnums=(carry_argnum,))
+        jitted = sanitize.checkified(fn, program=f"chunk:{name}")
+    else:
+        jitted = jax.jit(fn, donate_argnums=(carry_argnum,))
+    return obs.compile_watch(jitted, program=f"chunk:{name}")
 
 
 def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
@@ -320,7 +322,7 @@ def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
             # vmap + raw static ranking + masked initial free)
             return fast_solve_head(plugins, scoring, snap, state0, auxes)
 
-        cache[key] = jax.jit(head)
+        cache[key] = obs.compile_watch(jax.jit(head), program="streamed_head")
     admitted, raw, free0 = cache[key](snap, state0, auxes)
 
     from scheduler_plugins_tpu.utils import sanitize

@@ -33,6 +33,7 @@ from scheduler_plugins_tpu.ops.assign import waterfill_assign_targeted
 from scheduler_plugins_tpu.ops.fit import fits, free_capacity, pod_fit_demand
 from scheduler_plugins_tpu.ops.gang import gang_admit
 from scheduler_plugins_tpu.ops.quota import quota_admit
+from scheduler_plugins_tpu.utils import observability as obs
 
 
 def nominated_aggregates_batch(quota):
@@ -471,13 +472,16 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
         cache = scheduler._solve_cache
         if key not in cache:
             if sanitize.enabled():
-                cache[key] = sanitize.checkified(
+                fast_fn = sanitize.checkified(
                     fast_batch, program="profile_batch_fast"
                 )
             else:
-                cache[key] = _wrap_donated(
+                fast_fn = _wrap_donated(
                     jax.jit(fast_batch, donate_argnums=(1,))
                 )
+            cache[key] = obs.compile_watch(
+                fast_fn, program="profile_batch_fast"
+            )
         return cache[key], (snap, state0, auxes)
     # ------------------------------------------------------------------
 
@@ -499,26 +503,10 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
                 return plugin.filter_batch(state, snap)
             return None
 
-        filter0_rows, score_rows = {}, {}
-        for i, plugin in enumerate(plugins):
-            # fused filter+score rows when offered: one shared-intermediate
-            # pass instead of two (networkaware tallies)
-            if type(plugin).batch_rows is not _PluginBase.batch_rows:
-                fused = plugin.batch_rows(state0, snap)
-                if fused is not None:
-                    f_row, s_row = fused
-                    if f_row is not None:
-                        filter0_rows[i] = f_row
-                    if s_row is not None:
-                        score_rows[i] = s_row
-                    continue
-            m = _batch_filter(plugin, state0)
-            if m is not None:
-                filter0_rows[i] = m
-            if type(plugin).score_batch is not _PluginBase.score_batch:
-                s = plugin.score_batch(state0, snap)
-                if s is not None:
-                    score_rows[i] = s
+        # class-collapsed cycle-initial rows — the shared hook dispatch
+        # (`collapsed_batch_rows`) also feeds `batch_explain_rows`, so the
+        # explain surface sees exactly the rows this solve ranks by
+        filter0_rows, score_rows = collapsed_batch_rows(plugins, state0, snap)
 
         # plugins with batched score rows AND the base identity normalize
         # contribute a feasibility-independent weighted sum — fold them
@@ -728,10 +716,79 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
     cache = scheduler._solve_cache
     if key not in cache:
         if sanitize.enabled():
-            cache[key] = sanitize.checkified(batch, program="profile_batch")
+            batch_fn_j = sanitize.checkified(batch, program="profile_batch")
         else:
-            cache[key] = _wrap_donated(jax.jit(batch, donate_argnums=(1,)))
+            batch_fn_j = _wrap_donated(jax.jit(batch, donate_argnums=(1,)))
+        cache[key] = obs.compile_watch(batch_fn_j, program="profile_batch")
     return cache[key], (snap, state0, auxes)
+
+
+def collapsed_batch_rows(plugins, state0, snap):
+    """(filter_rows, score_rows): plugin position -> class-collapsed whole-
+    batch (P, N) rows from the `batch_rows` / `filter_batch` / `score_batch`
+    hooks against the cycle-initial state — THE one copy of the hook
+    dispatch, shared by the batched profile solve's cycle-initial pass and
+    `batch_explain_rows`, so the explain surface consumes exactly the rows
+    the batched solver ranks by."""
+    from scheduler_plugins_tpu.framework.plugin import Plugin as _PluginBase
+
+    filter_rows, score_rows = {}, {}
+    for i, plugin in enumerate(plugins):
+        # fused filter+score rows when offered: one shared-intermediate
+        # pass instead of two (networkaware tallies)
+        if type(plugin).batch_rows is not _PluginBase.batch_rows:
+            fused = plugin.batch_rows(state0, snap)
+            if fused is not None:
+                f_row, s_row = fused
+                if f_row is not None:
+                    filter_rows[i] = f_row
+                if s_row is not None:
+                    score_rows[i] = s_row
+                continue
+        if type(plugin).filter_batch is not _PluginBase.filter_batch:
+            m = plugin.filter_batch(state0, snap)
+            if m is not None:
+                filter_rows[i] = m
+        if type(plugin).score_batch is not _PluginBase.score_batch:
+            s = plugin.score_batch(state0, snap)
+            if s is not None:
+                score_rows[i] = s
+    return filter_rows, score_rows
+
+
+def batch_explain_rows(scheduler, snap, indices, auxes=None):
+    """The BATCHED twin of `Scheduler.explain_rows`: identical output
+    schema (admitted / fail_code / feasible / fit_margin / columns /
+    total, sliced to len(indices)), but the per-plugin filter verdicts and
+    raw scores come through the batched solver's class-collapsed row hooks
+    (`collapsed_batch_rows`) — the rows `profile_batch_fn`'s cycle-initial
+    pass actually ranks by — fed into the SAME shared explain body
+    (`framework.runtime._explain_one`). The two entries differ only in
+    where rows come from, so sequential and batched explains cannot
+    drift; tests/test_explain.py asserts exact agreement."""
+    from scheduler_plugins_tpu.framework.runtime import (
+        _explain_one,
+        run_explain_rows,
+    )
+
+    plugins = tuple(scheduler.profile.plugins)
+
+    def explain(snap, state0, auxes, idx):
+        for plugin, aux in zip(plugins, auxes):
+            plugin.bind_aux(aux)
+        for plugin in plugins:
+            plugin.bind_presolve(plugin.prepare_solve(snap))
+        filter_rows, score_rows = collapsed_batch_rows(plugins, state0, snap)
+        return jax.vmap(
+            lambda p: _explain_one(
+                plugins, state0, snap, p,
+                filter_rows=filter_rows, score_rows=score_rows,
+            )
+        )(idx)
+
+    return run_explain_rows(
+        scheduler, snap, indices, auxes, "batch_explain", explain
+    )
 
 
 def profile_initial_scores(scheduler, snap):
@@ -779,7 +836,9 @@ def profile_initial_scores(scheduler, snap):
 
             return jax.vmap(per_pod)(jnp.arange(snap.num_pods))
 
-        cache[key] = jax.jit(scores_fn)
+        cache[key] = obs.compile_watch(
+            jax.jit(scores_fn), program="profile_scores"
+        )
     return cache[key](snap, state0, auxes)
 
 
@@ -813,7 +872,10 @@ def sharded_batch_solve(snap, mesh, weights, max_waves: int = 8):
 
     snap = shard_snapshot(snap, mesh)
     with ambient_mesh(mesh):
-        fn = jax.jit(lambda s, w: batch_solve(s, w, max_waves))
+        fn = obs.compile_watch(
+            jax.jit(lambda s, w: batch_solve(s, w, max_waves)),
+            program="sharded_batch_solve",
+        )
         return fn(snap, weights)
 
 

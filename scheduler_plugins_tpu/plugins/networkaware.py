@@ -101,6 +101,18 @@ class NetworkOverhead(Plugin):
             return None
         return (self._zone_cost, self._region_cost)
 
+    def host_state(self):
+        # cost matrices come from the live Cluster's NetworkTopology CR;
+        # replay rebuilds without a Cluster (prepare_cluster then bakes
+        # all -1 matrices), so record the real ones for an exact rebuild
+        if self._zone_cost is None:
+            return None
+        return {"zone_cost": self._zone_cost, "region_cost": self._region_cost}
+
+    def restore_host_state(self, state) -> None:
+        self._zone_cost = jnp.asarray(state["zone_cost"])
+        self._region_cost = jnp.asarray(state["region_cost"])
+
     def _tallies(self, state, snap, p):
         net = snap.network
         placed = state.net_placed if state.net_placed is not None else net.placed_node

@@ -177,6 +177,15 @@ class NodeResourceTopologyMatch(Plugin):
             "w_f32_ok", self._weights_f32_ok(),
         )
 
+    def host_state(self):
+        # the scope specialization comes from the live Cluster's NRT CRs —
+        # a replayed bundle has no Cluster, so record it
+        return {"uniform_scope": getattr(self, "_uniform_scope", None)}
+
+    def restore_host_state(self, state) -> None:
+        scope = state.get("uniform_scope")
+        self._uniform_scope = None if scope is None else int(scope)
+
     def _weights_f32_ok(self):
         """Whether the f32 fast path keeps the weighted zone-score sums
         exact: per-resource scores are <= 100, so sum(100 * w) over the FULL

@@ -65,6 +65,28 @@ def _render_labels(items) -> str:
     return "{" + inner + "}"
 
 
+def atomic_write(path: str, data) -> None:
+    """Write `data` (str or bytes) to `path` via a same-directory temp file
+    + `os.replace`, fsync'd first — the crash-safe write discipline shared
+    by `Tracer.write` and the flight-recorder bundle writers
+    (utils.flightrec): a process killed mid-write leaves at worst a stray
+    `.tmp.*` file, never a truncated artifact under the real name."""
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 class _Histogram:
     __slots__ = ("counts", "sum", "count", "max")
 
@@ -213,6 +235,148 @@ UNSCHEDULABLE_BY_PLUGIN = "scheduler_unschedulable_by_plugin_total"
 #: per-plugin, per-extension-point latency histogram (labels: plugin,
 #: extension_point) — the upstream plugin_execution_duration_seconds shape
 PLUGIN_EXECUTION = "scheduler_plugin_execution_ms"
+#: compile wall-time histogram (labels: program) — total XLA
+#: trace+lower+compile seconds observed during one watched call that
+#: actually compiled (jax.monitoring compile-duration events, attributed
+#: to the program whose call triggered them)
+JIT_COMPILE = "scheduler_jit_compile_ms"
+#: jit-cache misses per program (labels: program): watched calls during
+#: which a compile event fired — each one paid a fresh trace+compile
+JIT_CACHE_MISS = "scheduler_jit_cache_misses_total"
+#: cycles captured by the flight recorder (utils.flightrec)
+FLIGHTREC_CYCLES = "scheduler_flightrec_cycles_total"
+
+
+# ---------------------------------------------------------------------------
+# Compile observability: per-program jit-cache misses + compile wall time
+# ---------------------------------------------------------------------------
+
+
+class CompileWatch:
+    """Attributes XLA compile wall time to named programs.
+
+    `watch(fn, program=...)` wraps a jitted callable: while a wrapped call
+    runs, a `jax.monitoring` duration listener credits any
+    `/jax/core/compile/...` event (jaxpr trace, MLIR lowering, backend
+    compile) to that program. A call during which at least one compile
+    event fired counts as ONE jit-cache miss
+    (`scheduler_jit_cache_misses_total{program}`) and observes the summed
+    compile seconds into `scheduler_jit_compile_ms{program}`; cache hits
+    cost two thread-local writes and nothing else. Shape signatures
+    (pytree structure + leaf shape/dtype) are collected per program ONLY
+    on misses, and crossing `SPT_SHAPE_CHURN_N` (default 8) distinct
+    signatures logs a shape-churn warning — the signature a mesh-padding
+    bug in `dryrun_multichip` leaves behind is the same program
+    recompiling once per ragged shape instead of hitting one padded
+    bucket.
+
+    The wrapper is transparent to AOT tooling: `functools.wraps` carries
+    the inner jit's `trace`/`lower` attributes through, so
+    `jax.export.export` on a watched callable still exports the exact
+    cached program (the tools/tpu_lower.py seam).
+    """
+
+    def __init__(self):
+        self._signatures: dict[str, set] = {}
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._installed = False
+
+    def _install_listener(self) -> None:
+        with self._lock:
+            if self._installed:
+                return
+            self._installed = True
+        try:
+            from jax import monitoring as _monitoring
+
+            _monitoring.register_event_duration_secs_listener(self._on_event)
+        except Exception:  # jax absent/too old: misses still count, no ms
+            pass
+
+    def _on_event(self, event, duration, **_kw) -> None:
+        if not isinstance(event, str) or not event.startswith(
+            "/jax/core/compile/"
+        ):
+            return
+        if getattr(self._tls, "program", None) is not None:
+            self._tls.compile_s += float(duration)
+
+    @staticmethod
+    def _signature(args, kwargs):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (
+            str(treedef),
+            tuple(
+                (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", "")))
+                for leaf in leaves
+            ),
+        )
+
+    def churn_threshold(self) -> int:
+        try:
+            return int(os.environ.get("SPT_SHAPE_CHURN_N", 8))
+        except ValueError:
+            return 8
+
+    def watch(self, fn, program: str):
+        """Wrap jitted callable `fn` for compile attribution under `program`."""
+        import functools
+
+        self._install_listener()
+        tls = self._tls
+
+        @functools.wraps(fn)
+        def watched(*args, **kwargs):
+            prev = (getattr(tls, "program", None),
+                    getattr(tls, "compile_s", 0.0))
+            tls.program, tls.compile_s = program, 0.0
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                compiled_s = tls.compile_s
+                tls.program, tls.compile_s = prev
+                if compiled_s > 0.0:
+                    metrics.inc(JIT_CACHE_MISS, program=program)
+                    metrics.observe_ms(
+                        JIT_COMPILE, compiled_s * 1000.0, program=program
+                    )
+                    # shape churn: signatures only collected on misses
+                    # (the hit path never pays the pytree flatten)
+                    try:
+                        sig = self._signature(args, kwargs)
+                    except Exception:
+                        sig = None
+                    if sig is not None:
+                        with self._lock:
+                            seen = self._signatures.setdefault(program, set())
+                            fresh = sig not in seen
+                            seen.add(sig)
+                            n = len(seen)
+                        # warn only when a NEW distinct signature lands past
+                        # the threshold — a re-miss of a seen shape (cache
+                        # eviction, new scheduler instance) must not spam
+                        if fresh and n > self.churn_threshold():
+                            logger.warning(
+                                "shape churn: program %r has compiled %d "
+                                "distinct shape signatures this run — "
+                                "inputs are probably not landing on padded "
+                                "buckets (mesh-aligned padding bug?)",
+                                program, n,
+                            )
+
+        return watched
+
+
+#: global compile watcher; `compile_watch(fn, program=...)` is the
+#: cache-insertion-site hook (runtime/solver/pipeline jit caches)
+_compile_watch = CompileWatch()
+
+
+def compile_watch(fn, program: str):
+    return _compile_watch.watch(fn, program=program)
 
 
 # ---------------------------------------------------------------------------
@@ -323,8 +487,11 @@ class Tracer:
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
     def write(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.export(), f)
+        """Export to `path` atomically (temp file + `os.replace`): a crash —
+        or SIGKILL — mid-write can never leave a truncated, unparsable
+        trace at the target path (the reader sees either the previous
+        complete file or the new complete file)."""
+        atomic_write(path, json.dumps(self.export()))
 
 
 #: global tracer, off by default (`bench.py --trace out.json` and

@@ -1,0 +1,382 @@
+"""Explain correctness ("why this node", the upstream --v=10 score dump).
+
+Decision table over the mixed and metric plugin rosters asserting, for
+every scoring plugin:
+
+- the explain columns are exactly `weight * normalize(raw, feasible)` and
+  sum (int64, intmath rounding included — the same trunc-division
+  normalize the solver runs) to the solver's total node score — anchored
+  against `profile_initial_scores`, the independent (P, N) objective both
+  solve modes rank by, NOT against explain's own arithmetic;
+- the sequential explain (`Scheduler.explain_rows`, per-pod tensor
+  methods) and the batched explain (`parallel.solver.batch_explain_rows`,
+  class-collapsed row hooks) agree EXACTLY — on failed rows and on every
+  other row — so a postmortem reads the same table whichever solve mode
+  produced the cycle;
+- the explain winner is the solver's actual first-pod decision (pod 0's
+  carried state IS the cycle-initial state, so the two must agree there);
+- `CycleReport.explain(uid)` round-trips through a real cycle and names
+  the same plugin the attribution path recorded.
+"""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import Container, Pod
+from scheduler_plugins_tpu.api.resources import CPU
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.models import (
+    metric_affinity_scenario,
+    mixed_scenario,
+)
+from scheduler_plugins_tpu.parallel.solver import (
+    batch_explain_rows,
+    profile_initial_scores,
+)
+from scheduler_plugins_tpu.plugins import (
+    InterPodAffinity,
+    LoadVariationRiskBalancing,
+    NetworkOverhead,
+    NodeResourcesAllocatable,
+    NodeResourceTopologyMatch,
+    PodTopologySpread,
+    SySched,
+    TargetLoadPacking,
+)
+
+
+def _mixed_roster():
+    cluster = mixed_scenario(n_nodes=8, n_pods=16)
+    # heterogeneous allocatable: identical nodes min-max-normalize every
+    # allocatable score to 0, which would leave that plugin's explain
+    # column trivially zero — spread capacities so the column is real
+    for i, node in enumerate(cluster.nodes.values()):
+        node.allocatable[CPU] = node.allocatable.get(CPU, 8000) + 1000 * i
+    # an ASSIGNED dependency pod: with no placed workload pods every node's
+    # network cost ties (and min-max normalizes to one flat column); one
+    # placed wl-0 member makes the cost — and the explain column — vary
+    # by region/zone
+    from scheduler_plugins_tpu.api.objects import (
+        APP_GROUP_LABEL,
+        WORKLOAD_SELECTOR_LABEL,
+    )
+
+    dep = Pod(
+        name="placed-dep", creation_ms=0,
+        containers=[Container(requests={CPU: 100})],
+        labels={APP_GROUP_LABEL: "mesh", WORKLOAD_SELECTOR_LABEL: "wl-0"},
+    )
+    dep.node_name = next(iter(cluster.nodes))
+    cluster.add_pod(dep)
+    return (
+        cluster,
+        [NodeResourcesAllocatable(), NodeResourceTopologyMatch(),
+         NetworkOverhead(), PodTopologySpread()],
+    )
+
+
+def _metric_roster():
+    return (
+        metric_affinity_scenario(n_nodes=8, n_pods=16),
+        [TargetLoadPacking(), LoadVariationRiskBalancing(),
+         InterPodAffinity(), SySched()],
+    )
+
+
+ROSTERS = {"mixed": _mixed_roster, "metric": _metric_roster}
+
+
+def _prepared(roster, with_unschedulable=True):
+    cluster, plugins = ROSTERS[roster]()
+    if with_unschedulable:
+        # guarantee at least one failed row for the failed-row assertions
+        cluster.add_pod(Pod(
+            name="impossible", creation_ms=10 ** 6,
+            containers=[Container(requests={CPU: 10 ** 9})],
+        ))
+    scheduler = Scheduler(Profile(plugins=plugins))
+    for p in plugins:
+        p.configure_cluster(cluster)
+    pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    scheduler.prepare(meta, cluster)
+    return cluster, scheduler, snap, meta, pending
+
+
+class TestExplainColumnsSumToSolverTotal:
+    @pytest.mark.parametrize("roster", sorted(ROSTERS))
+    def test_columns_sum_matches_profile_objective(self, roster):
+        _, scheduler, snap, meta, pending = _prepared(roster)
+        rows = scheduler.explain_rows(snap, list(range(len(pending))))
+        # independent anchor: the (P, N) objective both solve modes rank by
+        totals, _ = profile_initial_scores(scheduler, snap)
+        totals = np.asarray(totals)
+        admitted = rows["admitted"]
+        assert admitted.any()
+        for i in np.nonzero(admitted)[0]:
+            np.testing.assert_array_equal(
+                rows["columns"][i].sum(axis=0), rows["total"][i],
+                err_msg=f"pod {i}: columns do not sum to explain total",
+            )
+            np.testing.assert_array_equal(
+                rows["total"][i], totals[i],
+                err_msg=f"pod {i}: explain total != solver objective",
+            )
+
+    @pytest.mark.parametrize("roster", sorted(ROSTERS))
+    def test_every_scoring_plugin_contributes_a_column(self, roster):
+        _, scheduler, snap, meta, pending = _prepared(
+            roster, with_unschedulable=False
+        )
+        rows = scheduler.explain_rows(snap, list(range(len(pending))))
+        from scheduler_plugins_tpu.framework.plugin import Plugin
+
+        for l, plugin in enumerate(scheduler.profile.plugins):
+            scores = type(plugin).score is not Plugin.score
+            col = rows["columns"][:, l, :]
+            if scores:
+                assert np.any(col != 0), (
+                    f"{plugin.name}: scoring plugin produced an all-zero "
+                    "explain column across the whole batch — the roster "
+                    "does not exercise it"
+                )
+            else:
+                assert not np.any(col != 0), (
+                    f"{plugin.name} has no Score but a nonzero column"
+                )
+
+    def test_weights_scale_columns_with_intmath_rounding(self):
+        cluster, plugins = _mixed_roster()
+        plugins[0].weight = 3  # allocatable
+        scheduler = Scheduler(Profile(plugins=plugins))
+        for p in plugins:
+            p.configure_cluster(cluster)
+        pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        scheduler.prepare(meta, cluster)
+        rows = scheduler.explain_rows(snap, [0])
+        # the weighted column is weight x the unit-weight normalize —
+        # scaling happens AFTER the trunc-division normalize, exactly as
+        # the solve's weighted sum applies it
+        totals, _ = profile_initial_scores(scheduler, snap)
+        np.testing.assert_array_equal(
+            rows["columns"][0].sum(axis=0), np.asarray(totals)[0]
+        )
+        assert rows["columns"][0][0].max() >= 0
+        base = rows["columns"][0][0] // 3
+        np.testing.assert_array_equal(rows["columns"][0][0], base * 3)
+
+
+class TestSequentialVsBatchedExplain:
+    @pytest.mark.parametrize("roster", sorted(ROSTERS))
+    def test_agree_on_failed_rows(self, roster):
+        _, scheduler, snap, meta, pending = _prepared(roster)
+        assignment = np.asarray(scheduler.solve(snap).assignment)
+        failed = [
+            i for i in range(len(pending)) if assignment[i] < 0
+        ]
+        assert failed, "scenario produced no failed rows"
+        seq = scheduler.explain_rows(snap, failed)
+        bat = batch_explain_rows(scheduler, snap, failed)
+        for field in ("admitted", "fail_code", "feasible", "fit_margin",
+                      "columns", "total"):
+            np.testing.assert_array_equal(
+                seq[field], bat[field],
+                err_msg=f"sequential vs batched explain drift in {field!r}",
+            )
+
+    @pytest.mark.parametrize("roster", sorted(ROSTERS))
+    def test_agree_on_all_rows(self, roster):
+        _, scheduler, snap, meta, pending = _prepared(roster)
+        idx = list(range(len(pending)))
+        seq = scheduler.explain_rows(snap, idx)
+        bat = batch_explain_rows(scheduler, snap, idx)
+        for field in ("columns", "total", "feasible"):
+            np.testing.assert_array_equal(seq[field], bat[field])
+
+
+class TestExplainDecisionAnchors:
+    @pytest.mark.parametrize("roster", sorted(ROSTERS))
+    def test_winner_is_the_solvers_first_pod_choice(self, roster):
+        # pod 0 sees the pristine carry, so the cycle-initial explain
+        # winner must be the sequential solve's actual choice for it
+        from scheduler_plugins_tpu.utils import flightrec
+
+        _, scheduler, snap, meta, pending = _prepared(
+            roster, with_unschedulable=False
+        )
+        assignment = np.asarray(scheduler.solve(snap).assignment)
+        table = flightrec.explain_solver(
+            scheduler, snap, meta, meta.pod_names[0], top_k=3,
+            assignment=assignment,
+        )
+        if assignment[0] >= 0:
+            assert table["winner"] == meta.node_names[assignment[0]]
+            assert table["assigned"] == table["winner"]
+            assert table["candidates"][0]["gap_to_winner"] == 0
+        else:
+            assert table["failed_plugin"] is not None
+
+    def test_explain_schema_valid_on_live_table(self):
+        from tools.replay import validate_explain
+        from scheduler_plugins_tpu.utils import flightrec
+
+        _, scheduler, snap, meta, pending = _prepared("mixed")
+        assignment = np.asarray(scheduler.solve(snap).assignment)
+        for uid in (meta.pod_names[0], "default/impossible"):
+            table = flightrec.explain_solver(
+                scheduler, snap, meta, uid, assignment=assignment
+            )
+            assert validate_explain(table) == [], uid
+
+    def test_cycle_report_explain_round_trip(self):
+        cluster, plugins = _mixed_roster()
+        cluster.add_pod(Pod(
+            name="impossible", creation_ms=10 ** 6,
+            containers=[Container(requests={CPU: 10 ** 9})],
+        ))
+        report = run_cycle(
+            Scheduler(Profile(plugins=plugins)), cluster, now=1000
+        )
+        assert "default/impossible" in report.failed_by
+        table = report.explain("default/impossible")
+        assert table["failed_plugin"] == report.failed_by[
+            "default/impossible"
+        ]
+        assert table["placed"] is False
+        if report.bound:
+            uid, node = next(iter(report.bound.items()))
+            placed = report.explain(uid)
+            assert placed["assigned"] == node
+            assert placed["failed_plugin"] is None
+        with pytest.raises(KeyError):
+            report.explain("not/a-pod")
+
+    def test_nominee_holds_reach_the_explain_fit(self):
+        # a nominated pod's demand holds node capacity against lower-
+        # priority pods in the solve step (_free_with_nominee_holds); the
+        # explain fit must see the SAME held capacity, or it would call a
+        # node feasible (with a positive margin) that the solver rejected
+        from scheduler_plugins_tpu.api.objects import Node
+        from scheduler_plugins_tpu.api.resources import MEMORY, PODS
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        gib = 1 << 30
+        cluster = Cluster()
+        cluster.add_node(Node(
+            name="n0",
+            allocatable={CPU: 4000, MEMORY: 8 * gib, PODS: 110},
+        ))
+        nom = Pod(name="nom", creation_ms=0, priority=10,
+                  containers=[Container(requests={CPU: 3000})])
+        nom.nominated_node_name = "n0"
+        cluster.add_pod(nom)
+        cluster.add_pod(Pod(
+            name="low", creation_ms=1, priority=1,
+            containers=[Container(requests={CPU: 3000})],
+        ))
+        report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+            cluster, now=1000,
+        )
+        assert report.bound.get("default/nom") == "n0"
+        assert "default/low" in report.failed_by
+        # cycle-initially the hold (not yet the nominee's placement) is
+        # what makes n0 infeasible for the lower-priority pod: 4000 free
+        # - 3000 held < 3000 requested -> margin -2000, builtin-fit fail
+        table = report.explain("default/low")
+        cand = table["candidates"][0]
+        assert table["placed"] is False
+        assert table["failed_plugin"] == "NodeResourcesFit"
+        assert cand["feasible"] is False
+        assert cand["fit_margin"] == -2000
+        # the nominee itself never holds against its own row
+        own = report.explain("default/nom")
+        assert own["candidates"][0]["feasible"] is True
+
+    def test_empty_cycle_has_nothing_to_explain(self):
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+            Cluster(), now=1000,
+        )
+        with pytest.raises(RuntimeError, match="no solve"):
+            report.explain("any/pod")
+
+    def test_unschedulable_pod_lists_best_scoring_near_misses(self):
+        # the primary postmortem case: every node infeasible. The table
+        # must still rank candidates by score (best near-miss first), not
+        # degrade to node-index order
+        from scheduler_plugins_tpu.utils import flightrec
+
+        _, scheduler, snap, meta, pending = _prepared("mixed")
+        assignment = np.asarray(scheduler.solve(snap).assignment)
+        n_nodes = len(meta.node_names)
+        table = flightrec.explain_solver(
+            scheduler, snap, meta, "default/impossible",
+            top_k=n_nodes, assignment=assignment,
+        )
+        assert table["winner"] is None
+        assert all(not c["feasible"] for c in table["candidates"])
+        totals = [c["total"] for c in table["candidates"]]
+        assert totals == sorted(totals, reverse=True)
+        # full-table top_k: the head really is the global best near-miss
+        idx = meta.pod_names.index("default/impossible")
+        rows = scheduler.explain_rows(snap, [idx])
+        assert totals[0] == int(rows["total"][0][:n_nodes].max())
+        assert len({c["node"] for c in table["candidates"]}) == n_nodes
+
+    def test_explain_ctx_retention_window(self, monkeypatch):
+        # retaining every CycleReport must not pin every snapshot ever
+        # solved: beyond SPT_EXPLAIN_RETAIN reports, the oldest releases
+        # its explain context (and says so), the newest still explains
+        from scheduler_plugins_tpu.api.objects import Node
+        from scheduler_plugins_tpu.api.resources import MEMORY, PODS
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        monkeypatch.setenv("SPT_EXPLAIN_RETAIN", "2")
+
+        def one_cycle():
+            cluster = Cluster()
+            cluster.add_node(Node(
+                name="n0",
+                allocatable={CPU: 4000, MEMORY: 1 << 33, PODS: 110},
+            ))
+            cluster.add_pod(Pod(
+                name="p", creation_ms=0,
+                containers=[Container(requests={CPU: 100})],
+            ))
+            return run_cycle(
+                Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+                cluster, now=1000,
+            )
+
+        reports = [one_cycle() for _ in range(3)]
+        with pytest.raises(RuntimeError, match="released"):
+            reports[0].explain("default/p")
+        assert reports[-1].explain("default/p")["placed"] is True
+
+        # 0 disables explain outright — nothing pinned, not even the
+        # current cycle's snapshot
+        monkeypatch.setenv("SPT_EXPLAIN_RETAIN", "0")
+        with pytest.raises(RuntimeError, match="released"):
+            one_cycle().explain("default/p")
+
+    def test_retained_report_explains_with_its_own_cycles_aux(self):
+        # the ctx freezes the cycle's aux pytrees: a later cycle's
+        # prepare() rebinds the SHARED plugins to a differently-shaped
+        # cluster, and an old report's explain must still score against
+        # the config its own solve saw — not the live (wrong-shape) aux
+        cluster_a, plugins = _mixed_roster()
+        scheduler = Scheduler(Profile(plugins=plugins))
+        report_a = run_cycle(scheduler, cluster_a, now=1000)
+        uid, node = next(iter(report_a.bound.items()))
+        before = report_a.explain(uid)
+        assert before["assigned"] == node
+
+        cluster_b = mixed_scenario(n_nodes=4, n_pods=8)
+        run_cycle(scheduler, cluster_b, now=2000)
+
+        after = report_a.explain(uid)
+        assert after == before

@@ -1,0 +1,465 @@
+"""Flight recorder (utils.flightrec): ring-buffer capture through the real
+`run_cycle` hooks, bundle save/load round-trips, bit-identical replay
+through the sequential parity path, crash-safe (temp+rename) writes —
+including a real SIGKILL-mid-write subprocess test — and the compile
+observability metrics (`scheduler_jit_compile_ms{program}` / cache-miss
+counters / shape-churn warning).
+
+The committed golden bundle under tests/fixtures/flightrec/ is generated
+by `PYTHONPATH=. python tests/test_flightrec.py --regen` (deterministic cluster, no
+RNG); the round-trip test replays it and asserts bit-identical placements
+and a stable digest — a solver change that breaks replay determinism
+fails here before it corrupts anyone's postmortem.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    Pod,
+    PodGroup,
+    POD_GROUP_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.plugins import (
+    CapacityScheduling,
+    Coscheduling,
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import flightrec, observability as obs
+
+gib = 1 << 30
+
+FIXTURE_DIR = Path(__file__).parent / "fixtures" / "flightrec"
+
+
+def make_cluster() -> Cluster:
+    """Deterministic mini cluster: a gang, plain pods, one unschedulable
+    pod — exercises gang/quota admits, placements AND a failure row."""
+    c = Cluster()
+    for i in range(8):
+        c.add_node(Node(
+            name=f"n{i}",
+            allocatable={CPU: 16000, MEMORY: 64 * gib, PODS: 110},
+        ))
+    c.add_pod_group(PodGroup(name="g", namespace="default", min_member=2,
+                             creation_ms=0))
+    for p in range(12):
+        kw = {"labels": {POD_GROUP_LABEL: "g"}} if p < 2 else {}
+        c.add_pod(Pod(
+            name=f"p{p:02d}", creation_ms=p,
+            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+            **kw,
+        ))
+    c.add_pod(Pod(
+        name="huge", creation_ms=99,
+        containers=[Container(requests={CPU: 10 ** 9})],
+    ))
+    return c
+
+
+def make_scheduler() -> Scheduler:
+    return Scheduler(Profile(plugins=[
+        NodeResourcesAllocatable(), Coscheduling(), CapacityScheduling(),
+    ]))
+
+
+@pytest.fixture
+def recorder_off():
+    yield
+    flightrec.recorder.stop()
+
+
+class TestRecorderRing:
+    def test_disabled_recorder_captures_nothing(self, recorder_off):
+        flightrec.recorder.stop()
+        report = run_cycle(make_scheduler(), make_cluster(), now=1000)
+        assert report.bound  # the cycle itself ran
+        assert flightrec.recorder.begin(now_ms=0, profile="x") is None
+
+    def test_cycle_hooks_capture_inputs_and_outputs(self, recorder_off):
+        flightrec.recorder.start(capacity=4)
+        report = run_cycle(make_scheduler(), make_cluster(), now=1000)
+        recs = flightrec.recorder.records()
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec.complete
+        assert rec.manifest["snapshot"] is not None
+        assert rec.manifest["outputs"]["mode"] == "sequential"
+        assert rec.manifest["report"]["failed_by"] == report.failed_by
+        # queue order is the meta's pod_names order
+        assert "default/huge" in rec.pod_names
+        assert rec.manifest["profile_config"]["plugins"] == [
+            "NodeResourcesAllocatable", "Coscheduling", "CapacityScheduling",
+        ]
+
+    def test_ring_is_bounded(self, recorder_off):
+        flightrec.recorder.start(capacity=2)
+        for k in range(4):
+            run_cycle(make_scheduler(), make_cluster(), now=1000 + k)
+        recs = flightrec.recorder.records()
+        assert len(recs) == 2
+        assert [r.seq for r in recs] == [3, 4]
+
+    def test_find_newest_record_for_uid(self, recorder_off):
+        flightrec.recorder.start(capacity=4)
+        run_cycle(make_scheduler(), make_cluster(), now=1000)
+        run_cycle(make_scheduler(), make_cluster(), now=2000)
+        rec = flightrec.recorder.find("default/huge")
+        assert rec is not None and rec.seq == 2
+        assert flightrec.recorder.find("default/huge", cycle=1).seq == 1
+        assert flightrec.recorder.find("nope/nope") is None
+
+
+class TestBundleRoundTrip:
+    def _record_and_save(self, tmp_path):
+        flightrec.recorder.start(capacity=2)
+        report = run_cycle(make_scheduler(), make_cluster(), now=1000)
+        summary = flightrec.recorder.save(str(tmp_path))
+        flightrec.recorder.stop()
+        return report, summary
+
+    def test_replay_is_bit_identical_with_stable_digest(
+        self, tmp_path, recorder_off
+    ):
+        report, summary = self._record_and_save(tmp_path)
+        assert summary["cycles"] == 1
+        cycles = flightrec.load_bundle(str(tmp_path))
+        assert len(cycles) == 1
+        assert cycles[0].digest_ok()
+        out = flightrec.replay_cycle(cycles[0])
+        assert out["mode"] == "sequential"
+        assert out["profile_faithful"] and out["aux_match"]
+        assert out["placements_match"], out["mismatches"]
+        assert out["placed_replayed"] == len(report.bound) + len(
+            report.reserved
+        )
+
+    def test_save_appends_to_existing_bundle(self, tmp_path, recorder_off):
+        """Successive saves into one directory accumulate cycles (the
+        bench --record-per-config workflow) instead of clobbering the
+        manifest, and re-saving the same ring does not duplicate."""
+        _, summary = self._record_and_save(tmp_path)
+        assert summary["cycles"] == 1
+        # second run: fresh ring, same directory
+        flightrec.recorder.start(capacity=2)
+        run_cycle(make_scheduler(), make_cluster(), now=2000)
+        summary2 = flightrec.recorder.save(str(tmp_path))
+        # idempotent re-save of the same ring
+        summary3 = flightrec.recorder.save(str(tmp_path))
+        flightrec.recorder.stop()
+        assert summary2["cycles"] == 2
+        assert summary3["cycles"] == 2
+        cycles = flightrec.load_bundle(str(tmp_path))
+        assert [c.manifest["now_ms"] for c in cycles] == [1000, 2000]
+        assert all(c.digest_ok() for c in cycles)
+        for lc in cycles:
+            assert flightrec.replay_cycle(lc)["placements_match"]
+
+    def test_snapshot_arrays_content_addressed(self, tmp_path, recorder_off):
+        self._record_and_save(tmp_path)
+        cycles = flightrec.load_bundle(str(tmp_path))
+        snap = cycles[0].snapshot()
+        rec_blob_names = set(
+            p.stem for p in (tmp_path / "blobs").glob("*.npy")
+        )
+        # every blob file's name IS its content digest
+        for name in rec_blob_names:
+            arr = np.load(tmp_path / "blobs" / f"{name}.npy",
+                          allow_pickle=False)
+            assert flightrec.array_digest(arr) == name
+        assert snap.pods.req.shape[1] >= 4  # canonical axis present
+
+    def test_tampered_blob_detected(self, tmp_path, recorder_off):
+        self._record_and_save(tmp_path)
+        cycles = flightrec.load_bundle(str(tmp_path))
+        for blob in sorted((tmp_path / "blobs").glob("*.npy")):
+            arr = np.load(blob, allow_pickle=False)
+            if arr.size and arr.dtype != bool:
+                arr.reshape(-1)[0] += 1
+                np.save(blob, arr)
+                break
+        else:
+            pytest.fail("no mutable blob found")
+        with pytest.raises(ValueError, match="does not match"):
+            cycles[0].snapshot()
+            cycles[0].auxes()
+            cycles[0].output("assignment")
+
+    def test_pack_unpack_preserves_static_fields(self, recorder_off):
+        # NumaState.pack_scales (pytree_node=False tuple) and the
+        # scheduling table's static bool must survive the round trip
+        from scheduler_plugins_tpu.models import mixed_scenario
+
+        cluster = mixed_scenario(n_nodes=8, n_pods=16)
+        pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        blobs = {}
+        spec = flightrec.pack_pytree(snap, blobs)
+        rebuilt = flightrec.unpack_pytree(spec, blobs)
+        assert type(rebuilt) is type(snap)
+        if snap.numa is not None:
+            assert rebuilt.numa.pack_scales == snap.numa.pack_scales
+        if snap.scheduling is not None:
+            assert (rebuilt.scheduling.spread_needs_node_counts
+                    == snap.scheduling.spread_needs_node_counts)
+        import jax
+
+        for a, b in zip(jax.tree.leaves(snap), jax.tree.leaves(rebuilt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestHostStateRestore:
+    """Cluster-derived trace specializations (NRT uniform topology-manager
+    scope, NetworkOverhead cost matrices) come from the live Cluster's CRs
+    — a replayed bundle has no Cluster, so `prepare(meta, None)` resets
+    them to unspecialized defaults. The recorded per-plugin `host_state`
+    must re-bake them: without it the rebuilt solve traces a different
+    (NRT: numerically equivalent; NetworkOverhead: all -1 cost) program
+    and the static_key/aux fidelity checks report an unfaithful profile."""
+
+    def test_mixed_roster_replay_is_faithful(self, tmp_path, recorder_off):
+        from scheduler_plugins_tpu.models import mixed_scenario
+        from scheduler_plugins_tpu.plugins import (
+            NetworkOverhead,
+            NodeResourceTopologyMatch,
+        )
+
+        cluster = mixed_scenario(n_nodes=8, n_pods=12)
+        scheduler = Scheduler(Profile(plugins=[
+            NodeResourcesAllocatable(), NodeResourceTopologyMatch(),
+            NetworkOverhead(),
+        ]))
+        flightrec.recorder.start(capacity=1)
+        run_cycle(scheduler, cluster, now=1000)
+        flightrec.recorder.save(str(tmp_path))
+        flightrec.recorder.stop()
+
+        lc = flightrec.load_bundle(str(tmp_path))[0]
+        by_class = {p["class"]: p for p in lc.manifest["plugins"]}
+        assert by_class["NodeResourceTopologyMatch"]["host_state"] is not None
+        assert by_class["NetworkOverhead"]["host_state"] is not None
+
+        out = flightrec.replay_cycle(lc)
+        assert out["profile_faithful"], "static_key mismatch after restore"
+        assert out["aux_match"]
+        assert out["placements_match"], out["mismatches"]
+
+        # the rebuilt plugins really re-baked the recorded specializations
+        rebuilt, faithful = lc.scheduler()
+        assert faithful
+        nrt = next(p for p in rebuilt.profile.plugins
+                   if isinstance(p, NodeResourceTopologyMatch))
+        assert nrt._uniform_scope is not None
+        net = next(p for p in rebuilt.profile.plugins
+                   if isinstance(p, NetworkOverhead))
+        assert (np.asarray(net._zone_cost) != -1).any()
+
+    def test_old_bundle_without_host_state_still_loads(self):
+        # the committed golden fixture predates the host_state field:
+        # absence must mean "nothing to restore", not a crash
+        lc = flightrec.load_bundle(str(FIXTURE_DIR))[0]
+        assert all("host_state" not in p or p["host_state"] is None
+                   for p in lc.manifest["plugins"])
+        out = flightrec.replay_cycle(lc)
+        assert out["placements_match"]
+
+
+class TestGoldenFixture:
+    """The committed bundle must keep replaying bit-identically: replay
+    determinism IS the product here, so the fixture is the regression
+    canary (regen: `PYTHONPATH=. python tests/test_flightrec.py --regen`)."""
+
+    def test_fixture_present(self):
+        assert (FIXTURE_DIR / "cycles.jsonl").exists(), (
+            "golden bundle missing — PYTHONPATH=. python tests/test_flightrec.py --regen"
+        )
+
+    def test_fixture_replays_bit_identical(self):
+        cycles = flightrec.load_bundle(str(FIXTURE_DIR))
+        assert len(cycles) == 1
+        lc = cycles[0]
+        # stable digest: the manifest's recorded digest matches a fresh
+        # recomputation over the loaded content
+        assert lc.digest_ok()
+        out = flightrec.replay_cycle(lc)
+        assert out["placements_match"], out["mismatches"]
+        assert out["profile_faithful"] and out["aux_match"]
+        # the recorded failure attribution survives too
+        assert lc.manifest["report"]["failed_by"] == {
+            "default/huge": "NodeResourcesFit"
+        }
+
+    def test_fixture_explain_schema(self):
+        from tools.replay import validate_explain
+
+        cycles = flightrec.load_bundle(str(FIXTURE_DIR))
+        table = flightrec.explain_record(cycles[0], "default/huge")
+        assert validate_explain(table) == []
+        assert table["failed_plugin"] == "NodeResourcesFit"
+        assert table["placed"] is False
+        # infeasible everywhere: every candidate's fit margin is negative
+        assert all(c["fit_margin"] < 0 for c in table["candidates"]
+                   if c["fit_margin"] is not None)
+
+
+class TestAtomicWrites:
+    def test_tracer_write_replaces_atomically(self, tmp_path):
+        out = tmp_path / "trace.json"
+        out.write_text('{"traceEvents": "OLD"}')
+        obs.tracer.start(clear=True)
+        with obs.tracer.span("x", tid="t"):
+            pass
+        obs.tracer.stop()
+        obs.tracer.write(str(out))
+        data = json.loads(out.read_text())
+        assert isinstance(data["traceEvents"], list)
+        assert not list(tmp_path.glob("*.tmp.*"))  # no stray temp files
+
+    def test_failed_write_leaves_target_untouched(self, tmp_path,
+                                                  monkeypatch):
+        out = tmp_path / "trace.json"
+        out.write_text("ORIGINAL")
+
+        class Boom(RuntimeError):
+            pass
+
+        real_replace = os.replace
+
+        def exploding_replace(src, dst):
+            raise Boom("crash between temp write and rename")
+
+        monkeypatch.setattr(os, "replace", exploding_replace)
+        with pytest.raises(Boom):
+            obs.atomic_write(str(out), "NEW")
+        monkeypatch.setattr(os, "replace", real_replace)
+        assert out.read_text() == "ORIGINAL"
+        assert not list(tmp_path.glob("*.tmp.*"))  # temp cleaned up
+
+    def test_kill_mid_write_never_truncates(self, tmp_path):
+        """SIGKILL a subprocess that rewrites a trace in a tight loop; the
+        target must always be absent or complete, parseable JSON — the
+        temp+rename discipline's whole promise. (The writer imports only
+        the observability module: no jax, so the loop is tight enough to
+        make a mid-write kill likely.)"""
+        out = tmp_path / "trace.json"
+        code = (
+            "import sys; sys.path.insert(0, {root!r})\n"
+            "from scheduler_plugins_tpu.utils import observability as obs\n"
+            "obs.tracer.start()\n"
+            "for i in range(20000):\n"
+            "    with obs.tracer.span(f'span {{i}}', tid='kill'):\n"
+            "        pass\n"
+            "obs.tracer.stop()\n"
+            "print('ready', flush=True)\n"
+            "while True:\n"
+            "    obs.tracer.write({out!r})\n"
+        ).format(root=str(Path(__file__).parent.parent), out=str(out))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "ready"
+            deadline = time.time() + 10
+            while not out.exists() and time.time() < deadline:
+                time.sleep(0.005)
+            time.sleep(0.02)  # land the kill inside a write with high odds
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert out.exists(), "writer never completed a single write"
+        data = json.loads(out.read_text())  # parses => not truncated
+        assert len(data["traceEvents"]) > 20000
+
+    def test_bundle_save_is_crash_safe_order(self, tmp_path, recorder_off,
+                                             monkeypatch):
+        """Blobs land before the manifest: a save that dies mid-blobs
+        leaves no cycles.jsonl, so readers see 'no bundle', never a
+        manifest naming missing arrays."""
+        flightrec.recorder.start(capacity=1)
+        run_cycle(make_scheduler(), make_cluster(), now=1000)
+
+        real = obs.atomic_write
+        calls = []
+
+        def tracking(path, data):
+            calls.append(os.path.basename(path))
+            return real(path, data)
+
+        monkeypatch.setattr(obs, "atomic_write", tracking)
+        flightrec.recorder.save(str(tmp_path))
+        assert calls[-1] == "cycles.jsonl"
+        assert all(c.endswith(".npy") for c in calls[:-1])
+
+
+class TestCompileObservability:
+    def test_miss_then_hit_then_new_shape(self):
+        import jax
+        import jax.numpy as jnp
+
+        obs.metrics.reset()
+        watched = obs.compile_watch(
+            jax.jit(lambda x: x * 2 + 1), program="test_prog_a"
+        )
+        watched(jnp.ones(7))
+        assert obs.metrics.get(obs.JIT_CACHE_MISS, program="test_prog_a") == 1
+        hists = obs.metrics.histograms()
+        key = 'scheduler_jit_compile_ms{program="test_prog_a"}'
+        assert hists[key]["count"] == 1 and hists[key]["sum"] > 0
+        watched(jnp.ones(7))  # cache hit: no new miss
+        assert obs.metrics.get(obs.JIT_CACHE_MISS, program="test_prog_a") == 1
+        watched(jnp.ones(9))  # new shape signature: a second compile
+        assert obs.metrics.get(obs.JIT_CACHE_MISS, program="test_prog_a") == 2
+
+    def test_shape_churn_warning(self, monkeypatch, caplog):
+        import logging
+
+        import jax
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("SPT_SHAPE_CHURN_N", "2")
+        watched = obs.compile_watch(
+            jax.jit(lambda x: x + 1), program="test_churn"
+        )
+        with caplog.at_level(logging.WARNING, logger="scheduler_plugins_tpu"):
+            for n in (3, 4, 5):
+                watched(jnp.ones(n))
+        assert any("shape churn" in r.message and "test_churn" in r.message
+                   for r in caplog.records)
+
+    def test_solve_cache_attributes_compiles(self, recorder_off):
+        obs.metrics.reset()
+        run_cycle(make_scheduler(), make_cluster(), now=1000)
+        # a fresh Scheduler's first solve is a miss attributed to "solve"
+        assert obs.metrics.get(obs.JIT_CACHE_MISS, program="solve") >= 1
+
+
+def make_golden_bundle(path: str) -> None:
+    """Regenerate tests/fixtures/flightrec (deterministic; run from repo
+    root: `PYTHONPATH=. python tests/test_flightrec.py --regen`)."""
+    flightrec.recorder.start(capacity=1)
+    flightrec.recorder.seed = 0
+    run_cycle(make_scheduler(), make_cluster(), now=1000)
+    print(flightrec.recorder.save(path))
+    flightrec.recorder.stop()
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        make_golden_bundle(str(FIXTURE_DIR))
+    else:
+        print(__doc__)

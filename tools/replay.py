@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Flight-recorder replay + explain CLI, and the `make replay-smoke` gate.
+
+Subcommands over bundles written by `utils.flightrec` (the daemon's
+`--record/--record-dir`, `bench.py --record dir/`, or `FlightRecorder
+.save`):
+
+- `info BUNDLE` — list recorded cycles (digest, mode, batch size, placed).
+- `replay BUNDLE [--cycle K]` — re-run recorded cycles offline through the
+  bit-identical sequential parity path (`Scheduler.solve`) with the
+  RECORDED aux arrays bound, and diff placements. A sequential-mode record
+  that fails to replay bit-identically is an error (rc 1); wave-mode
+  records (batch/streamed) report their diff as evidence (soft
+  tie-breaking may differ) without failing.
+- `explain BUNDLE --uid UID [--cycle K] [--top N] [--batched]` — the
+  per-plugin score table for one recorded pod (the upstream `--v=10`
+  score dump): per-plugin weighted normalized columns, built-in fit
+  margin, winner gap.
+- `smoke` — the CI gate (`make replay-smoke`): record a reduced bench
+  cycle through the REAL `run_cycle` hooks, save/load the bundle, replay
+  it (diff must be empty), validate the explain JSON against
+  `EXPLAIN_SCHEMA`, check the explain columns sum to the solver's total,
+  and bound recorder-enabled overhead the same way tools/trace_smoke.py
+  bounds tracer overhead: interleaved off/on medians,
+  ≤ max(SPT_RECORD_BOUND_PCT [default 2%], the off series' p10-p90
+  spread).
+
+One JSON line per action on stdout; rc 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # `python tools/replay.py` from anywhere
+    sys.path.insert(0, str(REPO))
+
+#: reduced gang+quota roster shape for the smoke gate: big enough that a
+#: cycle is not pure dispatch overhead, small enough for a 2-core runner
+SMOKE_SHAPE = dict(n_gangs=4, gang_size=8, n_nodes=64)
+SMOKE_RUNS = 7
+
+
+# ---------------------------------------------------------------------------
+# explain JSON schema (stdlib check — no jsonschema dependency)
+# ---------------------------------------------------------------------------
+
+#: field -> allowed types (None in the tuple = nullable)
+EXPLAIN_SCHEMA = {
+    "uid": (str,),
+    "cycle": (int, None),
+    "pod_index": (int,),
+    "profile": (str,),
+    "path": (str,),
+    "admitted": (bool,),
+    "placed": (bool, None),
+    "assigned": (str, None),
+    "failed_plugin": (str, None),
+    "winner": (str, None),
+    "winner_total": (int, None),
+    "runner_up_gap": (int, None),
+    "weights": (dict,),
+    "candidates": (list,),
+}
+
+CANDIDATE_SCHEMA = {
+    "node": (str,),
+    "total": (int,),
+    "gap_to_winner": (int, None),
+    "feasible": (bool,),
+    "fit_margin": (int, None),
+    "scores": (dict,),
+}
+
+
+def _check_fields(obj: dict, schema: dict, where: str) -> list[str]:
+    errors = []
+    for field, types in schema.items():
+        if field not in obj:
+            errors.append(f"{where}: missing field {field!r}")
+            continue
+        value = obj[field]
+        if value is None:
+            if None not in types:
+                errors.append(f"{where}.{field}: unexpected null")
+            continue
+        concrete = tuple(t for t in types if t is not None)
+        # bool is an int subclass: reject bools where ints are expected
+        if isinstance(value, bool) and bool not in concrete:
+            errors.append(f"{where}.{field}: bool where {concrete} expected")
+        elif not isinstance(value, concrete):
+            errors.append(
+                f"{where}.{field}: {type(value).__name__} not in "
+                f"{[t.__name__ for t in concrete]}"
+            )
+    return errors
+
+
+def validate_explain(obj) -> list[str]:
+    """Structural errors in one explain JSON object (empty list = valid).
+    Shared by the smoke gate and tests/test_explain.py."""
+    if not isinstance(obj, dict):
+        return ["explain payload is not an object"]
+    errors = _check_fields(obj, EXPLAIN_SCHEMA, "explain")
+    for name, weight in (obj.get("weights") or {}).items():
+        if not isinstance(name, str) or isinstance(weight, bool) or not (
+            isinstance(weight, int)
+        ):
+            errors.append(f"explain.weights[{name!r}]: not str -> int")
+    candidates = obj.get("candidates")
+    if isinstance(candidates, list):
+        if not candidates:
+            errors.append("explain.candidates: empty")
+        for i, cand in enumerate(candidates):
+            if not isinstance(cand, dict):
+                errors.append(f"candidates[{i}]: not an object")
+                continue
+            errors += _check_fields(cand, CANDIDATE_SCHEMA, f"candidates[{i}]")
+            scores = cand.get("scores")
+            if isinstance(scores, dict):
+                if set(scores) != set(obj.get("weights") or {}):
+                    errors.append(
+                        f"candidates[{i}].scores: plugin set != weights set"
+                    )
+                # the tentpole invariant: columns sum to the total
+                if all(
+                    isinstance(v, int) and not isinstance(v, bool)
+                    for v in scores.values()
+                ) and isinstance(cand.get("total"), int):
+                    if sum(scores.values()) != cand["total"]:
+                        errors.append(
+                            f"candidates[{i}]: score columns sum "
+                            f"{sum(scores.values())} != total {cand['total']}"
+                        )
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_info(args) -> int:
+    from scheduler_plugins_tpu.utils import flightrec
+
+    cycles = flightrec.load_bundle(args.bundle)
+    out = []
+    for lc in cycles:
+        m = lc.manifest
+        outputs = m.get("outputs") or {}
+        out.append({
+            "cycle": m["cycle"],
+            "digest": m.get("digest"),
+            "digest_ok": lc.digest_ok(),
+            "profile": m.get("profile"),
+            "mode": outputs.get("mode"),
+            "pods": len(m.get("meta", {}).get("pod_names", [])),
+            "nodes": len(m.get("meta", {}).get("node_names", [])),
+            "seed": m.get("seed"),
+            "complete": m.get("complete"),
+        })
+    print(json.dumps({"bundle": args.bundle, "cycles": out}))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from scheduler_plugins_tpu.utils import flightrec
+
+    cycles = flightrec.load_bundle(args.bundle)
+    if args.cycle is not None:
+        cycles = [c for c in cycles if c.manifest["cycle"] == args.cycle]
+        if not cycles:
+            print(json.dumps({"error": f"cycle {args.cycle} not in bundle"}))
+            return 1
+    failed = False
+    results = []
+    for lc in cycles:
+        out = flightrec.replay_cycle(lc)
+        public = {k: v for k, v in out.items() if not k.startswith("_")}
+        # bit-identical replay is the CONTRACT for sequential records; a
+        # wave-mode record's diff is evidence of soft tie-break drift
+        must_match = out["mode"] == "sequential"
+        ok = (
+            out["digest_ok"]
+            and (out["placements_match"] or not must_match)
+        )
+        public["ok"] = ok
+        failed |= not ok
+        results.append(public)
+    print(json.dumps({"bundle": args.bundle, "replays": results,
+                      "ok": not failed}))
+    return 1 if failed else 0
+
+
+def cmd_explain(args) -> int:
+    from scheduler_plugins_tpu.utils import flightrec
+
+    cycles = flightrec.load_bundle(args.bundle)
+    chosen = None
+    for lc in reversed(cycles):
+        if args.cycle is not None and lc.manifest["cycle"] != args.cycle:
+            continue
+        if args.uid in lc.manifest.get("meta", {}).get("pod_names", []):
+            chosen = lc
+            break
+    if chosen is None:
+        print(json.dumps({
+            "error": f"uid {args.uid!r} not found in bundle"
+            + (f" cycle {args.cycle}" if args.cycle is not None else "")
+        }))
+        return 1
+    table = flightrec.explain_record(
+        chosen, args.uid, top_k=args.top, batched=args.batched
+    )
+    errors = validate_explain(table)
+    table["schema_errors"] = errors
+    print(json.dumps(table))
+    return 1 if errors else 0
+
+
+# ---------------------------------------------------------------------------
+# the CI gate
+# ---------------------------------------------------------------------------
+
+
+def _smoke_cluster():
+    """A fresh seed-0 cluster per cycle (run_cycle binds its pending pods,
+    so a cluster is single-use here); the Scheduler is built ONCE and
+    shared across cycles so every measured cycle hits the jit cache — the
+    overhead bound must compare recorder capture against a warm solve,
+    not against trace+compile noise that would swamp any regression."""
+    import bench
+
+    cluster, plugins, _ = bench.config_problem(4, shape=SMOKE_SHAPE)
+    return cluster, plugins
+
+
+def cmd_smoke(args) -> int:
+    import numpy as np
+
+    import bench
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.utils import flightrec
+
+    bench.apply_platform_override()
+    bound_pct = float(os.environ.get("SPT_RECORD_BOUND_PCT", 2.0))
+    out_dir = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="replay_smoke_"), "bundle"
+    )
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+
+    _, plugins = _smoke_cluster()
+    scheduler = Scheduler(Profile(plugins=plugins))
+
+    def one_cycle():
+        cluster, _plugins = _smoke_cluster()
+        start = time.perf_counter()
+        report = run_cycle(scheduler, cluster, now=1000)
+        return time.perf_counter() - start, report
+
+    one_cycle()  # compile warmup (recorder off; later cycles hit the cache)
+
+    # interleaved off/on series: drift hits both equally; medians compared
+    off, on = [], []
+    report = None
+    for _ in range(SMOKE_RUNS):
+        flightrec.recorder.stop()
+        t, _r = one_cycle()
+        off.append(t)
+        flightrec.recorder.start(capacity=2)
+        flightrec.recorder.seed = 0  # config_problem scenarios are seed-0
+        t, report = one_cycle()
+        on.append(t)
+    median_off = sorted(off)[len(off) // 2]
+    median_on = sorted(on)[len(on) // 2]
+    overhead_pct = 100.0 * (median_on - median_off) / median_off
+    off_sorted = sorted(off)
+    spread_pct = 100.0 * (
+        off_sorted[int(0.9 * (len(off) - 1))]
+        - off_sorted[int(0.1 * (len(off) - 1))]
+    ) / median_off
+    bound = max(bound_pct, spread_pct)
+
+    # save the LAST recorded cycle and round-trip it
+    save = flightrec.recorder.save(out_dir)
+    flightrec.recorder.stop()
+    cycles = flightrec.load_bundle(out_dir)
+    replay = flightrec.replay_cycle(cycles[-1])
+    replay_ok = (
+        replay["digest_ok"]
+        and replay["placements_match"]
+        and replay["aux_match"]
+        and replay["mode"] == "sequential"
+    )
+
+    # explain a failed pod when the cycle had one, else the first pod;
+    # schema validation includes the columns-sum-to-total invariant
+    pod_names = cycles[-1].manifest["meta"]["pod_names"]
+    uid = (report.failed[0] if report and report.failed else pod_names[0])
+    table = flightrec.explain_record(cycles[-1], uid)
+    schema_errors = validate_explain(table)
+
+    ok = (
+        replay_ok
+        and not schema_errors
+        and overhead_pct <= bound
+        and bool(report.bound)
+    )
+    print(json.dumps({
+        "metric": "replay_smoke",
+        "off_cycle_ms": round(median_off * 1000, 2),
+        "on_cycle_ms": round(median_on * 1000, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": round(bound, 2),
+        "noise_floor_pct": round(spread_pct, 2),
+        "bundle": save,
+        "replay": {k: v for k, v in replay.items()
+                   if not k.startswith("_")},
+        "replay_ok": replay_ok,
+        "explain_uid": uid,
+        "explain_schema_errors": schema_errors[:5],
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools/replay.py", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_info = sub.add_parser("info", help="list a bundle's recorded cycles")
+    p_info.add_argument("bundle")
+    p_replay = sub.add_parser(
+        "replay", help="re-run recorded cycles through Scheduler.solve "
+        "and diff placements"
+    )
+    p_replay.add_argument("bundle")
+    p_replay.add_argument("--cycle", type=int, default=None)
+    p_explain = sub.add_parser(
+        "explain", help="per-plugin score table for one recorded pod"
+    )
+    p_explain.add_argument("bundle")
+    p_explain.add_argument("--uid", required=True)
+    p_explain.add_argument("--cycle", type=int, default=None)
+    p_explain.add_argument("--top", type=int, default=5)
+    p_explain.add_argument("--batched", action="store_true",
+                           help="derive columns through the batched "
+                                "solver's class-collapsed row hooks")
+    p_smoke = sub.add_parser("smoke", help="the make replay-smoke CI gate")
+    p_smoke.add_argument("--out", default=None,
+                         help="bundle output dir (default: temp dir)")
+    args = ap.parse_args(argv)
+    return {
+        "info": cmd_info,
+        "replay": cmd_replay,
+        "explain": cmd_explain,
+        "smoke": cmd_smoke,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
