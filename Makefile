@@ -49,11 +49,20 @@ trace-smoke:
 replay-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/replay.py smoke
 
+# CI serving gate: reduced sustained-churn run (Poisson arrivals/
+# departures + node adds on the same event stream, serve mode vs full
+# re-snapshot) — the resident-state delta path must beat the baseline
+# >= 1.5x on cycles/s with IDENTICAL placements and zero hard-constraint
+# violations
+.PHONY: churn-smoke
+churn-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --churn-smoke
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke
 
 .PHONY: lint
 lint:

@@ -563,8 +563,242 @@ CONFIG_METRICS = {
     1: "pods_scheduled_per_sec", 2: "trimaran_pods_per_sec",
     3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
-    0: "tpu_smoke_pods_per_sec",
+    0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
 }
+
+
+# ---------------------------------------------------------------------------
+# config 7: sustained-churn serving (resident-state engine vs re-snapshot)
+# ---------------------------------------------------------------------------
+
+#: the serving headline shape: a large cluster with a deep bound
+#: population (what makes per-cycle re-snapshotting expensive) under
+#: Poisson pod arrivals/departures plus slow node add/remove churn
+SERVING_SHAPE = dict(
+    n_nodes=2000, prefill=12288, cycles=48, warmup=4,
+    lam_arrive=48, lam_depart=24, node_add_every=16, node_remove_every=24,
+)
+#: reduced shape for the `make churn-smoke` CI gate (2-core runners).
+#: Node counts sit BELOW their padding bucket (240 < 256, 2000 < 2048
+#: above) so the bench's node adds grow within the resident padding
+#: instead of crossing a bucket boundary and retracing the solve mid-run
+CHURN_SMOKE_SHAPE = dict(
+    n_nodes=240, prefill=2048, cycles=24, warmup=3,
+    lam_arrive=16, lam_depart=8, node_add_every=9, node_remove_every=0,
+)
+
+
+def churn_cluster(n_nodes, prefill, seed=0):
+    """Cluster with a deep ALREADY-BOUND pod population (arriving assigned,
+    as a feed replay would deliver them) — the state a serving scheduler
+    carries between decisions, and exactly what the full-resnapshot
+    baseline must re-accumulate every cycle."""
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    rng = np.random.default_rng(seed)
+    cluster = Cluster()
+    for i in range(n_nodes):
+        cluster.add_node(Node(
+            name=f"node-{i:05d}",
+            allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 256},
+        ))
+    cpus = rng.integers(100, 2000, size=prefill)
+    mems = rng.integers(256 << 20, 2 * gib, size=prefill)
+    for i in range(prefill):
+        pod = Pod(
+            name=f"bound-{i:06d}", creation_ms=i,
+            containers=[Container(requests={
+                CPU: int(cpus[i]), MEMORY: int(mems[i])})],
+        )
+        pod.node_name = f"node-{i % n_nodes:05d}"
+        cluster.add_pod(pod)
+    return cluster
+
+
+def _churn_events(cluster, rng, shape, cycle, now, serial):
+    """Apply one cycle's churn to `cluster`; returns the new pod serial.
+    Every draw depends only on the rng stream and the cluster's bound set,
+    so two runs with equal placements see IDENTICAL event sequences."""
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+
+    gib = 1 << 30
+    for _ in range(int(rng.poisson(shape["lam_arrive"]))):
+        serial += 1
+        cluster.add_pod(Pod(
+            name=f"arr-{serial:06d}", creation_ms=now * 1000 + serial,
+            containers=[Container(requests={
+                CPU: int(rng.integers(100, 2000)),
+                MEMORY: int(rng.integers(256 << 20, 2 * gib))})],
+        ))
+    n_dep = int(rng.poisson(shape["lam_depart"]))
+    if n_dep:
+        bound = sorted(
+            uid for uid, p in cluster.pods.items()
+            if p.node_name is not None and p.node_name in cluster.nodes
+        )
+        if bound:
+            picks = rng.choice(
+                len(bound), size=min(n_dep, len(bound)), replace=False
+            )
+            for i in sorted(int(x) for x in picks):
+                cluster.remove_pod(bound[i])
+    every = shape.get("node_add_every")
+    if every and cycle % every == every - 1:
+        cluster.add_node(Node(
+            name=f"node-x{cycle:04d}",
+            allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 256},
+        ))
+    every = shape.get("node_remove_every")
+    if every and cycle % every == every - 1 and len(cluster.nodes) > 1:
+        # drain-then-delete (the kubectl drain shape): pods leave cleanly,
+        # then the node row disappears (a serve-engine re-base)
+        victim = next(iter(cluster.nodes))
+        for uid in [
+            u for u, p in cluster.pods.items() if p.node_name == victim
+        ]:
+            cluster.remove_pod(uid)
+        cluster.remove_node(victim)
+    return serial
+
+
+def run_churn(cluster, scheduler, shape, seed=0, engine=None):
+    """Drive `shape['cycles']` timed churn cycles (after `warmup` untimed
+    ones) through `framework.cycle.run_cycle`, in serve mode when `engine`
+    is given. Returns per-cycle wall times, per-cycle decision counts, and
+    the accumulated uid -> node placements."""
+    from scheduler_plugins_tpu.framework import run_cycle
+
+    rng = np.random.default_rng(seed + 1)
+    serial = 0
+    times, decided = [], []
+    placements = {}
+    total_cycles = shape["warmup"] + shape["cycles"]
+    for cycle in range(total_cycles):
+        now = 1000 * (cycle + 1)
+        serial = _churn_events(cluster, rng, shape, cycle, now, serial)
+        start = time.perf_counter()
+        with _bench_span(
+            f"churn cycle {cycle}", mode="serve" if engine else "baseline"
+        ):
+            report = run_cycle(scheduler, cluster, now=now, serve=engine)
+        elapsed = time.perf_counter() - start
+        placements.update(report.bound)
+        if cycle >= shape["warmup"]:
+            times.append(elapsed)
+            decided.append(len(report.bound) + len(report.failed))
+    return {
+        "times": times, "decided": decided, "placements": placements,
+    }
+
+
+def _churn_capacity_violations(cluster) -> int:
+    """Hard-constraint audit after a churn run: nodes over allocatable on
+    any resource (bound pods replayed against node capacity)."""
+    from scheduler_plugins_tpu.api.resources import PODS
+
+    used: dict = {name: {} for name in cluster.nodes}
+    for pod in cluster.pods.values():
+        if pod.node_name is None or pod.node_name not in used:
+            continue
+        bucket = used[pod.node_name]
+        for r, q in pod.effective_request().items():
+            bucket[r] = bucket.get(r, 0) + q
+        bucket[PODS] = bucket.get(PODS, 0) + 1
+    violations = 0
+    for name, node in cluster.nodes.items():
+        for r, q in used[name].items():
+            if q > node.allocatable.get(r, 0):
+                violations += 1
+    return violations
+
+
+def serving_churn(shape=None, emit=True):
+    """Config 7: the sustained-churn serving bench. Runs the SAME Poisson
+    event sequence twice — resident-state serve mode (delta ingest,
+    `serving.engine.ServeEngine`) vs the full-resnapshot baseline
+    (`Cluster.snapshot` every cycle) — both through the bit-faithful
+    sequential solve, and reports p50/p99 decision latency, cycles/s and
+    pods/s with the cycles/s ratio as `vs_baseline`. Placements must
+    match exactly (drift 0.0): serve mode changes WHERE the solver input
+    comes from, never what the solver decides."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+    from scheduler_plugins_tpu.serving import ServeEngine
+
+    shape = shape or SERVING_SHAPE
+    seed = 0
+
+    serve_cluster = churn_cluster(shape["n_nodes"], shape["prefill"], seed)
+    engine = ServeEngine().attach(serve_cluster)
+    serve_sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    serve = run_churn(serve_cluster, serve_sched, shape, seed, engine=engine)
+
+    base_cluster = churn_cluster(shape["n_nodes"], shape["prefill"], seed)
+    base_sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    base = run_churn(base_cluster, base_sched, shape, seed)
+
+    serve_s, base_s = sum(serve["times"]), sum(base["times"])
+    n_cycles = len(serve["times"])
+    n_decided = sum(serve["decided"])
+    match = serve["placements"] == base["placements"]
+    violations = _churn_capacity_violations(serve_cluster)
+    # per-decision latency: a pod's decision latency is its cycle's wall
+    # time (ingest -> host-visible bind), weighted by decisions per cycle
+    lat = np.repeat(serve["times"], serve["decided"])
+    p50 = float(np.percentile(lat, 50)) * 1000 if lat.size else 0.0
+    p99 = float(np.percentile(lat, 99)) * 1000 if lat.size else 0.0
+    ratio = (n_cycles / serve_s) / (n_cycles / base_s) if serve_s else 0.0
+    line = {
+        "cycles": n_cycles,
+        "cycles_per_sec": round(n_cycles / serve_s, 2),
+        "baseline_cycles_per_sec": round(n_cycles / base_s, 2),
+        "vs_full_resnapshot": round(ratio, 2),
+        "decision_latency_p50_ms": round(p50, 2),
+        "decision_latency_p99_ms": round(p99, 2),
+        "placements_match": bool(match),
+        "capacity_violations": violations,
+        "rebases": engine.rebases,  # engine-local (the metric is global)
+        "resident_generation": engine.generation,
+        "decisions": n_decided,
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[7],
+            n_decided / serve_s if serve_s else 0.0,
+            f"{shape['n_nodes']} nodes, {shape['prefill']} bound, "
+            f"{n_cycles} cycles Poisson churn "
+            f"λ={shape['lam_arrive']}/{shape['lam_depart']}, serve mode",
+            baseline=n_decided / base_s if base_s else 1.0,
+            drift=(0.0 if match else None),
+            extra=line,
+        )
+    return line
+
+
+def churn_smoke(min_ratio=1.5):
+    """CI gate (`make churn-smoke`): reduced sustained-churn run — the
+    delta path must beat the full-resnapshot baseline by >= `min_ratio`
+    on cycles/s, place IDENTICALLY (the serve engine feeds the same
+    bit-faithful solve), and leave zero hard-constraint violations. One
+    JSON line; rc 1 on any failure."""
+    line = serving_churn(shape=CHURN_SMOKE_SHAPE, emit=False)
+    ok = (
+        line["vs_full_resnapshot"] >= min_ratio
+        and line["placements_match"]
+        and line["capacity_violations"] == 0
+    )
+    print(json.dumps({
+        "metric": "churn_smoke",
+        "min_ratio": min_ratio,
+        "backend": _backend_label(),
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
 
 
 #: replay cutoff: a capture older than this is too stale to stand in for
@@ -919,7 +1153,9 @@ if __name__ == "__main__":
     parser.add_argument("--config", type=int, default=1,
                         help="BASELINE.md scenario (1-5; 6 = 10k-node x "
                              "100k-pod north-star scale; 0 = tiny-shape "
-                             "tpu smoke); default flagship")
+                             "tpu smoke; 7 = sustained-churn serving, "
+                             "resident-state vs full-resnapshot); "
+                             "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
                         help="configs 2-5: bit-faithful scan or batched waves")
@@ -944,8 +1180,19 @@ if __name__ == "__main__":
                         help="CI gate: comma-separated configs run at "
                              "reduced shapes under SPT_SANITIZE=1 "
                              "(checkify); fails on any checkify error")
+    parser.add_argument("--churn-smoke", action="store_true",
+                        help="CI gate: reduced sustained-churn run; fails "
+                             "unless the resident-state delta path beats "
+                             "the full-resnapshot baseline >= 1.5x on "
+                             "cycles/s with identical placements and "
+                             "zero hard-constraint violations")
     args = parser.parse_args()
     apply_platform_override()
+    if args.churn_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # a mode-vs-mode comparison, not a timing run against history —
+        # no tunnel probe
+        sys.exit(churn_smoke())
     if args.sanitize_smoke:
         # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
         # correctness instrumentation, not a timing run — no tunnel probe
@@ -1004,6 +1251,8 @@ if __name__ == "__main__":
             main()
         elif args.config == 6:
             north_star()
+        elif args.config == 7:
+            serving_churn()
         else:
             sequential_config(args.config, args.mode,
                               record_dir=args.record)

@@ -112,6 +112,7 @@ from scheduler_plugins_tpu.api.objects import (
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )
+from scheduler_plugins_tpu.api import events as ev
 from scheduler_plugins_tpu.state.cluster import Cluster
 
 #: framed-transport sanity bound — far above any real event, far below a
@@ -377,12 +378,12 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         cluster.remove_node(event["name"])
     elif op == "delete_quota":
         if cluster.quotas.pop(event.get("namespace", "default"), None):
-            cluster.note_event("ElasticQuota/Delete")
+            cluster.note_event(ev.ELASTIC_QUOTA_DELETE)
     elif op == "delete_pod_group":
         if cluster.pod_groups.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
         ):
-            cluster.note_event("PodGroup/Delete")
+            cluster.note_event(ev.POD_GROUP_DELETE)
     elif op == "upsert_quota":
         cluster.add_quota(
             ElasticQuota(
@@ -467,7 +468,7 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         if cluster.app_groups.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
         ):
-            cluster.note_event("AppGroup/Delete")
+            cluster.note_event(ev.APP_GROUP_DELETE)
     elif op == "upsert_network_topology":
         # (origin, dest) pairs ride as [orig, dest, cost] triples on the wire
         cluster.add_network_topology(
@@ -489,7 +490,7 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         if cluster.network_topologies.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
         ):
-            cluster.note_event("NetworkTopology/Delete")
+            cluster.note_event(ev.NETWORK_TOPOLOGY_DELETE)
     elif op == "upsert_seccomp_profile":
         cluster.add_seccomp_profile(
             SeccompProfile(
@@ -502,7 +503,7 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         if cluster.seccomp_profiles.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
         ):
-            cluster.note_event("SeccompProfile/Delete")
+            cluster.note_event(ev.SECCOMP_PROFILE_DELETE)
     elif op == "upsert_priority_class":
         cluster.add_priority_class(
             PriorityClass(
@@ -513,14 +514,14 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         )
     elif op == "delete_priority_class":
         if cluster.priority_classes.pop(event["name"], None):
-            cluster.note_event("PriorityClass/Delete")
+            cluster.note_event(ev.PRIORITY_CLASS_DELETE)
     elif op == "upsert_namespace":
         cluster.add_namespace(
             Namespace(name=event["name"], labels=event.get("labels") or {})
         )
     elif op == "delete_namespace":
         if cluster.namespaces.pop(event["name"], None):
-            cluster.note_event("Namespace/Delete")
+            cluster.note_event(ev.NAMESPACE_DELETE)
     elif op == "upsert_pdb":
         cluster.add_pdb(
             PodDisruptionBudget(
@@ -535,7 +536,7 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
         if cluster.pdbs.pop(
             f"{event.get('namespace', 'default')}/{event['name']}", None
         ):
-            cluster.note_event("PodDisruptionBudget/Delete")
+            cluster.note_event(ev.PDB_DELETE)
     elif op == "metrics":
         cluster.node_metrics = event["nodes"]
     elif op == "sync":

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 import os
+import time
 from collections import deque
 
 from scheduler_plugins_tpu.framework.preemption import GATED, encode_demand
@@ -139,14 +140,28 @@ def _attach_explain_ctx(report: CycleReport, ctx: tuple) -> None:
 
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
-              stream_chunk: int | None = None) -> CycleReport:
+              stream_chunk: int | None = None, serve=None) -> CycleReport:
     """One daemon cycle. `stream_chunk` opts the solve into the donated,
     double-buffered chunk pipeline (`parallel.pipeline.streamed_profile_solve`)
     when the profile qualifies for the targeted fast path — huge pending
     queues then stream through bounded chunks instead of one (P, N) solve,
     with wave-path placement semantics (hard constraints exact, soft
     tie-breaking may differ from the sequential scan). Profiles that don't
-    qualify fall back to `scheduler.solve` unchanged."""
+    qualify fall back to `scheduler.solve` unchanged.
+
+    `serve` opts the SNAPSHOT stage into a resident-state serving engine
+    (`serving.engine.ServeEngine`, attached to this cluster): instead of
+    rebuilding and re-shipping the full cluster snapshot, the engine keeps
+    the node tensors device-resident across cycles and applies O(changed)
+    deltas captured from the store's mutation hooks. The solve itself is
+    unchanged — the assembled snapshot feeds the same bit-faithful
+    sequential parity path, so serve-mode placements are identical to a
+    fresh-snapshot cycle (tests/test_serving.py). When the engine cannot
+    own the state (side-table objects present, docs/SERVING.md gate), the
+    cycle falls back to `cluster.snapshot` transparently. Serve cycles do
+    NOT retain an explain context (the resident tensors are donated to
+    the next cycle's delta apply — a retained snapshot would read freed
+    buffers); the flight recorder is the postmortem surface there."""
     if now is None:
         now = _now_ms()
     report = CycleReport()
@@ -180,9 +195,18 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         sanitize.drain()
     generation = getattr(cluster.nrt_cache, "generation", None)
     rec = flightrec.recorder.begin(now_ms=now, profile=scheduler.profile.name)
+    serve_t0 = time.perf_counter() if serve is not None else None
+    served = False
     with obs.flow("cycle", generation=generation, pending=len(pending)):
         with obs.tracer.span("Snapshot", tid="cycle", pending=len(pending)):
-            snap, meta = cluster.snapshot(pending, now_ms=now)
+            snap = meta = None
+            if serve is not None:
+                refreshed = serve.refresh(cluster, pending, now_ms=now)
+                if refreshed is not None:
+                    snap, meta = refreshed
+                    served = True
+            if snap is None:
+                snap, meta = cluster.snapshot(pending, now_ms=now)
         scheduler.prepare(meta, cluster)
         if rec is not None:
             # inputs land in the ring BEFORE the solve: the cycle that
@@ -192,6 +216,11 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
                     snap, meta, scheduler, stream_chunk=stream_chunk,
                     profile_config=flightrec.recorder.profile_config,
                 )
+                if served:
+                    # serve provenance: resident generation, base digest,
+                    # and the packed delta stream that produced this
+                    # cycle's snapshot view
+                    serve.annotate_record(rec)
         result = None
         # the Solve span covers dispatch AND completion (np.asarray host
         # transfers below force it) for the sequential path; the streamed
@@ -229,16 +258,23 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
                         None if codes is None else np.asarray(codes)
                     ),
                 )
-    # cheap refs, not copies: lets `report.explain(uid)` rebuild the
-    # per-plugin score table for any pod of this batch after the fact;
-    # retention-bounded so old reports release their snapshot. The aux
-    # pytrees are frozen HERE — a later cycle's prepare() rebinds the
-    # shared plugins, and explaining an old report against the live
-    # aux() would score cycle K's snapshot with cycle K+n's config
-    _attach_explain_ctx(report, (
-        scheduler, snap, meta, assignment,
-        tuple(p.aux() for p in scheduler.profile.plugins),
-    ))
+    if served:
+        # serve cycles keep NO explain context: the snapshot's node
+        # tensors are the resident carry, donated to the next cycle's
+        # delta apply — a retained ctx would read freed device buffers.
+        # Postmortems go through the flight recorder (host copies).
+        report._explain_ctx = _CTX_RELEASED
+    else:
+        # cheap refs, not copies: lets `report.explain(uid)` rebuild the
+        # per-plugin score table for any pod of this batch after the fact;
+        # retention-bounded so old reports release their snapshot. The aux
+        # pytrees are frozen HERE — a later cycle's prepare() rebinds the
+        # shared plugins, and explaining an old report against the live
+        # aux() would score cycle K's snapshot with cycle K+n's config
+        _attach_explain_ctx(report, (
+            scheduler, snap, meta, assignment,
+            tuple(p.aux() for p in scheduler.profile.plugins),
+        ))
 
     if sanitize.enabled():
         # surface this cycle's checkify findings on the report (the solve
@@ -277,6 +313,16 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
             else:
                 cluster.bind(pod.uid, node_name, now)
                 report.bound[pod.uid] = node_name
+
+    if serve_t0 is not None:
+        # serve-mode decision latency: delta ingest through host-visible
+        # bind decisions (the per-decision number the sustained-churn
+        # bench reports as p50/p99) — observed even on fallback cycles so
+        # the histogram shows what serve traffic actually experienced
+        obs.metrics.observe_ms(
+            obs.SERVE_DECISION_LATENCY,
+            (time.perf_counter() - serve_t0) * 1000.0,
+        )
 
     _attribute_failures(scheduler, snap, result, failed_idx, report)
 
@@ -489,11 +535,18 @@ def _run_preemption(scheduler, cluster, pending, report, now):
             # so the pod re-enters PostFilter fresh (upstream clears
             # NominatedNodeName when unschedulable again)
             pod.nominated_node_name = None
+            if cluster.delta_sink is not None:
+                # in-place clear never passes through a Cluster mutator —
+                # untrack it or the serving engine's compatibility gate
+                # stays pinned False for this pod's lifetime
+                cluster.delta_sink.note_nomination(pod)
             continue
         obs.metrics.inc(obs.PREEMPTION_VICTIMS, len(result.victims))
         # setting the nomination NOW makes this pod visible to later
         # preemptors' live nominated aggregates (quota feedback) exactly once
         pod.nominated_node_name = result.nominated_node
+        if cluster.delta_sink is not None:
+            cluster.delta_sink.note_nomination(pod)
         n = node_pos[result.nominated_node]
         demand = encode_demand(meta.index, pod)
         victim_freed = np.zeros(len(meta.index), np.int64)

@@ -26,6 +26,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import struct
 
+from scheduler_plugins_tpu.api import events as ev
 from scheduler_plugins_tpu.state.snapshot import ClusterSnapshot, SnapshotMeta
 
 
@@ -81,8 +82,9 @@ class SolverState:
 
 
 #: cluster events that can free capacity for the framework's built-in
-#: resource-fit Filter (upstream NodeResourcesFit EventsToRegister)
-BUILTIN_EVENTS = ("Node/Add", "Node/Update", "Pod/Delete")
+#: resource-fit Filter (upstream NodeResourcesFit EventsToRegister) —
+#: kinds from the shared `api.events` table
+BUILTIN_EVENTS = (ev.NODE_ADD, ev.NODE_UPDATE, ev.POD_DELETE)
 
 
 class Plugin:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from scheduler_plugins_tpu.framework.plugin import Plugin
 from scheduler_plugins_tpu.ops.quota import quota_admit, quota_commit
+from scheduler_plugins_tpu.api import events as ev
 
 
 class CapacityScheduling(Plugin):
@@ -39,8 +40,8 @@ class CapacityScheduling(Plugin):
     def events_to_register(self):
         # freed capacity or quota growth (capacity_scheduling.go:194-203;
         # the EQ event is ActionType All)
-        return ("Pod/Delete", "ElasticQuota/Add", "ElasticQuota/Update",
-                "ElasticQuota/Delete")
+        return (ev.POD_DELETE, ev.ELASTIC_QUOTA_ADD, ev.ELASTIC_QUOTA_UPDATE,
+                ev.ELASTIC_QUOTA_DELETE)
 
     def preemption_engine(self):
         """PostFilter = quota-aware preemption

@@ -26,6 +26,7 @@ from scheduler_plugins_tpu.ops.gang import (
     gang_commit,
     gang_inflight_commit,
 )
+from scheduler_plugins_tpu.api import events as ev
 
 DEFAULT_PERMIT_WAITING_SECONDS = 60
 DEFAULT_POD_GROUP_BACKOFF_SECONDS = 0
@@ -38,7 +39,7 @@ class Coscheduling(Plugin):
     def events_to_register(self):
         # a new sibling or PodGroup change can complete the quorum
         # (coscheduling.go:113-122)
-        return ("Pod/Add", "PodGroup/Add", "PodGroup/Update")
+        return (ev.POD_ADD, ev.POD_GROUP_ADD, ev.POD_GROUP_UPDATE)
 
     def __init__(
         self,

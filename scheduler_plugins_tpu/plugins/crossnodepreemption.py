@@ -19,6 +19,7 @@ from scheduler_plugins_tpu.framework.preemption import (
     PreemptionEngine,
     PreemptionMode,
 )
+from scheduler_plugins_tpu.api import events as ev
 
 
 class CrossNodePreemption(Plugin):
@@ -32,7 +33,7 @@ class CrossNodePreemption(Plugin):
     def events_to_register(self):
         # a victim's deletion admits the preemptor (upstream
         # DefaultPreemption registration)
-        return ("Pod/Delete",)
+        return (ev.POD_DELETE,)
 
     def preemption_engine(self):
         return PreemptionEngine(

@@ -35,13 +35,14 @@ import jax.numpy as jnp
 
 from scheduler_plugins_tpu.framework.plugin import Plugin
 from scheduler_plugins_tpu.ops.normalize import default_normalize
+from scheduler_plugins_tpu.api import events as ev
 
 
 class NodeAffinity(Plugin):
     name = "NodeAffinity"
 
     def events_to_register(self):
-        return ("Node/Add", "Node/Update")
+        return (ev.NODE_ADD, ev.NODE_UPDATE)
 
     def __init__(self, added_affinity=None):
         #: NodeAffinityArgs.AddedAffinity (upstream): per-profile extra
@@ -116,8 +117,8 @@ class PodTopologySpread(Plugin):
     name = "PodTopologySpread"
 
     def events_to_register(self):
-        return ("Pod/Add", "Pod/Update", "Pod/Delete", "Node/Add",
-                "Node/Update")
+        return (ev.POD_ADD, ev.POD_UPDATE, ev.POD_DELETE, ev.NODE_ADD,
+                ev.NODE_UPDATE)
 
     #: the filter reads the carried live counts — later placements change
     #: earlier verdicts, and domains SPAN nodes, so the batched path also
@@ -266,8 +267,8 @@ class InterPodAffinity(Plugin):
     state_dependent_filter = True
 
     def events_to_register(self):
-        return ("Pod/Add", "Pod/Update", "Pod/Delete", "Node/Add",
-                "Node/Update", "Namespace/Add", "Namespace/Update")
+        return (ev.POD_ADD, ev.POD_UPDATE, ev.POD_DELETE, ev.NODE_ADD,
+                ev.NODE_UPDATE, ev.NAMESPACE_ADD, ev.NAMESPACE_UPDATE)
 
     def __init__(self, hard_pod_affinity_weight: int = 1,
                  ignore_preferred_terms_of_existing_pods: bool = False):
@@ -430,7 +431,7 @@ class TaintToleration(Plugin):
     name = "TaintToleration"
 
     def events_to_register(self):
-        return ("Node/Add", "Node/Update")
+        return (ev.NODE_ADD, ev.NODE_UPDATE)
 
     def filter(self, state, snap, p):
         if snap.scheduling is None:

@@ -34,6 +34,7 @@ from scheduler_plugins_tpu.ops.network import (
     placed_commit,
 )
 from scheduler_plugins_tpu.ops.normalize import peaks_normalize
+from scheduler_plugins_tpu.api import events as ev
 
 DEFAULT_WEIGHTS_NAME = "UserDefined"  # defaults.go:232-244
 DEFAULT_NETWORK_TOPOLOGY_NAME = "nt-default"
@@ -49,9 +50,9 @@ class NetworkOverhead(Plugin):
         # Filter verdict actually depends on)
         # Pod/Update included because cluster.bind() records bindings as
         # Pod/Update — a dependency binding can flip violated>satisfied.
-        return ("Pod/Add", "Pod/Update", "Pod/Delete",
-                "AppGroup/Add", "AppGroup/Update",
-                "NetworkTopology/Add", "NetworkTopology/Update")
+        return (ev.POD_ADD, ev.POD_UPDATE, ev.POD_DELETE,
+                ev.APP_GROUP_ADD, ev.APP_GROUP_UPDATE,
+                ev.NETWORK_TOPOLOGY_ADD, ev.NETWORK_TOPOLOGY_UPDATE)
     #: Filter tallies read the carried in-cycle placement counts — the
     #: batched path re-evaluates it per wave (counting heuristic, not a
     #: resource-safety bound, so no within-wave guard is needed)

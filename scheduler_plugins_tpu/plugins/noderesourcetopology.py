@@ -37,6 +37,7 @@ from scheduler_plugins_tpu.ops.numa import (
     LEAST_NUMA_NODES,
     MOST_ALLOCATED,
 )
+from scheduler_plugins_tpu.api import events as ev
 
 STRATEGIES = (
     LEAST_ALLOCATED,
@@ -51,8 +52,8 @@ class NodeResourceTopologyMatch(Plugin):
 
     def events_to_register(self):
         # plugin.go:141-151: Pod delete, node allocatable changes, NRT CRs
-        return ("Pod/Delete", "Node/Add", "Node/Update",
-                "NodeResourceTopology/Add", "NodeResourceTopology/Update")
+        return (ev.POD_DELETE, ev.NODE_ADD, ev.NODE_UPDATE,
+                ev.NRT_ADD, ev.NRT_UPDATE)
     #: the Filter reads the carried zone availability (in-cycle pessimistic
     #: deductions) — the batched path must re-evaluate it per wave
     state_dependent_filter = True

@@ -15,6 +15,7 @@ from scheduler_plugins_tpu.framework.preemption import (
     PreemptionEngine,
     PreemptionMode,
 )
+from scheduler_plugins_tpu.api import events as ev
 
 
 class PreemptionToleration(Plugin):
@@ -34,7 +35,7 @@ class PreemptionToleration(Plugin):
     def events_to_register(self):
         # a victim's deletion admits the preemptor (upstream
         # DefaultPreemption registers Pod/Delete)
-        return ("Pod/Delete",)
+        return (ev.POD_DELETE,)
 
     def preemption_engine(self) -> PreemptionEngine:
         return PreemptionEngine(

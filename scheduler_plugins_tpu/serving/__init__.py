@@ -1,0 +1,12 @@
+"""Resident-state serving engine: device-resident SolverState inputs across
+cycles + O(changed) delta ingestion (ROADMAP item 3; docs/SERVING.md)."""
+
+from scheduler_plugins_tpu.serving.deltas import (  # noqa: F401
+    DeltaSink,
+    NodeUpserts,
+    UsageDeltas,
+    apply_node_deltas,
+    delta_apply_program,
+    pod_usage_vectors,
+)
+from scheduler_plugins_tpu.serving.engine import ServeEngine  # noqa: F401

@@ -1,0 +1,516 @@
+"""ServeEngine: device-resident node state across cycles, O(changed) ingest.
+
+`framework.cycle.run_cycle(serve=engine)` swaps the per-cycle full
+re-snapshot (`Cluster.snapshot`: an O(nodes + assigned pods) Python
+rebuild plus a full host->device ship) for this engine's `refresh`: the
+`NodeState` columns live on device across cycles and each refresh applies
+only the deltas the store's mutation hooks captured since the last one
+(`serving.deltas.DeltaSink`), via one donated scatter program. The solve
+itself is untouched — the assembled snapshot feeds the SAME bit-faithful
+sequential parity path, so serve-mode placements are bit-identical to a
+fresh-snapshot solve (gated by tests/test_serving.py's delta-equivalence
+differential).
+
+Capacity policy (docs/SERVING.md):
+
+- **grow**: node adds past the padded capacity pad the resident columns
+  to the next `bucket_size` bucket device-side (cheap `jnp.pad`, usage
+  history preserved; one retrace for the new shape).
+- **re-base** (the compact path): Node/Delete, an existing node's
+  region/zone label change, an extended-resource sighting, or a pod event
+  against a node the engine has never seen (cross-watch ordering) all
+  invalidate either the row order or the packed axis — the engine
+  rebuilds from a fresh `Cluster.snapshot` at the canonical bucket for
+  the new node count, exactly like the C++ columnar mirror's
+  `_native_rebuild`. Rare control-plane events pay O(cluster); steady
+  churn pays O(changed).
+
+Compatibility gate: the engine owns the snapshot only while every side
+table would be None — no PodGroups/ElasticQuotas/NRTs/AppGroups/seccomp
+profiles/node metrics, no selector-spec pods, no node taints, and no
+node-affinity/nomination specs in the pending batch (the same shape of
+condition as the native-store fast path in `Cluster.snapshot`). While
+incompatible, `refresh` returns None (the cycle falls back to the full
+snapshot) but KEEPS absorbing deltas, so the resident columns stay in
+sync and serving resumes without a rebase once the side objects go away.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from scheduler_plugins_tpu.serving import deltas as D
+from scheduler_plugins_tpu.state.snapshot import (
+    ClusterSnapshot,
+    SnapshotMeta,
+    _Interner,
+    build_pod_state,
+)
+from scheduler_plugins_tpu.utils import observability as obs
+from scheduler_plugins_tpu.utils.intmath import bucket_size
+
+
+class ServeEngine:
+    """Long-lived serving engine for one `Cluster` store."""
+
+    def __init__(self):
+        self._sink = D.DeltaSink()
+        self._cluster = None
+        self._nodes = None  # resident NodeState (device arrays) or None
+        self._npad = 0
+        self._names: list[str] = []  # slot order == cluster.nodes order
+        self._slots: dict[str, int] = {}
+        # first-seen label interning over the shared tables (the snapshot
+        # path's own _Interner — one convention, O(1) lookups)
+        self._regions: list[str] = []
+        self._zones: list[str] = []
+        self._regions_in = _Interner(self._regions)
+        self._zones_in = _Interner(self._zones)
+        self._node_labels: dict[str, tuple] = {}  # name -> (region, zone)
+        self._tainted: set[str] = set()
+        self._apply = D.delta_apply_program()
+        self._generation = 0
+        self._rebases = 0
+        self._staleness = 0  # delta events applied since last rebase
+        self._base_digest: Optional[str] = None
+        #: last refresh's packed batch + mode, for the flight recorder
+        self._last: Optional[dict] = None
+
+    # -- wiring ---------------------------------------------------------
+    def attach(self, cluster) -> "ServeEngine":
+        """Install the delta sink on `cluster`. The resident base is built
+        lazily at the first `refresh` (which sees the full store)."""
+        cluster.delta_sink = self._sink
+        self._cluster = cluster
+        self._nodes = None
+        return self
+
+    def detach(self) -> None:
+        """Uninstall the sink and drop the resident base. Call when serve
+        mode is retired for a still-live cluster — otherwise every mutator
+        keeps appending events nobody drains (bounded by
+        `DeltaSink.MAX_EVENTS`, but pinning Pod references until then)."""
+        if (
+            self._cluster is not None
+            and self._cluster.delta_sink is self._sink
+        ):
+            self._cluster.delta_sink = None
+        self._cluster = None
+        self._nodes = None
+        self._sink.events.clear()
+        self._sink.overflowed = False
+        self._sink.nominated_unbound.clear()
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def rebases(self) -> int:
+        """Full re-snapshots THIS engine performed (the process-global
+        `scheduler_serve_rebases_total` sums across engines/runs)."""
+        return self._rebases
+
+    @property
+    def resident_nodes(self):
+        """The live resident `NodeState` (None before the first refresh).
+        Treat as consumed after the next `refresh` — the apply program
+        donates it."""
+        return self._nodes
+
+    @property
+    def npad(self) -> int:
+        return self._npad
+
+    # -- compatibility gate ---------------------------------------------
+    def compatible(self, cluster, pending) -> bool:
+        """True when the assembled snapshot's side tables would all be
+        None — the profile surface the resident columns fully describe."""
+        if (
+            cluster.pod_groups
+            or cluster.quotas
+            or cluster.nrts
+            or cluster.app_groups
+            or cluster.seccomp_profiles
+            or cluster.node_metrics is not None
+            or cluster._selector_spec_pods
+            or self._tainted
+        ):
+            return False
+        # nominations OUTSIDE the pending batch still count into the full
+        # snapshot's nominated column / nominee holds: scheduling-gated
+        # nominees (sink-tracked at upsert) and reserved nominees
+        # (O(reserved), in practice unreachable without gangs)
+        if self._sink.nominated_unbound:
+            return False
+        for uid in cluster.reserved:
+            p = cluster.pods.get(uid)
+            if p is not None and p.nominated_node_name is not None:
+                return False
+        # batch-local specs (O(batch), not O(cluster)): node affinity
+        # feeds SchedulingState; nominations feed the nominee holds;
+        # extended resources fall outside the canonical packed axis
+        for pod in pending:
+            if (
+                pod.node_selector
+                or pod.node_affinity_required
+                or pod.node_affinity_preferred
+                or pod.nominated_node_name is not None
+                or any(
+                    r not in D.CANON_INDEX for r in pod.effective_request()
+                )
+                or any(
+                    r not in D.CANON_INDEX for r in pod.effective_limits()
+                )
+            ):
+                return False
+        return True
+
+    # -- the per-cycle entry --------------------------------------------
+    def refresh(self, cluster, pending, now_ms: int = 0):
+        """(snapshot, meta) for this cycle, or None when the engine cannot
+        own the state (caller falls back to `Cluster.snapshot`). Drains
+        the sink either way — deltas are absorbed even while falling
+        back, so the resident columns never go stale."""
+        events = self._sink.drain()
+        obs.metrics.set_gauge(obs.SERVE_PENDING_DELTAS, len(events))
+        upserts, usage, rebase = self._classify(events)
+        if self._sink.consume_overflow():
+            # the queue collapsed while nobody drained: the surviving
+            # events are a partial window — the resident base is
+            # unrecoverable from deltas alone
+            rebase = "sink-overflow"
+        n_nodes = len(cluster.nodes)
+        grow = self._nodes is not None and n_nodes > self._npad
+
+        if not self.compatible(cluster, pending):
+            # keep the columns in sync while incompatible; a rebase-class
+            # event just drops the base (rebuilt at the next compatible
+            # refresh)
+            if rebase:
+                self._nodes = None
+            elif self._nodes is not None:
+                if grow:
+                    self._grow(bucket_size(n_nodes))
+                self._apply_batch(upserts, usage)
+            self._last = None
+            return None
+
+        if rebase or self._nodes is None:
+            return self._rebase(cluster, pending, now_ms)
+        if grow:
+            self._grow(bucket_size(n_nodes))
+        self._apply_batch(upserts, usage)
+        return self._assemble(cluster, pending)
+
+    # -- event classification -------------------------------------------
+    def _classify(self, events):
+        """Coalesce drained events into packed-row lists. Returns
+        (upsert_rows, usage_rows, rebase_reason|None)."""
+        upserts: dict[int, tuple] = {}  # slot -> row (last write wins)
+        usage: list[tuple] = []
+        rebase = None
+
+        def fail(reason):
+            nonlocal rebase
+            if rebase is None:
+                rebase = reason
+
+        for ev in events:
+            kind = ev[0]
+            if kind == D.NODE_DELETE:
+                # the row order dies with the node — but so do its label/
+                # taint entries: a deleted node must not pin `compatible`
+                # False forever (the rebase that follows rebuilds these
+                # tables only on the COMPATIBLE path)
+                name = ev[1]
+                self._tainted.discard(name)
+                self._node_labels.pop(name, None)
+                fail("node-delete")
+            elif kind == D.NODE_UPSERT:
+                node = ev[1]
+                if node.taints:
+                    self._tainted.add(node.name)
+                else:
+                    self._tainted.discard(node.name)
+                labels = (node.region or "", node.zone or "")
+                prev = self._node_labels.get(node.name)
+                if prev is not None and prev != labels:
+                    # region/zone re-interning cannot be expressed as a
+                    # row overwrite (codes are first-seen in slot order)
+                    fail("label-change")
+                self._node_labels[node.name] = labels
+                slot = self._slots.get(node.name)
+                if slot is None:
+                    slot = len(self._names)
+                    self._slots[node.name] = slot
+                    self._names.append(node.name)
+                try:
+                    alloc = D._encode(node.allocatable)
+                    cap = D._encode(node.capacity)
+                except D.UnsupportedResource:
+                    fail("extended-resource")
+                    continue
+                upserts[slot] = (
+                    slot, alloc, cap, not node.unschedulable,
+                    self._regions_in.code(node.region) if node.region
+                    else -1,
+                    self._zones_in.code(node.zone) if node.zone else -1,
+                )
+            else:  # pod usage transitions
+                pod, node_name = ev[1], ev[2]
+                slot = self._slots.get(node_name)
+                if slot is None:
+                    # pod referenced a node the engine never saw (cross-
+                    # watch ordering): the fresh snapshot skips such pods
+                    # until the node arrives, at which point row contents
+                    # change wholesale — re-base to stay exact
+                    fail("unknown-node")
+                    continue
+                if kind == D.POD_TERMINATING:
+                    usage.append((slot, D.ZERO_R, D.ZERO_R, D.ZERO_R, 0, 1))
+                    continue
+                sign = 1 if kind == D.POD_ASSIGN else -1
+                try:
+                    req, nz, lim = D.pod_usage_vectors(pod)
+                except D.UnsupportedResource:
+                    fail("extended-resource")
+                    continue
+                # event-time flag, NOT pod.terminating: a mark_terminating
+                # between event and drain mutates the pod in place and
+                # queues its own +1 — a drain-time read would double-count
+                term = 1 if ev[3] else 0
+                usage.append((
+                    slot, sign * req, sign * nz, sign * lim, sign,
+                    sign * term,
+                ))
+        return list(upserts.values()), usage, rebase
+
+    # -- state transitions ----------------------------------------------
+    def _apply_batch(self, upsert_rows, usage_rows) -> None:
+        import warnings
+
+        import jax
+        import jax.numpy as jnp
+
+        R = len(D.CANON_INDEX)
+        ups = D.NodeUpserts.pack(upsert_rows, R)
+        use = D.UsageDeltas.pack(usage_rows, R)
+        # slot indices are host-validated (< npad); the jit scatter relies
+        # on that, and SPT_SANITIZE=1 re-checks it with checkify
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            self._nodes = self._apply(
+                self._nodes,
+                *(jnp.asarray(a) for a in ups.as_args()),
+                *(jnp.asarray(a) for a in use.as_args()),
+            )
+        for w in caught:
+            msg = str(w.message)
+            if "donated buffers were not usable" not in msg:
+                warnings.warn_explicit(
+                    w.message, w.category, w.filename, w.lineno
+                )
+            elif msg.count("[") > 1 and jax.default_backend() != "cpu":
+                # ONE undonated buffer is expected — the intentionally
+                # unused `nominated` column (rewritten as zeros). More
+                # than one on a donating backend means the resident
+                # columns silently stopped aliasing, i.e. every apply
+                # pays the O(cluster) copy this subsystem exists to
+                # remove — keep that visible. (CPU never donates and
+                # lists everything, like the profile solves of PR 2.)
+                warnings.warn_explicit(
+                    w.message, w.category, w.filename, w.lineno
+                )
+        self._generation += 1
+        n_events = len(upsert_rows) + len(usage_rows)
+        self._staleness += n_events
+        self._last = {
+            "mode": "delta", "events": n_events,
+            "upserts": ups.as_dict(), "usage": use.as_dict(),
+        }
+        self._observe()
+
+    def _grow(self, new_npad: int) -> None:
+        """Pad the resident columns to a larger bucket device-side —
+        usage history is preserved, only the shape changes (one retrace
+        of the apply/solve programs for the new bucket)."""
+        import jax.numpy as jnp
+
+        pad = new_npad - self._npad
+        if pad <= 0:
+            return
+        nodes = self._nodes
+
+        def pad1(arr, value=0):
+            widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+            return jnp.pad(arr, widths, constant_values=value)
+
+        self._nodes = nodes.replace(
+            alloc=pad1(nodes.alloc),
+            capacity=pad1(nodes.capacity),
+            requested=pad1(nodes.requested),
+            nonzero_requested=pad1(nodes.nonzero_requested),
+            limits=pad1(nodes.limits),
+            mask=pad1(nodes.mask, False),
+            region=pad1(nodes.region, -1),
+            zone=pad1(nodes.zone, -1),
+            pod_count=pad1(nodes.pod_count),
+            terminating=pad1(nodes.terminating),
+            nominated=pad1(nodes.nominated),
+        )
+        self._npad = new_npad
+
+    def _rebase(self, cluster, pending, now_ms: int):
+        """Full re-snapshot: rebuild the resident base from the store (the
+        compact path — the new bucket fits the CURRENT node count) and
+        reset slot/interning tables to the store's own order."""
+        npad = bucket_size(max(len(cluster.nodes), 1))
+        snap, meta = cluster.snapshot(
+            pending, now_ms=now_ms, pad_nodes=npad,
+        )
+        if len(meta.index) != len(D.CANON_INDEX):
+            # an extended resource somewhere in the store (node column or
+            # an ASSIGNED pod's requests) widens the packed axis past the
+            # canonical four the delta vectors carry — the resident
+            # columns cannot own this state. Serve this cycle from the
+            # fresh snapshot and keep re-basing (full-snapshot cost,
+            # exact) until the extended objects go away.
+            self._nodes = None
+            self._generation += 1
+            self._staleness = 0
+            self._rebases += 1
+            obs.metrics.inc(obs.SERVE_REBASES)
+            self._observe()
+            self._last = None
+            return snap, meta
+        self._nodes = snap.nodes
+        self._npad = npad
+        self._names = list(meta.node_names)
+        self._slots = {n: i for i, n in enumerate(self._names)}
+        self._regions = meta.regions  # share: _assemble copies per cycle
+        self._zones = meta.zones
+        self._regions_in = _Interner(self._regions)
+        self._zones_in = _Interner(self._zones)
+        self._node_labels = {
+            n.name: (n.region or "", n.zone or "")
+            for n in cluster.nodes.values()
+        }
+        self._tainted = {n.name for n in cluster.nodes.values() if n.taints}
+        self._generation += 1
+        self._staleness = 0
+        self._rebases += 1
+        obs.metrics.inc(obs.SERVE_REBASES)
+        self._base_digest = None
+        from scheduler_plugins_tpu.utils import flightrec
+
+        if flightrec.recorder.enabled:
+            self._base_digest = flightrec._pack_digest(
+                {k: np.asarray(v) for k, v in self._node_columns().items()}
+            )
+        self._last = {"mode": "rebase", "events": 0}
+        self._observe()
+        return snap, meta
+
+    def _node_columns(self) -> dict:
+        n = self._nodes
+        return {
+            "alloc": n.alloc, "capacity": n.capacity,
+            "requested": n.requested,
+            "nonzero_requested": n.nonzero_requested, "limits": n.limits,
+            "mask": n.mask, "region": n.region, "zone": n.zone,
+            "pod_count": n.pod_count, "terminating": n.terminating,
+        }
+
+    def _assemble(self, cluster, pending):
+        """Snapshot view over the resident node columns + this cycle's
+        pending batch (built through the same `build_pod_state` the full
+        snapshot path uses, so the pod tensors are bit-identical)."""
+        import jax
+        import jax.numpy as jnp
+
+        P = bucket_size(max(len(pending), 1))
+        meta = SnapshotMeta(index=D.CANON_INDEX)
+        meta.node_names = list(self._names)
+        meta.pod_names = [p.uid for p in pending]
+        meta.regions = list(self._regions)
+        meta.zones = list(self._zones)
+        ns_in = _Interner(meta.namespaces)
+        pod_state = build_pod_state(
+            pending, P, D.CANON_INDEX, ns_in, lambda pod: -1,
+            cluster.tlp_prediction,
+        )
+        snap = ClusterSnapshot(
+            nodes=self._nodes,
+            pods=jax.tree.map(jnp.asarray, pod_state),
+        )
+        return snap, meta
+
+    def _observe(self) -> None:
+        obs.metrics.set_gauge(obs.SERVE_GENERATION, self._generation)
+        obs.metrics.set_gauge(obs.SERVE_STALENESS, self._staleness)
+
+    # -- observability hookups ------------------------------------------
+    def annotate_record(self, rec) -> None:
+        """Attach the serve-cycle provenance to a flight-recorder record:
+        resident generation, events-since-base staleness, the base
+        snapshot digest, and the packed delta stream itself (as plain
+        dict-of-array specs, so generic `unpack_pytree` reads them back).
+        The record stays replayable through the standard path — the
+        assembled snapshot is captured in full — and this block is the
+        evidence tying it to the delta stream that produced it."""
+        from scheduler_plugins_tpu.utils.flightrec import pack_pytree
+
+        if self._last is None:
+            return
+        serve = {
+            "generation": self._generation,
+            "staleness_events": self._staleness,
+            "base_digest": self._base_digest,
+            "mode": self._last["mode"],
+            "events": self._last["events"],
+        }
+        if self._last["mode"] == "delta":
+            serve["deltas"] = pack_pytree(
+                {
+                    "upserts": self._last["upserts"],
+                    "usage": self._last["usage"],
+                },
+                rec.blobs,
+            )
+        rec.manifest["serve"] = serve
+
+
+def lower_program_args(n_nodes: int = 256, n_upserts: int = 8,
+                       n_deltas: int = 64):
+    """(jitted fn, sample args) for the AOT compile-readiness gate — the
+    exact donated apply program `ServeEngine` runs, at a reduced resident
+    shape (`tools/tpu_lower.py` serving_delta_apply). One constructor so
+    the certified program and the shipped program cannot drift."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.models import allocatable_scenario
+
+    cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=1)
+    npad = bucket_size(n_nodes)
+    snap, _meta = cluster.snapshot([], now_ms=0, pad_nodes=npad)
+    R = len(D.CANON_INDEX)
+    ups = D.NodeUpserts.pack(
+        [(j, np.zeros(R, np.int64), np.zeros(R, np.int64), True, -1, -1)
+         for j in range(n_upserts)],
+        R,
+    )
+    use = D.UsageDeltas.pack(
+        [(j % n_nodes, np.zeros(R, np.int64), np.zeros(R, np.int64),
+          np.zeros(R, np.int64), 0, 0) for j in range(n_deltas)],
+        R,
+    )
+    args = (
+        snap.nodes,
+        *(jnp.asarray(a) for a in ups.as_args()),
+        *(jnp.asarray(a) for a in use.as_args()),
+    )
+    return D.delta_apply_program(), args

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from scheduler_plugins_tpu.api import events as ev
 from scheduler_plugins_tpu.api.objects import (
     AppGroup,
     ElasticQuota,
@@ -88,9 +89,15 @@ class Cluster:
     unschedulable_since: dict[str, tuple[int, int]] = field(
         default_factory=dict
     )
+    #: optional `serving.deltas.DeltaSink`: when set (ServeEngine.attach),
+    #: the mutators below push typed node-column delta events alongside
+    #: their `note_event` calls — the O(changed) feed the resident-state
+    #: serving engine ingests instead of re-snapshotting (docs/SERVING.md)
+    delta_sink: Optional[object] = None
 
     def note_event(self, kind: str) -> None:
-        """Record a cluster event ("Resource/Action") for requeue gating."""
+        """Record a cluster event ("Resource/Action", `api.events`) for
+        requeue gating."""
         self.event_seq += 1
         self.event_last[kind] = self.event_seq
 
@@ -217,15 +224,19 @@ class Cluster:
     # -- upserts ---------------------------------------------------------
     def add_node(self, node: Node):
         self.note_event(
-            "Node/Update" if node.name in self.nodes else "Node/Add"
+            ev.NODE_UPDATE if node.name in self.nodes else ev.NODE_ADD
         )
         self.nodes[node.name] = node
         if self.native is not None:
             self._native_upsert_node(node)
+        if self.delta_sink is not None:
+            self.delta_sink.node_upsert(node)
 
     def remove_node(self, name: str):
         if self.nodes.pop(name, None) is not None:
-            self.note_event("Node/Delete")
+            self.note_event(ev.NODE_DELETE)
+            if self.delta_sink is not None:
+                self.delta_sink.node_delete(name)
         if self.native is not None:
             self._native_rebuild()
 
@@ -239,11 +250,30 @@ class Cluster:
             or pod.pod_anti_affinity_preferred
         )
 
+    def _held_node(self, pod: Optional[Pod]) -> Optional[str]:
+        """The node whose usage columns `pod` currently contributes to:
+        its binding, else its permit reservation (reserved pods hold
+        capacity exactly like bound ones in the snapshot's assigned
+        view). None for plain pending pods."""
+        if pod is None:
+            return None
+        return pod.node_name or self.reserved.get(pod.uid)
+
     def add_pod(self, pod: Pod):
-        self.note_event(
-            "Pod/Update" if pod.uid in self.pods else "Pod/Add"
-        )
+        old = self.pods.get(pod.uid)
+        self.note_event(ev.POD_UPDATE if old is not None else ev.POD_ADD)
+        if self.delta_sink is not None:
+            # an upsert swaps the pod's assigned contribution wholesale
+            # (requests may have changed; a stale echo may drop the node)
+            old_hold = self._held_node(old)
+            if old_hold is not None:
+                self.delta_sink.pod_unassigned(old, old_hold)
         self.pods[pod.uid] = pod
+        if self.delta_sink is not None:
+            new_hold = self._held_node(pod)
+            if new_hold is not None:
+                self.delta_sink.pod_assigned(pod, new_hold)
+            self.delta_sink.note_nomination(pod)
         if self._has_selector_specs(pod):
             # spread/affinity tables need ASSIGNED pod objects at snapshot
             # build, which the native fast path skips (pod specs are
@@ -261,7 +291,13 @@ class Cluster:
         self.unschedulable_since.pop(uid, None)
         pod = self.pods.pop(uid, None)
         if pod is not None:
-            self.note_event("Pod/Delete")
+            self.note_event(ev.POD_DELETE)
+            if self.delta_sink is not None:
+                if pod.node_name is not None:
+                    # bound pod's usage leaves with it (a reserved pod's
+                    # hold was already released above)
+                    self.delta_sink.pod_unassigned(pod, pod.node_name)
+                self.delta_sink.forget_nomination(uid)
         if (
             pod is not None
             and pod.node_name is not None
@@ -281,29 +317,39 @@ class Cluster:
         pod = self.pods.get(uid)
         if pod is None:
             return
+        was_terminating = pod.terminating
         pod.deletion_ms = now_ms
-        self.note_event("Pod/Update")
+        self.note_event(ev.POD_UPDATE)
         if self.native is not None:
             self._native_upsert_pod(pod)
+        if self.delta_sink is not None and not was_terminating:
+            # the held-capacity node, binding OR reservation: a reserved
+            # victim's terminating flag counts at its reserved node in the
+            # snapshot's assigned view, and the eventual release subtracts
+            # the event-time flag — skipping the +1 here would leave the
+            # resident terminating column permanently negative
+            held = self._held_node(pod)
+            if held is not None:
+                self.delta_sink.pod_terminating(pod, held)
 
     def add_pod_group(self, pg: PodGroup):
         self.note_event(
-            "PodGroup/Update" if pg.full_name in self.pod_groups
-            else "PodGroup/Add"
+            ev.POD_GROUP_UPDATE if pg.full_name in self.pod_groups
+            else ev.POD_GROUP_ADD
         )
         self.pod_groups[pg.full_name] = pg
 
     def add_quota(self, eq: ElasticQuota):
         self.note_event(
-            "ElasticQuota/Update" if eq.namespace in self.quotas
-            else "ElasticQuota/Add"
+            ev.ELASTIC_QUOTA_UPDATE if eq.namespace in self.quotas
+            else ev.ELASTIC_QUOTA_ADD
         )
         self.quotas[eq.namespace] = eq
 
     def add_nrt(self, nrt: NodeResourceTopology):
         self.note_event(
-            "NodeResourceTopology/Update" if nrt.node_name in self.nrts
-            else "NodeResourceTopology/Add"
+            ev.NRT_UPDATE if nrt.node_name in self.nrts
+            else ev.NRT_ADD
         )
         self.nrts[nrt.node_name] = nrt
         if self.nrt_cache is not None:
@@ -313,54 +359,54 @@ class Cluster:
         """NRT CR deleted: evict from the cache tier too, or the snapshot
         keeps building NUMA tables from the stale copy forever."""
         if node_name in self.nrts:
-            self.note_event("NodeResourceTopology/Delete")
+            self.note_event(ev.NRT_DELETE)
         self.nrts.pop(node_name, None)
         if self.nrt_cache is not None:
             self.nrt_cache.delete_nrt(node_name)
 
     def add_app_group(self, ag: AppGroup):
         self.note_event(
-            "AppGroup/Update"
+            ev.APP_GROUP_UPDATE
             if f"{ag.namespace}/{ag.name}" in self.app_groups
-            else "AppGroup/Add"
+            else ev.APP_GROUP_ADD
         )
         self.app_groups[f"{ag.namespace}/{ag.name}"] = ag
 
     def add_network_topology(self, nt: NetworkTopology):
         self.note_event(
-            "NetworkTopology/Update"
+            ev.NETWORK_TOPOLOGY_UPDATE
             if f"{nt.namespace}/{nt.name}" in self.network_topologies
-            else "NetworkTopology/Add"
+            else ev.NETWORK_TOPOLOGY_ADD
         )
         self.network_topologies[f"{nt.namespace}/{nt.name}"] = nt
 
     def add_seccomp_profile(self, sp: SeccompProfile):
         self.note_event(
-            "SeccompProfile/Update"
+            ev.SECCOMP_PROFILE_UPDATE
             if sp.full_name in self.seccomp_profiles
-            else "SeccompProfile/Add"
+            else ev.SECCOMP_PROFILE_ADD
         )
         self.seccomp_profiles[sp.full_name] = sp
 
     def add_priority_class(self, pc: PriorityClass):
         self.note_event(
-            "PriorityClass/Update" if pc.name in self.priority_classes
-            else "PriorityClass/Add"
+            ev.PRIORITY_CLASS_UPDATE if pc.name in self.priority_classes
+            else ev.PRIORITY_CLASS_ADD
         )
         self.priority_classes[pc.name] = pc
 
     def add_namespace(self, ns):
         self.note_event(
-            "Namespace/Update" if ns.name in self.namespaces
-            else "Namespace/Add"
+            ev.NAMESPACE_UPDATE if ns.name in self.namespaces
+            else ev.NAMESPACE_ADD
         )
         self.namespaces[ns.name] = ns
 
     def add_pdb(self, pdb: PodDisruptionBudget):
         self.note_event(
-            "PodDisruptionBudget/Update"
+            ev.PDB_UPDATE
             if f"{pdb.namespace}/{pdb.name}" in self.pdbs
-            else "PodDisruptionBudget/Add"
+            else ev.PDB_ADD
         )
         self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
 
@@ -409,10 +455,19 @@ class Cluster:
 
     # -- binding / reservations -----------------------------------------
     def bind(self, uid: str, node_name: str, now_ms: int = 0):
-        self.reserved.pop(uid, None)
+        held = self.reserved.pop(uid, None)
         self.pod_deadline_ms.pop(uid, None)
         self.unschedulable_since.pop(uid, None)
-        self.note_event("Pod/Update")  # assigned: spec.nodeName set
+        self.note_event(ev.POD_UPDATE)  # assigned: spec.nodeName set
+        if self.delta_sink is not None:
+            if held != node_name:
+                # a reservation-to-bind on the SAME node is already
+                # counted; anything else transfers the contribution
+                if held is not None:
+                    self.delta_sink.pod_unassigned(self.pods[uid], held)
+                self.delta_sink.pod_assigned(self.pods[uid], node_name)
+            # bound pods never count toward the nominated column
+            self.delta_sink.forget_nomination(uid)
         self.pods[uid].node_name = node_name
         self.recent_bindings[uid] = (now_ms, node_name)
         if self.nrt_cache is not None:
@@ -428,6 +483,9 @@ class Cluster:
     def reserve(self, uid: str, node_name: str):
         """Permit said Wait: hold the placement without binding."""
         self.reserved[uid] = node_name
+        if self.delta_sink is not None:
+            # a reservation holds capacity exactly like a binding
+            self.delta_sink.pod_assigned(self.pods[uid], node_name)
         if self.nrt_cache is not None:
             self.nrt_cache.reserve(node_name, self.pods[uid])
         if self.native is not None:
@@ -439,6 +497,8 @@ class Cluster:
     def release_reservation(self, uid: str):
         self.pod_deadline_ms.pop(uid, None)
         node = self.reserved.pop(uid, None)
+        if node is not None and self.delta_sink is not None:
+            self.delta_sink.pod_unassigned(self.pods[uid], node)
         if node is not None and self.nrt_cache is not None:
             self.nrt_cache.unreserve(node, self.pods[uid])
         if node is not None and self.native is not None:

@@ -350,6 +350,73 @@ def nonzero_request(req: np.ndarray, index: ResourceIndex) -> np.ndarray:
     return out
 
 
+def build_pod_state(
+    pending_pods: Sequence[Pod],
+    P: int,
+    index: ResourceIndex,
+    ns_in: "_Interner",
+    gang_of,
+    tlp_prediction: tuple = (1.5, 1000),
+) -> PodState:
+    """Lower the pending batch into `PodState` (host numpy) — THE one copy
+    of the pod-tensor lowering, shared by `build_snapshot` and the serving
+    engine's per-cycle assembly (`serving.engine.ServeEngine._assemble`),
+    so the two paths produce bit-identical pod tensors by construction.
+    `ns_in` interns namespace codes into the caller's meta table;
+    `gang_of(pod) -> int` maps a pod to its gang code (-1 outside)."""
+    R = len(index)
+    preq = np.zeros((P, R), I64)
+    plimits = np.zeros((P, R), I64)
+    ppredicted = np.zeros(P, I64)
+    C = max(
+        max(
+            (len(p.init_containers) + len(p.containers) for p in pending_pods),
+            default=1,
+        ),
+        1,
+    )
+    pcreq = np.zeros((P, C, R), I64)
+    pcinit = np.zeros((P, C), bool)
+    pcmask = np.zeros((P, C), bool)
+    ppriority = np.zeros(P, I64)
+    pns = np.zeros(P, I32)
+    pgang = np.full(P, -1, I32)
+    pqos = np.zeros(P, I32)
+    pmask = np.zeros(P, bool)
+    pcreated = np.zeros(P, I64)
+    pgated = np.zeros(P, bool)
+    for i, pod in enumerate(pending_pods):
+        preq[i] = index.encode(pod.effective_request())
+        plimits[i] = index.encode(pod.effective_limits())
+        ppredicted[i] = pod.tlp_predicted_cpu_millis(*tlp_prediction)
+        for c, cont in enumerate(list(pod.init_containers) + list(pod.containers)):
+            pcreq[i, c] = index.encode(cont.requests)
+            pcinit[i, c] = c < len(pod.init_containers)
+            pcmask[i, c] = True
+        ppriority[i] = pod.priority
+        pns[i] = ns_in.code(pod.namespace)
+        pgang[i] = gang_of(pod)
+        pqos[i] = int(pod.qos_class())
+        pmask[i] = True
+        pcreated[i] = pod.creation_ms
+        pgated[i] = pod.scheduling_gated
+    return PodState(
+        req=preq,
+        limits=plimits,
+        predicted_cpu_millis=ppredicted,
+        container_req=pcreq,
+        container_is_init=pcinit,
+        container_mask=pcmask,
+        priority=ppriority,
+        ns=pns,
+        gang=pgang,
+        qos=pqos,
+        mask=pmask,
+        creation_ms=pcreated,
+        gated=pgated,
+    )
+
+
 def build_snapshot(
     nodes: Sequence[Node],
     pending_pods: Sequence[Pod],
@@ -567,55 +634,8 @@ def build_snapshot(
     )
 
     # --- pods (pending batch) -----------------------------------------
-    preq = np.zeros((P, R), I64)
-    plimits = np.zeros((P, R), I64)
-    ppredicted = np.zeros(P, I64)
-    C = max(
-        max(
-            (len(p.init_containers) + len(p.containers) for p in pending_pods),
-            default=1,
-        ),
-        1,
-    )
-    pcreq = np.zeros((P, C, R), I64)
-    pcinit = np.zeros((P, C), bool)
-    pcmask = np.zeros((P, C), bool)
-    ppriority = np.zeros(P, I64)
-    pns = np.zeros(P, I32)
-    pgang = np.full(P, -1, I32)
-    pqos = np.zeros(P, I32)
-    pmask = np.zeros(P, bool)
-    pcreated = np.zeros(P, I64)
-    pgated = np.zeros(P, bool)
-    for i, pod in enumerate(pending_pods):
-        preq[i] = index.encode(pod.effective_request())
-        plimits[i] = index.encode(pod.effective_limits())
-        ppredicted[i] = pod.tlp_predicted_cpu_millis(*tlp_prediction)
-        for c, cont in enumerate(list(pod.init_containers) + list(pod.containers)):
-            pcreq[i, c] = index.encode(cont.requests)
-            pcinit[i, c] = c < len(pod.init_containers)
-            pcmask[i, c] = True
-        ppriority[i] = pod.priority
-        pns[i] = ns_in.code(pod.namespace)
-        pgang[i] = _gang_of(pod)
-        pqos[i] = int(pod.qos_class())
-        pmask[i] = True
-        pcreated[i] = pod.creation_ms
-        pgated[i] = pod.scheduling_gated
-    pod_state = PodState(
-        req=preq,
-        limits=plimits,
-        predicted_cpu_millis=ppredicted,
-        container_req=pcreq,
-        container_is_init=pcinit,
-        container_mask=pcmask,
-        priority=ppriority,
-        ns=pns,
-        gang=pgang,
-        qos=pqos,
-        mask=pmask,
-        creation_ms=pcreated,
-        gated=pgated,
+    pod_state = build_pod_state(
+        pending_pods, P, index, ns_in, _gang_of, tlp_prediction
     )
 
     # --- quota ---------------------------------------------------------
@@ -778,7 +798,7 @@ def build_snapshot(
             fresh=nrt_fresh,
             max_numa=max_numa,
             pack_scales=_numa_pack_scales(
-                z_avail, z_alloc, preq, pcreq, R
+                z_avail, z_alloc, pod_state.req, pod_state.container_req, R
             ),
         )
 

@@ -126,6 +126,15 @@ class Metrics:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + value
 
+    def set_gauge(self, name: str, value, **labels) -> None:
+        """Gauge semantics: last write wins (e.g. resident-state
+        generation/staleness). Rendered as `# TYPE ... gauge` by
+        `prometheus_text` — gauge names must not end in `_total`/`_count`
+        (those suffixes type as counters)."""
+        key = (name, _label_items(labels))
+        with self._lock:
+            self._counters[key] = value
+
     def _set_max(self, name: str, value: int, items: tuple = ()) -> None:
         key = (name, items)
         if value > self._counters.get(key, 0):
@@ -245,6 +254,22 @@ JIT_COMPILE = "scheduler_jit_compile_ms"
 JIT_CACHE_MISS = "scheduler_jit_cache_misses_total"
 #: cycles captured by the flight recorder (utils.flightrec)
 FLIGHTREC_CYCLES = "scheduler_flightrec_cycles_total"
+#: serve-mode decision latency histogram: wall ms from delta ingest to
+#: host-visible bind decisions for one resident-state cycle
+#: (framework.cycle.run_cycle(serve=...))
+SERVE_DECISION_LATENCY = "scheduler_serve_decision_latency_ms"
+#: gauge: resident-state generation (monotonic per applied delta batch /
+#: rebase; serving.engine.ServeEngine)
+SERVE_GENERATION = "scheduler_serve_state_generation"
+#: gauge: delta events applied since the resident base was last rebuilt —
+#: how long the replay chain from the base snapshot has grown
+SERVE_STALENESS = "scheduler_serve_state_staleness_events"
+#: gauge: delta events drained at the START of the current refresh (queue
+#: depth the engine saw — sustained growth means ingest is falling behind)
+SERVE_PENDING_DELTAS = "scheduler_serve_pending_deltas"
+#: full re-snapshots the serving engine performed (node deletes, label
+#: re-interning, extended resources — docs/SERVING.md taxonomy)
+SERVE_REBASES = "scheduler_serve_rebases_total"
 
 
 # ---------------------------------------------------------------------------

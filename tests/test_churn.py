@@ -67,8 +67,25 @@ def check_invariants(cluster):
 
 class TestChurn:
     def test_thirty_cycle_churn(self):
+        self._thirty_cycle_churn()
+
+    def test_thirty_cycle_churn_serve_mode_parity(self):
+        """The same churn with a serving engine attached: the gang/quota
+        roster keeps the engine's compatibility gate False (side tables
+        present), so every cycle falls back to the full snapshot while
+        the sink absorbs deltas — outcomes must be identical to the
+        plain run, cycle for cycle (serve mode never changes WHAT the
+        solver decides, even when it cannot own the state)."""
+        plain = self._thirty_cycle_churn()
+        served = self._thirty_cycle_churn(serve=True)
+        assert served == plain
+
+    def _thirty_cycle_churn(self, serve=False):
+        from scheduler_plugins_tpu.serving import ServeEngine
+
         rng = np.random.default_rng(7)
         cluster = Cluster()
+        engine = ServeEngine().attach(cluster) if serve else None
         for i in range(8):
             cluster.add_node(
                 Node(name=f"n{i}", allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 30})
@@ -139,7 +156,7 @@ class TestChurn:
             for pod in bound:
                 if rng.random() < 0.15:
                     cluster.remove_pod(pod.uid)
-            run_cycle(sched, cluster, now=now)
+            run_cycle(sched, cluster, now=now, serve=engine)
             # mark bound pods running and reconcile controllers
             for pod in cluster.pods.values():
                 if pod.node_name is not None and pod.phase == PodPhase.PENDING:
@@ -157,12 +174,18 @@ class TestChurn:
             ]
             for pod in running_plain[: max(1, len(running_plain) // 2)]:
                 cluster.remove_pod(pod.uid)
-            run_cycle(sched, cluster, now=40_000 + extra * 1000)
+            run_cycle(sched, cluster, now=40_000 + extra * 1000,
+                      serve=engine)
             check_invariants(cluster)
         plain_left = [
             p for p in cluster.pending_pods() if not p.pod_group()
         ]
         assert not plain_left, [p.uid for p in plain_left]
+        return {
+            uid: p.node_name
+            for uid, p in cluster.pods.items()
+            if p.node_name is not None
+        }
 
 
 class TestExclusiveForeign:

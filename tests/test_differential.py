@@ -450,3 +450,67 @@ class TestBatchedNumaGangHardConstraintParity:
             # completeness parity: the throughput mode must not place fewer
             # pods than the bit-faithful path
             assert int((a_bat >= 0).sum()) >= int((a_seq >= 0).sum()), seed
+
+
+class TestServeDeltaEquivalence:
+    """Serve-mode differential (docs/SERVING.md): the resident-state
+    engine's delta-maintained solver input must be BIT-IDENTICAL to a
+    fresh full re-snapshot after any event sequence, and serve-mode
+    placements identical to full-resnapshot cycles — the engine changes
+    where the solver input comes from, never what the solver decides.
+    (The randomized per-cycle tensor diff lives in tests/test_serving.py;
+    this gate replays a fixed dense sequence through BOTH `run_cycle`
+    modes and diffs outcomes + final resident tensors.)"""
+
+    def test_delta_path_matches_full_resnapshot(self):
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.serving import ServeEngine
+        from tests.test_serving import (
+            NODE_COLUMNS,
+            make_cluster,
+            make_pod,
+            make_node,
+            make_scheduler,
+        )
+
+        outcomes = {}
+        finals = {}
+        for mode in ("serve", "baseline"):
+            rng = np.random.default_rng(11)
+            cluster = make_cluster(5)
+            engine = (
+                ServeEngine().attach(cluster) if mode == "serve" else None
+            )
+            sched = make_scheduler()
+            serial, bound_log = 0, []
+            for cycle in range(8):
+                now = 1000 * (cycle + 1)
+                for _ in range(int(rng.integers(1, 4))):
+                    serial += 1
+                    cluster.add_pod(make_pod(
+                        serial, now, int(rng.integers(200, 2500)), gib
+                    ))
+                if cycle == 3:
+                    cluster.add_node(make_node(40))
+                if cycle == 5:
+                    bound = sorted(
+                        u for u, p in cluster.pods.items()
+                        if p.node_name is not None
+                    )
+                    cluster.remove_pod(bound[0])
+                report = run_cycle(sched, cluster, now=now, serve=engine)
+                bound_log.append(dict(report.bound))
+            outcomes[mode] = bound_log
+            if engine is not None:
+                assert engine.refresh(cluster, [], now_ms=9000) is not None
+                finals["resident"] = engine.resident_nodes
+                finals["fresh"], _ = cluster.snapshot(
+                    [], now_ms=9000, pad_nodes=engine.npad
+                )
+        assert outcomes["serve"] == outcomes["baseline"]
+        for col in NODE_COLUMNS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(finals["resident"], col)),
+                np.asarray(getattr(finals["fresh"].nodes, col)),
+                err_msg=col,
+            )

@@ -1,0 +1,517 @@
+"""Resident-state serving engine: delta-equivalence differential + edge paths.
+
+The engine's contract (serving/engine.py): serve mode changes WHERE the
+solver input comes from — device-resident node columns maintained by
+O(changed) scatter deltas — never what the solver decides. These tests
+drive randomized event sequences through the delta path and assert
+bit-identical NodeState tensors against a fresh full re-snapshot, and
+identical placements against a full-resnapshot baseline run; the edge
+tests cover every transition in the docs/SERVING.md taxonomy (grow,
+re-base reasons, compatibility fallback and resumption).
+"""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api import events as ev
+from scheduler_plugins_tpu.api.objects import (
+    REGION_LABEL,
+    ZONE_LABEL,
+    Container,
+    ElasticQuota,
+    Node,
+    Pod,
+    Taint,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.framework.plugin import BUILTIN_EVENTS
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.serving import ServeEngine
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import observability as obs
+
+gib = 1 << 30
+
+#: every column of the resident NodeState — compared bit-exact
+NODE_COLUMNS = (
+    "alloc", "capacity", "requested", "nonzero_requested", "limits",
+    "mask", "region", "zone", "pod_count", "terminating", "nominated",
+)
+
+EXT = "example.com/gpu"
+
+
+def make_node(i, cpu=8000, unschedulable=False, extra=None):
+    alloc = {CPU: cpu, MEMORY: 32 * gib, PODS: 32}
+    if extra:
+        alloc.update(extra)
+    return Node(
+        name=f"n{i:03d}",
+        allocatable=alloc,
+        labels={REGION_LABEL: "r1", ZONE_LABEL: f"z{i % 2}"},
+        unschedulable=unschedulable,
+    )
+
+
+def make_cluster(n_nodes=6):
+    cluster = Cluster()
+    for i in range(n_nodes):
+        cluster.add_node(make_node(i))
+    return cluster
+
+
+def make_pod(serial, now, cpu=500, mem=gib):
+    return Pod(
+        name=f"p{serial:05d}",
+        creation_ms=now + serial,
+        containers=[Container(requests={CPU: cpu, MEMORY: mem})],
+    )
+
+
+def make_scheduler():
+    return Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+
+
+def assert_resident_matches(engine, cluster, now):
+    """Drain the sink (deltas from the cycle's own binds apply at the next
+    refresh), then compare the delta-maintained resident columns against a
+    fresh full re-snapshot of the same store, bit-exact."""
+    refreshed = engine.refresh(cluster, [], now_ms=now)
+    assert refreshed is not None, "engine fell back while compatible"
+    snap, _ = cluster.snapshot([], now_ms=now, pad_nodes=engine.npad)
+    for col in NODE_COLUMNS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(engine.resident_nodes, col)),
+            np.asarray(getattr(snap.nodes, col)),
+            err_msg=f"resident column {col} diverged from fresh snapshot",
+        )
+
+
+class TestDeltaEquivalence:
+    """The satellite differential: N randomized event sequences through
+    the delta path vs a full re-snapshot every cycle."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_event_sequences(self, seed):
+        rng = np.random.default_rng(seed)
+        serve_cluster = make_cluster(6)
+        engine = ServeEngine().attach(serve_cluster)
+        base_cluster = make_cluster(6)
+        serve_sched, base_sched = make_scheduler(), make_scheduler()
+
+        serial = 0
+        extra_nodes = 0
+        for cycle in range(10):
+            now = 1000 * (cycle + 1)
+            # one cycle's event batch, resolved against the serve cluster
+            # and replayed verbatim on the baseline (identical placements
+            # each cycle keep the two stores identical)
+            events = []
+            for _ in range(int(rng.integers(0, 5))):
+                serial += 1
+                events.append((
+                    "arrive", serial,
+                    int(rng.integers(100, 3000)),
+                    int(rng.integers(1, 4)) * gib,
+                ))
+            if rng.random() < 0.3:
+                serial += 1
+                # pre-bound arrival (feed-replay shape): lands directly in
+                # the usage columns without a solve
+                events.append((
+                    "arrive_bound", serial, int(rng.integers(100, 1000)),
+                    gib, f"n{int(rng.integers(0, 6)):03d}",
+                ))
+            bound = sorted(
+                uid for uid, p in serve_cluster.pods.items()
+                if p.node_name is not None
+            )
+            for _ in range(int(rng.integers(0, 3))):
+                if not bound:
+                    break
+                uid = bound.pop(int(rng.integers(0, len(bound))))
+                events.append(
+                    ("terminate", uid) if rng.random() < 0.3
+                    else ("depart", uid)
+                )
+            if rng.random() < 0.25:
+                extra_nodes += 1
+                events.append(("node_add", 100 + extra_nodes))
+            if rng.random() < 0.2:
+                # row overwrite of an existing node (mask flip)
+                events.append((
+                    "node_update", int(rng.integers(0, 6)),
+                    bool(rng.random() < 0.5),
+                ))
+
+            for cl in (serve_cluster, base_cluster):
+                for e in events:
+                    if e[0] == "arrive":
+                        cl.add_pod(make_pod(e[1], now, e[2], e[3]))
+                    elif e[0] == "arrive_bound":
+                        pod = make_pod(e[1], now, e[2], e[3])
+                        pod.node_name = e[4]
+                        cl.add_pod(pod)
+                    elif e[0] == "depart":
+                        cl.remove_pod(e[1])
+                    elif e[0] == "terminate":
+                        cl.mark_terminating(e[1], now)
+                    elif e[0] == "node_add":
+                        cl.add_node(make_node(e[1]))
+                    elif e[0] == "node_update":
+                        cl.add_node(make_node(e[1], unschedulable=e[2]))
+
+            serve_report = run_cycle(
+                serve_sched, serve_cluster, now=now, serve=engine
+            )
+            base_report = run_cycle(base_sched, base_cluster, now=now)
+            assert serve_report.bound == base_report.bound
+            assert serve_report.failed == base_report.failed
+            assert_resident_matches(engine, serve_cluster, now)
+
+    def test_steady_state_is_delta_applied_not_rebased(self):
+        """After the initial rebase, pure pod churn must never re-base —
+        the whole point of the O(changed) path."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(99, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        rebases0 = obs.metrics.get(obs.SERVE_REBASES)
+        gen0 = engine.generation
+        for cycle in range(5):
+            now = 2000 + 1000 * cycle
+            cluster.add_pod(make_pod(cycle + 1, now))
+            run_cycle(sched, cluster, now=now, serve=engine)
+        assert obs.metrics.get(obs.SERVE_REBASES) == rebases0
+        assert engine.generation > gen0  # deltas actually applied
+        assert_resident_matches(engine, cluster, now)
+
+
+class TestServeEdgePaths:
+    def test_grow_across_padding_bucket(self):
+        """Node adds past the padded capacity grow the resident columns
+        in place (usage history preserved, no rebase)."""
+        cluster = make_cluster(7)  # bucket 8
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        assert engine.npad == 8
+        rebases0 = obs.metrics.get(obs.SERVE_REBASES)
+        for i in range(7, 12):  # 12 nodes -> bucket 16
+            cluster.add_node(make_node(i))
+        cluster.add_pod(make_pod(2, 1500))
+        run_cycle(sched, cluster, now=2000, serve=engine)
+        assert engine.npad == 16
+        assert obs.metrics.get(obs.SERVE_REBASES) == rebases0
+        assert_resident_matches(engine, cluster, 2500)
+
+    def test_node_delete_rebases(self):
+        cluster = make_cluster(6)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        rebases0 = obs.metrics.get(obs.SERVE_REBASES)
+        victim = next(iter(cluster.nodes))
+        for uid in [
+            u for u, p in cluster.pods.items() if p.node_name == victim
+        ]:
+            cluster.remove_pod(uid)
+        cluster.remove_node(victim)
+        cluster.add_pod(make_pod(2, 1500))
+        report = run_cycle(sched, cluster, now=2000, serve=engine)
+        assert report.bound  # still placing
+        assert obs.metrics.get(obs.SERVE_REBASES) == rebases0 + 1
+        assert_resident_matches(engine, cluster, 2500)
+
+    def test_label_change_rebases(self):
+        """Region/zone re-labeling cannot be expressed as a row overwrite
+        (codes are first-seen interned) — must re-base, then match."""
+        cluster = make_cluster(6)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        rebases0 = obs.metrics.get(obs.SERVE_REBASES)
+        relabeled = make_node(1)
+        relabeled.labels = {REGION_LABEL: "r9", ZONE_LABEL: "z9"}
+        cluster.add_node(relabeled)
+        cluster.add_pod(make_pod(2, 1500))
+        run_cycle(sched, cluster, now=2000, serve=engine)
+        assert obs.metrics.get(obs.SERVE_REBASES) == rebases0 + 1
+        assert_resident_matches(engine, cluster, 2500)
+
+    def test_extended_resource_node_disengages_then_resumes(self):
+        """A node naming a resource outside the canonical axis widens the
+        packed axis — the engine must not own that state (serves from
+        fresh snapshots), and must resume once the node goes away."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(90, 500))
+        base = make_cluster(4)
+        base.add_pod(make_pod(90, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        base_sched = make_scheduler()
+        run_cycle(base_sched, base, now=1000)
+        assert engine.resident_nodes is not None
+        cluster.add_node(make_node(50, extra={EXT: 4}))
+        cluster.add_pod(make_pod(1, 1500))
+        base.add_node(make_node(50, extra={EXT: 4}))
+        base.add_pod(make_pod(1, 1500))
+        serve_report = run_cycle(sched, cluster, now=2000, serve=engine)
+        base_report = run_cycle(base_sched, base, now=2000)
+        assert serve_report.bound == base_report.bound
+        assert serve_report.bound
+        assert engine.resident_nodes is None  # disowned, not corrupted
+        # extended node drained away: serving resumes
+        for uid in [
+            u for u, p in cluster.pods.items() if p.node_name == "n050"
+        ]:
+            cluster.remove_pod(uid)
+        cluster.remove_node("n050")
+        cluster.add_pod(make_pod(2, 2500))
+        run_cycle(sched, cluster, now=3000, serve=engine)
+        assert engine.resident_nodes is not None  # serving resumed
+        assert_resident_matches(engine, cluster, 3500)
+
+    def test_extended_resource_pending_pod_falls_back(self):
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        pod = Pod(
+            name="gpu-pod", creation_ms=1500,
+            containers=[Container(requests={CPU: 100, EXT: 1})],
+        )
+        cluster.add_pod(pod)
+        pending = cluster.pending_pods()
+        assert not engine.compatible(cluster, pending)
+        cluster.remove_pod(pod.uid)
+        assert engine.compatible(cluster, cluster.pending_pods())
+        assert_resident_matches(engine, cluster, 2000)
+
+    def test_side_table_fallback_absorbs_deltas(self):
+        """While a side-table object (quota) disqualifies serve mode, the
+        cycle falls back to full snapshots but the resident columns keep
+        absorbing deltas — serving resumes WITHOUT a rebase."""
+        cluster = make_cluster(6)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(99, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        assert engine.resident_nodes is not None
+        rebases0 = obs.metrics.get(obs.SERVE_REBASES)
+        cluster.add_quota(ElasticQuota(
+            name="eq", namespace="team",
+            min={CPU: 1000}, max={CPU: 2000},
+        ))
+        for cycle in range(3):
+            now = 2000 + 1000 * cycle
+            cluster.add_pod(make_pod(cycle + 1, now))
+            report = run_cycle(sched, cluster, now=now, serve=engine)
+            assert report.bound  # fallback cycles still place
+        if cluster.quotas.pop("team", None):
+            cluster.note_event(ev.ELASTIC_QUOTA_DELETE)
+        assert obs.metrics.get(obs.SERVE_REBASES) == rebases0
+        assert_resident_matches(engine, cluster, 9000)
+
+    def test_tainted_node_delete_resumes_serving(self):
+        """Deleting the only tainted node must clear its compat entry —
+        serving resumes instead of pinning fallback forever."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        tainted = make_node(60)
+        tainted.taints = [Taint(key="k", value="v")]
+        cluster.add_node(tainted)
+        # refresh classifies the upsert (tracking the taint) before the
+        # gate — the tainted roster falls back to full snapshots
+        assert engine.refresh(cluster, [], now_ms=2000) is None
+        assert not engine.compatible(cluster, [])
+        cluster.remove_node("n060")
+        run_cycle(sched, cluster, now=3000, serve=engine)
+        assert_resident_matches(engine, cluster, 3500)
+
+    def test_terminating_flip_in_same_drain_window_counts_once(self):
+        """Regression: a pod bound in cycle K whose terminating flip lands
+        BEFORE cycle K+1's refresh drains the bind event. The flip mutates
+        the pod in place AND queues its own +1 delta — the assign row must
+        carry the event-time flag (False), not a drain-time re-read, or
+        the resident terminating column double-counts until a rebase."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        report = run_cycle(sched, cluster, now=1000, serve=engine)
+        (uid,) = report.bound
+        # the bind's POD_ASSIGN is still queued; flip terminating now
+        cluster.mark_terminating(uid, 1500)
+        assert_resident_matches(engine, cluster, 2000)
+
+    def test_reserved_pod_terminating_counts_at_reserved_node(self):
+        """Regression: a reserved (permit-held) pod marked terminating —
+        e.g. picked as a preemption victim — counts at its RESERVED node
+        in the snapshot's assigned view. The delta must fire for the
+        held node (binding OR reservation), or the later release
+        subtracts a terminating count that was never added and the
+        resident column goes permanently negative."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        held = make_pod(2, 600)
+        cluster.add_pod(held)
+        cluster.reserve(held.uid, "n001")
+        cluster.mark_terminating(held.uid, 1500)
+        assert_resident_matches(engine, cluster, 2000)
+        cluster.release_reservation(held.uid)
+        assert_resident_matches(engine, cluster, 3000)
+
+    def test_gated_nominated_pod_falls_back(self):
+        """A scheduling-gated pod carrying a NominatedNodeName never
+        enters the pending batch, but the full snapshot counts it into
+        the nominated column — the sink's sticky tracking must gate."""
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        pod = make_pod(1, 1500)
+        pod.scheduling_gated = True
+        pod.nominated_node_name = "n000"
+        cluster.add_pod(pod)
+        assert not engine.compatible(cluster, [])
+        cluster.remove_pod(pod.uid)
+        assert engine.compatible(cluster, [])
+        assert_resident_matches(engine, cluster, 2000)
+
+
+class TestSinkLifecycle:
+    def test_detach_uninstalls_sink(self):
+        cluster = make_cluster(3)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        engine.detach()
+        assert cluster.delta_sink is None
+        assert engine.resident_nodes is None
+        # mutators no longer append anywhere
+        cluster.add_pod(make_pod(2, 600))
+        run_cycle(sched, cluster, now=2000)
+        assert engine._sink.events == []
+
+    def test_sink_overflow_forces_rebase_not_corruption(self):
+        """An undrained sink past MAX_EVENTS collapses; the next refresh
+        must re-base (the surviving window is partial) and still match a
+        fresh snapshot bit-exact."""
+        from scheduler_plugins_tpu.serving.deltas import DeltaSink
+
+        cluster = make_cluster(3)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        cluster.add_pod(make_pod(1, 500))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        rebases0 = engine.rebases
+        old_max = DeltaSink.MAX_EVENTS
+        DeltaSink.MAX_EVENTS = 4
+        try:
+            for s in range(2, 9):  # bound arrivals: 7 usage events > cap
+                pod = make_pod(s, 1500)
+                pod.node_name = "n000"
+                cluster.add_pod(pod)
+            assert engine._sink.overflowed
+        finally:
+            DeltaSink.MAX_EVENTS = old_max
+        cluster.add_pod(make_pod(50, 1800))  # pending: the cycle refreshes
+        run_cycle(sched, cluster, now=2000, serve=engine)
+        assert engine.rebases == rebases0 + 1
+        assert_resident_matches(engine, cluster, 3000)
+
+
+class TestEventKindTable:
+    """Satellite: the `api.events` table is THE one copy of the kind
+    strings — every registration must name a kind the store can emit."""
+
+    def test_builtin_events_are_known(self):
+        assert set(BUILTIN_EVENTS) <= ev.EVENT_KINDS
+
+    def test_plugin_registrations_are_known(self):
+        from scheduler_plugins_tpu import plugins as P
+
+        checked = 0
+        for name in dir(P):
+            cls = getattr(P, name)
+            if not (isinstance(cls, type) and hasattr(
+                    cls, "events_to_register")):
+                continue
+            try:
+                plugin = cls()
+            except TypeError:
+                continue
+            kinds = set(plugin.events_to_register())
+            assert kinds <= ev.EVENT_KINDS, name
+            checked += 1
+        assert checked >= 8  # the mixed roster's worth of plugins
+
+    def test_kind_format(self):
+        for kind in ev.EVENT_KINDS:
+            resource, _, action = kind.partition("/")
+            assert resource and action in {"Add", "Update", "Delete"}, kind
+
+    def test_serve_taxonomy_is_within_the_table(self):
+        assert ev.NODE_COLUMN_EVENTS <= ev.EVENT_KINDS
+        assert ev.SERVE_REBASE_EVENTS <= ev.EVENT_KINDS
+
+
+class TestServeFlightRecorder:
+    """Satellite: serve-mode cycles are replayable artifacts — the
+    assembled snapshot is captured in full (standard replay path) and the
+    record additionally carries the serve provenance: resident
+    generation, staleness, the base snapshot digest, and the packed
+    delta stream that produced this cycle's solver input."""
+
+    def test_serve_cycles_record_replayably(self, tmp_path):
+        from scheduler_plugins_tpu.utils import flightrec
+
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        flightrec.recorder.start(capacity=4)
+        try:
+            cluster.add_pod(make_pod(1, 500))
+            r1 = run_cycle(sched, cluster, now=1000, serve=engine)
+            cluster.add_pod(make_pod(2, 1500))
+            r2 = run_cycle(sched, cluster, now=2000, serve=engine)
+            recs = flightrec.recorder.records()
+            assert [r.manifest["serve"]["mode"] for r in recs] == [
+                "rebase", "delta",
+            ]
+            assert recs[0].manifest["serve"]["base_digest"]
+            delta_blk = recs[1].manifest["serve"]
+            assert delta_blk["events"] > 0
+            assert "deltas" in delta_blk  # the packed scatter batch
+            assert delta_blk["generation"] == engine.generation
+            summary = flightrec.recorder.save(str(tmp_path))
+            assert summary["cycles"] == 2
+        finally:
+            flightrec.recorder.stop()
+        cycles = flightrec.load_bundle(str(tmp_path))
+        assert len(cycles) == 2
+        for cyc, report in zip(cycles, (r1, r2)):
+            assert cyc.digest_ok()
+            out = flightrec.replay_cycle(cyc)
+            assert out["placements_match"], out.get("mismatches")
+            assert out["placed_replayed"] == len(report.bound)
+        # the delta stream round-trips: unpacked arrays match the packed
+        # usage batch shape (idx + 3 usage vectors + 2 counters)
+        spec = cycles[1].manifest["serve"]["deltas"]
+        deltas = flightrec.unpack_pytree(spec, cycles[1]._blobs_for(spec))
+        assert set(deltas) == {"upserts", "usage"}
+        assert deltas["usage"]["idx"].ndim == 1

@@ -102,6 +102,18 @@ ROLE_OVERRIDES = {
         "score_raw", "snap.nodes.mask", "snap.pods.req", "snap.pods.mask",
         "state.free",
     ),
+    # apply_node_deltas(nodes, <7 packed upsert cols>, <6 usage cols>):
+    # the NodeState argument is the donated RESIDENT carry (the serving
+    # engine's cycle-to-cycle thread), not a static snapshot — label it
+    # state.* so JA001's stale-snapshot rule doesn't treat the resident
+    # columns as a bypassed snapshot read
+    "serving_delta_apply": (
+        "state.nodes",
+        "up.idx", "up.valid", "up.alloc", "up.capacity", "up.mask",
+        "up.region", "up.zone",
+        "d.idx", "d.requested", "d.nonzero", "d.limits", "d.pod_count",
+        "d.terminating",
+    ),
 }
 
 

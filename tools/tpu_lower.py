@@ -361,8 +361,22 @@ def build_sharded_profile_batch_solve():
     return fn, args, mesh
 
 
+def build_serving_delta_apply():
+    """`serving.deltas.delta_apply_program` — the donated O(changed)
+    scatter-apply the resident-state serving engine folds each cycle's
+    delta batch with (`serving.engine.ServeEngine._apply_batch`), at the
+    reduced resident shape `serving.engine.lower_program_args` builds.
+    The donated resident carry changes the exported calling convention,
+    so the certified program must carry it (like cfg6's chunk solver)."""
+    from scheduler_plugins_tpu.serving.engine import lower_program_args
+
+    fn, args = lower_program_args()
+    return fn, args, None
+
+
 PROGRAMS = {
     "entry": build_entry,
+    "serving_delta_apply": build_serving_delta_apply,
     "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
     "bench_cfg1_flagship": build_cfg1_flagship,
     "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
