@@ -58,11 +58,28 @@ replay-smoke:
 churn-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --churn-smoke
 
+# CI sharded-solver gate: reduced mega shape on an 8-host-device ("nodes",)
+# mesh — the shard_map ring-election waterfill's placements must MATCH the
+# single-device wave path bit-exactly, the replayed hard-constraint audit
+# must be clean, and the traced program's collective census must stay
+# O(shards) with NO all_gather of the node axis (graft_lint GL009's
+# compiled-level twin)
+.PHONY: shard-smoke
+shard-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --shard-smoke
+
+# the full mega-scale bench (100k nodes x 1M pods on the sharded wave
+# solver, 8-host-device mesh vs the single-device wave path) — minutes,
+# not a CI gate; shard-smoke is the CI-sized version
+.PHONY: mega
+mega:
+	JAX_PLATFORMS=cpu $(PY) bench.py --config 8
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke
 
 .PHONY: lint
 lint:

@@ -141,6 +141,22 @@ def _backend_label():
         return "unknown"
 
 
+def _device_attribution():
+    """{"devices", "mesh_shape"} stamped into every emitted line next to
+    `backend`, so sharded numbers are attributable without reading the
+    probe tail: `devices` is the backend's visible device count and
+    `mesh_shape` the mesh the solve actually ran on (None = unsharded
+    single-device program — the default for every config except the
+    sharded wave runs, which override it via `extra`)."""
+    try:
+        import jax
+
+        devices = jax.device_count()
+    except Exception:
+        devices = None
+    return {"devices": devices, "mesh_shape": None}
+
+
 def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
           drift=None):
     """One JSON line. `vs_baseline` is the honest headline: measured against
@@ -162,6 +178,7 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
         "value": round(pods_per_sec, 1),
         "unit": f"pods/s ({detail})",
         "backend": _backend_label(),
+        **_device_attribution(),
         "drift": None if drift is None else round(drift, 4),
     }
     if compiled is not None and compiled > 0:
@@ -564,7 +581,318 @@ CONFIG_METRICS = {
     3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
+    8: "mega_pods_per_sec",
 }
+
+
+# ---------------------------------------------------------------------------
+# config 8: mega scale — shard_map ring-election wave solver on a host mesh
+# ---------------------------------------------------------------------------
+
+#: the mega scale (~10x north star): 100k nodes x 1M pods is the regime
+#: placement systems actually live in ("Tesserae", arxiv 2508.04953).
+#: Tensor-level construction — a million Pod objects would spend the run on
+#: host-side bookkeeping the solver never sees. Runs on an 8-host-device
+#: ("nodes",) mesh (XLA_FLAGS --xla_force_host_platform_device_count) BY
+#: POLICY while the axon tunnel is down; the compile-readiness manifests
+#: are the standing TPU evidence (docs/SCALING.md).
+MEGA_SHAPE = dict(n_nodes=100_000, n_pods=1_000_000, chunk=16_384, devices=8)
+#: reduced mega for the `make shard-smoke` CI gate: a NON-shard-multiple
+#: node count (1020 pads to 1024 over 8 shards — the mesh-padding edge
+#: rides through CI), small enough for 2-core runners, cumulative capacity
+#: far below the 2^53 bit-parity bound so placements must match EXACTLY
+SHARD_SMOKE_SHAPE = dict(n_nodes=1020, n_pods=8192, chunk=2048, devices=8)
+
+
+def _force_host_mesh(n_devices):
+    """Pin the n-device virtual CPU platform AND the one-lane-per-device
+    execution policy (`--xla_cpu_multi_thread_eigen=false`) for the mesh
+    benches. With per-device intra-op thread pools, an oversubscribed host
+    measures pool thrashing, not mesh scaling; one lane per device is the
+    regime a real chip mesh executes in (a device never borrows its
+    neighbor's ALUs), and BOTH arms of the mega comparison run under the
+    same policy in the same process. Must run before the first backend
+    touch."""
+    import os
+
+    import __graft_entry__
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_cpu_multi_thread_eigen" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_multi_thread_eigen=false"
+        ).strip()
+    __graft_entry__._force_cpu_platform(n_devices)
+
+
+def mega_problem(n_nodes, n_pods, chunk, seed=0):
+    """Tensor-level problem dict for the mega configs, CANONICAL axis order
+    and reference units (cpu millicores, memory bytes, int64). Four
+    heterogeneous node SKUs make the allocatable ranking non-degenerate
+    (the wave election actually orders nodes); the pod distribution
+    mirrors `models.scenarios._pods`. Pods pad to a chunk multiple so
+    every chunk shares one compiled shape (mask False on padding)."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.api.resources import (
+        CANONICAL,
+        CPU,
+        MEMORY,
+        PODS,
+        ResourceIndex,
+    )
+    from scheduler_plugins_tpu.ops.allocatable import (
+        MODE_LEAST,
+        allocatable_scores,
+        demote_scores_int32,
+    )
+
+    gib = 1 << 30
+    rng = np.random.default_rng(seed)
+    R = len(CANONICAL)
+    # SKU columns follow CANONICAL (cpu, memory, ephemeral-storage, pods)
+    skus = np.asarray(
+        [
+            [64_000, 256 * gib, 0, 256],
+            [32_000, 128 * gib, 0, 220],
+            [96_000, 384 * gib, 0, 256],
+            [16_000, 64 * gib, 0, 128],
+        ],
+        dtype=np.int64,
+    )
+    alloc = skus[rng.integers(0, len(skus), size=n_nodes)]
+    padded = ((n_pods + chunk - 1) // chunk) * chunk
+    req = np.zeros((padded, R), np.int64)
+    req[:n_pods, CANONICAL.index(CPU)] = rng.integers(100, 4000, n_pods)
+    req[:n_pods, CANONICAL.index(MEMORY)] = rng.integers(
+        256 << 20, 8 * gib, n_pods
+    )
+    mask = np.arange(padded) < n_pods
+    weights = jnp.asarray(
+        ResourceIndex().encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+    )
+    free0 = jnp.asarray(alloc)  # nothing bound: free == allocatable
+    raw = demote_scores_int32(
+        allocatable_scores(free0, weights, MODE_LEAST)
+    ).astype(jnp.int64)
+    return {
+        "alloc": alloc, "free0": free0, "req": req, "mask": mask,
+        "node_mask": jnp.ones(n_nodes, bool), "weights": weights,
+        "raw": raw, "padded": padded, "n_pods": n_pods,
+    }
+
+
+def _mega_run(problem, shape, sharded: bool):
+    """One full pass of the mega pod stream through the double-buffered
+    chunk pipeline: the shard_map ring-election solver on the ("nodes",)
+    host mesh when `sharded`, else the single-device wave path (the
+    north-star chunk program — the same targeted waterfill, unsharded, on
+    device 0). Returns (elapsed_s, assignment (n_pods,), waves, occ,
+    done_s)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.parallel.pipeline import run_chunk_pipeline
+
+    chunk = shape["chunk"]
+    chunk_inputs = [
+        (problem["req"][lo:lo + chunk], problem["mask"][lo:lo + chunk])
+        for lo in range(0, problem["padded"], chunk)
+    ]
+    if sharded:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from scheduler_plugins_tpu.parallel.mesh import (
+            NODES_AXIS,
+            make_node_mesh,
+        )
+        from scheduler_plugins_tpu.parallel.solver import (
+            rank_order_inputs,
+            sharded_wave_chunk_solver,
+        )
+
+        mesh = make_node_mesh(shape["devices"])
+        solve_chunk = sharded_wave_chunk_solver(
+            mesh, shape["n_nodes"], rescue_window=256
+        )
+        node_ids, rank_free = rank_order_inputs(
+            problem["raw"], problem["free0"], problem["node_mask"],
+            shape["devices"],
+        )
+        carry_host = np.asarray(rank_free)  # donated away each pass
+        carry_sharding = NamedSharding(mesh, P(NODES_AXIS, None))
+        invariant = (
+            jax.device_put(node_ids, NamedSharding(mesh, P(NODES_AXIS))),
+        )
+
+        def fresh_carry():
+            return jax.device_put(carry_host, carry_sharding)
+    else:
+        solve_chunk = north_star_chunk_solver()
+        invariant = (problem["raw"], problem["node_mask"])
+        carry_host = np.asarray(problem["free0"])
+
+        def fresh_carry():
+            return jnp.asarray(carry_host)
+
+    # warmup/compile on the first chunk shape (the warmup donates its own
+    # fresh carry; the timed pipeline below gets another)
+    out0, _ = solve_chunk(
+        *invariant, *(jax.device_put(a) for a in chunk_inputs[0]),
+        fresh_carry(),
+    )
+    np.asarray(out0[0])
+
+    carry = fresh_carry()
+    start = time.perf_counter()
+    with _bench_span(
+        "mega pipeline", chunks=len(chunk_inputs), sharded=sharded
+    ):
+        results, carry, done_s, _timeline = run_chunk_pipeline(
+            solve_chunk, invariant, chunk_inputs, carry
+        )
+    elapsed = time.perf_counter() - start
+    assignment = np.concatenate(
+        [np.asarray(a) for a, _ in results]
+    )[: problem["n_pods"]]
+    waves = sum(int(np.asarray(s["waves"])) for _, s in results)
+    occ = np.sum([np.asarray(s["occupancy"]) for _, s in results], axis=0)
+    return elapsed, assignment, waves, occ, done_s
+
+
+def _mega_capacity_violations(problem, assignment) -> int:
+    """Hard-constraint audit: replay the placements against allocatable —
+    (node, resource) cells over capacity, pods slot charged 1 per pod."""
+    from scheduler_plugins_tpu.ops import PODS_I
+
+    used = np.zeros_like(problem["alloc"])
+    dem = problem["req"][: problem["n_pods"]].copy()
+    dem[:, PODS_I] = 1
+    placed = assignment >= 0
+    np.add.at(used, assignment[placed], dem[placed])
+    return int((used > problem["alloc"]).sum())
+
+
+def mega(shape=None, emit=True):
+    """Config 8: the mega-scale sharded wave bench. Streams the pod set
+    through the shard_map ring-election waterfill on an n-device ("nodes",)
+    host mesh AND through the single-device wave path (the north-star chunk
+    program) on the same tensors, so every line carries the measured mesh
+    scaling (`vs_baseline` = sharded vs 1-device pods/s), an exact
+    placement diff, and a replayed hard-constraint audit. Placements are
+    expected bit-identical below the 2^53 cumulative-capacity bound (the
+    smoke shape); at full mega scale the float64 bucket positions may
+    round differently between shardings — a targeting heuristic only, so
+    `placements_match` is reported and hard constraints stay exact either
+    way."""
+    shape = shape or MEGA_SHAPE
+    # must run before the first backend touch (device count fixes at init)
+    _force_host_mesh(shape["devices"])
+
+    problem = mega_problem(shape["n_nodes"], shape["n_pods"], shape["chunk"])
+    t_sh, a_sh, waves, occ, done_s = _mega_run(problem, shape, sharded=True)
+    t_one, a_one, _, _, _ = _mega_run(problem, shape, sharded=False)
+
+    match = bool((a_sh == a_one).all())
+    violations = _mega_capacity_violations(problem, a_sh)
+    placed = int((a_sh >= 0).sum())
+    pod_latency_s = np.repeat(done_s, shape["chunk"])[: shape["n_pods"]]
+    line = {
+        "devices": shape["devices"],
+        "mesh_shape": {"nodes": shape["devices"]},
+        "vs_single_device": round(t_one / t_sh, 2),
+        "single_device_pods_per_sec": round(shape["n_pods"] / t_one, 1),
+        "placements_match": match,
+        "capacity_violations": violations,
+        "chunks": problem["padded"] // shape["chunk"],
+        "waves": waves,
+        "wave_occupancy": _trim_occupancy(occ),
+        "pod_latency_p50_ms": round(
+            float(np.percentile(pod_latency_s, 50)) * 1000, 1),
+        "pod_latency_p99_ms": round(
+            float(np.percentile(pod_latency_s, 99)) * 1000, 1),
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[8],
+            shape["n_pods"] / t_sh,
+            f"{shape['n_nodes']} nodes x {shape['n_pods']} pods chunked "
+            f"x{shape['chunk']}, {placed} placed, "
+            f"{shape['devices']}-device nodes mesh",
+            baseline=shape["n_pods"] / t_one,
+            drift=(0.0 if match else _score_sum_drift(
+                np.asarray(problem["raw"]), a_sh, a_one
+            )),
+            extra=line,
+        )
+    return line
+
+
+def shard_smoke():
+    """CI gate (`make shard-smoke`): reduced mega config on an 8-host-device
+    ("nodes",) mesh — the sharded wave placements must MATCH the single-
+    device wave path bit-exactly (the reduced shape sits far below the 2^53
+    cumulative-capacity bound, where parity is unconditional), the replayed
+    hard-constraint audit must be clean, and the traced chunk program's
+    collective census must stay O(shards) with ZERO all_gather/all_to_all
+    equations (the silent way the ring election degrades back to a full
+    gather; graft_lint GL009 is the source-level twin). One JSON line;
+    rc 1 on any failure."""
+    shape = SHARD_SMOKE_SHAPE
+    _force_host_mesh(shape["devices"])
+    import jax.numpy as jnp  # noqa: F401
+
+    from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
+    from scheduler_plugins_tpu.parallel.solver import (
+        collective_census,
+        rank_order_inputs,
+        sharded_wave_chunk_solver,
+    )
+
+    line = mega(shape=shape, emit=False)
+
+    # static collective census of the traced chunk program: the wave loops
+    # are while_loops, so each wave body appears ONCE in the jaxpr and the
+    # census bounds the per-wave collective count independent of trip count
+    problem = mega_problem(shape["n_nodes"], shape["n_pods"], shape["chunk"])
+    S = shape["devices"]
+    mesh = make_node_mesh(S)
+    node_ids, rank_free = rank_order_inputs(
+        problem["raw"], problem["free0"], problem["node_mask"], S
+    )
+    chunk = shape["chunk"]
+    census = collective_census(
+        sharded_wave_chunk_solver(mesh, shape["n_nodes"], rescue_window=256),
+        node_ids, problem["req"][:chunk], problem["mask"][:chunk], rank_free,
+    )
+    gathers = sum(
+        census.get(k, 0)
+        for k in ("all_gather", "all_gather_invariant", "all_to_all")
+    )
+    total = sum(census.values())
+    # 3 wave bodies (whole-queue lite, windowed lite, rescue), each a
+    # handful of psum/pmin champion reductions — CONSTANT per wave at this
+    # shard count (the slot-scatter scan; the ppermute ring takes over
+    # above ops.assign.PSUM_SCAN_MAX_SHARDS at S-1 steps per scan), so the
+    # budget is linear in S with room for either regime
+    budget = 6 * S + 24
+    ok = (
+        line["placements_match"]
+        and line["capacity_violations"] == 0
+        and gathers == 0
+        and 0 < total <= budget
+    )
+    print(json.dumps({
+        "metric": "shard_smoke",
+        "backend": _backend_label(),
+        "collectives": census,
+        "collective_total": total,
+        "collective_budget": budget,
+        "full_axis_gathers": gathers,
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
 
 
 # ---------------------------------------------------------------------------
@@ -1154,7 +1482,10 @@ if __name__ == "__main__":
                         help="BASELINE.md scenario (1-5; 6 = 10k-node x "
                              "100k-pod north-star scale; 0 = tiny-shape "
                              "tpu smoke; 7 = sustained-churn serving, "
-                             "resident-state vs full-resnapshot); "
+                             "resident-state vs full-resnapshot; 8 = "
+                             "100k-node x 1M-pod mega scale on the "
+                             "shard_map ring-election wave solver, "
+                             "8-host-device mesh vs 1 device); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -1180,6 +1511,13 @@ if __name__ == "__main__":
                         help="CI gate: comma-separated configs run at "
                              "reduced shapes under SPT_SANITIZE=1 "
                              "(checkify); fails on any checkify error")
+    parser.add_argument("--shard-smoke", action="store_true",
+                        help="CI gate: reduced mega config on an 8-host-"
+                             "device nodes mesh; fails unless sharded "
+                             "placements match the single-device wave "
+                             "path bit-exactly, the capacity audit is "
+                             "clean, and the program's collective census "
+                             "stays O(shards) with zero all_gathers")
     parser.add_argument("--churn-smoke", action="store_true",
                         help="CI gate: reduced sustained-churn run; fails "
                              "unless the resident-state delta path beats "
@@ -1188,6 +1526,18 @@ if __name__ == "__main__":
                              "zero hard-constraint violations")
     args = parser.parse_args()
     apply_platform_override()
+    if args.shard_smoke:
+        # CPU-host-mesh CI gate (pins its own 8-device virtual platform):
+        # sharded-vs-single-device parity + collective census, not a
+        # timing run against history — no tunnel probe
+        sys.exit(shard_smoke())
+    if args.config == 8:
+        # host-mesh scaling bench BY POLICY while the axon tunnel is down
+        # (docs/SCALING.md evidence policy; the compile-readiness
+        # manifests are the standing TPU evidence) — pins its own
+        # n-device virtual CPU platform, so no tunnel probe either
+        mega()
+        sys.exit(0)
     if args.churn_smoke:
         # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
         # a mode-vs-mode comparison, not a timing run against history —
@@ -1216,6 +1566,10 @@ if __name__ == "__main__":
         replay = latest_capture(args.config, args.mode)
         if replay is not None:
             captured = replay.pop("ts")
+            # older captures predate the devices/mesh_shape attribution
+            # columns — keep the replayed line schema-complete
+            replay.setdefault("devices", None)
+            replay.setdefault("mesh_shape", None)
             replay.update({
                 "stale_capture": True,
                 "captured_unix": captured,
@@ -1230,7 +1584,8 @@ if __name__ == "__main__":
         # one parseable line, rc=0 — the environment is sick, not the code
         print(json.dumps({
             "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
-            "vs_baseline": 0.0, "drift": None,
+            "vs_baseline": 0.0, "devices": None, "mesh_shape": None,
+            "drift": None,
             "error": "tpu-backend-unavailable",
             "detail": diagnosis,
         }))

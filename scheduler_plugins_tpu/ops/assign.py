@@ -103,6 +103,92 @@ def _pick(feasible, scores):
     return jnp.where(feasible.any(), best.astype(jnp.int32), jnp.int32(-1))
 
 
+def _straggler_window(demand, pod_mask, assignment, hopeless, W):
+    """First W still-active pods in queue order: (idx (W,), valid (W,),
+    dem (W, R)) — rank-compaction scatter into a W+1 buffer (slot W is
+    the overflow trash slot), no P-length sort. Deliberately NOT
+    `jnp.nonzero(size=)`: jax pads that via a bincount scatter whose
+    out-of-bounds writes rely on drop semantics, which the SPT_SANITIZE
+    checkify gate rightly flags; this form is in-bounds by construction
+    at the same O(P) scatter cost. Shared by the single-device targeted
+    waterfill and the shard_map sharded variant (pod-axis state is
+    replicated there, so the same code runs per shard)."""
+    P = pod_mask.shape[0]
+    active = (assignment == -1) & pod_mask & ~hopeless
+    rank = jnp.cumsum(active) - 1  # (P,) inclusive rank among active
+    slot = jnp.where(active & (rank < W), rank, W).astype(jnp.int32)
+    idx = jnp.full(W + 1, P, jnp.int32).at[slot].min(
+        jnp.arange(P, dtype=jnp.int32)
+    )[:W]
+    valid = idx < P
+    dem_w = jnp.where(valid[:, None], demand[jnp.minimum(idx, P - 1)], 0)
+    return idx, valid, dem_w
+
+
+def ring_exclusive_scan(x, axis_name, n_shards: int):
+    """Exclusive prefix sum of `x` over the mesh axis `axis_name` (shard s
+    receives the sum of x from shards < s) via an (S-1)-step `lax.ppermute`
+    ring — O(shards) collectives of O(|x|) payload each, never a full-axis
+    gather (tools/graft_lint.py GL009 forbids `all_gather` over the node
+    axis: it silently degrades the ring election back to a full gather).
+    After k ring steps each shard holds the value of shard (idx - k) mod S;
+    summing the steps with k <= idx yields the exclusive prefix."""
+    if n_shards == 1:
+        return jnp.zeros_like(x)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    acc = jnp.zeros_like(x)
+    recv = x
+    for k in range(1, n_shards):
+        recv = jax.lax.ppermute(recv, axis_name, perm)
+        acc = acc + jnp.where(k <= idx, recv, jnp.zeros_like(recv))
+    return acc
+
+
+#: shard count above which the sharded wave's block-offset scans switch
+#: from the one-psum slot-scatter (payload O(S·|x|), ONE barrier) to the
+#: ppermute ring (payload O(|x|) per step, S-1 barriers): barriers are the
+#: expensive resource on small meshes (XLA's in-process CPU collectives
+#: spin-wait at every rendezvous), payload is on large ones.
+PSUM_SCAN_MAX_SHARDS = 64
+
+
+def block_exclusive_offsets(x, axis_name, n_shards: int):
+    """(exclusive_prefix, total) of the per-shard values `x` over the mesh
+    axis — the cross-shard reduction behind both wave elections (cumulative
+    free-capacity bases, rescue feasible-count offsets). Reduces per-shard
+    CHAMPIONS only (an (S, ...) table of block aggregates), never the node
+    axis itself.
+
+    Two exact formulations, picked by shard count:
+
+    - S <= `PSUM_SCAN_MAX_SHARDS`: each shard scatters its value into its
+      own slot of an (S, ...) zero table and ONE `lax.psum` assembles all
+      block aggregates everywhere (slots are disjoint, so the sum is exact
+      for any dtype); the exclusive prefix and the total then fall out of
+      one local cumsum over the tiny S axis.
+    - larger S: the (S-1)-step `ring_exclusive_scan` plus one psum for the
+      total — O(|x|) payload per step when S·|x| tables would outgrow the
+      win of fewer barriers.
+
+    Both orderings sum blocks left-to-right, so results are bit-identical
+    to each other and to the single-device cumsum decomposition whenever
+    the values are exact (integers below 2^53 in float64 — the documented
+    parity bound)."""
+    if n_shards == 1:
+        return jnp.zeros_like(x), x
+    if n_shards > PSUM_SCAN_MAX_SHARDS:
+        return (
+            ring_exclusive_scan(x, axis_name, n_shards),
+            jax.lax.psum(x, axis_name),
+        )
+    shard = jax.lax.axis_index(axis_name)
+    slots = jnp.zeros((n_shards,) + x.shape, x.dtype).at[shard].set(x)
+    blocks = jax.lax.psum(slots, axis_name)  # (S, ...) every block's value
+    csum = jnp.cumsum(blocks, axis=0)
+    return (csum - blocks)[shard], csum[-1]
+
+
 @partial(jax.jit, static_argnames=("step_fn",))
 def greedy_assign(step_fn: StepFn, req, pod_mask, free0):
     """Sequential greedy placement.
@@ -528,24 +614,10 @@ def waterfill_assign_targeted(raw_scores, req, pod_mask, free0,
     LITE_PROBES = 4
 
     def window_of(free, assignment, hopeless, W):
-        """First W still-active pods in queue order: (idx (W,), valid (W,),
-        dem (W, R)) — rank-compaction scatter into a W+1 buffer (slot W is
-        the overflow trash slot), no P-length sort. Deliberately NOT
-        `jnp.nonzero(size=)`: jax pads that via a bincount scatter whose
-        out-of-bounds writes rely on drop semantics, which the
-        SPT_SANITIZE checkify gate rightly flags; this form is in-bounds
-        by construction at the same O(P) scatter cost."""
-        active = (assignment == -1) & pod_mask & ~hopeless
-        rank = jnp.cumsum(active) - 1  # (P,) inclusive rank among active
-        slot = jnp.where(active & (rank < W), rank, W).astype(jnp.int32)
-        idx = jnp.full(W + 1, P, jnp.int32).at[slot].min(
-            jnp.arange(P, dtype=jnp.int32)
-        )[:W]
-        valid = idx < P
-        dem_w = jnp.where(
-            valid[:, None], demand[jnp.minimum(idx, P - 1)], 0
-        )
-        return idx, valid, dem_w
+        """First W still-active pods in queue order — the shared
+        `_straggler_window` rank-compaction scatter (one copy with the
+        sharded waterfill, so the window rule cannot drift)."""
+        return _straggler_window(demand, pod_mask, assignment, hopeless, W)
 
     def lite_choice(free, idx, valid, dem_w):
         # cumulative-demand waterfill over the window (the shared
@@ -721,3 +793,275 @@ def wave_assign(batch_fn, req, pod_mask, free0, max_waves: int = 8):
         wave, (free0, jnp.full(P, -1, jnp.int32)), None, length=max_waves
     )
     return assignment, free
+
+
+# ---------------------------------------------------------------------------
+# Sharded targeted waterfill (shard_map body): node axis sharded, per-wave
+# winner election via ring collectives
+# ---------------------------------------------------------------------------
+
+
+def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
+                               axis_name: str, n_shards: int,
+                               n_real: int,
+                               max_waves: int = 8,
+                               rescue_window: int = 512,
+                               lite_window: int = 1024,
+                               collect_stats: bool = False):
+    """Shard-local body of `waterfill_assign_targeted` — runs INSIDE a
+    `shard_map` with the NODE axis sharded over `axis_name` (S = `n_shards`
+    shards). The node axis arrives in GLOBAL SCORE-RANK ORDER (the caller
+    permutes once per solve via `parallel.solver.rank_order_inputs`), so
+    shard s owns the contiguous rank block [s*BS, (s+1)*BS) and the static
+    ranking `order_n` of the single-device path becomes the identity: all
+    wave math happens in rank space, and the winning shard maps its rank
+    back to the original node index through its `node_ids` rows.
+
+    Per-wave cross-shard traffic is O(shards) collectives of O(window)
+    payload — never a gather of the node axis:
+
+    - cumulative-free bases for the demand buckets: per-shard block totals
+      combined with an (S-1)-step `ring_exclusive_scan` (`lax.ppermute`);
+    - winner election: each shard proposes its local champion RANK (or N =
+      "no candidate") and `lax.pmin` elects the global minimum — the
+      min-rank key reproduces the single-device searchsorted/first-fit
+      choice exactly, because rank order IS score order with the
+      lowest-index tie-break baked in by the stable pre-sort;
+    - admission/committal: the queue-order sorted-segment prefix check runs
+      replicated on the (W,) window, each shard verifies the pods that
+      chose ITS nodes against its local free rows, and one `lax.psum`
+      ORs the per-shard verdicts; commits then scatter ONLY into the
+      owning shard's resident `rank_free` block.
+
+    Padded rank rows (node_ids -1, zero capacity) can never win an
+    election: every valid pod's fit demand carries a pods-slot of 1, so a
+    zero-capacity row fails both the lite fit probes and the rescue
+    feasibility row (tests/test_shard_wave.py gates the edge).
+
+    Placements are BIT-IDENTICAL to `waterfill_assign_targeted` at any
+    shard count while every cumulative-capacity float64 sum stays exact
+    (< 2^53 — all test/gate shapes; beyond it, block-decomposed summation
+    can round bucket POSITIONS differently than the single-device cumsum:
+    a targeting heuristic only — the per-node admission sums stay exact at
+    any scale, so hard constraints never depend on the bound). The
+    degenerate 1-shard program emits no ring steps and is bit-identical by
+    construction.
+
+    Arguments (per shard): `rank_free` (BS, R) local block of score-rank-
+    ordered free capacity (the resident carry — returned updated),
+    `node_ids` (BS,) original rank-row node index (-1 = padding),
+    `req` (P, R) and `pod_mask` (P,) replicated. `n_real` is the PRE-
+    PADDING rank count (the single-device path's N): probe clamps must
+    saturate at the worst REAL node, exactly as the unsharded
+    `jnp.minimum(pos + probe, N - 1)` does — clamping into the padding
+    tail would silently drop overflow pods the single-device path still
+    probes against rank N-1. Returns (assignment (P,) original node
+    indices, replicated; rank_free (BS, R); stats dict when
+    `collect_stats`).
+    """
+    P, R = req.shape
+    BS = rank_free.shape[0]
+    N = BS * n_shards  # padded global rank count ("no candidate" sentinel)
+    demand = pod_fit_demand(req)
+    shard = jax.lax.axis_index(axis_name)
+    block_start = shard * BS
+
+    LITE_PROBES = 4
+
+    def lite_choice(free_l, idx, valid, dem_w):
+        """Cumulative-demand bucket targets + next-fit probes, elected
+        across shards: per-resource global bucket position = pmin over the
+        shards' local searchsorted candidates (exact — the global cumfree
+        is nondecreasing, so the first covering index lives in exactly one
+        shard), then the first fitting probe = min fitting rank."""
+        cumfree_l = jnp.cumsum(
+            jnp.clip(free_l, 0, None).astype(jnp.float64), axis=0
+        )  # (BS, R) local inclusive
+        base, _ = block_exclusive_offsets(
+            cumfree_l[-1], axis_name, n_shards
+        )  # (R,)
+        abs_cf = cumfree_l + base[None, :]
+        cumdem = jnp.cumsum(dem_w.astype(jnp.float64), axis=0)  # (W, R)
+        loc = jax.vmap(
+            lambda cf, cd: jnp.searchsorted(cf, cd, side="left"),
+            in_axes=(1, 1), out_axes=1,
+        )(abs_cf, cumdem)  # (W, R) local positions
+        cand = jnp.where(loc < BS, block_start + loc, N)
+        pos = jnp.max(jax.lax.pmin(cand, axis_name), axis=1)  # (W,) global
+        ranks = jnp.minimum(
+            pos[None, :] + jnp.arange(LITE_PROBES)[:, None], n_real - 1
+        )  # (LP, W) — saturate at the worst REAL rank, never the padding
+        local = ranks - block_start
+        mine = (local >= 0) & (local < BS)
+        row = free_l[jnp.clip(local, 0, BS - 1)]  # (LP, W, R)
+        fit_l = mine & valid[None, :] & jnp.all(
+            dem_w[None, :, :] <= row, axis=2
+        )
+        # first fitting probe == min fitting rank (ranks nondecreasing in
+        # probe order; equal only when clamped to the same node): each
+        # shard proposes its min fitting OWNED rank, pmin elects — a (W,)
+        # champion reduction instead of a (LP, W) verdict exchange
+        fit_rank = jax.lax.pmin(
+            jnp.min(jnp.where(fit_l, ranks, N), axis=0), axis_name
+        )  # (W,)
+        choice = jnp.where(
+            valid & (fit_rank < N), fit_rank.astype(jnp.int32), -1
+        )
+        # lite misses prove nothing about true feasibility: no hopeless delta
+        return choice, jnp.zeros(idx.shape[0], bool)
+
+    def rescue_choice(free_l, idx, valid, dem_w):
+        """Dense rescue wave, sharded: each shard counts its local feasible
+        nodes per window pod; a ring scan turns the counts into global
+        score-order offsets (rank blocks ARE score order), the shard whose
+        range covers the pod's round-robin slot k proposes its k-local-th
+        feasible rank, and pmin elects it (exactly one shard proposes)."""
+        W = idx.shape[0]
+        feasible_l = jnp.all(
+            dem_w[:, None, :] <= free_l[None, :, :], axis=2
+        ) & valid[:, None]  # (W, BS)
+        counts_l = feasible_l.sum(axis=1, dtype=jnp.int32)  # (W,)
+        base_l, total = block_exclusive_offsets(
+            counts_l, axis_name, n_shards
+        )  # (W,) each — ONE collective serves both the round-robin offsets
+        # and the global feasible totals
+        k = jnp.where(total > 0, jnp.arange(W) % jnp.maximum(total, 1), 0)
+        k_local = (k - base_l).astype(jnp.int32)
+        c_l = jnp.cumsum(feasible_l.astype(jnp.int32), axis=1)  # (W, BS)
+        locpos = jax.vmap(
+            lambda c, kk: jnp.searchsorted(c, kk, side="right")
+        )(c_l, k_local)  # first local idx with count > k_local
+        mine = (k_local >= 0) & (k_local < counts_l)
+        cand = jnp.where(
+            mine & valid & (total > 0), block_start + locpos, N
+        )
+        rank = jax.lax.pmin(cand, axis_name)  # (W,)
+        choice = jnp.where(
+            valid & (total > 0),
+            jnp.minimum(rank, n_real - 1).astype(jnp.int32), -1,
+        )
+        # window pods with NO feasible node anywhere retire as hopeless
+        # (free only shrinks within a solve, so the verdict cannot go stale)
+        return choice, valid & (total == 0)
+
+    def queue_admission_local(choice, dem_w, free_l):
+        """`_queue_order_admission_choice` with the free rows sharded: the
+        sorted-segment prefix math is replicated (choice/demand are), each
+        shard checks the pods whose chosen rank lies in its block against
+        its local rows. Returns the LOCAL sorted-order verdicts + the sort
+        permutation — the wave ORs the verdicts across shards in the same
+        psum that elects the winner node ids (each chosen rank is owned by
+        exactly one shard, so a sum is an OR)."""
+        W = choice.shape[0]
+        seg_choice = jnp.where(choice >= 0, choice, N)
+        order = jnp.argsort(
+            seg_choice.astype(jnp.int64) * W + jnp.arange(W)
+        )
+        seg = seg_choice[order]
+        first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
+        dem_sorted = dem_w[order].astype(jnp.float64)
+        within = _segment_prefix(dem_sorted, first)  # inclusive per-segment
+        local = seg - block_start
+        mine = (local >= 0) & (local < BS) & (seg < N)
+        free_row = free_l[jnp.clip(local, 0, BS - 1)].astype(jnp.float64)
+        ok_l = mine & jnp.all(within <= free_row, axis=1)
+        return ok_l, order
+
+    def wave(free_l, assignment, hopeless, W, choice_fn):
+        idx, valid, dem_w = _straggler_window(
+            demand, pod_mask, assignment, hopeless, W
+        )
+        choice, hopeless_w = choice_fn(free_l, idx, valid, dem_w)
+        ok_l, order = queue_admission_local(choice, dem_w, free_l)
+        # rank -> original node id: the owning shard contributes id+1 for
+        # its owned CHOICES (independent of admission, so it packs into
+        # the same collective; -1 padding rows can never be chosen, so
+        # id+1 >= 1 on every elected winner)
+        local = choice - block_start
+        own = (choice >= 0) & (local >= 0) & (local < BS)
+        nid_l = jnp.where(
+            own, node_ids[jnp.clip(local, 0, BS - 1)].astype(jnp.int32) + 1, 0
+        )
+        # ONE barrier elects admission verdicts (sorted order) AND winner
+        # node ids (window order): psum is elementwise, the two rows just
+        # ride together
+        packed = jax.lax.psum(
+            jnp.stack([ok_l.astype(jnp.int32), nid_l]), axis_name
+        )
+        Wn = choice.shape[0]
+        admitted = (choice >= 0) & jnp.zeros(Wn, bool).at[order].set(
+            packed[0] > 0
+        )
+        nid = packed[1]  # (W,) node_id + 1, replicated
+        ownc = admitted & own
+        safe_idx = jnp.minimum(idx, P - 1)
+        placed_plus = jnp.zeros(P, jnp.int32).at[safe_idx].add(
+            jnp.where(admitted, nid, 0)
+        )
+        assignment = jnp.where(placed_plus > 0, placed_plus - 1, assignment)
+        hop_add = jnp.zeros(P, jnp.int32).at[safe_idx].add(
+            hopeless_w.astype(jnp.int32)
+        )
+        hopeless = hopeless | (hop_add > 0)
+        # commit scatters ONLY into the owning shard's resident block
+        used_l = jnp.zeros_like(free_l).at[
+            jnp.where(ownc, jnp.clip(local, 0, BS - 1), BS - 1)
+        ].add(jnp.where(ownc[:, None], dem_w, 0))
+        return (
+            free_l - used_l, assignment, hopeless,
+            admitted.sum(), hopeless_w.sum(),
+        )
+
+    def run(free_l, assignment, hopeless, W, choice_fn, occ, base, budget):
+        """Wave loop to `budget` — the loop state is replicated except the
+        local free block, so every shard takes identical trips."""
+        def cond(ls):
+            free_l, assignment, hopeless, wave_idx, progressed, _ = ls
+            return (
+                (wave_idx < budget)
+                & progressed
+                & ((assignment == -1) & pod_mask & ~hopeless).any()
+            )
+
+        def body(ls):
+            free_l, assignment, hopeless, wave_idx, _, occ = ls
+            free_l, assignment, hopeless, adm, retired = wave(
+                free_l, assignment, hopeless, W, choice_fn
+            )
+            return (
+                free_l, assignment, hopeless, wave_idx + 1,
+                (adm + retired) > 0,
+                occ.at[base + wave_idx].set(adm.astype(jnp.int32)),
+            )
+
+        return jax.lax.while_loop(
+            cond, body,
+            (free_l, assignment, hopeless, jnp.int32(0), jnp.bool_(True),
+             occ),
+        )
+
+    assignment0 = jnp.full(P, -1, jnp.int32)
+    hopeless0 = jnp.zeros(P, bool)
+    occ0 = jnp.zeros(2 * max_waves + 1, jnp.int32)
+    Wl = min(P, lite_window)
+    K = min(P, rescue_window)
+    # phase 1: one whole-queue lite wave
+    free_l, assignment, hopeless, adm0, _ = wave(
+        rank_free, assignment0, hopeless0, P, lite_choice
+    )
+    occ = occ0.at[0].set(adm0.astype(jnp.int32))
+    # phase 2: sparse lite waves over straggler windows
+    free_l, assignment, hopeless, w_lite, _, occ = run(
+        free_l, assignment, hopeless, Wl, lite_choice, occ, jnp.int32(1),
+        max_waves,
+    )
+    # phase 3: sparse rescue waves
+    free_l, assignment, _, w_full, _, occ = run(
+        free_l, assignment, hopeless, K, rescue_choice, occ, 1 + w_lite,
+        max_waves,
+    )
+    if collect_stats:
+        return assignment, free_l, {
+            "occupancy": occ, "waves": 1 + w_lite + w_full
+        }
+    return assignment, free_l

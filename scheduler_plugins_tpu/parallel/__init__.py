@@ -11,9 +11,13 @@ collectives from sharding annotations — no hand-written NCCL analog.
 
 from scheduler_plugins_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    make_node_mesh,
+    pad_to_shards,
     snapshot_shardings,
 )
 from scheduler_plugins_tpu.parallel.solver import (  # noqa: F401
     sharded_batch_solve,
     sharded_profile_batch_solve,
+    sharded_wave_chunk_solver,
+    sharded_wave_solve,
 )

@@ -46,6 +46,28 @@ def make_mesh(
     return Mesh(grid, (PODS_AXIS, NODES_AXIS))
 
 
+def make_node_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1-D ("nodes",) mesh for the sharded wave solver: EVERY device on the
+    node axis. The wave hot loop's only sharded dimension is the node axis
+    (pod-window state is replicated and cheap); a 2-D factorization would
+    idle the pods-axis devices during the per-wave ring election."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (NODES_AXIS,))
+
+
+def pad_to_shards(n: int, n_shards: int) -> int:
+    """Smallest multiple of `n_shards` >= n — the mesh-aligned node-axis
+    padding rule shared by `dryrun_multichip` and the sharded wave solve
+    (padded rows carry zero capacity and node id -1, so they can never win
+    a wave election; tests/test_shard_wave.py gates the edge)."""
+    return ((n + n_shards - 1) // n_shards) * n_shards
+
+
 def snapshot_shardings(snap, mesh: Mesh):
     """Sharding pytree for a ClusterSnapshot: node-major arrays shard their
     leading axis over "nodes", pod-major arrays over "pods", side tables

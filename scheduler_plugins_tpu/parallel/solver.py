@@ -899,3 +899,197 @@ def sharded_profile_batch_solve(scheduler, snap, mesh, max_waves: int = 8):
     snap = shard_snapshot(snap, mesh)
     with ambient_mesh(mesh):
         return profile_batch_solve(scheduler, snap, max_waves=max_waves)
+
+
+# ---------------------------------------------------------------------------
+# Sharded wave solver: shard_map ring-election waterfill (node axis sharded)
+# ---------------------------------------------------------------------------
+
+
+def rank_order_inputs(raw_scores, free0, node_mask, n_shards: int):
+    """(node_ids, rank_free) for the sharded wave solver: the node axis
+    permuted into GLOBAL SCORE-RANK ORDER (stable argsort — the lowest-
+    index tie-break of the single-device ranking is baked into the
+    permutation) and padded to a multiple of `n_shards` with zero-capacity
+    rows (node id -1), so each shard owns a contiguous global rank block
+    and the shard-local wave kernels never need the (N,) score vector
+    again. Masked nodes are zeroed like `batch_solve`'s solve_free0 — a
+    masked node can then never admit any pod (pod demands include a
+    pods-slot of 1). One O(N log N) sort + one gather per SOLVE (scores
+    are static across waves and chunks), not per wave."""
+    from scheduler_plugins_tpu.parallel.mesh import pad_to_shards
+
+    N, R = free0.shape
+    order_n = jnp.argsort(-raw_scores, stable=True)
+    rank_free = jnp.where(node_mask[:, None], free0, 0)[order_n]
+    node_ids = order_n.astype(jnp.int32)
+    pad = pad_to_shards(N, n_shards) - N
+    if pad:
+        rank_free = jnp.concatenate(
+            [rank_free, jnp.zeros((pad, R), rank_free.dtype)]
+        )
+        node_ids = jnp.concatenate(
+            [node_ids, jnp.full((pad,), -1, jnp.int32)]
+        )
+    return node_ids, rank_free
+
+
+def sharded_wave_chunk_solver(mesh, n_nodes: int, max_waves: int = 8,
+                              rescue_window: int = 512,
+                              lite_window: int = 1024,
+                              collect_stats: bool = True):
+    """The sharded wave chunk program: `ops.assign.waterfill_targeted_sharded`
+    wrapped in a `shard_map` over `mesh`'s "nodes" axis and jitted with the
+    resident rank-ordered free carry DONATED — the pipeline calling
+    convention (`parallel.pipeline.run_chunk_pipeline`):
+
+        fn(node_ids, req_chunk, mask_chunk, rank_free)
+            -> ((assignment[, stats]), rank_free)
+
+    `node_ids`/`rank_free` come from `rank_order_inputs` (node axis in
+    global score-rank order, padded to the shard count); `n_nodes` is the
+    PRE-PADDING node count those inputs were built from (the probe-clamp
+    anchor — see the body's docstring); req/mask chunks are replicated. The carry stays device-resident and SHARDED across
+    chunks — chunk boundaries never reassemble the node axis, and per-wave
+    cross-shard traffic is O(shards) ring/psum collectives (see the body's
+    docstring). Placements are bit-identical to the single-device
+    `waterfill_assign_targeted` chunk program at any shard count (below
+    the documented 2^53 cumulative-capacity bound)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from scheduler_plugins_tpu.ops.assign import waterfill_targeted_sharded
+    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS
+    from scheduler_plugins_tpu.parallel.pipeline import donated_chunk_solver
+
+    n_shards = mesh.shape[NODES_AXIS]
+    body = partial(
+        waterfill_targeted_sharded,
+        axis_name=NODES_AXIS, n_shards=n_shards, n_real=n_nodes,
+        max_waves=max_waves,
+        rescue_window=rescue_window, lite_window=lite_window,
+        collect_stats=collect_stats,
+    )
+    stats_spec = ({"occupancy": P(), "waves": P()},) if collect_stats else ()
+    sharded_body = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(NODES_AXIS, None), P(NODES_AXIS), P(), P()),
+        out_specs=(P(), P(NODES_AXIS, None)) + stats_spec,
+        check_rep=False,  # ppermute ring + replicated outputs via psum
+    )
+
+    def sharded_wave_chunk(node_ids, req_chunk, mask_chunk, rank_free):
+        out = sharded_body(rank_free, node_ids, req_chunk, mask_chunk)
+        if collect_stats:
+            assignment, rank_free, stats = out
+            return (assignment, stats), rank_free
+        assignment, rank_free = out
+        return (assignment,), rank_free
+
+    return donated_chunk_solver(sharded_wave_chunk, carry_argnum=3)
+
+
+#: built sharded-wave chunk solvers by (mesh, n_nodes, chunk, knobs) — the
+#: trace-cache seam `sharded_wave_solve` reuses across calls (jit caches
+#: per wrapper object, so rebuilding the wrapper would recompile)
+_WAVE_SOLVER_CACHE: dict = {}
+
+
+def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
+                       max_waves: int = 8, rescue_window: int = 512,
+                       collect_stats: bool = False):
+    """`batch_solve`'s flagship semantics with the WAVE HOT LOOP sharded:
+    admission (gang/quota PreFilter), the static allocatable ranking and
+    the finalize tail (queue-order namespace quota prefix + gang quorum
+    Permit) are unchanged; placement runs through the shard_map ring-
+    election waterfill with the node axis sharded over `mesh` and the free
+    carry resident per shard. Pods stream in queue-order chunks (`chunk`
+    None = one chunk) with the carry threading device-side, donated.
+
+    Hard constraints (fit, queue-order node admission, quota caps, gang
+    quorum) hold exactly at every shard count; placements are bit-
+    identical to the single-device wave path below the 2^53 cumulative-
+    capacity bound (tests/test_shard_wave.py + tests/test_differential.py
+    gate both). Returns (assignment, admitted, wait[, stats])."""
+    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS, ambient_mesh
+
+    free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    admitted = batch_admission(snap, free0)
+    raw = demote_scores_int32(
+        allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+    ).astype(jnp.int64)
+    n_shards = mesh.shape[NODES_AXIS]
+    node_ids, rank_free = rank_order_inputs(
+        raw, free0, snap.nodes.mask, n_shards
+    )
+    P = snap.num_pods
+    chunk = P if chunk is None else min(chunk, P)
+    if P % chunk != 0:
+        raise ValueError(f"pod count {P} not a multiple of chunk {chunk}")
+    # memoize the built solver per program identity: a fresh jit wrapper
+    # per call would recompile the whole multi-device program on every
+    # solve of the same shapes
+    key = (mesh, free0.shape[0], chunk, max_waves, rescue_window,
+           collect_stats)
+    solve_chunk = _WAVE_SOLVER_CACHE.get(key)
+    if solve_chunk is None:
+        solve_chunk = _WAVE_SOLVER_CACHE[key] = sharded_wave_chunk_solver(
+            mesh, free0.shape[0], max_waves=max_waves,
+            rescue_window=rescue_window, collect_stats=collect_stats,
+        )
+    parts, stats_parts = [], []
+    with ambient_mesh(mesh):
+        for lo in range(0, P, chunk):
+            out, rank_free = solve_chunk(
+                node_ids, snap.pods.req[lo:lo + chunk],
+                admitted[lo:lo + chunk], rank_free,
+            )
+            parts.append(out[0])
+            if collect_stats:
+                stats_parts.append(out[1])
+    assignment = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    assignment, wait = finalize_assignment(assignment, snap)
+    if collect_stats:
+        stats = {
+            "occupancy": sum(jnp.asarray(s["occupancy"]) for s in stats_parts),
+            "waves": sum(jnp.asarray(s["waves"]) for s in stats_parts),
+        }
+        return assignment, admitted, wait, stats
+    return assignment, admitted, wait
+
+
+#: cross-shard collective primitives the census tracks; `all_gather` /
+#: `all_to_all` should NEVER appear in the sharded wave program (the ring
+#: election's silent degradation mode — graft_lint GL009 is the source-level
+#: twin of this jaxpr-level check)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "all_gather", "all_gather_invariant",
+    "all_to_all",
+})
+
+
+def collective_census(fn, *args):
+    """{collective primitive: equation count} over the traced `fn(*args)`
+    jaxpr, recursing through every sub-jaxpr (pjit/shard_map/while/scan/
+    cond). Because the wave loops are `lax.while_loop`s, each wave BODY
+    appears exactly once in the jaxpr — so the static census directly
+    bounds the PER-WAVE collective count, independent of how many waves a
+    solve actually runs: the shard-smoke gate asserts it stays O(shards)
+    and that no full-axis gather ever appears."""
+    from jax import core
+
+    closed = jax.make_jaxpr(fn)(*args)
+    counts: dict[str, int] = {}
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                counts[name] = counts.get(name, 0) + 1
+            for sub in core.jaxprs_in_params(eqn.params):
+                walk(getattr(sub, "jaxpr", sub))
+
+    walk(closed.jaxpr)
+    return counts

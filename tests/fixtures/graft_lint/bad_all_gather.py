@@ -1,0 +1,35 @@
+"""Golden-bad: all_gather over the node shard axis (GL009) — the silent
+way the sharded wave solver's ring election degrades back to a full
+gather: every shard reassembles the entire (N, ...) tensor."""
+
+import jax
+import jax.numpy as jnp
+
+NODES_AXIS = "nodes"
+
+
+def bad_literal_axis(free_local):
+    # BAD: gathers the full node axis onto every shard
+    full = jax.lax.all_gather(free_local, "nodes", tiled=True)
+    return jnp.argmax(full)
+
+
+def bad_axis_constant(free_local):
+    # BAD: same gather through the NODES_AXIS constant
+    return jax.lax.all_gather(free_local, axis_name=NODES_AXIS)
+
+
+def bad_multi_axis(scores_local):
+    # BAD: a multi-axis gather that includes the node axis is still a
+    # full node gather
+    return jax.lax.all_gather(scores_local, ("pods", NODES_AXIS))
+
+
+def fine_pod_axis_gather(prefix_local):
+    # OK: the pod axis is not the sharded node dimension
+    return jax.lax.all_gather(prefix_local, "pods")
+
+
+def fine_champion_reduction(counts_local):
+    # OK: per-shard champions ride psum/pmin reductions, not gathers
+    return jax.lax.psum(counts_local, NODES_AXIS)

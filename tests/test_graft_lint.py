@@ -28,10 +28,22 @@ class TestGoldenBad:
             ("bad_donated_reuse.py", "GL006"),
             ("bad_config_update.py", "GL007"),
             ("bad_jit_walltime.py", "GL008"),
+            ("bad_all_gather.py", "GL009"),
         ],
     )
     def test_flagged(self, fixture, rule):
         assert rule in rules_for(FIXTURES / fixture)
+
+    def test_all_gather_fixture_flags_only_node_axis_sites(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_all_gather.py"])
+            if f.rule == "GL009"
+        ]
+        # literal "nodes", the NODES_AXIS constant, and the multi-axis
+        # tuple — the pod-axis gather and the psum champion reduction
+        # must stay clean
+        assert len(findings) == 3
+        assert rules_for(FIXTURES / "bad_all_gather.py") == {"GL009"}
 
     def test_jit_walltime_fixture_flags_all_traced_sites(self):
         findings = [

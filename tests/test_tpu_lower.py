@@ -154,4 +154,7 @@ class TestCurrentTree:
             assert any(f"cfg{cfg}" in n for n in names), names
         assert "sharded_batch_solve" in names
         assert "sharded_profile_batch_solve" in names
+        # ISSUE-7: the shard_map ring-election wave program must stay
+        # under the gate (its collectives must keep lowering for TPU)
+        assert "sharded_wave_chunk" in names
         assert "entry" in names

@@ -47,6 +47,16 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   or when they are Plugin tensor methods (which run under the fused
   solve's trace).
 
+- **GL009 node-axis-all-gather** — no `lax.all_gather` /
+  `all_gather_invariant` over the NODE shard axis (`"nodes"` /
+  `parallel.mesh.NODES_AXIS`): the sharded wave solver's per-wave
+  elections reduce per-shard CHAMPIONS (ring `ppermute` scans, psum/pmin
+  slot-scatter reductions — `ops.assign.block_exclusive_offsets`); an
+  all_gather of the node axis reassembles the full (N, ...) tensor on
+  every shard, silently degrading the O(shards)-collective election back
+  to a full gather. The shard-smoke gate's jaxpr collective census is the
+  compiled-level twin.
+
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
 array constructor, a local name assigned from one, or a known int64
@@ -892,6 +902,55 @@ def check_donated_reuse(path, tree, findings):
         _sweep_body(fn.body, donating, {}, report)
 
 
+#: the node shard axis name (mirrors parallel.mesh.NODES_AXIS — the lint is
+#: stdlib-only and cannot import jax-adjacent modules)
+_NODE_AXIS_LITERAL = "nodes"
+_NODE_AXIS_NAMES = frozenset({"NODES_AXIS"})
+
+
+def _is_node_axis_expr(node) -> bool:
+    """Does this AST expression denote the node shard axis? Literal
+    "nodes", the NODES_AXIS constant (bare or attribute), or a tuple/list
+    containing one of those (multi-axis gathers over the node axis are
+    just as much a full-axis gather)."""
+    if isinstance(node, ast.Constant):
+        return node.value == _NODE_AXIS_LITERAL
+    if isinstance(node, ast.Name):
+        return node.id in _NODE_AXIS_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _NODE_AXIS_NAMES
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_node_axis_expr(e) for e in node.elts)
+    return False
+
+
+def check_node_axis_all_gather(path, tree, findings):
+    """GL009: `all_gather`/`all_gather_invariant` over the node shard
+    axis. The axis is read from the second positional argument or the
+    `axis_name` keyword; gathers over other axes (pod-axis prefix
+    exchanges, side-table sweeps) are not findings."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node.func)
+        if name not in ("all_gather", "all_gather_invariant"):
+            continue
+        axis = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis = kw.value
+        if axis is None or not _is_node_axis_expr(axis):
+            continue
+        findings.append(Finding(
+            path, node, "GL009",
+            f"{name} over the node shard axis reassembles the full node "
+            "tensor on every shard — the ring election degrades back to a "
+            "full gather. Reduce per-shard champions instead "
+            "(ops.assign.block_exclusive_offsets / ring_exclusive_scan, "
+            "lax.pmin/psum key reductions)",
+        ))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -922,6 +981,7 @@ def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str
     check_block_until_ready(rel, tree, findings)
     check_resource_slots(rel, tree, findings)
     check_donated_reuse(rel, tree, findings)
+    check_node_axis_all_gather(rel, tree, findings)
     if not config_owner:
         check_config_update(rel, tree, findings)
     return findings, tree, source

@@ -80,6 +80,10 @@ _CALL_PRIMS = {
     "custom_jvp_call": "call_jaxpr",
     "custom_vjp_call": "call_jaxpr",
     "custom_vmap_call": "call_jaxpr",
+    # shard_map's body jaxpr takes the PER-SHARD blocks of the same
+    # operands, 1:1 with the equation invars — provenance flows through
+    # unchanged (the sharded wave solver program)
+    "shard_map": "jaxpr",
 }
 
 #: cumulative-scan primitives whose rank>=2 i64 form lowers to the
@@ -113,6 +117,12 @@ ROLE_OVERRIDES = {
         "up.region", "up.zone",
         "d.idx", "d.requested", "d.nonzero", "d.limits", "d.pod_count",
         "d.terminating",
+    ),
+    # sharded_wave_chunk(node_ids, req_chunk, mask_chunk, rank_free): the
+    # rank-ordered free block is the donated RESIDENT carry threading
+    # chunk to chunk on device (the sharded analog of cfg6's state.free)
+    "sharded_wave_chunk": (
+        "node_ids", "snap.pods.req", "snap.pods.mask", "state.free",
     ),
 }
 

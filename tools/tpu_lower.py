@@ -374,9 +374,44 @@ def build_serving_delta_apply():
     return fn, args, None
 
 
+def build_sharded_wave_chunk():
+    """The sharded wave chunk program (`parallel.solver.
+    sharded_wave_chunk_solver` — the shard_map ring-election waterfill the
+    mega config 8 ships) on an 8-way ("nodes",) mesh at the reduced
+    shard-smoke shapes, node axis pre-permuted into global score-rank
+    order by `rank_order_inputs` exactly as bench stages it. The resident
+    rank-ordered free carry is DONATED (the exported calling convention
+    must carry it, like cfg6's chunk program), and the lowering proves the
+    per-wave ring/psum elections — never a full node-axis gather — lower
+    to TPU collectives."""
+    import bench
+    from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
+    from scheduler_plugins_tpu.parallel.solver import (
+        rank_order_inputs,
+        sharded_wave_chunk_solver,
+    )
+
+    shape = bench.SHARD_SMOKE_SHAPE
+    problem = bench.mega_problem(
+        shape["n_nodes"], shape["n_pods"], shape["chunk"]
+    )
+    mesh = make_node_mesh(shape["devices"])
+    node_ids, rank_free = rank_order_inputs(
+        problem["raw"], problem["free0"], problem["node_mask"],
+        shape["devices"],
+    )
+    chunk = shape["chunk"]
+    fn = sharded_wave_chunk_solver(mesh, shape["n_nodes"], rescue_window=256)
+    args = (
+        node_ids, problem["req"][:chunk], problem["mask"][:chunk], rank_free
+    )
+    return fn, args, mesh
+
+
 PROGRAMS = {
     "entry": build_entry,
     "serving_delta_apply": build_serving_delta_apply,
+    "sharded_wave_chunk": build_sharded_wave_chunk,
     "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
     "bench_cfg1_flagship": build_cfg1_flagship,
     "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
