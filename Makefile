@@ -58,6 +58,15 @@ replay-smoke:
 churn-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --churn-smoke
 
+# CI tuning gate: record a reduced trimaran corpus through the real
+# run_cycle hooks, sweep >= 64 candidate weight vectors in ONE vmapped
+# compile (compile-watch asserts <= 1 trace for the sweep program), and
+# require the emitted tuned profile to pass the hard-constraint replay
+# oracles (fit / queue-order quota / gang quorum) with zero violations
+.PHONY: tune-smoke
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/tune.py smoke
+
 # CI sharded-solver gate: reduced mega shape on an 8-host-device ("nodes",)
 # mesh — the shard_map ring-election waterfill's placements must MATCH the
 # single-device wave path bit-exactly, the replayed hard-constraint audit
@@ -79,7 +88,7 @@ mega:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke
 
 .PHONY: lint
 lint:

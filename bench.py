@@ -157,8 +157,32 @@ def _device_attribution():
     return {"devices": devices, "mesh_shape": None}
 
 
+def _quality_cycle(snap, assignment, wait=None):
+    """JSON-ready placement-quality dict for one solved snapshot (the
+    jittable `tuning.quality` tensor core) — the quality columns every
+    bench line carries next to `drift`."""
+    import numpy as np
+
+    from scheduler_plugins_tpu.tuning import quality as Q
+
+    assignment = np.asarray(assignment)
+    if wait is None:
+        wait = np.zeros(assignment.shape[0], bool)
+    q = Q.cycle_quality(snap, assignment, None, np.asarray(wait))
+    return {k: round(v, 4) for k, v in q.items()}
+
+
+def _quality_state(alloc, used, node_mask=None):
+    """JSON-ready {fragmentation, util_imbalance} of an accumulated
+    cluster state (the multi-cycle configs 7/8)."""
+    from scheduler_plugins_tpu.tuning import quality as Q
+
+    q = Q.state_quality(alloc, used, node_mask)
+    return {k: round(v, 4) for k, v in q.items()}
+
+
 def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
-          drift=None):
+          drift=None, quality=None):
     """One JSON line. `vs_baseline` is the honest headline: measured against
     the COMPILED reference-shaped loop (`bridge/ref_baseline.cc`) when it is
     available — the reference is compiled Go, so a pure-Python denominator
@@ -180,6 +204,11 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
         "backend": _backend_label(),
         **_device_attribution(),
         "drift": None if drift is None else round(drift, 4),
+        # the placement-quality columns (tuning.quality): per-cycle
+        # objectives for the single-solve configs, accumulated-state
+        # fragmentation/balance for the multi-cycle ones; None only when
+        # no solve ran (error/stale-capture lines)
+        "quality": quality,
     }
     if compiled is not None and compiled > 0:
         line["vs_baseline"] = round(pods_per_sec / compiled, 2)
@@ -341,6 +370,7 @@ def main(n_nodes=None, n_pods=None):
         drift=_score_sum_drift(
             _alloc_objective(snap, weights), warm_np, ref_out
         ),
+        quality=_quality_cycle(snap, warm_np),
         extra=_wave_extra(stats),
     )
 
@@ -511,6 +541,9 @@ def north_star(n_nodes=None, n_pods=None, chunk=None):
             np.concatenate(chunk_assignments)[:n_pods],
             ref_out,
         ),
+        quality=_quality_cycle(
+            snap, np.concatenate(chunk_assignments)[: snap.num_pods]
+        ),
         extra={
             "pod_latency_p50_ms": round(
                 float(np.percentile(pod_latency_s, 50)) * 1000, 1),
@@ -570,6 +603,7 @@ def tpu_smoke(n_nodes=None, n_pods=None):
         drift=_score_sum_drift(
             _alloc_objective(snap, weights), warm_np, ref_out
         ),
+        quality=_quality_cycle(snap, warm_np),
         extra={"compile_seconds": round(compile_s, 1), **_wave_extra(stats)},
     )
 
@@ -763,11 +797,10 @@ def _mega_run(problem, shape, sharded: bool):
 def _mega_capacity_violations(problem, assignment) -> int:
     """Hard-constraint audit: replay the placements against allocatable —
     (node, resource) cells over capacity, pods slot charged 1 per pod."""
-    from scheduler_plugins_tpu.ops import PODS_I
+    from scheduler_plugins_tpu.tuning.gates import pod_fit_demand_np
 
     used = np.zeros_like(problem["alloc"])
-    dem = problem["req"][: problem["n_pods"]].copy()
-    dem[:, PODS_I] = 1
+    dem = pod_fit_demand_np(problem["req"][: problem["n_pods"]])
     placed = assignment >= 0
     np.add.at(used, assignment[placed], dem[placed])
     return int((used > problem["alloc"]).sum())
@@ -796,6 +829,13 @@ def mega(shape=None, emit=True):
     match = bool((a_sh == a_one).all())
     violations = _mega_capacity_violations(problem, a_sh)
     placed = int((a_sh >= 0).sum())
+    from scheduler_plugins_tpu.tuning.gates import pod_fit_demand_np
+
+    used = np.zeros_like(problem["alloc"])
+    dem = pod_fit_demand_np(problem["req"][: problem["n_pods"]])
+    placed_mask = a_sh >= 0
+    np.add.at(used, a_sh[placed_mask], dem[placed_mask])
+    quality = _quality_state(problem["alloc"], used)
     pod_latency_s = np.repeat(done_s, shape["chunk"])[: shape["n_pods"]]
     line = {
         "devices": shape["devices"],
@@ -812,6 +852,7 @@ def mega(shape=None, emit=True):
         "pod_latency_p99_ms": round(
             float(np.percentile(pod_latency_s, 99)) * 1000, 1),
     }
+    line["quality"] = quality
     if emit:
         _emit(
             CONFIG_METRICS[8],
@@ -823,6 +864,7 @@ def mega(shape=None, emit=True):
             drift=(0.0 if match else _score_sum_drift(
                 np.asarray(problem["raw"]), a_sh, a_one
             )),
+            quality=quality,
             extra=line,
         )
     return line
@@ -1044,6 +1086,33 @@ def _churn_capacity_violations(cluster) -> int:
     return violations
 
 
+def _cluster_state_matrices(cluster):
+    """(alloc (N, R), used (N, R)) CANONICAL-axis matrices of a cluster's
+    bound population — the accumulated end state the multi-cycle serving
+    bench scores with `tuning.quality.state_quality`."""
+    from scheduler_plugins_tpu.api.resources import CANONICAL, PODS
+
+    names = list(cluster.nodes)
+    pos = {n: i for i, n in enumerate(names)}
+    R = len(CANONICAL)
+    alloc = np.zeros((len(names), R), np.int64)
+    used = np.zeros((len(names), R), np.int64)
+    for i, name in enumerate(names):
+        node = cluster.nodes[name]
+        for r, q in node.allocatable.items():
+            if r in CANONICAL:
+                alloc[i, CANONICAL.index(r)] = q
+    for pod in cluster.pods.values():
+        i = pos.get(pod.node_name)
+        if i is None:
+            continue
+        for r, q in pod.effective_request().items():
+            if r in CANONICAL:
+                used[i, CANONICAL.index(r)] += q
+        used[i, CANONICAL.index(PODS)] += 1
+    return alloc, used
+
+
 def serving_churn(shape=None, emit=True):
     """Config 7: the sustained-churn serving bench. Runs the SAME Poisson
     event sequence twice — resident-state serve mode (delta ingest,
@@ -1102,6 +1171,7 @@ def serving_churn(shape=None, emit=True):
             f"λ={shape['lam_arrive']}/{shape['lam_depart']}, serve mode",
             baseline=n_decided / base_s if base_s else 1.0,
             drift=(0.0 if match else None),
+            quality=_quality_state(*_cluster_state_matrices(serve_cluster)),
             extra=line,
         )
     return line
@@ -1255,10 +1325,13 @@ def sequential_config(config: int, mode: str = "sequential",
         def run():
             out = profile_batch_solve(scheduler, snap, collect_stats=True)
             wave_stats["stats"] = out[3]
+            wave_stats["wait"] = out[2]
             return out[0]
     else:
         def run():
-            return scheduler.solve(snap).assignment
+            result = scheduler.solve(snap)
+            wave_stats["wait"] = result.wait
+            return result.assignment
 
     np.asarray(run())  # compile
     times = []
@@ -1300,7 +1373,11 @@ def sequential_config(config: int, mode: str = "sequential",
     if record_dir:
         _record_bench_cycle(scheduler, snap, meta, mode, record_dir, drift)
     _emit(metric, n_pods / elapsed, f"{detail}, {placed}/{n_pods} placed",
-          baseline, compiled=compiled, drift=drift, extra=extra)
+          baseline, compiled=compiled, drift=drift,
+          quality=_quality_cycle(
+              snap, assignment, np.asarray(wave_stats["wait"])
+          ),
+          extra=extra)
 
 
 def _record_bench_cycle(scheduler, snap, meta, mode, record_dir, drift):
@@ -1570,6 +1647,7 @@ if __name__ == "__main__":
             # columns — keep the replayed line schema-complete
             replay.setdefault("devices", None)
             replay.setdefault("mesh_shape", None)
+            replay.setdefault("quality", None)
             replay.update({
                 "stale_capture": True,
                 "captured_unix": captured,
@@ -1585,7 +1663,7 @@ if __name__ == "__main__":
         print(json.dumps({
             "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
             "vs_baseline": 0.0, "devices": None, "mesh_shape": None,
-            "drift": None,
+            "drift": None, "quality": None,
             "error": "tpu-backend-unavailable",
             "detail": diagnosis,
         }))

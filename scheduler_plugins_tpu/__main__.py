@@ -188,6 +188,11 @@ class HealthServer:
                         "cycles": outer.cycles,
                         "bound_total": outer.bound_total,
                         "pending": outer.last_pending,
+                        # latest cycle's placement-quality objectives
+                        # (tuning.quality; None before the first solved
+                        # cycle) — the gauge view lives on /metrics as
+                        # scheduler_placement_quality{objective}
+                        "quality": outer.last_quality,
                         "feed_address": list(outer.feed.address),
                     }
                     if outer.elector is not None:
@@ -313,6 +318,7 @@ class Daemon:
         self.ticks = 0
         self.bound_total = 0
         self.last_pending = 0
+        self.last_quality = None
         self._unposted: dict[str, str] = {}
         self.elector = None  # before HealthServer: /healthz reads it
         self.stop_event = threading.Event()
@@ -456,6 +462,8 @@ class Daemon:
                     failures += 1
         self.cycles += 1
         self.bound_total += len(report.bound)
+        if report.quality is not None:
+            self.last_quality = report.quality
         return report
 
     def run(self):

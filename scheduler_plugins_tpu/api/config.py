@@ -201,6 +201,11 @@ def profile_spec(profile: Profile) -> dict:
     spec = {"profileName": profile.name, "plugins": names}
     if plugin_config:
         spec["pluginConfig"] = plugin_config
+    # score weights, aligned with the `plugins` list (the upstream
+    # Plugins.Score.Enabled[].Weight knob) — what the tuning observatory
+    # (tools/tune.py) emits a tuned profile through
+    if any(p.weight != type(p).weight for p in profile.plugins):
+        spec["weights"] = [int(p.weight) for p in profile.plugins]
     return spec
 
 
@@ -227,6 +232,18 @@ def load_profile(config: Mapping) -> Profile:
                 raise ValueError(f"unknown arg {key!r} for plugin {name}")
             kwargs[arg_map[key]] = value
         plugins.append(cls(**kwargs))
+    weights = config.get("weights")
+    if weights is not None:
+        if len(weights) != len(plugins):
+            raise ValueError(
+                f"weights list has {len(weights)} entries for "
+                f"{len(plugins)} plugins"
+            )
+        for plugin, w in zip(plugins, weights):
+            w = int(w)
+            if w < 1:
+                raise ValueError(f"plugin weight must be >= 1, got {w}")
+            plugin.weight = w
     return Profile(
         plugins=plugins, name=config.get("profileName", "tpu-scheduler")
     )

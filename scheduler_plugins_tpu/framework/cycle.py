@@ -77,6 +77,12 @@ class CycleReport:
     #: number of checkify-instrumented solve invocations this cycle (None
     #: when sanitize mode is off; 0 means the solve path was uninstrumented)
     sanitize_checked: int | None = None
+    #: placement-quality objectives for this cycle's solve
+    #: (`tuning.quality`: fragmentation, util_imbalance, gang_wait_frac,
+    #: unplaced_frac, plus the host preemption/nomination counts) — None
+    #: when the cycle ran no solve. Also exported as
+    #: `scheduler_placement_quality{objective}` gauges.
+    quality: dict | None = None
 
     def explain(self, uid: str, top_k: int = 5) -> dict:
         """The "why this node" score table for one pod of THIS cycle's
@@ -360,9 +366,31 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
     obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
     obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
+    _observe_quality(report, snap, assignment, admitted, wait)
     if rec is not None:
         rec.commit(report)
     return report
+
+
+def _observe_quality(report, snap, assignment, admitted, wait) -> None:
+    """Stamp the cycle's placement-quality objectives on the report and
+    export them as `scheduler_placement_quality{objective}` gauges
+    (tuning.quality's numpy twin — per-cycle reductions on host arrays,
+    no per-shape jit compiles on this always-on path; the jitted tensor
+    core is what the bench lines and the counterfactual sweep use, and
+    tests/test_tuning.py holds the two in agreement)."""
+    from scheduler_plugins_tpu.tuning import quality as Q
+
+    q = Q.cycle_quality_np(snap, assignment, admitted, wait)
+    q["nominations"] = float(len(report.preempted))
+    q["preemptions"] = float(
+        sum(len(v) for _, v in report.preempted.values())
+    )
+    report.quality = q
+    for objective, value in q.items():
+        obs.metrics.set_gauge(
+            obs.PLACEMENT_QUALITY, value, objective=objective
+        )
 
 
 def _attribute_failures(scheduler, snap, result, failed_idx, report):

@@ -117,8 +117,32 @@ class Plugin:
 
     def bind_aux(self, aux) -> None:
         """Called inside the traced solve with this plugin's aux pytree (as
-        tracers); tensor methods read `self._aux`."""
+        tracers); tensor methods read `self._aux`. Also clears any traced
+        weight override left by a sweep trace (`bind_weight`) so every
+        solve body that binds aux starts from the static profile weight —
+        a leaked weight tracer from an earlier sweep trace would otherwise
+        poison the next program traced against this plugin object."""
         self._aux = aux
+        self._weight_t = None
+
+    def bind_weight(self, w) -> None:
+        """Traced per-candidate weight override — the tuning sweep's aux
+        channel for the ONE config knob the profile format keeps outside
+        `aux()` (the score weight, a host int baked at trace time).
+        `tuning.sweep` binds each vmapped lane's weight scalar here so K
+        candidate weight vectors share one compiled program; None falls
+        back to the static `weight`."""
+        self._weight_t = w
+
+    @property
+    def eff_weight(self):
+        """The weight the traced score fold multiplies by: the traced
+        override when a sweep bound one, else the static profile int.
+        Identical arithmetic either way (int64 scalar times the int64
+        normalized column), so a swept lane is bit-identical to a solve
+        whose static weight equals that lane's vector."""
+        w = getattr(self, "_weight_t", None)
+        return self.weight if w is None else w
 
     def prepare_solve(self, snap: ClusterSnapshot):
         """Called once inside the traced solve, BEFORE the per-pod scan:

@@ -159,7 +159,7 @@ def _score_columns(plugins, state, snap, p, feasible, rows=None):
         if raw is None:
             cols.append(jnp.zeros(N, jnp.int64))
             continue
-        col = (plugin.weight * plugin.normalize(raw, feasible)).astype(
+        col = (plugin.eff_weight * plugin.normalize(raw, feasible)).astype(
             jnp.int64
         )
         cols.append(col)
@@ -267,6 +267,112 @@ def _encode_fail(ok0, admit_code, fit0_any, filter_code, fallback):
     )
 
 
+def _solve_step(plugins, carry, p, snap: ClusterSnapshot):
+    """One pod of the bit-faithful sequential scan: PreFilter -> built-in
+    fit (nominee holds) -> Filter chain -> Score/Normalize weighted sum ->
+    argmax select -> Reserve commits — THE parity-path step body, shared by
+    `Scheduler.solve` and the vmapped counterfactual sweep
+    (`parallel.solver.sweep_solve_fn`), so a swept weight lane runs exactly
+    the program the parity path runs."""
+    state = carry
+    # PreFilter, with per-plugin attribution (shared helper)
+    ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]
+    ok, admit_code = _admit_with_attribution(
+        plugins, state, snap, p, ok0
+    )
+    # Filter: built-in resource fit (nominee capacity holds
+    # included — see _free_with_nominee_holds) + plugin filters
+    free_eff = _free_with_nominee_holds(state, snap, p)
+    fit0 = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
+    # Filter chain with attribution (shared helper) — exact
+    # against the CARRIED state: the parity path's ground truth
+    feasible, filter_code = _filter_with_attribution(
+        plugins, state, snap, p, fit0
+    )
+    feasible &= ok
+    # Score + Normalize, weighted sum (eff_weight: the static profile int,
+    # or the traced per-candidate scalar a sweep lane bound)
+    total = jnp.zeros(state.free.shape[0], jnp.int64)
+    for plugin in plugins:
+        raw = plugin.score(state, snap, p)
+        if raw is not None:
+            total = total + plugin.eff_weight * plugin.normalize(raw, feasible)
+    # select: argmax score among feasible, lowest index tie-break
+    masked = jnp.where(feasible, total, jnp.int64(-(2**62)))
+    choice = jnp.where(
+        feasible.any(), jnp.argmax(masked).astype(jnp.int32), jnp.int32(-1)
+    )
+    # built-in Reserve: commit capacity
+    demand = pod_fit_demand(snap.pods.req[p])
+    onehot = (jnp.arange(state.free.shape[0]) == choice)[:, None]
+    state = state.replace(
+        free=state.free - jnp.where(choice >= 0, onehot * demand[None, :], 0)
+    )
+    if state.placed_mask is not None:
+        state = state.replace(
+            placed_mask=state.placed_mask.at[p].set(choice >= 0)
+        )
+    if snap.scheduling is not None:
+        # built-in: selector/domain carries are shared by multiple
+        # plugins (spread, inter-pod affinity) — commit once
+        from scheduler_plugins_tpu.ops.selectors import commit_tracks
+
+        state = commit_tracks(state, snap.scheduling, p, choice)
+    for plugin in plugins:
+        state = plugin.commit(state, snap, p, choice)
+    # attribution code (SolveResult.failed_plugin); fallback 0:
+    # a failed pod that no stage rejected lost to in-cycle
+    # capacity consumption -> built-in fit
+    fail_code = jnp.where(
+        choice >= 0,
+        jnp.int32(-1),
+        _encode_fail(ok0, admit_code, fit0.any(), filter_code,
+                     jnp.int32(0)),
+    )
+    return state, (choice, ok, fail_code)
+
+
+def sequential_solve_body(plugins, snap: ClusterSnapshot,
+                          state0: SolverState, auxes, unroll: int = 1,
+                          weights=None) -> SolveResult:
+    """The traced sequential parity solve over one snapshot: bind aux (and
+    optionally a traced (L,) per-plugin `weights` vector — the tuning
+    sweep's counterfactual channel), hoist presolves, scan `_solve_step`,
+    reduce gang quorum. `Scheduler._make_solve` jits this with
+    weights=None; `parallel.solver.sweep_solve_fn` vmaps it over K weight
+    vectors so every candidate shares one compile."""
+    # bind per-plugin traced aux inputs (weight vectors, cost
+    # matrices) so they are solve ARGUMENTS, not baked constants
+    for plugin, aux in zip(plugins, auxes):
+        plugin.bind_aux(aux)  # also clears any stale weight override
+    if weights is not None:
+        for i, plugin in enumerate(plugins):
+            plugin.bind_weight(weights[i])
+    # loop-invariant per-solve precomputes (hoisted out of the scan)
+    for plugin in plugins:
+        plugin.bind_presolve(plugin.prepare_solve(snap))
+    P = snap.num_pods
+    state, (assignment, admitted, failed_plugin) = jax.lax.scan(
+        lambda c, p: _solve_step(plugins, c, p, snap), state0,
+        jnp.arange(P), unroll=unroll,
+    )
+    wait = jnp.zeros(P, bool)
+    if snap.gangs is not None and state.gang_scheduled is not None:
+        # Permit quorum: previously-assigned + this cycle's placements
+        total_per_gang = snap.gangs.assigned + state.gang_scheduled
+        quorum = total_per_gang >= snap.gangs.min_member
+        gang = snap.pods.gang
+        in_gang = gang >= 0
+        pod_quorum = jnp.where(
+            in_gang, quorum[jnp.maximum(gang, 0)], True
+        )
+        wait = (assignment >= 0) & ~pod_quorum
+    return SolveResult(
+        assignment=assignment, admitted=admitted, wait=wait,
+        state=state, failed_plugin=failed_plugin,
+    )
+
+
 @dataclass
 class Profile:
     """An enabled-plugin set, the equivalent of one KubeSchedulerConfiguration
@@ -351,93 +457,10 @@ class Scheduler:
     def _make_solve(self, unroll: int):
         plugins = tuple(self.profile.plugins)
 
-        def step(carry, p, snap: ClusterSnapshot):
-            state = carry
-            # PreFilter, with per-plugin attribution (shared helper)
-            ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]
-            ok, admit_code = _admit_with_attribution(
-                plugins, state, snap, p, ok0
-            )
-            # Filter: built-in resource fit (nominee capacity holds
-            # included — see _free_with_nominee_holds) + plugin filters
-            free_eff = _free_with_nominee_holds(state, snap, p)
-            fit0 = fits_one(snap.pods.req[p], free_eff, snap.nodes.mask)
-            # Filter chain with attribution (shared helper) — exact
-            # against the CARRIED state: the parity path's ground truth
-            feasible, filter_code = _filter_with_attribution(
-                plugins, state, snap, p, fit0
-            )
-            feasible &= ok
-            # Score + Normalize, weighted sum
-            total = jnp.zeros(state.free.shape[0], jnp.int64)
-            for plugin in plugins:
-                raw = plugin.score(state, snap, p)
-                if raw is not None:
-                    total = total + plugin.weight * plugin.normalize(raw, feasible)
-            # select: argmax score among feasible, lowest index tie-break
-            masked = jnp.where(feasible, total, jnp.int64(-(2**62)))
-            choice = jnp.where(
-                feasible.any(), jnp.argmax(masked).astype(jnp.int32), jnp.int32(-1)
-            )
-            # built-in Reserve: commit capacity
-            demand = pod_fit_demand(snap.pods.req[p])
-            onehot = (jnp.arange(state.free.shape[0]) == choice)[:, None]
-            state = state.replace(
-                free=state.free - jnp.where(choice >= 0, onehot * demand[None, :], 0)
-            )
-            if state.placed_mask is not None:
-                state = state.replace(
-                    placed_mask=state.placed_mask.at[p].set(choice >= 0)
-                )
-            if snap.scheduling is not None:
-                # built-in: selector/domain carries are shared by multiple
-                # plugins (spread, inter-pod affinity) — commit once
-                from scheduler_plugins_tpu.ops.selectors import commit_tracks
-
-                state = commit_tracks(state, snap.scheduling, p, choice)
-            for plugin in plugins:
-                state = plugin.commit(state, snap, p, choice)
-            # attribution code (SolveResult.failed_plugin); fallback 0:
-            # a failed pod that no stage rejected lost to in-cycle
-            # capacity consumption -> built-in fit
-            fail_code = jnp.where(
-                choice >= 0,
-                jnp.int32(-1),
-                _encode_fail(ok0, admit_code, fit0.any(), filter_code,
-                             jnp.int32(0)),
-            )
-            return state, (choice, ok, fail_code)
-
         def solve(
             snap: ClusterSnapshot, state0: SolverState, auxes
         ) -> SolveResult:
-            # bind per-plugin traced aux inputs (weight vectors, cost
-            # matrices) so they are solve ARGUMENTS, not baked constants
-            for plugin, aux in zip(plugins, auxes):
-                plugin.bind_aux(aux)
-            # loop-invariant per-solve precomputes (hoisted out of the scan)
-            for plugin in plugins:
-                plugin.bind_presolve(plugin.prepare_solve(snap))
-            P = snap.num_pods
-            state, (assignment, admitted, failed_plugin) = jax.lax.scan(
-                lambda c, p: step(c, p, snap), state0, jnp.arange(P),
-                unroll=unroll,
-            )
-            wait = jnp.zeros(P, bool)
-            if snap.gangs is not None and state.gang_scheduled is not None:
-                # Permit quorum: previously-assigned + this cycle's placements
-                total_per_gang = snap.gangs.assigned + state.gang_scheduled
-                quorum = total_per_gang >= snap.gangs.min_member
-                gang = snap.pods.gang
-                in_gang = gang >= 0
-                pod_quorum = jnp.where(
-                    in_gang, quorum[jnp.maximum(gang, 0)], True
-                )
-                wait = (assignment >= 0) & ~pod_quorum
-            return SolveResult(
-                assignment=assignment, admitted=admitted, wait=wait,
-                state=state, failed_plugin=failed_plugin,
-            )
+            return sequential_solve_body(plugins, snap, state0, auxes, unroll)
 
         return jax.jit(solve)
 

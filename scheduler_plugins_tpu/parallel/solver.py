@@ -723,6 +723,47 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
     return cache[key], (snap, state0, auxes)
 
 
+def sweep_solve_fn(scheduler):
+    """The vmapped-over-weights counterfactual solve entry (the tuning
+    observatory's hot program): a single jitted function
+
+        fn(snap, state0, auxes, W (K, L) int64) ->
+            (assignment (K, P), admitted (K, P), wait (K, P))
+
+    that runs the bit-faithful sequential parity body
+    (`framework.runtime.sequential_solve_body`) once per candidate weight
+    vector, vmapped over the K axis — the per-candidate weight scalars are
+    traced arguments bound through `Plugin.bind_weight` (the aux-channel
+    discipline of CLAUDE.md applied to the one config knob the profile
+    format keeps host-side), so K candidates share ONE compile and zero
+    per-candidate retraces (`tools/tune.py` asserts this via the PR 5
+    compile-watch counters, program "sweep_solve"). Lane k is
+    bit-identical to a standalone `Scheduler.solve(auxes=)` on a profile
+    whose static weights equal W[k] (tests/test_tuning.py gates it).
+
+    Callers pad K to a power-of-two bucket (`tuning.sweep.pad_candidates`)
+    so candidate-count churn stays within bounded retraces, exactly like
+    `run_explain_rows`' index buckets."""
+    from scheduler_plugins_tpu.framework.runtime import sequential_solve_body
+
+    plugins = tuple(scheduler.profile.plugins)
+    key = ("sweep_solve",) + tuple(p.static_key() for p in plugins)
+    cache = scheduler._solve_cache
+    if key not in cache:
+
+        def sweep(snap, state0, auxes, W):
+            def lane(w):
+                r = sequential_solve_body(
+                    plugins, snap, state0, auxes, unroll=1, weights=w
+                )
+                return r.assignment, r.admitted, r.wait
+
+            return jax.vmap(lane)(W)
+
+        cache[key] = obs.compile_watch(jax.jit(sweep), program="sweep_solve")
+    return cache[key]
+
+
 def collapsed_batch_rows(plugins, state0, snap):
     """(filter_rows, score_rows): plugin position -> class-collapsed whole-
     batch (P, N) rows from the `batch_rows` / `filter_batch` / `score_batch`
@@ -791,7 +832,7 @@ def batch_explain_rows(scheduler, snap, indices, auxes=None):
     )
 
 
-def profile_initial_scores(scheduler, snap):
+def profile_initial_scores(scheduler, snap, auxes=None):
     """(P, N) weighted normalized plugin score matrix and (P, N) feasibility
     against the CYCLE-INITIAL state — the objective both solve modes rank
     nodes by before placements start. Used to quantify the batched path's
@@ -799,12 +840,16 @@ def profile_initial_scores(scheduler, snap):
     score_sum(assignment) = Σ_p scores[p, assignment[p]] is comparable
     across modes because both optimize this same cycle-initial surface
     (the sequential path then re-evaluates state-dependent filters as it
-    commits; scores stay cycle-initial in both, runtime.py step())."""
+    commits; scores stay cycle-initial in both, runtime.py step()).
+    `auxes` force-binds recorded config arrays on the flight-recorder
+    replay path (the tuner's drift anchor must score with exactly the
+    recorded inputs), like `Scheduler.solve(auxes=)`."""
     import jax
 
     plugins = tuple(scheduler.profile.plugins)
     state0 = scheduler.initial_state(snap)
-    auxes = tuple(p.aux() for p in plugins)
+    if auxes is None:
+        auxes = tuple(p.aux() for p in plugins)
     key = ("profile_scores",) + tuple(p.static_key() for p in plugins)
     cache = scheduler._solve_cache
     if key not in cache:
@@ -996,6 +1041,10 @@ def sharded_wave_chunk_solver(mesh, n_nodes: int, max_waves: int = 8,
 #: per wrapper object, so rebuilding the wrapper would recompile)
 _WAVE_SOLVER_CACHE: dict = {}
 
+#: static collective census per solver identity, computed lazily for
+#: tracer-enabled solves only (the merged trace's shard_wave/census row)
+_WAVE_CENSUS_CACHE: dict = {}
+
 
 def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
                        max_waves: int = 8, rescue_window: int = 512,
@@ -1039,9 +1088,26 @@ def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
             mesh, free0.shape[0], max_waves=max_waves,
             rescue_window=rescue_window, collect_stats=collect_stats,
         )
+    tracing = obs.tracer.enabled
+    if tracing:
+        # one-time static collective census for the merged trace (a
+        # make_jaxpr trace per solver identity — cached; tracer-enabled
+        # runs only, the hot path never pays it)
+        census = _WAVE_CENSUS_CACHE.get(key)
+        if census is None:
+            with ambient_mesh(mesh):
+                census = _WAVE_CENSUS_CACHE[key] = collective_census(
+                    solve_chunk, node_ids, snap.pods.req[:chunk],
+                    admitted[:chunk], rank_free,
+                )
+        obs.tracer.complete(
+            "census", obs.tracer.now_ns(), 0, tid="shard_wave",
+            args={"shards": n_shards, **census},
+        )
     parts, stats_parts = [], []
     with ambient_mesh(mesh):
-        for lo in range(0, P, chunk):
+        for i, lo in enumerate(range(0, P, chunk)):
+            start_ns = obs.tracer.now_ns() if tracing else 0
             out, rank_free = solve_chunk(
                 node_ids, snap.pods.req[lo:lo + chunk],
                 admitted[lo:lo + chunk], rank_free,
@@ -1049,6 +1115,24 @@ def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
             parts.append(out[0])
             if collect_stats:
                 stats_parts.append(out[1])
+            if tracing:
+                # per-chunk row: host-sync envelope of dispatch through
+                # stats transfer, stamped with the chunk's wave counters
+                # (device numbers strictly via host transfer — GL008)
+                args = {"chunk": i}
+                if collect_stats:
+                    import numpy as np
+
+                    args["waves"] = int(np.asarray(out[1]["waves"]))
+                    occ = [int(x) for x in np.asarray(out[1]["occupancy"])]
+                    while len(occ) > 1 and occ[-1] == 0:
+                        occ.pop()
+                    args["wave_occupancy"] = occ
+                obs.tracer.complete(
+                    f"chunk[{i}]", start_ns,
+                    obs.tracer.now_ns() - start_ns,
+                    tid="shard_wave", args=args,
+                )
     assignment = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     assignment, wait = finalize_assignment(assignment, snap)
     if collect_stats:

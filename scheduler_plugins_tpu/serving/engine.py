@@ -174,9 +174,13 @@ class ServeEngine:
         own the state (caller falls back to `Cluster.snapshot`). Drains
         the sink either way — deltas are absorbed even while falling
         back, so the resident columns never go stale."""
-        events = self._sink.drain()
+        with obs.tracer.span("ServeRefresh/drain", tid="serve"):
+            events = self._sink.drain()
         obs.metrics.set_gauge(obs.SERVE_PENDING_DELTAS, len(events))
-        upserts, usage, rebase = self._classify(events)
+        with obs.tracer.span(
+            "ServeRefresh/classify", tid="serve", events=len(events)
+        ):
+            upserts, usage, rebase = self._classify(events)
         if self._sink.consume_overflow():
             # the queue collapsed while nobody drained: the surviving
             # events are a partial window — the resident base is
@@ -290,6 +294,13 @@ class ServeEngine:
 
     # -- state transitions ----------------------------------------------
     def _apply_batch(self, upsert_rows, usage_rows) -> None:
+        with obs.tracer.span(
+            "ServeRefresh/apply", tid="serve",
+            upserts=len(upsert_rows), usage=len(usage_rows),
+        ):
+            self._apply_batch_inner(upsert_rows, usage_rows)
+
+    def _apply_batch_inner(self, upsert_rows, usage_rows) -> None:
         import warnings
 
         import jax
@@ -367,6 +378,12 @@ class ServeEngine:
         """Full re-snapshot: rebuild the resident base from the store (the
         compact path — the new bucket fits the CURRENT node count) and
         reset slot/interning tables to the store's own order."""
+        with obs.tracer.span(
+            "ServeRefresh/rebase", tid="serve", nodes=len(cluster.nodes)
+        ):
+            return self._rebase_inner(cluster, pending, now_ms)
+
+    def _rebase_inner(self, cluster, pending, now_ms: int):
         npad = bucket_size(max(len(cluster.nodes), 1))
         snap, meta = cluster.snapshot(
             pending, now_ms=now_ms, pad_nodes=npad,
@@ -428,6 +445,12 @@ class ServeEngine:
         """Snapshot view over the resident node columns + this cycle's
         pending batch (built through the same `build_pod_state` the full
         snapshot path uses, so the pod tensors are bit-identical)."""
+        with obs.tracer.span(
+            "ServeRefresh/assemble", tid="serve", pending=len(pending)
+        ):
+            return self._assemble_inner(cluster, pending)
+
+    def _assemble_inner(self, cluster, pending):
         import jax
         import jax.numpy as jnp
 

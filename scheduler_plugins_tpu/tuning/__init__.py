@@ -1,0 +1,18 @@
+"""Counterfactual tuning observatory over flight-recorder corpora.
+
+- `tuning.quality`: placement-quality objectives as tensor math
+  (fragmentation, utilization imbalance, gang wait, unplaced fraction,
+  drift) + the numpy twin `run_cycle` stamps on every report.
+- `tuning.sweep`: K candidate weight vectors replayed through ONE
+  vmapped sequential solve (zero per-candidate retraces).
+- `tuning.gates`: numpy hard-constraint replay oracles (fit, queue-order
+  quota, gang quorum) gating tuned-profile emission.
+
+Drivers: `tools/tune.py` (corpus sweep + gated profile emission),
+`tools/replay.py quality` (score a recorded bundle), `bench.py` (quality
+columns on every JSON line).
+"""
+
+from scheduler_plugins_tpu.tuning import gates, quality, sweep
+
+__all__ = ["gates", "quality", "sweep"]

@@ -1,0 +1,118 @@
+"""Hard-constraint replay oracles for counterfactual placements.
+
+Independent numpy re-implementations of the three hard-constraint
+families both solve paths enforce — resource fit, queue-order elastic
+quota (Max + aggregate-Min), gang quorum Permit — replayed against a
+snapshot and a candidate assignment. These mirror the differential-test
+oracles (tests/test_differential.py, PR 2/7) and are the acceptance gate
+for tuned-profile emission (`tools/tune.py`): a tuned weight vector is
+emitted ONLY if every replay across the corpus shows zero violations.
+
+Each oracle returns a violation COUNT (0 = clean) so the tune report can
+say what broke, not just that something did.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pod_fit_demand_np(req) -> np.ndarray:
+    """Numpy twin of `ops.fit.pod_fit_demand`: the effective request with
+    the pod-count slot charged 1 per pod — THE one host-side copy of the
+    fit-demand rule, shared by these oracles, the quality telemetry
+    (`tuning.quality.cycle_quality_np`) and the bench capacity audits, so
+    a change to fit-demand semantics has exactly one numpy site to
+    mirror. Deliberately a numpy re-statement, not a call into the jitted
+    path — the oracles must stay independent of the solver."""
+    from scheduler_plugins_tpu.ops import PODS_I
+
+    demand = np.asarray(req).copy()
+    demand[:, PODS_I] = 1
+    return demand
+
+
+def fit_violations(snap, assignment) -> int:
+    """(node, resource) cells over allocatable after committing the
+    placements (pods slot charged 1 per pod)."""
+    alloc = np.asarray(snap.nodes.alloc)
+    requested = np.asarray(snap.nodes.requested)
+    assignment = np.asarray(assignment)
+    used = requested.copy()
+    demand = pod_fit_demand_np(snap.pods.req)
+    placed = assignment >= 0
+    np.add.at(used, assignment[placed], demand[placed])
+    return int((used > alloc).sum())
+
+
+def mask_violations(snap, assignment) -> int:
+    """Placements on unschedulable (masked) or padded node rows."""
+    node_mask = np.asarray(snap.nodes.mask)
+    assignment = np.asarray(assignment)
+    placed = assignment[assignment >= 0]
+    n = node_mask.shape[0]
+    return int((placed >= n).sum() + (~node_mask[np.minimum(placed, n - 1)]
+                                      & (placed < n)).sum())
+
+
+def quota_violations(snap, assignment) -> int:
+    """Placed quota-namespace pods that exceed their Max or the
+    aggregate-Min pool at their own queue-order admission step (the scan
+    semantics both solvers enforce; capacity_scheduling.go:208-282)."""
+    if snap.quota is None:
+        return 0
+    req = np.asarray(snap.pods.req).astype(np.int64)
+    ns = np.asarray(snap.pods.ns)
+    has_q = np.asarray(snap.quota.has_quota)
+    qmax = np.asarray(snap.quota.max).astype(np.int64)
+    qmin = np.asarray(snap.quota.min).astype(np.int64)
+    used = np.asarray(snap.quota.used).astype(np.int64).copy()
+    assignment = np.asarray(assignment)
+    agg_min = (qmin * has_q[:, None]).sum(axis=0)
+    agg_used = (used * has_q[:, None]).sum(axis=0)
+    violations = 0
+    for p in range(len(assignment)):
+        if assignment[p] < 0 or not has_q[ns[p]]:
+            continue
+        if (used[ns[p]] + req[p] > qmax[ns[p]]).any() or (
+            agg_used + req[p] > agg_min
+        ).any():
+            violations += 1
+            continue  # violating pod holds no capacity it was denied
+        used[ns[p]] += req[p]
+        agg_used += req[p]
+    return violations
+
+
+def gang_quorum_violations(snap, assignment, wait) -> int:
+    """Gangs with a member BOUND (placed, not Permit-Wait) below quorum
+    (assigned-before + placed-this-cycle < MinMember)."""
+    if snap.gangs is None:
+        return 0
+    gang = np.asarray(snap.pods.gang)
+    min_member = np.asarray(snap.gangs.min_member)
+    assigned = np.asarray(snap.gangs.assigned)
+    assignment = np.asarray(assignment)
+    wait = np.asarray(wait).astype(bool)
+    placed = assignment >= 0
+    violations = 0
+    for g in range(len(min_member)):
+        members = gang == g
+        bound = int((members & placed & ~wait).sum())
+        total = int((members & placed).sum()) + int(assigned[g])
+        if bound > 0 and total < int(min_member[g]):
+            violations += 1
+    return violations
+
+
+def hard_violations(snap, assignment, wait) -> dict:
+    """{family: count} + "total" — the one gate summary the tuner and the
+    tune-smoke CI gate consume."""
+    out = {
+        "fit": fit_violations(snap, assignment),
+        "mask": mask_violations(snap, assignment),
+        "quota": quota_violations(snap, assignment),
+        "gang_quorum": gang_quorum_violations(snap, assignment, wait),
+    }
+    out["total"] = sum(out.values())
+    return out

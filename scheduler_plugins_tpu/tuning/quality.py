@@ -1,0 +1,362 @@
+"""Placement-quality objectives as tensor math.
+
+The bench's headline is pods/s; this module is the quality frontier next
+to it ("Priority Matters", arxiv 2511.08373; ROADMAP item 1): a solved
+cycle — (snapshot, assignment, admitted, wait) — scores on a small vector
+of placement-quality objectives, each a scalar float64:
+
+- ``fragmentation``: how shattered the POST-placement free capacity is.
+  Per core resource (cpu, memory): ``1 - max_node_free / total_free``
+  (0 when nothing is free — a fully packed cluster is not fragmented),
+  averaged over the two. 0 = all remaining headroom sits on one node
+  (a gang/large pod can still land); → 1 = headroom is dust spread over
+  the fleet.
+- ``util_imbalance``: population standard deviation of per-node
+  utilization (mean of cpu/mem used-over-allocatable) across schedulable
+  nodes. 0 = perfectly balanced load.
+- ``gang_wait_frac``: fraction of this cycle's placements parked in
+  Permit-Wait (gang quorum unmet) — capacity held hostage by incomplete
+  gangs.
+- ``unplaced_frac``: fraction of the real pending batch left unplaced.
+- ``drift`` (computed where an anchor exists — sweeps, batch bench
+  lines): relative score-sum drift vs the sequential-anchor placements
+  on the anchor profile's cycle-initial objective (the same definition as
+  `parallel.solver.score_drift_vs_sequential`).
+- ``preemptions`` / ``nominations`` (host counts from the `CycleReport`):
+  victims deleted and nominations made by this cycle's PostFilter.
+
+`SENSE` maps each objective to its improvement direction so ranking code
+(`tools/tune.py`) never hardcodes "lower is better".
+
+Two implementations, gated for agreement by tests/test_tuning.py:
+
+- the JAX core (`cycle_quality`, `batch_quality`, `state_quality`) — what
+  the bench lines and the vmapped counterfactual sweep use (K candidate
+  lanes score in one jitted vmap);
+- a numpy twin (`cycle_quality_np`) — what `framework.cycle.run_cycle`
+  stamps on every `CycleReport` and exports as
+  ``scheduler_placement_quality{objective}`` gauges. Numpy there on
+  purpose: run_cycle executes across dozens of snapshot shapes in the
+  unit suite and a per-shape jit compile for a sub-millisecond reduction
+  would buy nothing but compile time (the tier-1 suite sits at its
+  runtime cliff); the twin is ~30 lines of identical float64 arithmetic
+  and the decision-table tests hold the two bit-close.
+
+Multi-cycle objectives (gang admission latency in cycles) need memory
+across reports — `QualityAccumulator` below.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from scheduler_plugins_tpu.api.resources import CANONICAL, CPU, MEMORY
+
+#: resource-axis slots the capacity objectives aggregate over (requests in
+#: reference units are only comparable within a resource, so objectives
+#: reduce per resource first, then average)
+CPU_I = CANONICAL.index(CPU)
+MEM_I = CANONICAL.index(MEMORY)
+
+#: objective -> +1 (higher is better) / -1 (lower is better)
+SENSE = {
+    "fragmentation": -1,
+    "util_imbalance": -1,
+    "gang_wait_frac": -1,
+    "unplaced_frac": -1,
+    "drift": +1,
+    "preemptions": -1,
+    "nominations": -1,
+    "gang_latency_cycles": -1,
+}
+
+#: the objectives `cycle_quality` / `cycle_quality_np` emit per cycle
+CYCLE_OBJECTIVES = (
+    "fragmentation", "util_imbalance", "gang_wait_frac", "unplaced_frac",
+)
+
+
+# ---------------------------------------------------------------------------
+# JAX core
+# ---------------------------------------------------------------------------
+
+
+def placed_demand(req, assignment, n_nodes):
+    """(N, R) demand committed by the placements: each placed pod's fit
+    demand (request with the pods slot at 1) scatter-added onto its node."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.ops.fit import pod_fit_demand
+
+    demand = pod_fit_demand(req)
+    placed = assignment >= 0
+    add = jnp.where(placed[:, None], demand, 0)
+    return jnp.zeros((n_nodes, req.shape[1]), req.dtype).at[
+        jnp.maximum(assignment, 0)
+    ].add(add)
+
+
+def fragmentation(free, node_mask):
+    """Scalar float64 free-capacity fragmentation (see module docstring)."""
+    import jax.numpy as jnp
+
+    freef = jnp.where(node_mask[:, None], free, 0).astype(jnp.float64)
+    core = freef[:, (CPU_I, MEM_I)]
+    total = core.sum(axis=0)
+    largest = core.max(axis=0)
+    frag = jnp.where(total > 0, 1.0 - largest / jnp.maximum(total, 1.0), 0.0)
+    return frag.mean()
+
+
+def util_imbalance(alloc, free, node_mask):
+    """Scalar float64 population stddev of per-node cpu/mem utilization
+    over schedulable nodes."""
+    import jax.numpy as jnp
+
+    allocf = jnp.asarray(alloc).astype(jnp.float64)[:, (CPU_I, MEM_I)]
+    usedf = allocf - jnp.asarray(free).astype(jnp.float64)[:, (CPU_I, MEM_I)]
+    util = jnp.where(allocf > 0, usedf / jnp.maximum(allocf, 1.0), 0.0)
+    node_util = util.mean(axis=1)
+    n = jnp.maximum(node_mask.sum(), 1)
+    mean = jnp.where(node_mask, node_util, 0.0).sum() / n
+    var = jnp.where(node_mask, (node_util - mean) ** 2, 0.0).sum() / n
+    return jnp.sqrt(var)
+
+
+def _quality_terms(snap, assignment, wait):
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.ops.fit import free_capacity
+
+    free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    free0 = jnp.where(snap.nodes.mask[:, None], free0, 0)
+    free = free0 - placed_demand(snap.pods.req, assignment, snap.num_nodes)
+    placed = (assignment >= 0) & snap.pods.mask
+    n_real = jnp.maximum(snap.pods.mask.sum(), 1)
+    return {
+        "fragmentation": fragmentation(free, snap.nodes.mask),
+        "util_imbalance": util_imbalance(
+            snap.nodes.alloc, free, snap.nodes.mask
+        ),
+        "gang_wait_frac": (
+            jnp.where(placed, wait, False).sum().astype(jnp.float64)
+            / jnp.maximum(placed.sum(), 1)
+        ),
+        "unplaced_frac": (
+            1.0 - placed.sum().astype(jnp.float64) / n_real
+        ),
+    }
+
+
+_CYCLE_JIT = None
+_BATCH_JIT = None
+
+
+def cycle_quality(snap, assignment, admitted, wait):
+    """{objective: float} for one solved cycle — the jitted tensor entry
+    the bench lines and `tools/replay.py quality` use. `admitted` is
+    accepted for signature symmetry with the solve outputs (the
+    objectives read placements and waits)."""
+    import jax
+
+    from scheduler_plugins_tpu.utils import observability as obs
+
+    global _CYCLE_JIT
+    if _CYCLE_JIT is None:
+        _CYCLE_JIT = obs.compile_watch(
+            jax.jit(lambda s, a, w: _quality_terms(s, a, w)),
+            program="cycle_quality",
+        )
+    import jax.numpy as jnp
+
+    out = _CYCLE_JIT(
+        snap, jnp.asarray(assignment), jnp.asarray(wait).astype(bool)
+    )
+    return {k: float(v) for k, v in out.items()}
+
+
+def batch_quality(snap, assignments, waits):
+    """{objective: (K,) float64} for K candidate placements of ONE cycle
+    in a single vmapped jit — how the counterfactual sweep scores every
+    weight candidate without K dispatches."""
+    import jax
+
+    from scheduler_plugins_tpu.utils import observability as obs
+
+    global _BATCH_JIT
+    if _BATCH_JIT is None:
+        _BATCH_JIT = obs.compile_watch(
+            jax.jit(
+                lambda s, A, W: jax.vmap(
+                    lambda a, w: _quality_terms(s, a, w)
+                )(A, W)
+            ),
+            program="batch_quality",
+        )
+    import jax.numpy as jnp
+
+    out = _BATCH_JIT(
+        snap, jnp.asarray(assignments), jnp.asarray(waits).astype(bool)
+    )
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def state_quality(alloc, used, node_mask=None):
+    """{fragmentation, util_imbalance} of a CLUSTER STATE (allocatable vs
+    used matrices, CANONICAL axis) — the multi-cycle benches (config 7
+    serving churn, config 8 mega) score their accumulated end state with
+    this instead of a single cycle's placements."""
+    import jax.numpy as jnp
+
+    alloc = jnp.asarray(alloc)
+    used = jnp.asarray(used)
+    if node_mask is None:
+        node_mask = jnp.ones(alloc.shape[0], bool)
+    free = jnp.where(node_mask[:, None], alloc - used, 0)
+    return {
+        "fragmentation": float(fragmentation(free, node_mask)),
+        "util_imbalance": float(util_imbalance(alloc, free, node_mask)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# numpy twin (run_cycle's per-cycle stamp — no per-shape compiles)
+# ---------------------------------------------------------------------------
+
+
+def cycle_quality_np(snap, assignment, admitted, wait) -> dict:
+    """Numpy twin of `cycle_quality` — identical float64 arithmetic on
+    host arrays (tests/test_tuning.py gates the two for agreement)."""
+    alloc = np.asarray(snap.nodes.alloc)
+    requested = np.asarray(snap.nodes.requested)
+    node_mask = np.asarray(snap.nodes.mask)
+    req = np.asarray(snap.pods.req)
+    pods_mask = np.asarray(snap.pods.mask)
+    assignment = np.asarray(assignment)
+    wait = np.asarray(wait).astype(bool)
+
+    from scheduler_plugins_tpu.tuning.gates import pod_fit_demand_np
+
+    free = np.where(node_mask[:, None], alloc - requested, 0)
+    demand = pod_fit_demand_np(req)
+    placed = (assignment >= 0) & pods_mask
+    free = free.copy()
+    np.add.at(free, assignment[placed], -demand[placed])
+
+    core = np.where(node_mask[:, None], free, 0).astype(np.float64)[
+        :, (CPU_I, MEM_I)
+    ]
+    total = core.sum(axis=0)
+    largest = core.max(axis=0, initial=0.0)
+    frag = np.where(total > 0, 1.0 - largest / np.maximum(total, 1.0), 0.0)
+
+    allocf = alloc.astype(np.float64)[:, (CPU_I, MEM_I)]
+    usedf = allocf - free.astype(np.float64)[:, (CPU_I, MEM_I)]
+    util = np.where(allocf > 0, usedf / np.maximum(allocf, 1.0), 0.0)
+    node_util = util.mean(axis=1)
+    n = max(int(node_mask.sum()), 1)
+    mean = float(np.where(node_mask, node_util, 0.0).sum()) / n
+    var = float(np.where(node_mask, (node_util - mean) ** 2, 0.0).sum()) / n
+
+    n_real = max(int(pods_mask.sum()), 1)
+    return {
+        "fragmentation": float(frag.mean()),
+        "util_imbalance": float(np.sqrt(var)),
+        "gang_wait_frac": float((placed & wait).sum())
+        / max(int(placed.sum()), 1),
+        "unplaced_frac": 1.0 - float(placed.sum()) / n_real,
+    }
+
+
+def score_drift(scores, assignment, anchor) -> float:
+    """Relative score-sum drift of `assignment` vs `anchor` placements on
+    a (P, N) cycle-initial score matrix (same definition as
+    `parallel.solver.score_drift_vs_sequential`, host-side)."""
+    scores = np.asarray(scores)
+    a = np.asarray(assignment)
+    ref = np.asarray(anchor)
+
+    def ssum(x):
+        placed = x >= 0
+        return int(scores[np.nonzero(placed)[0], x[placed]].sum())
+
+    s_ref = ssum(ref)
+    return (ssum(a) - s_ref) / max(abs(s_ref), 1)
+
+
+# ---------------------------------------------------------------------------
+# multi-cycle: gang admission latency
+# ---------------------------------------------------------------------------
+
+
+class QualityAccumulator:
+    """Host-side accumulator for objectives that need memory across
+    cycles: gang admission latency (cycles from a gang's first pending
+    appearance to its first member binding — 0 = admitted the cycle it
+    arrived) and the preemption/nomination totals. Feed one
+    `(cycle_no, report, gang_of)` per cycle; `gang_of` maps a pod uid to
+    its gang name (or None)."""
+
+    def __init__(self):
+        self._first_pending: dict = {}
+        self.latencies: dict = {}  # gang -> cycles waited
+        self.preemptions = 0
+        self.nominations = 0
+
+    def observe(self, cycle_no: int, report, gang_of) -> None:
+        self.nominations += len(report.preempted)
+        self.preemptions += sum(
+            len(victims) for _, victims in report.preempted.values()
+        )
+        pending = set()
+        for uid in list(report.failed) + list(report.reserved):
+            g = gang_of(uid)
+            if g is not None:
+                pending.add(g)
+        admitted = set()
+        for uid in report.bound:
+            g = gang_of(uid)
+            if g is not None:
+                admitted.add(g)
+        for g in pending | admitted:
+            self._first_pending.setdefault(g, cycle_no)
+        for g in admitted:
+            if g not in self.latencies:
+                self.latencies[g] = cycle_no - self._first_pending[g]
+
+    def summary(self) -> dict:
+        lat = list(self.latencies.values())
+        return {
+            "gang_latency_cycles": (
+                float(np.mean(lat)) if lat else None
+            ),
+            "gangs_admitted": len(lat),
+            "gangs_still_waiting": len(self._first_pending)
+            - len(self.latencies),
+            "preemptions": self.preemptions,
+            "nominations": self.nominations,
+        }
+
+
+def gang_admission_latency(cycles) -> dict:
+    """Gang admission latency over a recorded-corpus replay: `cycles` is
+    an iterable of (gang_names, gang (P,), assignment (P,), wait (P,)) in
+    cycle order. A gang is pending while a member sits in the batch, and
+    admitted the first cycle a member places with quorum met (wait
+    False). Returns {gang: cycles waited} for admitted gangs."""
+    first: dict = {}
+    admitted: dict = {}
+    for cycle_no, (gang_names, gang, assignment, wait) in enumerate(cycles):
+        gang = np.asarray(gang)
+        assignment = np.asarray(assignment)
+        wait = np.asarray(wait).astype(bool)
+        for g, name in enumerate(gang_names):
+            members = gang == g
+            if not members.any():
+                continue
+            first.setdefault(name, cycle_no)
+            if name not in admitted and (
+                members & (assignment >= 0) & ~wait
+            ).any():
+                admitted[name] = cycle_no - first[name]
+    return admitted

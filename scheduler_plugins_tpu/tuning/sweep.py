@@ -1,0 +1,116 @@
+"""Vmapped counterfactual weight sweep over flight-recorder cycles.
+
+The PR 5 flight recorder captures each cycle's FULL solver inputs; this
+module replays a recorded cycle under K candidate plugin-weight vectors
+in ONE vmapped batched solve (`parallel.solver.sweep_solve_fn`): the
+candidate weights are traced arguments bound per lane through
+`Plugin.bind_weight` — the aux-channel discipline applied to the one
+profile knob (the score weight) the config format keeps host-side — so
+all K candidates share a single compile and zero per-candidate retraces.
+Candidate generation is seeded and deterministic: the identity row (the
+recorded profile's own weights) always rides at index 0 as the in-band
+baseline, followed by one-knob grid emphasis rows and Dirichlet
+perturbations of the current weight profile ("Learning to Score",
+arxiv 2603.10545, explores exactly this simplex).
+
+`sweep_cycle` is the one-cycle engine; corpus aggregation, objective
+ranking and gated profile emission live in `tools/tune.py`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: one-knob grid multipliers: for each plugin, emphasis (w*m) and
+#: de-emphasis (max(1, w//m)) rows at these factors
+GRID_FACTORS = (2, 4, 8)
+
+#: integer budgets per plugin for Dirichlet rows: candidates live on the
+#: simplex scaled to L*budget, so a ratio like 1.86:1 survives integer
+#: rounding; several scales keep the distinct-candidate pool large even
+#: for two-plugin profiles (weights multiply normalized scores <= 100,
+#: so O(40) totals stay far inside int64)
+WEIGHT_BUDGETS = (10, 20, 40)
+
+#: Dirichlet concentration: alpha = normalized current weights * this —
+#: samples cluster around the current profile instead of the uniform
+#: corners (perturbation, not random search)
+CONCENTRATION = 8.0
+
+
+def candidate_weights(base, k: int, seed: int = 0) -> np.ndarray:
+    """(K, L) int64 candidate weight matrix: row 0 = `base` (the current
+    profile), then the one-knob grid, then seeded Dirichlet perturbations
+    until `k` rows exist (duplicates dropped, so every lane is a distinct
+    counterfactual). All weights >= 1 (the solve contracts — e.g. the
+    targeted fast path — require positive weights)."""
+    base = np.asarray(base, np.int64)
+    L = base.shape[0]
+    if (base < 1).any():
+        raise ValueError("candidate sweep requires positive base weights")
+    rows = [tuple(base)]
+    seen = {tuple(base)}
+
+    def add(row):
+        row = tuple(int(max(w, 1)) for w in row)
+        if row not in seen:
+            seen.add(row)
+            rows.append(row)
+
+    for m in GRID_FACTORS:
+        for i in range(L):
+            up = base.copy()
+            up[i] *= m
+            add(up)
+            down = base.copy()
+            down[i] = max(1, int(down[i]) // m)
+            add(down)
+    rng = np.random.default_rng(seed)
+    alpha = base.astype(np.float64) / base.sum() * CONCENTRATION
+    guard = 0
+    while len(rows) < k and guard < 64 * k:
+        budget = L * WEIGHT_BUDGETS[guard % len(WEIGHT_BUDGETS)]
+        guard += 1
+        w = rng.dirichlet(alpha) * budget
+        add(np.maximum(np.rint(w), 1).astype(np.int64))
+    return np.asarray(rows[:k], np.int64)
+
+
+def pad_candidates(W: np.ndarray) -> np.ndarray:
+    """Pad the candidate axis to a power-of-two bucket with repeats of
+    row 0, bounding jit retraces under candidate-count churn (the same
+    discipline as `framework.runtime.run_explain_rows`)."""
+    K = W.shape[0]
+    bucket = 1 << max(int(K - 1).bit_length(), 0)
+    if bucket == K:
+        return W
+    pad = np.broadcast_to(W[0], (bucket - K, W.shape[1]))
+    return np.concatenate([W, pad], axis=0)
+
+
+def sweep_cycle(scheduler, snap, W, auxes=None):
+    """Replay one cycle under every row of `W` ((K, L) int64) in one
+    vmapped solve. Returns (assignment (K, P), admitted (K, P), wait
+    (K, P)) as host numpy, sliced back to the unpadded K. `auxes`
+    force-binds recorded config arrays exactly like
+    `Scheduler.solve(auxes=)` on the replay path."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.parallel.solver import sweep_solve_fn
+
+    W = np.asarray(W, np.int64)
+    K = W.shape[0]
+    plugins = tuple(scheduler.profile.plugins)
+    if W.shape[1] != len(plugins):
+        raise ValueError(
+            f"candidate width {W.shape[1]} != plugin count {len(plugins)}"
+        )
+    if auxes is None:
+        auxes = tuple(p.aux() for p in plugins)
+    fn = sweep_solve_fn(scheduler)
+    out = fn(
+        snap, scheduler.initial_state(snap), auxes,
+        jnp.asarray(pad_candidates(W)),
+    )
+    assignment, admitted, wait = (np.asarray(x)[:K] for x in out)
+    return assignment, admitted, wait

@@ -286,6 +286,11 @@ class CycleRecord:
                 "failed": list(report.failed),
                 "failed_by": dict(report.failed_by),
             }
+            # per-cycle placement-quality objectives (tuning.quality) —
+            # `tools/replay.py quality` diffs its recomputation against
+            # this recorded stamp
+            if getattr(report, "quality", None) is not None:
+                self.manifest["report"]["quality"] = dict(report.quality)
         self.manifest["drift"] = drift
         self.complete = True
         obs.metrics.inc(obs.FLIGHTREC_CYCLES)

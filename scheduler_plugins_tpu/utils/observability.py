@@ -270,6 +270,11 @@ SERVE_PENDING_DELTAS = "scheduler_serve_pending_deltas"
 #: full re-snapshots the serving engine performed (node deletes, label
 #: re-interning, extended resources — docs/SERVING.md taxonomy)
 SERVE_REBASES = "scheduler_serve_rebases_total"
+#: gauge (labels: objective): the latest cycle's placement-quality
+#: objective values (tuning.quality — fragmentation, util_imbalance,
+#: gang_wait_frac, unplaced_frac, preemptions, nominations), stamped by
+#: `framework.cycle.run_cycle` on every solved cycle
+PLACEMENT_QUALITY = "scheduler_placement_quality"
 
 
 # ---------------------------------------------------------------------------

@@ -228,3 +228,116 @@ class TestCycleTrace:
         run_cycle(Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
                   self._cluster(), now=1000)
         assert len(obs.tracer.export()["traceEvents"]) == before
+
+
+class TestServeTraceRows:
+    """PR 6 gap closure: ServeEngine.refresh stages appear as spans on
+    the "serve" row of a traced serve-mode cycle, and the trace stays
+    Perfetto-valid with the new rows."""
+
+    def _cluster(self):
+        c = Cluster()
+        for i in range(4):
+            c.add_node(Node(
+                name=f"n{i}",
+                allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110},
+            ))
+        for p in range(6):
+            c.add_pod(Pod(name=f"p{p}", creation_ms=p,
+                          containers=[Container(requests={CPU: 100})]))
+        return c
+
+    def test_serve_refresh_stage_spans(self):
+        from scheduler_plugins_tpu.serving import ServeEngine
+
+        cluster = self._cluster()
+        engine = ServeEngine().attach(cluster)
+        sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+        obs.tracer.start()
+        try:
+            # first serve cycle re-bases; a churned second cycle applies
+            # deltas and assembles from the resident columns
+            run_cycle(sched, cluster, now=1000, serve=engine)
+            cluster.add_pod(Pod(
+                name="late", creation_ms=99,
+                containers=[Container(requests={CPU: 100})],
+            ))
+            run_cycle(sched, cluster, now=2000, serve=engine)
+        finally:
+            obs.tracer.stop()
+        trace = obs.tracer.export()
+        assert validate_trace(trace) == []
+        rows = {
+            e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "serve" in rows
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        for expected in ("ServeRefresh/drain", "ServeRefresh/classify",
+                         "ServeRefresh/rebase", "ServeRefresh/apply",
+                         "ServeRefresh/assemble"):
+            assert expected in names, (expected, sorted(names))
+
+    def test_untraced_serve_cycle_records_nothing(self):
+        from scheduler_plugins_tpu.serving import ServeEngine
+
+        cluster = self._cluster()
+        engine = ServeEngine().attach(cluster)
+        sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+        before = len(obs.tracer.export()["traceEvents"])
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        assert len(obs.tracer.export()["traceEvents"]) == before
+
+
+class TestShardWaveTraceRows:
+    """PR 7 gap closure: a traced sharded-wave solve emits per-chunk rows
+    (waves + wave_occupancy) and the static collective census on the
+    "shard_wave" row, and the merged trace stays Perfetto-valid."""
+
+    def test_shard_wave_rows_and_census(self):
+        import jax.numpy as jnp
+
+        from scheduler_plugins_tpu.models import allocatable_scenario
+        from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
+        from scheduler_plugins_tpu.parallel.solver import sharded_wave_solve
+
+        cluster = allocatable_scenario(n_nodes=64, n_pods=256)
+        pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        weights = jnp.asarray(
+            meta.index.encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+        )
+        mesh = make_node_mesh(8)
+        obs.tracer.start()
+        try:
+            sharded_wave_solve(
+                snap, mesh, weights, chunk=128, collect_stats=True
+            )
+        finally:
+            obs.tracer.stop()
+        trace = obs.tracer.export()
+        assert validate_trace(trace) == []
+        rows = {
+            e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "shard_wave" in rows
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        chunks = [e for e in spans if e["name"].startswith("chunk[")]
+        assert len(chunks) == 2  # 256 pods / 128 chunk
+        for e in chunks:
+            assert e["args"]["waves"] >= 1
+            assert sum(e["args"]["wave_occupancy"]) > 0
+        census = [e for e in spans if e["name"] == "census"]
+        assert len(census) == 1
+        args = census[0]["args"]
+        assert args["shards"] == 8
+        # the ring election never gathers the node axis (GL009's
+        # trace-level twin)
+        for prim in ("all_gather", "all_gather_invariant", "all_to_all"):
+            assert args.get(prim, 0) == 0
+        assert sum(
+            v for k, v in args.items()
+            if k in ("psum", "pmin", "pmax", "ppermute")
+        ) > 0

@@ -1,0 +1,435 @@
+"""Tuning observatory tests: quality decision tables vs hand-computed
+oracles, vmapped-sweep bit-parity vs standalone solves, candidate
+generation, gates, weight round-trip, and the per-cycle quality stamp."""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.tuning import gates, quality, sweep
+from scheduler_plugins_tpu.utils import observability as obs
+
+
+def _tiny_cluster():
+    """2 nodes x 3 pods with round numbers — every objective below is
+    hand-computed from these figures, independent of quality.py."""
+    cluster = Cluster()
+    cluster.add_node(Node(
+        name="n0", allocatable={CPU: 1000, MEMORY: 1000, PODS: 10}
+    ))
+    cluster.add_node(Node(
+        name="n1", allocatable={CPU: 3000, MEMORY: 1000, PODS: 10}
+    ))
+    reqs = [(500, 200), (1000, 300), (100, 100)]
+    for i, (c, m) in enumerate(reqs):
+        cluster.add_pod(Pod(
+            name=f"p{i}", creation_ms=i,
+            containers=[Container(requests={CPU: c, MEMORY: m})],
+        ))
+    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    return snap, meta
+
+
+def _padded(snap, values, fill):
+    """Pad a per-real-pod vector out to the snapshot's pod bucket."""
+    out = np.full(snap.num_pods, fill, dtype=np.asarray(values).dtype)
+    out[: len(values)] = values
+    return out
+
+
+class TestQualityDecisionTables:
+    """Each objective against a hand-computed numpy oracle on the tiny
+    cluster (assignment fixed by hand, not solved — the objectives score
+    placements, wherever they came from)."""
+
+    def _fixed(self, snap):
+        assignment = _padded(snap, np.array([0, 1, -1], np.int32), -1)
+        wait = _padded(snap, np.zeros(3, bool), False)
+        return assignment, wait
+
+    def _hand_quality(self):
+        # free after placements: n0 (cpu 500, mem 800), n1 (2000, 700)
+        cpu_free = [500.0, 2000.0]
+        mem_free = [800.0, 700.0]
+        frag_cpu = 1 - max(cpu_free) / sum(cpu_free)          # 0.2
+        frag_mem = 1 - max(mem_free) / sum(mem_free)          # 0.4666..
+        frag = (frag_cpu + frag_mem) / 2
+        # per-node utilization: mean of cpu/mem used fraction
+        u0 = (500 / 1000 + 200 / 1000) / 2                    # 0.35
+        u1 = (1000 / 3000 + 300 / 1000) / 2                   # 0.31666..
+        mean = (u0 + u1) / 2
+        imb = np.sqrt(((u0 - mean) ** 2 + (u1 - mean) ** 2) / 2)
+        return frag, imb
+
+    def test_fragmentation_and_imbalance(self):
+        snap, _ = _tiny_cluster()
+        assignment, wait = self._fixed(snap)
+        frag, imb = self._hand_quality()
+        q = quality.cycle_quality(snap, assignment, None, wait)
+        assert q["fragmentation"] == pytest.approx(frag, abs=1e-12)
+        assert q["util_imbalance"] == pytest.approx(imb, abs=1e-12)
+
+    def test_unplaced_frac(self):
+        snap, _ = _tiny_cluster()
+        assignment, wait = self._fixed(snap)
+        q = quality.cycle_quality(snap, assignment, None, wait)
+        # 3 real pods (padding masked), 2 placed
+        assert q["unplaced_frac"] == pytest.approx(1 / 3, abs=1e-12)
+
+    def test_gang_wait_frac(self):
+        snap, _ = _tiny_cluster()
+        assignment, _ = self._fixed(snap)
+        wait = _padded(snap, np.array([True, False, False]), False)
+        q = quality.cycle_quality(snap, assignment, None, wait)
+        assert q["gang_wait_frac"] == pytest.approx(0.5, abs=1e-12)
+        # padded/unplaced rows never count: their wait bits are ignored
+        wait_pad = _padded(snap, np.zeros(3, bool), True)
+        q = quality.cycle_quality(snap, assignment, None, wait_pad)
+        assert q["gang_wait_frac"] == 0.0
+
+    def test_empty_cluster_objectives_are_defined(self):
+        snap, _ = _tiny_cluster()
+        _, wait = self._fixed(snap)
+        nothing = np.full(snap.num_pods, -1, np.int32)
+        q = quality.cycle_quality(snap, nothing, None, wait)
+        assert q["unplaced_frac"] == pytest.approx(1.0)
+        assert q["gang_wait_frac"] == 0.0  # 0/0 guards
+        assert np.isfinite(list(q.values())).all()
+
+    def test_numpy_twin_matches_jax_core(self):
+        snap, _ = _tiny_cluster()
+        assignment, wait0 = self._fixed(snap)
+        wait1 = _padded(snap, np.array([True, False, True]), False)
+        for wait in (wait0, wait1):
+            qj = quality.cycle_quality(snap, assignment, None, wait)
+            qn = quality.cycle_quality_np(snap, assignment, None, wait)
+            assert set(qj) == set(qn)
+            for k in qj:
+                assert qj[k] == pytest.approx(qn[k], abs=1e-12), k
+
+    def test_batch_quality_rows_match_single(self):
+        snap, _ = _tiny_cluster()
+        a0, w0 = self._fixed(snap)
+        A = np.stack([a0, _padded(snap, np.array([1, 0, 0], np.int32), -1)])
+        W = np.stack([w0, _padded(snap, np.array([False, True, False]), False)])
+        batch = quality.batch_quality(snap, A, W)
+        for k_row in range(2):
+            single = quality.cycle_quality(snap, A[k_row], None, W[k_row])
+            for name in single:
+                assert batch[name][k_row] == pytest.approx(
+                    single[name], abs=1e-12
+                ), name
+
+    def test_score_drift_hand_oracle(self):
+        scores = np.array([[10, 0], [5, 7], [1, 1]])
+        anchor = np.array([0, 1, -1])   # 10 + 7 = 17
+        ours = np.array([1, 0, 0])      # 0 + 5 + 1 = 6
+        assert quality.score_drift(scores, ours, anchor) == pytest.approx(
+            (6 - 17) / 17
+        )
+        assert quality.score_drift(scores, anchor, anchor) == 0.0
+
+    def test_state_quality_matches_cycle_view(self):
+        """state_quality(alloc, used) with used = committed placements
+        agrees with cycle_quality's fragmentation/imbalance (the config
+        7/8 accumulated-state view is the same math)."""
+        snap, _ = _tiny_cluster()
+        assignment, wait = self._fixed(snap)
+        q = quality.cycle_quality(snap, assignment, None, wait)
+        alloc = np.asarray(snap.nodes.alloc)
+        from scheduler_plugins_tpu.ops import PODS_I
+
+        req = np.asarray(snap.pods.req)
+        demand = req.copy()
+        demand[:, PODS_I] = 1
+        used = np.zeros_like(alloc)
+        placed = assignment >= 0
+        np.add.at(used, assignment[placed], demand[placed])
+        qs = quality.state_quality(alloc, used, np.asarray(snap.nodes.mask))
+        assert qs["fragmentation"] == pytest.approx(
+            q["fragmentation"], abs=1e-12
+        )
+        assert qs["util_imbalance"] == pytest.approx(
+            q["util_imbalance"], abs=1e-12
+        )
+
+
+class TestGangLatency:
+    def test_gang_admission_latency_feed(self):
+        gang_names = ["ga", "gb"]
+        # cycle 0: both pending, none admitted; cycle 1: ga admits;
+        # cycle 2: gb still waiting (placed but quorum-wait)
+        feed = [
+            (gang_names, np.array([0, 1]), np.array([-1, -1]),
+             np.array([False, False])),
+            (gang_names, np.array([0, 1]), np.array([2, 3]),
+             np.array([False, True])),
+            (gang_names, np.array([0, 1]), np.array([2, 3]),
+             np.array([False, True])),
+        ]
+        lat = quality.gang_admission_latency(feed)
+        assert lat == {"ga": 1}
+
+    def test_quality_accumulator(self):
+        from scheduler_plugins_tpu.framework.cycle import CycleReport
+
+        acc = quality.QualityAccumulator()
+        gang_of = {"a1": "ga", "a2": "ga", "b1": None}.get
+        r0 = CycleReport()
+        r0.failed = ["a1", "a2"]
+        acc.observe(0, r0, gang_of)
+        r1 = CycleReport()
+        r1.bound = {"a1": "n0", "b1": "n1"}
+        r1.preempted = {"a2": ("n0", ["v1", "v2"])}
+        acc.observe(1, r1, gang_of)
+        s = acc.summary()
+        assert s["gang_latency_cycles"] == 1.0
+        assert s["gangs_admitted"] == 1
+        assert s["preemptions"] == 2
+        assert s["nominations"] == 1
+
+
+class TestGates:
+    def test_fit_violation_detected(self):
+        snap, _ = _tiny_cluster()
+        # both heavy pods on n0: cpu 1500 > 1000
+        bad = _padded(snap, np.array([0, 0, -1], np.int32), -1)
+        assert gates.fit_violations(snap, bad) > 0
+        ok = _padded(snap, np.array([0, 1, 1], np.int32), -1)
+        assert gates.fit_violations(snap, ok) == 0
+
+    def test_mask_violation_detected(self):
+        snap, _ = _tiny_cluster()
+        ok = _padded(snap, np.array([0, 1, -1], np.int32), -1)
+        assert gates.mask_violations(snap, ok) == 0
+        out_of_range = _padded(snap, np.array([0, 5, -1], np.int32), -1)
+        assert gates.mask_violations(snap, out_of_range) > 0
+
+    def test_quota_and_quorum_on_gang_roster(self):
+        from scheduler_plugins_tpu.models import gang_quota_scenario
+        from scheduler_plugins_tpu import plugins as P
+
+        cluster = gang_quota_scenario(n_gangs=2, gang_size=4, n_nodes=16)
+        sched = Scheduler(Profile(plugins=[
+            P.NodeResourcesAllocatable(), P.Coscheduling(),
+            P.CapacityScheduling(),
+        ]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        result = sched.solve(snap)
+        a = np.asarray(result.assignment)
+        w = np.asarray(result.wait)
+        # the parity path's own placements are gate-clean by construction
+        assert gates.hard_violations(snap, a, w)["total"] == 0
+        if snap.gangs is not None and (a >= 0).any():
+            # binding one lone member of an unmet gang violates quorum
+            gang = np.asarray(snap.pods.gang)
+            g = int(gang[np.argmax(a >= 0)])
+            lone = np.full_like(a, -1)
+            member = int(np.argmax((gang == g) & (a >= 0)))
+            lone[member] = a[member]
+            min_member = int(np.asarray(snap.gangs.min_member)[g])
+            if min_member > 1:
+                assert gates.gang_quorum_violations(
+                    snap, lone, np.zeros_like(w)
+                ) == 1
+
+
+class TestCandidateWeights:
+    def test_identity_row_grid_and_determinism(self):
+        W1 = sweep.candidate_weights([1, 1], 64, seed=3)
+        W2 = sweep.candidate_weights([1, 1], 64, seed=3)
+        assert (W1 == W2).all()
+        assert W1.shape == (64, 2)
+        assert (W1[0] == [1, 1]).all()
+        assert (W1 >= 1).all()
+        assert len({tuple(r) for r in W1.tolist()}) == 64  # all distinct
+        W3 = sweep.candidate_weights([1, 1], 64, seed=4)
+        assert not (W1 == W3).all()
+
+    def test_pad_candidates_power_of_two(self):
+        W = sweep.candidate_weights([2, 3], 5)
+        P = sweep.pad_candidates(W)
+        assert P.shape[0] == 8
+        assert (P[5:] == W[0]).all()
+
+    def test_rejects_nonpositive_base(self):
+        with pytest.raises(ValueError):
+            sweep.candidate_weights([0, 1], 4)
+
+
+class TestSweepParity:
+    """The tentpole invariant: candidate k's vmapped lane bit-matches a
+    standalone `Scheduler.solve(auxes=)` whose static weights equal that
+    candidate's vector."""
+
+    def _trimaran(self, n_nodes=32, n_pods=24):
+        from scheduler_plugins_tpu.models import trimaran_scenario
+        from scheduler_plugins_tpu import plugins as P
+
+        cluster = trimaran_scenario(n_nodes=n_nodes, n_pods=n_pods, seed=1)
+        plugins = [P.TargetLoadPacking(), P.LoadVariationRiskBalancing()]
+        sched = Scheduler(Profile(plugins=plugins))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        return cluster, sched, snap, meta
+
+    def test_lane_bit_matches_standalone_solve(self):
+        from scheduler_plugins_tpu import plugins as P
+
+        cluster, sched, snap, meta = self._trimaran()
+        W = sweep.candidate_weights([1, 1], 8, seed=0)
+        auxes = tuple(p.aux() for p in sched.profile.plugins)
+        A, adm, wt = sweep.sweep_cycle(sched, snap, W, auxes=auxes)
+        assert A.shape == (8, snap.num_pods)
+        # lane 0 == the profile's own solve
+        base = sched.solve(snap, auxes=auxes)
+        assert (A[0] == np.asarray(base.assignment)).all()
+        assert (adm[0] == np.asarray(base.admitted)).all()
+        assert (wt[0] == np.asarray(base.wait)).all()
+        # every lane == a fresh scheduler with that weight vector static
+        for k in (1, 3, 7):
+            plugins = [
+                P.TargetLoadPacking(), P.LoadVariationRiskBalancing(),
+            ]
+            for plugin, w in zip(plugins, W[k]):
+                plugin.weight = int(w)
+            other = Scheduler(Profile(plugins=plugins))
+            other.prepare(meta, cluster)
+            result = other.solve(snap, auxes=auxes)
+            assert (A[k] == np.asarray(result.assignment)).all(), k
+            assert (wt[k] == np.asarray(result.wait)).all(), k
+
+    def test_sweep_compiles_once_and_buckets_candidates(self):
+        _, sched, snap, _ = self._trimaran()
+        miss0 = obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve")
+        A5, _, _ = sweep.sweep_cycle(
+            sched, snap, sweep.candidate_weights([1, 1], 5)
+        )
+        A8, _, _ = sweep.sweep_cycle(
+            sched, snap, sweep.candidate_weights([1, 1], 8)
+        )
+        assert A5.shape[0] == 5 and A8.shape[0] == 8
+        # 5 pads to the same 8-bucket: ONE compile serves both sweeps
+        miss = obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve")
+        assert miss - miss0 <= 1
+
+    def test_sweep_holds_hard_constraints_on_gang_roster(self):
+        """Weights are soft: every candidate lane of a gang+quota sweep
+        must satisfy fit/quota/quorum, and with a SINGLE scoring plugin
+        the argmax is weight-scale invariant so every lane bit-matches
+        lane 0."""
+        from scheduler_plugins_tpu.models import gang_quota_scenario
+        from scheduler_plugins_tpu import plugins as P
+
+        cluster = gang_quota_scenario(n_gangs=2, gang_size=4, n_nodes=16)
+        sched = Scheduler(Profile(plugins=[
+            P.NodeResourcesAllocatable(), P.Coscheduling(),
+            P.CapacityScheduling(),
+        ]))
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        W = sweep.candidate_weights([1, 1, 1], 6, seed=0)
+        A, adm, wt = sweep.sweep_cycle(sched, snap, W)
+        for k in range(len(W)):
+            assert gates.hard_violations(snap, A[k], wt[k])["total"] == 0, k
+            assert (A[k] == A[0]).all(), k
+
+
+class TestWeightsRoundTrip:
+    def test_profile_spec_and_load_profile_weights(self):
+        from scheduler_plugins_tpu.api.config import (
+            load_profile,
+            profile_spec,
+        )
+        from scheduler_plugins_tpu import plugins as P
+
+        plugins = [P.TargetLoadPacking(), P.LoadVariationRiskBalancing()]
+        plugins[0].weight = 46
+        plugins[1].weight = 34
+        spec = profile_spec(Profile(plugins=plugins, name="tuned"))
+        assert spec["weights"] == [46, 34]
+        profile = load_profile(spec)
+        assert [p.weight for p in profile.plugins] == [46, 34]
+
+    def test_default_weights_not_exported(self):
+        from scheduler_plugins_tpu.api.config import profile_spec
+        from scheduler_plugins_tpu import plugins as P
+
+        spec = profile_spec(Profile(plugins=[P.NodeResourcesAllocatable()]))
+        assert "weights" not in spec
+
+    def test_bad_weights_rejected(self):
+        from scheduler_plugins_tpu.api.config import load_profile
+
+        with pytest.raises(ValueError):
+            load_profile({"plugins": ["PodState"], "weights": [0]})
+        with pytest.raises(ValueError):
+            load_profile({"plugins": ["PodState"], "weights": [1, 2]})
+
+
+class TestCycleQualityStamp:
+    def _cluster(self):
+        from scheduler_plugins_tpu.api.resources import PODS as _PODS
+
+        gib = 1 << 30
+        cluster = Cluster()
+        for i in range(4):
+            cluster.add_node(Node(
+                name=f"n{i}",
+                allocatable={CPU: 8000, MEMORY: 32 * gib, _PODS: 64},
+            ))
+        for p in range(12):
+            cluster.add_pod(Pod(
+                name=f"p{p}", creation_ms=p,
+                containers=[Container(requests={CPU: 500, MEMORY: gib})],
+            ))
+        return cluster
+
+    def test_report_quality_and_gauges(self):
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+            self._cluster(), now=0,
+        )
+        assert report.quality is not None
+        for name in quality.CYCLE_OBJECTIVES:
+            assert name in report.quality
+        assert report.quality["unplaced_frac"] == 0.0
+        assert report.quality["preemptions"] == 0.0
+        for name, value in report.quality.items():
+            assert obs.metrics.get(
+                obs.PLACEMENT_QUALITY, objective=name
+            ) == value
+
+    def test_quality_recorded_in_flight_manifest(self):
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+        from scheduler_plugins_tpu.utils import flightrec
+
+        flightrec.recorder.start(capacity=1)
+        try:
+            report = run_cycle(
+                Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+                self._cluster(), now=0,
+            )
+            rec = flightrec.recorder.records()[-1]
+            assert rec.manifest["report"]["quality"] == report.quality
+        finally:
+            flightrec.recorder.stop()
+
+    def test_empty_cycle_has_no_quality(self):
+        from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+        report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+            Cluster(), now=0,
+        )
+        assert report.quality is None

@@ -124,6 +124,12 @@ ROLE_OVERRIDES = {
     "sharded_wave_chunk": (
         "node_ids", "snap.pods.req", "snap.pods.mask", "state.free",
     ),
+    # sweep(snap, state0, auxes, W): the (K, L) candidate weight matrix
+    # IS an aux-channel input — per-lane weight scalars bound through
+    # Plugin.bind_weight, the traced twin of the profile's static weight
+    # (labeling it aux keeps JA001's snapshot-bypass lattice honest about
+    # where candidate config enters the program)
+    "sweep_solve": ("snap", "state", "aux", "aux.weights"),
 }
 
 

@@ -408,10 +408,41 @@ def build_sharded_wave_chunk():
     return fn, args, mesh
 
 
+def build_sweep_solve():
+    """The vmapped counterfactual weight sweep (`parallel.solver
+    .sweep_solve_fn` — the tuning observatory's hot program): the
+    bit-faithful sequential solve body vmapped over an 8-lane candidate
+    weight bucket on the reduced tune-smoke trimaran roster
+    (tools/tune.py SMOKE corpus roster at a smaller shape; candidate
+    weights are traced per-lane arguments, so ONE program serves every
+    candidate — the property the lowering certifies for TPU)."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu import plugins as P
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.models import trimaran_scenario
+    from scheduler_plugins_tpu.parallel.solver import sweep_solve_fn
+    from scheduler_plugins_tpu.tuning import sweep
+
+    cluster = trimaran_scenario(n_nodes=64, n_pods=32, seed=0)
+    scheduler = Scheduler(Profile(plugins=[
+        P.TargetLoadPacking(), P.LoadVariationRiskBalancing(),
+    ]))
+    pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    scheduler.prepare(meta, cluster)
+    W = sweep.pad_candidates(sweep.candidate_weights([1, 1], 8))
+    auxes = tuple(p.aux() for p in scheduler.profile.plugins)
+    fn = sweep_solve_fn(scheduler)
+    args = (snap, scheduler.initial_state(snap), auxes, jnp.asarray(W))
+    return fn, args, None
+
+
 PROGRAMS = {
     "entry": build_entry,
     "serving_delta_apply": build_serving_delta_apply,
     "sharded_wave_chunk": build_sharded_wave_chunk,
+    "sweep_solve": build_sweep_solve,
     "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
     "bench_cfg1_flagship": build_cfg1_flagship,
     "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
