@@ -84,11 +84,21 @@ shard-smoke:
 mega:
 	JAX_PLATFORMS=cpu $(PY) bench.py --config 8
 
+# CI resilience gate: reduced chaos-churn run under the FULL seeded fault
+# plan (hung solve, device error, garbage output, dropped/duplicated/
+# corrupted sink deltas, feed stall, crash mid-cycle) — zero
+# hard-constraint violations, every fault fired and recovered within a
+# bounded cycle count, EVERY cycle bit-identical to the no-chaos control,
+# and fault-free watchdog overhead within max(2%, the run's jitter floor)
+.PHONY: chaos-smoke
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --chaos-smoke
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke chaos-smoke
 
 .PHONY: lint
 lint:

@@ -34,11 +34,46 @@ def apply_platform_override():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+#: the last tunnel probe's structured verdict, stamped into every emitted
+#: JSON line as `backend_probe` so a sick backend is ATTRIBUTED, not just
+#: flagged: {"kind": "healthy"} after a clean probe;
+#: {"kind": "timeout"|"import-error"|"device-error", "detail": ...} after
+#: a failed one; None when the config skips the probe by policy (the
+#: CPU-pinned CI gates and host-mesh benches)
+_PROBE_STATE = None
+
+
+def classify_probe_failure(proc, timeout):
+    """Structured classification of a failed probe subprocess — the
+    difference matters operationally: a TIMEOUT is the hung-tunnel
+    signature (blocks forever at 0% CPU; wait for the window), an
+    IMPORT ERROR is a broken environment (no amount of waiting helps),
+    a DEVICE ERROR is the backend answering and failing (retryable,
+    the watchdog's retry/backoff territory)."""
+    if proc is None:
+        return {
+            "kind": "timeout",
+            "detail": f"no probe answer in {timeout}s "
+                      "(hung-tunnel signature: blocked at 0% CPU)",
+        }
+    tail = (proc.stderr or "").strip().splitlines()
+    detail = tail[-1][:200] if tail else f"rc={proc.returncode}"
+    kind = "device-error"
+    if any(
+        marker in line
+        for line in tail[-8:]
+        for marker in ("ImportError", "ModuleNotFoundError")
+    ):
+        kind = "import-error"
+    return {"kind": kind, "detail": detail}
+
+
 def backend_probe(timeout=None):
     """CLAUDE.md tunnel probe: an 8x8 matmul must round-trip through a host
     transfer before anything else runs. In a subprocess so a dead axon tunnel
     (which blocks forever at 0% CPU) cannot hang the bench itself; returns
-    None when healthy, else a short diagnosis string.
+    None when healthy, else the structured `classify_probe_failure` dict
+    (also stamped into every emitted line as `backend_probe`).
 
     The timeout is SHORT by design (default 45s, `SPT_PROBE_TIMEOUT_S`
     overrides): the driver runs each config under a ~90s budget, so a sick
@@ -49,6 +84,7 @@ def backend_probe(timeout=None):
     windows the north star needs."""
     import os
 
+    global _PROBE_STATE
     if timeout is None:
         timeout = float(os.environ.get("SPT_PROBE_TIMEOUT_S", 45))
     # self-contained (no `import bench`: the subprocess inherits the caller's
@@ -66,10 +102,12 @@ def backend_probe(timeout=None):
             timeout=timeout, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        return f"tpu-backend-timeout ({timeout}s)"
+        _PROBE_STATE = classify_probe_failure(None, timeout)
+        return _PROBE_STATE
     if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()
-        return "tpu-backend-error: " + (tail[-1][:160] if tail else "unknown")
+        _PROBE_STATE = classify_probe_failure(proc, timeout)
+        return _PROBE_STATE
+    _PROBE_STATE = {"kind": "healthy"}
     return None
 
 
@@ -202,6 +240,10 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
         "value": round(pods_per_sec, 1),
         "unit": f"pods/s ({detail})",
         "backend": _backend_label(),
+        # structured probe attribution: {"kind": "healthy"} or the
+        # timeout/import-error/device-error classification; None when the
+        # config skips the tunnel probe by policy
+        "backend_probe": _PROBE_STATE,
         **_device_attribution(),
         "drift": None if drift is None else round(drift, 4),
         # the placement-quality columns (tuning.quality): per-cycle
@@ -615,7 +657,7 @@ CONFIG_METRICS = {
     3: "numa_pods_per_sec", 4: "gang_quota_pods_per_sec",
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
-    8: "mega_pods_per_sec",
+    8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
 }
 
 
@@ -1199,6 +1241,357 @@ def churn_smoke(min_ratio=1.5):
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# config 9: chaos churn — the config-7 workload under a seeded fault plan
+# ---------------------------------------------------------------------------
+
+#: the chaos headline shape: the config-7 churn workload (same generators,
+#: same Poisson streams) with the full `resilience.faults` taxonomy
+#: injected — hung solve, device error, garbage output, dropped/
+#: duplicated/corrupted sink events, feed stall, crash-mid-cycle. The
+#: claim under test (docs/ROBUSTNESS.md): zero hard-constraint
+#: violations, bounded recovery, and EVERY cycle bit-identical to the
+#: no-chaos run — the watchdog failover is bit-faithful by construction
+#: and the anti-entropy window is pinned to one cycle, so faults cost
+#: latency and rebases, never placements.
+CHAOS_SHAPE = dict(
+    n_nodes=500, prefill=4096, cycles=32, warmup=4,
+    lam_arrive=32, lam_depart=16, node_add_every=10, node_remove_every=0,
+    timeout_s=2.0, hang_seconds=3.0, stall_seconds=0.05, probe_every=1,
+)
+#: reduced shape for the `make chaos-smoke` CI gate (2-core runners);
+#: node count below its padding bucket like CHURN_SMOKE_SHAPE
+CHAOS_SMOKE_SHAPE = dict(
+    n_nodes=120, prefill=1024, cycles=16, warmup=2,
+    lam_arrive=12, lam_depart=6, node_add_every=7, node_remove_every=0,
+    timeout_s=1.5, hang_seconds=2.5, stall_seconds=0.02, probe_every=1,
+)
+#: interleaved watchdog-on/off pairs for the fault-free overhead bound
+#: (the replay-smoke pairing discipline: the statistic is the median of
+#: PAIRED deltas, the floor is the off series' own p10-p90 spread)
+CHAOS_OVERHEAD_PAIRS = 9
+
+
+def _chaos_fault_plan(shape, seed=0):
+    from scheduler_plugins_tpu.resilience import faults as F
+
+    plan = F.FaultPlan.standard(
+        seed, shape["cycles"], hang_seconds=shape["hang_seconds"],
+        stall_seconds=shape["stall_seconds"],
+    )
+    for spec in plan.specs:
+        if spec.site == F.DELTA_EVENT:
+            # a delta fault can only fire when a sink event actually
+            # passes through its cycle — sticky specs roll forward to
+            # the first opportunity instead of silently missing
+            spec.sticky = True
+    return plan
+
+
+def _chaos_resilience(shape, engine, seed=0):
+    from scheduler_plugins_tpu.resilience import Resilience, SolveWatchdog
+
+    return Resilience(
+        watchdog=SolveWatchdog(
+            timeout_s=shape["timeout_s"], max_attempts=2,
+            backoff_base_s=0.01, seed=seed,
+        ),
+        probe_every=shape["probe_every"],
+        engine=engine,
+    )
+
+
+def _run_chaos_arm(scheduler, shape, seed=0, plan=None):
+    """One full chaos-churn run: the config-7 event stream through serve
+    mode + the resilience layer, with `plan` installed (None = the
+    no-chaos control arm — SAME engine/resilience configuration, so the
+    two arms differ ONLY in injected faults). The anti-entropy window is
+    pinned to ONE cycle (`verify_every=1`): every refresh digests the
+    resident columns before the solve consumes them, which is what makes
+    "every cycle bit-identical under faults" a provable claim instead of
+    a lucky one. Returns per-cycle wall times, per-cycle bound maps, and
+    the recovery/degradation bookkeeping."""
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.resilience import faults as F
+    from scheduler_plugins_tpu.serving import ServeEngine
+
+    cluster = churn_cluster(shape["n_nodes"], shape["prefill"], seed)
+    engine = ServeEngine().attach(cluster)
+    engine.verify_every = 1
+    rz = _chaos_resilience(shape, engine, seed)
+    rng = np.random.default_rng(seed + 1)
+    serial = 0
+    times, decided, bound_per_cycle = [], [], []
+    degraded_cycles = 0
+    crashes = 0
+    #: accumulated across engine replacements (a crash swaps the engine
+    #: object; its pre-crash counters must not vanish with it)
+    rebases_acc = 0
+    divergences_acc = 0
+    rebases0 = engine.rebases
+    recoveries: list = []
+    checkpoint = None
+    if plan is not None:
+        F.install(plan)
+    try:
+        total = shape["warmup"] + shape["cycles"]
+        for cycle in range(total):
+            now = 1000 * (cycle + 1)
+            timed_idx = cycle - shape["warmup"]
+            if plan is not None:
+                # warmup cycles are fault-free (timed_idx < 0 matches no
+                # spec); the window also covers _churn_events' sink pushes
+                plan.begin_cycle(timed_idx)
+                stall = plan.fire(F.FEED_STALL)
+                if stall is not None:
+                    time.sleep(stall.seconds)  # a stalled feed costs
+                    # latency; the cycle itself must be unaffected
+            serial = _churn_events(cluster, rng, shape, cycle, now, serial)
+            start = time.perf_counter()
+            try:
+                with _bench_span(
+                    f"chaos cycle {cycle}", chaos=plan is not None
+                ):
+                    report = run_cycle(
+                        scheduler, cluster, now=now, serve=engine,
+                        resilience=rz,
+                    )
+                bound = dict(report.bound)
+                failed = len(report.failed)
+                degraded = report.degraded
+            except F.CrashInjected as crash:
+                # process death after bindings landed: the engine (its
+                # resident tensors + undrained sink) and the watchdog
+                # state die; the harness "restarts" from the last
+                # checkpoint, and anti-entropy re-bases the stale base
+                # within one window
+                crashes += 1
+                bound = dict(crash.report.bound)
+                failed = len(crash.report.failed)
+                degraded = rz.degraded
+                recoveries.extend(rz.recoveries)
+                if rz.degraded:
+                    # the crash ends the open degradation window at the
+                    # restart boundary — charge it now (the fresh process
+                    # starts fast and re-measures if the backend is still
+                    # sick) instead of silently dropping it with the old rz
+                    recoveries.append((rz.degraded_at, rz.cycle))
+                rebases_acc += engine.rebases - rebases0
+                divergences_acc += engine.antientropy_divergences
+                engine.detach()
+                engine = ServeEngine().attach(cluster)
+                engine.verify_every = 1
+                rebases0 = engine.rebases
+                if checkpoint is not None:
+                    engine.restore_checkpoint(checkpoint)
+                rz = _chaos_resilience(shape, engine, seed)
+            elapsed = time.perf_counter() - start
+            checkpoint = engine.checkpoint_bytes() or checkpoint
+            if timed_idx >= 0:
+                times.append(elapsed)
+                decided.append(len(bound) + failed)
+                bound_per_cycle.append(bound)
+                degraded_cycles += 1 if degraded else 0
+    finally:
+        if plan is not None:
+            F.clear()
+    recoveries.extend(rz.recoveries)
+    if rz.degraded:
+        # never recovered within the run: charge the open window through
+        # one past the end so the gate's recovery bound fails honestly
+        recoveries.append((rz.degraded_at, rz.cycle + 1))
+    return {
+        "times": times, "decided": decided, "bound": bound_per_cycle,
+        "cluster": cluster, "engine": engine, "resilience": rz,
+        "degraded_cycles": degraded_cycles, "crashes": crashes,
+        "rebases": rebases_acc + engine.rebases - rebases0,
+        "divergences": divergences_acc + engine.antientropy_divergences,
+        "recoveries": recoveries,
+    }
+
+
+def _chaos_overhead_pct(shape, seed=77):
+    """Fault-free watchdog/failover overhead, measured the replay-smoke
+    way: two identically-evolving serve clusters, one cycle each per
+    pair (resilience OFF first, then ON), overhead = median of paired
+    deltas, floor = the off series' p10-p90 spread. Two full passes over
+    the same seeded event stream — the first untimed, so every jit shape
+    a timed pair can hit (pod buckets vary with the Poisson draws) is
+    already warm and the statistic times the WATCHDOG layer, never a
+    compile. One shared scheduler across arms and passes for the same
+    reason. Anti-entropy stays at its production cadence here — this
+    bounds the per-cycle cost of the watchdog wrapping alone."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+    from scheduler_plugins_tpu.serving import ServeEngine
+
+    scheduler = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    n_cycles = shape["warmup"] + CHAOS_OVERHEAD_PAIRS
+
+    def one_cycle(arm, cycle):
+        now = 1000 * (cycle + 1)
+        arm["serial"] = _churn_events(
+            arm["cluster"], arm["rng"], shape, cycle, now, arm["serial"]
+        )
+        start = time.perf_counter()
+        run_cycle(
+            scheduler, arm["cluster"], now=now, serve=arm["engine"],
+            resilience=arm["resilience"],
+        )
+        return time.perf_counter() - start
+
+    off, pair_pct = [], []
+    for timed in (False, True):
+        arms = {}
+        for name in ("off", "on"):
+            cluster = churn_cluster(
+                shape["n_nodes"], shape["prefill"], seed
+            )
+            engine = ServeEngine().attach(cluster)
+            arms[name] = dict(
+                cluster=cluster, engine=engine,
+                rng=np.random.default_rng(seed + 1), serial=0,
+                resilience=(
+                    None if name == "off"
+                    else _chaos_resilience(shape, engine, seed)
+                ),
+            )
+        for cycle in range(n_cycles):
+            t_off = one_cycle(arms["off"], cycle)
+            t_on = one_cycle(arms["on"], cycle)
+            if timed and cycle >= shape["warmup"]:
+                off.append(t_off)
+                pair_pct.append(100.0 * (t_on - t_off) / t_off)
+    median_off = sorted(off)[len(off) // 2]
+    overhead_pct = sorted(pair_pct)[len(pair_pct) // 2]
+    off_sorted = sorted(off)
+    spread_pct = 100.0 * (
+        off_sorted[int(0.9 * (len(off) - 1))]
+        - off_sorted[int(0.1 * (len(off) - 1))]
+    ) / median_off
+    return overhead_pct, spread_pct
+
+
+def chaos_churn(shape=None, emit=True, seed=0):
+    """Config 9: the chaos bench. Runs the config-7 churn workload twice
+    through serve mode + the resilience layer — once under the full
+    seeded fault plan, once fault-free (the control) — and reports
+    recovery windows, degraded-time fraction, violations, and the
+    fault-free watchdog overhead. The headline claims (asserted by
+    `chaos_smoke`): zero hard-constraint violations, every injected
+    fault recovered within a bounded cycle count, EVERY cycle's bound
+    set bit-identical to the no-chaos control, and fault-free-path
+    watchdog overhead within max(2%, the run's own jitter floor)."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+    shape = shape or CHAOS_SHAPE
+    # ONE scheduler for both arms: the control arm walks the identical
+    # event stream first, so every (pod-bucket, node-bucket) jit shape
+    # the chaos arm's device solves and probation probes hit is warm —
+    # the watchdog deadline then times the BACKEND, never a legit compile
+    scheduler = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    control = _run_chaos_arm(scheduler, shape, seed=seed, plan=None)
+    plan = _chaos_fault_plan(shape, seed=seed)
+    chaos = _run_chaos_arm(scheduler, shape, seed=seed, plan=plan)
+
+    cycles_match = sum(
+        1 for a, b in zip(chaos["bound"], control["bound"]) if a == b
+    )
+    n_cycles = len(chaos["times"])
+    cumulative_chaos: dict = {}
+    cumulative_control: dict = {}
+    for b in chaos["bound"]:
+        cumulative_chaos.update(b)
+    for b in control["bound"]:
+        cumulative_control.update(b)
+    violations = _churn_capacity_violations(chaos["cluster"])
+    recovery_cycles = [b - a for a, b in chaos["recoveries"]]
+    # delta faults recover within the pinned one-cycle anti-entropy
+    # window BY CONSTRUCTION (verify_every=1, divergence => rebase before
+    # the solve); solve faults measure their own windows via probation
+    recovery_max = max(
+        recovery_cycles + ([1] if chaos["divergences"] else [0])
+    )
+    overhead_pct, jitter_floor_pct = _chaos_overhead_pct(shape, seed + 77)
+    serve_s, control_s = sum(chaos["times"]), sum(control["times"])
+    n_decided = sum(chaos["decided"])
+    lat = np.repeat(chaos["times"], chaos["decided"])
+    line = {
+        "cycles": n_cycles,
+        "faults_injected": len(plan.log),
+        "faults_unfired": len(plan.unfired()),
+        "fault_log": [list(entry) for entry in plan.log],
+        "cycles_bit_identical": cycles_match,
+        "all_cycles_bit_identical": cycles_match == n_cycles,
+        "cumulative_placements_match": (
+            cumulative_chaos == cumulative_control
+        ),
+        "capacity_violations": violations,
+        "recovery_cycles_max": recovery_max,
+        "degraded_cycles": chaos["degraded_cycles"],
+        "degraded_fraction": round(chaos["degraded_cycles"] / n_cycles, 4),
+        "crashes": chaos["crashes"],
+        "rebases": chaos["rebases"],
+        "antientropy_divergences": chaos["divergences"],
+        "watchdog_overhead_pct": round(overhead_pct, 2),
+        "overhead_jitter_floor_pct": round(jitter_floor_pct, 2),
+        "decision_latency_p50_ms": round(
+            float(np.percentile(lat, 50)) * 1000, 2) if lat.size else 0.0,
+        "decision_latency_p99_ms": round(
+            float(np.percentile(lat, 99)) * 1000, 2) if lat.size else 0.0,
+        "decisions": n_decided,
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[9],
+            n_decided / serve_s if serve_s else 0.0,
+            f"{shape['n_nodes']} nodes, {shape['prefill']} bound, "
+            f"{n_cycles} cycles chaos churn x {len(plan.specs)} faults, "
+            "serve+resilience",
+            baseline=n_decided / control_s if control_s else 1.0,
+            drift=(0.0 if line["all_cycles_bit_identical"] else None),
+            quality=_quality_state(
+                *_cluster_state_matrices(chaos["cluster"])
+            ),
+            extra=line,
+        )
+    return line
+
+
+def chaos_smoke(bound_pct=2.0, recovery_bound=4):
+    """CI gate (`make chaos-smoke`): reduced chaos config under the FULL
+    seeded fault plan — zero hard-constraint violations, every fault
+    fired and recovered within `recovery_bound` cycles, every cycle
+    bit-identical to the no-chaos control, and fault-free watchdog
+    overhead within max(`bound_pct`%, the run's own jitter floor). One
+    JSON line; rc 1 on any failure."""
+    line = chaos_churn(shape=CHAOS_SMOKE_SHAPE, emit=False)
+    overhead_bound = max(bound_pct, line["overhead_jitter_floor_pct"])
+    ok = (
+        line["capacity_violations"] == 0
+        and line["faults_unfired"] == 0
+        and line["faults_injected"] >= 8
+        and line["all_cycles_bit_identical"]
+        and line["cumulative_placements_match"]
+        and line["recovery_cycles_max"] <= recovery_bound
+        and line["crashes"] >= 1
+        # one divergence per delta fault that poisoned resident state
+        # (drop/dup/corrupt) plus the post-crash stale-checkpoint detect
+        and line["antientropy_divergences"] >= 3
+        and line["watchdog_overhead_pct"] <= overhead_bound
+    )
+    print(json.dumps({
+        "metric": "chaos_smoke",
+        "backend": _backend_label(),
+        "overhead_bound_pct": round(overhead_bound, 2),
+        "recovery_bound_cycles": recovery_bound,
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
+
+
 #: replay cutoff: a capture older than this is too stale to stand in for
 #: "the round's number" (a round is ~12h; 48h allows the previous round's
 #: tail while excluding week-old numbers from a drifted codebase)
@@ -1562,7 +1955,10 @@ if __name__ == "__main__":
                              "resident-state vs full-resnapshot; 8 = "
                              "100k-node x 1M-pod mega scale on the "
                              "shard_map ring-election wave solver, "
-                             "8-host-device mesh vs 1 device); "
+                             "8-host-device mesh vs 1 device; 9 = chaos "
+                             "churn: the config-7 workload under the "
+                             "full seeded fault plan, serve+resilience "
+                             "vs the no-chaos control); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -1601,6 +1997,15 @@ if __name__ == "__main__":
                              "the full-resnapshot baseline >= 1.5x on "
                              "cycles/s with identical placements and "
                              "zero hard-constraint violations")
+    parser.add_argument("--chaos-smoke", action="store_true",
+                        help="CI gate: reduced chaos-churn run under the "
+                             "full seeded fault plan (hung solve, device "
+                             "error, garbage output, dropped/dup/corrupt "
+                             "deltas, feed stall, crash mid-cycle); fails "
+                             "unless zero hard-constraint violations, "
+                             "bounded recovery, every cycle bit-identical "
+                             "to the no-chaos control, and watchdog "
+                             "overhead within max(2%, jitter floor)")
     args = parser.parse_args()
     apply_platform_override()
     if args.shard_smoke:
@@ -1620,6 +2025,18 @@ if __name__ == "__main__":
         # a mode-vs-mode comparison, not a timing run against history —
         # no tunnel probe
         sys.exit(churn_smoke())
+    if args.chaos_smoke:
+        # CPU-backend CI gate: a chaos-vs-control comparison under
+        # injected faults — no tunnel probe (the REAL backend's health is
+        # irrelevant to what the gate asserts)
+        sys.exit(chaos_smoke())
+    if args.config == 9:
+        # chaos-vs-control comparison like the smoke gate, full shape —
+        # runs on whatever backend is configured; no tunnel probe (both
+        # arms share the backend, so its health cancels out of every
+        # asserted claim and shows up only in the latency columns)
+        chaos_churn()
+        sys.exit(0)
     if args.sanitize_smoke:
         # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
         # correctness instrumentation, not a timing run — no tunnel probe
@@ -1652,7 +2069,11 @@ if __name__ == "__main__":
                 "stale_capture": True,
                 "captured_unix": captured,
                 "error": "tpu-backend-unavailable-now",
-                "detail": f"{diagnosis}; replaying capture from "
+                # the structured probe verdict REPLACES any replayed one:
+                # it describes THIS run's backend, not the capture's
+                "backend_probe": diagnosis,
+                "detail": f"{diagnosis['kind']}: {diagnosis['detail']}; "
+                          "replaying capture from "
                           f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime(captured))}",
             })
             replay.pop("config", None)
@@ -1665,7 +2086,8 @@ if __name__ == "__main__":
             "vs_baseline": 0.0, "devices": None, "mesh_shape": None,
             "drift": None, "quality": None,
             "error": "tpu-backend-unavailable",
-            "detail": diagnosis,
+            "backend_probe": diagnosis,
+            "detail": f"{diagnosis['kind']}: {diagnosis['detail']}",
         }))
         sys.exit(0)
     trace_json = bool(args.trace) and args.trace.endswith(".json")

@@ -130,6 +130,30 @@ def parse_args(argv=None):
                          "temp+rename writes; replay offline with "
                          "tools/replay.py). NOTE: bundles carry full pod "
                          "specs — handle like an apiserver dump")
+    ap.add_argument("--serve", action="store_true",
+                    help="resident-state serving: keep node tensors "
+                         "device-resident across cycles and ingest "
+                         "O(changed) deltas (serving.engine.ServeEngine) "
+                         "with periodic anti-entropy verification; falls "
+                         "back to full snapshots transparently when the "
+                         "profile surface needs them")
+    ap.add_argument("--resilient", action="store_true",
+                    help="solve watchdog + degraded-mode failover "
+                         "(resilience.watchdog): device solves complete "
+                         "through a deadlined worker thread, retry with "
+                         "seeded-jitter backoff, then fail over to the "
+                         "host sequential parity path and probe for "
+                         "recovery (SPT_SOLVE_TIMEOUT_S tunes the "
+                         "deadline)")
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="with --serve: restore the resident state from "
+                         "PATH at startup (if present; anti-entropy "
+                         "verifies it before trusting it) and write a "
+                         "final crash-safe checkpoint there on shutdown")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="record the cycle tracer for the daemon's "
+                         "lifetime and flush a Perfetto-loadable JSON to "
+                         "OUT.json on shutdown (SIGTERM included)")
     return ap.parse_args(argv)
 
 
@@ -194,7 +218,27 @@ class HealthServer:
                         # scheduler_placement_quality{objective}
                         "quality": outer.last_quality,
                         "feed_address": list(outer.feed.address),
+                        # degraded-mode serving state (resilience.watchdog
+                        # / docs/ROBUSTNESS.md): degraded=True means the
+                        # device backend failed past the watchdog budget
+                        # and cycles serve from the host parity path
+                        "degraded": (
+                            outer.resilience is not None
+                            and outer.resilience.degraded
+                        ),
+                        "degraded_reason": (
+                            outer.resilience.degraded_reason
+                            if outer.resilience is not None else None
+                        ),
+                        "parked_cycles": outer.parked_cycles,
                     }
+                    if outer.engine is not None:
+                        payload["serve"] = {
+                            "generation": outer.engine.generation,
+                            "rebases": outer.engine.rebases,
+                            "antientropy_divergences":
+                                outer.engine.antientropy_divergences,
+                        }
                     if outer.elector is not None:
                         payload["leader"] = outer.elector.is_leader
                         payload["holder"] = outer.elector.observed_holder
@@ -288,6 +332,33 @@ class Daemon:
         self.cluster = Cluster()
         if args.scheduler_name:
             self.cluster.scheduler_names = set(args.scheduler_name)
+        self.engine = None
+        if args.serve:
+            from scheduler_plugins_tpu.serving import ServeEngine
+
+            self.engine = ServeEngine().attach(self.cluster)
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                try:
+                    self.engine.restore_checkpoint(args.checkpoint)
+                    obs.logger.info(
+                        "resident state restored from %s (generation %d; "
+                        "anti-entropy verifies at the first refresh)",
+                        args.checkpoint, self.engine.generation,
+                    )
+                except Exception as exc:
+                    # a bad checkpoint must never block startup: the
+                    # engine just rebuilds from the store (cold path)
+                    obs.logger.warning(
+                        "checkpoint restore failed (%s): rebuilding "
+                        "resident state from the store", exc,
+                    )
+        self.resilience = None
+        if args.resilient:
+            from scheduler_plugins_tpu.resilience import Resilience
+
+            self.resilience = Resilience(engine=self.engine)
+        if args.trace:
+            obs.tracer.start()
         if args.native_store:
             try:
                 self.cluster.attach_native_store()
@@ -319,6 +390,7 @@ class Daemon:
         self.bound_total = 0
         self.last_pending = 0
         self.last_quality = None
+        self.parked_cycles = 0
         self._unposted: dict[str, str] = {}
         self.elector = None  # before HealthServer: /healthz reads it
         self.stop_event = threading.Event()
@@ -428,7 +500,25 @@ class Daemon:
             return None
         now_ms = int(time.time() * 1000)
         cycle_started = time.monotonic()
-        report = self.feed.run_cycle(self.scheduler, now=now_ms)
+        try:
+            report = self.feed.run_cycle(
+                self.scheduler, now=now_ms, serve=self.engine,
+                resilience=self.resilience,
+            )
+        except Exception as exc:
+            from scheduler_plugins_tpu.resilience import BackendUnavailable
+
+            if not isinstance(exc, BackendUnavailable):
+                raise
+            # backend gone AND no host fallback for this profile: park
+            # the cycle (pods stay pending, requeue backoff paces them)
+            # and keep ticking — the probation probe restores the fast
+            # path when the backend answers again
+            obs.logger.warning("cycle parked: %s", exc.reason)
+            self.parked_cycles += 1
+            with self.feed.locked():
+                self.last_pending = len(self.cluster.pending_pods())
+            return None
         obs.metrics.observe_ms(
             "scheduler_cycle", (time.monotonic() - cycle_started) * 1000
         )
@@ -498,6 +588,11 @@ class Daemon:
                 if remaining > 0:
                     self.stop_event.wait(remaining)
         finally:
+            # graceful shutdown (SIGTERM/SIGINT path): every artifact the
+            # process owns is flushed through the crash-safe
+            # `obs.atomic_write` discipline BEFORE the servers come down,
+            # then the exit path returns rc 0 — a drained, checkpointed
+            # daemon is indistinguishable from one that never ran
             if self.args.record and self.args.record_dir:
                 from scheduler_plugins_tpu.utils import flightrec
 
@@ -506,6 +601,21 @@ class Daemon:
                     obs.logger.info("flight recorder bundle: %s", summary)
                 except Exception as exc:
                     obs.logger.warning("flight recorder save failed: %s", exc)
+            if self.args.trace and obs.tracer.enabled:
+                try:
+                    obs.tracer.stop()
+                    obs.tracer.write(self.args.trace)  # atomic_write inside
+                except Exception as exc:
+                    obs.logger.warning("tracer flush failed: %s", exc)
+            if self.engine is not None and self.args.checkpoint:
+                try:
+                    if self.engine.save_checkpoint(self.args.checkpoint):
+                        obs.logger.info(
+                            "resilience checkpoint written: %s",
+                            self.args.checkpoint,
+                        )
+                except Exception as exc:
+                    obs.logger.warning("checkpoint write failed: %s", exc)
             if self.elector is not None:
                 self.elector.release()  # ReleaseOnCancel (idempotent)
             if self.health:
@@ -517,6 +627,10 @@ class Daemon:
                 "daemon_exit": True,
                 "cycles": self.cycles,
                 "bound_total": self.bound_total,
+                "parked_cycles": self.parked_cycles,
+                "degraded": (
+                    self.resilience is not None and self.resilience.degraded
+                ),
             }), flush=True)
 
 

@@ -94,7 +94,7 @@ class NativeStore:
     def __del__(self):  # pragma: no cover - GC path
         try:
             self.close()
-        except Exception:
+        except Exception:  # graft-lint: ignore[GL010] — GC finalizer: nothing to route a close failure to
             pass
 
     def upsert_node(self, node_id: int, alloc: np.ndarray, capacity=None):

@@ -31,6 +31,7 @@ from scheduler_plugins_tpu.framework.runtime import (
     now_ms as _now_ms,
 )
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
+from scheduler_plugins_tpu.resilience import faults
 from scheduler_plugins_tpu.state.cluster import Cluster
 from scheduler_plugins_tpu.utils import flightrec, observability as obs
 
@@ -83,6 +84,13 @@ class CycleReport:
     #: when the cycle ran no solve. Also exported as
     #: `scheduler_placement_quality{objective}` gauges.
     quality: dict | None = None
+    #: which solve served this cycle when a `resilience` state machine is
+    #: attached: "device" (fast path) or "host" (degraded failover /
+    #: probation miss — `resilience.hostsolve`); None without one
+    solve_path: str | None = None
+    #: True when the process was serving from the host parity path at
+    #: the END of this cycle (`scheduler_degraded` gauge's report twin)
+    degraded: bool = False
 
     def explain(self, uid: str, top_k: int = 5) -> dict:
         """The "why this node" score table for one pod of THIS cycle's
@@ -146,7 +154,8 @@ def _attach_explain_ctx(report: CycleReport, ctx: tuple) -> None:
 
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
-              stream_chunk: int | None = None, serve=None) -> CycleReport:
+              stream_chunk: int | None = None, serve=None,
+              resilience=None) -> CycleReport:
     """One daemon cycle. `stream_chunk` opts the solve into the donated,
     double-buffered chunk pipeline (`parallel.pipeline.streamed_profile_solve`)
     when the profile qualifies for the targeted fast path — huge pending
@@ -167,7 +176,15 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     cycle falls back to `cluster.snapshot` transparently. Serve cycles do
     NOT retain an explain context (the resident tensors are donated to
     the next cycle's delta apply — a retained snapshot would read freed
-    buffers); the flight recorder is the postmortem surface there."""
+    buffers); the flight recorder is the postmortem surface there.
+
+    `resilience` (a `resilience.watchdog.Resilience`) routes the solve
+    through the solve watchdog: device dispatch + host-transfer
+    completion fence in a worker thread with a deadline, seeded-jitter
+    retries, failover to the host sequential parity path on an exhausted
+    budget, probation probes while degraded (docs/ROBUSTNESS.md). Raises
+    `resilience.BackendUnavailable` only when the backend is gone AND the
+    profile has no host fallback — callers (the daemon) park the cycle."""
     if now is None:
         now = _now_ms()
     report = CycleReport()
@@ -235,30 +252,46 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         with obs.extension_span(
             "Solve", scheduler.profile.name, pending=len(pending)
         ):
-            if stream_chunk:
-                from scheduler_plugins_tpu.parallel.pipeline import (
-                    streamed_profile_solve,
+            if resilience is not None:
+                # watchdog-guarded: dispatch + completion fence in a
+                # worker thread with a deadline; retries, then failover
+                # to the host parity path (resilience.watchdog)
+                (assignment, admitted, wait, codes_np,
+                 report.solve_path) = resilience.solve_cycle(
+                    scheduler, snap, stream_chunk=stream_chunk
                 )
+                result = SolveResultView(
+                    assignment, admitted, wait, failed_plugin=codes_np
+                )
+            else:
+                if stream_chunk:
+                    from scheduler_plugins_tpu.parallel.pipeline import (
+                        streamed_profile_solve,
+                    )
 
-                streamed = streamed_profile_solve(
-                    scheduler, snap, chunk=stream_chunk
-                )
-                if streamed is not None:
-                    result = SolveResultView(*streamed)
-            if result is None:
-                result = scheduler.solve(snap)
-            # host transfers force completion (block_until_ready can
-            # return early through the tunneled backend — CLAUDE.md), so
-            # the Solve span/histogram covers the full device round-trip
-            assignment = np.asarray(result.assignment)
-            admitted = np.asarray(result.admitted)
-            wait = np.asarray(result.wait)
+                    streamed = streamed_profile_solve(
+                        scheduler, snap, chunk=stream_chunk
+                    )
+                    if streamed is not None:
+                        result = SolveResultView(*streamed)
+                if result is None:
+                    result = scheduler.solve(snap)
+                # host transfers force completion (block_until_ready can
+                # return early through the tunneled backend — CLAUDE.md),
+                # so the Solve span/histogram covers the device round-trip
+                assignment = np.asarray(result.assignment)
+                admitted = np.asarray(result.admitted)
+                wait = np.asarray(result.wait)
+        report.degraded = resilience is not None and resilience.degraded
         if rec is not None:
             with obs.tracer.span("Record", tid="cycle"):
                 codes = getattr(result, "failed_plugin", None)
                 rec.capture_outputs(
+                    # the host failover path carries the sequential parity
+                    # semantics (and per-pod codes), so its records replay
+                    # through the same path as device-sequential ones
                     "sequential" if isinstance(result, SolveResult)
-                    else "streamed",
+                    or codes is not None else "streamed",
                     assignment, admitted, wait,
                     failed_plugin=(
                         None if codes is None else np.asarray(codes)
@@ -329,6 +362,16 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
             obs.SERVE_DECISION_LATENCY,
             (time.perf_counter() - serve_t0) * 1000.0,
         )
+
+    if faults.ACTIVE is not None:
+        # chaos harness only (zero overhead otherwise): simulate process
+        # death AFTER bindings landed in the store — the worst-ordered
+        # crash for resident serve state, since the dying sink's
+        # undrained deltas are lost with the process. The report rides
+        # the exception so the harness can account the real, landed binds
+        spec = faults.ACTIVE.fire(faults.CRASH_POST_BIND)
+        if spec is not None:
+            raise faults.CrashInjected(report)
 
     _attribute_failures(scheduler, snap, result, failed_idx, report)
 
@@ -432,12 +475,25 @@ def _requeue_eligible(scheduler, cluster, pending, now, report):
     - it holds a live nomination (upstream nominated pods stay active),
     - its flush deadline passed (podMaxInUnschedulablePodsDuration), or
     - a gang sibling is eligible (upstream ActivateSiblings moves the whole
-      group together).
+      group together),
+
+    AND its requeue backoff window has expired: every re-queue pays the
+    seeded deterministic jittered exponential backoff
+    `Cluster.mark_unschedulable` computed at its last failure — upstream
+    backoffQ semantics, where an event moves a pod from the
+    unschedulable pool to the backoff queue but it pops into the active
+    queue only once its per-pod backoff completes, so a
+    permanently-unschedulable pod cannot hot-loop the queue. Nominated
+    pods bypass the backoff like they bypass the event gate (they hold
+    capacity; delaying their retry delays everyone behind them).
 
     Pods never marked unschedulable (new arrivals, retried reservations)
     always run. Reference: EventsToRegister registrations, e.g.
     coscheduling.go:113-122, capacity_scheduling.go:194-203,
-    noderesourcetopology plugin.go:141-151."""
+    noderesourcetopology plugin.go:141-151; backoff:
+    k8s.io/kubernetes pkg/scheduler/internal/queue/scheduling_queue.go
+    (calculateBackoffDuration — the framework queue every reference
+    plugin registers into)."""
     from scheduler_plugins_tpu.framework.plugin import BUILTIN_EVENTS
 
     if not cluster.unschedulable_since:
@@ -453,6 +509,9 @@ def _requeue_eligible(scheduler, cluster, pending, now, report):
         seq, flush_at = rec
         if pod.nominated_node_name is not None:
             return True
+        if now < cluster.pod_backoff_until_ms.get(pod.uid, 0):
+            obs.metrics.inc(obs.REQUEUE_BACKOFF_SKIPS)
+            return False
         if now >= flush_at:
             return True
         return any(

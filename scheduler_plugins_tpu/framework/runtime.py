@@ -64,6 +64,33 @@ class SolveResult:
     failed_plugin: Optional[jnp.ndarray] = None
 
 
+def solve_output_anomaly(assignment, admitted, wait, n_nodes: int):
+    """Reason string when solve outputs violate the framework contract,
+    else None — integer (P,) assignment in [-1, n_nodes), matching-shape
+    admitted/wait, no NaNs. THE one statement of the output contract:
+    the resilience watchdog (`resilience.watchdog`) runs it after every
+    device solve's completion fence to classify garbage output (a
+    desynced tunnel answers with plausible-length junk) as a backend
+    fault rather than committing it."""
+    import numpy as np
+
+    a = np.asarray(assignment)
+    if a.ndim != 1 or not np.issubdtype(a.dtype, np.integer):
+        return f"assignment dtype/rank {a.dtype}/{a.ndim}"
+    if a.size and (int(a.min()) < -1 or int(a.max()) >= n_nodes):
+        return (
+            f"assignment out of range [{int(a.min())}, {int(a.max())}] "
+            f"vs {n_nodes} nodes"
+        )
+    for name, arr in (("admitted", admitted), ("wait", wait)):
+        x = np.asarray(arr)
+        if x.shape != a.shape:
+            return f"{name} shape {x.shape} != assignment {a.shape}"
+        if np.issubdtype(x.dtype, np.floating) and np.isnan(x).any():
+            return f"NaN in {name}"
+    return None
+
+
 def _admit_with_attribution(plugins, state, snap, p, ok0):
     """PreFilter sweep with attribution: (ok, admit_code) where
     `admit_code` is the FIRST plugin (profile order) whose verdict flipped

@@ -204,7 +204,7 @@ def donated_chunk_solver(fn, carry_argnum: int):
 
 
 def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
-                       clock=None):
+                       clock=None, fetch_deadline_s=None):
     """Stream `chunk_inputs` through `solve_chunk`, double-buffered.
 
     - ``solve_chunk(*invariant_args, *chunk_dev, carry) -> (result, carry)``
@@ -215,6 +215,13 @@ def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
     - ``carry``: the threaded state (free capacity); returned updated.
     - ``clock``: optional ``time.perf_counter``-like callable for the
       completion stamps (injectable for tests).
+    - ``fetch_deadline_s``: optional per-chunk deadline on the D2H
+      completion fences (`jax.device_get` is the only point this loop
+      blocks on the device, so it is where a hung backend strands the
+      host): each fetch runs through
+      `resilience.watchdog.call_with_deadline` and raises
+      `BackendUnavailable` on timeout instead of hanging the cycle loop
+      forever. None (the default) keeps the direct call.
 
     Returns ``(results, carry, done_s, timeline)`` where ``results[k]`` is
     chunk k's `result` pytree fetched to host and ``done_s[k]`` its
@@ -229,6 +236,19 @@ def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
     rows per buffer automatically.
     """
     clock = clock or time.perf_counter
+    if fetch_deadline_s is None:
+        fetch = jax.device_get
+    else:
+        from scheduler_plugins_tpu.resilience.watchdog import (
+            call_with_deadline,
+        )
+
+        def fetch(x):
+            return call_with_deadline(
+                lambda: jax.device_get(x), fetch_deadline_s,
+                label="pipeline-d2h",
+            )
+
     n = len(chunk_inputs)
     results, done_s = [], []
     timeline = PipelineTimeline(n_chunks=n)
@@ -252,14 +272,14 @@ def run_chunk_pipeline(solve_chunk, invariant_args, chunk_inputs, carry,
         if pending is not None:
             # D2H for chunk k-1: blocks only until ITS solve finished
             t0 = clock()
-            results.append(jax.device_get(pending))
+            results.append(fetch(pending))
             t1 = clock()
             timeline.add("d2h", k - 1, t0, t1)
             done_s.append(t1 - start)
         pending = result
     if pending is not None:
         t0 = clock()
-        results.append(jax.device_get(pending))
+        results.append(fetch(pending))
         t1 = clock()
         timeline.add("d2h", n - 1, t0, t1)
         done_s.append(t1 - start)
@@ -284,7 +304,8 @@ def _targeted_fast_gate(scheduler):
 
 
 def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
-                           max_waves: int = 8, rescue_window: int = 256):
+                           max_waves: int = 8, rescue_window: int = 256,
+                           fetch_deadline_s=None):
     """Chunked, double-buffered variant of the targeted fast-path solve:
     admission and the static node ranking are computed once, then pod
     chunks stream through the donated targeted waterfill with free capacity
@@ -344,7 +365,8 @@ def streamed_profile_solve(scheduler, snap, chunk: int = 4096,
         for lo in range(0, P, chunk)
     ]
     parts, free, _, _ = run_chunk_pipeline(
-        cache[ckey], (raw,), chunk_inputs, free0
+        cache[ckey], (raw,), chunk_inputs, free0,
+        fetch_deadline_s=fetch_deadline_s,
     )
     assignment = jnp.concatenate([jnp.asarray(a) for a in parts])
     assignment, wait = finalize_assignment(assignment, snap)

@@ -41,6 +41,7 @@ from __future__ import annotations
 import numpy as np
 
 from scheduler_plugins_tpu.api.resources import PODS, ResourceIndex
+from scheduler_plugins_tpu.resilience import faults as _faults
 from scheduler_plugins_tpu.state.snapshot import NodeState, nonzero_request
 from scheduler_plugins_tpu.utils.intmath import bucket_size
 
@@ -132,6 +133,18 @@ class DeltaSink:
         self.nominated_unbound: set[str] = set()
 
     def _push(self, ev: tuple) -> None:
+        if _faults.ACTIVE is not None:
+            # chaos harness only (zero overhead when no plan is
+            # installed): drop/duplicate/corrupt THIS sink event — the
+            # Cluster store never sees the mutation, so the poisoning is
+            # invisible to everything except the serving engine's
+            # anti-entropy digest (docs/ROBUSTNESS.md)
+            for mutated in _faults.mutate_delta(ev):
+                self._push_one(mutated)
+            return
+        self._push_one(ev)
+
+    def _push_one(self, ev: tuple) -> None:
         if len(self.events) >= self.MAX_EVENTS:
             self.events.clear()
             self.overflowed = True
@@ -355,23 +368,37 @@ def apply_node_deltas(nodes: NodeState,
     )
 
 
+#: process-wide memo keyed by sanitize mode: every `ServeEngine` (and a
+#: chaos-harness crash restart, which builds a fresh one mid-run) shares
+#: ONE jitted apply program per mode, so engine reconstruction never pays
+#: a recompile for an already-warm shape
+_APPLY_PROGRAMS: dict = {}
+
+
 def delta_apply_program():
     """The jitted apply program with the resident carry DONATED — the
     serving engine's calling convention (rebind the carry from the
     result; GL006). One constructor shared by `ServeEngine` and the AOT
     compile-readiness gate (`tools/tpu_lower.py` serving_delta_apply) so
-    the certified program is the shipped program. Under `SPT_SANITIZE=1`
-    the program is built checkify-instrumented with donation dropped,
-    like every other donated jit in the repo."""
+    the certified program is the shipped program, memoized process-wide
+    per sanitize mode. Under `SPT_SANITIZE=1` the program is built
+    checkify-instrumented with donation dropped, like every other
+    donated jit in the repo."""
     import jax
 
     from scheduler_plugins_tpu.utils import observability as obs
     from scheduler_plugins_tpu.utils import sanitize
 
-    if sanitize.enabled():
+    key = sanitize.enabled()
+    if key in _APPLY_PROGRAMS:
+        return _APPLY_PROGRAMS[key]
+    if key:
         jitted = sanitize.checkified(
             apply_node_deltas, program="serve_delta_apply"
         )
     else:
         jitted = jax.jit(apply_node_deltas, donate_argnums=(0,))
-    return obs.compile_watch(jitted, program="serve_delta_apply")
+    _APPLY_PROGRAMS[key] = obs.compile_watch(
+        jitted, program="serve_delta_apply"
+    )
+    return _APPLY_PROGRAMS[key]

@@ -77,6 +77,28 @@ class ServeEngine:
         self._base_digest: Optional[str] = None
         #: last refresh's packed batch + mode, for the flight recorder
         self._last: Optional[dict] = None
+        # -- anti-entropy (docs/ROBUSTNESS.md) --------------------------
+        #: digest the resident columns against a freshly built snapshot
+        #: every N serving refreshes (0 = periodic checks off); any
+        #: divergence forces a rebase, so a corrupted/dropped delta can
+        #: poison at most one verification window. SPT_SERVE_VERIFY_EVERY
+        #: overrides.
+        self.verify_every = self._verify_every_default()
+        self._refreshes = 0
+        #: force a verify at the next refresh (set by `note_fault` — any
+        #: watchdog/backend fault is treated as potential corruption)
+        self._verify_pending = False
+        self.antientropy_divergences = 0
+        self.last_fault: Optional[str] = None
+
+    @staticmethod
+    def _verify_every_default() -> int:
+        import os
+
+        try:
+            return int(os.environ.get("SPT_SERVE_VERIFY_EVERY", "32"))
+        except ValueError:
+            return 32
 
     # -- wiring ---------------------------------------------------------
     def attach(self, cluster) -> "ServeEngine":
@@ -207,6 +229,13 @@ class ServeEngine:
         if grow:
             self._grow(bucket_size(n_nodes))
         self._apply_batch(upserts, usage)
+        self._refreshes += 1
+        if self._verify_pending or (
+            self.verify_every and self._refreshes % self.verify_every == 0
+        ):
+            divergence = self.verify(cluster)
+            if divergence is not None:
+                return self._rebase(cluster, pending, now_ms)
         return self._assemble(cluster, pending)
 
     # -- event classification -------------------------------------------
@@ -430,6 +459,168 @@ class ServeEngine:
         self._last = {"mode": "rebase", "events": 0}
         self._observe()
         return snap, meta
+
+    # -- anti-entropy ----------------------------------------------------
+    def note_fault(self, reason: Optional[str] = None) -> None:
+        """Treat any runtime fault (watchdog timeout/device error/garbage
+        output, crash restore) as potential resident-state corruption:
+        the NEXT refresh digests the resident columns against a freshly
+        built snapshot before serving from them."""
+        self._verify_pending = True
+        self.last_fault = reason
+
+    def verify(self, cluster) -> Optional[str]:
+        """Anti-entropy digest: blake2b over the canonical tensor bytes
+        of the resident node columns (the flight-recorder content-address
+        scheme) vs the same columns of a freshly built snapshot. Returns
+        a divergence reason (caller re-bases) or None (resident state is
+        byte-exact). O(cluster) host work — cadenced by `verify_every`,
+        forced by `note_fault`; a corrupted or dropped delta can
+        therefore poison at most one verification window
+        (tests/test_resilience.py::TestAntiEntropy)."""
+        from scheduler_plugins_tpu.utils import flightrec
+
+        with obs.tracer.span(
+            "ServeRefresh/verify", tid="serve", staleness=self._staleness
+        ):
+            self._verify_pending = False
+            obs.metrics.inc(obs.ANTIENTROPY_CHECKS)
+            if self._nodes is None:
+                return None
+            fresh, meta = cluster.snapshot(
+                [], now_ms=0, pad_nodes=self._npad
+            )
+            reason = None
+            if len(meta.index) != len(D.CANON_INDEX):
+                reason = "axis-width"
+            elif list(meta.node_names) != self._names:
+                reason = "row-order"
+            else:
+                mine = flightrec._pack_digest(
+                    {k: np.asarray(v)
+                     for k, v in self._node_columns().items()}
+                )
+                theirs = flightrec._pack_digest(
+                    {k: np.asarray(getattr(fresh.nodes, k))
+                     for k in self._node_columns()}
+                )
+                if mine != theirs:
+                    reason = "column-digest"
+            if reason is not None:
+                self.antientropy_divergences += 1
+                obs.metrics.inc(obs.ANTIENTROPY_DIVERGENCE)
+                obs.logger.warning(
+                    "serve anti-entropy divergence (%s) after %d delta "
+                    "events%s: re-basing", reason, self._staleness,
+                    f" (last fault: {self.last_fault})"
+                    if self.last_fault else "",
+                )
+            return reason
+
+    # -- checkpoint / restore -------------------------------------------
+    #: checkpoint format version (bump on layout change; restore refuses
+    #: versions it does not understand)
+    CHECKPOINT_VERSION = 1
+
+    def checkpoint_bytes(self) -> Optional[bytes]:
+        """Self-contained npz of the resident columns + slot/interning
+        tables, or None before the first refresh. Written crash-safe by
+        `save_checkpoint`; a process killed after writing one resumes
+        serving via `restore_checkpoint` without rebuilding the resident
+        base from the store."""
+        import io
+        import json as _json
+
+        if self._nodes is None:
+            return None
+        cols = {k: np.asarray(v) for k, v in self._node_columns().items()}
+        cols["nominated"] = np.asarray(self._nodes.nominated)
+        header = {
+            "version": self.CHECKPOINT_VERSION,
+            "npad": self._npad,
+            "generation": self._generation,
+            "staleness": self._staleness,
+            "names": self._names,
+            "regions": self._regions,
+            "zones": self._zones,
+            "node_labels": {k: list(v) for k, v in
+                            self._node_labels.items()},
+            "tainted": sorted(self._tainted),
+        }
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            header=np.frombuffer(
+                _json.dumps(header, sort_keys=True).encode(), np.uint8
+            ),
+            **cols,
+        )
+        return buf.getvalue()
+
+    def save_checkpoint(self, path: str) -> bool:
+        """Crash-safe checkpoint write (`obs.atomic_write` temp+rename).
+        Returns False when there is no resident base to checkpoint."""
+        data = self.checkpoint_bytes()
+        if data is None:
+            return False
+        obs.atomic_write(path, data)
+        return True
+
+    def restore_checkpoint(self, source) -> bool:
+        """Rebuild the resident base from a checkpoint (`bytes` or a file
+        path) — call AFTER `attach`. The restored state is NOT trusted
+        blindly: `note_fault` marks it for an anti-entropy verify at the
+        next refresh, so a checkpoint stale against the live store (the
+        usual case after a crash — the dying sink's undrained deltas are
+        gone) re-bases within one window, while an exact one resumes
+        serving with generation continuity and no rebase
+        (tests/test_resilience.py::TestCheckpointRestore)."""
+        import io
+        import json as _json
+
+        import jax.numpy as jnp
+
+        if isinstance(source, (str, bytes, bytearray)):
+            data = source
+            if isinstance(source, str):
+                with open(source, "rb") as f:
+                    data = f.read()
+        else:
+            raise TypeError(f"checkpoint source {type(source).__name__}")
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            header = _json.loads(bytes(z["header"].tobytes()).decode())
+            if header.get("version") != self.CHECKPOINT_VERSION:
+                raise ValueError(
+                    f"checkpoint version {header.get('version')} != "
+                    f"{self.CHECKPOINT_VERSION}"
+                )
+            from scheduler_plugins_tpu.state.snapshot import NodeState
+
+            self._nodes = NodeState(
+                **{k: jnp.asarray(z[k]) for k in (
+                    "alloc", "capacity", "requested", "nonzero_requested",
+                    "limits", "mask", "region", "zone", "pod_count",
+                    "terminating", "nominated",
+                )}
+            )
+        self._npad = int(header["npad"])
+        self._generation = int(header["generation"])
+        self._staleness = int(header["staleness"])
+        self._names = list(header["names"])
+        self._slots = {n: i for i, n in enumerate(self._names)}
+        self._regions = list(header["regions"])
+        self._zones = list(header["zones"])
+        self._regions_in = _Interner(self._regions)
+        self._zones_in = _Interner(self._zones)
+        self._node_labels = {
+            k: tuple(v) for k, v in header["node_labels"].items()
+        }
+        self._tainted = set(header["tainted"])
+        self._base_digest = None
+        self._last = None
+        self.note_fault("checkpoint-restore")
+        self._observe()
+        return True
 
     def _node_columns(self) -> dict:
         n = self._nodes

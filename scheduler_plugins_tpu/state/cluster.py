@@ -89,6 +89,23 @@ class Cluster:
     unschedulable_since: dict[str, tuple[int, int]] = field(
         default_factory=dict
     )
+    # requeue backoff (upstream backoffQ: k8s.io/kubernetes
+    # pkg/scheduler/internal/queue/scheduling_queue.go
+    # calculateBackoffDuration — podInitialBackoffDuration 1s doubling to
+    # podMaxBackoffDuration 10s per scheduling attempt): per-pod attempt
+    # counts and the wall-clock ms before which `_requeue_eligible` must
+    # not re-admit the pod. The jitter multiplier is DETERMINISTIC
+    # (blake2b of seed/uid/attempt, in [0.5, 1.0]) so colliding retries
+    # spread out while a seeded run replays exactly.
+    backoff_initial_ms: int = 1000
+    backoff_max_ms: int = 10_000
+    backoff_seed: int = 0
+    pod_attempts: dict[str, int] = field(default_factory=dict)
+    pod_backoff_until_ms: dict[str, int] = field(default_factory=dict)
+    #: last failure stamp per pod — one cycle can mark the same pod twice
+    #: (bind-loop failure + whole-gang rejection); only the first marks
+    #: an ATTEMPT
+    _pod_last_failure_ms: dict[str, int] = field(default_factory=dict)
     #: optional `serving.deltas.DeltaSink`: when set (ServeEngine.attach),
     #: the mutators below push typed node-column delta events alongside
     #: their `note_event` calls — the O(changed) feed the resident-state
@@ -102,10 +119,42 @@ class Cluster:
         self.event_last[kind] = self.event_seq
 
     def mark_unschedulable(self, uid: str, now_ms: int) -> None:
+        """Park a pod and charge one backoff attempt: duration =
+        min(initial * 2^(attempts-1), max) scaled by the deterministic
+        jitter in [0.5, 1.0] (upstream calculateBackoffDuration shape —
+        see the field comment above for the citation). A successful bind
+        or a pod delete clears the attempt count."""
+        if self._pod_last_failure_ms.get(uid) != now_ms:
+            self._pod_last_failure_ms[uid] = now_ms
+            attempts = self.pod_attempts.get(uid, 0) + 1
+            self.pod_attempts[uid] = attempts
+            base = min(
+                self.backoff_initial_ms * (1 << min(attempts - 1, 30)),
+                self.backoff_max_ms,
+            )
+            self.pod_backoff_until_ms[uid] = now_ms + int(
+                base * (0.5 + 0.5 * self._backoff_jitter(uid, attempts))
+            )
         self.unschedulable_since[uid] = (
             self.event_seq,
             now_ms + self.requeue_flush_ms,
         )
+
+    def _backoff_jitter(self, uid: str, attempt: int) -> float:
+        """[0, 1) from blake2b(seed:uid:attempt) — stable across runs
+        and processes (Python's hash() is salted; an rng stream would
+        depend on failure ORDER, which serve/baseline arms must not)."""
+        import hashlib
+
+        h = hashlib.blake2b(
+            f"{self.backoff_seed}:{uid}:{attempt}".encode(), digest_size=8
+        ).digest()
+        return int.from_bytes(h, "big") / 2.0 ** 64
+
+    def _clear_backoff(self, uid: str) -> None:
+        self.pod_attempts.pop(uid, None)
+        self.pod_backoff_until_ms.pop(uid, None)
+        self._pod_last_failure_ms.pop(uid, None)
 
     # -- native mirror ----------------------------------------------------
     def attach_native_store(self):
@@ -289,6 +338,7 @@ class Cluster:
         self.release_reservation(uid)  # notifies the NRT cache too
         self._selector_spec_pods.discard(uid)
         self.unschedulable_since.pop(uid, None)
+        self._clear_backoff(uid)
         pod = self.pods.pop(uid, None)
         if pod is not None:
             self.note_event(ev.POD_DELETE)
@@ -458,6 +508,7 @@ class Cluster:
         held = self.reserved.pop(uid, None)
         self.pod_deadline_ms.pop(uid, None)
         self.unschedulable_since.pop(uid, None)
+        self._clear_backoff(uid)
         self.note_event(ev.POD_UPDATE)  # assigned: spec.nodeName set
         if self.delta_sink is not None:
             if held != node_name:

@@ -118,8 +118,8 @@ class AsyncLoadWatcherCollector:
         def fetch():
             try:
                 self.latest = self.collector.fetch()
-            except Exception:
-                pass  # keep previous metrics (reference cache behavior)
+            except Exception:  # graft-lint: ignore[GL010] — reference cache behavior: a failed fetch keeps the previous metrics window
+                pass
 
         self.thread = threading.Thread(target=fetch, daemon=True)
         self.thread.start()

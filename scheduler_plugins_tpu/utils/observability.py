@@ -275,6 +275,31 @@ SERVE_REBASES = "scheduler_serve_rebases_total"
 #: gang_wait_frac, unplaced_frac, preemptions, nominations), stamped by
 #: `framework.cycle.run_cycle` on every solved cycle
 PLACEMENT_QUALITY = "scheduler_placement_quality"
+#: gauge: 1 while the process serves from the host-side parity solve
+#: because the device backend failed past the watchdog's retry budget
+#: (resilience.watchdog.Resilience); 0 on the fast path. Also surfaced
+#: as `degraded` on the daemon's /healthz and every chaos bench line
+DEGRADED = "scheduler_degraded"
+#: watchdog retry attempts that failed (labels: label=solve|probe) —
+#: each is one timeout/device-error/garbage-output before backoff
+SOLVE_RETRIES = "scheduler_solve_retries_total"
+#: fast-path -> degraded transitions (retry budget exhausted)
+SOLVE_FAILOVERS = "scheduler_solve_failovers_total"
+#: probation probes dispatched while degraded (successful ones restore
+#: the fast path; `scheduler_degraded` returning to 0 is the signal)
+PROBATION_PROBES = "scheduler_probation_probes_total"
+#: watchdog workers orphaned inside a hung backend call (they cannot be
+#: interrupted, only abandoned — a flapping backend shows up here)
+SOLVE_WORKERS_ABANDONED = "scheduler_solve_workers_abandoned_total"
+#: anti-entropy digest checks of the resident serve state vs a freshly
+#: built snapshot (serving.engine.ServeEngine.verify)
+ANTIENTROPY_CHECKS = "scheduler_serve_antientropy_checks_total"
+#: anti-entropy divergences detected (each forces a rebase — a corrupted
+#: or dropped delta can poison at most one verification window)
+ANTIENTROPY_DIVERGENCE = "scheduler_serve_antientropy_divergence_total"
+#: unschedulable pods currently parked in a requeue backoff window
+#: (upstream backoffQ semantics; framework.cycle._requeue_eligible)
+REQUEUE_BACKOFF_SKIPS = "scheduler_requeue_backoff_skips_total"
 
 
 # ---------------------------------------------------------------------------
@@ -321,7 +346,7 @@ class CompileWatch:
             from jax import monitoring as _monitoring
 
             _monitoring.register_event_duration_secs_listener(self._on_event)
-        except Exception:  # jax absent/too old: misses still count, no ms
+        except Exception:  # graft-lint: ignore[GL010] — optional-dep probe: jax absent/too old, misses still count without ms
             pass
 
     def _on_event(self, event, duration, **_kw) -> None:

@@ -1,0 +1,41 @@
+"""Golden-bad fixture for GL010: broad exception handlers that swallow
+faults around solve/ingest sites. The narrow handler and the
+record-and-reroute handler must stay clean."""
+
+
+def solve_cycle(scheduler, snap):
+    try:
+        return scheduler.solve(snap)
+    except Exception:  # BAD: the backend fault vanishes silently
+        pass
+
+
+def ingest_deltas(engine, events):
+    try:
+        engine.apply(events)
+    except BaseException:  # BAD: BaseException swallow, body is only ...
+        ...
+
+
+def drain_sink(sink):
+    try:
+        return sink.drain()
+    except (ValueError, Exception):  # BAD: tuple smuggles the broad catch
+        pass
+
+
+def narrow_is_fine(path):
+    try:
+        import os
+
+        os.unlink(path)
+    except OSError:  # fine: a specific, expected failure
+        pass
+
+
+def record_and_reroute_is_fine(scheduler, snap, fallback):
+    try:
+        return scheduler.solve(snap)
+    except Exception as exc:  # fine: recorded and re-routed
+        print("solve failed, failing over:", exc)
+        return fallback(snap)

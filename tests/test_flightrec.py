@@ -385,6 +385,90 @@ class TestAtomicWrites:
         data = json.loads(out.read_text())  # parses => not truncated
         assert len(data["traceEvents"]) > 20000
 
+    def test_sigterm_daemon_flushes_ring_tracer_and_checkpoint(
+        self, tmp_path
+    ):
+        """Graceful shutdown (the SIGKILL test's counterpart): SIGTERM to
+        a live daemon must flush the flight-recorder ring, the tracer,
+        and a final resilience checkpoint — all through `obs.atomic_write`
+        — and exit 0. The daemon runs feed-driven with a served cycle so
+        every artifact has real content."""
+        from scheduler_plugins_tpu.bridge.feed import FeedClient
+
+        repo = str(Path(__file__).parent.parent)
+        profile = tmp_path / "profile.yaml"
+        profile.write_text("plugins:\n  - NodeResourcesAllocatable\n")
+        record_dir = tmp_path / "bundle"
+        trace_out = tmp_path / "trace.json"
+        ckpt = tmp_path / "resident.ckpt"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "scheduler_plugins_tpu",
+             "--profile", str(profile),
+             "--record", "4", "--record-dir", str(record_dir),
+             "--trace", str(trace_out),
+             "--serve", "--resilient", "--checkpoint", str(ckpt),
+             "--cycle-interval-s", "0.05", "--health-port", "-1"],
+            cwd=repo, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            ready = proc.stdout.readline()
+            assert ready.startswith("daemon ready "), ready
+            status = json.loads(ready[len("daemon ready "):])
+            host, port = status["feed"].split(":")
+            client = FeedClient(host, int(port))
+            assert client.send({
+                "op": "upsert_node", "name": "n0",
+                "allocatable": {CPU: 8000, MEMORY: 32 * gib, PODS: 110},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_pod", "name": "web", "namespace": "team",
+                "requests": {CPU: 500, MEMORY: gib},
+            })["ok"]
+            # wait until a cycle actually bound the pod (ring/engine
+            # non-empty), then SIGTERM mid-flight
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.send({"op": "sync"})["pending"] == 0:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("daemon never scheduled the pod")
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == 0, err
+        exit_line = json.loads(out.strip().splitlines()[-1])
+        assert exit_line["daemon_exit"] and exit_line["bound_total"] >= 1
+        assert not exit_line["degraded"]
+        # flight-recorder ring flushed as a loadable bundle
+        manifest = record_dir / "cycles.jsonl"
+        assert manifest.exists()
+        from scheduler_plugins_tpu.utils import flightrec
+
+        cycles = flightrec.load_bundle(str(record_dir))
+        assert cycles and any(
+            c.manifest.get("serve") or c.manifest.get("outputs")
+            for c in cycles
+        )
+        # tracer flushed as parseable Perfetto JSON
+        trace = json.loads(trace_out.read_text())
+        assert isinstance(trace["traceEvents"], list)
+        assert trace["traceEvents"]
+        # final resilience checkpoint written and restorable
+        assert ckpt.exists()
+        from scheduler_plugins_tpu.serving import ServeEngine
+
+        restored = ServeEngine()
+        assert restored.restore_checkpoint(str(ckpt))
+        assert "n0" in restored._names
+        # no stray temp files from any of the three writers
+        assert not list(tmp_path.rglob("*.tmp.*"))
+
     def test_bundle_save_is_crash_safe_order(self, tmp_path, recorder_off,
                                              monkeypatch):
         """Blobs land before the manifest: a save that dies mid-blobs

@@ -29,10 +29,22 @@ class TestGoldenBad:
             ("bad_config_update.py", "GL007"),
             ("bad_jit_walltime.py", "GL008"),
             ("bad_all_gather.py", "GL009"),
+            ("bad_swallow.py", "GL010"),
         ],
     )
     def test_flagged(self, fixture, rule):
         assert rule in rules_for(FIXTURES / fixture)
+
+    def test_swallow_fixture_flags_only_broad_swallows(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_swallow.py"])
+            if f.rule == "GL010"
+        ]
+        # bare Exception pass, BaseException ..., and the tuple that
+        # smuggles Exception — the narrow OSError handler and the
+        # record-and-reroute handler must stay clean
+        assert len(findings) == 3
+        assert rules_for(FIXTURES / "bad_swallow.py") == {"GL010"}
 
     def test_all_gather_fixture_flags_only_node_axis_sites(self):
         findings = [

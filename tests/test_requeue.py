@@ -113,6 +113,93 @@ class TestEventGating:
         assert "default/p" in r.skipped
 
 
+class TestRequeueBackoff:
+    """Seeded deterministic jittered exponential backoff on re-queued
+    pods (upstream backoffQ: k8s.io/kubernetes pkg/scheduler/internal/
+    queue/scheduling_queue.go calculateBackoffDuration — initial 1s
+    doubling per attempt, capped at 10s). The jitter multiplier lives in
+    [0.5, 1.0] and is blake2b(seed:uid:attempt)-derived, so a seeded run
+    replays exactly."""
+
+    def test_backoff_window_decision_table(self):
+        c = Cluster()
+        uid = "default/p"
+        for attempt, base in [(1, 1000), (2, 2000), (3, 4000), (4, 8000),
+                              (5, 10_000), (6, 10_000)]:
+            c.mark_unschedulable(uid, now_ms=attempt * 100_000)
+            dur = c.pod_backoff_until_ms[uid] - attempt * 100_000
+            assert c.pod_attempts[uid] == attempt
+            # jitter in [0.5, 1.0] x base, exponential then capped at max
+            assert base // 2 <= dur <= base, (attempt, dur)
+
+    def test_backoff_deterministic_across_runs(self):
+        runs = []
+        for _ in range(2):
+            c = Cluster()
+            for attempt in range(1, 5):
+                c.mark_unschedulable("default/p", now_ms=attempt * 100_000)
+                runs.append(c.pod_backoff_until_ms["default/p"])
+        assert runs[:4] == runs[4:]
+
+    def test_same_cycle_double_mark_charges_one_attempt(self):
+        # a gang member can be marked twice in one cycle (bind-loop
+        # failure + whole-gang rejection) — one failure, one attempt
+        c = Cluster()
+        c.mark_unschedulable("default/p", now_ms=1000)
+        c.mark_unschedulable("default/p", now_ms=1000)
+        assert c.pod_attempts["default/p"] == 1
+
+    def test_bind_clears_backoff(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        assert c.pod_attempts["default/p"] == 1
+        c.remove_pod("default/resident")
+        run_cycle(s, c, now=2500)  # backoff (<= 1000ms) expired: binds
+        assert c.pods["default/p"].node_name == "n0"
+        assert "default/p" not in c.pod_attempts
+        assert "default/p" not in c.pod_backoff_until_ms
+
+    def test_event_does_not_bypass_backoff_window(self):
+        """Upstream semantics: an event moves an unschedulable pod to
+        the BACKOFF queue; it pops into the active queue only when its
+        per-pod backoff completes — so a permanently-unschedulable pod
+        cannot hot-loop the queue on a busy event stream."""
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)  # attempt 1: backoff in [1500, 2000]
+        c.remove_pod("default/resident")  # Pod/Delete event fires NOW
+        r = run_cycle(s, c, now=1100)  # event seen, but inside backoff
+        assert r.skipped == ["default/p"]
+        assert not r.bound
+        r = run_cycle(s, c, now=2100)  # window expired: the event admits
+        assert r.bound["default/p"] == "n0"
+
+    def test_hot_loop_is_paced_exponentially(self):
+        """A pod that can never schedule, retried under a busy event
+        stream, runs O(log) cycles, not every cycle."""
+        c = full_cluster()
+        s = sched()
+        attempts_log = []
+        for k in range(12):
+            now = 1000 + k * 1000
+            c.add_node(mknode(f"tiny-{k}", cpu=100))  # event every cycle
+            run_cycle(s, c, now=now)
+            attempts_log.append(c.pod_attempts.get("default/p", 0))
+        # 12 evented cycles, far fewer actual attempts (1s, 2s, 4s, 8s
+        # windows absorb the rest)
+        assert attempts_log[-1] <= 5
+        assert attempts_log[-1] >= 2  # but it IS still retrying
+
+    def test_nominated_pod_bypasses_backoff(self):
+        c = full_cluster()
+        s = sched()
+        run_cycle(s, c, now=1000)
+        c.pods["default/p"].nominated_node_name = "n0"
+        r = run_cycle(s, c, now=1100)  # inside the backoff window
+        assert "default/p" not in r.skipped
+
+
 class TestGangActivation:
     def test_new_sibling_requeues_whole_gang(self):
         c = Cluster()

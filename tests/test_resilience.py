@@ -1,0 +1,475 @@
+"""Resilience layer: watchdog failover, host-solve parity, fault plans,
+anti-entropy recovery, checkpoint/restore (docs/ROBUSTNESS.md).
+
+The load-bearing invariant everywhere: faults cost LATENCY and REBASES,
+never placements — the host failover solve is bit-identical to the
+sequential parity path on the supported profile surface, and a poisoned
+resident column survives at most one anti-entropy verification window.
+
+Shapes are deliberately tiny and shared (6-node cluster, pod bucket 8)
+so the whole module rides a handful of jit compiles — the tier-1 suite
+sits near its time budget (ROADMAP).
+"""
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.api.objects import Container, Node, Pod, Taint
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+from scheduler_plugins_tpu.resilience import (
+    BackendUnavailable,
+    Resilience,
+    SolveWatchdog,
+    faults,
+    host_sequential_solve,
+    solve_output_anomaly,
+    supports_host_solve,
+)
+from scheduler_plugins_tpu.plugins import Coscheduling, NodeResourcesAllocatable
+from scheduler_plugins_tpu.serving import ServeEngine
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import observability as obs
+
+gib = 1 << 30
+
+NODE_COLUMNS = (
+    "alloc", "capacity", "requested", "nonzero_requested", "limits",
+    "mask", "region", "zone", "pod_count", "terminating", "nominated",
+)
+
+
+def make_cluster(n_nodes=6, cpu=8000):
+    cluster = Cluster()
+    for i in range(n_nodes):
+        cluster.add_node(Node(
+            name=f"n{i:03d}",
+            allocatable={CPU: cpu, MEMORY: 32 * gib, PODS: 32},
+        ))
+    return cluster
+
+
+def make_pod(serial, now=0, cpu=500, mem=gib, **kw):
+    return Pod(
+        name=f"p{serial:05d}", creation_ms=now + serial,
+        containers=[Container(requests={CPU: cpu, MEMORY: mem})], **kw,
+    )
+
+
+@pytest.fixture()
+def no_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def shared_scheduler():
+    """One Scheduler for the whole module: every test solves the same
+    (8-pod, 6-node) bucket, so the sequential solve compiles once."""
+    return Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+
+
+def fast_resilience(engine=None, timeout_s=30.0, attempts=2, probe_every=1):
+    return Resilience(
+        watchdog=SolveWatchdog(
+            timeout_s=timeout_s, max_attempts=attempts,
+            backoff_base_s=0.005, seed=0,
+        ),
+        probe_every=probe_every, engine=engine,
+    )
+
+
+class TestHostSolveParity:
+    def test_bit_identical_including_failures(self, shared_scheduler):
+        cluster = make_cluster(cpu=3000)
+        # mix: placeable pods, an oversized pod (built-in fit failure),
+        # and a scheduling-gated pod (PreFilter gate)
+        for i in range(4):
+            cluster.add_pod(make_pod(i, cpu=1000))
+        cluster.add_pod(make_pod(4, cpu=50_000))
+        gated = make_pod(5, cpu=100)
+        gated.scheduling_gated = True
+        cluster.add_pod(gated)
+        s = shared_scheduler
+        pending = s.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        s.prepare(meta, cluster)
+        assert supports_host_solve(s, snap)
+        dev = s.solve(snap)
+        a, ad, w, f = host_sequential_solve(s, snap)
+        np.testing.assert_array_equal(a, np.asarray(dev.assignment))
+        np.testing.assert_array_equal(ad, np.asarray(dev.admitted))
+        np.testing.assert_array_equal(w, np.asarray(dev.wait))
+        np.testing.assert_array_equal(f, np.asarray(dev.failed_plugin))
+        # the mix actually exercised both outcomes
+        assert (a >= 0).any() and (a < 0).any()
+
+    def test_supports_gates_on_profile_and_side_tables(self,
+                                                       shared_scheduler):
+        cluster = make_cluster()
+        cluster.add_pod(make_pod(0))
+        s = shared_scheduler
+        pending = s.sort_pending(cluster.pending_pods(), cluster)
+        snap, _ = cluster.snapshot(pending, now_ms=0)
+        assert supports_host_solve(s, snap)
+        mixed = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), Coscheduling()]
+        ))
+        assert not supports_host_solve(mixed, snap)
+
+
+class TestWatchdog:
+    def test_timeout_then_retry_succeeds(self):
+        import time as _time
+
+        wd = SolveWatchdog(timeout_s=0.15, max_attempts=3,
+                           backoff_base_s=0.005, seed=0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                _time.sleep(1.0)  # first attempt hangs past the deadline
+            return "ok"
+
+        assert wd.run(flaky) == "ok"
+        assert len(calls) == 2
+        assert wd.abandoned == 1
+        assert "timeout" in wd.last_reason
+        # every watchdog worker — including the abandoned, still-stuck
+        # one — must be a DAEMON thread: ThreadPoolExecutor workers are
+        # non-daemon and joined at interpreter exit, which would turn a
+        # hung backend into a process that can never exit 0 on SIGTERM
+        import threading as _threading
+
+        workers = [
+            t for t in _threading.enumerate()
+            if t.name.startswith("solve-watchdog")
+        ]
+        assert workers and all(t.daemon for t in workers)
+
+    def test_exhausted_budget_raises_with_classification(self):
+        wd = SolveWatchdog(timeout_s=1.0, max_attempts=2,
+                           backoff_base_s=0.001, seed=0)
+
+        def broken():
+            raise RuntimeError("xla went away")
+
+        with pytest.raises(BackendUnavailable) as exc:
+            wd.run(broken)
+        assert "device-error: RuntimeError" in exc.value.reason
+
+    def test_backoff_schedule_deterministic_and_capped(self):
+        a = SolveWatchdog(backoff_base_s=0.1, backoff_cap_s=0.4, seed=7)
+        b = SolveWatchdog(backoff_base_s=0.1, backoff_cap_s=0.4, seed=7)
+        seq_a = [a.backoff_s(k) for k in range(1, 7)]
+        seq_b = [b.backoff_s(k) for k in range(1, 7)]
+        assert seq_a == seq_b  # seeded: replays exactly
+        for attempt, s in enumerate(seq_a, start=1):
+            base = min(0.1 * 2 ** (attempt - 1), 0.4)
+            assert 0.5 * base <= s <= base  # jitter in [0.5, 1.0] x base
+
+    def test_output_anomaly_contract(self):
+        a = np.array([0, -1, 2], np.int32)
+        ok = np.ones(3, bool)
+        assert solve_output_anomaly(a, ok, ok, 3) is None
+        bad = a.copy()
+        bad[0] = 3  # >= n_nodes
+        assert "out of range" in solve_output_anomaly(bad, ok, ok, 3)
+        assert "shape" in solve_output_anomaly(a, np.ones(2, bool), ok, 3)
+        assert "NaN" in solve_output_anomaly(
+            a, np.array([1.0, np.nan, 1.0]), ok, 3
+        )
+
+
+class TestResilienceCycle:
+    def test_device_error_fails_over_bit_identical(self, shared_scheduler,
+                                                   no_faults):
+        def fresh():
+            c = make_cluster()
+            for i in range(5):
+                c.add_pod(make_pod(i))
+            return c
+
+        baseline = run_cycle(shared_scheduler, fresh(), now=1000)
+        plan = faults.install(faults.FaultPlan(seed=0))
+        plan.specs.append(faults.FaultSpec(
+            site=faults.SOLVE_DISPATCH, cycle=0, kind="device-error",
+            repeat=8,
+        ))
+        plan.begin_cycle(0)
+        rz = fast_resilience()
+        chaos = fresh()
+        report = run_cycle(shared_scheduler, chaos, now=1000, resilience=rz)
+        assert report.solve_path == "host"
+        assert report.degraded
+        assert report.bound == baseline.bound
+        assert report.failed == baseline.failed
+        assert rz.failovers == 1
+        assert obs.metrics.get(obs.DEGRADED) == 1.0
+        # fault clears -> the next cycle's probation probe restores fast
+        plan.begin_cycle(1)
+        for i in range(5, 8):
+            chaos.add_pod(make_pod(i))
+        report2 = run_cycle(shared_scheduler, chaos, now=2000, resilience=rz)
+        assert report2.solve_path == "device"
+        assert not report2.degraded
+        assert rz.recoveries and obs.metrics.get(obs.DEGRADED) == 0.0
+
+    def test_garbage_output_is_a_backend_fault(self, shared_scheduler,
+                                               no_faults):
+        cluster = make_cluster()
+        for i in range(5):
+            cluster.add_pod(make_pod(i))
+        plan = faults.install(faults.FaultPlan(seed=3))
+        plan.specs.append(faults.FaultSpec(
+            site=faults.SOLVE_DISPATCH, cycle=0, kind="garbage",
+        ))
+        plan.begin_cycle(0)
+        rz = fast_resilience(attempts=2)
+        report = run_cycle(shared_scheduler, cluster, now=1000,
+                           resilience=rz)
+        # one garbage answer -> retried clean on the second attempt
+        assert report.solve_path == "device"
+        assert not report.degraded
+        assert "garbage-output" in rz.watchdog.last_reason
+
+    def test_no_host_fallback_surfaces_backend_unavailable(self, no_faults):
+        cluster = make_cluster()
+        cluster.add_pod(make_pod(0))
+        mixed = Scheduler(Profile(
+            plugins=[NodeResourcesAllocatable(), Coscheduling()]
+        ))
+        plan = faults.install(faults.FaultPlan(seed=0))
+        plan.specs.append(faults.FaultSpec(
+            site=faults.SOLVE_DISPATCH, cycle=0, kind="device-error",
+            repeat=8,
+        ))
+        plan.begin_cycle(0)
+        rz = fast_resilience(attempts=1)
+        with pytest.raises(BackendUnavailable):
+            run_cycle(mixed, cluster, now=1000, resilience=rz)
+        assert rz.degraded  # parked, not silently guessed
+
+
+class TestFaultPlan:
+    def test_standard_plan_deterministic(self):
+        a = faults.FaultPlan.standard(42, 16)
+        b = faults.FaultPlan.standard(42, 16)
+        assert [(s.site, s.cycle, s.kind) for s in a.specs] == \
+               [(s.site, s.cycle, s.kind) for s in b.specs]
+        c = faults.FaultPlan.standard(43, 16)
+        assert [(s.site, s.cycle, s.kind) for s in a.specs] != \
+               [(s.site, s.cycle, s.kind) for s in c.specs]
+        # full taxonomy, one cycle each, all within (0, cycles-1)
+        kinds = {s.kind for s in a.specs}
+        assert kinds == {"hang", "device-error", "garbage", "drop", "dup",
+                         "corrupt", "stall", "crash"}
+        cycles = [s.cycle for s in a.specs]
+        assert len(set(cycles)) == len(cycles)
+        assert all(1 <= c <= 14 for c in cycles)
+
+    def test_standard_plan_minimum_cycles(self):
+        # 8 distinct slots need [1, cycles-2] to hold them: 10 is the
+        # floor — 9 must raise the documented error, not a numpy one
+        plan = faults.FaultPlan.standard(0, 10)
+        assert len(plan.specs) == 8
+        with pytest.raises(ValueError, match=">= 10 cycles"):
+            faults.FaultPlan.standard(0, 9)
+
+    def test_sticky_spec_rolls_forward_once(self):
+        plan = faults.FaultPlan(seed=0)
+        plan.specs.append(faults.FaultSpec(
+            site=faults.DELTA_EVENT, cycle=3, kind="drop", sticky=True,
+        ))
+        plan.begin_cycle(2)
+        assert plan.fire(faults.DELTA_EVENT) is None  # not due yet
+        plan.begin_cycle(5)  # missed its slot: still pending
+        assert plan.fire(faults.DELTA_EVENT).kind == "drop"
+        assert plan.fire(faults.DELTA_EVENT) is None  # consumed
+        assert plan.unfired() == []
+
+    def test_zero_overhead_registry_off(self):
+        assert faults.ACTIVE is None
+        assert faults.fire(faults.SOLVE_DISPATCH) is None
+        assert faults.mutate_delta(("pod_assign", None, "n", False)) == [
+            ("pod_assign", None, "n", False)
+        ]
+
+
+def serve_cycle(scheduler, cluster, engine, now, n_new=3, serial=[0]):
+    for _ in range(n_new):
+        serial[0] += 1
+        cluster.add_pod(make_pod(serial[0], now=now, cpu=100))
+    return run_cycle(scheduler, cluster, now=now, serve=engine)
+
+
+class TestAntiEntropy:
+    def test_corrupted_resident_column_recovers_in_one_window(
+        self, shared_scheduler
+    ):
+        """Satellite: seeded corruption of one resident column -> the
+        next refresh's digest detects it, re-bases, and the cycle's
+        placements are bit-exact vs a no-corruption control."""
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = ServeEngine().attach(cluster)
+        engine.verify_every = 1
+        control = make_cluster()
+        ctrl_engine = ServeEngine().attach(control)
+        ctrl_engine.verify_every = 1
+        for now in (1000, 2000):
+            serve_cycle(s, cluster, engine, now, serial=[now])
+            serve_cycle(s, control, ctrl_engine, now, serial=[now])
+        assert engine.resident_nodes is not None
+        # seeded corruption: bump one cell of the requested column (the
+        # shape of a lost/garbled delta that already landed)
+        rng = np.random.default_rng(0)
+        slot = int(rng.integers(0, len(cluster.nodes)))
+        nodes = engine.resident_nodes
+        engine._nodes = nodes.replace(
+            requested=nodes.requested.at[slot, 0].add(1 << 20)
+        )
+        div0 = engine.antientropy_divergences
+        r = serve_cycle(s, cluster, engine, 3000, serial=[3000])
+        rc = serve_cycle(s, control, ctrl_engine, 3000, serial=[3000])
+        assert engine.antientropy_divergences == div0 + 1  # detected
+        assert r.bound == rc.bound  # re-based BEFORE the solve consumed it
+        # and the resident base is exact again (one window, no lingering)
+        div1 = engine.antientropy_divergences
+        r = serve_cycle(s, cluster, engine, 4000, serial=[4000])
+        rc = serve_cycle(s, control, ctrl_engine, 4000, serial=[4000])
+        assert engine.antientropy_divergences == div1
+        assert r.bound == rc.bound
+
+    def test_dropped_sink_event_detected_within_window(
+        self, shared_scheduler, no_faults
+    ):
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = ServeEngine().attach(cluster)
+        engine.verify_every = 1
+        serve_cycle(s, cluster, engine, 1000, serial=[1])
+        plan = faults.install(faults.FaultPlan(seed=0))
+        plan.specs.append(faults.FaultSpec(
+            site=faults.DELTA_EVENT, cycle=0, kind="drop", sticky=True,
+        ))
+        plan.begin_cycle(0)
+        div0 = engine.antientropy_divergences
+        serve_cycle(s, cluster, engine, 2000, serial=[2])  # bind dropped
+        faults.clear()
+        serve_cycle(s, cluster, engine, 3000, serial=[3])
+        assert plan.unfired() == []
+        assert engine.antientropy_divergences == div0 + 1
+
+    def test_note_fault_forces_offcadence_verify(self, shared_scheduler):
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = ServeEngine().attach(cluster)
+        engine.verify_every = 0  # periodic checks OFF
+        serve_cycle(s, cluster, engine, 1000, serial=[100])
+        checks0 = obs.metrics.get(obs.ANTIENTROPY_CHECKS)
+        serve_cycle(s, cluster, engine, 2000, serial=[200])
+        assert obs.metrics.get(obs.ANTIENTROPY_CHECKS) == checks0
+        engine.note_fault("test-fault")
+        serve_cycle(s, cluster, engine, 3000, serial=[300])
+        assert obs.metrics.get(obs.ANTIENTROPY_CHECKS) == checks0 + 1
+
+    def test_fallback_reentry_then_corruption_recovery(
+        self, shared_scheduler
+    ):
+        """Satellite: repeated compatibility-fallback -> serve resume
+        round trips (taint appears/clears, twice), then a corruption is
+        still caught and recovered — the fallback windows must not
+        desync the resident base."""
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = ServeEngine().attach(cluster)
+        engine.verify_every = 1
+        serial = [0]
+        serve_cycle(s, cluster, engine, 1000, serial=serial)
+        gen = engine.generation
+        rebases0 = engine.rebases
+        for round_ in range(2):
+            node = cluster.nodes["n000"]
+            node.taints = [Taint(key="k", value="v")]
+            cluster.add_node(node)  # upsert: side state, serve falls back
+            assert engine.refresh(cluster, [], now_ms=2000) is None
+            node.taints = []
+            cluster.add_node(node)  # cleared: serving resumes
+            serve_cycle(s, cluster, engine, 3000 + round_, serial=serial)
+            assert engine.generation > gen
+            gen = engine.generation
+        # fallback windows absorbed deltas — NO rebase was needed to
+        # resume (verify_every=1 re-checked the base at every resumed
+        # refresh, so staying at zero rebases PROVES the base stayed
+        # bit-exact through both round trips)
+        assert engine.rebases == rebases0
+        assert engine.antientropy_divergences == 0
+
+
+class TestCheckpointRestore:
+    def _served_engine(self, scheduler, cluster):
+        engine = ServeEngine().attach(cluster)
+        engine.verify_every = 1
+        serve_cycle(scheduler, cluster, engine, 1000, serial=[10])
+        # drain the last cycle's bind deltas so the checkpoint is a
+        # settled base (the daemon's shutdown path checkpoints after its
+        # final refresh the same way)
+        engine.refresh(cluster, [], now_ms=1500)
+        return engine
+
+    def test_restore_resumes_without_rebase(self, shared_scheduler,
+                                            tmp_path):
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = self._served_engine(s, cluster)
+        path = str(tmp_path / "resident.ckpt")
+        assert engine.save_checkpoint(path)
+        gen = engine.generation
+        engine.detach()
+
+        restored = ServeEngine().attach(cluster)
+        restored.verify_every = 1
+        assert restored.restore_checkpoint(path)
+        assert restored.generation == gen  # continuity, not a cold start
+        r = serve_cycle(s, cluster, restored, 2000, serial=[20])
+        # the forced anti-entropy verify PASSED: no divergence, no rebase
+        assert restored.rebases == 0
+        assert restored.antientropy_divergences == 0
+        assert r.bound  # and it actually served decisions
+
+    def test_stale_checkpoint_rebases_within_one_window(
+        self, shared_scheduler, tmp_path
+    ):
+        s = shared_scheduler
+        cluster = make_cluster()
+        engine = self._served_engine(s, cluster)
+        ckpt = engine.checkpoint_bytes()
+        assert ckpt is not None
+        engine.detach()
+        # the store moves on while the process is "down": these deltas
+        # never reach any sink, exactly like a crash's undrained events
+        victim = next(
+            uid for uid, p in cluster.pods.items()
+            if p.node_name is not None
+        )
+        cluster.remove_pod(victim)
+
+        restored = ServeEngine().attach(cluster)
+        restored.verify_every = 1
+        restored.restore_checkpoint(ckpt)  # bytes source: the crash path
+        # the restored-but-stale base must be detected by the forced
+        # verify and re-based BEFORE the first solve consumes it
+        r = serve_cycle(s, cluster, restored, 2000, serial=[30])
+        assert restored.antientropy_divergences == 1
+        assert restored.rebases == 1
+        # recovered: next refresh is clean
+        serve_cycle(s, cluster, restored, 3000, serial=[40])
+        assert restored.antientropy_divergences == 1
+        assert r.bound
+
+    def test_checkpoint_none_before_first_refresh(self, tmp_path):
+        engine = ServeEngine()
+        assert engine.checkpoint_bytes() is None
+        assert not engine.save_checkpoint(str(tmp_path / "x.ckpt"))

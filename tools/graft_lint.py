@@ -57,6 +57,15 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   to a full gather. The shard-smoke gate's jaxpr collective census is the
   compiled-level twin.
 
+- **GL010 swallowed-exception** — no broad exception handler (bare
+  ``except:``, ``Exception``, ``BaseException``) whose body is only
+  ``pass``/``...``: around solve/ingest sites that is how a backend
+  fault, a poisoned delta batch, or a checkpoint failure vanishes
+  silently. Fault paths must record + re-route (retry/failover/park/
+  re-base — `resilience.watchdog` is the pattern); sanctioned
+  best-effort paths (GC finalizers, shutdown cleanup, optional-dep
+  probes) carry an inline ignore with their reason.
+
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
 array constructor, a local name assigned from one, or a known int64
@@ -951,6 +960,50 @@ def check_node_axis_all_gather(path, tree, findings):
         ))
 
 
+def check_swallowed_exception(path, tree, findings):
+    """GL010: a broad exception handler (bare ``except:``, ``except
+    Exception``, ``except BaseException``) whose body is only
+    ``pass``/``...``. Around solve/ingest sites this is how a backend
+    fault, a poisoned delta batch, or a checkpoint failure disappears
+    without a trace — fault paths must RECORD (log/metric) and RE-ROUTE
+    (retry, failover, park, re-base; `resilience.watchdog` is the
+    pattern), never swallow. Narrow handlers for specific exceptions are
+    fine; genuinely-sanctioned best-effort paths (GC finalizers,
+    shutdown cleanup, optional-dependency probes) carry an inline
+    ``# graft-lint: ignore[GL010]`` with their reason."""
+
+    def is_broad(t) -> bool:
+        if t is None:
+            return True  # bare except
+        if isinstance(t, ast.Name):
+            return t.id in ("Exception", "BaseException")
+        if isinstance(t, ast.Tuple):
+            return any(is_broad(e) for e in t.elts)
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not is_broad(node.type):
+            continue
+        body_swallows = all(
+            isinstance(stmt, ast.Pass)
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant))
+            for stmt in node.body
+        )
+        if not body_swallows:
+            continue
+        findings.append(Finding(
+            path, node, "GL010",
+            "broad exception handler swallows the fault (body is only "
+            "pass) — record + re-route instead: log/count it and retry, "
+            "fail over, park, or re-base (resilience.watchdog is the "
+            "pattern); a sanctioned best-effort path needs an inline "
+            "ignore with its reason",
+        ))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -982,6 +1035,7 @@ def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str
     check_resource_slots(rel, tree, findings)
     check_donated_reuse(rel, tree, findings)
     check_node_axis_all_gather(rel, tree, findings)
+    check_swallowed_exception(rel, tree, findings)
     if not config_owner:
         check_config_update(rel, tree, findings)
     return findings, tree, source
