@@ -94,11 +94,20 @@ mega:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --chaos-smoke
 
+# CI rank-gang gate: reduced config-10 run — the gang phase's max
+# inter-rank cost strictly below the quorum-only Coscheduling baseline on
+# the same event stream, jit solve bit-identical to its numpy sequential
+# twin (drift 0.0), zero fit/quota/quorum violations, and elastic
+# grow/shrink converging within 2 cycles
+.PHONY: gang-smoke
+gang-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --gang-smoke
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke chaos-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke chaos-smoke gang-smoke
 
 .PHONY: lint
 lint:

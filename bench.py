@@ -658,6 +658,7 @@ CONFIG_METRICS = {
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
+    10: "rank_gang_pods_per_sec",
 }
 
 
@@ -1592,6 +1593,326 @@ def chaos_smoke(bound_pct=2.0, recovery_bound=4):
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# config 10: rank-aware gangs — topology-cost gang solves + elastic DL jobs
+# ---------------------------------------------------------------------------
+
+#: the config-10 headline shape: heterogeneous MPI gangs + elastic DL jobs
+#: on a 3-level (node / zone-block / region) topology — the rank-aware arm
+#: runs the gang phase (gangs.phase.GangPhase, jit solve with the numpy
+#: twin cross-checked every cycle), the baseline arm the SAME event stream
+#: through quorum-only Coscheduling
+RANK_GANG_SHAPE = dict(
+    n_nodes=384, n_regions=2, zones_per_region=3, n_mpi=24, mpi_ranks=8,
+    n_dl=8, dl_min=2, dl_desired=4, dl_max=8,
+)
+#: reduced shape for the `make gang-smoke` CI gate (2-core runners)
+GANG_SMOKE_SHAPE = dict(
+    n_nodes=48, n_regions=2, zones_per_region=2, n_mpi=4, mpi_ranks=6,
+    n_dl=2, dl_min=2, dl_desired=3, dl_max=6,
+)
+
+#: bench cycles advance wall-clock by this much so per-pod requeue
+#: backoffs (seeded jittered exponential, initial ~1s) never stall a
+#: parked gang across the measured window
+GANG_CYCLE_MS = 10_000
+
+
+def _gang_placement_costs(cluster):
+    """Audit a cluster's CURRENT rank-gang placements: per-gang max/sum
+    inter-rank cost + the `tuning.quality.rank_gang_quality` objectives,
+    computed from bound members' nodes against the scenario's own
+    NetworkTopology weights — the SAME scoring for both arms, so the
+    quorum-only baseline is measured with the rank-aware yardstick."""
+    from scheduler_plugins_tpu.gangs import phase as GP
+    from scheduler_plugins_tpu.gangs import topology as GT
+    from scheduler_plugins_tpu.tuning import quality as Q
+
+    # the solver's own lowering (gangs.phase.block_cost_view): both arms
+    # are measured with the identical yardstick by construction
+    node_pos, zones, block_cost = GP.block_cost_view(cluster)
+    groups = [
+        pg for _, pg in sorted(cluster.pod_groups.items())
+        if getattr(pg, "rank_aware", False)
+    ]
+    rows, per_gang = [], {}
+    M = 1
+    for pg in groups:
+        bound = [
+            node_pos[p.node_name] for p in cluster.gang_members(pg)
+            if p.node_name in node_pos
+        ]
+        rows.append((pg.full_name, bound))
+        M = max(M, len(bound))
+    rank_nodes = np.full((max(len(rows), 1), M), -1, np.int32)
+    rank_mask = np.zeros((max(len(rows), 1), M), bool)
+    for g, (_, bound) in enumerate(rows):
+        rank_nodes[g, : len(bound)] = bound
+        rank_mask[g, : len(bound)] = True
+    max_cost, sum_cost = GT.gang_cost_stats(
+        rank_nodes, rank_mask, zones, block_cost
+    )
+    for g, (name, bound) in enumerate(rows):
+        per_gang[name] = {
+            "ranks": len(bound),
+            "max_cost": int(max_cost[g]),
+            "sum_cost": int(sum_cost[g]),
+        }
+    quality = Q.rank_gang_quality(rank_nodes, rank_mask, zones, block_cost)
+    return per_gang, quality
+
+
+def _gang_violations(cluster) -> dict:
+    """Hard-constraint replay over the bound population: node capacity
+    (`_churn_capacity_violations`), ElasticQuota max per namespace, and
+    the rank-gang quorum/zero-partial invariant (a rank-aware gang's
+    bound member count is either 0 or >= min_member)."""
+    from scheduler_plugins_tpu.api.resources import PODS  # noqa: F401
+
+    quota_violations = 0
+    used: dict = {}
+    for pod in cluster.pods.values():
+        if pod.node_name is None:
+            continue
+        bucket = used.setdefault(pod.namespace, {})
+        for r, q in pod.effective_request().items():
+            bucket[r] = bucket.get(r, 0) + q
+    for eq in cluster.quotas.values():
+        bucket = used.get(eq.namespace, {})
+        for r, cap in eq.max.items():
+            if bucket.get(r, 0) > cap:
+                quota_violations += 1
+    quorum_violations = 0
+    for pg in cluster.pod_groups.values():
+        if not getattr(pg, "rank_aware", False):
+            continue
+        bound = sum(
+            1 for p in cluster.gang_members(pg) if p.node_name is not None
+        )
+        if 0 < bound < pg.min_member:
+            quorum_violations += 1
+    return {
+        "capacity": _churn_capacity_violations(cluster),
+        "quota": quota_violations,
+        "quorum": quorum_violations,
+    }
+
+
+def _run_gang_arm(shape, phase, seed=0, max_cycles=8):
+    """One arm of the config-10 comparison: the scenario cluster driven
+    through `run_cycle` (with the gang phase when `phase` is given) until
+    the queue drains or `max_cycles`. Returns the cluster/scheduler plus
+    per-gang admission latency in cycles and the wall time."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+    from scheduler_plugins_tpu.models import rank_gang_scenario
+    from scheduler_plugins_tpu import plugins as P
+
+    cluster = rank_gang_scenario(seed=seed, **shape)
+    scheduler = Scheduler(Profile(plugins=[
+        P.NodeResourcesAllocatable(), P.Coscheduling(),
+        P.CapacityScheduling(),
+    ]))
+    first_pending: dict = {}
+    admitted_at: dict = {}
+    decided = 0
+    start = time.perf_counter()
+    for cycle in range(max_cycles):
+        now = GANG_CYCLE_MS * (cycle + 1)
+        pending_gangs = {
+            pg.full_name
+            for p in cluster.pending_pods()
+            if (pg := cluster.pod_group_of(p)) is not None
+        }
+        for name in pending_gangs:
+            first_pending.setdefault(name, cycle)
+        report = run_cycle(scheduler, cluster, now=now, gangs=phase)
+        decided += len(report.bound) + len(report.failed)
+        for pg in cluster.pod_groups.values():
+            name = pg.full_name
+            if name in admitted_at or name not in first_pending:
+                continue
+            bound = sum(
+                1 for p in cluster.gang_members(pg)
+                if p.node_name is not None
+            )
+            if bound >= pg.min_member:
+                admitted_at[name] = cycle - first_pending[name]
+        if not cluster.pending_pods():
+            break
+    elapsed = time.perf_counter() - start
+    return {
+        "cluster": cluster, "scheduler": scheduler,
+        "latencies": admitted_at, "first_pending": first_pending,
+        "decided": decided, "elapsed": elapsed,
+        "cycles": cycle + 1,
+    }
+
+
+def _elastic_transition(cluster, scheduler, phase, set_desired, start_now,
+                        max_cycles=4):
+    """Apply `set_desired(pg) -> int` to every elastic rank-aware gang
+    (through `add_pod_group`, so PodGroup/Update events fire) and run
+    cycles until every one's LIVE width equals its clamped desired.
+    Returns the convergence cycle count (max_cycles + 1 = did not
+    converge)."""
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.gangs import elastic_bounds
+
+    targets = {}
+    for pg in list(cluster.pod_groups.values()):
+        if getattr(pg, "rank_aware", False) and pg.desired_replicas is not None:
+            pg.desired_replicas = set_desired(pg)
+            cluster.add_pod_group(pg)  # PodGroup/Update (api.events)
+            targets[pg.full_name] = elastic_bounds(pg)[1]
+
+    def converged():
+        for name, want in targets.items():
+            pg = cluster.pod_groups[name]
+            live = sum(
+                1 for p in cluster.gang_members(pg)
+                if p.node_name is not None
+            )
+            if live != want:
+                return False
+        return True
+
+    for k in range(max_cycles):
+        if converged():
+            return k
+        run_cycle(
+            scheduler, cluster, now=start_now + GANG_CYCLE_MS * (k + 1),
+            gangs=phase,
+        )
+    return max_cycles if converged() else max_cycles + 1
+
+
+def rank_gangs(shape=None, emit=True, seed=0):
+    """Config 10: the rank-aware gang bench (ISSUE 10; docs/GANGS.md).
+
+    Two arms on the same scenario stream: the gang phase (topology-block
+    waterfill, jit solve cross-checked against the numpy sequential twin
+    every cycle — `drift` is 0.0 iff they stayed bit-identical) vs
+    quorum-only Coscheduling. Reports gang admission latency, max/p99
+    inter-rank cost for BOTH arms, elastic grow/shrink convergence, and
+    the hard-constraint audit."""
+    from scheduler_plugins_tpu.gangs import GangPhase
+    from scheduler_plugins_tpu.tuning.quality import (
+        elastic_satisfaction_quality,
+    )
+
+    shape = shape or RANK_GANG_SHAPE
+
+    phase = GangPhase(check_twin=True)
+    with _bench_span("rank-aware arm"):
+        rank = _run_gang_arm(shape, phase, seed=seed)
+    admit_now = GANG_CYCLE_MS * (rank["cycles"] + 1)
+    with _bench_span("elastic grow"):
+        grow_cycles = _elastic_transition(
+            rank["cluster"], rank["scheduler"], phase,
+            lambda pg: min(pg.max_replicas or 10**6,
+                           (pg.desired_replicas or pg.min_member) + 2),
+            admit_now,
+        )
+    with _bench_span("elastic shrink"):
+        shrink_cycles = _elastic_transition(
+            rank["cluster"], rank["scheduler"], phase,
+            lambda pg: pg.min_member,
+            admit_now + GANG_CYCLE_MS * 8,
+        )
+    rank_costs, rank_quality = _gang_placement_costs(rank["cluster"])
+    rank_violations = _gang_violations(rank["cluster"])
+
+    with _bench_span("quorum-only baseline arm"):
+        base = _run_gang_arm(shape, None, seed=seed,
+                             max_cycles=rank["cycles"] + 2)
+    base_costs, base_quality = _gang_placement_costs(base["cluster"])
+
+    lat = list(rank["latencies"].values())
+    elastic_sat = elastic_satisfaction_quality([
+        {
+            name: {
+                "resident": sum(
+                    1 for p in rank["cluster"].gang_members(pg)
+                    if p.node_name is not None
+                ),
+                "placed_new": 0,
+                "desired": pg.desired_replicas or pg.min_member,
+            }
+            for name, pg in rank["cluster"].pod_groups.items()
+            if getattr(pg, "rank_aware", False)
+        }
+    ])
+    line = {
+        "gangs": len(rank_costs),
+        "gangs_admitted": len(lat),
+        "gang_admission_latency_cycles": (
+            round(float(np.mean(lat)), 2) if lat else None
+        ),
+        "max_inter_rank_cost": rank_quality["rank_cost_max"],
+        "baseline_max_inter_rank_cost": base_quality["rank_cost_max"],
+        "rank_cost_p99": rank_quality["rank_cost_p99"],
+        "baseline_rank_cost_p99": base_quality["rank_cost_p99"],
+        "gang_spread_cost": round(rank_quality["gang_spread_cost"], 2),
+        "baseline_gang_spread_cost": round(
+            base_quality["gang_spread_cost"], 2
+        ),
+        "elastic_grow_convergence_cycles": grow_cycles,
+        "elastic_shrink_convergence_cycles": shrink_cycles,
+        "elastic_satisfaction": round(elastic_sat, 4),
+        "violations": rank_violations,
+        "baseline_violations": _gang_violations(base["cluster"]),
+        # the WORST jit-vs-twin drift over every solved cycle (admission
+        # + grow + shrink): 0.0 iff the two stayed bit-identical all run
+        "twin_drift": phase.max_drift,
+        "serve_gang_fallback_documented": True,
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[10],
+            rank["decided"] / rank["elapsed"] if rank["elapsed"] else 0.0,
+            f"{shape['n_nodes']} nodes x {len(rank_costs)} rank gangs "
+            f"(3-level topology), gang phase vs quorum-only",
+            baseline=(
+                base["decided"] / base["elapsed"] if base["elapsed"] else 1.0
+            ),
+            drift=phase.max_drift,
+            quality={
+                **{k: round(v, 4) for k, v in rank_quality.items()},
+                "elastic_satisfaction": round(elastic_sat, 4),
+            },
+            extra=line,
+        )
+    return line
+
+
+def gang_smoke(max_convergence=2):
+    """CI gate (`make gang-smoke`): reduced config-10 run — the gang
+    phase's max inter-rank cost must sit STRICTLY below the quorum-only
+    Coscheduling baseline on the same event stream, the jit solve must
+    stay bit-identical to its numpy sequential twin (drift 0.0), the
+    hard-constraint replay must be clean (capacity/quota/quorum all 0),
+    every gang must admit, and elastic grow/shrink must converge within
+    `max_convergence` cycles. One JSON line; rc 1 on any failure."""
+    line = rank_gangs(shape=GANG_SMOKE_SHAPE, emit=False)
+    ok = (
+        line["max_inter_rank_cost"] < line["baseline_max_inter_rank_cost"]
+        and line["twin_drift"] == 0.0
+        and all(v == 0 for v in line["violations"].values())
+        and line["gangs_admitted"] == line["gangs"]
+        and line["elastic_grow_convergence_cycles"] <= max_convergence
+        and line["elastic_shrink_convergence_cycles"] <= max_convergence
+        and line["elastic_satisfaction"] == 1.0
+    )
+    print(json.dumps({
+        "metric": "gang_smoke",
+        "backend": _backend_label(),
+        "max_convergence_cycles": max_convergence,
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
+
+
 #: replay cutoff: a capture older than this is too stale to stand in for
 #: "the round's number" (a round is ~12h; 48h allows the previous round's
 #: tail while excluding week-old numbers from a drifted codebase)
@@ -1958,7 +2279,9 @@ if __name__ == "__main__":
                              "8-host-device mesh vs 1 device; 9 = chaos "
                              "churn: the config-7 workload under the "
                              "full seeded fault plan, serve+resilience "
-                             "vs the no-chaos control); "
+                             "vs the no-chaos control; 10 = rank-aware "
+                             "gangs: topology-cost gang solves + elastic "
+                             "DL jobs vs quorum-only Coscheduling); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -1997,6 +2320,14 @@ if __name__ == "__main__":
                              "the full-resnapshot baseline >= 1.5x on "
                              "cycles/s with identical placements and "
                              "zero hard-constraint violations")
+    parser.add_argument("--gang-smoke", action="store_true",
+                        help="CI gate: reduced rank-gang config-10 run; "
+                             "fails unless the gang phase's max inter-"
+                             "rank cost is strictly below the quorum-"
+                             "only baseline, the jit solve bit-matches "
+                             "its numpy twin (drift 0.0), the hard-"
+                             "constraint audit is clean, and elastic "
+                             "grow/shrink converge within 2 cycles")
     parser.add_argument("--chaos-smoke", action="store_true",
                         help="CI gate: reduced chaos-churn run under the "
                              "full seeded fault plan (hung solve, device "
@@ -2036,6 +2367,16 @@ if __name__ == "__main__":
         # arms share the backend, so its health cancels out of every
         # asserted claim and shows up only in the latency columns)
         chaos_churn()
+        sys.exit(0)
+    if args.gang_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # arm-vs-arm placement-quality comparison — no tunnel probe
+        sys.exit(gang_smoke())
+    if args.config == 10:
+        # rank-aware vs quorum-only comparison, full shape — both arms
+        # share whatever backend is configured, so no tunnel probe (its
+        # health cancels out of every asserted claim)
+        rank_gangs()
         sys.exit(0)
     if args.sanitize_smoke:
         # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):

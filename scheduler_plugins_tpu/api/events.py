@@ -91,3 +91,18 @@ NODE_COLUMN_EVENTS = frozenset({
 #: the serving engine re-bases (full re-snapshot) when one fires — the
 #: same rule `Cluster._native_rebuild` applies to the C++ columnar mirror
 SERVE_REBASE_EVENTS = frozenset({NODE_DELETE})
+
+#: every kind the rank-aware gang phase can emit or gate on
+#: (`gangs.phase.GangPhase`): elastic growth arrives as Pod/Add, binds as
+#: Pod/Update, shrink as Pod/Delete, spec changes as PodGroup/Update —
+#: all spelled HERE, so the phase introduces no literal kind strings and
+#: a parked gang member requeues on exactly the kinds Coscheduling
+#: already registers (plus Pod/Delete: freed capacity can complete a
+#: previously capacity-rejected gang)
+GANG_EVENTS = frozenset({
+    POD_ADD, POD_UPDATE, POD_DELETE,
+    POD_GROUP_ADD, POD_GROUP_UPDATE, POD_GROUP_DELETE,
+    NODE_ADD, NODE_UPDATE,
+    NETWORK_TOPOLOGY_ADD, NETWORK_TOPOLOGY_UPDATE,
+})
+assert GANG_EVENTS <= EVENT_KINDS

@@ -518,6 +518,19 @@ class PodGroup:
     min_resources: Mapping[str, int] = field(default_factory=dict)
     schedule_timeout_seconds: Optional[int] = None
     creation_ms: int = 0
+    #: rank-aware workload family (docs/GANGS.md, beyond the reference's
+    #: scope): members are RANKS — placed as a whole gang by the
+    #: topology-block waterfill (`gangs.topology`) ahead of the per-pod
+    #: solve, minimizing inter-rank network cost under the same hard
+    #: constraints. min_member stays the quorum.
+    rank_aware: bool = False
+    #: elastic DL-job bounds (Tesserae, arxiv 2508.04953): desired replica
+    #: width this gang should run at (clamped into
+    #: [min_member, max_replicas]); None = rigid gang (desired == min).
+    #: The gang phase's reconcile grows/shrinks members between cycles
+    #: (`gangs.elastic`), shrink releasing highest-cost ranks first.
+    desired_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
     # status
     phase: PodGroupPhase = PodGroupPhase.PENDING
     occupied_by: str = ""

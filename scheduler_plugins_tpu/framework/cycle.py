@@ -24,6 +24,7 @@ import os
 import time
 from collections import deque
 
+from scheduler_plugins_tpu.api import events as ev_api
 from scheduler_plugins_tpu.framework.preemption import GATED, encode_demand
 from scheduler_plugins_tpu.framework.runtime import (
     Scheduler,
@@ -91,6 +92,11 @@ class CycleReport:
     #: True when the process was serving from the host parity path at
     #: the END of this cycle (`scheduler_degraded` gauge's report twin)
     degraded: bool = False
+    #: per-gang outcome of the rank-aware gang phase (`gangs.phase`):
+    #: gang full_name -> {admitted, placed_new, resident, desired,
+    #: max_cost, sum_cost} — empty when the cycle ran without a gang
+    #: phase or no rank-aware gang had pending members
+    rank_gangs: dict = field(default_factory=dict)
 
     def explain(self, uid: str, top_k: int = 5) -> dict:
         """The "why this node" score table for one pod of THIS cycle's
@@ -155,7 +161,7 @@ def _attach_explain_ctx(report: CycleReport, ctx: tuple) -> None:
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
               stream_chunk: int | None = None, serve=None,
-              resilience=None) -> CycleReport:
+              resilience=None, gangs=None) -> CycleReport:
     """One daemon cycle. `stream_chunk` opts the solve into the donated,
     double-buffered chunk pipeline (`parallel.pipeline.streamed_profile_solve`)
     when the profile qualifies for the targeted fast path — huge pending
@@ -177,6 +183,15 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     NOT retain an explain context (the resident tensors are donated to
     the next cycle's delta apply — a retained snapshot would read freed
     buffers); the flight recorder is the postmortem surface there.
+
+    `gangs` (a `gangs.phase.GangPhase`) opts the cycle into the
+    rank-aware gang phase AHEAD of the per-pod solve: rank-aware
+    PodGroups' members are lifted out of the pending batch, placed as
+    whole gangs by the topology-block waterfill, and bound through the
+    store — so the snapshot the per-pod path solves already carries the
+    committed free/eq_used state (the CLAUDE.md carry discipline, at
+    phase granularity). Quorum-failed gangs park whole (zero partial
+    ranks); elastic gangs grow/shrink in the phase's reconcile first.
 
     `resilience` (a `resilience.watchdog.Resilience`) routes the solve
     through the solve watchdog: device dispatch + host-transfer
@@ -204,10 +219,43 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
 
     pending = cluster.pending_pods()
     with obs.tracer.span("Requeue", tid="cycle"):
-        pending = _requeue_eligible(scheduler, cluster, pending, now, report)
-    if not pending:
+        pending = _requeue_eligible(
+            scheduler, cluster, pending, now, report,
+            gang_phase=gangs is not None,
+        )
+    if gangs is None and not pending:
         return report
     pending = scheduler.sort_pending(pending, cluster)
+
+    if gangs is not None:
+        # the phase runs even on an empty batch: elastic reconcile must
+        # observe desired-width changes (shrink deletes need no pending
+        # pods), and growth clones it creates join THIS cycle's batch
+        with obs.extension_span("GangPhase", type(gangs).__name__,
+                                pending=len(pending)):
+            pending = gangs.run(scheduler, cluster, pending, now, report)
+        if not pending:
+            # gang-only cycle: every pending pod was a rank-gang member
+            # (bound or parked by the phase); nothing for the per-pod
+            # solve, so close out the counters and return. A serving
+            # engine still DRAINS (refresh with an empty batch): the
+            # phase's binds must land in the resident columns and the
+            # per-gang rank mirror now, not pile up in the sink until the
+            # next non-gang cycle. The cycle is still RECORDED when the
+            # flight recorder is live — the gang capture alone replays
+            # bit-identically through the twin
+            if serve is not None:
+                serve.refresh(cluster, [], now_ms=now)
+            rec = flightrec.recorder.begin(
+                now_ms=now, profile=scheduler.profile.name
+            )
+            if rec is not None:
+                gangs.annotate_record(rec)
+                rec.commit(report)
+            obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
+            obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
+            obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
+            return report
 
     from scheduler_plugins_tpu.utils import sanitize
 
@@ -244,6 +292,11 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
                     # and the packed delta stream that produced this
                     # cycle's snapshot view
                     serve.annotate_record(rec)
+                if gangs is not None:
+                    # gang-phase provenance: the full RankGangState +
+                    # outputs, so a recorded gang cycle replays
+                    # bit-identically through the numpy twin
+                    gangs.annotate_record(rec)
         result = None
         # the Solve span covers dispatch AND completion (np.asarray host
         # transfers below force it) for the sequential path; the streamed
@@ -466,7 +519,8 @@ def _attribute_failures(scheduler, snap, result, failed_idx, report):
             obs.metrics.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin=name)
 
 
-def _requeue_eligible(scheduler, cluster, pending, now, report):
+def _requeue_eligible(scheduler, cluster, pending, now, report,
+                      gang_phase=False):
     """EnqueueExtensions gating (upstream scheduling-queue semantics): a pod
     parked unschedulable re-enters the batch only when
 
@@ -493,12 +547,20 @@ def _requeue_eligible(scheduler, cluster, pending, now, report):
     noderesourcetopology plugin.go:141-151; backoff:
     k8s.io/kubernetes pkg/scheduler/internal/queue/scheduling_queue.go
     (calculateBackoffDuration — the framework queue every reference
-    plugin registers into)."""
+    plugin registers into).
+
+    `gang_phase` registers `api.events.GANG_EVENTS` on top: a pod parked
+    by the rank-gang phase (`RankGangPlacement`) has no owning plugin in
+    the profile to register its events, but its schedulability changes on
+    exactly those kinds (sibling add/delete frees quorum or capacity, a
+    NetworkTopology update moves the cost surface)."""
     from scheduler_plugins_tpu.framework.plugin import BUILTIN_EVENTS
 
     if not cluster.unschedulable_since:
         return pending
     registered = set(BUILTIN_EVENTS)
+    if gang_phase:
+        registered.update(ev_api.GANG_EVENTS)
     for plugin in scheduler.profile.plugins:
         registered.update(plugin.events_to_register())
 

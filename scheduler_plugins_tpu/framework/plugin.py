@@ -79,6 +79,14 @@ class SolverState:
     #: (E2, D) live symmetric-score carrier counts (existing pods'
     #: preferred/required affinity terms per domain); built-in commit
     sym_counts: Optional[jnp.ndarray] = None
+    #: (G2, M) live rank -> node assignment of the rank-aware gang phase
+    #: (`gangs.topology.gang_solve_body`): initialized from the resident
+    #: assignment (`RankGangState.prev_assigned`, its static snapshot
+    #: counterpart per `state.snapshot.CARRY_COUNTERPARTS`) and updated as
+    #: gangs place during the gang scan — elastic growth anchors on the
+    #: carried rows, never on a re-read of the static tensor. None outside
+    #: the gang phase (the per-pod solves do not thread it).
+    rank_nodes: Optional[jnp.ndarray] = None
 
 
 #: cluster events that can free capacity for the framework's built-in

@@ -1,0 +1,31 @@
+"""Rank-aware gang placement engine (ROADMAP item 4; docs/GANGS.md).
+
+- `gangs.topology` — the topology-block waterfill gang solve (jit) and
+  its bit-identical numpy sequential twin, plus block-cost lowering and
+  placement-cost scoring.
+- `gangs.elastic` — elastic (min, desired, max) gangs: highest-cost-first
+  shrink selection (jit + twin) and the satisfaction objective.
+- `gangs.phase` — the host `GangPhase` that `framework.cycle.run_cycle`
+  runs ahead of the per-pod solve.
+"""
+
+from scheduler_plugins_tpu.gangs.elastic import (  # noqa: F401
+    elastic_bounds,
+    elastic_satisfaction,
+    shrink_select,
+    shrink_select_np,
+)
+from scheduler_plugins_tpu.gangs.phase import (  # noqa: F401
+    GangPhase,
+    RANK_GANG_PLACEMENT,
+    build_rank_gang_problem,
+)
+from scheduler_plugins_tpu.gangs.topology import (  # noqa: F401
+    RankGangState,
+    build_block_cost,
+    gang_cost_stats,
+    gang_solve_body,
+    gang_solve_fn,
+    gang_solve_np,
+    pair_costs,
+)

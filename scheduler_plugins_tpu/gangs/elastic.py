@@ -1,0 +1,136 @@
+"""Elastic DL jobs: gangs with (min, desired, max) replica bounds.
+
+Tesserae (arxiv 2508.04953) treats deep-learning jobs as gangs that
+grow/shrink between scheduling rounds. Here an elastic gang is a
+`PodGroup` with `rank_aware=True` and `desired_replicas`/`max_replicas`
+set (`min_member` stays the hard quorum). The transitions:
+
+- **shrink** (live members > desired): release the HIGHEST-COST ranks
+  first — a rank's cost is its max inter-rank pair cost against the
+  surviving set, so the topology outliers leave before well-packed
+  ranks; ties release the highest rank index (the launcher, rank 0,
+  leaves last). `shrink_select` is the jittable selection (registered
+  with the AOT/jaxpr gates as `elastic_shrink`); `shrink_select_np` is
+  its bit-identical host twin — `GangPhase.reconcile` applies the host
+  twin's verdict through the store mutators, so the deletes emit
+  `api.events.POD_DELETE` like any other removal.
+- **grow** (live + pending members < desired): clone new member pods
+  from the gang's rank template (its lowest-ranked live member). The
+  clones enter the next cycle's pending batch, and the topology solve
+  anchors them on the block already holding the gang's residents
+  (`gangs.topology.gang_solve_body` primary-block rule) — so growth is
+  an O(changed) delta (new pods + their binds ride the store's delta
+  sink into the serving engine), never a gang re-placement.
+
+The elastic state machine (docs/GANGS.md): Stable -> (desired bump) ->
+Growing -> Stable in <= 2 cycles (reconcile creates, the next solve
+places); Stable -> (desired drop) -> Shrinking -> Stable in 1 cycle
+(reconcile deletes immediately). `elastic_satisfaction` scores the fleet:
+mean over elastic gangs of live/desired, the quality objective
+`tuning.quality` exports.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from scheduler_plugins_tpu.ops.network import MAX_COST
+
+I32 = np.int32
+I64 = np.int64
+
+
+def rank_release_keys(rank_nodes, live, node_block, block_cost):
+    """(G, M) int64 release-priority keys: `max-pair-cost * M + rank
+    index` for live ranks (unique keys — highest key releases first),
+    -1 for dead slots. Shared by the jit and numpy selections so the two
+    cannot disagree on ordering."""
+    import jax.numpy as jnp
+
+    G, M = rank_nodes.shape
+    nb = jnp.where(live, node_block[jnp.maximum(rank_nodes, 0)], -1)
+    known = nb >= 0
+    nb0 = jnp.maximum(nb, 0)
+    bc = block_cost[nb0[:, :, None], nb0[:, None, :]].astype(jnp.int64)
+    cost = jnp.where(known[:, :, None] & known[:, None, :], bc, MAX_COST)
+    same_node = rank_nodes[:, :, None] == rank_nodes[:, None, :]
+    cost = jnp.where(same_node, 0, cost)
+    valid = live[:, :, None] & live[:, None, :]
+    valid &= ~jnp.eye(M, dtype=bool)[None]
+    per_rank = jnp.max(jnp.where(valid, cost, 0), axis=2)  # (G, M)
+    keys = per_rank * M + jnp.arange(M)
+    return jnp.where(live, keys, jnp.int64(-1))
+
+
+def shrink_select(rank_nodes, live, node_block, block_cost, n_release):
+    """(G, M) bool release mask: for each gang, mark the `n_release[g]`
+    live ranks with the highest release keys (highest max inter-rank
+    cost first, highest index tie-break). Jittable — the `elastic_shrink`
+    program of the certification gates; `rank_nodes` is the resident
+    rank-assignment carry (`SolverState.rank_nodes`)."""
+    import jax.numpy as jnp
+
+    keys = rank_release_keys(rank_nodes, live, node_block, block_cost)
+    M = rank_nodes.shape[1]
+    # rank of each slot in descending key order (0 = released first)
+    order = jnp.argsort(-keys, axis=1)  # keys unique among live slots
+    pos = jnp.zeros_like(order).at[
+        jnp.arange(order.shape[0])[:, None], order
+    ].set(jnp.arange(M)[None, :].repeat(order.shape[0], axis=0))
+    return live & (pos < n_release[:, None])
+
+
+def shrink_select_np(rank_nodes, live, node_block, block_cost, n_release):
+    """Bit-identical host twin of `shrink_select` (the one `GangPhase`
+    actually applies — deletions are host mutations)."""
+    rank_nodes = np.asarray(rank_nodes)
+    live = np.asarray(live)
+    node_block = np.asarray(node_block)
+    block_cost = np.asarray(block_cost)
+    n_release = np.asarray(n_release)
+    G, M = rank_nodes.shape
+    nb = np.where(live, node_block[np.maximum(rank_nodes, 0)], -1)
+    known = nb >= 0
+    nb0 = np.maximum(nb, 0)
+    bc = block_cost[nb0[:, :, None], nb0[:, None, :]].astype(I64)
+    cost = np.where(known[:, :, None] & known[:, None, :], bc, MAX_COST)
+    same_node = rank_nodes[:, :, None] == rank_nodes[:, None, :]
+    cost = np.where(same_node, 0, cost)
+    valid = live[:, :, None] & live[:, None, :]
+    valid &= ~np.eye(M, dtype=bool)[None]
+    per_rank = np.max(np.where(valid, cost, 0), axis=2)
+    keys = np.where(live, per_rank * M + np.arange(M), -1)
+    order = np.argsort(-keys, axis=1, kind="stable")
+    pos = np.zeros_like(order)
+    np.put_along_axis(
+        pos, order, np.broadcast_to(np.arange(M), (G, M)).copy(), axis=1
+    )
+    return live & (pos < n_release[:, None])
+
+
+def elastic_bounds(pg):
+    """(min, desired, max) replica bounds for a PodGroup: `min_member` is
+    the quorum floor; `desired_replicas` defaults to min (rigid gang);
+    `max_replicas` caps desired. Clamping mirrors upstream scale
+    subresource semantics (desired is clamped into [min, max]); a
+    misconfigured `max_replicas < min_member` saturates at the quorum
+    floor — shrinking a gang below its own quorum would manufacture the
+    exact partial-rank state the solve exists to prevent."""
+    lo = int(pg.min_member)
+    desired = pg.desired_replicas if pg.desired_replicas is not None else lo
+    hi = pg.max_replicas if pg.max_replicas is not None else max(desired, lo)
+    hi = max(int(hi), lo)
+    return lo, int(min(max(desired, lo), hi)), hi
+
+
+def elastic_satisfaction(live_counts, desired_counts) -> float:
+    """Mean over elastic gangs of min(live/desired, 1) — 1.0 when every
+    elastic gang runs at its desired width (the Tesserae satisfaction
+    fraction). Gangs with desired 0 are skipped; empty input -> 1.0."""
+    live_counts = np.asarray(live_counts, np.float64)
+    desired_counts = np.asarray(desired_counts, np.float64)
+    mask = desired_counts > 0
+    if not mask.any():
+        return 1.0
+    frac = np.minimum(live_counts[mask] / desired_counts[mask], 1.0)
+    return float(frac.mean())

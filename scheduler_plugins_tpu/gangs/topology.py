@@ -1,0 +1,446 @@
+"""Rank-aware gang placement: topology-block waterfill as tensor math.
+
+"Rank-Aware Resource Scheduling for Tightly-Coupled MPI Workloads on
+Kubernetes" (arxiv 2603.22691) and "Tesserae" (arxiv 2508.04953) both show
+that for gang jobs *which* nodes host the ranks — not just whether quorum
+is reachable — dominates runtime: inter-rank network distance is the
+objective. The reference composes nothing here (Coscheduling admits by
+quorum alone, NetworkOverhead scores pods one at a time); this module is
+the composition, beyond the reference's scope (docs/GANGS.md).
+
+Model
+-----
+Nodes group into **topology blocks** (zone codes from the node labels; the
+three levels a rank pair can sit at are node / block / cross-block, with
+cross-block cost split by region — the NetworkTopology CR's zone and
+region weight tables, lowered once into one (B, B) `block_cost` matrix by
+`build_block_cost`). A gang of up to M ranks carries per-rank demand
+vectors (heterogeneous: an MPI launcher rank may want more than its
+workers). The placement objective per gang: minimize the max (and sum)
+inter-rank pair cost
+
+    cost(i, j) = 0                       same node
+                 block_cost[b_i, b_j]    otherwise (diag = SAME_ZONE_COST)
+
+subject to the identical hard constraints the per-pod solve enforces —
+fit (free capacity per node), quota caps (ElasticQuota max per
+namespace), and quorum (>= min_ranks ranks place, or NONE do).
+
+Algorithm (the topology-block waterfill, `gang_solve`)
+------------------------------------------------------
+One `lax.scan` over gangs in queue order (carried free/eq_used/rank_nodes
+— in-cycle mutations live in SolverState carries per CLAUDE.md, never
+re-reads of a static snapshot). Per gang:
+
+1. **Score blocks by packed-rank capacity**: inclusive cumulative rank
+   demand (float64 — exact < 2^53, the `ops.assign` cumulative-demand
+   bucket formulation; never a 2-D int64 cumsum) searchsorted against
+   each block's free totals — how many queue-ranked ranks the block
+   covers. Primary block = argmax packed capacity, lowest index on ties;
+   a gang with RESIDENT ranks (elastic growth) instead anchors on the
+   block holding most residents.
+2. **Spill order**: remaining blocks ascend by `block_cost[primary, b]`,
+   index tie-break (the key `cost * B + b` is unique, so any sort is
+   stable); unblocked nodes come after every block.
+3. **Exact rank scan**: ranks place one at a time in rank order, each to
+   the first node in block-first order with capacity (and quota
+   headroom) — the sequential-waterfill admission that keeps a bit-exact
+   host twin (`gang_solve_np`). The first rank that fits nowhere kills
+   the rest (placements are a queue prefix — no holes).
+4. **Quorum revert**: resident + newly placed ranks < min_ranks rolls the
+   gang's commits back — zero partial ranks, mirroring the whole-gang
+   PostFilter rejection. Elastic gangs (min < desired) keep any prefix
+   >= min.
+
+`gang_solve_np` is the bit-identical sequential twin gated by
+tests/test_differential.py (3-seed oracle: placements equal, fit/quota/
+quorum replay clean); `pair_costs`/`gang_cost_stats` score the result for
+the bench and the quality objectives (`tuning.quality.rank_gang_quality`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from flax import struct
+
+from scheduler_plugins_tpu.ops.network import MAX_COST, SAME_ZONE_COST
+
+I64 = np.int64
+I32 = np.int32
+
+#: node-order sentinel for "never place here" (masked node)
+_FAR = np.iinfo(np.int32).max
+
+
+@struct.dataclass
+class RankGangState:
+    """Snapshot-side tensors for one gang-phase solve.
+
+    Rank slots are per-gang rows: slot m of gang g is that gang's rank m
+    in rank order (residents first, then pending by queue order — the
+    order `gangs.phase.build_rank_gang_problem` fixes host-side).
+    """
+
+    rank_req: np.ndarray  # (G, M, R) int64 per-rank fit demand (pods slot 1)
+    rank_mask: np.ndarray  # (G, M) bool — real rank slots this cycle
+    #: (G, M) int32 resident rank -> node (-1 = pending, needs placement).
+    #: THE snapshot counterpart of the `SolverState.rank_nodes` carry
+    #: (state.snapshot.CARRY_COUNTERPARTS): the solve must thread its
+    #: in-cycle placements through the carry, never re-read this tensor.
+    prev_assigned: np.ndarray
+    min_ranks: np.ndarray  # (G,) int32 quorum (elastic min)
+    gang_ns: np.ndarray  # (G,) int32 namespace code (-1 = no quota scope)
+    gang_mask: np.ndarray  # (G,) bool
+    node_block: np.ndarray  # (N,) int32 topology-block (zone) code, -1 none
+    block_cost: np.ndarray  # (B, B) int32 inter-block cost, diag SAME_ZONE
+    quota_max: np.ndarray  # (Q, R) int64 ElasticQuota max per namespace
+    quota_has: np.ndarray  # (Q,) bool namespace carries a quota
+
+
+# ---------------------------------------------------------------------------
+# block cost lowering (host)
+# ---------------------------------------------------------------------------
+
+
+def build_block_cost(zones, regions, zone_region, zone_cost, region_cost):
+    """(B, B) int32 inter-block cost matrix over zone codes.
+
+    Composition mirrors the NetworkOverhead pair tables
+    (`ops.network.dependency_tallies`): same block -> SAME_ZONE_COST;
+    different blocks, zone-cost pair known -> that cost; unknown but both
+    regions known and different with a region-cost pair -> that cost;
+    anything else -> MAX_COST. `zone_region` maps zone code -> region code
+    (-1 unknown); `zone_cost`/`region_cost` are the dense -1-for-missing
+    matrices `plugins.networkaware.NetworkOverhead.prepare_cluster`
+    builds.
+    """
+    B = max(len(zones), 1)
+    zone_cost = np.asarray(zone_cost)
+    region_cost = np.asarray(region_cost)
+    zone_region = np.asarray(zone_region)
+    out = np.full((B, B), MAX_COST, I32)
+    for a in range(B):
+        for b in range(B):
+            if a == b:
+                out[a, b] = SAME_ZONE_COST
+                continue
+            if a < zone_cost.shape[0] and b < zone_cost.shape[1] and \
+                    zone_cost[a, b] >= 0:
+                out[a, b] = zone_cost[a, b]
+                continue
+            ra = zone_region[a] if a < zone_region.shape[0] else -1
+            rb = zone_region[b] if b < zone_region.shape[0] else -1
+            if ra >= 0 and rb >= 0:
+                if ra == rb:
+                    # same region, no zone pair in the CR: the reference's
+                    # missing-zone-lookup MaxCost path
+                    out[a, b] = MAX_COST
+                elif region_cost[ra, rb] >= 0:
+                    out[a, b] = region_cost[ra, rb]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the jittable solve
+# ---------------------------------------------------------------------------
+
+
+def packed_rank_capacity(cumdem, block_free):
+    """(B,) int32 packed-rank capacity per block: how many queue-ranked
+    ranks each block's free totals cover — the `ops.assign`
+    `_cumulative_demand_positions` bucketing transposed (searchsorted of
+    block capacity into the inclusive cumulative demand, min over
+    resources). `cumdem` (M, R) float64 inclusive cumulative rank demand;
+    `block_free` (B, R) non-negative block free totals."""
+    import jax
+    import jax.numpy as jnp
+
+    # count of m with cumdem[m, r] <= block_free[b, r], per resource
+    counts = jax.vmap(
+        lambda cd, bf: jnp.searchsorted(cd, bf, side="right"),
+        in_axes=(1, 1), out_axes=1,
+    )(cumdem, block_free.astype(jnp.float64))  # (B, R)
+    return jnp.min(counts, axis=1).astype(jnp.int32)
+
+
+def gang_solve_body(gangs: RankGangState, state0, node_mask):
+    """Traced topology-block waterfill over every gang (see module doc).
+
+    `state0` is a `framework.plugin.SolverState` carrying `free` (N, R),
+    `eq_used` (Q, R) and `rank_nodes` (G, M) — `rank_nodes` MUST be
+    initialized from `gangs.prev_assigned` (the resident assignment; the
+    carry is the live copy, the snapshot tensor stays static). Returns
+    (rank_nodes, admitted, placed_new, state) with the final carries.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    G, M, R = gangs.rank_req.shape
+    N = state0.free.shape[0]
+    B = gangs.block_cost.shape[0]
+    node_block = gangs.node_block
+    block_cost = gangs.block_cost.astype(jnp.int32)
+    blk = jnp.maximum(node_block, 0)
+    blocked = (node_block >= 0) & node_mask
+
+    def place_gang(carry, g):
+        free, eq_used, rank_nodes = carry
+        pending = gangs.rank_mask[g] & (gangs.prev_assigned[g] < 0)  # (M,)
+        resident = gangs.rank_mask[g] & (gangs.prev_assigned[g] >= 0)
+        dem = jnp.where(pending[:, None], gangs.rank_req[g], 0)  # (M, R)
+
+        # 1. block scoring: packed-rank capacity over the gang's pending
+        # demand prefix (cumulative-demand bucket machinery, f64 exact)
+        freec = jnp.where(node_mask[:, None], jnp.clip(free, 0, None), 0)
+        block_free = jnp.zeros((B, R), free.dtype).at[blk].add(
+            jnp.where(blocked[:, None], freec, 0)
+        )
+        cumdem = jnp.cumsum(dem.astype(jnp.float64), axis=0)  # (M, R)
+        packed = packed_rank_capacity(cumdem, block_free)  # (B,)
+        res_cnt = jnp.zeros(B, jnp.int32).at[
+            blk[jnp.maximum(gangs.prev_assigned[g], 0)]
+        ].add(
+            jnp.where(
+                resident
+                & (node_block[jnp.maximum(gangs.prev_assigned[g], 0)] >= 0),
+                1, 0,
+            )
+        )
+        has_res = res_cnt.sum() > 0
+        # argmax takes the FIRST max — lowest block index on ties, in both
+        # jnp and np (the twin relies on this)
+        primary = jnp.where(
+            has_res, jnp.argmax(res_cnt), jnp.argmax(packed)
+        ).astype(jnp.int32)
+
+        # 2. spill order: cost from primary asc, index tie-break (unique
+        # keys make the sort order-independent); primary pinned first
+        cost_from = block_cost[primary].at[primary].set(-1)
+        block_order = jnp.argsort(
+            cost_from.astype(jnp.int64) * B + jnp.arange(B)
+        )
+        block_pos = jnp.zeros(B, jnp.int64).at[block_order].set(
+            jnp.arange(B, dtype=jnp.int64)
+        )
+        node_pos = jnp.where(
+            blocked,
+            block_pos[blk] * N + jnp.arange(N),
+            jnp.where(node_mask, jnp.int64(B) * N + jnp.arange(N),
+                      jnp.int64(_FAR)),
+        )  # (N,) unique finite positions for usable nodes
+
+        ns = gangs.gang_ns[g]
+        nsc = jnp.maximum(ns, 0)
+        has_quota = (ns >= 0) & gangs.quota_has[nsc]
+        qmax = gangs.quota_max[nsc]
+
+        # 3. exact rank scan: first-fit in block-first order, dead after
+        # the first unplaceable rank (prefix placements, no holes)
+        def place_rank(c, m):
+            free_l, eq_l, dead = c
+            d = dem[m]
+            is_pending = pending[m]
+            fits = jnp.all(free_l >= d[None, :], axis=1) & node_mask
+            qok = ~has_quota | jnp.all(eq_l[nsc] + d <= qmax)
+            feasible = fits & is_pending & ~dead & qok
+            pos = jnp.where(feasible, node_pos, jnp.int64(_FAR))
+            choice = jnp.where(
+                feasible.any(), jnp.argmin(pos).astype(jnp.int32),
+                jnp.int32(-1),
+            )
+            placed = choice >= 0
+            onehot = (jnp.arange(N) == choice)[:, None]
+            free_l = free_l - jnp.where(placed, onehot * d[None, :], 0)
+            eq_l = eq_l.at[nsc].add(
+                jnp.where(placed & has_quota, d, 0)
+            )
+            dead = dead | (is_pending & ~placed)
+            return (free_l, eq_l, dead), choice
+
+        (free_l, eq_l, _), choices = jax.lax.scan(
+            place_rank, (free, eq_used, jnp.bool_(False)), jnp.arange(M)
+        )
+
+        # 4. quorum revert: zero partial ranks below min
+        q_new = jnp.sum(choices >= 0).astype(jnp.int32)
+        q_total = q_new + jnp.sum(resident).astype(jnp.int32)
+        admitted = gangs.gang_mask[g] & (q_total >= gangs.min_ranks[g])
+        free = jnp.where(admitted, free_l, free)
+        eq_used = jnp.where(admitted, eq_l, eq_used)
+        row = jnp.where(
+            resident,
+            gangs.prev_assigned[g],
+            jnp.where(admitted, choices, jnp.int32(-1)),
+        )
+        rank_nodes = rank_nodes.at[g].set(row)
+        return (free, eq_used, rank_nodes), (
+            admitted, jnp.where(admitted, q_new, 0)
+        )
+
+    (free, eq_used, rank_nodes), (admitted, placed_new) = jax.lax.scan(
+        place_gang,
+        (state0.free, state0.eq_used, state0.rank_nodes),
+        jnp.arange(G),
+    )
+    state = state0.replace(free=free, eq_used=eq_used, rank_nodes=rank_nodes)
+    return rank_nodes, admitted, placed_new, state
+
+
+def gang_solve_fn():
+    """The jitted gang-solve program — one constructor so the bench, the
+    phase, and the AOT/jaxpr certification gates (tools/tpu_lower.py,
+    tools/jaxpr_audit.py `rank_gang_solve`) trace the same function."""
+    import jax
+
+    return jax.jit(gang_solve_body)
+
+
+def pair_costs(rank_nodes, rank_mask, node_block, block_cost):
+    """(G, M, M) int32 inter-rank pair costs (-1 = invalid pair: an
+    unplaced slot, a padded slot, or the diagonal). Same-node pairs cost
+    0; otherwise `block_cost[b_i, b_j]`, MAX_COST when either block is
+    unknown. Works on jnp or np inputs (pure numpy here: the bench and
+    the quality objectives consume host copies)."""
+    rank_nodes = np.asarray(rank_nodes)
+    rank_mask = np.asarray(rank_mask)
+    node_block = np.asarray(node_block)
+    block_cost = np.asarray(block_cost)
+    live = rank_mask & (rank_nodes >= 0)  # (G, M)
+    nb = np.where(live, node_block[np.maximum(rank_nodes, 0)], -1)
+    known = nb >= 0
+    nb0 = np.maximum(nb, 0)
+    bc = block_cost[nb0[:, :, None], nb0[:, None, :]]
+    cost = np.where(
+        known[:, :, None] & known[:, None, :], bc, MAX_COST
+    ).astype(I32)
+    same_node = rank_nodes[:, :, None] == rank_nodes[:, None, :]
+    cost = np.where(same_node, 0, cost)
+    valid = live[:, :, None] & live[:, None, :]
+    M = rank_nodes.shape[1]
+    valid &= ~np.eye(M, dtype=bool)[None]
+    return np.where(valid, cost, -1)
+
+
+def gang_cost_stats(rank_nodes, rank_mask, node_block, block_cost):
+    """Per-gang placement-cost stats: (max_cost (G,), sum_cost (G,)) int64
+    over valid rank pairs (sum counts each unordered pair once; gangs with
+    < 2 placed ranks score 0)."""
+    pc = pair_costs(rank_nodes, rank_mask, node_block, block_cost)
+    valid = pc >= 0
+    max_cost = np.where(
+        valid.any(axis=(1, 2)), np.max(np.where(valid, pc, 0), axis=(1, 2)), 0
+    ).astype(I64)
+    sum_cost = (np.sum(np.where(valid, pc, 0), axis=(1, 2)) // 2).astype(I64)
+    return max_cost, sum_cost
+
+
+# ---------------------------------------------------------------------------
+# the bit-identical numpy sequential twin (differential-gate parity path)
+# ---------------------------------------------------------------------------
+
+
+def gang_solve_np(gangs: RankGangState, free0, eq_used0, node_mask):
+    """Host-side twin of `gang_solve_body`: identical operation order,
+    identical tie-breaks (np.argmax/argmin take the first extremum, same
+    as jnp), int64 throughout — bit-exact against the jit solve
+    (tests/test_differential.py gates this across seeds). Returns
+    (rank_nodes (G, M) int32, admitted (G,) bool, placed_new (G,) int32,
+    free (N, R), eq_used (Q, R))."""
+    rank_req = np.asarray(gangs.rank_req)
+    rank_mask = np.asarray(gangs.rank_mask)
+    prev = np.asarray(gangs.prev_assigned)
+    min_ranks = np.asarray(gangs.min_ranks)
+    gang_ns = np.asarray(gangs.gang_ns)
+    gang_mask = np.asarray(gangs.gang_mask)
+    node_block = np.asarray(gangs.node_block)
+    block_cost = np.asarray(gangs.block_cost)
+    quota_max = np.asarray(gangs.quota_max)
+    quota_has = np.asarray(gangs.quota_has)
+    node_mask = np.asarray(node_mask)
+
+    G, M, R = rank_req.shape
+    N = free0.shape[0]
+    B = block_cost.shape[0]
+    blk = np.maximum(node_block, 0)
+    blocked = (node_block >= 0) & node_mask
+
+    free = np.asarray(free0).astype(I64).copy()
+    eq_used = np.asarray(eq_used0).astype(I64).copy()
+    rank_nodes = prev.astype(I32).copy()
+    admitted = np.zeros(G, bool)
+    placed_new = np.zeros(G, I32)
+
+    for g in range(G):
+        pending = rank_mask[g] & (prev[g] < 0)
+        resident = rank_mask[g] & (prev[g] >= 0)
+        dem = np.where(pending[:, None], rank_req[g], 0)
+
+        freec = np.where(node_mask[:, None], np.clip(free, 0, None), 0)
+        block_free = np.zeros((B, R), I64)
+        np.add.at(block_free, blk[blocked], freec[blocked])
+        cumdem = np.cumsum(dem.astype(np.float64), axis=0)
+        packed = np.zeros(B, I32)
+        for b in range(B):
+            counts = [
+                int(np.searchsorted(
+                    cumdem[:, r], float(block_free[b, r]), side="right"
+                ))
+                for r in range(R)
+            ]
+            packed[b] = min(counts)
+        res_cnt = np.zeros(B, I32)
+        res_nodes = np.maximum(prev[g], 0)
+        res_ok = resident & (node_block[res_nodes] >= 0)
+        np.add.at(res_cnt, blk[res_nodes[res_ok]], 1)
+        primary = int(np.argmax(res_cnt) if res_cnt.sum() > 0
+                      else np.argmax(packed))
+
+        cost_from = block_cost[primary].astype(I64).copy()
+        cost_from[primary] = -1
+        block_order = np.argsort(cost_from * B + np.arange(B))
+        block_pos = np.zeros(B, I64)
+        block_pos[block_order] = np.arange(B)
+        node_pos = np.where(
+            blocked,
+            block_pos[blk] * N + np.arange(N),
+            np.where(node_mask, I64(B) * N + np.arange(N), I64(_FAR)),
+        )
+
+        ns = int(gang_ns[g])
+        nsc = max(ns, 0)
+        has_quota = ns >= 0 and bool(quota_has[nsc])
+
+        free_l = free.copy()
+        eq_l = eq_used.copy()
+        choices = np.full(M, -1, I32)
+        dead = False
+        for m in range(M):
+            if not pending[m] or dead:
+                continue
+            d = dem[m]
+            fits = np.all(free_l >= d[None, :], axis=1) & node_mask
+            qok = (not has_quota) or bool(
+                np.all(eq_l[nsc] + d <= quota_max[nsc])
+            )
+            feasible = fits & qok
+            if not feasible.any():
+                dead = True
+                continue
+            pos = np.where(feasible, node_pos, I64(_FAR))
+            choice = int(np.argmin(pos))
+            choices[m] = choice
+            free_l[choice] -= d
+            if has_quota:
+                eq_l[nsc] += d
+
+        q_new = int((choices >= 0).sum())
+        q_total = q_new + int(resident.sum())
+        ok = bool(gang_mask[g]) and q_total >= int(min_ranks[g])
+        if ok:
+            free = free_l
+            eq_used = eq_l
+        admitted[g] = ok
+        placed_new[g] = q_new if ok else 0
+        row = np.where(resident, prev[g], choices if ok else -1)
+        rank_nodes[g] = row
+    return rank_nodes, admitted, placed_new, free, eq_used

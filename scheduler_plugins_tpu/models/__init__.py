@@ -8,5 +8,6 @@ from scheduler_plugins_tpu.models.scenarios import (  # noqa: F401
     mixed_scenario,
     network_scenario,
     numa_scenario,
+    rank_gang_scenario,
     trimaran_scenario,
 )

@@ -90,6 +90,21 @@ class ServeEngine:
         self._verify_pending = False
         self.antientropy_divergences = 0
         self.last_fault: Optional[str] = None
+        # -- rank-gang awareness (docs/GANGS.md) ------------------------
+        #: gang full_name -> {pod uid: node name}: the per-gang resident
+        #: rank-assignment mirror, maintained O(changed) from the SAME
+        #: drained delta stream that feeds the node columns — elastic
+        #: grow/shrink consumers read the current rank roster without a
+        #: cluster re-scan. Gang-carrying rosters still DEGRADE the
+        #: snapshot path to fallback (`compatible` returns False while
+        #: PodGroups exist): the resident node columns cannot express
+        #: gang/quota side tables, and serving them anyway would
+        #: silently mis-serve — the mirror keeps absorbing so serving
+        #: resumes the moment the gangs drain away.
+        self.resident_ranks: dict[str, dict] = {}
+        #: refreshes that fell back BECAUSE the cluster carried PodGroups
+        #: (the measured cost of running gangs on a serve-mode daemon)
+        self.gang_fallbacks = 0
 
     @staticmethod
     def _verify_every_default() -> int:
@@ -212,6 +227,8 @@ class ServeEngine:
         grow = self._nodes is not None and n_nodes > self._npad
 
         if not self.compatible(cluster, pending):
+            if cluster.pod_groups:
+                self.gang_fallbacks += 1
             # keep the columns in sync while incompatible; a rebase-class
             # event just drops the base (rebuilt at the next compatible
             # refresh)
@@ -294,6 +311,23 @@ class ServeEngine:
                 )
             else:  # pod usage transitions
                 pod, node_name = ev[1], ev[2]
+                gang = pod.pod_group()
+                if gang:
+                    # O(changed) per-gang resident rank mirror: assigns
+                    # record the rank's node, unassigns drop it (the
+                    # terminating transition keeps the slot — the rank
+                    # still occupies its node until the delete lands)
+                    roster = self.resident_ranks.setdefault(
+                        f"{pod.namespace}/{gang}", {}
+                    )
+                    if kind == D.POD_ASSIGN:
+                        roster[pod.uid] = node_name
+                    elif kind != D.POD_TERMINATING:
+                        roster.pop(pod.uid, None)
+                        if not roster:
+                            self.resident_ranks.pop(
+                                f"{pod.namespace}/{gang}", None
+                            )
                 slot = self._slots.get(node_name)
                 if slot is None:
                     # pod referenced a node the engine never saw (cross-

@@ -69,6 +69,11 @@ CARRY_COUNTERPARTS = {
     ".gangs.assigned": "gang_scheduled",
     ".network.placed_node": "net_placed",
     ".numa.available": "numa_avail",
+    # the gang phase's resident rank assignment (gangs.topology
+    # RankGangState.prev_assigned -> the SolverState.rank_nodes carry):
+    # the rank-gang solve must thread in-cycle placements through the
+    # carry, never re-read the static resident tensor
+    ".ranks.prev_assigned": "rank_nodes",
 }
 
 

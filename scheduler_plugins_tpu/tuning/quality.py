@@ -68,6 +68,11 @@ SENSE = {
     "preemptions": -1,
     "nominations": -1,
     "gang_latency_cycles": -1,
+    # rank-aware gang placement (gangs.topology; docs/GANGS.md)
+    "gang_spread_cost": -1,
+    "rank_cost_max": -1,
+    "rank_cost_p99": -1,
+    "elastic_satisfaction": +1,
 }
 
 #: the objectives `cycle_quality` / `cycle_quality_np` emit per cycle
@@ -282,6 +287,71 @@ def score_drift(scores, assignment, anchor) -> float:
 
     s_ref = ssum(ref)
     return (ssum(a) - s_ref) / max(abs(s_ref), 1)
+
+
+# ---------------------------------------------------------------------------
+# rank-aware gang placement objectives (gangs.topology; docs/GANGS.md)
+# ---------------------------------------------------------------------------
+
+
+def rank_gang_quality(rank_nodes, rank_mask, node_block, block_cost) -> dict:
+    """Placement-quality objectives of a rank-gang solve — host float64
+    reductions over `gangs.topology.pair_costs`:
+
+    - ``gang_spread_cost``: mean over solved gangs of the SUM of
+      inter-rank pair costs (each unordered pair once) — the aggregate
+      network bill of the fleet's gang placements.
+    - ``rank_cost_max``: max inter-rank pair cost across every gang — the
+      single worst rank pair (the tightly-coupled MPI headline: one slow
+      link paces the whole collective).
+    - ``rank_cost_p99``: 99th percentile over ALL valid rank-pair costs —
+      the tail the max alone can hide.
+
+    Gangs with < 2 placed ranks contribute no pairs; with no pairs at all
+    every objective is 0.0.
+    """
+    from scheduler_plugins_tpu.gangs.topology import pair_costs
+
+    pc = np.asarray(
+        pair_costs(rank_nodes, rank_mask, node_block, block_cost)
+    )
+    valid = pc >= 0
+    if not valid.any():
+        return {
+            "gang_spread_cost": 0.0, "rank_cost_max": 0.0,
+            "rank_cost_p99": 0.0,
+        }
+    per_gang_sum = np.sum(np.where(valid, pc, 0), axis=(1, 2)) / 2.0
+    gang_has = valid.any(axis=(1, 2))
+    flat = pc[valid].astype(np.float64)
+    return {
+        "gang_spread_cost": float(per_gang_sum[gang_has].mean()),
+        "rank_cost_max": float(flat.max()),
+        "rank_cost_p99": float(np.percentile(flat, 99)),
+    }
+
+
+def elastic_satisfaction_quality(reports_or_counts) -> float:
+    """Fleet elastic-satisfaction fraction (`gangs.elastic`): accepts
+    either (live_counts, desired_counts) arrays or an iterable of
+    `CycleReport.rank_gangs` dicts (the LAST observation per gang wins —
+    satisfaction is a state, not a flow)."""
+    from scheduler_plugins_tpu.gangs.elastic import elastic_satisfaction
+
+    if isinstance(reports_or_counts, tuple):
+        return elastic_satisfaction(*reports_or_counts)
+    latest: dict = {}
+    for stats in reports_or_counts:
+        for gang, row in stats.items():
+            latest[gang] = (
+                row.get("resident", 0) + row.get("placed_new", 0),
+                row.get("desired", 0),
+            )
+    if not latest:
+        return 1.0
+    live = [v[0] for v in latest.values()]
+    desired = [v[1] for v in latest.values()]
+    return elastic_satisfaction(live, desired)
 
 
 # ---------------------------------------------------------------------------

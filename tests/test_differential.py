@@ -732,3 +732,181 @@ class TestShardedWaveHardConstraintParity:
         assert helper._numa_ok(an, snap)
         assert helper._gang_quorum_ok(an, wn, snap)
         assert (an >= 0).sum() > 0
+
+
+class TestRankGangDifferential:
+    """ISSUE 10 oracle discipline: the jit topology-block waterfill
+    (`gangs.topology.gang_solve_body`) must bit-match its numpy
+    sequential twin across seeds, and an INDEPENDENT numpy replay of the
+    placements must prove the hard constraints — fit (no node over
+    free0), quota caps (no namespace over ElasticQuota max), and quorum
+    (an admitted gang's resident+new ranks >= min; a rejected gang
+    places ZERO new ranks)."""
+
+    def _random_problem(self, seed):
+        from scheduler_plugins_tpu.gangs.topology import RankGangState
+
+        rng = np.random.default_rng(seed)
+        N = int(rng.integers(8, 24))
+        B = int(rng.integers(2, 5))
+        G = int(rng.integers(2, 6))
+        M = int(rng.integers(3, 9))
+        R = 3  # cpu, memory, pods-style axis
+        Q = int(rng.integers(1, 4))
+
+        node_block = rng.integers(-1, B, size=N).astype(np.int32)
+        node_mask = rng.random(N) > 0.1
+        block_cost = rng.integers(1, 60, size=(B, B)).astype(np.int32)
+        block_cost = np.maximum(block_cost, block_cost.T)
+        np.fill_diagonal(block_cost, 1)
+
+        # synthetic 3-slot axis local to this oracle (NOT the CANONICAL
+        # layout — the gang solve is axis-order agnostic)
+        free0 = np.zeros((N, R), np.int64)
+        free0[:, 0] = rng.integers(1_000, 8_000, size=N)  # graft-lint: ignore[GL005]
+        free0[:, 1] = rng.integers(4, 64, size=N)  # graft-lint: ignore[GL005]
+        free0[:, 2] = rng.integers(2, 10, size=N)  # graft-lint: ignore[GL005]
+
+        rank_req = np.zeros((G, M, R), np.int64)
+        rank_mask = np.zeros((G, M), bool)
+        prev = np.full((G, M), -1, np.int32)
+        min_ranks = np.ones(G, np.int32)
+        gang_ns = rng.integers(-1, Q, size=G).astype(np.int32)
+        gang_mask = np.ones(G, bool)
+        for g in range(G):
+            k = int(rng.integers(2, M + 1))
+            rank_mask[g, :k] = True
+            rank_req[g, :k, 0] = rng.integers(200, 3_000, size=k)
+            rank_req[g, :k, 1] = rng.integers(1, 8, size=k)
+            rank_req[g, :k, 2] = 1
+            min_ranks[g] = int(rng.integers(1, k + 1))
+            # some gangs carry residents (elastic growth mid-flight)
+            if rng.random() < 0.5:
+                n_res = int(rng.integers(1, k))
+                prev[g, :n_res] = rng.integers(0, N, size=n_res)
+
+        eq_used0 = np.zeros((Q, R), np.int64)
+        quota_max = np.full((Q, R), np.iinfo(np.int64).max, np.int64)
+        quota_has = rng.random(Q) > 0.4
+        for q in range(Q):
+            if quota_has[q]:
+                quota_max[q, 0] = int(rng.integers(2_000, 20_000))
+                quota_max[q, 1] = int(rng.integers(16, 128))
+                quota_max[q, 2] = int(rng.integers(4, 32))
+                eq_used0[q, 0] = int(rng.integers(0, 1_000))
+
+        gangs = RankGangState(
+            rank_req=rank_req, rank_mask=rank_mask, prev_assigned=prev,
+            min_ranks=min_ranks, gang_ns=gang_ns, gang_mask=gang_mask,
+            node_block=node_block, block_cost=block_cost,
+            quota_max=quota_max, quota_has=quota_has,
+        )
+        return gangs, free0, eq_used0, node_mask
+
+    def _replay_oracle(self, gangs, free0, eq_used0, node_mask,
+                      rank_nodes, admitted, placed_new):
+        """Independent numpy audit — written against the CONTRACT, not
+        the solver's code paths."""
+        G, M, R = gangs.rank_req.shape
+        new = (rank_nodes >= 0) & (gangs.prev_assigned < 0) & gangs.rank_mask
+        # fit: total newly placed demand per node within free0, and only
+        # on schedulable nodes
+        used = np.zeros_like(free0)
+        for g in range(G):
+            for m in range(M):
+                if new[g, m]:
+                    n = int(rank_nodes[g, m])
+                    assert node_mask[n], (g, m, n)
+                    used[n] += gangs.rank_req[g, m]
+        assert (used <= free0).all(), "node over free capacity"
+        # quota caps: per-namespace new demand within max - used0
+        for q in range(gangs.quota_max.shape[0]):
+            if not gangs.quota_has[q]:
+                continue
+            dem = np.zeros(R, np.int64)
+            for g in range(G):
+                if gangs.gang_ns[g] == q:
+                    dem += gangs.rank_req[g][new[g]].sum(axis=0)
+            assert (eq_used0[q] + dem <= gangs.quota_max[q]).all(), \
+                f"namespace {q} over quota max"
+        # quorum / zero-partial
+        for g in range(G):
+            resident = int(
+                ((gangs.prev_assigned[g] >= 0) & gangs.rank_mask[g]).sum()
+            )
+            n_new = int(new[g].sum())
+            if admitted[g]:
+                assert resident + n_new >= int(gangs.min_ranks[g]), g
+                assert n_new == int(placed_new[g]), g
+            else:
+                assert n_new == 0, f"rejected gang {g} left partial ranks"
+
+    def test_jit_matches_twin_and_oracle_across_seeds(self):
+        import jax
+        import jax.numpy as jnp
+
+        from scheduler_plugins_tpu.framework.plugin import SolverState
+        from scheduler_plugins_tpu.gangs.topology import (
+            gang_solve_fn,
+            gang_solve_np,
+        )
+
+        fn = gang_solve_fn()
+        for seed in range(3):
+            gangs, free0, eq_used0, node_mask = self._random_problem(
+                1000 + seed
+            )
+            rn_np, adm_np, new_np, free_np, eq_np = gang_solve_np(
+                gangs, free0, eq_used0, node_mask
+            )
+            state0 = SolverState(
+                free=jnp.asarray(free0),
+                eq_used=jnp.asarray(eq_used0),
+                rank_nodes=jnp.asarray(gangs.prev_assigned),
+            )
+            rn_j, adm_j, new_j, state = fn(
+                jax.tree.map(jnp.asarray, gangs), state0,
+                jnp.asarray(node_mask),
+            )
+            assert (np.asarray(rn_j) == rn_np).all(), f"seed {seed}"
+            assert (np.asarray(adm_j) == adm_np).all(), f"seed {seed}"
+            assert (np.asarray(new_j) == new_np).all(), f"seed {seed}"
+            assert (np.asarray(state.free) == free_np).all(), f"seed {seed}"
+            assert (np.asarray(state.eq_used) == eq_np).all(), f"seed {seed}"
+            self._replay_oracle(
+                gangs, free0, eq_used0, node_mask, rn_np, adm_np, new_np
+            )
+
+    def test_shrink_selection_jit_matches_twin(self):
+        import jax
+
+        from scheduler_plugins_tpu.gangs.elastic import (
+            shrink_select,
+            shrink_select_np,
+        )
+
+        for seed in range(3):
+            gangs, free0, _, _ = self._random_problem(2000 + seed)
+            rng = np.random.default_rng(seed)
+            G, M = gangs.rank_mask.shape
+            N = free0.shape[0]
+            rank_nodes = np.where(
+                gangs.rank_mask, rng.integers(0, N, size=(G, M)), -1
+            ).astype(np.int32)
+            live = rank_nodes >= 0
+            n_release = rng.integers(0, 3, size=G).astype(np.int32)
+            got = np.asarray(jax.jit(shrink_select)(
+                rank_nodes, live, gangs.node_block, gangs.block_cost,
+                n_release,
+            ))
+            want = shrink_select_np(
+                rank_nodes, live, gangs.node_block, gangs.block_cost,
+                n_release,
+            )
+            assert (got == want).all(), f"seed {seed}"
+            # contract: exactly min(n_release, live) released, live only
+            assert (got <= live).all()
+            assert (
+                got.sum(axis=1)
+                == np.minimum(n_release, live.sum(axis=1))
+            ).all()

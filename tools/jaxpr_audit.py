@@ -130,6 +130,21 @@ ROLE_OVERRIDES = {
     # (labeling it aux keeps JA001's snapshot-bypass lattice honest about
     # where candidate config enters the program)
     "sweep_solve": ("snap", "state", "aux", "aux.weights"),
+    # gang_solve_body(gangs, state0, node_mask): the RankGangState arg is
+    # the gang phase's snapshot family — labeling it snap.ranks makes its
+    # `prev_assigned` leaf the CARRY_COUNTERPARTS twin of the
+    # SolverState.rank_nodes carry, so JA001 proves the solve never
+    # bypasses the rank-assignment carry (the state arg keeps its
+    # type-derived "state" role)
+    "rank_gang_solve": ("snap.ranks", "state", "snap.nodes.mask"),
+    # shrink_select(rank_nodes, live, node_block, block_cost, n_release):
+    # rank_nodes is the RESIDENT rank-assignment carry (the elastic delta
+    # program mutates resident state, not a snapshot); the release count
+    # is elastic config
+    "elastic_shrink": (
+        "state.rank_nodes", "snap.ranks.rank_mask", "snap.ranks.node_block",
+        "snap.ranks.block_cost", "elastic.release",
+    ),
 }
 
 

@@ -408,6 +408,74 @@ def build_sharded_wave_chunk():
     return fn, args, mesh
 
 
+def _gang_problem():
+    """Reduced rank-gang problem shared by the two gang programs: the
+    config-10 scenario generators at smoke shape, lowered through the
+    SAME `gangs.phase.build_rank_gang_problem` the shipped phase uses."""
+    from scheduler_plugins_tpu.gangs.phase import build_rank_gang_problem
+    from scheduler_plugins_tpu.models import rank_gang_scenario
+
+    cluster = rank_gang_scenario(
+        n_nodes=16, n_regions=2, zones_per_region=2, n_mpi=2, mpi_ranks=4,
+        n_dl=1, dl_min=2, dl_desired=3, dl_max=4,
+    )
+    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+    prob = build_rank_gang_problem(cluster, pending, now=0)
+    assert prob is not None
+    return prob
+
+
+def build_rank_gang_solve():
+    """`gangs.topology.gang_solve_body` — the topology-block waterfill
+    gang solve (scan over gangs, carried free/eq_used/rank_nodes). The
+    `SolverState.rank_nodes` carry is initialized from the resident
+    assignment (`RankGangState.prev_assigned` — its CARRY_COUNTERPARTS
+    snapshot twin), so the jaxpr audit's JA001 can prove the solve
+    threads placements through the carry."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.framework.plugin import SolverState
+    from scheduler_plugins_tpu.gangs.topology import gang_solve_fn
+
+    prob = _gang_problem()
+    gangs = jax.tree.map(jnp.asarray, prob["gangs"])
+    state0 = SolverState(
+        free=jnp.asarray(prob["free0"]),
+        eq_used=jnp.asarray(prob["eq_used0"]),
+        rank_nodes=jnp.asarray(prob["gangs"].prev_assigned),
+    )
+    return gang_solve_fn(), (gangs, state0, jnp.asarray(prob["node_mask"])), None
+
+
+def build_elastic_shrink():
+    """`gangs.elastic.shrink_select` — the elastic shrink-selection
+    program (highest-cost ranks released first) over the resident
+    rank-assignment carry."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_plugins_tpu.gangs.elastic import shrink_select
+
+    prob = _gang_problem()
+    gangs = prob["gangs"]
+    G, M = gangs.rank_mask.shape
+    # a resident assignment: every masked slot on some node (the shrink
+    # program runs on LIVE gangs)
+    rank_nodes = np.where(
+        gangs.rank_mask, np.arange(M)[None, :] % prob["free0"].shape[0], -1
+    ).astype(np.int32)
+    args = (
+        jnp.asarray(rank_nodes),
+        jnp.asarray(gangs.rank_mask),
+        jnp.asarray(gangs.node_block),
+        jnp.asarray(gangs.block_cost),
+        jnp.asarray(np.ones(G, np.int32)),
+    )
+    return jax.jit(shrink_select), args, None
+
+
 def build_sweep_solve():
     """The vmapped counterfactual weight sweep (`parallel.solver
     .sweep_solve_fn` — the tuning observatory's hot program): the
@@ -443,6 +511,8 @@ PROGRAMS = {
     "serving_delta_apply": build_serving_delta_apply,
     "sharded_wave_chunk": build_sharded_wave_chunk,
     "sweep_solve": build_sweep_solve,
+    "rank_gang_solve": build_rank_gang_solve,
+    "elastic_shrink": build_elastic_shrink,
     "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
     "bench_cfg1_flagship": build_cfg1_flagship,
     "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
