@@ -94,6 +94,16 @@ mega:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --chaos-smoke
 
+# CI endurance gate: reduced cluster-life config-11 run (one seeded
+# churn+gangs+chaos+waves stream, concurrent pipelined cycle engine vs
+# the serial engine, shared scheduler) — the pipelined engine must beat
+# the serial engine >= 1.5x on serve-phase (churn+waves) cycles/s with IDENTICAL
+# per-cycle placements, a bit-identical final cluster state and a clean
+# replayed capacity audit
+.PHONY: endurance-smoke
+endurance-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --endurance-smoke
+
 # CI rank-gang gate: reduced config-10 run — the gang phase's max
 # inter-rank cost strictly below the quorum-only Coscheduling baseline on
 # the same event stream, jit solve bit-identical to its numpy sequential
@@ -107,7 +117,7 @@ gang-smoke:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke chaos-smoke gang-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke tune-smoke chaos-smoke gang-smoke endurance-smoke
 
 .PHONY: lint
 lint:

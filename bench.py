@@ -658,7 +658,7 @@ CONFIG_METRICS = {
     5: "network_pods_per_sec", 6: "north_star_pods_per_sec",
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
-    10: "rank_gang_pods_per_sec",
+    10: "rank_gang_pods_per_sec", 11: "cluster_life_pods_per_sec",
 }
 
 
@@ -1913,6 +1913,560 @@ def gang_smoke(max_convergence=2):
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# config 11: cluster life — endurance composition, pipelined vs serial engine
+# ---------------------------------------------------------------------------
+
+#: the cluster-life endurance composition (ISSUE 11 / ROADMAP item 5):
+#: ONE long run per arm over the same seeded event stream, phased —
+#:   churn: exactly the config-7 Poisson workload (the >= 2x ratio claim
+#:          is measured on THIS phase's cycles);
+#:   gangs: churn + Coscheduling gang arrivals with elastic member
+#:          resizes (PodGroups force the serve engines into full-snapshot
+#:          fallback — the measured cost of gangs on a serving daemon —
+#:          and serving resumes when the gangs drain at phase end);
+#:   chaos: churn under a seeded fault-plan subset (solve garbage +
+#:          dropped/duplicated/corrupted sink deltas) with the resilience
+#:          watchdog attached and the anti-entropy window tightened;
+#:   waves: node remove/add waves (drain-then-delete) under churn — the
+#:          serial engine re-bases O(cluster) per delete, the streaming
+#:          engine row-compacts O(changed).
+#: Arms: pipelined = PipelinedCycle + StreamingServeEngine; serial = the
+#: unchanged run_cycle + ServeEngine. Both share ONE scheduler so jit
+#: caches are shared; the PIPELINED arm runs FIRST and eats every
+#: first-shape compile, making the reported ratio conservative.
+CLUSTER_LIFE_SHAPE = dict(
+    n_nodes=2000, prefill=12288, warmup=4, seed=0,
+    churn=dict(cycles=48, lam_arrive=48, lam_depart=24,
+               node_add_every=16, node_remove_every=24),
+    gangs=dict(cycles=12, lam_arrive=24, lam_depart=12, gang_every=4,
+               gang_size=4, grow_by=2),
+    chaos=dict(cycles=16, lam_arrive=32, lam_depart=16, verify_every=1,
+               timeout_s=5.0),
+    waves=dict(cycles=16, lam_arrive=24, lam_depart=24,
+               node_add_every=3, node_remove_every=2),
+)
+#: reduced shape for the `make endurance-smoke` CI gate (2-core runners);
+#: node count below its padding bucket like CHURN_SMOKE_SHAPE
+ENDURANCE_SMOKE_SHAPE = dict(
+    n_nodes=500, prefill=4096, warmup=3, seed=0,
+    churn=dict(cycles=16, lam_arrive=24, lam_depart=12,
+               node_add_every=9, node_remove_every=5),
+    gangs=dict(cycles=6, lam_arrive=12, lam_depart=6, gang_every=3,
+               gang_size=3, grow_by=1),
+    chaos=dict(cycles=8, lam_arrive=16, lam_depart=8, verify_every=1,
+               timeout_s=5.0),
+    waves=dict(cycles=8, lam_arrive=12, lam_depart=12,
+               node_add_every=3, node_remove_every=2),
+)
+
+#: the phase order is part of the workload definition
+CLUSTER_LIFE_PHASES = ("churn", "gangs", "chaos", "waves")
+
+
+def _life_fault_plan(shape, seed):
+    """Seeded chaos subset for the cluster-life run: solve garbage plus
+    the three sink-delta corruptions (sticky — they fire at the first
+    delta after their slot). Hang/crash stay in config 9's dedicated
+    harness: a multi-second hang would dominate the endurance timing
+    and a crash needs config 9's restart machinery."""
+    from scheduler_plugins_tpu.resilience import faults as F
+
+    cycles = shape["chaos"]["cycles"]
+    rng = np.random.default_rng(seed + 17)
+    kinds = [
+        (F.SOLVE_DISPATCH, "garbage", False),
+        (F.DELTA_EVENT, "drop", True),
+        (F.DELTA_EVENT, "dup", True),
+        (F.DELTA_EVENT, "corrupt", True),
+    ]
+    slots = rng.choice(np.arange(1, cycles - 1), size=len(kinds),
+                       replace=False)
+    plan = F.FaultPlan(seed=seed)
+    for (site, kind, sticky), cycle in zip(
+        kinds, sorted(int(s) for s in slots)
+    ):
+        plan.specs.append(
+            F.FaultSpec(site=site, cycle=cycle, kind=kind, sticky=sticky)
+        )
+    return plan
+
+
+def _life_gang_events(cluster, phase_cycle, shape, now, roster):
+    """Deterministic gang lifecycle for the gangs phase: arrivals every
+    `gang_every` cycles, an elastic GROW (+`grow_by` members) two cycles
+    in, an elastic SHRINK (-1 bound member, quorum kept) three cycles
+    later, completion (members + group removed) after eight. `roster`
+    carries {gang name: (birth cycle, next member serial)} across
+    cycles."""
+    from scheduler_plugins_tpu.api.objects import (
+        Container, Pod, PodGroup, POD_GROUP_LABEL,
+    )
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+    gib = 1 << 30
+    cfg = shape["gangs"]
+
+    def add_member(gname, m):
+        cluster.add_pod(Pod(
+            name=f"{gname}-m{m}", namespace="life", creation_ms=now + m,
+            labels={POD_GROUP_LABEL: gname},
+            containers=[Container(
+                requests={CPU: 1500, MEMORY: 3 * gib}
+            )],
+        ))
+
+    if phase_cycle % cfg["gang_every"] == 0:
+        gname = f"lg{phase_cycle:03d}"
+        cluster.add_pod_group(PodGroup(
+            name=gname, namespace="life",
+            min_member=cfg["gang_size"] - 1, creation_ms=now,
+        ))
+        for m in range(cfg["gang_size"]):
+            add_member(gname, m)
+        roster[gname] = (phase_cycle, cfg["gang_size"])
+    for gname, (birth, serial) in list(roster.items()):
+        age = phase_cycle - birth
+        if age == 2:
+            # elastic grow: desired width increased
+            for m in range(serial, serial + cfg["grow_by"]):
+                add_member(gname, m)
+            roster[gname] = (birth, serial + cfg["grow_by"])
+        elif age == 5:
+            # elastic shrink: release the highest-serial BOUND member
+            # (stays >= quorum: grow_by extra members exist by now)
+            members = sorted(
+                (p.uid for p in cluster.pods.values()
+                 if p.namespace == "life" and p.pod_group() == gname
+                 and p.node_name is not None),
+                reverse=True,
+            )
+            if members:
+                cluster.remove_pod(members[0])
+        elif age >= 8:
+            # gang completes: workload done, members and group leave
+            for uid in [
+                p.uid for p in cluster.pods.values()
+                if p.namespace == "life" and p.pod_group() == gname
+            ]:
+                cluster.remove_pod(uid)
+            cluster.pod_groups.pop(f"life/{gname}", None)
+            roster.pop(gname, None)
+
+
+def _drain_life_gangs(cluster, roster):
+    """End of the gangs phase: every remaining gang completes, so the
+    serve engines re-engage (the compatibility gate re-opens once the
+    PodGroups drain away)."""
+    for gname in list(roster):
+        for uid in [
+            p.uid for p in cluster.pods.values()
+            if p.namespace == "life" and p.pod_group() == gname
+        ]:
+            cluster.remove_pod(uid)
+        cluster.pod_groups.pop(f"life/{gname}", None)
+        roster.pop(gname, None)
+
+
+class _LifeArm:
+    """One cluster-life arm as an externally-stepped state machine.
+    `cluster_life` steps the two timed arms INTERLEAVED (pipelined cycle
+    k, then serial cycle k) so environment noise — this class of shared
+    2-core hosts stalls a whole process for hundreds of ms at a time —
+    lands on both arms of every compared window instead of whichever arm
+    happened to be running (the replay-smoke pairing discipline, at arm
+    granularity). Determinism contract: every random draw comes from the
+    seeded stream + the cluster's bound set, so two arms with equal
+    placements see IDENTICAL event sequences (the `_churn_events`
+    discipline), and the chaos plan is seeded and installed around each
+    arm's OWN tick — the arms differ only in engine."""
+
+    def __init__(self, scheduler, shape, pipelined, seed=0):
+        from scheduler_plugins_tpu.framework.pipeline_cycle import (
+            PipelinedCycle,
+        )
+        from scheduler_plugins_tpu.serving import (
+            ServeEngine,
+            StreamingServeEngine,
+        )
+
+        self.scheduler = scheduler
+        self.shape = shape
+        self.pipelined = pipelined
+        self.seed = seed
+        self.cluster = churn_cluster(
+            shape["n_nodes"], shape["prefill"], seed
+        )
+        self.engine = (
+            StreamingServeEngine() if pipelined else ServeEngine()
+        ).attach(self.cluster)
+        self.pipe = (
+            PipelinedCycle(scheduler, self.cluster, serve=self.engine)
+            if pipelined else None
+        )
+        self.rng = np.random.default_rng(seed + 1)
+        self.serial = 0
+        self.cycle = 0
+        self.times = {name: [] for name in CLUSTER_LIFE_PHASES}
+        self.decided = {name: [] for name in CLUSTER_LIFE_PHASES}
+        self.placements: dict = {}
+        self.report_digests: list = []
+        self.gang_roster: dict = {}
+        # per-cycle phase schedule (warmup rides the churn generators,
+        # untimed — covers the resident base build + hot compile shapes)
+        self.schedule = (
+            ["churn"] * (shape["warmup"] + shape["churn"]["cycles"])
+            + ["gangs"] * shape["gangs"]["cycles"]
+            + ["chaos"] * shape["chaos"]["cycles"]
+            + ["waves"] * shape["waves"]["cycles"]
+        )
+        self.gang_phase_start = shape["warmup"] + shape["churn"]["cycles"]
+        self.chaos_start = (
+            self.gang_phase_start + shape["gangs"]["cycles"]
+        )
+        self._events = {
+            "churn": dict(shape["churn"]),
+            "gangs": dict(shape["gangs"],
+                          node_add_every=shape["gangs"].get(
+                              "node_add_every", 0),
+                          node_remove_every=shape["gangs"].get(
+                              "node_remove_every", 0)),
+            # the anti-entropy window is pinned to ONE refresh for the
+            # chaos phase (the config-9 discipline): detection then
+            # happens at the SAME refresh that applied the corruption in
+            # BOTH arms — the periodic cadence counts only compatible
+            # refreshes, and the two engines' counters drift (the serial
+            # engine re-bases on node deletes where the streaming engine
+            # compacts), which would move the corruption-recovery rebase
+            # to different cycles and break the placement-identity gate
+            "chaos": dict(shape["chaos"], node_add_every=0,
+                          node_remove_every=0, probe_every=1),
+            "waves": dict(shape["waves"]),
+        }
+        self.plan = _life_fault_plan(shape, seed)
+        self.rz = _chaos_resilience(self._events["chaos"], self.engine, seed)
+        self._old_verify = self.engine.verify_every
+        self._prev_phase = None
+
+    @property
+    def done(self) -> bool:
+        return self.cycle >= len(self.schedule)
+
+    def _transition(self, phase):
+        if phase == self._prev_phase:
+            return
+        if self._prev_phase == "gangs":
+            # gangs complete at phase end: serving re-engages
+            if self.pipe is not None:
+                self.pipe.flush()
+            _drain_life_gangs(self.cluster, self.gang_roster)
+        if self._prev_phase == "chaos":
+            if self.pipe is not None:
+                self.pipe.flush()
+                self.pipe.resilience = None
+            self.engine.verify_every = self._old_verify
+        if phase == "chaos":
+            if self.pipe is not None:
+                self.pipe.resilience = self.rz
+            self.engine.verify_every = (
+                self.shape["chaos"]["verify_every"]
+            )
+        self._prev_phase = phase
+
+    def step(self):
+        """Run ONE cycle (events + tick) of this arm's schedule."""
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.resilience import faults as F
+
+        phase = self.schedule[self.cycle]
+        self._transition(phase)
+        now = 1000 * (self.cycle + 1)
+        self.serial = _churn_events(
+            self.cluster, self.rng, self._events[phase], self.cycle, now,
+            self.serial,
+        )
+        if phase == "gangs":
+            _life_gang_events(
+                self.cluster, self.cycle - self.gang_phase_start,
+                self.shape, now, self.gang_roster,
+            )
+        chaos = phase == "chaos"
+        if chaos:
+            # each arm's OWN plan is live only around its own tick (the
+            # registry is process-global and the arms interleave)
+            F.install(self.plan)
+            self.plan.begin_cycle(self.cycle - self.chaos_start)
+        start = time.perf_counter()
+        try:
+            with _bench_span(
+                f"life cycle {self.cycle}", phase=phase,
+                mode="pipelined" if self.pipelined else "serial",
+            ):
+                if self.pipelined:
+                    report = self.pipe.tick(now)
+                    # decision latency = ingest boundary -> host-visible
+                    # binds: fence inside the timed window (the bench's
+                    # event generator needs the bound set anyway)
+                    self.pipe.fence()
+                else:
+                    report = run_cycle(
+                        self.scheduler, self.cluster, now=now,
+                        serve=self.engine,
+                        resilience=self.rz if chaos else None,
+                    )
+        finally:
+            if chaos:
+                F.clear()
+        elapsed = time.perf_counter() - start
+        self.placements.update(report.bound)
+        if self.cycle >= self.shape["warmup"]:
+            self.times[phase].append(elapsed)
+            self.decided[phase].append(
+                len(report.bound) + len(report.failed)
+            )
+            self.report_digests.append((
+                tuple(sorted(report.bound.items())),
+                tuple(sorted(report.reserved.items())),
+                tuple(sorted(report.failed)),
+                tuple(sorted(report.rejected_gangs)),
+            ))
+        self.cycle += 1
+
+    def finish(self) -> dict:
+        if self.pipe is not None:
+            self.pipe.flush()
+            self.pipe.close()
+        out = {
+            "times": self.times,
+            "decided": self.decided,
+            "placements": self.placements,
+            "report_digests": self.report_digests,
+            "final_state": {
+                uid: p.node_name
+                for uid, p in sorted(self.cluster.pods.items())
+            },
+            "violations": _churn_capacity_violations(self.cluster),
+            "state_matrices": _cluster_state_matrices(self.cluster),
+            "rebases": self.engine.rebases,
+            "compactions": getattr(self.engine, "compactions", 0),
+            "gang_fallbacks": self.engine.gang_fallbacks,
+            "antientropy_divergences": self.engine.antientropy_divergences,
+            "faults_fired": len(self.plan.log),
+            "degraded_end": self.rz.degraded,
+        }
+        if self.pipe is not None:
+            tls = [t.as_dict() for t in self.pipe.timelines]
+            out["overlap_efficiency_mean"] = (
+                round(float(np.mean(
+                    [t["overlap_efficiency"] for t in tls]
+                )), 4) if tls else None
+            )
+            out["pipeline_bubble_ms_mean"] = (
+                round(float(np.mean(
+                    [t["pipeline_bubble_ms"] for t in tls]
+                )), 3) if tls else None
+            )
+            out["late_binds"] = sum(
+                1 for t in self.pipe.timelines if t.late_bind
+            )
+        return out
+
+
+def _cluster_life_arm(scheduler, shape, pipelined, seed=0):
+    """One full cluster-life run to completion (the prewarm pass and any
+    standalone use; the timed comparison steps two `_LifeArm`s
+    interleaved instead — see `cluster_life`)."""
+    arm = _LifeArm(scheduler, shape, pipelined, seed)
+    while not arm.done:
+        arm.step()
+    return arm.finish()
+
+
+def cluster_life(shape=None, emit=True):
+    """Config 11: the cluster-life endurance bench. ONE seeded event
+    stream (Poisson churn + gang arrivals/elastic resizes + seeded chaos
+    faults + node add/remove waves) run twice — the concurrent pipeline
+    engine (`framework.pipeline_cycle.PipelinedCycle` +
+    `serving.engine.StreamingServeEngine`) vs the serial `run_cycle` +
+    base `ServeEngine` — sharing one scheduler (warm jit caches; the
+    pipelined arm runs first and eats the first-shape compiles).
+    Headline: sustained cycles/s and p99 decision latency, with the
+    >= 2x claim measured on the churn phase (exactly the config-7
+    workload) and every hard gate checked: identical per-cycle
+    placements, bit-identical final cluster state, zero capacity
+    violations in the replayed audit."""
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.plugins import (
+        Coscheduling,
+        NodeResourcesAllocatable,
+    )
+
+    shape = shape or CLUSTER_LIFE_SHAPE
+    seed = shape.get("seed", 0)
+    scheduler = Scheduler(Profile(plugins=[
+        NodeResourcesAllocatable(),
+        Coscheduling(permit_waiting_seconds=30),
+    ]))
+
+    # untimed prewarm: one full pipelined pass over the SAME seeded
+    # stream compiles every shape both timed arms will hit (the two
+    # arms' cluster states are bit-identical cycle for cycle, so their
+    # jit signatures are too) — the comparison then times the overlap,
+    # not compiles. The pipelined arm still runs first: any residual
+    # first-shape compile lands there, keeping the ratio conservative.
+    import gc
+
+    _cluster_life_arm(scheduler, shape, pipelined=True, seed=seed)
+    # bench hygiene, applied identically to both timed arms: move the
+    # prewarm's surviving objects (plus the arms' prefill populations)
+    # out of the collector's scan set — a gen-2 GC pause over a few
+    # million tracked objects lands as a multi-hundred-ms spike on
+    # whichever cycle it hits
+    gc.collect()
+    gc.freeze()
+    try:
+        # the timed arms run INTERLEAVED (pipelined cycle k, serial
+        # cycle k): on a shared host, episodic slowdowns then land on
+        # both arms of every compared window instead of poisoning
+        # whichever arm happened to be running
+        pipe = _LifeArm(scheduler, shape, pipelined=True, seed=seed)
+        ser = _LifeArm(scheduler, shape, pipelined=False, seed=seed)
+        while not pipe.done:
+            pipe.step()
+            ser.step()
+        pipe_arm = pipe.finish()
+        serial_arm = ser.finish()
+    finally:
+        gc.unfreeze()
+
+    def cps(arm, phase=None):
+        ts = (
+            arm["times"][phase] if phase
+            else [t for name in CLUSTER_LIFE_PHASES for t in
+                  arm["times"][name]]
+        )
+        return len(ts) / sum(ts) if ts else 0.0
+
+    phases = {}
+    for name in CLUSTER_LIFE_PHASES:
+        p, s = cps(pipe_arm, name), cps(serial_arm, name)
+        phases[name] = {
+            "cycles": len(pipe_arm["times"][name]),
+            "cycles_per_sec": round(p, 2),
+            "serial_cycles_per_sec": round(s, 2),
+            "vs_serial": round(p / s, 2) if s else 0.0,
+        }
+    all_p, all_s = cps(pipe_arm), cps(serial_arm)
+
+    def cps_phases(arm, names):
+        ts = [t for name in names for t in arm["times"][name]]
+        return len(ts) / sum(ts) if ts else 0.0
+
+    # the serve-mode phases (churn + node waves) — the workload the
+    # pipelined engine's O(changed) ingest targets; the composite is the
+    # smoke gate's statistic because a single phase's ratio at reduced
+    # scale swings with the serial arm's per-rebase cost
+    serve_p = cps_phases(pipe_arm, ("churn", "waves"))
+    serve_s = cps_phases(serial_arm, ("churn", "waves"))
+    pipe_times = np.array(
+        [t for name in CLUSTER_LIFE_PHASES for t in pipe_arm["times"][name]]
+    )
+    # per-decision latency: a pod's decision latency is its cycle's wall
+    # time (ingest -> host-visible bind), weighted by decisions per cycle
+    # — the config-7 convention, so the columns compare directly
+    weights = np.array([
+        d for name in CLUSTER_LIFE_PHASES
+        for d in pipe_arm["decided"][name]
+    ])
+    lat = np.repeat(pipe_times, weights) \
+        if pipe_times.size else np.array([])
+    p50 = float(np.percentile(lat, 50)) * 1000 if lat.size else 0.0
+    p99 = float(np.percentile(lat, 99)) * 1000 if lat.size else 0.0
+
+    placements_match = pipe_arm["placements"] == serial_arm["placements"]
+    n_decided = int(weights.sum())
+    cycles_match = (
+        pipe_arm["report_digests"] == serial_arm["report_digests"]
+    )
+    state_match = pipe_arm["final_state"] == serial_arm["final_state"]
+    total_s = pipe_times.sum()
+    line = {
+        "cycles": int(len(pipe_times)),
+        "cycles_per_sec": round(all_p, 2),
+        "serial_cycles_per_sec": round(all_s, 2),
+        "vs_serial": round(all_p / all_s, 2) if all_s else 0.0,
+        "churn_vs_serial": phases["churn"]["vs_serial"],
+        "serve_phases_vs_serial": (
+            round(serve_p / serve_s, 2) if serve_s else 0.0
+        ),
+        "phases": phases,
+        "decision_latency_p50_ms": round(p50, 2),
+        "decision_latency_p99_ms": round(p99, 2),
+        "placements_match": bool(placements_match),
+        "per_cycle_reports_match": bool(cycles_match),
+        "final_state_identical": bool(state_match),
+        "capacity_violations": int(pipe_arm["violations"]),
+        "overlap_efficiency_mean": pipe_arm["overlap_efficiency_mean"],
+        "pipeline_bubble_ms_mean": pipe_arm["pipeline_bubble_ms_mean"],
+        "late_binds": pipe_arm["late_binds"],
+        "rebases": int(pipe_arm["rebases"]),
+        "serial_rebases": int(serial_arm["rebases"]),
+        "compactions": int(pipe_arm["compactions"]),
+        "gang_fallbacks": int(pipe_arm["gang_fallbacks"]),
+        "antientropy_divergences": int(
+            pipe_arm["antientropy_divergences"]
+        ),
+        "faults_fired": int(pipe_arm["faults_fired"]),
+        "decisions": int(n_decided),
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[11],
+            n_decided / total_s if total_s else 0.0,
+            f"{shape['n_nodes']} nodes, {shape['prefill']} bound, "
+            f"{line['cycles']} cycles cluster life "
+            "(churn+gangs+chaos+waves), pipelined vs serial engine",
+            baseline=(
+                n_decided / sum(
+                    t for name in CLUSTER_LIFE_PHASES
+                    for t in serial_arm["times"][name]
+                )
+            ),
+            drift=(0.0 if placements_match else None),
+            quality=_quality_state(*pipe_arm["state_matrices"]),
+            extra=line,
+        )
+    return line
+
+
+def endurance_smoke(min_ratio=1.5):
+    """CI gate (`make endurance-smoke`): reduced cluster-life run — the
+    pipelined engine must beat the serial engine >= `min_ratio` on
+    cycles/s over the serve-mode phases (churn + node waves: the
+    composite is robust against the run-to-run cost variance of the
+    serial arm's individual rebases at reduced scale; the full-shape
+    config-7 churn ratio is the headline claim, not the CI statistic),
+    produce IDENTICAL per-cycle placements and a bit-identical final
+    cluster state, and leave a clean replayed capacity audit. One JSON
+    line; rc 1 on any failure."""
+    line = cluster_life(shape=ENDURANCE_SMOKE_SHAPE, emit=False)
+    ok = (
+        line["serve_phases_vs_serial"] >= min_ratio
+        and line["placements_match"]
+        and line["per_cycle_reports_match"]
+        and line["final_state_identical"]
+        and line["capacity_violations"] == 0
+    )
+    print(json.dumps({
+        "metric": "endurance_smoke",
+        "min_ratio": min_ratio,
+        "backend": _backend_label(),
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
+
+
 #: replay cutoff: a capture older than this is too stale to stand in for
 #: "the round's number" (a round is ~12h; 48h allows the previous round's
 #: tail while excluding week-old numbers from a drifted codebase)
@@ -2328,6 +2882,16 @@ if __name__ == "__main__":
                              "its numpy twin (drift 0.0), the hard-"
                              "constraint audit is clean, and elastic "
                              "grow/shrink converge within 2 cycles")
+    parser.add_argument("--endurance-smoke", action="store_true",
+                        help="CI gate: reduced cluster-life config-11 "
+                             "run (churn+gangs+chaos+waves, one seeded "
+                             "stream); fails unless the pipelined cycle "
+                             "engine beats the serial engine >= 1.5x on "
+                             "serve-phase (churn+waves) cycles/s with "
+                             "identical "
+                             "per-cycle placements, a bit-identical "
+                             "final cluster state and a clean replayed "
+                             "capacity audit")
     parser.add_argument("--chaos-smoke", action="store_true",
                         help="CI gate: reduced chaos-churn run under the "
                              "full seeded fault plan (hung solve, device "
@@ -2372,6 +2936,16 @@ if __name__ == "__main__":
         # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
         # arm-vs-arm placement-quality comparison — no tunnel probe
         sys.exit(gang_smoke())
+    if args.endurance_smoke:
+        # CPU-backend CI gate: engine-vs-engine comparison on one seeded
+        # stream — no tunnel probe
+        sys.exit(endurance_smoke())
+    if args.config == 11:
+        # pipelined-vs-serial engine comparison, full cluster-life shape
+        # — both arms share whatever backend is configured, so no tunnel
+        # probe (its health cancels out of every asserted claim)
+        cluster_life()
+        sys.exit(0)
     if args.config == 10:
         # rank-aware vs quorum-only comparison, full shape — both arms
         # share whatever backend is configured, so no tunnel probe (its

@@ -137,6 +137,16 @@ def parse_args(argv=None):
                          "with periodic anti-entropy verification; falls "
                          "back to full snapshots transparently when the "
                          "profile surface needs them")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="concurrent cycle pipeline "
+                         "(framework.pipeline_cycle.PipelinedCycle): "
+                         "dispatch the device solve asynchronously and "
+                         "run the previous cycle's finalize in the "
+                         "overlap window, with binds conflict-fenced at "
+                         "the next ingest boundary; with --serve the "
+                         "engine upgrades to the O(changed) "
+                         "StreamingServeEngine (node-delete compaction, "
+                         "memoized ingest, O(assigned) anti-entropy)")
     ap.add_argument("--resilient", action="store_true",
                     help="solve watchdog + degraded-mode failover "
                          "(resilience.watchdog): device solves complete "
@@ -232,6 +242,14 @@ class HealthServer:
                         ),
                         "parked_cycles": outer.parked_cycles,
                     }
+                    if outer.pipeline is not None:
+                        # concurrent cycle pipeline introspection:
+                        # configured depth + host stages still in
+                        # flight (deferred finalize / unflushed binds)
+                        payload["pipeline"] = {
+                            "depth": outer.pipeline.depth,
+                            "inflight": outer.pipeline.inflight,
+                        }
                     if outer.engine is not None:
                         payload["serve"] = {
                             "generation": outer.engine.generation,
@@ -334,9 +352,15 @@ class Daemon:
             self.cluster.scheduler_names = set(args.scheduler_name)
         self.engine = None
         if args.serve:
-            from scheduler_plugins_tpu.serving import ServeEngine
+            from scheduler_plugins_tpu.serving import (
+                ServeEngine,
+                StreamingServeEngine,
+            )
 
-            self.engine = ServeEngine().attach(self.cluster)
+            engine_cls = (
+                StreamingServeEngine if args.pipeline else ServeEngine
+            )
+            self.engine = engine_cls().attach(self.cluster)
             if args.checkpoint and os.path.exists(args.checkpoint):
                 try:
                     self.engine.restore_checkpoint(args.checkpoint)
@@ -357,6 +381,19 @@ class Daemon:
             from scheduler_plugins_tpu.resilience import Resilience
 
             self.resilience = Resilience(engine=self.engine)
+        self.pipeline = None
+        if args.pipeline:
+            from scheduler_plugins_tpu.framework import PipelinedCycle
+
+            # binds flush inline (async_bind=False): every store
+            # mutation happens under the feed lock the tick holds, so
+            # the flusher thread's mutations cannot race feed ingest;
+            # the overlap (async solve dispatch + the previous cycle's
+            # finalize in the in-flight window) is within-tick
+            self.pipeline = PipelinedCycle(
+                self.scheduler, self.cluster, serve=self.engine,
+                resilience=self.resilience, async_bind=False,
+            )
         if args.trace:
             obs.tracer.start()
         if args.native_store:
@@ -501,10 +538,14 @@ class Daemon:
         now_ms = int(time.time() * 1000)
         cycle_started = time.monotonic()
         try:
-            report = self.feed.run_cycle(
-                self.scheduler, now=now_ms, serve=self.engine,
-                resilience=self.resilience,
-            )
+            if self.pipeline is not None:
+                with self.feed.locked():
+                    report = self.pipeline.tick(now_ms)
+            else:
+                report = self.feed.run_cycle(
+                    self.scheduler, now=now_ms, serve=self.engine,
+                    resilience=self.resilience,
+                )
         except Exception as exc:
             from scheduler_plugins_tpu.resilience import BackendUnavailable
 
@@ -593,6 +634,16 @@ class Daemon:
             # `obs.atomic_write` discipline BEFORE the servers come down,
             # then the exit path returns rc 0 — a drained, checkpointed
             # daemon is indistinguishable from one that never ran
+            if self.pipeline is not None:
+                try:
+                    # conflict-fence + deferred finalize of the last
+                    # in-flight cycle: a drained pipeline leaves the
+                    # store and the recorder exactly as the serial
+                    # engine would
+                    with self.feed.locked():
+                        self.pipeline.close()
+                except Exception as exc:
+                    obs.logger.warning("pipeline flush failed: %s", exc)
             if self.args.record and self.args.record_dir:
                 from scheduler_plugins_tpu.utils import flightrec
 

@@ -539,6 +539,40 @@ def _apply_op(cluster: Cluster, event: dict, op) -> dict:
             cluster.note_event(ev.PDB_DELETE)
     elif op == "metrics":
         cluster.node_metrics = event["nodes"]
+    elif op == "drain_deltas":
+        # streaming-delta bridge seam (SURVEY §L5): export ONLY the node
+        # rows the native columnar mirror touched since the last drain —
+        # a remote consumer (mirror shard, dashboard) polls this instead
+        # of a full O(cluster) snapshot. Single-consumer semantics: the
+        # drain consumes the delta window and bumps the generation.
+        native = cluster.native
+        if native is None:
+            return {
+                "ok": False,
+                "error": "no native store attached "
+                         "(Cluster.attach_native_store)",
+            }
+        deltas = native.export_dirty()
+        return {
+            "ok": True,
+            "generation": int(deltas["generation"]),
+            "count": int(len(deltas["ids"])),
+            "nodes": [
+                {
+                    "id": int(deltas["ids"][i]),
+                    "alloc": [int(v) for v in deltas["alloc"][i]],
+                    "capacity": [int(v) for v in deltas["capacity"][i]],
+                    "requested": [int(v) for v in deltas["requested"][i]],
+                    "nonzero_requested": [
+                        int(v) for v in deltas["nonzero_requested"][i]
+                    ],
+                    "limits": [int(v) for v in deltas["limits"][i]],
+                    "pod_count": int(deltas["pod_count"][i]),
+                    "terminating": int(deltas["terminating"][i]),
+                }
+                for i in range(len(deltas["ids"]))
+            ],
+        }
     elif op == "sync":
         return {
             "ok": True,

@@ -16,6 +16,14 @@ fence with one {"op": "sync"} at the end. Resource-version fencing and the
 store lock are shared with any `FeedServer` attached to the same cluster
 when you pass its `lock`/`rv_table`.
 
+Streaming deltas: when the cluster carries the native columnar mirror
+(`Cluster.attach_native_store`), the {"op": "drain_deltas"} query returns
+ONLY the node rows touched since the last drain (`snapshot_store.cc`
+dirty-row export) — a remote mirror polls `drain_deltas` over `Apply` (or
+interleaves it on a `Stream`) and ingests O(changed) per cycle instead of
+re-shipping the whole snapshot; `GrpcFeedClient.drain_deltas()` is the
+client-side convenience.
+
 grpcio is an optional dependency: importing this module is always safe; the
 deferred `import grpc` raises ImportError only when constructing
 `GrpcFeedServer` / `GrpcFeedClient` (the plain TCP feed keeps working).
@@ -130,6 +138,11 @@ class GrpcFeedClient:
     def send_batch(self, events: list[dict]) -> list[dict]:
         payloads = (json.dumps(e).encode() for e in events)
         return [json.loads(ack) for ack in self._stream(payloads)]
+
+    def drain_deltas(self) -> dict:
+        """Pull the server store's streaming node-delta window (the rows
+        touched since the last drain; O(changed), consumes the window)."""
+        return self.send({"op": "drain_deltas"})
 
     def close(self):
         self._channel.close()

@@ -52,8 +52,27 @@ struct Store {
   std::vector<int32_t> pod_count;   // (N)
   std::vector<int32_t> terminating; // (N)
   std::unordered_map<int64_t, Pod> pods;
+  // Streaming-delta export (the O(changed) bridge seam): rows whose
+  // columns changed since the last drain, first-touch ordered. A drain
+  // exports ONLY these rows and bumps `generation`, so a downstream
+  // mirror (serve engine, remote shard) ingests O(changed) per cycle
+  // instead of the O(cluster) full export. A fresh store marks every
+  // row dirty as it hydrates — a new consumer's first drain is a full
+  // resync by construction.
+  std::vector<int32_t> dirty_rows;  // first-touch order, unique
+  std::vector<uint8_t> dirty_flag;  // (N)
+  int64_t generation = 0;
 
   explicit Store(int r) : R(r) {}
+
+  void MarkDirty(int32_t row) {
+    if (row >= static_cast<int32_t>(dirty_flag.size()))
+      dirty_flag.resize(row + 1, 0);
+    if (!dirty_flag[row]) {
+      dirty_flag[row] = 1;
+      dirty_rows.push_back(row);
+    }
+  }
 
   int32_t NodeRow(int64_t id) {
     auto it = node_pos.find(id);
@@ -68,6 +87,7 @@ struct Store {
     limits.resize(limits.size() + R, 0);
     pod_count.push_back(0);
     terminating.push_back(0);
+    MarkDirty(row);
     return row;
   }
 
@@ -78,6 +98,7 @@ struct Store {
   }
 
   void Apply(int32_t row, const Pod& pod, int sign) {
+    MarkDirty(row);
     int64_t* rq = requested.data() + static_cast<size_t>(row) * R;
     int64_t* nz = nonzero.data() + static_cast<size_t>(row) * R;
     int64_t* lm = limits.data() + static_cast<size_t>(row) * R;
@@ -107,6 +128,7 @@ void store_upsert_node(void* handle, int64_t id, const int64_t* alloc,
                        const int64_t* capacity) {
   Store* s = static_cast<Store*>(handle);
   int32_t row = s->NodeRow(id);
+  s->MarkDirty(row);
   std::memcpy(s->alloc.data() + static_cast<size_t>(row) * s->R, alloc,
               sizeof(int64_t) * s->R);
   std::memcpy(s->capacity.data() + static_cast<size_t>(row) * s->R, capacity,
@@ -169,6 +191,7 @@ void store_upsert_nodes_batch(void* handle, int64_t k, const int64_t* ids,
   Store* s = static_cast<Store*>(handle);
   for (int64_t i = 0; i < k; ++i) {
     int32_t row = s->NodeRow(ids[i]);
+    s->MarkDirty(row);
     std::memcpy(s->alloc.data() + static_cast<size_t>(row) * s->R,
                 alloc + i * s->R, sizeof(int64_t) * s->R);
     std::memcpy(s->capacity.data() + static_cast<size_t>(row) * s->R,
@@ -215,6 +238,54 @@ void store_export_nodes(void* handle, int64_t* ids, int64_t* alloc,
   std::memcpy(limits, s->limits.data(), sizeof(int64_t) * n * s->R);
   std::memcpy(pod_count, s->pod_count.data(), sizeof(int32_t) * n);
   std::memcpy(terminating, s->terminating.data(), sizeof(int32_t) * n);
+}
+
+// -- streaming-delta export (O(changed) bridge seam) ------------------------
+
+int64_t store_dirty_count(void* handle) {
+  return static_cast<int64_t>(static_cast<Store*>(handle)->dirty_rows.size());
+}
+
+int64_t store_generation(void* handle) {
+  return static_cast<Store*>(handle)->generation;
+}
+
+// Fills caller-allocated buffers sized (store_dirty_count() x R) /
+// (store_dirty_count()) with ONLY the rows touched since the last drain
+// (first-touch order), then clears the dirty set and bumps the
+// generation. Returns the number of rows written. Single-consumer
+// semantics: a drain consumes the delta window.
+int64_t store_export_dirty(void* handle, int64_t* ids, int64_t* alloc,
+                           int64_t* capacity, int64_t* requested,
+                           int64_t* nonzero, int64_t* limits,
+                           int32_t* pod_count, int32_t* terminating) {
+  Store* s = static_cast<Store*>(handle);
+  const size_t R = s->R;
+  for (size_t i = 0; i < s->dirty_rows.size(); ++i) {
+    const int32_t row = s->dirty_rows[i];
+    ids[i] = s->node_ids[row];
+    std::memcpy(alloc + i * R, s->alloc.data() + static_cast<size_t>(row) * R,
+                sizeof(int64_t) * R);
+    std::memcpy(capacity + i * R,
+                s->capacity.data() + static_cast<size_t>(row) * R,
+                sizeof(int64_t) * R);
+    std::memcpy(requested + i * R,
+                s->requested.data() + static_cast<size_t>(row) * R,
+                sizeof(int64_t) * R);
+    std::memcpy(nonzero + i * R,
+                s->nonzero.data() + static_cast<size_t>(row) * R,
+                sizeof(int64_t) * R);
+    std::memcpy(limits + i * R,
+                s->limits.data() + static_cast<size_t>(row) * R,
+                sizeof(int64_t) * R);
+    pod_count[i] = s->pod_count[row];
+    terminating[i] = s->terminating[row];
+    s->dirty_flag[row] = 0;
+  }
+  const int64_t n = static_cast<int64_t>(s->dirty_rows.size());
+  s->dirty_rows.clear();
+  ++s->generation;
+  return n;
 }
 
 // Fills caller-allocated buffers sized (num_pending x R) / (num_pending),

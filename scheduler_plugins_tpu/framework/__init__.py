@@ -11,6 +11,10 @@ from scheduler_plugins_tpu.framework.cycle import (  # noqa: F401
     CycleReport,
     run_cycle,
 )
+from scheduler_plugins_tpu.framework.pipeline_cycle import (  # noqa: F401
+    CycleTimeline,
+    PipelinedCycle,
+)
 from scheduler_plugins_tpu.framework.plugin import (  # noqa: F401
     Plugin,
     SolverState,

@@ -159,6 +159,429 @@ def _attach_explain_ctx(report: CycleReport, ctx: tuple) -> None:
         _EXPLAIN_RING.popleft()._explain_ctx = _CTX_RELEASED
 
 
+@dataclass
+class CycleCtx:
+    """Mutable state threaded through one cycle's stages.
+
+    `run_cycle` composes the `_cycle_*` stage functions below strictly
+    serially; the pipelined engine (`framework.pipeline_cycle`) composes
+    the SAME functions with cycle N's device solve left in flight while
+    host stages of neighboring cycles run — one copy of every stage, so
+    the two engines cannot drift (the serial engine stays the parity
+    anchor, gated by `tests/test_differential.py`'s pipelined-equivalence
+    twin)."""
+
+    scheduler: Scheduler
+    cluster: Cluster
+    now: int
+    report: CycleReport
+    stream_chunk: int | None = None
+    serve: object = None
+    resilience: object = None
+    gangs: object = None
+    cosched: object = None
+    pending: list = field(default_factory=list)
+    snap: object = None
+    meta: object = None
+    served: bool = False
+    serve_t0: float | None = None
+    rec: object = None
+    result: object = None
+    assignment: object = None
+    admitted: object = None
+    wait: object = None
+    #: host transfers already forced (resilience path fences internally)
+    fenced: bool = False
+    #: early return taken (empty batch / gang-only cycle)
+    done: bool = False
+    #: tracer row for the bind/post-bind stages — the pipelined engine's
+    #: async bind flusher runs them on a worker thread, and spans from
+    #: two threads on one row would partially overlap (the Perfetto
+    #: validity gate rejects that); the serial engine keeps "cycle"
+    tid: str = "cycle"
+    failed_idx: list = field(default_factory=list)
+    failed_by_gang: dict = field(default_factory=dict)
+    #: host copies of the snapshot columns `_observe_quality` reads —
+    #: captured at the fence by the pipelined engine, whose deferred
+    #: finalize runs AFTER the next refresh consumed (donated) the
+    #: resident node tensors; None on the serial path (quality reads the
+    #: live snapshot before any donation)
+    quality_view: object = None
+
+
+def _cycle_open(scheduler, cluster, now, stream_chunk=None, serve=None,
+                resilience=None, gangs=None) -> CycleCtx:
+    """Cycle prologue: counters, per-cycle plugin wiring, gang expiry, NRT
+    resync and collector ticks — everything before the pending batch."""
+    ctx = CycleCtx(
+        scheduler=scheduler, cluster=cluster, now=now, report=CycleReport(),
+        stream_chunk=stream_chunk, serve=serve, resilience=resilience,
+        gangs=gangs,
+    )
+    obs.metrics.inc(obs.SCHEDULING_CYCLES)
+    ctx.cosched = next(
+        (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)),
+        None,
+    )
+    for plugin in scheduler.profile.plugins:
+        plugin.configure_cluster(cluster)
+    with obs.tracer.span("ExpireGangs", tid="cycle"):
+        _expire_gangs(cluster, now, ctx.report)
+    with obs.tracer.span("NRTResync", tid="cycle"):
+        _resync_nrt_cache(cluster, now)
+    with obs.tracer.span("Collectors", tid="cycle"):
+        _refresh_metrics(scheduler, cluster, now)
+    return ctx
+
+
+def _cycle_pending(ctx: CycleCtx) -> None:
+    """Pending batch assembly: requeue gating, queue sort, and the
+    rank-gang phase. Sets `ctx.done` when the cycle ends here (no batch,
+    or a gang-only cycle fully handled by the phase)."""
+    scheduler, cluster, now, report = (
+        ctx.scheduler, ctx.cluster, ctx.now, ctx.report,
+    )
+    gangs, serve = ctx.gangs, ctx.serve
+    pending = cluster.pending_pods()
+    with obs.tracer.span("Requeue", tid="cycle"):
+        pending = _requeue_eligible(
+            scheduler, cluster, pending, now, report,
+            gang_phase=gangs is not None,
+        )
+    if gangs is None and not pending:
+        ctx.done = True
+        return
+    pending = scheduler.sort_pending(pending, cluster)
+
+    if gangs is not None:
+        # the phase runs even on an empty batch: elastic reconcile must
+        # observe desired-width changes (shrink deletes need no pending
+        # pods), and growth clones it creates join THIS cycle's batch
+        with obs.extension_span("GangPhase", type(gangs).__name__,
+                                pending=len(pending)):
+            pending = gangs.run(scheduler, cluster, pending, now, report)
+        if not pending:
+            # gang-only cycle: every pending pod was a rank-gang member
+            # (bound or parked by the phase); nothing for the per-pod
+            # solve, so close out the counters and return. A serving
+            # engine still DRAINS (refresh with an empty batch): the
+            # phase's binds must land in the resident columns and the
+            # per-gang rank mirror now, not pile up in the sink until the
+            # next non-gang cycle. The cycle is still RECORDED when the
+            # flight recorder is live — the gang capture alone replays
+            # bit-identically through the twin
+            if serve is not None:
+                serve.refresh(cluster, [], now_ms=now)
+            rec = flightrec.recorder.begin(
+                now_ms=now, profile=scheduler.profile.name
+            )
+            if rec is not None:
+                gangs.annotate_record(rec)
+                rec.commit(report)
+            obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
+            obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
+            obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
+            ctx.done = True
+            return
+    ctx.pending = pending
+
+
+def _cycle_snapshot(ctx: CycleCtx) -> None:
+    """Snapshot/serve-refresh assembly, plugin prepare, flight-recorder
+    input capture. Runs inside the caller's `obs.flow` context."""
+    scheduler, cluster, now = ctx.scheduler, ctx.cluster, ctx.now
+    pending, serve, gangs = ctx.pending, ctx.serve, ctx.gangs
+    with obs.tracer.span("Snapshot", tid="cycle", pending=len(pending)):
+        snap = meta = None
+        if serve is not None:
+            refreshed = serve.refresh(cluster, pending, now_ms=now)
+            if refreshed is not None:
+                snap, meta = refreshed
+                ctx.served = True
+        if snap is None:
+            snap, meta = cluster.snapshot(pending, now_ms=now)
+    ctx.snap, ctx.meta = snap, meta
+    scheduler.prepare(meta, cluster)
+    if ctx.rec is not None:
+        # inputs land in the ring BEFORE the solve: the cycle that
+        # crashes the solver is exactly the one worth replaying
+        with obs.tracer.span("Record", tid="cycle"):
+            ctx.rec.capture_inputs(
+                snap, meta, scheduler, stream_chunk=ctx.stream_chunk,
+                profile_config=flightrec.recorder.profile_config,
+            )
+            if ctx.served:
+                # serve provenance: resident generation, base digest,
+                # and the packed delta stream that produced this
+                # cycle's snapshot view
+                serve.annotate_record(ctx.rec)
+            if gangs is not None:
+                # gang-phase provenance: the full RankGangState +
+                # outputs, so a recorded gang cycle replays
+                # bit-identically through the numpy twin
+                gangs.annotate_record(ctx.rec)
+
+
+def _cycle_solve_dispatch(ctx: CycleCtx) -> None:
+    """Dispatch the solve. On the plain path the result tensors stay
+    DEVICE arrays (async dispatch — `_cycle_solve_fence` forces the host
+    transfer); the resilience path completes through the watchdog's own
+    deadlined fence and returns host arrays (`ctx.fenced`)."""
+    scheduler, snap = ctx.scheduler, ctx.snap
+    result = None
+    if ctx.resilience is not None:
+        # watchdog-guarded: dispatch + completion fence in a
+        # worker thread with a deadline; retries, then failover
+        # to the host parity path (resilience.watchdog)
+        (assignment, admitted, wait, codes_np,
+         ctx.report.solve_path) = ctx.resilience.solve_cycle(
+            scheduler, snap, stream_chunk=ctx.stream_chunk
+        )
+        result = SolveResultView(
+            assignment, admitted, wait, failed_plugin=codes_np
+        )
+        ctx.assignment, ctx.admitted, ctx.wait = assignment, admitted, wait
+        ctx.fenced = True
+    else:
+        if ctx.stream_chunk:
+            from scheduler_plugins_tpu.parallel.pipeline import (
+                streamed_profile_solve,
+            )
+
+            streamed = streamed_profile_solve(
+                scheduler, snap, chunk=ctx.stream_chunk
+            )
+            if streamed is not None:
+                result = SolveResultView(*streamed)
+        if result is None:
+            result = scheduler.solve(snap)
+    ctx.result = result
+
+
+def _cycle_solve_fence(ctx: CycleCtx, quality_view: bool = False) -> None:
+    """Force the host transfers (block_until_ready can return early
+    through the tunneled backend — CLAUDE.md), so the caller's Solve
+    span/histogram covers the device round-trip. `quality_view` also
+    copies the snapshot columns the deferred quality observation reads
+    (the pipelined engine's finalize runs after the resident node
+    tensors were donated to the next cycle's delta apply)."""
+    if not ctx.fenced:
+        ctx.assignment = np.asarray(ctx.result.assignment)
+        ctx.admitted = np.asarray(ctx.result.admitted)
+        ctx.wait = np.asarray(ctx.result.wait)
+        ctx.fenced = True
+    if quality_view:
+        ctx.quality_view = _quality_view(ctx.snap)
+
+
+def _cycle_post_solve(ctx: CycleCtx) -> None:
+    """Post-fence bookkeeping: degraded flag, flight-recorder output
+    capture, explain-context retention, sanitizer drain."""
+    from scheduler_plugins_tpu.utils import sanitize
+
+    report, result = ctx.report, ctx.result
+    report.degraded = (
+        ctx.resilience is not None and ctx.resilience.degraded
+    )
+    if ctx.rec is not None:
+        with obs.tracer.span("Record", tid="cycle"):
+            codes = getattr(result, "failed_plugin", None)
+            ctx.rec.capture_outputs(
+                # the host failover path carries the sequential parity
+                # semantics (and per-pod codes), so its records replay
+                # through the same path as device-sequential ones
+                "sequential" if isinstance(result, SolveResult)
+                or codes is not None else "streamed",
+                ctx.assignment, ctx.admitted, ctx.wait,
+                failed_plugin=(
+                    None if codes is None else np.asarray(codes)
+                ),
+            )
+    if ctx.served:
+        # serve cycles keep NO explain context: the snapshot's node
+        # tensors are the resident carry, donated to the next cycle's
+        # delta apply — a retained ctx would read freed device buffers.
+        # Postmortems go through the flight recorder (host copies).
+        report._explain_ctx = _CTX_RELEASED
+    else:
+        # cheap refs, not copies: lets `report.explain(uid)` rebuild the
+        # per-plugin score table for any pod of this batch after the fact;
+        # retention-bounded so old reports release their snapshot. The aux
+        # pytrees are frozen HERE — a later cycle's prepare() rebinds the
+        # shared plugins, and explaining an old report against the live
+        # aux() would score cycle K's snapshot with cycle K+n's config
+        _attach_explain_ctx(report, (
+            ctx.scheduler, ctx.snap, ctx.meta, ctx.assignment,
+            tuple(p.aux() for p in ctx.scheduler.profile.plugins),
+        ))
+
+    if sanitize.enabled():
+        # surface this cycle's checkify findings on the report (the solve
+        # paths above report into the sanitizer's buffer as they run);
+        # checked-call count kept so "no errors" cannot be mistaken for
+        # "checks ran" when the solve fell back to an uninstrumented path
+        reports = sanitize.drain()
+        report.sanitize_checked = len(reports)
+        report.sanitize_errors = [r for r in reports if not r["ok"]]
+
+
+def _cycle_bind(ctx: CycleCtx) -> None:
+    """The bind stage: flush this cycle's placement decisions through the
+    store mutators (bind / reserve / mark_unschedulable). Every mutation
+    here carries THIS cycle's `now` — under the pipelined engine the
+    flush may run while the wall clock is already inside the next cycle's
+    ingest, and backoff windows must still be charged to the cycle that
+    observed the snapshot."""
+    cluster, report, now = ctx.cluster, ctx.report, ctx.now
+    pending, meta = ctx.pending, ctx.meta
+    assignment, admitted, wait = ctx.assignment, ctx.admitted, ctx.wait
+    cosched = ctx.cosched
+    with obs.tracer.span("Bind", tid=ctx.tid):
+        for i, pod in enumerate(pending):
+            node_idx = int(assignment[i])
+            pg = cluster.pod_group_of(pod)
+            if node_idx < 0 or not admitted[i]:
+                report.failed.append(pod.uid)
+                ctx.failed_idx.append((i, pod.uid))
+                cluster.mark_unschedulable(pod.uid, now)
+                if pg is not None:
+                    ctx.failed_by_gang.setdefault(
+                        pg.full_name, []
+                    ).append(pod.uid)
+                continue
+            node_name = meta.node_names[node_idx]
+            if wait[i]:
+                cluster.reserve(pod.uid, node_name)
+                report.reserved[pod.uid] = node_name
+                # per-POD waiting timer from THIS pod's reservation time
+                # (upstream waitingPods, coscheduling.go:227-235;
+                # GetWaitTimeDuration: ScheduleTimeoutSeconds else
+                # PermitWaitingTimeSeconds)
+                timeout_s = (
+                    pg.schedule_timeout_seconds if pg is not None else None
+                )
+                if timeout_s is None and cosched is not None:
+                    timeout_s = cosched.permit_waiting_seconds
+                cluster.pod_deadline_ms[pod.uid] = now + 1000 * (timeout_s or 0)
+            else:
+                cluster.bind(pod.uid, node_name, now)
+                report.bound[pod.uid] = node_name
+
+    if ctx.serve_t0 is not None:
+        # serve-mode decision latency: delta ingest through host-visible
+        # bind decisions (the per-decision number the sustained-churn
+        # bench reports as p50/p99) — observed even on fallback cycles so
+        # the histogram shows what serve traffic actually experienced
+        obs.metrics.observe_ms(
+            obs.SERVE_DECISION_LATENCY,
+            (time.perf_counter() - ctx.serve_t0) * 1000.0,
+        )
+
+    if faults.ACTIVE is not None:
+        # chaos harness only (zero overhead otherwise): simulate process
+        # death AFTER bindings landed in the store — the worst-ordered
+        # crash for resident serve state, since the dying sink's
+        # undrained deltas are lost with the process. The report rides
+        # the exception so the harness can account the real, landed binds
+        spec = faults.ACTIVE.fire(faults.CRASH_POST_BIND)
+        if spec is not None:
+            raise faults.CrashInjected(ctx.report)
+
+
+def _cycle_postbind(ctx: CycleCtx, attribution: bool = True) -> None:
+    """Post-bind store machinery, fenced to the cycle that observed the
+    snapshot: Permit quorum fan-out, whole-gang PostFilter rejection,
+    over-reserve marks and preemption nomination set/clear. The pipelined
+    engine MUST run this before the next cycle's ingest boundary — a
+    nomination or backoff landing mid-overlap would otherwise be observed
+    by (and attributed to) the wrong cycle. `attribution=False` lets the
+    pipelined engine defer the host-only failure decode to its overlap
+    window when the per-pod codes already rode the solve result."""
+    cluster, report, now = ctx.cluster, ctx.report, ctx.now
+    cosched = ctx.cosched
+    if attribution:
+        _attribute_failures(
+            ctx.scheduler, ctx.snap, ctx.result, ctx.failed_idx, report,
+            tid=ctx.tid,
+        )
+
+    # Permit Allow fan-out: quorum reached this cycle releases waiting
+    # siblings
+    with obs.tracer.span("Permit", tid=ctx.tid):
+        for pg in list(cluster.pod_groups.values()):
+            _maybe_release_gang(cluster, pg, report, now)
+
+    # PostFilter: whole-gang rejection (coscheduling.go:160-209)
+    for gang_name in ctx.failed_by_gang:
+        pg = cluster.pod_groups.get(gang_name)
+        if pg is None:
+            continue
+        members = cluster.gang_members(pg)
+        assigned = sum(
+            1 for p in members
+            if p.node_name is not None or p.uid in cluster.reserved
+        )
+        if assigned >= pg.min_member:
+            continue  # quorum already met; stragglers can retry freely
+        # tolerate a small quorum gap: (MinMember - assigned)/MinMember
+        # <= rejectPercentage (coscheduling.go:180-185)
+        reject_pct = cosched.reject_percentage if cosched else 10
+        gap = (pg.min_member - assigned) / max(pg.min_member, 1)
+        if gap <= reject_pct / 100:
+            continue  # a subsequent pod may still complete the quorum
+        _reject_gang(cluster, pg, now, report, cosched, len(members))
+
+    _mark_overreserved_on_failures(cluster, report)
+    engine = ctx.scheduler.profile.preemption
+    with obs.extension_span(
+        "PostFilter", type(engine).__name__ if engine else "none",
+        tid="framework" if ctx.tid == "cycle" else ctx.tid,
+        failed=len(report.failed),
+    ):
+        _run_preemption(ctx.scheduler, cluster, ctx.pending, report, now)
+    obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
+    obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
+    obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
+
+
+def _cycle_finalize(ctx: CycleCtx, attribution: bool = False) -> None:
+    """Report-only epilogue — placement-quality observation and the
+    flight-recorder commit (plus the deferred failure decode under the
+    pipelined engine). Touches no store state, so the pipelined engine
+    runs it inside the NEXT cycle's overlap window, on the host copies
+    `_cycle_solve_fence(quality_view=True)` captured."""
+    if attribution:
+        _attribute_failures(
+            ctx.scheduler, ctx.snap, ctx.result, ctx.failed_idx, ctx.report,
+            tid=ctx.tid,
+        )
+    _observe_quality(
+        ctx.report, ctx.quality_view or ctx.snap,
+        ctx.assignment, ctx.admitted, ctx.wait,
+    )
+    if ctx.rec is not None:
+        ctx.rec.commit(ctx.report)
+
+
+def _quality_view(snap):
+    """Host copies of exactly the snapshot columns `cycle_quality_np`
+    reads, in the same attribute shape — safe to read after the resident
+    node tensors were donated to a later cycle's delta apply."""
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        nodes=SimpleNamespace(
+            alloc=np.asarray(snap.nodes.alloc),
+            requested=np.asarray(snap.nodes.requested),
+            mask=np.asarray(snap.nodes.mask),
+        ),
+        pods=SimpleNamespace(
+            req=np.asarray(snap.pods.req),
+            mask=np.asarray(snap.pods.mask),
+        ),
+    )
+
+
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
               stream_chunk: int | None = None, serve=None,
               resilience=None, gangs=None) -> CycleReport:
@@ -202,60 +625,13 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     profile has no host fallback — callers (the daemon) park the cycle."""
     if now is None:
         now = _now_ms()
-    report = CycleReport()
-    obs.metrics.inc(obs.SCHEDULING_CYCLES)
-    cosched = next(
-        (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)), None
+    ctx = _cycle_open(
+        scheduler, cluster, now, stream_chunk=stream_chunk, serve=serve,
+        resilience=resilience, gangs=gangs,
     )
-
-    for plugin in scheduler.profile.plugins:
-        plugin.configure_cluster(cluster)
-    with obs.tracer.span("ExpireGangs", tid="cycle"):
-        _expire_gangs(cluster, now, report)
-    with obs.tracer.span("NRTResync", tid="cycle"):
-        _resync_nrt_cache(cluster, now)
-    with obs.tracer.span("Collectors", tid="cycle"):
-        _refresh_metrics(scheduler, cluster, now)
-
-    pending = cluster.pending_pods()
-    with obs.tracer.span("Requeue", tid="cycle"):
-        pending = _requeue_eligible(
-            scheduler, cluster, pending, now, report,
-            gang_phase=gangs is not None,
-        )
-    if gangs is None and not pending:
-        return report
-    pending = scheduler.sort_pending(pending, cluster)
-
-    if gangs is not None:
-        # the phase runs even on an empty batch: elastic reconcile must
-        # observe desired-width changes (shrink deletes need no pending
-        # pods), and growth clones it creates join THIS cycle's batch
-        with obs.extension_span("GangPhase", type(gangs).__name__,
-                                pending=len(pending)):
-            pending = gangs.run(scheduler, cluster, pending, now, report)
-        if not pending:
-            # gang-only cycle: every pending pod was a rank-gang member
-            # (bound or parked by the phase); nothing for the per-pod
-            # solve, so close out the counters and return. A serving
-            # engine still DRAINS (refresh with an empty batch): the
-            # phase's binds must land in the resident columns and the
-            # per-gang rank mirror now, not pile up in the sink until the
-            # next non-gang cycle. The cycle is still RECORDED when the
-            # flight recorder is live — the gang capture alone replays
-            # bit-identically through the twin
-            if serve is not None:
-                serve.refresh(cluster, [], now_ms=now)
-            rec = flightrec.recorder.begin(
-                now_ms=now, profile=scheduler.profile.name
-            )
-            if rec is not None:
-                gangs.annotate_record(rec)
-                rec.commit(report)
-            obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
-            obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
-            obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
-            return report
+    _cycle_pending(ctx)
+    if ctx.done:
+        return ctx.report
 
     from scheduler_plugins_tpu.utils import sanitize
 
@@ -265,207 +641,26 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         # only THIS cycle's checked calls to this report
         sanitize.drain()
     generation = getattr(cluster.nrt_cache, "generation", None)
-    rec = flightrec.recorder.begin(now_ms=now, profile=scheduler.profile.name)
-    serve_t0 = time.perf_counter() if serve is not None else None
-    served = False
-    with obs.flow("cycle", generation=generation, pending=len(pending)):
-        with obs.tracer.span("Snapshot", tid="cycle", pending=len(pending)):
-            snap = meta = None
-            if serve is not None:
-                refreshed = serve.refresh(cluster, pending, now_ms=now)
-                if refreshed is not None:
-                    snap, meta = refreshed
-                    served = True
-            if snap is None:
-                snap, meta = cluster.snapshot(pending, now_ms=now)
-        scheduler.prepare(meta, cluster)
-        if rec is not None:
-            # inputs land in the ring BEFORE the solve: the cycle that
-            # crashes the solver is exactly the one worth replaying
-            with obs.tracer.span("Record", tid="cycle"):
-                rec.capture_inputs(
-                    snap, meta, scheduler, stream_chunk=stream_chunk,
-                    profile_config=flightrec.recorder.profile_config,
-                )
-                if served:
-                    # serve provenance: resident generation, base digest,
-                    # and the packed delta stream that produced this
-                    # cycle's snapshot view
-                    serve.annotate_record(rec)
-                if gangs is not None:
-                    # gang-phase provenance: the full RankGangState +
-                    # outputs, so a recorded gang cycle replays
-                    # bit-identically through the numpy twin
-                    gangs.annotate_record(rec)
-        result = None
-        # the Solve span covers dispatch AND completion (np.asarray host
-        # transfers below force it) for the sequential path; the streamed
-        # path's device-side overlap shows up as pipeline rows emitted by
-        # run_chunk_pipeline itself
+    ctx.rec = flightrec.recorder.begin(
+        now_ms=now, profile=scheduler.profile.name
+    )
+    ctx.serve_t0 = time.perf_counter() if serve is not None else None
+    with obs.flow("cycle", generation=generation, pending=len(ctx.pending)):
+        _cycle_snapshot(ctx)
+        # the Solve span covers dispatch AND completion (the fence's
+        # np.asarray host transfers force it) for the sequential path;
+        # the streamed path's device-side overlap shows up as pipeline
+        # rows emitted by run_chunk_pipeline itself
         with obs.extension_span(
-            "Solve", scheduler.profile.name, pending=len(pending)
+            "Solve", scheduler.profile.name, pending=len(ctx.pending)
         ):
-            if resilience is not None:
-                # watchdog-guarded: dispatch + completion fence in a
-                # worker thread with a deadline; retries, then failover
-                # to the host parity path (resilience.watchdog)
-                (assignment, admitted, wait, codes_np,
-                 report.solve_path) = resilience.solve_cycle(
-                    scheduler, snap, stream_chunk=stream_chunk
-                )
-                result = SolveResultView(
-                    assignment, admitted, wait, failed_plugin=codes_np
-                )
-            else:
-                if stream_chunk:
-                    from scheduler_plugins_tpu.parallel.pipeline import (
-                        streamed_profile_solve,
-                    )
-
-                    streamed = streamed_profile_solve(
-                        scheduler, snap, chunk=stream_chunk
-                    )
-                    if streamed is not None:
-                        result = SolveResultView(*streamed)
-                if result is None:
-                    result = scheduler.solve(snap)
-                # host transfers force completion (block_until_ready can
-                # return early through the tunneled backend — CLAUDE.md),
-                # so the Solve span/histogram covers the device round-trip
-                assignment = np.asarray(result.assignment)
-                admitted = np.asarray(result.admitted)
-                wait = np.asarray(result.wait)
-        report.degraded = resilience is not None and resilience.degraded
-        if rec is not None:
-            with obs.tracer.span("Record", tid="cycle"):
-                codes = getattr(result, "failed_plugin", None)
-                rec.capture_outputs(
-                    # the host failover path carries the sequential parity
-                    # semantics (and per-pod codes), so its records replay
-                    # through the same path as device-sequential ones
-                    "sequential" if isinstance(result, SolveResult)
-                    or codes is not None else "streamed",
-                    assignment, admitted, wait,
-                    failed_plugin=(
-                        None if codes is None else np.asarray(codes)
-                    ),
-                )
-    if served:
-        # serve cycles keep NO explain context: the snapshot's node
-        # tensors are the resident carry, donated to the next cycle's
-        # delta apply — a retained ctx would read freed device buffers.
-        # Postmortems go through the flight recorder (host copies).
-        report._explain_ctx = _CTX_RELEASED
-    else:
-        # cheap refs, not copies: lets `report.explain(uid)` rebuild the
-        # per-plugin score table for any pod of this batch after the fact;
-        # retention-bounded so old reports release their snapshot. The aux
-        # pytrees are frozen HERE — a later cycle's prepare() rebinds the
-        # shared plugins, and explaining an old report against the live
-        # aux() would score cycle K's snapshot with cycle K+n's config
-        _attach_explain_ctx(report, (
-            scheduler, snap, meta, assignment,
-            tuple(p.aux() for p in scheduler.profile.plugins),
-        ))
-
-    if sanitize.enabled():
-        # surface this cycle's checkify findings on the report (the solve
-        # paths above report into the sanitizer's buffer as they run);
-        # checked-call count kept so "no errors" cannot be mistaken for
-        # "checks ran" when the solve fell back to an uninstrumented path
-        reports = sanitize.drain()
-        report.sanitize_checked = len(reports)
-        report.sanitize_errors = [r for r in reports if not r["ok"]]
-
-    failed_by_gang: dict[str, list[str]] = {}
-    failed_idx: list[tuple[int, str]] = []
-    with obs.tracer.span("Bind", tid="cycle"):
-        for i, pod in enumerate(pending):
-            node_idx = int(assignment[i])
-            pg = cluster.pod_group_of(pod)
-            if node_idx < 0 or not admitted[i]:
-                report.failed.append(pod.uid)
-                failed_idx.append((i, pod.uid))
-                cluster.mark_unschedulable(pod.uid, now)
-                if pg is not None:
-                    failed_by_gang.setdefault(pg.full_name, []).append(pod.uid)
-                continue
-            node_name = meta.node_names[node_idx]
-            if wait[i]:
-                cluster.reserve(pod.uid, node_name)
-                report.reserved[pod.uid] = node_name
-                # per-POD waiting timer from THIS pod's reservation time
-                # (upstream waitingPods, coscheduling.go:227-235;
-                # GetWaitTimeDuration: ScheduleTimeoutSeconds else
-                # PermitWaitingTimeSeconds)
-                timeout_s = pg.schedule_timeout_seconds if pg is not None else None
-                if timeout_s is None and cosched is not None:
-                    timeout_s = cosched.permit_waiting_seconds
-                cluster.pod_deadline_ms[pod.uid] = now + 1000 * (timeout_s or 0)
-            else:
-                cluster.bind(pod.uid, node_name, now)
-                report.bound[pod.uid] = node_name
-
-    if serve_t0 is not None:
-        # serve-mode decision latency: delta ingest through host-visible
-        # bind decisions (the per-decision number the sustained-churn
-        # bench reports as p50/p99) — observed even on fallback cycles so
-        # the histogram shows what serve traffic actually experienced
-        obs.metrics.observe_ms(
-            obs.SERVE_DECISION_LATENCY,
-            (time.perf_counter() - serve_t0) * 1000.0,
-        )
-
-    if faults.ACTIVE is not None:
-        # chaos harness only (zero overhead otherwise): simulate process
-        # death AFTER bindings landed in the store — the worst-ordered
-        # crash for resident serve state, since the dying sink's
-        # undrained deltas are lost with the process. The report rides
-        # the exception so the harness can account the real, landed binds
-        spec = faults.ACTIVE.fire(faults.CRASH_POST_BIND)
-        if spec is not None:
-            raise faults.CrashInjected(report)
-
-    _attribute_failures(scheduler, snap, result, failed_idx, report)
-
-    # Permit Allow fan-out: quorum reached this cycle releases waiting siblings
-    with obs.tracer.span("Permit", tid="cycle"):
-        for pg in list(cluster.pod_groups.values()):
-            _maybe_release_gang(cluster, pg, report, now)
-
-    # PostFilter: whole-gang rejection (coscheduling.go:160-209)
-    for gang_name in failed_by_gang:
-        pg = cluster.pod_groups.get(gang_name)
-        if pg is None:
-            continue
-        members = cluster.gang_members(pg)
-        assigned = sum(
-            1 for p in members if p.node_name is not None or p.uid in cluster.reserved
-        )
-        if assigned >= pg.min_member:
-            continue  # quorum already met; stragglers can retry freely
-        # tolerate a small quorum gap: (MinMember - assigned)/MinMember
-        # <= rejectPercentage (coscheduling.go:180-185)
-        reject_pct = cosched.reject_percentage if cosched else 10
-        gap = (pg.min_member - assigned) / max(pg.min_member, 1)
-        if gap <= reject_pct / 100:
-            continue  # a subsequent pod may still complete the quorum
-        _reject_gang(cluster, pg, now, report, cosched, len(members))
-
-    _mark_overreserved_on_failures(cluster, report)
-    engine = scheduler.profile.preemption
-    with obs.extension_span(
-        "PostFilter", type(engine).__name__ if engine else "none",
-        failed=len(report.failed),
-    ):
-        _run_preemption(scheduler, cluster, pending, report, now)
-    obs.metrics.inc(obs.PODS_BOUND, len(report.bound))
-    obs.metrics.inc(obs.PODS_FAILED, len(report.failed))
-    obs.metrics.inc(obs.GANG_REJECTIONS, len(report.rejected_gangs))
-    _observe_quality(report, snap, assignment, admitted, wait)
-    if rec is not None:
-        rec.commit(report)
-    return report
+            _cycle_solve_dispatch(ctx)
+            _cycle_solve_fence(ctx)
+        _cycle_post_solve(ctx)
+    _cycle_bind(ctx)
+    _cycle_postbind(ctx, attribution=True)
+    _cycle_finalize(ctx)
+    return ctx.report
 
 
 def _observe_quality(report, snap, assignment, admitted, wait) -> None:
@@ -489,7 +684,8 @@ def _observe_quality(report, snap, assignment, admitted, wait) -> None:
         )
 
 
-def _attribute_failures(scheduler, snap, result, failed_idx, report):
+def _attribute_failures(scheduler, snap, result, failed_idx, report,
+                        tid="cycle"):
     """Fill `CycleReport.failed_by` and the
     `scheduler_unschedulable_by_plugin_total{plugin}` counters — the
     upstream UnschedulablePlugins attribution. The sequential parity path
@@ -500,7 +696,7 @@ def _attribute_failures(scheduler, snap, result, failed_idx, report):
     gates, or in-cycle capacity exhaustion) decode to "NodeResourcesFit"."""
     if not failed_idx:
         return
-    with obs.tracer.span("Attribution", tid="cycle", failed=len(failed_idx)):
+    with obs.tracer.span("Attribution", tid=tid, failed=len(failed_idx)):
         codes = getattr(result, "failed_plugin", None)
         if codes is not None:
             # sequential parity path: (P,) in-solve codes, pod-indexed

@@ -6,7 +6,12 @@ from scheduler_plugins_tpu.serving.deltas import (  # noqa: F401
     NodeUpserts,
     UsageDeltas,
     apply_node_deltas,
+    compact_node_rows,
     delta_apply_program,
+    node_compact_program,
     pod_usage_vectors,
 )
-from scheduler_plugins_tpu.serving.engine import ServeEngine  # noqa: F401
+from scheduler_plugins_tpu.serving.engine import (  # noqa: F401
+    ServeEngine,
+    StreamingServeEngine,
+)

@@ -124,6 +124,10 @@ class DeltaSink:
     def __init__(self):
         self.events: list[tuple] = []
         self.overflowed = False
+        #: drain generation: bumped by every `drain()` — the pipelined
+        #: engine's conflict-fence accounting compares it around a bind
+        #: flush to tell whether the flush crossed an ingest boundary
+        self.drains = 0
         #: unbound pods carrying a NominatedNodeName that the per-cycle
         #: pending gate cannot see (scheduling-gated pods arrive through
         #: `add_pod`, never through the pending batch) — any entry keeps
@@ -191,6 +195,7 @@ class DeltaSink:
 
     def drain(self) -> list[tuple]:
         events, self.events = self.events, []
+        self.drains += 1
         return events
 
     def consume_overflow(self) -> bool:
@@ -368,11 +373,77 @@ def apply_node_deltas(nodes: NodeState,
     )
 
 
+def compact_node_rows(nodes: NodeState, gather_idx, valid) -> NodeState:
+    """Delete node rows in place: gather the surviving rows into their
+    shifted slots (`gather_idx`, host-computed) and re-pad the freed tail
+    (`valid` False) with the exact values a fresh `build_snapshot` pad
+    row carries (zeros; mask False; region/zone -1) — so the compacted
+    resident columns stay byte-identical to a rebase's, and the
+    anti-entropy digest cannot tell them apart. Row ORDER is preserved
+    (a shift, never a swap-with-last): the store's dict pop preserves the
+    order of the remaining nodes, and score tie-breaking is
+    lowest-index. This turns the Node/Delete rebase — the one O(cluster)
+    event in steady churn — into an O(changed)-host, O(N)-device
+    gather (`StreamingServeEngine`). The `nodes` argument is donated at
+    the jit boundary (`node_compact_program`)."""
+    import jax.numpy as jnp
+
+    def take2(cur):
+        return jnp.where(valid[:, None], cur[gather_idx], 0)
+
+    def take1(cur, pad=0):
+        out = cur[gather_idx]
+        return jnp.where(valid, out, jnp.asarray(pad).astype(out.dtype))
+
+    return nodes.replace(
+        alloc=take2(nodes.alloc),
+        capacity=take2(nodes.capacity),
+        requested=take2(nodes.requested),
+        nonzero_requested=take2(nodes.nonzero_requested),
+        limits=take2(nodes.limits),
+        mask=take1(nodes.mask, False),
+        region=take1(nodes.region, -1),
+        zone=take1(nodes.zone, -1),
+        pod_count=take1(nodes.pod_count),
+        terminating=take1(nodes.terminating),
+        # invariantly zero while serve mode owns the snapshot (the
+        # compatibility gate excludes nominations); written fresh so no
+        # donated buffer aliases an output (JA002)
+        nominated=jnp.zeros_like(nodes.nominated),
+    )
+
+
 #: process-wide memo keyed by sanitize mode: every `ServeEngine` (and a
 #: chaos-harness crash restart, which builds a fresh one mid-run) shares
 #: ONE jitted apply program per mode, so engine reconstruction never pays
 #: a recompile for an already-warm shape
 _APPLY_PROGRAMS: dict = {}
+_COMPACT_PROGRAMS: dict = {}
+
+
+def node_compact_program():
+    """The jitted row-compaction program with the resident carry DONATED
+    (`StreamingServeEngine` node-delete path) — same constructor/memo
+    discipline as `delta_apply_program`, registered with the AOT
+    compile-readiness gate as `serving_node_compact`."""
+    import jax
+
+    from scheduler_plugins_tpu.utils import observability as obs
+    from scheduler_plugins_tpu.utils import sanitize
+
+    key = sanitize.enabled()
+    if key in _COMPACT_PROGRAMS:
+        return _COMPACT_PROGRAMS[key]
+    if key:
+        jitted = sanitize.checkified(
+            compact_node_rows, program="serve_node_compact"
+        )
+    else:
+        jitted = jax.jit(compact_node_rows, donate_argnums=(0,))
+    _COMPACT_PROGRAMS[key] = obs.compile_watch(
+        jitted, program="serve_node_compact"
+    )
+    return _COMPACT_PROGRAMS[key]
 
 
 def delta_apply_program():

@@ -217,7 +217,7 @@ class ServeEngine:
         with obs.tracer.span(
             "ServeRefresh/classify", tid="serve", events=len(events)
         ):
-            upserts, usage, rebase = self._classify(events)
+            upserts, usage, rebase = self._ingest(events)
         if self._sink.consume_overflow():
             # the queue collapsed while nobody drained: the surviving
             # events are a partial window — the resident base is
@@ -256,6 +256,41 @@ class ServeEngine:
         return self._assemble(cluster, pending)
 
     # -- event classification -------------------------------------------
+    def _ingest(self, events):
+        """Classification seam: the streaming subclass splits the event
+        stream at node-delete boundaries (compacting rows in place); the
+        base engine classifies the whole batch, with a node delete
+        forcing a rebase."""
+        return self._classify(events)
+
+    def _usage_vectors(self, pod, final=False):
+        """One pod's (requested, nonzero, limits) usage contribution —
+        the streaming subclass memoizes this per pod object (`final`
+        marks the pod's last event, releasing its entry)."""
+        return D.pod_usage_vectors(pod)
+
+    def _row_cache(self):
+        """Per-pod assembly memo passed to `build_pod_state` (None in the
+        base engine: every cycle lowers its batch from scratch)."""
+        return None
+
+    def _stage_args(self, args):
+        """Host->device staging of one packed delta batch. The base
+        engine ships explicit device copies; the streaming engine hands
+        pjit the numpy arrays directly (one C++ shard_args pass instead
+        of a Python conversion per array — same bytes either way)."""
+        import jax.numpy as jnp
+
+        return tuple(jnp.asarray(a) for a in args)
+
+    def _stage_pods(self, pod_state):
+        """Host->device staging of the assembled pod tensors (same
+        split as `_stage_args`)."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(jnp.asarray, pod_state)
+
     def _classify(self, events):
         """Coalesce drained events into packed-row lists. Returns
         (upsert_rows, usage_rows, rebase_reason|None)."""
@@ -341,7 +376,9 @@ class ServeEngine:
                     continue
                 sign = 1 if kind == D.POD_ASSIGN else -1
                 try:
-                    req, nz, lim = D.pod_usage_vectors(pod)
+                    req, nz, lim = self._usage_vectors(
+                        pod, final=kind == D.POD_UNASSIGN
+                    )
                 except D.UnsupportedResource:
                     fail("extended-resource")
                     continue
@@ -378,8 +415,8 @@ class ServeEngine:
             warnings.simplefilter("always")
             self._nodes = self._apply(
                 self._nodes,
-                *(jnp.asarray(a) for a in ups.as_args()),
-                *(jnp.asarray(a) for a in use.as_args()),
+                *self._stage_args(ups.as_args()),
+                *self._stage_args(use.as_args()),
             )
         for w in caught:
             msg = str(w.message)
@@ -688,11 +725,11 @@ class ServeEngine:
         ns_in = _Interner(meta.namespaces)
         pod_state = build_pod_state(
             pending, P, D.CANON_INDEX, ns_in, lambda pod: -1,
-            cluster.tlp_prediction,
+            cluster.tlp_prediction, row_cache=self._row_cache(),
         )
         snap = ClusterSnapshot(
             nodes=self._nodes,
-            pods=jax.tree.map(jnp.asarray, pod_state),
+            pods=self._stage_pods(pod_state),
         )
         return snap, meta
 
@@ -729,6 +766,357 @@ class ServeEngine:
                 rec.blobs,
             )
         rec.manifest["serve"] = serve
+
+
+def _shift_gather_args(npad: int, slot: int, survivors: int):
+    """(gather_idx, valid) for `compact_node_rows`: rows above `slot`
+    shift down one, the freed tail re-pads; `survivors` real rows remain.
+    ONE constructor shared by the live compaction path and the AOT
+    compile-readiness gate, so the certified argument layout IS the
+    shipped one."""
+    idx = np.empty(npad, np.int32)
+    idx[:slot] = np.arange(slot, dtype=np.int32)
+    idx[slot:npad - 1] = np.arange(slot + 1, npad, dtype=np.int32)
+    idx[npad - 1] = npad - 1
+    valid = np.zeros(npad, bool)
+    valid[:survivors] = True
+    return idx, valid
+
+
+class StreamingServeEngine(ServeEngine):
+    """O(changed)-everything serving engine for the pipelined cycle
+    engine (`framework.pipeline_cycle.PipelinedCycle`; docs/SCALING.md
+    measured breakdown). Same exactness contract as the base engine —
+    the differential gates hold it bit-identical to fresh snapshots —
+    with three streaming-ingest upgrades:
+
+    - **Node-delete compaction**: a Node/Delete no longer forces the
+      O(cluster) rebase. The resident rows are shift-compacted in place
+      by one donated gather program (`serving.deltas.compact_node_rows`),
+      preserving row order (= the store's dict order after the pop) and
+      re-padding the freed tail byte-identically to a fresh snapshot's
+      pad rows. The event stream is segmented at each delete so slot
+      numbering stays exact within every applied batch. Remaining
+      rebase-class events (label re-interning, extended resources,
+      unknown-node pods, sink overflow) rebase exactly as before. One
+      self-healing caveat: the region/zone interning tables survive a
+      compaction, so deleting the first-seen carrier of a label code can
+      make the next anti-entropy digest diverge from a fresh re-intern —
+      the divergence rebases (exact, just slower), never mis-serves.
+    - **Usage-vector memo**: `pod_usage_vectors` is cached per pod
+      OBJECT (a feed upsert replaces the object wholesale, naturally
+      invalidating); a pod's final unassign releases its entry.
+    - **Pod-row memo**: `build_pod_state` runs with a per-pod row cache,
+      so retried pods re-lower nothing (hits are bit-identical by
+      construction — the cache stores the same encodes the cold path
+      computes).
+    """
+
+    #: safety valve on the memo tables (not a tuning knob): beyond this
+    #: many entries the caches clear wholesale and rebuild from misses
+    MAX_CACHE = 1 << 16
+
+    def __init__(self):
+        super().__init__()
+        self._compact_fn = D.node_compact_program()
+        self._compact_warm: set = set()
+        self._vec_cache: dict = {}
+        self._rows: dict = {}
+        #: node-delete row compactions performed (each replaces what the
+        #: base engine counts as a rebase)
+        self.compactions = 0
+
+    # -- memo seams ------------------------------------------------------
+    def _row_cache(self):
+        if len(self._rows) > self.MAX_CACHE:
+            self._rows.clear()
+        return self._rows
+
+    def _usage_vectors(self, pod, final=False):
+        ent = self._vec_cache.get(pod.uid)
+        if ent is not None and ent[0] is pod:
+            if final:
+                del self._vec_cache[pod.uid]
+            return ent[1]
+        vecs = D.pod_usage_vectors(pod)
+        if final:
+            self._vec_cache.pop(pod.uid, None)
+        else:
+            if len(self._vec_cache) > self.MAX_CACHE:
+                self._vec_cache.clear()
+            self._vec_cache[pod.uid] = (pod, vecs)
+        return vecs
+
+    def _stage_args(self, args):
+        # pjit stages numpy args itself in one C++ pass; the explicit
+        # per-array device conversion is pure Python overhead here
+        return args
+
+    def _stage_pods(self, pod_state):
+        # the solve jit stages the pod tensors with the call; keeping
+        # them numpy also spares the recorder a device round-trip
+        return pod_state
+
+    def _rebase_inner(self, cluster, pending, now_ms: int):
+        out = super()._rebase_inner(cluster, pending, now_ms)
+        # prime the usage-vector memo for the whole assigned population:
+        # a rebase is already O(cluster), and paying the per-pod encodes
+        # here keeps the FIRST O(assigned) verify from owning them on a
+        # timed cycle (every later verify then runs at memo speed). Prime
+        # on the REAL pod objects (never `_assigned_pods`'s per-reserved
+        # copies — a copy-keyed entry can never hit the identity check)
+        try:
+            for pod in cluster.pods.values():
+                if pod.node_name is not None or pod.uid in cluster.reserved:
+                    self._usage_vectors(pod)
+        except D.UnsupportedResource:
+            pass  # extended resources: verify falls back to base anyway
+        if self._nodes is not None and self._npad not in self._compact_warm:
+            # compile the compaction program for this resident shape NOW,
+            # on a throwaway zero-state (NEVER the live carry — the
+            # program donates its input, and the rebase just handed the
+            # live tensors to the current cycle's snapshot), so the first
+            # real node delete never pays a mid-run retrace
+            self._compact_warm.add(self._npad)
+            import warnings
+
+            import jax
+            import jax.numpy as jnp
+
+            dummy = jax.tree.map(
+                lambda a: jnp.zeros_like(a), self._nodes
+            )
+            idx = np.arange(self._npad, dtype=np.int32)
+            valid = np.zeros(self._npad, bool)
+            valid[:len(self._names)] = True
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers were not usable.*"
+                )
+                self._compact_fn(dummy, idx, valid)
+        return out
+
+    # -- segmented ingest -----------------------------------------------
+    def _ingest(self, events):
+        """Split the drained stream at compactable node-delete
+        boundaries: classify+apply each preceding segment (slot numbering
+        is exact within a segment — deletes renumber slots), compact the
+        deleted row, continue. Returns the final delete-free tail for the
+        base refresh flow. Falls back to the base whole-batch classify
+        (rebase on delete) whenever there is no resident base to
+        compact."""
+        if self._nodes is None or not any(
+            ev[0] == D.NODE_DELETE for ev in events
+        ):
+            return self._classify(events)
+        segment: list = []
+        rebase = None
+        for ev in events:
+            if ev[0] == D.NODE_DELETE and rebase is None:
+                name = ev[1]
+                # classify+apply the preceding segment FIRST: a node
+                # added (or otherwise touched) in THIS drain window gets
+                # its slot from the segment's upserts — looking the slot
+                # up before applying would discard the delete and leave
+                # a ghost resident row for a node the store no longer
+                # has (an add+remove flap within one window)
+                ups, use, rebase = self._classify(segment)
+                segment = []
+                if rebase is not None:
+                    continue  # the resident base is dying anyway
+                if ups or use:
+                    self._grow(bucket_size(max(len(self._names), 1)))
+                    self._apply_batch(ups, use)
+                slot = self._slots.get(name)
+                if slot is None:
+                    # node the engine truly never saw: nothing resident
+                    # to remove — keep the base bookkeeping only
+                    self._tainted.discard(name)
+                    self._node_labels.pop(name, None)
+                    continue
+                self._compact_row(name, slot)
+                continue
+            segment.append(ev)
+        ups, use, seg_rebase = self._classify(segment)
+        return ups, use, rebase if rebase is not None else seg_rebase
+
+    # -- O(assigned) anti-entropy ---------------------------------------
+    def verify(self, cluster) -> Optional[str]:
+        """Anti-entropy digest without the O(cluster) snapshot rebuild:
+        the expected node columns are accumulated directly from the store
+        objects through the SAME shared per-pod encode
+        (`pod_usage_vectors`, memoized per pod object) and per-node
+        encode the fresh snapshot would use, then digest-compared to the
+        resident columns — byte-identical expectations by construction
+        (tests/test_pipeline_cycle.py::TestStreamingVerify holds this
+        against the base engine's fresh-snapshot verify on clean AND
+        corrupted state). Independence is preserved: the resident
+        columns were built through the sink+device path, the expectation
+        comes straight from the store objects. Anything outside the
+        canonical axis (an extended resource) falls back to the base
+        engine's full verify, which classifies it exactly."""
+        from scheduler_plugins_tpu.utils import flightrec
+
+        if self._nodes is None:
+            self._verify_pending = False
+            obs.metrics.inc(obs.ANTIENTROPY_CHECKS)
+            return None
+        names = list(cluster.nodes)
+        expected = None
+        if names == self._names:
+            try:
+                expected = self._expected_columns(cluster, names)
+            except D.UnsupportedResource:
+                # extended resource somewhere: the packed axis is wider
+                # than the canonical four — delegate to the base
+                # engine's fresh-snapshot verify BEFORE opening this
+                # path's span/counter (one check = one count, one span)
+                return super().verify(cluster)
+        with obs.tracer.span(
+            "ServeRefresh/verify", tid="serve", staleness=self._staleness,
+            fast=True,
+        ):
+            self._verify_pending = False
+            obs.metrics.inc(obs.ANTIENTROPY_CHECKS)
+            reason = None
+            if expected is None:
+                reason = "row-order"
+            else:
+                mine = flightrec._pack_digest(
+                    {k: np.asarray(v)
+                     for k, v in self._node_columns().items()}
+                )
+                theirs = flightrec._pack_digest(expected)
+                if mine != theirs:
+                    reason = "column-digest"
+            if reason is not None:
+                self.antientropy_divergences += 1
+                obs.metrics.inc(obs.ANTIENTROPY_DIVERGENCE)
+                obs.logger.warning(
+                    "serve anti-entropy divergence (%s) after %d delta "
+                    "events%s: re-basing", reason, self._staleness,
+                    f" (last fault: {self.last_fault})"
+                    if self.last_fault else "",
+                )
+            return reason
+
+    def _expected_columns(self, cluster, names) -> dict:
+        """The node columns a fresh `build_snapshot` at this padding
+        would produce, accumulated O(nodes + assigned) — the exact
+        per-pod arithmetic rides the shared `pod_usage_vectors`
+        (requested/nonzero carry the pods-count slot per pod, so their
+        sums equal the snapshot's pod_count overwrite)."""
+        R = len(D.CANON_INDEX)
+        npad = self._npad
+        alloc = np.zeros((npad, R), np.int64)
+        capacity = np.zeros((npad, R), np.int64)
+        requested = np.zeros((npad, R), np.int64)
+        nonzero = np.zeros((npad, R), np.int64)
+        limits = np.zeros((npad, R), np.int64)
+        mask = np.zeros(npad, bool)
+        region = np.full(npad, -1, np.int32)
+        zone = np.full(npad, -1, np.int32)
+        pod_count = np.zeros(npad, np.int32)
+        terminating = np.zeros(npad, np.int32)
+        # fresh first-seen label interning in store order (NOT the
+        # engine's surviving tables): this keeps the label-drift check
+        # the fresh-snapshot verify performs — deleting the first-seen
+        # carrier of a code diverges here and rebases
+        regions: dict = {}
+        zones: dict = {}
+        node_pos = {}
+        for i, node in enumerate(cluster.nodes.values()):
+            node_pos[node.name] = i
+            alloc[i] = D._encode(node.allocatable)
+            capacity[i] = D._encode(node.capacity)
+            mask[i] = not node.unschedulable
+            if node.region:
+                region[i] = regions.setdefault(node.region, len(regions))
+            if node.zone:
+                zone[i] = zones.setdefault(node.zone, len(zones))
+        # the assigned view, on the REAL pod objects: bound pods at their
+        # node plus reserved (permit-waiting) pods at their held node —
+        # the same definition `Cluster._assigned_pods` materializes, but
+        # without its per-reserved-pod copies (a copy would miss the
+        # usage-vector memo's identity check and evict the real pod's
+        # entry on every verify)
+        for pod in cluster.pods.values():
+            i = node_pos.get(pod.node_name)
+            if i is None:
+                continue
+            req, nz, lim = self._usage_vectors(pod)
+            requested[i] += req
+            nonzero[i] += nz
+            limits[i] += lim
+            pod_count[i] += 1
+            if pod.terminating:
+                terminating[i] += 1
+        for uid, node in cluster.reserved.items():
+            pod = cluster.pods.get(uid)
+            if pod is None or pod.node_name is not None:
+                continue
+            i = node_pos.get(node)
+            if i is None:
+                continue
+            req, nz, lim = self._usage_vectors(pod)
+            requested[i] += req
+            nonzero[i] += nz
+            limits[i] += lim
+            pod_count[i] += 1
+            if pod.terminating:
+                terminating[i] += 1
+        # same key order as _node_columns so the digests align
+        return {
+            "alloc": alloc, "capacity": capacity, "requested": requested,
+            "nonzero_requested": nonzero, "limits": limits,
+            "mask": mask, "region": region, "zone": zone,
+            "pod_count": pod_count, "terminating": terminating,
+        }
+
+    def _compact_row(self, name: str, slot: int) -> None:
+        import warnings
+
+        import jax.numpy as jnp
+
+        with obs.tracer.span(
+            "ServeRefresh/compact", tid="serve", slot=slot
+        ):
+            self._tainted.discard(name)
+            self._node_labels.pop(name, None)
+            idx, valid = _shift_gather_args(
+                self._npad, slot, len(self._names) - 1
+            )
+            with warnings.catch_warnings():
+                # CPU backends never donate and list every buffer (the
+                # delta-apply program's known shape, PR 2/6)
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers were not usable.*"
+                )
+                self._nodes = self._compact_fn(
+                    self._nodes, jnp.asarray(idx), jnp.asarray(valid)
+                )
+            self._names.pop(slot)
+            self._slots = {n: i for i, n in enumerate(self._names)}
+            self.compactions += 1
+            self._generation += 1
+            self._staleness += 1
+            self._last = {"mode": "compact", "events": 1}
+            self._observe()
+
+
+def compact_lower_args(n_nodes: int = 256, delete_slot: int = 3):
+    """(jitted fn, sample args) for the AOT compile-readiness gate — the
+    exact donated row-compaction program `StreamingServeEngine` runs on a
+    node delete (`tools/tpu_lower.py` serving_node_compact), at the same
+    reduced resident shape as `lower_program_args`. One constructor so
+    the certified program and the shipped program cannot drift."""
+    from scheduler_plugins_tpu.models import allocatable_scenario
+
+    cluster = allocatable_scenario(n_nodes=n_nodes, n_pods=1)
+    npad = bucket_size(n_nodes)
+    snap, _meta = cluster.snapshot([], now_ms=0, pad_nodes=npad)
+    idx, valid = _shift_gather_args(npad, delete_slot, n_nodes - 1)
+    return D.node_compact_program(), (snap.nodes, idx, valid)
 
 
 def lower_program_args(n_nodes: int = 256, n_upserts: int = 8,

@@ -111,6 +111,19 @@ class Cluster:
     #: their `note_event` calls — the O(changed) feed the resident-state
     #: serving engine ingests instead of re-snapshotting (docs/SERVING.md)
     delta_sink: Optional[object] = None
+    #: opt-in O(changed) pending index (`enable_pending_index`, the
+    #: pipelined cycle engine's ingest path): uid -> Pod for every
+    #: currently-schedulable pod, maintained by the same mutators that
+    #: notify the delta sink. None (the default) keeps `pending_pods` as
+    #: the exact O(pods) scan the serial engine has always run.
+    _pending_idx: Optional[dict] = None
+    #: admission serial per uid, reproducing the pods-dict iteration
+    #: order the scan yields: assigned at FIRST add (dict updates keep
+    #: their position), re-assigned when a removed uid is re-added
+    #: (Python dicts move it to the end) — so the indexed queue order is
+    #: bit-identical to the scan's, ties and all
+    _pod_order: dict = field(default_factory=dict)
+    _order_next: int = 0
 
     def note_event(self, kind: str) -> None:
         """Record a cluster event ("Resource/Action", `api.events`) for
@@ -333,6 +346,7 @@ class Cluster:
             self.nrt_cache.track_pod(pod)
         if self.native is not None:
             self._native_upsert_pod(pod)
+        self._index_add_pod(pod, was_present=old is not None)
 
     def remove_pod(self, uid: str):
         self.release_reservation(uid)  # notifies the NRT cache too
@@ -340,6 +354,10 @@ class Cluster:
         self.unschedulable_since.pop(uid, None)
         self._clear_backoff(uid)
         pod = self.pods.pop(uid, None)
+        # after the pop: release_reservation may have re-indexed the
+        # still-present pod above; a removed uid must leave both tables
+        # (a later re-add lands at the end, like the pods dict)
+        self._index_drop_pod(uid, forget_order=True)
         if pod is not None:
             self.note_event(ev.POD_DELETE)
             if self.delta_sink is not None:
@@ -369,6 +387,7 @@ class Cluster:
             return
         was_terminating = pod.terminating
         pod.deletion_ms = now_ms
+        self._index_drop_pod(uid)
         self.note_event(ev.POD_UPDATE)
         if self.native is not None:
             self._native_upsert_pod(pod)
@@ -480,20 +499,83 @@ class Cluster:
             and p.pod_group() == pg.name
         ]
 
+    def _pending_eligible(self, pod: Pod) -> bool:
+        """THE schedulable-queue predicate — one copy shared by the scan
+        and the maintained index, so the two views cannot drift."""
+        return (
+            pod.node_name is None
+            and pod.uid not in self.reserved
+            and pod.phase == PodPhase.PENDING
+            and not pod.terminating
+            and not pod.scheduling_gated
+            and pod.scheduler_name in self.scheduler_names
+        )
+
+    def enable_pending_index(self) -> None:
+        """Switch `pending_pods` from the O(pods) scan to a maintained
+        O(changed) index (the pipelined engine's ingest path,
+        docs/SCALING.md). Call AFTER `scheduler_names` and the initial
+        population are configured; mutators keep it exact from here on.
+        Code that flips a pod's eligibility IN PLACE (outside the store
+        mutators — the same blind spot the delta sink has) must call
+        `reindex_pod`."""
+        self._pod_order = {uid: i for i, uid in enumerate(self.pods)}
+        self._order_next = len(self._pod_order)
+        self._pending_idx = {
+            p.uid: p for p in self.pods.values() if self._pending_eligible(p)
+        }
+
+    def disable_pending_index(self) -> None:
+        self._pending_idx = None
+        self._pod_order = {}
+        self._order_next = 0
+
+    def reindex_pod(self, uid: str) -> None:
+        """Re-evaluate one pod's pending-index membership after an
+        in-place eligibility flip (phase / scheduling gate)."""
+        if self._pending_idx is None:
+            return
+        pod = self.pods.get(uid)
+        if pod is not None and self._pending_eligible(pod):
+            self._pending_idx[uid] = pod
+        else:
+            self._pending_idx.pop(uid, None)
+
+    def _index_add_pod(self, pod: Pod, was_present: bool) -> None:
+        if self._pending_idx is None:
+            return
+        if not was_present or pod.uid not in self._pod_order:
+            # first sighting (or re-add after a remove): dicts append
+            self._pod_order[pod.uid] = self._order_next
+            self._order_next += 1
+        if self._pending_eligible(pod):
+            self._pending_idx[pod.uid] = pod
+        else:
+            self._pending_idx.pop(pod.uid, None)
+
+    def _index_drop_pod(self, uid: str, forget_order: bool = False) -> None:
+        if self._pending_idx is None:
+            return
+        self._pending_idx.pop(uid, None)
+        if forget_order:
+            self._pod_order.pop(uid, None)
+
     def pending_pods(self) -> list[Pod]:
         """Schedulable queue: gated pods stay out (upstream keeps them off
         activeQ entirely — they are neither attempted nor reported failed),
         and only pods addressed to one of `scheduler_names` enter (the
-        upstream per-profile dequeue)."""
+        upstream per-profile dequeue). With the opt-in index enabled the
+        list is assembled O(pending log pending) in the identical order
+        (admission serials mirror the dict iteration the scan performs)."""
+        if self._pending_idx is not None:
+            order = self._pod_order
+            return sorted(
+                self._pending_idx.values(), key=lambda p: order[p.uid]
+            )
         return [
             p
             for p in self.pods.values()
-            if p.node_name is None
-            and p.uid not in self.reserved
-            and p.phase == PodPhase.PENDING
-            and not p.terminating
-            and not p.scheduling_gated
-            and p.scheduler_name in self.scheduler_names
+            if self._pending_eligible(p)
         ]
 
     def gated_pods(self) -> list[Pod]:
@@ -520,6 +602,7 @@ class Cluster:
             # bound pods never count toward the nominated column
             self.delta_sink.forget_nomination(uid)
         self.pods[uid].node_name = node_name
+        self._index_drop_pod(uid)
         self.recent_bindings[uid] = (now_ms, node_name)
         if self.nrt_cache is not None:
             # Reserve -> bind -> PostBind lifecycle for the NRT cache
@@ -534,6 +617,7 @@ class Cluster:
     def reserve(self, uid: str, node_name: str):
         """Permit said Wait: hold the placement without binding."""
         self.reserved[uid] = node_name
+        self._index_drop_pod(uid)
         if self.delta_sink is not None:
             # a reservation holds capacity exactly like a binding
             self.delta_sink.pod_assigned(self.pods[uid], node_name)
@@ -555,6 +639,8 @@ class Cluster:
         if node is not None and self.native is not None:
             # re-upsert as unbound (removes the hold's contribution)
             self._native_upsert_pod(self.pods[uid])
+        if node is not None:
+            self.reindex_pod(uid)
 
     def gang_reservations(self, pg: PodGroup) -> list[str]:
         return [

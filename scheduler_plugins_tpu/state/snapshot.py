@@ -355,6 +355,34 @@ def nonzero_request(req: np.ndarray, index: ResourceIndex) -> np.ndarray:
     return out
 
 
+class _PodRow:
+    """Cached per-pod lowering pieces for `build_pod_state` — everything
+    derivable from the pod SPEC alone (requests/limits encodes, container
+    rows, QoS, TLP prediction), keyed by pod object identity so a feed
+    upsert (which replaces the object wholesale) naturally invalidates.
+    Meta-dependent codes (namespace interning, gang code) and in-place
+    mutable flags (scheduling gate) are never cached."""
+
+    __slots__ = ("pod", "index", "tlp", "req", "limits", "predicted",
+                 "creq", "cinit", "qos")
+
+    def __init__(self, pod, index, tlp_prediction):
+        self.pod = pod
+        self.index = index
+        self.tlp = tlp_prediction
+        self.req = index.encode(pod.effective_request())
+        self.limits = index.encode(pod.effective_limits())
+        self.predicted = pod.tlp_predicted_cpu_millis(*tlp_prediction)
+        conts = list(pod.init_containers) + list(pod.containers)
+        self.creq = np.stack(
+            [index.encode(c.requests) for c in conts]
+        ) if conts else np.zeros((0, len(index)), I64)
+        self.cinit = np.array(
+            [c < len(pod.init_containers) for c in range(len(conts))], bool
+        )
+        self.qos = int(pod.qos_class())
+
+
 def build_pod_state(
     pending_pods: Sequence[Pod],
     P: int,
@@ -362,13 +390,18 @@ def build_pod_state(
     ns_in: "_Interner",
     gang_of,
     tlp_prediction: tuple = (1.5, 1000),
+    row_cache: dict | None = None,
 ) -> PodState:
     """Lower the pending batch into `PodState` (host numpy) — THE one copy
     of the pod-tensor lowering, shared by `build_snapshot` and the serving
     engine's per-cycle assembly (`serving.engine.ServeEngine._assemble`),
     so the two paths produce bit-identical pod tensors by construction.
     `ns_in` interns namespace codes into the caller's meta table;
-    `gang_of(pod) -> int` maps a pod to its gang code (-1 outside)."""
+    `gang_of(pod) -> int` maps a pod to its gang code (-1 outside).
+    `row_cache` (uid -> `_PodRow`, the streaming serve engine's O(changed)
+    assembly) memoizes the spec-derived pieces across cycles for pods
+    that retry — entries re-derive whenever the pod object, resource axis
+    or TLP parameters differ, so a hit is bit-identical by construction."""
     R = len(index)
     preq = np.zeros((P, R), I64)
     plimits = np.zeros((P, R), I64)
@@ -391,17 +424,37 @@ def build_pod_state(
     pcreated = np.zeros(P, I64)
     pgated = np.zeros(P, bool)
     for i, pod in enumerate(pending_pods):
-        preq[i] = index.encode(pod.effective_request())
-        plimits[i] = index.encode(pod.effective_limits())
-        ppredicted[i] = pod.tlp_predicted_cpu_millis(*tlp_prediction)
-        for c, cont in enumerate(list(pod.init_containers) + list(pod.containers)):
-            pcreq[i, c] = index.encode(cont.requests)
-            pcinit[i, c] = c < len(pod.init_containers)
-            pcmask[i, c] = True
+        row = None
+        if row_cache is not None:
+            row = row_cache.get(pod.uid)
+            if (
+                row is None or row.pod is not pod or row.index is not index
+                or row.tlp != tlp_prediction
+            ):
+                row = row_cache[pod.uid] = _PodRow(pod, index, tlp_prediction)
+        if row is not None:
+            preq[i] = row.req
+            plimits[i] = row.limits
+            ppredicted[i] = row.predicted
+            nC = row.creq.shape[0]
+            pcreq[i, :nC] = row.creq
+            pcinit[i, :nC] = row.cinit
+            pcmask[i, :nC] = True
+            pqos[i] = row.qos
+        else:
+            preq[i] = index.encode(pod.effective_request())
+            plimits[i] = index.encode(pod.effective_limits())
+            ppredicted[i] = pod.tlp_predicted_cpu_millis(*tlp_prediction)
+            for c, cont in enumerate(
+                list(pod.init_containers) + list(pod.containers)
+            ):
+                pcreq[i, c] = index.encode(cont.requests)
+                pcinit[i, c] = c < len(pod.init_containers)
+                pcmask[i, c] = True
+            pqos[i] = int(pod.qos_class())
         ppriority[i] = pod.priority
         pns[i] = ns_in.code(pod.namespace)
         pgang[i] = gang_of(pod)
-        pqos[i] = int(pod.qos_class())
         pmask[i] = True
         pcreated[i] = pod.creation_ms
         pgated[i] = pod.scheduling_gated

@@ -300,6 +300,19 @@ ANTIENTROPY_DIVERGENCE = "scheduler_serve_antientropy_divergence_total"
 #: unschedulable pods currently parked in a requeue backoff window
 #: (upstream backoffQ semantics; framework.cycle._requeue_eligible)
 REQUEUE_BACKOFF_SKIPS = "scheduler_requeue_backoff_skips_total"
+#: fraction of the in-flight device-solve envelope the pipelined cycle
+#: engine covered with useful host work (framework.pipeline_cycle;
+#: 1.0 = the fence never waited on the device)
+CYCLE_OVERLAP_EFFICIENCY = "scheduler_cycle_overlap_efficiency"
+#: wall-clock ms the pipelined engine's fence idled waiting on the
+#: in-flight device solve after the overlap work ran dry — the
+#: per-cycle pipeline bubble the overlap exists to eliminate
+CYCLE_PIPELINE_BUBBLE = "scheduler_cycle_pipeline_bubble_ms"
+#: binds flushed by the pipelined engine's async flusher that landed
+#: AFTER a later cycle's ingest boundary — each one reached the resident
+#: serving state as an ordinary DeltaSink delta (the conflict-fence
+#: taxonomy, docs/SERVING.md)
+CYCLE_LATE_BINDS = "scheduler_cycle_late_binds_total"
 
 
 # ---------------------------------------------------------------------------
@@ -555,13 +568,17 @@ tracer = Tracer()
 
 
 @contextmanager
-def extension_span(extension_point: str, plugin: str, **args):
+def extension_span(extension_point: str, plugin: str, tid: str = "framework",
+                   **args):
     """One extension-point execution: a tracer span on the "framework" row
     plus a `scheduler_plugin_execution_ms{plugin,extension_point}` histogram
     observation — the upstream per-plugin, per-extension-point latency
-    metric (frameworkruntime plugin_execution_duration_seconds)."""
+    metric (frameworkruntime plugin_execution_duration_seconds). `tid`
+    overrides the row for stages the pipelined cycle engine runs off the
+    main thread (per-tid spans must stay disjoint-or-nested for the
+    Perfetto validity gate)."""
     with tracer.span(
-        f"{extension_point}/{plugin}", tid="framework", **args
+        f"{extension_point}/{plugin}", tid=tid, **args
     ):
         start = time.perf_counter_ns()
         try:

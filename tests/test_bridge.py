@@ -146,6 +146,103 @@ class TestNativeStore:
         )
 
 
+class TestStreamingDeltaExport:
+    """The O(changed) bridge seam: `store_export_dirty` must return
+    exactly the rows touched since the last drain (first-touch order),
+    with drained contents equal to the full export's rows, and a fresh
+    store's first drain must be a full resync."""
+
+    def test_first_drain_is_full_resync(self):
+        s = make_store()
+        for i in range(5):
+            s.upsert_node(i, np.array([1000 * (i + 1), gib, 0, 110]))
+        assert s.dirty_count == 5
+        d = s.export_dirty()
+        assert list(d["ids"]) == [0, 1, 2, 3, 4]
+        assert d["generation"] == 1
+        assert s.dirty_count == 0
+
+    def test_drain_returns_only_touched_rows(self):
+        s = make_store()
+        for i in range(6):
+            s.upsert_node(i, np.array([8000, 32 * gib, 0, 110]))
+        s.export_dirty()
+        s.upsert_pod(100, np.array([500, gib, 0, 0]), node_id=2)
+        s.upsert_pod(101, np.array([700, gib, 0, 0]), node_id=4)
+        s.upsert_pod(102, np.array([50, gib, 0, 0]))  # pending: no row
+        d = s.export_dirty()
+        assert list(d["ids"]) == [2, 4]
+        # drained rows equal the full export's same rows, column by column
+        full = s.export_nodes()
+        for key in ("alloc", "capacity", "requested", "nonzero_requested",
+                    "limits"):
+            np.testing.assert_array_equal(d[key][0], full[key][2], key)
+            np.testing.assert_array_equal(d[key][1], full[key][4], key)
+        assert d["pod_count"][0] == 1 and d["pod_count"][1] == 1
+        # binding the pending pod dirties exactly its node
+        s.bind(102, 0)
+        d2 = s.export_dirty()
+        assert list(d2["ids"]) == [0]
+        assert d2["requested"][0, 0] == 50
+        assert d2["generation"] == 3
+
+    def test_duplicate_touches_coalesce(self):
+        s = make_store()
+        s.upsert_node(7, np.array([8000, 32 * gib, 0, 110]))
+        s.export_dirty()
+        for pod_id in range(3):
+            s.upsert_pod(pod_id, np.array([100, 0, 0, 0]), node_id=7)
+        assert s.dirty_count == 1  # one row, many touches
+        d = s.export_dirty()
+        assert list(d["ids"]) == [7] and d["pod_count"][0] == 3
+
+    def test_delete_pod_marks_its_row(self):
+        s = make_store()
+        s.upsert_node(1, np.array([8000, 32 * gib, 0, 110]))
+        s.upsert_pod(9, np.array([100, 0, 0, 0]), node_id=1)
+        s.export_dirty()
+        s.delete_pod(9)
+        d = s.export_dirty()
+        assert list(d["ids"]) == [1]
+        assert d["pod_count"][0] == 0 and d["requested"][0, 0] == 0
+
+    def test_feed_drain_deltas_op(self):
+        """The wire seam: {"op": "drain_deltas"} exports the dirty
+        window as JSON through the shared event protocol (TCP feed and
+        gRPC front ends both route through `apply_event`)."""
+        from scheduler_plugins_tpu.bridge.feed import apply_event
+        from scheduler_plugins_tpu.state.cluster import Cluster
+
+        cluster = Cluster()
+        # without a native mirror the op reports, never crashes
+        ack = apply_event(cluster, {"op": "drain_deltas"})
+        assert ack["ok"] is False and "native" in ack["error"]
+
+        cluster.attach_native_store()
+        cluster.add_node(Node(
+            name="n0", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110}
+        ))
+        cluster.add_node(Node(
+            name="n1", allocatable={CPU: 8000, MEMORY: 32 * gib, PODS: 110}
+        ))
+        ack = apply_event(cluster, {"op": "drain_deltas"})
+        assert ack["ok"] and ack["count"] == 2
+        pod = Pod(name="p0", creation_ms=1,
+                  containers=[Container(requests={CPU: 500, MEMORY: gib})])
+        pod.node_name = "n1"
+        cluster.add_pod(pod)
+        ack2 = apply_event(cluster, {"op": "drain_deltas"})
+        assert ack2["ok"] and ack2["count"] == 1
+        assert ack2["generation"] == ack["generation"] + 1
+        row = ack2["nodes"][0]
+        assert row["pod_count"] == 1
+        assert row["requested"][CANONICAL.index(CPU)] == 500
+        assert row["requested"][CANONICAL.index(PODS)] == 1
+        # quiet window drains empty
+        ack3 = apply_event(cluster, {"op": "drain_deltas"})
+        assert ack3["ok"] and ack3["count"] == 0
+
+
 class TestNativeSnapshotSource:
     """VERDICT round-1 #3: the C++ store is the snapshot source for the hot
     node columns. The native-backed snapshot must be bit-identical to the

@@ -516,6 +516,213 @@ class TestServeDeltaEquivalence:
             )
 
 
+class TestPipelinedCycleEquivalence:
+    """The concurrent-pipeline differential (docs/SCALING.md): N
+    pipelined cycles vs the serial `run_cycle` on ONE shared seeded
+    event stream must produce identical per-cycle placements
+    (bound/reserved/failed/attribution, with conflict-fenced binds
+    replayed as ordinary deltas) AND an identical final cluster state —
+    on a plain serve-mode roster and on a gang+quota roster (where the
+    serve engines run in permanent full-snapshot fallback). Shapes reuse
+    tests/test_serving's compile buckets."""
+
+    def _run_plain(self, pipelined):
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.framework.pipeline_cycle import (
+            PipelinedCycle,
+        )
+        from scheduler_plugins_tpu.serving import (
+            ServeEngine,
+            StreamingServeEngine,
+        )
+        from tests.test_serving import (
+            make_cluster,
+            make_node,
+            make_pod,
+            make_scheduler,
+        )
+
+        rng = np.random.default_rng(23)
+        cluster = make_cluster(6)
+        engine = (
+            StreamingServeEngine() if pipelined else ServeEngine()
+        ).attach(cluster)
+        sched = make_scheduler()
+        pipe = (
+            PipelinedCycle(sched, cluster, serve=engine)
+            if pipelined else None
+        )
+        serial = 0
+        reports = []
+        for cycle in range(10):
+            now = 1000 * (cycle + 1)
+            for _ in range(int(rng.integers(1, 4))):
+                serial += 1
+                cluster.add_pod(make_pod(
+                    serial, now, int(rng.integers(200, 2500)), gib
+                ))
+            if cycle == 3:
+                cluster.add_node(make_node(40))
+            if cycle == 4:
+                # a pod that fits nowhere: failure + attribution rows
+                # must match cycle for cycle (the pipelined engine
+                # defers the failed_by decode — digested post-flush)
+                cluster.add_pod(Pod(
+                    name="nofit", creation_ms=now + 999,
+                    containers=[Container(requests={CPU: 10**9})],
+                ))
+            if cycle == 5:
+                bound = sorted(
+                    u for u, p in cluster.pods.items()
+                    if p.node_name is not None
+                )
+                cluster.remove_pod(bound[0])
+            if cycle == 7:
+                # drain-then-delete: the serial engine re-bases, the
+                # streaming engine row-compacts — placements must agree
+                victim = next(iter(cluster.nodes))
+                for uid in [
+                    u for u, p in cluster.pods.items()
+                    if p.node_name == victim
+                ]:
+                    cluster.remove_pod(uid)
+                cluster.remove_node(victim)
+            if pipelined:
+                report = pipe.tick(now)
+                pipe.fence()
+            else:
+                report = run_cycle(sched, cluster, now=now, serve=engine)
+            reports.append(report)
+        if pipelined:
+            # finalize the last cycle BEFORE digesting: the pipelined
+            # engine defers attribution/quality into the next tick's
+            # overlap window, so failed_by is complete only post-flush
+            pipe.flush()
+            pipe.close()
+        per_cycle = [
+            (
+                dict(r.bound), dict(r.reserved),
+                list(r.failed), dict(r.failed_by),
+            )
+            for r in reports
+        ]
+        final = {u: p.node_name for u, p in sorted(cluster.pods.items())}
+        return per_cycle, final
+
+    def test_plain_roster_cycles_identical(self):
+        serial_cycles, serial_final = self._run_plain(pipelined=False)
+        pipe_cycles, pipe_final = self._run_plain(pipelined=True)
+        assert pipe_cycles == serial_cycles
+        assert pipe_final == serial_final
+
+    def _run_gang_quota(self, pipelined):
+        from scheduler_plugins_tpu.api.objects import (
+            ElasticQuota,
+            PodGroup,
+            POD_GROUP_LABEL,
+        )
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.framework.pipeline_cycle import (
+            PipelinedCycle,
+        )
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+        )
+        from scheduler_plugins_tpu.serving import (
+            ServeEngine,
+            StreamingServeEngine,
+        )
+
+        rng = np.random.default_rng(5)
+        cluster = Cluster()
+        for i in range(8):
+            cluster.add_node(Node(
+                name=f"n{i}",
+                allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 30},
+            ))
+        cluster.add_quota(ElasticQuota(
+            name="eq", namespace="team",
+            min={CPU: 64_000, MEMORY: 256 * gib},
+            max={CPU: 96_000, MEMORY: 384 * gib},
+        ))
+        engine = (
+            StreamingServeEngine() if pipelined else ServeEngine()
+        ).attach(cluster)
+        sched = Scheduler(Profile(plugins=[
+            NodeResourcesAllocatable(),
+            Coscheduling(permit_waiting_seconds=5),
+            CapacityScheduling(),
+        ]))
+        pipe = (
+            PipelinedCycle(sched, cluster, serve=engine)
+            if pipelined else None
+        )
+        serial = 0
+        reports = []
+        for cycle in range(12):
+            now = 1000 * (cycle + 1)
+            for _ in range(int(rng.integers(0, 5))):
+                serial += 1
+                cluster.add_pod(Pod(
+                    name=f"p{serial:04d}", namespace="team",
+                    creation_ms=now + serial,
+                    priority=int(rng.integers(0, 5)),
+                    containers=[Container(requests={
+                        CPU: int(rng.integers(200, 4000)),
+                        MEMORY: int(rng.integers(1, 8)) * gib,
+                    })],
+                ))
+            if cycle % 5 == 1:
+                gname = f"g{cycle}"
+                cluster.add_pod_group(PodGroup(
+                    name=gname, namespace="team", min_member=3,
+                    creation_ms=now,
+                ))
+                for m in range(3):
+                    serial += 1
+                    cluster.add_pod(Pod(
+                        name=f"{gname}-m{m}", namespace="team",
+                        creation_ms=now + m,
+                        labels={POD_GROUP_LABEL: gname},
+                        containers=[Container(
+                            requests={CPU: 2000, MEMORY: 4 * gib}
+                        )],
+                    ))
+            bound = [
+                p for p in cluster.pods.values()
+                if p.node_name is not None and not p.pod_group()
+            ]
+            for pod in bound:
+                if rng.random() < 0.15:
+                    cluster.remove_pod(pod.uid)
+            if pipelined:
+                report = pipe.tick(now)
+                pipe.fence()
+            else:
+                report = run_cycle(sched, cluster, now=now, serve=engine)
+            reports.append(report)
+        if pipelined:
+            pipe.flush()
+            pipe.close()
+        per_cycle = [
+            (
+                dict(r.bound), dict(r.reserved),
+                list(r.failed), dict(r.failed_by),
+                list(r.rejected_gangs), dict(r.preempted),
+            )
+            for r in reports
+        ]
+        final = {u: p.node_name for u, p in sorted(cluster.pods.items())}
+        return per_cycle, final
+
+    def test_gang_quota_roster_cycles_identical(self):
+        serial_cycles, serial_final = self._run_gang_quota(pipelined=False)
+        pipe_cycles, pipe_final = self._run_gang_quota(pipelined=True)
+        assert pipe_cycles == serial_cycles
+        assert pipe_final == serial_final
+
+
 class TestShardedWaveHardConstraintParity:
     """ISSUE 7 satellite: the shard_map ring-election wave solver vs the
     sequential parity path — hard constraints (resource fit, queue-order

@@ -118,6 +118,11 @@ ROLE_OVERRIDES = {
         "d.idx", "d.requested", "d.nonzero", "d.limits", "d.pod_count",
         "d.terminating",
     ),
+    # compact_node_rows(nodes, gather_idx, valid): the NodeState arg is
+    # the donated RESIDENT carry being row-compacted in place (the
+    # serving engine's cycle-to-cycle thread), same labeling rationale
+    # as serving_delta_apply
+    "serving_node_compact": ("state.nodes", "gather_idx", "valid"),
     # sharded_wave_chunk(node_ids, req_chunk, mask_chunk, rank_free): the
     # rank-ordered free block is the donated RESIDENT carry threading
     # chunk to chunk on device (the sharded analog of cfg6's state.free)

@@ -374,6 +374,18 @@ def build_serving_delta_apply():
     return fn, args, None
 
 
+def build_serving_node_compact():
+    """`serving.deltas.node_compact_program` — the donated row-shift
+    gather the streaming serve engine replaces node-delete rebases with
+    (`StreamingServeEngine._compact_row`), at the reduced resident shape
+    `serving.engine.compact_lower_args` builds. Same donated-carry
+    calling convention as serving_delta_apply."""
+    from scheduler_plugins_tpu.serving.engine import compact_lower_args
+
+    fn, args = compact_lower_args()
+    return fn, args, None
+
+
 def build_sharded_wave_chunk():
     """The sharded wave chunk program (`parallel.solver.
     sharded_wave_chunk_solver` — the shard_map ring-election waterfill the
@@ -509,6 +521,7 @@ def build_sweep_solve():
 PROGRAMS = {
     "entry": build_entry,
     "serving_delta_apply": build_serving_delta_apply,
+    "serving_node_compact": build_serving_node_compact,
     "sharded_wave_chunk": build_sharded_wave_chunk,
     "sweep_solve": build_sweep_solve,
     "rank_gang_solve": build_rank_gang_solve,

@@ -106,9 +106,18 @@ def validate_trace(trace) -> list[str]:
     return errors
 
 
-def required_rows(trace) -> list[str]:
+#: rows the concurrent cycle pipeline emits per tick
+#: (framework.pipeline_cycle: ingest/dispatch+fence/overlap-finalize on
+#: the main thread, bind/post-bind on the flusher row)
+PIPELINED_CYCLE_ROWS = (
+    "Cycle/ingest", "Cycle/solve", "Cycle/finalize", "Cycle/bind",
+)
+
+
+def required_rows(trace, extra=()) -> list[str]:
     """Rows the tentpole promises: pipeline H2D/solve/D2H per buffer and a
-    framework extension-point row. Returns the MISSING row names."""
+    framework extension-point row, plus any caller-required `extra` rows
+    (the gate adds `PIPELINED_CYCLE_ROWS`). Returns the MISSING rows."""
     names = {
         e["args"]["name"]
         for e in trace.get("traceEvents", ())
@@ -121,6 +130,7 @@ def required_rows(trace) -> list[str]:
             "pipeline/solve/buf0", "pipeline/solve/buf1",
             "pipeline/d2h/buf0", "pipeline/d2h/buf1",
             "framework",
+            *extra,
         )
         if row not in names
     ]
@@ -218,6 +228,37 @@ def main(out_path=None, bound_pct=None):
         Scheduler(Profile(plugins=[NodeResourcesAllocatable()])), cluster,
         now=0,
     )
+    # two pipelined ticks on a fresh serve-mode cluster add the
+    # concurrent-cycle rows (Cycle/{ingest,solve,finalize,bind}) to the
+    # exported trace — the overlap stages the tentpole promises are
+    # observable, and their spans must stay Perfetto-valid alongside the
+    # serial spans (the bind row is emitted from the flusher thread)
+    from scheduler_plugins_tpu.framework import PipelinedCycle
+    from scheduler_plugins_tpu.serving import StreamingServeEngine
+
+    pcluster = Cluster()
+    for i in range(8):
+        pcluster.add_node(Node(
+            name=f"pn{i}",
+            allocatable={CPU: 16000, MEMORY: 64 * gib, PODS: 110},
+        ))
+    for p in range(8):
+        pcluster.add_pod(Pod(
+            name=f"pp{p}", creation_ms=p,
+            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+        ))
+    engine = StreamingServeEngine().attach(pcluster)
+    pipe = PipelinedCycle(
+        Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+        pcluster, serve=engine,
+    )
+    pipe.tick(now=1000)
+    pcluster.add_pod(Pod(
+        name="pp9", creation_ms=20,
+        containers=[Container(requests={CPU: 500, MEMORY: gib})],
+    ))
+    pipe.tick(now=2000)
+    pipe.close()
     obs.tracer.stop()
     obs.tracer.write(out_path)
     with open(out_path) as f:
@@ -236,7 +277,7 @@ def main(out_path=None, bound_pct=None):
     bound = max(bound_pct, spread_pct)
 
     errors = validate_trace(final_trace)
-    missing = required_rows(final_trace)
+    missing = required_rows(final_trace, extra=PIPELINED_CYCLE_ROWS)
     attribution_ok = (
         bool(report.failed_by)
         and set(report.failed_by.values()) == {"NodeResourcesFit"}
