@@ -659,6 +659,7 @@ CONFIG_METRICS = {
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
     10: "rank_gang_pods_per_sec", 11: "cluster_life_pods_per_sec",
+    12: "mega_gang_ranks_per_sec",
 }
 
 
@@ -2160,11 +2161,25 @@ class _LifeArm:
             if self.pipe is not None:
                 self.pipe.flush()
             _drain_life_gangs(self.cluster, self.gang_roster)
+            self.engine.verify_every = self._old_verify
         if self._prev_phase == "chaos":
             if self.pipe is not None:
                 self.pipe.flush()
                 self.pipe.resilience = None
             self.engine.verify_every = self._old_verify
+        if phase == "gangs":
+            # the periodic anti-entropy cadence is pinned OUT of the
+            # short gang window (and back on afterwards): the two
+            # engines' refresh counters drift across earlier phases (the
+            # serial engine's node-delete rebases skip the counter where
+            # the streaming engine compacts), so the periodic O(assigned)
+            # verify lands on DIFFERENT arms' gang cycles run to run —
+            # one ~100 ms maintenance spike inside a 12-cycle window
+            # decides the phase ratio by lottery. Forced verifies
+            # (note_fault) stay armed, and the anti-entropy cost is
+            # measured where it is pinned SYMMETRICALLY: the chaos phase
+            # runs both arms at verify_every=1.
+            self.engine.verify_every = 0
         if phase == "chaos":
             if self.pipe is not None:
                 self.pipe.resilience = self.rz
@@ -2317,20 +2332,23 @@ def cluster_life(shape=None, emit=True):
     import gc
 
     _cluster_life_arm(scheduler, shape, pipelined=True, seed=seed)
+    # the timed arms run INTERLEAVED (pipelined cycle k, serial cycle
+    # k): on a shared host, episodic slowdowns then land on both arms
+    # of every compared window instead of poisoning whichever arm
+    # happened to be running
+    pipe = _LifeArm(scheduler, shape, pipelined=True, seed=seed)
+    ser = _LifeArm(scheduler, shape, pipelined=False, seed=seed)
     # bench hygiene, applied identically to both timed arms: move the
-    # prewarm's surviving objects (plus the arms' prefill populations)
-    # out of the collector's scan set — a gen-2 GC pause over a few
-    # million tracked objects lands as a multi-hundred-ms spike on
-    # whichever cycle it hits
+    # prewarm's surviving objects AND both timed arms' prefill
+    # populations out of the collector's scan set — the freeze must
+    # happen AFTER the arms exist, or the ~25k-pod populations stay in
+    # the unfrozen set and the first gen-2 collection lands as a
+    # 100-200 ms pause on whichever timed cycle triggers it (measured:
+    # it deterministically hit the 12-cycle gang phase and decided that
+    # phase's ratio by itself)
     gc.collect()
     gc.freeze()
     try:
-        # the timed arms run INTERLEAVED (pipelined cycle k, serial
-        # cycle k): on a shared host, episodic slowdowns then land on
-        # both arms of every compared window instead of poisoning
-        # whichever arm happened to be running
-        pipe = _LifeArm(scheduler, shape, pipelined=True, seed=seed)
-        ser = _LifeArm(scheduler, shape, pipelined=False, seed=seed)
         while not pipe.done:
             pipe.step()
             ser.step()
@@ -2447,8 +2465,11 @@ def endurance_smoke(min_ratio=1.5):
     serial arm's individual rebases at reduced scale; the full-shape
     config-7 churn ratio is the headline claim, not the CI statistic),
     produce IDENTICAL per-cycle placements and a bit-identical final
-    cluster state, and leave a clean replayed capacity audit. One JSON
-    line; rc 1 on any failure."""
+    cluster state, and leave a clean replayed capacity audit. ISSUE 12
+    adds the gang-phase gate: zero serve fallbacks across the gang phase
+    (the resident gang side tables own the roster) and gang-phase
+    cycles/s >= `min_ratio` x the serial arm. One JSON line; rc 1 on
+    any failure."""
     line = cluster_life(shape=ENDURANCE_SMOKE_SHAPE, emit=False)
     ok = (
         line["serve_phases_vs_serial"] >= min_ratio
@@ -2456,6 +2477,13 @@ def endurance_smoke(min_ratio=1.5):
         and line["per_cycle_reports_match"]
         and line["final_state_identical"]
         and line["capacity_violations"] == 0
+        # ISSUE 12 gang-phase gate: the resident gang side tables must
+        # keep the serve engines OFF the O(cluster) fallback for the
+        # whole gang phase (zero fallbacks — the roster is compatible)
+        # and the pipelined engine must beat the serial engine on
+        # gang-phase cycles/s now that both serve resident
+        and line["gang_fallbacks"] == 0
+        and line["phases"]["gangs"]["vs_serial"] >= min_ratio
     )
     print(json.dumps({
         "metric": "endurance_smoke",
@@ -2465,6 +2493,227 @@ def endurance_smoke(min_ratio=1.5):
         **line,
     }))
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# config 12: mega gangs — wave-batched gang solve at 10k nodes x 1k gangs
+# ---------------------------------------------------------------------------
+
+#: the mega gang scale (ROADMAP item 3 / ISSUE 12): 10k nodes x 1k gangs
+#: is the regime Tesserae (arxiv 2508.04953) says DL placement must scale
+#: to. Tensor-level construction like config 8 (8k Pod objects would
+#: dominate the run). The workload is the STEADY-STATE RECONCILE a
+#: serving scheduler actually loops on: `resident_frac` of the gangs are
+#: elastic jobs anchored on their resident topology block with 1-2
+#: pending grow/repair ranks, the rest fresh admissions — the regime
+#: where independent gangs spread across blocks and the wave validator
+#: accepts long runs (a cold-cluster admission storm serializes through
+#: the host-resolve path instead; docs/GANGS.md documents both).
+MEGA_GANG_SHAPE = dict(
+    n_nodes=10_240, n_gangs=1_024, max_ranks=8, blocks=256, regions=8,
+    quota_ns=32, resident_frac=0.8, wave=64, seed=0,
+)
+
+
+def mega_gang_problem(shape):
+    """Tensor-level `RankGangState` + initial state for the mega gang
+    configs: heterogeneous node SKUs over `blocks` zone-blocks grouped
+    into regions (same-region spill 10, cross-region 40, same-block 1),
+    heterogeneous rank demand (launcher 2x), half the namespaces quota-
+    capped, elastic residents anchored per `resident_frac`."""
+    from scheduler_plugins_tpu.gangs.topology import RankGangState
+
+    rng = np.random.default_rng(shape["seed"])
+    N, G, M, B = (shape["n_nodes"], shape["n_gangs"], shape["max_ranks"],
+                  shape["blocks"])
+    Q, regions = shape["quota_ns"], shape["regions"]
+    R = 3  # cpu, memory, pods-style axis (the gang solve is axis-agnostic)
+    node_block = (np.arange(N) * B // N).astype(np.int32)
+    free0 = np.zeros((N, R), np.int64)
+    sku = rng.integers(0, 4, N)
+    # synthetic 3-slot axis local to this problem (NOT the CANONICAL
+    # layout — the gang solve is axis-order agnostic, like the gang
+    # differential's oracle axis)
+    free0[:, 0] = np.array([32_000, 48_000, 64_000, 96_000])[sku]  # graft-lint: ignore[GL005]
+    free0[:, 1] = np.array([128, 192, 256, 384])[sku]  # graft-lint: ignore[GL005]
+    free0[:, 2] = 48  # graft-lint: ignore[GL005]
+    zone_region = (np.arange(B) * regions // B)
+    block_cost = np.where(
+        zone_region[:, None] == zone_region[None, :], 10, 40
+    ).astype(np.int32)
+    np.fill_diagonal(block_cost, 1)
+    rank_req = np.zeros((G, M, R), np.int64)
+    rank_mask = np.zeros((G, M), bool)
+    prev = np.full((G, M), -1, np.int32)
+    min_ranks = np.zeros(G, np.int32)
+    nodes_of_block = [np.where(node_block == b)[0] for b in range(B)]
+    for g in range(G):
+        k = int(rng.integers(max(4, M // 2), M + 1))
+        rank_mask[g, :k] = True
+        cpu = int(rng.integers(1_000, 4_000))
+        rank_req[g, :k, 0] = cpu
+        rank_req[g, 0, 0] = 2 * cpu  # MPI launcher wants double
+        rank_req[g, :k, 1] = int(rng.integers(4, 16))
+        rank_req[g, :k, 2] = 1
+        if rng.random() < shape["resident_frac"]:
+            # resident elastic gang: anchored ranks on one block, 1-2
+            # pending grow/repair ranks
+            b = int(rng.integers(0, B))
+            pend = int(rng.integers(1, 3))
+            block_nodes = nodes_of_block[b]
+            prev[g, : k - pend] = block_nodes[
+                rng.integers(0, len(block_nodes), k - pend)
+            ]
+            min_ranks[g] = max(2, k - pend)
+        else:
+            min_ranks[g] = k if rng.random() < 0.7 else max(2, k - 2)
+    quota_max = np.full((Q, R), np.iinfo(np.int64).max, np.int64)
+    quota_has = np.zeros(Q, bool)
+    quota_has[: Q // 2] = True
+    quota_max[: Q // 2, 0] = rng.integers(400_000, 4_000_000, Q // 2)
+    quota_max[: Q // 2, 1] = rng.integers(4_000, 40_000, Q // 2)
+    quota_max[: Q // 2, 2] = rng.integers(400, 4_000, Q // 2)
+    gangs = RankGangState(
+        rank_req=rank_req, rank_mask=rank_mask, prev_assigned=prev,
+        min_ranks=min_ranks,
+        gang_ns=rng.integers(-1, Q, G).astype(np.int32),
+        gang_mask=np.ones(G, bool),
+        node_block=node_block, block_cost=block_cost,
+        quota_max=quota_max, quota_has=quota_has,
+    )
+    return {
+        "gangs": gangs, "free0": free0,
+        "eq_used0": np.zeros((Q, R), np.int64),
+        "node_mask": np.ones(N, bool),
+    }
+
+
+def _mega_gang_violations(problem, rank_nodes, admitted, placed_new):
+    """Independent replay of the gang hard constraints over the emitted
+    placements (the TestRankGangDifferential oracle, vectorized): fit
+    (new demand per node within free0, schedulable nodes only), quota
+    caps, quorum/zero-partial."""
+    gangs = problem["gangs"]
+    free0 = problem["free0"]
+    node_mask = problem["node_mask"]
+    G, M, R = gangs.rank_req.shape
+    new = (rank_nodes >= 0) & (gangs.prev_assigned < 0) & gangs.rank_mask
+    fit = quota = quorum = 0
+    used = np.zeros_like(free0)
+    g_idx, m_idx = np.nonzero(new)
+    nodes = rank_nodes[g_idx, m_idx]
+    if not node_mask[nodes].all():
+        fit += int((~node_mask[nodes]).sum())
+    np.add.at(used, nodes, gangs.rank_req[g_idx, m_idx])
+    fit += int((used > free0).any(axis=1).sum())
+    for q in range(gangs.quota_max.shape[0]):
+        if not gangs.quota_has[q]:
+            continue
+        sel = gangs.gang_ns[g_idx] == q
+        dem = gangs.rank_req[g_idx[sel], m_idx[sel]].sum(axis=0)
+        if ((problem["eq_used0"][q] + dem) > gangs.quota_max[q]).any():
+            quota += 1
+    resident = ((gangs.prev_assigned >= 0) & gangs.rank_mask).sum(axis=1)
+    n_new = new.sum(axis=1)
+    quorum += int((
+        admitted & (resident + n_new < gangs.min_ranks)
+    ).sum())
+    quorum += int((~admitted & (n_new > 0)).sum())
+    quorum += int((admitted & (n_new != placed_new)).sum())
+    return {"fit": int(fit), "quota": quota, "quorum": quorum}
+
+
+def mega_gangs(shape=None, emit=True):
+    """Config 12: the mega gang bench (ISSUE 12; docs/GANGS.md). One
+    problem, three solvers: the sequential jit gang scan (PR 10's
+    `gang_solve_body` — the parity anchor), the wave-batched solve
+    (`gangs.waves.wave_gang_solve`), and the numpy sequential twin
+    (`gang_solve_np` — the bit-identity oracle). Headline: newly placed
+    ranks/s of the wave path; the gate is placements BIT-IDENTICAL to
+    the twin across all three, drift 0.0, zero fit/quota/quorum
+    violations in the independent replay."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.framework.plugin import SolverState
+    from scheduler_plugins_tpu.gangs.topology import (
+        gang_solve_fn,
+        gang_solve_np,
+    )
+    from scheduler_plugins_tpu.gangs.waves import wave_gang_solve
+
+    shape = shape or MEGA_GANG_SHAPE
+    problem = mega_gang_problem(shape)
+    gangs = problem["gangs"]
+
+    fn = gang_solve_fn()
+    gangs_dev = jax.tree.map(jnp.asarray, gangs)
+    state0 = SolverState(
+        free=jnp.asarray(problem["free0"]),
+        eq_used=jnp.asarray(problem["eq_used0"]),
+        rank_nodes=jnp.asarray(gangs.prev_assigned),
+    )
+    mask_dev = jnp.asarray(problem["node_mask"])
+    with _bench_span("mega-gang sequential scan"):
+        out = fn(gangs_dev, state0, mask_dev)
+        np.asarray(out[0])  # warm (compile)
+        t0 = time.perf_counter()
+        out = fn(gangs_dev, state0, mask_dev)
+        rn_seq = np.asarray(out[0])
+        adm_seq = np.asarray(out[1])
+        t_seq = time.perf_counter() - t0
+
+    wave_args = (gangs, problem["free0"], problem["eq_used0"],
+                 problem["node_mask"])
+    with _bench_span("mega-gang wave solve"):
+        wave_gang_solve(*wave_args, wave=shape["wave"])  # warm
+        stats: dict = {}
+        t0 = time.perf_counter()
+        rn_w, adm_w, pn_w, free_w, eq_w = wave_gang_solve(
+            *wave_args, wave=shape["wave"], stats=stats
+        )
+        t_wave = time.perf_counter() - t0
+
+    with _bench_span("mega-gang numpy twin"):
+        rn_np, adm_np, pn_np, free_np, eq_np = gang_solve_np(*wave_args)
+
+    twin_match = (
+        (rn_w == rn_np).all() and (adm_w == adm_np).all()
+        and (pn_w == pn_np).all() and (free_w == free_np).all()
+        and (eq_w == eq_np).all()
+    )
+    seq_match = (rn_seq == rn_np).all() and (adm_seq == adm_np).all()
+    violations = _mega_gang_violations(problem, rn_w, adm_w, pn_w)
+    placed = int(pn_w.sum())
+    line = {
+        "gangs": int(gangs.gang_mask.sum()),
+        "gangs_admitted": int(adm_w.sum()),
+        "ranks_placed": placed,
+        "wave_seconds": round(t_wave, 3),
+        "sequential_scan_seconds": round(t_seq, 3),
+        "wave_vs_sequential_scan": round(t_seq / t_wave, 2) if t_wave
+        else 0.0,
+        "waves": stats.get("waves"),
+        "wave_width": shape["wave"],
+        "host_resolves": stats.get("host_solves"),
+        "placements_match_twin": bool(twin_match),
+        "sequential_matches_twin": bool(seq_match),
+        "violations": violations,
+        "resident_frac": shape["resident_frac"],
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[12],
+            placed / t_wave if t_wave else 0.0,
+            f"{shape['n_nodes']} nodes x {line['gangs']} gangs "
+            f"({shape['blocks']} blocks), wave-batched vs sequential "
+            "gang scan",
+            baseline=placed / t_seq if t_seq else 1.0,
+            drift=(0.0 if twin_match and seq_match else None),
+            quality=None,
+            extra=line,
+        )
+    return line
 
 
 #: replay cutoff: a capture older than this is too stale to stand in for
@@ -2835,7 +3084,10 @@ if __name__ == "__main__":
                              "full seeded fault plan, serve+resilience "
                              "vs the no-chaos control; 10 = rank-aware "
                              "gangs: topology-cost gang solves + elastic "
-                             "DL jobs vs quorum-only Coscheduling); "
+                             "DL jobs vs quorum-only Coscheduling; 12 = "
+                             "10k-node x 1k-gang mega gangs, wave-"
+                             "batched gang solve vs the sequential gang "
+                             "scan, bit-identical placements); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -2887,7 +3139,9 @@ if __name__ == "__main__":
                              "run (churn+gangs+chaos+waves, one seeded "
                              "stream); fails unless the pipelined cycle "
                              "engine beats the serial engine >= 1.5x on "
-                             "serve-phase (churn+waves) cycles/s with "
+                             "serve-phase (churn+waves) AND gang-phase "
+                             "cycles/s with zero serve gang fallbacks "
+                             "(resident gang/quota side tables), "
                              "identical "
                              "per-cycle placements, a bit-identical "
                              "final cluster state and a clean replayed "
@@ -2945,6 +3199,12 @@ if __name__ == "__main__":
         # — both arms share whatever backend is configured, so no tunnel
         # probe (its health cancels out of every asserted claim)
         cluster_life()
+        sys.exit(0)
+    if args.config == 12:
+        # solver-vs-solver comparison on one problem (wave-batched vs
+        # sequential gang scan, bit-identity gated) — both arms share the
+        # backend, so no tunnel probe
+        mega_gangs()
         sys.exit(0)
     if args.config == 10:
         # rank-aware vs quorum-only comparison, full shape — both arms

@@ -256,6 +256,12 @@ class HealthServer:
                             "rebases": outer.engine.rebases,
                             "antientropy_divergences":
                                 outer.engine.antientropy_divergences,
+                            # resident gang/quota serving health: >0
+                            # means gang rosters are falling back to
+                            # O(cluster) snapshots (ISSUE 12 — should
+                            # stay 0 on a compatible roster)
+                            "gang_fallbacks":
+                                outer.engine.gang_fallbacks,
                         }
                     if outer.elector is not None:
                         payload["leader"] = outer.elector.is_leader

@@ -259,7 +259,9 @@ def _cycle_pending(ctx: CycleCtx) -> None:
         # pods), and growth clones it creates join THIS cycle's batch
         with obs.extension_span("GangPhase", type(gangs).__name__,
                                 pending=len(pending)):
-            pending = gangs.run(scheduler, cluster, pending, now, report)
+            pending = gangs.run(
+                scheduler, cluster, pending, now, report, serve=serve
+            )
         if not pending:
             # gang-only cycle: every pending pod was a rank-gang member
             # (bound or parked by the phase); nothing for the per-pod

@@ -28,4 +28,10 @@ from scheduler_plugins_tpu.gangs.topology import (  # noqa: F401
     gang_solve_fn,
     gang_solve_np,
     pair_costs,
+    place_gang_one,
+)
+from scheduler_plugins_tpu.gangs.waves import (  # noqa: F401
+    wave_gang_solve,
+    wave_solve_body,
+    wave_solve_fn,
 )

@@ -124,9 +124,15 @@ def block_cost_view(cluster, weights_name=DEFAULT_WEIGHTS_NAME,
 
 def build_rank_gang_problem(cluster, pending, now,
                             weights_name=DEFAULT_WEIGHTS_NAME,
-                            nt_name=DEFAULT_NETWORK_TOPOLOGY_NAME):
+                            nt_name=DEFAULT_NETWORK_TOPOLOGY_NAME,
+                            serve=None):
     """Lower the cluster's rank-aware gangs into a solvable problem, or
-    None when no rank-aware gang has pending members.
+    None when no rank-aware gang has pending members. With `serve` (a
+    `serving.engine.ServeEngine` attached to this cluster) the node/
+    quota/meta lowering comes from the engine's RESIDENT columns and
+    side tables (O(changed) — the gang phase no longer pays an
+    O(cluster) re-snapshot per cycle); an incompatible roster falls back
+    to `Cluster.snapshot` transparently, exactly like the per-pod path.
 
     Returns a dict: the `RankGangState`, the initial free/eq_used/node
     mask arrays, `uids` (G lists of per-slot uids, None for pad slots),
@@ -154,8 +160,15 @@ def build_rank_gang_problem(cluster, pending, now,
     # member, so the resource-axis union covers any extended resource a
     # rank requests (a one-pod snapshot would KeyError encoding the rest;
     # the pod tensors themselves are irrelevant — the gang solve builds
-    # its own rank rows)
-    snap, meta = cluster.snapshot(consumed, now_ms=now)
+    # its own rank rows). A serving engine provides the same view from
+    # its resident state when the roster qualifies.
+    snap = meta = None
+    if serve is not None:
+        refreshed = serve.refresh(cluster, consumed, now_ms=now)
+        if refreshed is not None:
+            snap, meta = refreshed
+    if snap is None:
+        snap, meta = cluster.snapshot(consumed, now_ms=now)
     alloc = np.asarray(snap.nodes.alloc)
     requested = np.asarray(snap.nodes.requested)
     node_mask = np.asarray(snap.nodes.mask)
@@ -257,9 +270,16 @@ class GangPhase:
 
     def __init__(self, host_twin: bool = False, check_twin: bool = False,
                  weights_name: str = DEFAULT_WEIGHTS_NAME,
-                 network_topology_name: str = DEFAULT_NETWORK_TOPOLOGY_NAME):
+                 network_topology_name: str = DEFAULT_NETWORK_TOPOLOGY_NAME,
+                 wave: bool = False, wave_width: Optional[int] = None):
         self.host_twin = host_twin
         self.check_twin = check_twin
+        #: wave-batched solve (gangs.waves): independent gangs solved in
+        #: parallel waves, bit-identical to the sequential scan by the
+        #: conflict-fence acceptance rule — the sequential path stays the
+        #: parity anchor (tests/test_differential.py)
+        self.wave = wave
+        self.wave_width = wave_width
         self.weights_name = weights_name
         self.network_topology_name = network_topology_name
         #: gang full_name -> {uid: node} resident rank ledger, updated
@@ -373,11 +393,13 @@ class GangPhase:
         return created
 
     # -- the per-cycle entry --------------------------------------------
-    def run(self, scheduler, cluster, pending, now, report):
+    def run(self, scheduler, cluster, pending, now, report, serve=None):
         """Solve + bind this cycle's rank gangs; returns the pending list
         with every rank-gang member removed (placed, parked, or waiting
         for quorum — rank pods NEVER fall through to the per-pod solve,
-        which would undo the topology objective)."""
+        which would undo the topology objective). `serve` routes the
+        problem lowering through the resident serving engine
+        (O(changed)) instead of a fresh cluster snapshot."""
         self._last = None
         moved = self.reconcile(cluster, now)
         if moved:
@@ -398,7 +420,7 @@ class GangPhase:
                 )
         prob = build_rank_gang_problem(
             cluster, pending, now, self.weights_name,
-            self.network_topology_name,
+            self.network_topology_name, serve=serve,
         )
         if prob is None:
             return pending
@@ -484,7 +506,15 @@ class GangPhase:
             np_out = T.gang_solve_np(
                 gangs, prob["free0"], prob["eq_used0"], prob["node_mask"]
             )[:3]
-        if want_jit:
+        if want_jit and self.wave:
+            from scheduler_plugins_tpu.gangs import waves as GW
+
+            out = GW.wave_gang_solve(
+                gangs, prob["free0"], prob["eq_used0"], prob["node_mask"],
+                wave=self.wave_width or GW.DEFAULT_WAVE,
+            )
+            jit_out = tuple(np.asarray(x) for x in out[:3])
+        elif want_jit:
             import jax
             import jax.numpy as jnp
 

@@ -163,6 +163,114 @@ def packed_rank_capacity(cumdem, block_free):
     return jnp.min(counts, axis=1).astype(jnp.int32)
 
 
+def place_gang_one(gangs: RankGangState, g, free, eq_used, node_mask):
+    """ONE gang's topology-block waterfill step against (`free`,
+    `eq_used`) — THE shared per-gang body: the sequential scan
+    (`gang_solve_body`) runs it with the live carries, the wave-batched
+    solve (`gangs.waves`) vmaps it over a wave of independent gangs
+    against the wave-start state. One copy, so the two paths cannot
+    drift (and both stay bit-exact against `gang_solve_np`).
+
+    Returns (choices, admitted, q_new, free_l, eq_l, resident, primary,
+    has_res): `choices` are the PRE-revert tentative placements (the wave
+    validator needs them even for quorum-failed gangs), `free_l`/`eq_l`
+    the post-placement state BEFORE the quorum revert — callers apply
+    `jnp.where(admitted, ...)` themselves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    G, M, R = gangs.rank_req.shape
+    N = free.shape[0]
+    B = gangs.block_cost.shape[0]
+    node_block = gangs.node_block
+    block_cost = gangs.block_cost.astype(jnp.int32)
+    blk = jnp.maximum(node_block, 0)
+    blocked = (node_block >= 0) & node_mask
+
+    pending = gangs.rank_mask[g] & (gangs.prev_assigned[g] < 0)  # (M,)
+    resident = gangs.rank_mask[g] & (gangs.prev_assigned[g] >= 0)
+    dem = jnp.where(pending[:, None], gangs.rank_req[g], 0)  # (M, R)
+
+    # 1. block scoring: packed-rank capacity over the gang's pending
+    # demand prefix (cumulative-demand bucket machinery, f64 exact)
+    freec = jnp.where(node_mask[:, None], jnp.clip(free, 0, None), 0)
+    block_free = jnp.zeros((B, R), free.dtype).at[blk].add(
+        jnp.where(blocked[:, None], freec, 0)
+    )
+    cumdem = jnp.cumsum(dem.astype(jnp.float64), axis=0)  # (M, R)
+    packed = packed_rank_capacity(cumdem, block_free)  # (B,)
+    res_cnt = jnp.zeros(B, jnp.int32).at[
+        blk[jnp.maximum(gangs.prev_assigned[g], 0)]
+    ].add(
+        jnp.where(
+            resident
+            & (node_block[jnp.maximum(gangs.prev_assigned[g], 0)] >= 0),
+            1, 0,
+        )
+    )
+    has_res = res_cnt.sum() > 0
+    # argmax takes the FIRST max — lowest block index on ties, in both
+    # jnp and np (the twin relies on this)
+    primary = jnp.where(
+        has_res, jnp.argmax(res_cnt), jnp.argmax(packed)
+    ).astype(jnp.int32)
+
+    # 2. spill order: cost from primary asc, index tie-break (unique
+    # keys make the sort order-independent); primary pinned first
+    cost_from = block_cost[primary].at[primary].set(-1)
+    block_order = jnp.argsort(
+        cost_from.astype(jnp.int64) * B + jnp.arange(B)
+    )
+    block_pos = jnp.zeros(B, jnp.int64).at[block_order].set(
+        jnp.arange(B, dtype=jnp.int64)
+    )
+    node_pos = jnp.where(
+        blocked,
+        block_pos[blk] * N + jnp.arange(N),
+        jnp.where(node_mask, jnp.int64(B) * N + jnp.arange(N),
+                  jnp.int64(_FAR)),
+    )  # (N,) unique finite positions for usable nodes
+
+    ns = gangs.gang_ns[g]
+    nsc = jnp.maximum(ns, 0)
+    has_quota = (ns >= 0) & gangs.quota_has[nsc]
+    qmax = gangs.quota_max[nsc]
+
+    # 3. exact rank scan: first-fit in block-first order, dead after
+    # the first unplaceable rank (prefix placements, no holes)
+    def place_rank(c, m):
+        free_l, eq_l, dead = c
+        d = dem[m]
+        is_pending = pending[m]
+        fits = jnp.all(free_l >= d[None, :], axis=1) & node_mask
+        qok = ~has_quota | jnp.all(eq_l[nsc] + d <= qmax)
+        feasible = fits & is_pending & ~dead & qok
+        pos = jnp.where(feasible, node_pos, jnp.int64(_FAR))
+        choice = jnp.where(
+            feasible.any(), jnp.argmin(pos).astype(jnp.int32),
+            jnp.int32(-1),
+        )
+        placed = choice >= 0
+        onehot = (jnp.arange(N) == choice)[:, None]
+        free_l = free_l - jnp.where(placed, onehot * d[None, :], 0)
+        eq_l = eq_l.at[nsc].add(
+            jnp.where(placed & has_quota, d, 0)
+        )
+        dead = dead | (is_pending & ~placed)
+        return (free_l, eq_l, dead), choice
+
+    (free_l, eq_l, _), choices = jax.lax.scan(
+        place_rank, (free, eq_used, jnp.bool_(False)), jnp.arange(M)
+    )
+
+    # 4. quorum verdict: zero partial ranks below min (callers revert)
+    q_new = jnp.sum(choices >= 0).astype(jnp.int32)
+    q_total = q_new + jnp.sum(resident).astype(jnp.int32)
+    admitted = gangs.gang_mask[g] & (q_total >= gangs.min_ranks[g])
+    return choices, admitted, q_new, free_l, eq_l, resident, primary, has_res
+
+
 def gang_solve_body(gangs: RankGangState, state0, node_mask):
     """Traced topology-block waterfill over every gang (see module doc).
 
@@ -175,96 +283,12 @@ def gang_solve_body(gangs: RankGangState, state0, node_mask):
     import jax
     import jax.numpy as jnp
 
-    G, M, R = gangs.rank_req.shape
-    N = state0.free.shape[0]
-    B = gangs.block_cost.shape[0]
-    node_block = gangs.node_block
-    block_cost = gangs.block_cost.astype(jnp.int32)
-    blk = jnp.maximum(node_block, 0)
-    blocked = (node_block >= 0) & node_mask
+    G = gangs.rank_req.shape[0]
 
     def place_gang(carry, g):
         free, eq_used, rank_nodes = carry
-        pending = gangs.rank_mask[g] & (gangs.prev_assigned[g] < 0)  # (M,)
-        resident = gangs.rank_mask[g] & (gangs.prev_assigned[g] >= 0)
-        dem = jnp.where(pending[:, None], gangs.rank_req[g], 0)  # (M, R)
-
-        # 1. block scoring: packed-rank capacity over the gang's pending
-        # demand prefix (cumulative-demand bucket machinery, f64 exact)
-        freec = jnp.where(node_mask[:, None], jnp.clip(free, 0, None), 0)
-        block_free = jnp.zeros((B, R), free.dtype).at[blk].add(
-            jnp.where(blocked[:, None], freec, 0)
-        )
-        cumdem = jnp.cumsum(dem.astype(jnp.float64), axis=0)  # (M, R)
-        packed = packed_rank_capacity(cumdem, block_free)  # (B,)
-        res_cnt = jnp.zeros(B, jnp.int32).at[
-            blk[jnp.maximum(gangs.prev_assigned[g], 0)]
-        ].add(
-            jnp.where(
-                resident
-                & (node_block[jnp.maximum(gangs.prev_assigned[g], 0)] >= 0),
-                1, 0,
-            )
-        )
-        has_res = res_cnt.sum() > 0
-        # argmax takes the FIRST max — lowest block index on ties, in both
-        # jnp and np (the twin relies on this)
-        primary = jnp.where(
-            has_res, jnp.argmax(res_cnt), jnp.argmax(packed)
-        ).astype(jnp.int32)
-
-        # 2. spill order: cost from primary asc, index tie-break (unique
-        # keys make the sort order-independent); primary pinned first
-        cost_from = block_cost[primary].at[primary].set(-1)
-        block_order = jnp.argsort(
-            cost_from.astype(jnp.int64) * B + jnp.arange(B)
-        )
-        block_pos = jnp.zeros(B, jnp.int64).at[block_order].set(
-            jnp.arange(B, dtype=jnp.int64)
-        )
-        node_pos = jnp.where(
-            blocked,
-            block_pos[blk] * N + jnp.arange(N),
-            jnp.where(node_mask, jnp.int64(B) * N + jnp.arange(N),
-                      jnp.int64(_FAR)),
-        )  # (N,) unique finite positions for usable nodes
-
-        ns = gangs.gang_ns[g]
-        nsc = jnp.maximum(ns, 0)
-        has_quota = (ns >= 0) & gangs.quota_has[nsc]
-        qmax = gangs.quota_max[nsc]
-
-        # 3. exact rank scan: first-fit in block-first order, dead after
-        # the first unplaceable rank (prefix placements, no holes)
-        def place_rank(c, m):
-            free_l, eq_l, dead = c
-            d = dem[m]
-            is_pending = pending[m]
-            fits = jnp.all(free_l >= d[None, :], axis=1) & node_mask
-            qok = ~has_quota | jnp.all(eq_l[nsc] + d <= qmax)
-            feasible = fits & is_pending & ~dead & qok
-            pos = jnp.where(feasible, node_pos, jnp.int64(_FAR))
-            choice = jnp.where(
-                feasible.any(), jnp.argmin(pos).astype(jnp.int32),
-                jnp.int32(-1),
-            )
-            placed = choice >= 0
-            onehot = (jnp.arange(N) == choice)[:, None]
-            free_l = free_l - jnp.where(placed, onehot * d[None, :], 0)
-            eq_l = eq_l.at[nsc].add(
-                jnp.where(placed & has_quota, d, 0)
-            )
-            dead = dead | (is_pending & ~placed)
-            return (free_l, eq_l, dead), choice
-
-        (free_l, eq_l, _), choices = jax.lax.scan(
-            place_rank, (free, eq_used, jnp.bool_(False)), jnp.arange(M)
-        )
-
-        # 4. quorum revert: zero partial ranks below min
-        q_new = jnp.sum(choices >= 0).astype(jnp.int32)
-        q_total = q_new + jnp.sum(resident).astype(jnp.int32)
-        admitted = gangs.gang_mask[g] & (q_total >= gangs.min_ranks[g])
+        (choices, admitted, q_new, free_l, eq_l, resident, _primary,
+         _has_res) = place_gang_one(gangs, g, free, eq_used, node_mask)
         free = jnp.where(admitted, free_l, free)
         eq_used = jnp.where(admitted, eq_l, eq_used)
         row = jnp.where(
@@ -284,6 +308,26 @@ def gang_solve_body(gangs: RankGangState, state0, node_mask):
     )
     state = state0.replace(free=free, eq_used=eq_used, rank_nodes=rank_nodes)
     return rank_nodes, admitted, placed_new, state
+
+
+def packed_rank_capacity_np(cumdem, block_free):
+    """Host twin of `packed_rank_capacity` — identical float64
+    searchsorted semantics (gated bit-exact by the gang differentials).
+    Shared by `gang_solve_np` and the wave validator
+    (`gangs.waves._primary_invariant`), so the host-side primary-block
+    recomputation IS the solve's own scoring."""
+    R = cumdem.shape[1]
+    counts = np.stack(
+        [
+            np.searchsorted(
+                cumdem[:, r], block_free[:, r].astype(np.float64),
+                side="right",
+            )
+            for r in range(R)
+        ],
+        axis=1,
+    )  # (B, R)
+    return np.min(counts, axis=1).astype(I32)
 
 
 def gang_solve_fn():
@@ -339,19 +383,17 @@ def gang_cost_stats(rank_nodes, rank_mask, node_block, block_cost):
 # ---------------------------------------------------------------------------
 
 
-def gang_solve_np(gangs: RankGangState, free0, eq_used0, node_mask):
-    """Host-side twin of `gang_solve_body`: identical operation order,
-    identical tie-breaks (np.argmax/argmin take the first extremum, same
-    as jnp), int64 throughout — bit-exact against the jit solve
-    (tests/test_differential.py gates this across seeds). Returns
-    (rank_nodes (G, M) int32, admitted (G,) bool, placed_new (G,) int32,
-    free (N, R), eq_used (Q, R))."""
+def place_gang_np(gangs: RankGangState, g: int, free, eq_used, node_mask):
+    """Host twin of `place_gang_one` for ONE gang against the live
+    (`free`, `eq_used`) — identical operation order and tie-breaks
+    (np.argmax/argmin take the first extremum, same as jnp). THE shared
+    per-gang host body: `gang_solve_np` loops it in queue order, and the
+    wave solve (`gangs.waves`) resolves conflicted lanes with it. Returns
+    (choices (M,) int32, ok, q_new, free_l, eq_l, resident) — PRE-revert
+    state like the traced body; callers apply the quorum revert."""
     rank_req = np.asarray(gangs.rank_req)
     rank_mask = np.asarray(gangs.rank_mask)
     prev = np.asarray(gangs.prev_assigned)
-    min_ranks = np.asarray(gangs.min_ranks)
-    gang_ns = np.asarray(gangs.gang_ns)
-    gang_mask = np.asarray(gangs.gang_mask)
     node_block = np.asarray(gangs.node_block)
     block_cost = np.asarray(gangs.block_cost)
     quota_max = np.asarray(gangs.quota_max)
@@ -359,10 +401,82 @@ def gang_solve_np(gangs: RankGangState, free0, eq_used0, node_mask):
     node_mask = np.asarray(node_mask)
 
     G, M, R = rank_req.shape
-    N = free0.shape[0]
+    N = free.shape[0]
     B = block_cost.shape[0]
     blk = np.maximum(node_block, 0)
     blocked = (node_block >= 0) & node_mask
+
+    pending = rank_mask[g] & (prev[g] < 0)
+    resident = rank_mask[g] & (prev[g] >= 0)
+    dem = np.where(pending[:, None], rank_req[g], 0)
+
+    freec = np.where(node_mask[:, None], np.clip(free, 0, None), 0)
+    block_free = np.zeros((B, R), I64)
+    np.add.at(block_free, blk[blocked], freec[blocked])
+    cumdem = np.cumsum(dem.astype(np.float64), axis=0)
+    packed = packed_rank_capacity_np(cumdem, block_free)
+    res_cnt = np.zeros(B, I32)
+    res_nodes = np.maximum(prev[g], 0)
+    res_ok = resident & (node_block[res_nodes] >= 0)
+    np.add.at(res_cnt, blk[res_nodes[res_ok]], 1)
+    primary = int(np.argmax(res_cnt) if res_cnt.sum() > 0
+                  else np.argmax(packed))
+
+    cost_from = block_cost[primary].astype(I64).copy()
+    cost_from[primary] = -1
+    block_order = np.argsort(cost_from * B + np.arange(B))
+    block_pos = np.zeros(B, I64)
+    block_pos[block_order] = np.arange(B)
+    node_pos = np.where(
+        blocked,
+        block_pos[blk] * N + np.arange(N),
+        np.where(node_mask, I64(B) * N + np.arange(N), I64(_FAR)),
+    )
+
+    ns = int(np.asarray(gangs.gang_ns)[g])
+    nsc = max(ns, 0)
+    has_quota = ns >= 0 and bool(quota_has[nsc])
+
+    free_l = free.copy()
+    eq_l = eq_used.copy()
+    choices = np.full(M, -1, I32)
+    dead = False
+    for m in range(M):
+        if not pending[m] or dead:
+            continue
+        d = dem[m]
+        fits = np.all(free_l >= d[None, :], axis=1) & node_mask
+        qok = (not has_quota) or bool(
+            np.all(eq_l[nsc] + d <= quota_max[nsc])
+        )
+        feasible = fits & qok
+        if not feasible.any():
+            dead = True
+            continue
+        pos = np.where(feasible, node_pos, I64(_FAR))
+        choice = int(np.argmin(pos))
+        choices[m] = choice
+        free_l[choice] -= d
+        if has_quota:
+            eq_l[nsc] += d
+
+    q_new = int((choices >= 0).sum())
+    q_total = q_new + int(resident.sum())
+    ok = bool(np.asarray(gangs.gang_mask)[g]) and \
+        q_total >= int(np.asarray(gangs.min_ranks)[g])
+    return choices, ok, q_new, free_l, eq_l, resident
+
+
+def gang_solve_np(gangs: RankGangState, free0, eq_used0, node_mask):
+    """Host-side twin of `gang_solve_body`: the shared per-gang body
+    (`place_gang_np`) looped in queue order — bit-exact against the jit
+    solve (tests/test_differential.py gates this across seeds). Returns
+    (rank_nodes (G, M) int32, admitted (G,) bool, placed_new (G,) int32,
+    free (N, R), eq_used (Q, R))."""
+    rank_mask = np.asarray(gangs.rank_mask)
+    prev = np.asarray(gangs.prev_assigned)
+
+    G, M, R = np.asarray(gangs.rank_req).shape
 
     free = np.asarray(free0).astype(I64).copy()
     eq_used = np.asarray(eq_used0).astype(I64).copy()
@@ -371,71 +485,9 @@ def gang_solve_np(gangs: RankGangState, free0, eq_used0, node_mask):
     placed_new = np.zeros(G, I32)
 
     for g in range(G):
-        pending = rank_mask[g] & (prev[g] < 0)
-        resident = rank_mask[g] & (prev[g] >= 0)
-        dem = np.where(pending[:, None], rank_req[g], 0)
-
-        freec = np.where(node_mask[:, None], np.clip(free, 0, None), 0)
-        block_free = np.zeros((B, R), I64)
-        np.add.at(block_free, blk[blocked], freec[blocked])
-        cumdem = np.cumsum(dem.astype(np.float64), axis=0)
-        packed = np.zeros(B, I32)
-        for b in range(B):
-            counts = [
-                int(np.searchsorted(
-                    cumdem[:, r], float(block_free[b, r]), side="right"
-                ))
-                for r in range(R)
-            ]
-            packed[b] = min(counts)
-        res_cnt = np.zeros(B, I32)
-        res_nodes = np.maximum(prev[g], 0)
-        res_ok = resident & (node_block[res_nodes] >= 0)
-        np.add.at(res_cnt, blk[res_nodes[res_ok]], 1)
-        primary = int(np.argmax(res_cnt) if res_cnt.sum() > 0
-                      else np.argmax(packed))
-
-        cost_from = block_cost[primary].astype(I64).copy()
-        cost_from[primary] = -1
-        block_order = np.argsort(cost_from * B + np.arange(B))
-        block_pos = np.zeros(B, I64)
-        block_pos[block_order] = np.arange(B)
-        node_pos = np.where(
-            blocked,
-            block_pos[blk] * N + np.arange(N),
-            np.where(node_mask, I64(B) * N + np.arange(N), I64(_FAR)),
+        choices, ok, q_new, free_l, eq_l, resident = place_gang_np(
+            gangs, g, free, eq_used, node_mask
         )
-
-        ns = int(gang_ns[g])
-        nsc = max(ns, 0)
-        has_quota = ns >= 0 and bool(quota_has[nsc])
-
-        free_l = free.copy()
-        eq_l = eq_used.copy()
-        choices = np.full(M, -1, I32)
-        dead = False
-        for m in range(M):
-            if not pending[m] or dead:
-                continue
-            d = dem[m]
-            fits = np.all(free_l >= d[None, :], axis=1) & node_mask
-            qok = (not has_quota) or bool(
-                np.all(eq_l[nsc] + d <= quota_max[nsc])
-            )
-            feasible = fits & qok
-            if not feasible.any():
-                dead = True
-                continue
-            pos = np.where(feasible, node_pos, I64(_FAR))
-            choice = int(np.argmin(pos))
-            choices[m] = choice
-            free_l[choice] -= d
-            if has_quota:
-                eq_l[nsc] += d
-
-        q_new = int((choices >= 0).sum())
-        q_total = q_new + int(resident.sum())
-        ok = bool(gang_mask[g]) and q_total >= int(min_ranks[g])
         if ok:
             free = free_l
             eq_used = eq_l

@@ -39,6 +39,7 @@ clocks inside jit (GL008).
 from __future__ import annotations
 
 import numpy as np
+from flax import struct
 
 from scheduler_plugins_tpu.api.resources import PODS, ResourceIndex
 from scheduler_plugins_tpu.resilience import faults as _faults
@@ -70,6 +71,15 @@ def _encode(quantities: dict) -> np.ndarray:
         return CANON_INDEX.encode(quantities)
     except KeyError as exc:
         raise UnsupportedResource(str(exc)) from exc
+
+
+def pod_quota_vector(pod) -> np.ndarray:
+    """One assigned pod's contribution to its namespace's ElasticQuota
+    `used` row — the RAW effective-request encode (no pods-slot override:
+    `build_snapshot`'s quota accumulation sums `index.encode(
+    pod.effective_request())` verbatim). Raises `UnsupportedResource` on
+    extended resources, like the usage vectors."""
+    return _encode(pod.effective_request())
 
 
 def pod_usage_vectors(pod) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -105,6 +115,13 @@ NODE_DELETE = "node_delete"
 POD_ASSIGN = "pod_assign"
 POD_UNASSIGN = "pod_unassign"
 POD_TERMINATING = "pod_terminating"
+#: gang GATED-count transition (resident gang side tables): an UNBOUND,
+#: scheduling-gated gang member appeared (+1) or left that state (-1).
+#: The full snapshot counts such pods into `GangState.gated`/`total`
+#: (via `Cluster.gated_pods`), and no node-column event fires for them —
+#: the mutators push this kind with the delta captured at EVENT time
+#: (the gate/terminating flags mutate in place)
+GANG_GATED = "gang_gated"
 
 
 class DeltaSink:
@@ -180,6 +197,15 @@ class DeltaSink:
         """Terminating flag flipped False -> True on a held (bound or
         reserved) pod."""
         self._push((POD_TERMINATING, pod, node_name))
+
+    # -- gang side-table transitions ------------------------------------
+    def gang_gated(self, gang_full_name: str, delta: int) -> None:
+        """Unbound+gated membership transition of gang `gang_full_name`
+        (+1 appeared / -1 left). Delta captured at event time — the
+        scheduling-gate and terminating flags mutate pods in place, so a
+        drain-time re-read could double- or under-count a flip landing in
+        the same drain window (the POD_ASSIGN terminating-flag rule)."""
+        self._push((GANG_GATED, gang_full_name, delta))
 
     # -- sticky compatibility flags -------------------------------------
     def note_nomination(self, pod) -> None:
@@ -413,12 +439,160 @@ def compact_node_rows(nodes: NodeState, gather_idx, valid) -> NodeState:
     )
 
 
+# ---------------------------------------------------------------------------
+# resident gang/quota side tables (ISSUE 12; docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class SideTables:
+    """Device-resident gang/quota aggregate side tables, in ENGINE-stable
+    row order (first-seen gang / namespace; the per-cycle assembly
+    permutes host copies into that cycle's snapshot interning order).
+    These are the per-POD aggregates a fresh `build_snapshot` pays
+    O(cluster) pod loops for — maintained O(changed) from the drained
+    delta stream by `apply_side_deltas`, exactly like the node columns:
+
+    - gang_assigned (G,) i32 / gang_slack (G, R) i64: bound+reserved
+      members and their request sums (pods slot 1) per gang — the
+      `GangState.assigned` / `cluster_slack` aggregates.
+    - gang_gated (G,) i32: unbound scheduling-gated members (the
+      `gated_pods()` contribution to `GangState.gated`/`total_members`).
+    - quota_used (Q, R) i64: per-namespace assigned request sums (the
+      `QuotaState.used` accumulation, raw encodes).
+    - ns_assigned (Q,) i32: assigned-pod count per namespace — only used
+      host-side to reproduce the fresh snapshot's namespace-interning
+      tail (namespaces with assigned pods intern after batch + quotas;
+      their rows are all-default, so only the SET matters).
+    """
+
+    gang_assigned: np.ndarray
+    gang_gated: np.ndarray
+    gang_slack: np.ndarray
+    quota_used: np.ndarray
+    ns_assigned: np.ndarray
+
+
+def zero_side_tables(G: int, Q: int, R: int) -> SideTables:
+    import jax.numpy as jnp
+
+    return SideTables(
+        gang_assigned=jnp.zeros(G, jnp.int32),
+        gang_gated=jnp.zeros(G, jnp.int32),
+        gang_slack=jnp.zeros((G, R), jnp.int64),
+        quota_used=jnp.zeros((Q, R), jnp.int64),
+        ns_assigned=jnp.zeros(Q, jnp.int32),
+    )
+
+
+class SideDeltas:
+    """Packed side-table delta batch: gang rows (engine-stable gang row,
+    d_assigned, d_gated, d_slack) + namespace rows (engine-stable ns row,
+    d_used, d_count), bucket-padded with zero-delta rows (scatter-add
+    no-ops) so the jit cache stays warm across cycles."""
+
+    __slots__ = ("g_idx", "g_assigned", "g_gated", "g_slack",
+                 "q_idx", "q_used", "q_count")
+
+    MIN_BUCKET = 16
+
+    def __init__(self, g_idx, g_assigned, g_gated, g_slack, q_idx, q_used,
+                 q_count):
+        self.g_idx = g_idx
+        self.g_assigned = g_assigned
+        self.g_gated = g_gated
+        self.g_slack = g_slack
+        self.q_idx = q_idx
+        self.q_used = q_used
+        self.q_count = q_count
+
+    @classmethod
+    def pack(cls, gang_rows: list[tuple], ns_rows: list[tuple],
+             R: int) -> "SideDeltas":
+        """`gang_rows`: [(row, d_assigned, d_gated, d_slack_vec)];
+        `ns_rows`: [(row, d_used_vec, d_count)]. Duplicate rows sum."""
+        Ug = bucket_size(max(len(gang_rows), 1), minimum=cls.MIN_BUCKET)
+        Uq = bucket_size(max(len(ns_rows), 1), minimum=cls.MIN_BUCKET)
+        g_idx = np.zeros(Ug, I32)
+        g_assigned = np.zeros(Ug, I32)
+        g_gated = np.zeros(Ug, I32)
+        g_slack = np.zeros((Ug, R), I64)
+        for j, (row, da, dg, ds) in enumerate(gang_rows):
+            g_idx[j] = row
+            g_assigned[j] = da
+            g_gated[j] = dg
+            g_slack[j] = ds
+        q_idx = np.zeros(Uq, I32)
+        q_used = np.zeros((Uq, R), I64)
+        q_count = np.zeros(Uq, I32)
+        for j, (row, du, dc) in enumerate(ns_rows):
+            q_idx[j] = row
+            q_used[j] = du
+            q_count[j] = dc
+        return cls(g_idx, g_assigned, g_gated, g_slack, q_idx, q_used,
+                   q_count)
+
+    def as_args(self) -> tuple:
+        return (self.g_idx, self.g_assigned, self.g_gated, self.g_slack,
+                self.q_idx, self.q_used, self.q_count)
+
+    def as_dict(self) -> dict:
+        return {
+            "g_idx": self.g_idx, "g_assigned": self.g_assigned,
+            "g_gated": self.g_gated, "g_slack": self.g_slack,
+            "q_idx": self.q_idx, "q_used": self.q_used,
+            "q_count": self.q_count,
+        }
+
+
+def apply_side_deltas(tables: SideTables, g_idx, g_assigned, g_gated,
+                      g_slack, q_idx, q_used, q_count) -> SideTables:
+    """Fold one packed side-table delta batch into the resident gang/
+    quota aggregates. Pure scatter-adds (duplicate rows sum; padded rows
+    are zero-delta no-ops at row 0), mirroring `apply_node_deltas`'s
+    discipline; the `tables` argument is donated at the jit boundary
+    (`side_apply_program`) — callers rebind the resident carry from the
+    result."""
+    return tables.replace(
+        gang_assigned=tables.gang_assigned.at[g_idx].add(g_assigned),
+        gang_gated=tables.gang_gated.at[g_idx].add(g_gated),
+        gang_slack=tables.gang_slack.at[g_idx].add(g_slack),
+        quota_used=tables.quota_used.at[q_idx].add(q_used),
+        ns_assigned=tables.ns_assigned.at[q_idx].add(q_count),
+    )
+
+
 #: process-wide memo keyed by sanitize mode: every `ServeEngine` (and a
 #: chaos-harness crash restart, which builds a fresh one mid-run) shares
 #: ONE jitted apply program per mode, so engine reconstruction never pays
 #: a recompile for an already-warm shape
 _APPLY_PROGRAMS: dict = {}
 _COMPACT_PROGRAMS: dict = {}
+_SIDE_PROGRAMS: dict = {}
+
+
+def side_apply_program():
+    """The jitted side-table apply program with the resident carry
+    DONATED — same constructor/memo discipline as `delta_apply_program`,
+    registered with the AOT compile-readiness gate as
+    `serving_side_apply`."""
+    import jax
+
+    from scheduler_plugins_tpu.utils import observability as obs
+    from scheduler_plugins_tpu.utils import sanitize
+
+    key = sanitize.enabled()
+    if key in _SIDE_PROGRAMS:
+        return _SIDE_PROGRAMS[key]
+    if key:
+        jitted = sanitize.checkified(
+            apply_side_deltas, program="serve_side_apply"
+        )
+    else:
+        jitted = jax.jit(apply_side_deltas, donate_argnums=(0,))
+    _SIDE_PROGRAMS[key] = obs.compile_watch(
+        jitted, program="serve_side_apply"
+    )
+    return _SIDE_PROGRAMS[key]
 
 
 def node_compact_program():

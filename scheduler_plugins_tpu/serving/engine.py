@@ -25,14 +25,19 @@ Capacity policy (docs/SERVING.md):
   `_native_rebuild`. Rare control-plane events pay O(cluster); steady
   churn pays O(changed).
 
-Compatibility gate: the engine owns the snapshot only while every side
-table would be None — no PodGroups/ElasticQuotas/NRTs/AppGroups/seccomp
-profiles/node metrics, no selector-spec pods, no node taints, and no
-node-affinity/nomination specs in the pending batch (the same shape of
-condition as the native-store fast path in `Cluster.snapshot`). While
-incompatible, `refresh` returns None (the cycle falls back to the full
-snapshot) but KEEPS absorbing deltas, so the resident columns stay in
-sync and serving resumes without a rebase once the side objects go away.
+Compatibility gate: the engine owns the snapshot while every side table
+is either None or one the resident state fully describes. Gang
+(PodGroup) and quota (ElasticQuota) rosters are OWNED since ISSUE 12 —
+their aggregate tensors assemble O(G + Q) from resident side tables
+(`serving.deltas.SideTables`) maintained O(changed) from the same
+drained delta stream, docs/SERVING.md "Resident gang/quota side
+tables". NRTs/AppGroups/seccomp profiles/node metrics/selector-spec
+pods/node taints and any nomination or extended resource still gate
+(the same shape of condition as the native-store fast path in
+`Cluster.snapshot`). While incompatible, `refresh` returns None (the
+cycle falls back to the full snapshot) but KEEPS absorbing deltas, so
+the resident columns stay in sync and serving resumes without a rebase
+once the side objects go away.
 """
 
 from __future__ import annotations
@@ -44,9 +49,14 @@ import numpy as np
 from scheduler_plugins_tpu.serving import deltas as D
 from scheduler_plugins_tpu.state.snapshot import (
     ClusterSnapshot,
+    GangState,
+    QuotaState,
     SnapshotMeta,
     _Interner,
     build_pod_state,
+    empty_quota_nominees,
+    gang_object_tables,
+    quota_object_tables,
 )
 from scheduler_plugins_tpu.utils import observability as obs
 from scheduler_plugins_tpu.utils.intmath import bucket_size
@@ -102,9 +112,36 @@ class ServeEngine:
         #: silently mis-serve — the mirror keeps absorbing so serving
         #: resumes the moment the gangs drain away.
         self.resident_ranks: dict[str, dict] = {}
-        #: refreshes that fell back BECAUSE the cluster carried PodGroups
-        #: (the measured cost of running gangs on a serve-mode daemon)
+        #: refreshes that fell back while the cluster carried PodGroups.
+        #: Since ISSUE 12 a gang/quota roster is served RESIDENT (the
+        #: side tables below) — this counts only fallbacks forced by some
+        #: OTHER incompatibility while gangs were present, so a compatible
+        #: gang roster keeps it at 0 (`make endurance-smoke` gates that).
+        #: Exported as `scheduler_serve_gang_fallbacks_total`.
         self.gang_fallbacks = 0
+        # -- resident gang/quota side tables (ISSUE 12; docs/SERVING.md)
+        #: device-resident `serving.deltas.SideTables` aggregates in
+        #: engine-stable row order, maintained O(changed) by the donated
+        #: `side_apply_program` from the SAME drained delta stream as the
+        #: node columns; None until first built
+        self._side = None
+        self._gang_rows: dict[str, int] = {}  # gang full_name -> row
+        self._ns_rows: dict[str, int] = {}  # namespace -> row
+        self._side_apply = D.side_apply_program()
+        self._side_gpad = 0
+        self._side_qpad = 0
+        #: gang slack depends on node EXISTENCE (a fresh snapshot drops
+        #: contributions of pods bound to since-deleted nodes) — the rare
+        #: invalidating events (node delete under streaming compaction, a
+        #: previously-unknown node arriving, checkpoint restore) mark the
+        #: side tables dirty; the next assembly rebuilds them with ONE
+        #: O(pods) store scan instead of corrupting incrementally
+        self._side_dirty = True
+        #: per-namespace quota aggregates are maintained only once an
+        #: ElasticQuota has been sighted — without this gate every bind in
+        #: a quota-less cluster would pay a side-delta row (and a second
+        #: apply dispatch) for tables nobody reads
+        self._quota_tracking = False
 
     @staticmethod
     def _verify_every_default() -> int:
@@ -139,6 +176,10 @@ class ServeEngine:
         self._sink.events.clear()
         self._sink.overflowed = False
         self._sink.nominated_unbound.clear()
+        self._side = None
+        self._side_dirty = True
+        self._gang_rows.clear()
+        self._ns_rows.clear()
 
     @property
     def generation(self) -> int:
@@ -163,12 +204,15 @@ class ServeEngine:
 
     # -- compatibility gate ---------------------------------------------
     def compatible(self, cluster, pending) -> bool:
-        """True when the assembled snapshot's side tables would all be
-        None — the profile surface the resident columns fully describe."""
+        """True when the engine can own this cycle's snapshot: every
+        side table is either None or one the resident state fully
+        describes. Gang (PodGroup) and quota (ElasticQuota) rosters are
+        OWNED since ISSUE 12 — their aggregate tensors assemble from the
+        resident side tables — as long as their resources stay on the
+        canonical axis; NRTs/AppGroups/seccomp/metrics/selector-spec
+        pods/taints/nominations still fall back."""
         if (
-            cluster.pod_groups
-            or cluster.quotas
-            or cluster.nrts
+            cluster.nrts
             or cluster.app_groups
             or cluster.seccomp_profiles
             or cluster.node_metrics is not None
@@ -176,6 +220,20 @@ class ServeEngine:
             or self._tainted
         ):
             return False
+        # gang/quota objects naming an extended resource widen the fresh
+        # snapshot's packed axis past the canonical four (build_snapshot
+        # unions PodGroup.min_resources and quota min/max) — the resident
+        # columns cannot express that; O(G + Q), objects only
+        for pg in cluster.pod_groups.values():
+            if pg.min_resources and any(
+                r not in D.CANON_INDEX for r in pg.min_resources
+            ):
+                return False
+        for eq in cluster.quotas.values():
+            if any(r not in D.CANON_INDEX for r in eq.min) or any(
+                r not in D.CANON_INDEX for r in eq.max
+            ):
+                return False
         # nominations OUTSIDE the pending batch still count into the full
         # snapshot's nominated column / nominee holds: scheduling-gated
         # nominees (sink-tracked at upsert) and reserved nominees
@@ -214,30 +272,39 @@ class ServeEngine:
         with obs.tracer.span("ServeRefresh/drain", tid="serve"):
             events = self._sink.drain()
         obs.metrics.set_gauge(obs.SERVE_PENDING_DELTAS, len(events))
+        if cluster.quotas and not self._quota_tracking:
+            # first ElasticQuota sighting: start maintaining the quota
+            # aggregates; the activation rebuild picks up every already-
+            # assigned pod (classification below only carries deltas)
+            self._quota_tracking = True
+            self._side_dirty = True
         with obs.tracer.span(
             "ServeRefresh/classify", tid="serve", events=len(events)
         ):
-            upserts, usage, rebase = self._ingest(events)
+            upserts, usage, side, rebase = self._ingest(events)
         if self._sink.consume_overflow():
             # the queue collapsed while nobody drained: the surviving
             # events are a partial window — the resident base is
             # unrecoverable from deltas alone
             rebase = "sink-overflow"
+            self._side_dirty = True
         n_nodes = len(cluster.nodes)
         grow = self._nodes is not None and n_nodes > self._npad
 
         if not self.compatible(cluster, pending):
             if cluster.pod_groups:
                 self.gang_fallbacks += 1
+                obs.metrics.inc(obs.SERVE_GANG_FALLBACKS)
             # keep the columns in sync while incompatible; a rebase-class
             # event just drops the base (rebuilt at the next compatible
             # refresh)
             if rebase:
                 self._nodes = None
+                self._side_dirty = True
             elif self._nodes is not None:
                 if grow:
                     self._grow(bucket_size(n_nodes))
-                self._apply_batch(upserts, usage)
+                self._apply_batch(upserts, usage, side)
             self._last = None
             return None
 
@@ -245,7 +312,7 @@ class ServeEngine:
             return self._rebase(cluster, pending, now_ms)
         if grow:
             self._grow(bucket_size(n_nodes))
-        self._apply_batch(upserts, usage)
+        self._apply_batch(upserts, usage, side)
         self._refreshes += 1
         if self._verify_pending or (
             self.verify_every and self._refreshes % self.verify_every == 0
@@ -253,7 +320,15 @@ class ServeEngine:
             divergence = self.verify(cluster)
             if divergence is not None:
                 return self._rebase(cluster, pending, now_ms)
-        return self._assemble(cluster, pending)
+        if (cluster.pod_groups or cluster.quotas) and not self._ensure_side(
+            cluster
+        ):
+            # defensive: the side tables could not be rebuilt (an
+            # extended-resource assigned pod appeared between the axis
+            # checks) — serve this cycle from the full snapshot
+            self._last = None
+            return None
+        return self._assemble(cluster, pending, now_ms)
 
     # -- event classification -------------------------------------------
     def _ingest(self, events):
@@ -263,11 +338,13 @@ class ServeEngine:
         forcing a rebase."""
         return self._classify(events)
 
-    def _usage_vectors(self, pod, final=False):
-        """One pod's (requested, nonzero, limits) usage contribution —
-        the streaming subclass memoizes this per pod object (`final`
-        marks the pod's last event, releasing its entry)."""
-        return D.pod_usage_vectors(pod)
+    def _pod_vectors(self, pod, final=False):
+        """One pod's (requested, nonzero, limits, quota) contribution
+        vectors — the node usage columns' per-pod arithmetic plus the
+        ElasticQuota `used` row's raw request encode. The streaming
+        subclass memoizes this per pod object (`final` marks the pod's
+        last event, releasing its entry)."""
+        return D.pod_usage_vectors(pod) + (D.pod_quota_vector(pod),)
 
     def _row_cache(self):
         """Per-pod assembly memo passed to `build_pod_state` (None in the
@@ -291,11 +368,29 @@ class ServeEngine:
 
         return jax.tree.map(jnp.asarray, pod_state)
 
+    def _gang_row(self, name: str) -> int:
+        row = self._gang_rows.get(name)
+        if row is None:
+            row = self._gang_rows[name] = len(self._gang_rows)
+        return row
+
+    def _ns_row(self, name: str) -> int:
+        row = self._ns_rows.get(name)
+        if row is None:
+            row = self._ns_rows[name] = len(self._ns_rows)
+        return row
+
     def _classify(self, events):
         """Coalesce drained events into packed-row lists. Returns
-        (upsert_rows, usage_rows, rebase_reason|None)."""
+        (upsert_rows, usage_rows, side_rows, rebase_reason|None) where
+        `side_rows` is the (gang_rows, ns_rows) pair feeding the resident
+        gang/quota side tables (`serving.deltas.SideDeltas.pack`)."""
         upserts: dict[int, tuple] = {}  # slot -> row (last write wins)
         usage: list[tuple] = []
+        # side aggregates coalesce per engine-stable row (sums)
+        gang_acc: dict[int, list] = {}
+        ns_acc: dict[int, list] = {}
+        R = len(D.CANON_INDEX)
         rebase = None
 
         def fail(reason):
@@ -303,8 +398,31 @@ class ServeEngine:
             if rebase is None:
                 rebase = reason
 
+        def gang_add(name, d_assigned, d_gated, d_slack):
+            row = self._gang_row(name)
+            acc = gang_acc.get(row)
+            if acc is None:
+                acc = gang_acc[row] = [0, 0, np.zeros(R, np.int64)]
+            acc[0] += d_assigned
+            acc[1] += d_gated
+            if d_slack is not None:
+                acc[2] = acc[2] + d_slack
+
+        def ns_add(name, d_used, d_count):
+            row = self._ns_row(name)
+            acc = ns_acc.get(row)
+            if acc is None:
+                acc = ns_acc[row] = [np.zeros(R, np.int64), 0]
+            acc[0] = acc[0] + d_used
+            acc[1] += d_count
+
         for ev in events:
             kind = ev[0]
+            if kind == D.GANG_GATED:
+                # unbound gated gang-membership transition (event-time
+                # delta; see Cluster._gang_gated_key)
+                gang_add(ev[1], 0, ev[2], None)
+                continue
             if kind == D.NODE_DELETE:
                 # the row order dies with the node — but so do its label/
                 # taint entries: a deleted node must not pin `compatible`
@@ -332,6 +450,12 @@ class ServeEngine:
                     slot = len(self._names)
                     self._slots[node.name] = slot
                     self._names.append(node.name)
+                    if self._gang_rows:
+                        # a NEW node name can resurrect gang slack for
+                        # pods already bound to it (cross-watch arrival:
+                        # fresh snapshots include slack only for nodes
+                        # that exist) — rebuild rather than drift
+                        self._side_dirty = True
                 try:
                     alloc = D._encode(node.allocatable)
                     cap = D._encode(node.capacity)
@@ -364,23 +488,37 @@ class ServeEngine:
                                 f"{pod.namespace}/{gang}", None
                             )
                 slot = self._slots.get(node_name)
+                if kind == D.POD_TERMINATING:
+                    if slot is None:
+                        fail("unknown-node")
+                        continue
+                    usage.append((slot, D.ZERO_R, D.ZERO_R, D.ZERO_R, 0, 1))
+                    continue
+                sign = 1 if kind == D.POD_ASSIGN else -1
+                try:
+                    req, nz, lim, qreq = self._pod_vectors(
+                        pod, final=kind == D.POD_UNASSIGN
+                    )
+                except D.UnsupportedResource:
+                    fail("extended-resource")
+                    continue
+                # side-table contributions FIRST: the quota used row and
+                # the gang assigned count follow the pod regardless of
+                # node existence (build_snapshot's rule); gang slack only
+                # when the node is known (fresh drops unknown-node slack)
+                if self._quota_tracking:
+                    ns_add(pod.namespace, sign * qreq, sign)
+                if gang:
+                    gang_add(
+                        f"{pod.namespace}/{gang}", sign, 0,
+                        sign * req if slot is not None else None,
+                    )
                 if slot is None:
                     # pod referenced a node the engine never saw (cross-
                     # watch ordering): the fresh snapshot skips such pods
                     # until the node arrives, at which point row contents
                     # change wholesale — re-base to stay exact
                     fail("unknown-node")
-                    continue
-                if kind == D.POD_TERMINATING:
-                    usage.append((slot, D.ZERO_R, D.ZERO_R, D.ZERO_R, 0, 1))
-                    continue
-                sign = 1 if kind == D.POD_ASSIGN else -1
-                try:
-                    req, nz, lim = self._usage_vectors(
-                        pod, final=kind == D.POD_UNASSIGN
-                    )
-                except D.UnsupportedResource:
-                    fail("extended-resource")
                     continue
                 # event-time flag, NOT pod.terminating: a mark_terminating
                 # between event and drain mutates the pod in place and
@@ -390,17 +528,21 @@ class ServeEngine:
                     slot, sign * req, sign * nz, sign * lim, sign,
                     sign * term,
                 ))
-        return list(upserts.values()), usage, rebase
+        side = (
+            [(row, a, g, s) for row, (a, g, s) in gang_acc.items()],
+            [(row, u, c) for row, (u, c) in ns_acc.items()],
+        )
+        return list(upserts.values()), usage, side, rebase
 
     # -- state transitions ----------------------------------------------
-    def _apply_batch(self, upsert_rows, usage_rows) -> None:
+    def _apply_batch(self, upsert_rows, usage_rows, side=None) -> None:
         with obs.tracer.span(
             "ServeRefresh/apply", tid="serve",
             upserts=len(upsert_rows), usage=len(usage_rows),
         ):
-            self._apply_batch_inner(upsert_rows, usage_rows)
+            self._apply_batch_inner(upsert_rows, usage_rows, side)
 
-    def _apply_batch_inner(self, upsert_rows, usage_rows) -> None:
+    def _apply_batch_inner(self, upsert_rows, usage_rows, side=None) -> None:
         import warnings
 
         import jax
@@ -435,6 +577,7 @@ class ServeEngine:
                 warnings.warn_explicit(
                     w.message, w.category, w.filename, w.lineno
                 )
+        side_dict = self._apply_side(side)
         self._generation += 1
         n_events = len(upsert_rows) + len(usage_rows)
         self._staleness += n_events
@@ -442,7 +585,64 @@ class ServeEngine:
             "mode": "delta", "events": n_events,
             "upserts": ups.as_dict(), "usage": use.as_dict(),
         }
+        if side_dict is not None:
+            self._last["side"] = side_dict
         self._observe()
+
+    def _apply_side(self, side):
+        """Fold this window's packed side-table deltas into the resident
+        gang/quota aggregates (donated jit scatter). Skipped entirely for
+        windows without gang/quota rows (the common quota-less churn
+        case pays nothing) and while the tables are dirty — the pending
+        O(pods) rebuild supersedes any incremental application."""
+        if side is None:
+            return None
+        gang_rows, ns_rows = side
+        if (not gang_rows and not ns_rows) or self._side_dirty:
+            return None
+        if self._side is None:
+            self._side_dirty = True
+            return None
+        import warnings
+
+        R = len(D.CANON_INDEX)
+        need_g = max((row for row, *_ in gang_rows), default=-1) + 1
+        need_q = max((row for row, *_ in ns_rows), default=-1) + 1
+        self._grow_side(need_g, need_q)
+        packed = D.SideDeltas.pack(gang_rows, ns_rows, R)
+        with warnings.catch_warnings():
+            # CPU backends never donate and list every buffer
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*"
+            )
+            self._side = self._side_apply(
+                self._side, *self._stage_args(packed.as_args())
+            )
+        return packed.as_dict()
+
+    def _grow_side(self, need_g: int, need_q: int) -> None:
+        """Pad the resident side tables to cover rows `need_g`/`need_q`
+        (bucketed, zero-padded — new gangs/namespaces appear mid-run)."""
+        import jax.numpy as jnp
+
+        new_g = bucket_size(max(need_g, self._side_gpad, 1))
+        new_q = bucket_size(max(need_q, self._side_qpad, 1))
+        if new_g == self._side_gpad and new_q == self._side_qpad:
+            return
+
+        def pad1(arr, n):
+            widths = [(0, n - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+            return jnp.pad(arr, widths)
+
+        self._side = self._side.replace(
+            gang_assigned=pad1(self._side.gang_assigned, new_g),
+            gang_gated=pad1(self._side.gang_gated, new_g),
+            gang_slack=pad1(self._side.gang_slack, new_g),
+            quota_used=pad1(self._side.quota_used, new_q),
+            ns_assigned=pad1(self._side.ns_assigned, new_q),
+        )
+        self._side_gpad = new_g
+        self._side_qpad = new_q
 
     def _grow(self, new_npad: int) -> None:
         """Pad the resident columns to a larger bucket device-side —
@@ -496,6 +696,7 @@ class ServeEngine:
             # fresh snapshot and keep re-basing (full-snapshot cost,
             # exact) until the extended objects go away.
             self._nodes = None
+            self._side_dirty = True
             self._generation += 1
             self._staleness = 0
             self._rebases += 1
@@ -516,6 +717,10 @@ class ServeEngine:
             for n in cluster.nodes.values()
         }
         self._tainted = {n.name for n in cluster.nodes.values() if n.taints}
+        # a rebase is already O(cluster): rebuild the gang/quota side
+        # tables in the same breath (their aggregates must match the
+        # fresh snapshot this rebase just served from)
+        self._rebuild_side_tables(cluster)
         self._generation += 1
         self._staleness = 0
         self._rebases += 1
@@ -530,6 +735,183 @@ class ServeEngine:
         self._last = {"mode": "rebase", "events": 0}
         self._observe()
         return snap, meta
+
+    # -- resident gang/quota side tables --------------------------------
+    def _ensure_side(self, cluster) -> bool:
+        """Side tables ready for assembly: rebuild them from one O(pods)
+        store scan when dirty or absent (activation, node-set change,
+        restore, divergence)."""
+        if self._side is not None and not self._side_dirty:
+            return True
+        return self._rebuild_side_tables(cluster)
+
+    def _scan_side_aggregates(self, cluster):
+        """ONE store scan producing the gang/quota aggregate dicts a
+        fresh `build_snapshot` would accumulate: {gang full_name:
+        [assigned, gated, slack_vec]} + {namespace: [used_vec, count]}.
+        Shared by the rebuild (packs them resident) and the anti-entropy
+        verify (compares them against the resident copies). Raises
+        `UnsupportedResource` on extended-resource assigned pods — the
+        same condition that already keeps the engine on the
+        full-snapshot rebase path."""
+        R = len(D.CANON_INDEX)
+        gangs: dict[str, list] = {}
+        namespaces: dict[str, list] = {}
+
+        def gang_acc(name):
+            acc = gangs.get(name)
+            if acc is None:
+                acc = gangs[name] = [0, 0, np.zeros(R, np.int64)]
+            return acc
+
+        for pod in cluster.pods.values():
+            held = pod.node_name or cluster.reserved.get(pod.uid)
+            gang = pod.pod_group()
+            if held is not None:
+                req, _nz, _lim, qreq = self._pod_vectors(pod)
+                if self._quota_tracking:
+                    acc = namespaces.get(pod.namespace)
+                    if acc is None:
+                        acc = namespaces[pod.namespace] = [
+                            np.zeros(R, np.int64), 0,
+                        ]
+                    acc[0] = acc[0] + qreq
+                    acc[1] += 1
+                if gang:
+                    acc = gang_acc(f"{pod.namespace}/{gang}")
+                    acc[0] += 1
+                    if held in cluster.nodes:
+                        # fresh snapshots count slack only for nodes that
+                        # exist (node_pos membership)
+                        acc[2] = acc[2] + req
+            # gated runs on `gated_pods()`'s own predicate (node_name is
+            # None), INDEPENDENT of a permit reservation: a reserved
+            # gated pod counts BOTH ways in a fresh snapshot (assigned
+            # via its materialized reserved copy, gated via the real
+            # unbound object) and the delta stream mirrors that
+            # (POD_ASSIGN at reserve + GANG_GATED at upsert)
+            if (
+                gang
+                and pod.node_name is None
+                and pod.scheduling_gated
+                and not pod.terminating
+            ):
+                gang_acc(f"{pod.namespace}/{gang}")[1] += 1
+        return gangs, namespaces
+
+    def _rebuild_side_tables(self, cluster) -> bool:
+        """Rebuild the resident side tables from the store (O(pods), the
+        rare path — steady state is the O(changed) `_apply_side`).
+        Returns False (tables stay dirty) when an extended-resource
+        assigned pod makes the canonical-axis aggregates unrepresentable
+        — the axis-width rebase rule already keeps the engine off the
+        resident path in exactly that state."""
+        import jax.numpy as jnp
+
+        with obs.tracer.span(
+            "ServeRefresh/side_rebuild", tid="serve",
+            pods=len(cluster.pods),
+        ):
+            try:
+                gangs, namespaces = self._scan_side_aggregates(cluster)
+            except D.UnsupportedResource:
+                self._side_dirty = True
+                return False
+            R = len(D.CANON_INDEX)
+            self._gang_rows = {name: i for i, name in enumerate(gangs)}
+            self._ns_rows = {name: i for i, name in enumerate(namespaces)}
+            self._side_gpad = bucket_size(max(len(gangs), 1))
+            self._side_qpad = bucket_size(max(len(namespaces), 1))
+            ga = np.zeros(self._side_gpad, np.int32)
+            gg = np.zeros(self._side_gpad, np.int32)
+            gs = np.zeros((self._side_gpad, R), np.int64)
+            qu = np.zeros((self._side_qpad, R), np.int64)
+            qc = np.zeros(self._side_qpad, np.int32)
+            for name, (assigned, gated, slack) in gangs.items():
+                row = self._gang_rows[name]
+                ga[row] = assigned
+                gg[row] = gated
+                gs[row] = slack
+            for name, (used, count) in namespaces.items():
+                row = self._ns_rows[name]
+                qu[row] = used
+                qc[row] = count
+            self._side = D.SideTables(
+                gang_assigned=jnp.asarray(ga),
+                gang_gated=jnp.asarray(gg),
+                gang_slack=jnp.asarray(gs),
+                quota_used=jnp.asarray(qu),
+                ns_assigned=jnp.asarray(qc),
+            )
+            self._side_dirty = False
+            return True
+
+    def _side_host(self) -> dict:
+        """Host copies of the resident side tables (small: (G,)/(Q, R))."""
+        return {
+            "gang_assigned": np.asarray(self._side.gang_assigned),
+            "gang_gated": np.asarray(self._side.gang_gated),
+            "gang_slack": np.asarray(self._side.gang_slack),
+            "quota_used": np.asarray(self._side.quota_used),
+            "ns_assigned": np.asarray(self._side.ns_assigned),
+        }
+
+    def _side_verify_live(self, cluster) -> bool:
+        """True when the side tables have state worth verifying (skipped
+        — costing nothing — in plain churn)."""
+        return (
+            self._side is not None
+            and not self._side_dirty
+            and bool(
+                cluster.pod_groups or cluster.quotas
+                or self._quota_tracking
+            )
+        )
+
+    def _side_divergence(self, gangs: dict, namespaces: dict
+                         ) -> Optional[str]:
+        """Compare expected aggregate dicts (a `_scan_side_aggregates`
+        result) against the resident side tables. Consumes the dicts."""
+        host = self._side_host()
+        for name, row in self._gang_rows.items():
+            exp = gangs.pop(name, None)
+            if exp is None:
+                exp = [0, 0, np.zeros(len(D.CANON_INDEX), np.int64)]
+            if (
+                int(host["gang_assigned"][row]) != exp[0]
+                or int(host["gang_gated"][row]) != exp[1]
+                or not (host["gang_slack"][row] == exp[2]).all()
+            ):
+                return "side-gang"
+        if gangs:
+            return "side-gang"  # expected rows the resident table lacks
+        for name, row in self._ns_rows.items():
+            exp = namespaces.pop(name, None)
+            if exp is None:
+                exp = [np.zeros(len(D.CANON_INDEX), np.int64), 0]
+            if (
+                int(host["ns_assigned"][row]) != exp[1]
+                or not (host["quota_used"][row] == exp[0]).all()
+            ):
+                return "side-quota"
+        if namespaces:
+            return "side-quota"
+        return None
+
+    def _verify_side(self, cluster) -> Optional[str]:
+        """Anti-entropy over the gang/quota side tables: recompute the
+        expected aggregates from the store (independent of the delta
+        path) and compare to the resident copies. Skipped — costing
+        nothing — while no gang/quota state is live. (The streaming
+        engine folds the expectation into its single `_expected_columns`
+        pass instead of paying a second store scan.)"""
+        if not self._side_verify_live(cluster):
+            return None
+        try:
+            gangs, namespaces = self._scan_side_aggregates(cluster)
+        except D.UnsupportedResource:
+            return None  # axis-width rule owns this state
+        return self._side_divergence(gangs, namespaces)
 
     # -- anti-entropy ----------------------------------------------------
     def note_fault(self, reason: Optional[str] = None) -> None:
@@ -577,6 +959,8 @@ class ServeEngine:
                 )
                 if mine != theirs:
                     reason = "column-digest"
+            if reason is None:
+                reason = self._verify_side(cluster)
             if reason is not None:
                 self.antientropy_divergences += 1
                 obs.metrics.inc(obs.ANTIENTROPY_DIVERGENCE)
@@ -687,6 +1071,13 @@ class ServeEngine:
             k: tuple(v) for k, v in header["node_labels"].items()
         }
         self._tainted = set(header["tainted"])
+        # side tables are cheap to re-derive (one store scan) relative to
+        # checkpointing them: rebuilt lazily at the next gang/quota use
+        self._side = None
+        self._side_dirty = True
+        self._gang_rows = {}
+        self._ns_rows = {}
+        self._quota_tracking = False
         self._base_digest = None
         self._last = None
         self.note_fault("checkpoint-restore")
@@ -703,33 +1094,132 @@ class ServeEngine:
             "pod_count": n.pod_count, "terminating": n.terminating,
         }
 
-    def _assemble(self, cluster, pending):
+    def _assemble(self, cluster, pending, now_ms: int = 0):
         """Snapshot view over the resident node columns + this cycle's
         pending batch (built through the same `build_pod_state` the full
-        snapshot path uses, so the pod tensors are bit-identical)."""
+        snapshot path uses, so the pod tensors are bit-identical). Gang
+        and quota rosters assemble their `GangState`/`QuotaState` from
+        the resident side tables: the per-PodGroup/per-quota OBJECT
+        columns re-lower O(G + Q) through the SAME
+        `gang_object_tables`/`quota_object_tables` the fresh path uses,
+        the per-pod AGGREGATES come from the O(changed)-maintained side
+        tables — never an O(cluster) pod loop."""
         with obs.tracer.span(
             "ServeRefresh/assemble", tid="serve", pending=len(pending)
         ):
-            return self._assemble_inner(cluster, pending)
+            return self._assemble_inner(cluster, pending, now_ms)
 
-    def _assemble_inner(self, cluster, pending):
-        import jax
-        import jax.numpy as jnp
-
+    def _assemble_inner(self, cluster, pending, now_ms: int = 0):
         P = bucket_size(max(len(pending), 1))
+        R = len(D.CANON_INDEX)
         meta = SnapshotMeta(index=D.CANON_INDEX)
         meta.node_names = list(self._names)
         meta.pod_names = [p.uid for p in pending]
         meta.regions = list(self._regions)
         meta.zones = list(self._zones)
         ns_in = _Interner(meta.namespaces)
+
+        # gang interning in pod_groups-dict order — build_snapshot's own
+        # first-seen rule, so codes match the fresh path's exactly
+        pod_groups = list(cluster.pod_groups.values())
+        gangs_in = _Interner(meta.gang_names)
+        gang_pos = {
+            pg.full_name: gangs_in.code(pg.full_name) for pg in pod_groups
+        }
+
+        def gang_of(pod):
+            name = pod.pod_group()
+            if not name:
+                return -1
+            return gang_pos.get(f"{pod.namespace}/{name}", -1)
+
+        batch_counts: dict[int, int] = {}
+        if pod_groups:
+            def gang_of_counted(pod, _inner=gang_of):
+                g = _inner(pod)
+                if g >= 0:
+                    batch_counts[g] = batch_counts.get(g, 0) + 1
+                return g
+            gang_code = gang_of_counted
+        else:
+            gang_code = gang_of
         pod_state = build_pod_state(
-            pending, P, D.CANON_INDEX, ns_in, lambda pod: -1,
+            pending, P, D.CANON_INDEX, ns_in, gang_code,
             cluster.tlp_prediction, row_cache=self._row_cache(),
         )
+
+        gang_state = quota_state = None
+        side = (
+            self._side_host() if (pod_groups or cluster.quotas) else None
+        )
+        if pod_groups:
+            G = max(len(gang_pos), 1)
+            backed_off = [
+                name
+                for name, until in cluster.gang_backoff_until_ms.items()
+                if until > now_ms
+            ]
+            obj = gang_object_tables(
+                pod_groups, gang_pos, D.CANON_INDEX, G, backed_off
+            )
+            assigned = np.zeros(G, np.int32)
+            gated = np.zeros(G, np.int32)
+            slack = np.zeros((G, R), np.int64)
+            for pg in pod_groups:
+                row = self._gang_rows.get(pg.full_name)
+                if row is None:
+                    continue
+                g = gang_pos[pg.full_name]
+                assigned[g] = side["gang_assigned"][row]
+                gated[g] = side["gang_gated"][row]
+                slack[g] = side["gang_slack"][row]
+            # total = this cycle's batch members + assigned + gated: the
+            # same three populations build_snapshot's pod loop walks
+            total = (assigned + gated).astype(np.int32)
+            for g, count in batch_counts.items():
+                total[g] += count
+            gang_state = GangState(
+                total_members=total,
+                assigned=assigned,
+                gated=gated,
+                cluster_slack=slack,
+                **obj,
+            )
+        if cluster.quotas:
+            quotas = list(cluster.quotas.values())
+            # fresh interning order: batch namespaces (above), then quota
+            # namespaces, then assigned-pod namespaces. The assigned tail
+            # rows are all-default (used accumulates only under a quota),
+            # so only the SET matters — the resident count tracks it.
+            for q in quotas:
+                ns_in.code(q.namespace)
+            for name, row in self._ns_rows.items():
+                if side["ns_assigned"][row] > 0:
+                    ns_in.code(name)
+            Q = max(len(meta.namespaces), 1)
+            qmin, qmax, qhas = quota_object_tables(
+                quotas, D.CANON_INDEX, ns_in, Q
+            )
+            qused = np.zeros((Q, R), np.int64)
+            for q in quotas:
+                row = self._ns_rows.get(q.namespace)
+                if row is not None:
+                    qused[ns_in.get(q.namespace)] = side["quota_used"][row]
+            nom_req, nom_in_eq, nom_total, nom_batch = empty_quota_nominees(
+                R, P
+            )
+            quota_state = QuotaState(
+                min=qmin, max=qmax, used=qused, has_quota=qhas,
+                nom_req=nom_req, nom_in_eq_mask=nom_in_eq,
+                nom_total_mask=nom_total, nom_batch_idx=nom_batch,
+            )
         snap = ClusterSnapshot(
             nodes=self._nodes,
             pods=self._stage_pods(pod_state),
+            gangs=self._stage_pods(gang_state)
+            if gang_state is not None else None,
+            quota=self._stage_pods(quota_state)
+            if quota_state is not None else None,
         )
         return snap, meta
 
@@ -758,13 +1248,13 @@ class ServeEngine:
             "events": self._last["events"],
         }
         if self._last["mode"] == "delta":
-            serve["deltas"] = pack_pytree(
-                {
-                    "upserts": self._last["upserts"],
-                    "usage": self._last["usage"],
-                },
-                rec.blobs,
-            )
+            packed = {
+                "upserts": self._last["upserts"],
+                "usage": self._last["usage"],
+            }
+            if "side" in self._last:
+                packed["side"] = self._last["side"]
+            serve["deltas"] = pack_pytree(packed, rec.blobs)
         rec.manifest["serve"] = serve
 
 
@@ -832,13 +1322,13 @@ class StreamingServeEngine(ServeEngine):
             self._rows.clear()
         return self._rows
 
-    def _usage_vectors(self, pod, final=False):
+    def _pod_vectors(self, pod, final=False):
         ent = self._vec_cache.get(pod.uid)
         if ent is not None and ent[0] is pod:
             if final:
                 del self._vec_cache[pod.uid]
             return ent[1]
-        vecs = D.pod_usage_vectors(pod)
+        vecs = D.pod_usage_vectors(pod) + (D.pod_quota_vector(pod),)
         if final:
             self._vec_cache.pop(pod.uid, None)
         else:
@@ -868,7 +1358,7 @@ class StreamingServeEngine(ServeEngine):
         try:
             for pod in cluster.pods.values():
                 if pod.node_name is not None or pod.uid in cluster.reserved:
-                    self._usage_vectors(pod)
+                    self._pod_vectors(pod)
         except D.UnsupportedResource:
             pass  # extended resources: verify falls back to base anyway
         if self._nodes is not None and self._npad not in self._compact_warm:
@@ -911,6 +1401,8 @@ class StreamingServeEngine(ServeEngine):
             return self._classify(events)
         segment: list = []
         rebase = None
+        side_gang: list = []
+        side_ns: list = []
         for ev in events:
             if ev[0] == D.NODE_DELETE and rebase is None:
                 name = ev[1]
@@ -920,10 +1412,12 @@ class StreamingServeEngine(ServeEngine):
                 # up before applying would discard the delete and leave
                 # a ghost resident row for a node the store no longer
                 # has (an add+remove flap within one window)
-                ups, use, rebase = self._classify(segment)
+                ups, use, side, rebase = self._classify(segment)
                 segment = []
                 if rebase is not None:
                     continue  # the resident base is dying anyway
+                side_gang.extend(side[0])
+                side_ns.extend(side[1])
                 if ups or use:
                     self._grow(bucket_size(max(len(self._names), 1)))
                     self._apply_batch(ups, use)
@@ -937,8 +1431,9 @@ class StreamingServeEngine(ServeEngine):
                 self._compact_row(name, slot)
                 continue
             segment.append(ev)
-        ups, use, seg_rebase = self._classify(segment)
-        return ups, use, rebase if rebase is not None else seg_rebase
+        ups, use, side, seg_rebase = self._classify(segment)
+        side = (side_gang + side[0], side_ns + side[1])
+        return ups, use, side, rebase if rebase is not None else seg_rebase
 
     # -- O(assigned) anti-entropy ---------------------------------------
     def verify(self, cluster) -> Optional[str]:
@@ -962,10 +1457,12 @@ class StreamingServeEngine(ServeEngine):
             obs.metrics.inc(obs.ANTIENTROPY_CHECKS)
             return None
         names = list(cluster.nodes)
-        expected = None
+        expected = side_exp = None
         if names == self._names:
             try:
-                expected = self._expected_columns(cluster, names)
+                expected, side_exp = self._expected_columns(
+                    cluster, names, want_side=self._side_verify_live(cluster)
+                )
             except D.UnsupportedResource:
                 # extended resource somewhere: the packed axis is wider
                 # than the canonical four — delegate to the base
@@ -989,6 +1486,8 @@ class StreamingServeEngine(ServeEngine):
                 theirs = flightrec._pack_digest(expected)
                 if mine != theirs:
                     reason = "column-digest"
+            if reason is None and side_exp is not None:
+                reason = self._side_divergence(*side_exp)
             if reason is not None:
                 self.antientropy_divergences += 1
                 obs.metrics.inc(obs.ANTIENTROPY_DIVERGENCE)
@@ -1000,13 +1499,41 @@ class StreamingServeEngine(ServeEngine):
                 )
             return reason
 
-    def _expected_columns(self, cluster, names) -> dict:
+    def _expected_columns(self, cluster, names, want_side=False):
         """The node columns a fresh `build_snapshot` at this padding
         would produce, accumulated O(nodes + assigned) — the exact
         per-pod arithmetic rides the shared `pod_usage_vectors`
         (requested/nonzero carry the pods-count slot per pod, so their
-        sums equal the snapshot's pod_count overwrite)."""
+        sums equal the snapshot's pod_count overwrite). With
+        `want_side`, the SAME pass also accumulates the expected
+        gang/quota side aggregates (`_scan_side_aggregates` semantics —
+        one store walk covers both verifications); returns
+        (columns, (gangs, namespaces) | None)."""
         R = len(D.CANON_INDEX)
+        side_gangs: dict = {}
+        side_ns: dict = {}
+
+        def side_gang_acc(name):
+            acc = side_gangs.get(name)
+            if acc is None:
+                acc = side_gangs[name] = [0, 0, np.zeros(R, np.int64)]
+            return acc
+
+        def side_assigned(pod, held, req, qreq):
+            if self._quota_tracking:
+                acc = side_ns.get(pod.namespace)
+                if acc is None:
+                    acc = side_ns[pod.namespace] = [
+                        np.zeros(R, np.int64), 0,
+                    ]
+                acc[0] = acc[0] + qreq
+                acc[1] += 1
+            gang = pod.pod_group()
+            if gang:
+                acc = side_gang_acc(f"{pod.namespace}/{gang}")
+                acc[0] += 1
+                if held in cluster.nodes:
+                    acc[2] = acc[2] + req
         npad = self._npad
         alloc = np.zeros((npad, R), np.int64)
         capacity = np.zeros((npad, R), np.int64)
@@ -1041,24 +1568,48 @@ class StreamingServeEngine(ServeEngine):
         # usage-vector memo's identity check and evict the real pod's
         # entry on every verify)
         for pod in cluster.pods.values():
+            if pod.node_name is None:
+                if want_side:
+                    # the `gated_pods()` predicate, INDEPENDENT of a
+                    # permit reservation: a reserved gated pod counts
+                    # both gated (here) and assigned (the reserved
+                    # loop), exactly like the fresh snapshot and the
+                    # delta stream (`_scan_side_aggregates`)
+                    gang = pod.pod_group()
+                    if (
+                        gang and pod.scheduling_gated
+                        and not pod.terminating
+                    ):
+                        side_gang_acc(f"{pod.namespace}/{gang}")[1] += 1
+                continue
             i = node_pos.get(pod.node_name)
             if i is None:
+                if want_side:
+                    # bound to a node the store no longer has: still
+                    # counts into quota used + gang assigned (never
+                    # slack) — build_snapshot's rule
+                    req, _nz, _lim, qreq = self._pod_vectors(pod)
+                    side_assigned(pod, pod.node_name, req, qreq)
                 continue
-            req, nz, lim = self._usage_vectors(pod)
+            req, nz, lim, qreq = self._pod_vectors(pod)
             requested[i] += req
             nonzero[i] += nz
             limits[i] += lim
             pod_count[i] += 1
             if pod.terminating:
                 terminating[i] += 1
+            if want_side:
+                side_assigned(pod, pod.node_name, req, qreq)
         for uid, node in cluster.reserved.items():
             pod = cluster.pods.get(uid)
             if pod is None or pod.node_name is not None:
                 continue
+            req, nz, lim, qreq = self._pod_vectors(pod)
+            if want_side:
+                side_assigned(pod, node, req, qreq)
             i = node_pos.get(node)
             if i is None:
                 continue
-            req, nz, lim = self._usage_vectors(pod)
             requested[i] += req
             nonzero[i] += nz
             limits[i] += lim
@@ -1071,7 +1622,7 @@ class StreamingServeEngine(ServeEngine):
             "nonzero_requested": nonzero, "limits": limits,
             "mask": mask, "region": region, "zone": zone,
             "pod_count": pod_count, "terminating": terminating,
-        }
+        }, ((side_gangs, side_ns) if want_side else None)
 
     def _compact_row(self, name: str, slot: int) -> None:
         import warnings
@@ -1097,6 +1648,11 @@ class StreamingServeEngine(ServeEngine):
                 )
             self._names.pop(slot)
             self._slots = {n: i for i, n in enumerate(self._names)}
+            if self._gang_rows:
+                # fresh snapshots drop gang slack of pods bound to a
+                # deleted node — rebuild rather than drift (the base
+                # engine's rebase path rebuilds side tables implicitly)
+                self._side_dirty = True
             self.compactions += 1
             self._generation += 1
             self._staleness += 1
@@ -1117,6 +1673,29 @@ def compact_lower_args(n_nodes: int = 256, delete_slot: int = 3):
     snap, _meta = cluster.snapshot([], now_ms=0, pad_nodes=npad)
     idx, valid = _shift_gather_args(npad, delete_slot, n_nodes - 1)
     return D.node_compact_program(), (snap.nodes, idx, valid)
+
+
+def side_lower_args(n_gangs: int = 8, n_ns: int = 4, n_rows: int = 16):
+    """(jitted fn, sample args) for the AOT compile-readiness gate — the
+    exact donated side-table apply program `ServeEngine` folds gang/quota
+    aggregate deltas with (`tools/tpu_lower.py` serving_side_apply), at a
+    reduced resident shape. One constructor so the certified program and
+    the shipped program cannot drift."""
+    import jax.numpy as jnp
+
+    R = len(D.CANON_INDEX)
+    G = bucket_size(n_gangs)
+    Q = bucket_size(n_ns)
+    tables = D.zero_side_tables(G, Q, R)
+    gang_rows = [
+        (j % n_gangs, 1, 0, np.ones(R, np.int64)) for j in range(n_rows)
+    ]
+    ns_rows = [
+        (j % n_ns, np.ones(R, np.int64), 1) for j in range(n_rows)
+    ]
+    packed = D.SideDeltas.pack(gang_rows, ns_rows, R)
+    args = (tables, *(jnp.asarray(a) for a in packed.as_args()))
+    return D.side_apply_program(), args
 
 
 def lower_program_args(n_nodes: int = 256, n_upserts: int = 8,

@@ -321,6 +321,21 @@ class Cluster:
             return None
         return pod.node_name or self.reserved.get(pod.uid)
 
+    def _gang_gated_key(self, pod: Optional[Pod]) -> Optional[str]:
+        """The gang this pod counts into as an UNBOUND, scheduling-gated
+        member (the `gated_pods()` contribution to the snapshot's gang
+        gated/total counters), or None — the serving engine's resident
+        gang side table tracks transitions of this predicate
+        (serving.deltas.GANG_GATED)."""
+        if pod is None or pod.node_name is not None:
+            return None
+        if not pod.scheduling_gated or pod.terminating:
+            return None
+        name = pod.pod_group()
+        if not name:
+            return None
+        return f"{pod.namespace}/{name}"
+
     def add_pod(self, pod: Pod):
         old = self.pods.get(pod.uid)
         self.note_event(ev.POD_UPDATE if old is not None else ev.POD_ADD)
@@ -330,6 +345,15 @@ class Cluster:
             old_hold = self._held_node(old)
             if old_hold is not None:
                 self.delta_sink.pod_unassigned(old, old_hold)
+            # gated-gang-membership transition, captured at event time
+            # (the upsert replaces the object wholesale)
+            old_gated = self._gang_gated_key(old)
+            new_gated = self._gang_gated_key(pod)
+            if old_gated != new_gated:
+                if old_gated is not None:
+                    self.delta_sink.gang_gated(old_gated, -1)
+                if new_gated is not None:
+                    self.delta_sink.gang_gated(new_gated, +1)
         self.pods[pod.uid] = pod
         if self.delta_sink is not None:
             new_hold = self._held_node(pod)
@@ -365,6 +389,9 @@ class Cluster:
                     # bound pod's usage leaves with it (a reserved pod's
                     # hold was already released above)
                     self.delta_sink.pod_unassigned(pod, pod.node_name)
+                gated = self._gang_gated_key(pod)
+                if gated is not None:
+                    self.delta_sink.gang_gated(gated, -1)
                 self.delta_sink.forget_nomination(uid)
         if (
             pod is not None
@@ -386,7 +413,15 @@ class Cluster:
         if pod is None:
             return
         was_terminating = pod.terminating
+        # gated-gang contribution captured BEFORE the in-place flip (a
+        # terminating gated member leaves `gated_pods()`)
+        gated = (
+            self._gang_gated_key(pod)
+            if self.delta_sink is not None and not was_terminating else None
+        )
         pod.deletion_ms = now_ms
+        if gated is not None:
+            self.delta_sink.gang_gated(gated, -1)
         self._index_drop_pod(uid)
         self.note_event(ev.POD_UPDATE)
         if self.native is not None:
@@ -599,6 +634,11 @@ class Cluster:
                 if held is not None:
                     self.delta_sink.pod_unassigned(self.pods[uid], held)
                 self.delta_sink.pod_assigned(self.pods[uid], node_name)
+            # a (defensively possible) gated pod leaves `gated_pods()`
+            # the moment nodeName lands — its gang gated count drops
+            gated = self._gang_gated_key(self.pods[uid])
+            if gated is not None:
+                self.delta_sink.gang_gated(gated, -1)
             # bound pods never count toward the nominated column
             self.delta_sink.forget_nomination(uid)
         self.pods[uid].node_name = node_name

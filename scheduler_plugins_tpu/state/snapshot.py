@@ -475,6 +475,80 @@ def build_pod_state(
     )
 
 
+def gang_object_tables(pod_groups, gang_pos, index, G: int,
+                       backed_off_gangs) -> dict:
+    """The PodGroup-OBJECT-derived `GangState` columns (min_member,
+    creation, backoff, MinResources incl. the pods-slot MinMember
+    injection, mask) — THE one copy of this lowering, shared by
+    `build_snapshot` and the serving engine's resident side-table
+    assembly (`serving.engine.ServeEngine._assemble`), so the two paths
+    produce bit-identical object columns by construction. The per-pod
+    AGGREGATE columns (total/assigned/gated/cluster_slack) are the
+    caller's: the fresh path accumulates them from the pod population,
+    the serving engine from its O(changed) resident side tables."""
+    R = len(index)
+    pods_i = index.position(PODS)
+    backed_off = set(backed_off_gangs)
+    gang_min = np.ones(G, I32)
+    gang_minres = np.zeros((G, R), I64)
+    gang_has_minres = np.zeros(G, bool)
+    gang_created = np.zeros(G, I64)
+    gang_backoff = np.zeros(G, bool)
+    gang_mask = np.zeros(G, bool)
+    for pg in pod_groups:
+        g = gang_pos[pg.full_name]
+        gang_mask[g] = True
+        gang_min[g] = pg.min_member
+        gang_created[g] = pg.creation_ms
+        gang_backoff[g] = pg.full_name in backed_off
+        if pg.min_resources:
+            gang_minres[g] = index.encode(pg.min_resources)
+            gang_has_minres[g] = True
+            # MinResources demand includes a pods slot of MinMember
+            # (core.go:295-297 injects minResources[pods] = MinMember)
+            gang_minres[g, pods_i] = pg.min_member
+    return {
+        "min_member": gang_min,
+        "min_resources": gang_minres,
+        "has_min_resources": gang_has_minres,
+        "creation_ms": gang_created,
+        "backed_off": gang_backoff,
+        "mask": gang_mask,
+    }
+
+
+def quota_object_tables(quotas, index, ns_in: "_Interner", Q: int):
+    """The ElasticQuota-OBJECT-derived `QuotaState` columns (min, max,
+    has_quota) — one copy shared by `build_snapshot` and the serving
+    engine (same rationale as `gang_object_tables`). Callers must have
+    interned every quota namespace into `ns_in` already (the fresh
+    path's interning order: batch first, then quotas, then assigned)."""
+    R = len(index)
+    qmin = np.zeros((Q, R), I64)
+    qmax = np.full((Q, R), np.iinfo(I64).max, I64)
+    qhas = np.zeros(Q, bool)
+    for q in quotas:
+        nsi = ns_in.get(q.namespace)
+        qhas[nsi] = True
+        qmin[nsi] = index.encode(q.min)
+        # absent resources in Max are unbounded (UpperBound semantics,
+        # /root/reference/pkg/capacityscheduling/elasticquota.go:96-120)
+        qmax[nsi] = index.encode(q.max, default=np.iinfo(I64).max)
+    return qmin, qmax, qhas
+
+
+def empty_quota_nominees(R: int, P: int):
+    """The nominee-table defaults an empty nominated set produces
+    (M = 1 all-zero rows, batch_idx -1) — the serving engine's case by
+    construction: its compatibility gate excludes every nomination."""
+    return (
+        np.zeros((1, R), I64),
+        np.zeros((1, P), bool),
+        np.zeros((1, P), bool),
+        np.full(1, -1, I32),
+    )
+
+
 def build_snapshot(
     nodes: Sequence[Node],
     pending_pods: Sequence[Pod],
@@ -625,36 +699,16 @@ def build_snapshot(
     for pg in pod_groups:
         gang_pos[pg.full_name] = gangs_in.code(pg.full_name)
     G = max(len(gang_pos), 1)
-    gang_min = np.ones(G, I32)
+    obj = gang_object_tables(pod_groups, gang_pos, index, G,
+                             backed_off_gangs)
     gang_total = np.zeros(G, I32)
     gang_assigned = np.zeros(G, I32)
-    gang_minres = np.zeros((G, R), I64)
-    gang_has_minres = np.zeros(G, bool)
-    gang_created = np.zeros(G, I64)
-    gang_backoff = np.zeros(G, bool)
-    gang_mask = np.zeros(G, bool)
-    for pg in pod_groups:
-        g = gang_pos[pg.full_name]
-        gang_mask[g] = True
-        gang_min[g] = pg.min_member
-        gang_created[g] = pg.creation_ms
-        gang_backoff[g] = pg.full_name in backed_off_gangs
-        if pg.min_resources:
-            gang_minres[g] = index.encode(pg.min_resources)
-            gang_has_minres[g] = True
 
     def _gang_of(pod: Pod) -> int:
         name = pod.pod_group()
         if not name:
             return -1
         return gang_pos.get(f"{pod.namespace}/{name}", -1)
-
-    # MinResources demand includes a pods slot of MinMember
-    # (core.go:295-297 injects minResources[pods] = MinMember)
-    for pg in pod_groups:
-        g = gang_pos[pg.full_name]
-        if gang_has_minres[g]:
-            gang_minres[g, pods_i] = pg.min_member
 
     gang_gated = np.zeros(G, I32)
     # cluster_slack[g] = total demand of already-assigned members, added back
@@ -676,16 +730,11 @@ def build_snapshot(
 
     gang_state = (
         GangState(
-            min_member=gang_min,
             total_members=gang_total,
             assigned=gang_assigned,
             gated=gang_gated,
-            min_resources=gang_minres,
-            has_min_resources=gang_has_minres,
-            creation_ms=gang_created,
-            backed_off=gang_backoff,
             cluster_slack=gang_slack,
-            mask=gang_mask,
+            **obj,
         )
         if pod_groups
         else None
@@ -704,17 +753,8 @@ def build_snapshot(
         for pod in assigned_pods:
             ns_in.code(pod.namespace)
         Q = max(len(meta.namespaces), 1)
-        qmin = np.zeros((Q, R), I64)
-        qmax = np.full((Q, R), np.iinfo(I64).max, I64)
         qused = np.zeros((Q, R), I64)
-        qhas = np.zeros(Q, bool)
-        for q in quotas:
-            nsi = ns_in.get(q.namespace)
-            qhas[nsi] = True
-            qmin[nsi] = index.encode(q.min)
-            # absent resources in Max are unbounded (UpperBound semantics,
-            # /root/reference/pkg/capacityscheduling/elasticquota.go:96-120)
-            qmax[nsi] = index.encode(q.max, default=np.iinfo(I64).max)
+        qmin, qmax, qhas = quota_object_tables(quotas, index, ns_in, Q)
         for pod in assigned_pods:
             if pod.node_name is None:
                 continue

@@ -270,6 +270,12 @@ SERVE_PENDING_DELTAS = "scheduler_serve_pending_deltas"
 #: full re-snapshots the serving engine performed (node deletes, label
 #: re-interning, extended resources — docs/SERVING.md taxonomy)
 SERVE_REBASES = "scheduler_serve_rebases_total"
+#: serve refreshes that fell back to the full snapshot while the cluster
+#: carried PodGroups. Gang/quota rosters serve RESIDENT since ISSUE 12
+#: (gang/quota side tables), so on a compatible gang roster this stays 0
+#: — the production signal that the resident-gang win is actually
+#: engaged (`make endurance-smoke` gates it)
+SERVE_GANG_FALLBACKS = "scheduler_serve_gang_fallbacks_total"
 #: gauge (labels: objective): the latest cycle's placement-quality
 #: objective values (tuning.quality — fragmentation, util_imbalance,
 #: gang_wait_frac, unplaced_frac, preemptions, nominations), stamped by

@@ -522,9 +522,11 @@ class TestPipelinedCycleEquivalence:
     event stream must produce identical per-cycle placements
     (bound/reserved/failed/attribution, with conflict-fenced binds
     replayed as ordinary deltas) AND an identical final cluster state —
-    on a plain serve-mode roster and on a gang+quota roster (where the
-    serve engines run in permanent full-snapshot fallback). Shapes reuse
-    tests/test_serving's compile buckets."""
+    on a plain serve-mode roster and on a gang+quota roster (served
+    RESIDENT since ISSUE 12: the gang/quota side tables keep both
+    engines off the full-snapshot fallback, and the equivalence must
+    hold through them). Shapes reuse tests/test_serving's compile
+    buckets."""
 
     def _run_plain(self, pipelined):
         from scheduler_plugins_tpu.framework import run_cycle
@@ -1117,3 +1119,42 @@ class TestRankGangDifferential:
                 got.sum(axis=1)
                 == np.minimum(n_release, live.sum(axis=1))
             ).all()
+
+
+class TestWaveGangDifferential:
+    """ISSUE 12: the wave-batched gang solve (`gangs.waves`) must be
+    BIT-IDENTICAL to the numpy sequential twin — and therefore to the
+    sequential jit scan it parity-anchors — on every output
+    (rank_nodes, admitted, placed_new, AND the final free/eq_used
+    carries), across seeds and wave widths (width 2 forces many waves,
+    so between-wave host carries and the conflicted-lane host-resolve
+    path both exercise), with the independent replay oracle proving the
+    hard constraints. Problems reuse `TestRankGangDifferential`'s
+    generator seeds, so the two gang differentials share shapes."""
+
+    def test_wave_matches_twin_and_oracle_across_seeds(self):
+        from scheduler_plugins_tpu.gangs.topology import gang_solve_np
+        from scheduler_plugins_tpu.gangs.waves import wave_gang_solve
+
+        base = TestRankGangDifferential()
+        names = ("rank_nodes", "admitted", "placed_new", "free", "eq_used")
+        for seed in range(3):
+            gangs, free0, eq_used0, node_mask = base._random_problem(
+                1000 + seed
+            )
+            ref = gang_solve_np(gangs, free0, eq_used0, node_mask)
+            for wave in (2, 64):
+                stats: dict = {}
+                out = wave_gang_solve(
+                    gangs, free0, eq_used0, node_mask, wave=wave,
+                    stats=stats,
+                )
+                for got, want, name in zip(out, ref, names):
+                    assert (np.asarray(got) == np.asarray(want)).all(), (
+                        f"seed {seed} wave {wave}: {name} diverged from "
+                        "the sequential twin"
+                    )
+                assert stats["waves"] >= 1
+            base._replay_oracle(
+                gangs, free0, eq_used0, node_mask, out[0], out[1], out[2]
+            )

@@ -307,9 +307,32 @@ class TestGangPhaseCycle:
         }
         assert place_a == place_b
 
+    def test_wave_mode_places_identically_with_zero_drift(self):
+        """ISSUE 12: a `GangPhase(wave=True)` cycle — the wave-batched
+        solve — binds the SAME placements as the sequential phase, and
+        with `check_twin` the numpy twin cross-check reports drift 0.0
+        on the real cycle (the bit-identity claim, at phase level)."""
+        a = self._arm()
+        b_cluster, b_sched, _ = self._arm()
+        run_cycle(a[1], a[0], now=10_000, gangs=a[2])
+        wave_phase = GangPhase(check_twin=True, wave=True, wave_width=4)
+        run_cycle(b_sched, b_cluster, now=10_000, gangs=wave_phase)
+        place_a = {
+            u: p.node_name for u, p in a[0].pods.items() if p.node_name
+        }
+        place_b = {
+            u: p.node_name for u, p in b_cluster.pods.items() if p.node_name
+        }
+        assert place_a == place_b
+        assert wave_phase.max_drift == 0.0
+
 
 class TestServingSeam:
-    def test_gang_roster_degrades_to_fallback_and_recovers(self):
+    def test_gang_roster_serves_resident(self):
+        """ISSUE 12: a gang/quota roster no longer degrades the serving
+        engine to the O(cluster) full-snapshot fallback — the resident
+        gang/quota side tables own it (zero `gang_fallbacks`), and the
+        per-gang resident-rank mirror stays maintained O(changed)."""
         from scheduler_plugins_tpu.serving import ServeEngine
 
         cluster = rank_gang_scenario(
@@ -323,19 +346,42 @@ class TestServingSeam:
             scheduler, cluster, now=10_000, serve=engine, gangs=phase
         )
         assert report.bound  # the gang placed
-        # gang-carrying roster: the engine must FALL BACK, not mis-serve
-        assert engine.gang_fallbacks >= 1
+        # the roster is compatible: every refresh serves resident
+        assert engine.gang_fallbacks == 0
+        # the resident-served gang problem places IDENTICALLY to the
+        # fresh-snapshot phase (the O(changed) lowering changes WHERE
+        # the inputs come from, never what the solve decides)
+        control = rank_gang_scenario(
+            seed=0, n_nodes=8, n_regions=1, zones_per_region=2, n_mpi=1,
+            mpi_ranks=3, n_dl=0,
+        )
+        control_report = run_cycle(
+            Scheduler(Profile(plugins=[NodeResourcesAllocatable()])),
+            control, now=10_000, gangs=GangPhase(),
+        )
+        assert report.bound == control_report.bound
         # ...while absorbing the binds into the resident-rank mirror
         gang_name = next(iter(cluster.pod_groups))
-        engine.refresh(cluster, [], now_ms=20_000)  # drain
+        refreshed = engine.refresh(cluster, [], now_ms=20_000)  # drain
+        assert refreshed is not None, "gang roster fell back"
         assert gang_name in engine.resident_ranks
         assert set(engine.resident_ranks[gang_name]) == set(report.bound)
         # a member delete leaves the mirror O(changed)
         victim = next(iter(report.bound))
         cluster.remove_pod(victim)
-        engine.refresh(cluster, [], now_ms=30_000)
+        assert engine.refresh(cluster, [], now_ms=30_000) is not None
         assert victim not in engine.resident_ranks.get(gang_name, {})
-        # gangs drained away -> serving resumes (no side tables left)
+        # a still-gating side table (an NRT) forces the fallback AND
+        # counts it as a gang fallback while PodGroups exist
+        from scheduler_plugins_tpu.api.objects import (
+            NodeResourceTopology,
+        )
+
+        cluster.add_nrt(NodeResourceTopology(node_name="n000", zones=[]))
+        assert engine.refresh(cluster, [], now_ms=40_000) is None
+        assert engine.gang_fallbacks == 1
+        cluster.remove_nrt("n000")
+        # gangs drained away -> plain serving continues
         for uid in list(cluster.pods):
             cluster.remove_pod(uid)
         for name in list(cluster.pod_groups):

@@ -392,7 +392,7 @@ class TestStreamingServeEngine:
         c.reserve(list(c.pending_pods())[0].uid, "n1") \
             if c.pending_pods() else None
         c.mark_terminating("default/b3", 1500)
-        expected = engine._expected_columns(c, list(c.nodes))
+        expected, _side = engine._expected_columns(c, list(c.nodes))
         fresh, _meta = c.snapshot([], now_ms=0, pad_nodes=engine.npad)
         for key, arr in expected.items():
             ref = np.asarray(getattr(fresh.nodes, key))
@@ -453,13 +453,14 @@ class TestStreamingServeEngine:
     def test_usage_vector_memo_invalidates_on_new_pod_object(self):
         c, engine = self._churny(n_nodes=2, n_bound=0)
         pod = mkpod("x", cpu=700)
-        v1 = engine._usage_vectors(pod)
-        assert engine._usage_vectors(pod)[0] is v1[0]  # memo hit
+        v1 = engine._pod_vectors(pod)
+        assert engine._pod_vectors(pod)[0] is v1[0]  # memo hit
         replacement = mkpod("x", cpu=900)  # same uid, new object
-        v2 = engine._usage_vectors(replacement)
+        v2 = engine._pod_vectors(replacement)
         assert v2[0][0] == 900
+        assert v2[3][0] == 900  # the quota vector rides the same memo
         # final release drops the entry
-        engine._usage_vectors(replacement, final=True)
+        engine._pod_vectors(replacement, final=True)
         assert "default/x" not in engine._vec_cache
 
 

@@ -295,9 +295,11 @@ class TestServeEdgePaths:
         assert_resident_matches(engine, cluster, 2000)
 
     def test_side_table_fallback_absorbs_deltas(self):
-        """While a side-table object (quota) disqualifies serve mode, the
-        cycle falls back to full snapshots but the resident columns keep
-        absorbing deltas — serving resumes WITHOUT a rebase."""
+        """While a still-gating side table (node metrics) disqualifies
+        serve mode, the cycle falls back to full snapshots but the
+        resident columns keep absorbing deltas — serving resumes WITHOUT
+        a rebase. (Gang/quota rosters no longer gate — ISSUE 12's
+        resident side tables own them; see TestResidentGangQuota.)"""
         cluster = make_cluster(6)
         engine = ServeEngine().attach(cluster)
         sched = make_scheduler()
@@ -305,17 +307,14 @@ class TestServeEdgePaths:
         run_cycle(sched, cluster, now=1000, serve=engine)
         assert engine.resident_nodes is not None
         rebases0 = obs.metrics.get(obs.SERVE_REBASES)
-        cluster.add_quota(ElasticQuota(
-            name="eq", namespace="team",
-            min={CPU: 1000}, max={CPU: 2000},
-        ))
+        cluster.node_metrics = {"n000": {"cpu_avg": 50.0}}
+        assert not engine.compatible(cluster, [])
         for cycle in range(3):
             now = 2000 + 1000 * cycle
             cluster.add_pod(make_pod(cycle + 1, now))
             report = run_cycle(sched, cluster, now=now, serve=engine)
             assert report.bound  # fallback cycles still place
-        if cluster.quotas.pop("team", None):
-            cluster.note_event(ev.ELASTIC_QUOTA_DELETE)
+        cluster.node_metrics = None
         assert obs.metrics.get(obs.SERVE_REBASES) == rebases0
         assert_resident_matches(engine, cluster, 9000)
 
@@ -515,3 +514,274 @@ class TestServeFlightRecorder:
         deltas = flightrec.unpack_pytree(spec, cycles[1]._blobs_for(spec))
         assert set(deltas) == {"upserts", "usage"}
         assert deltas["usage"]["idx"].ndim == 1
+
+
+class TestResidentGangQuota:
+    """ISSUE 12: gang/quota rosters serve RESIDENT. Randomized event
+    streams (gang arrivals with gated members, quota-scoped churn,
+    elastic member deletes) must keep (a) serve-vs-baseline placements
+    identical cycle for cycle, (b) the engine-assembled GangState/
+    QuotaState tensors BIT-EQUAL to a fresh `cluster.snapshot`'s, and
+    (c) the engine off the fallback path entirely (zero gang
+    fallbacks)."""
+
+    @staticmethod
+    def _gang_quota_cluster():
+        from scheduler_plugins_tpu.api.objects import ElasticQuota
+
+        cluster = make_cluster(6)
+        cluster.add_quota(ElasticQuota(
+            name="eq", namespace="team",
+            min={CPU: 24_000, MEMORY: 96 * gib},
+            max={CPU: 48_000, MEMORY: 160 * gib},
+        ))
+        return cluster
+
+    @staticmethod
+    def _gang_sched():
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+        )
+
+        return Scheduler(Profile(plugins=[
+            NodeResourcesAllocatable(),
+            Coscheduling(permit_waiting_seconds=5),
+            CapacityScheduling(),
+        ]))
+
+    def _assert_side_tables_match(self, engine, cluster, now):
+        """Engine-assembled snapshot vs a fresh one: every gang/quota
+        tensor bit-equal (the namespace-interning tail rows are
+        all-default, so tensor equality is exact, not just semantic)."""
+        import dataclasses
+
+        pend = cluster.pending_pods()
+        refreshed = engine.refresh(cluster, pend, now_ms=now)
+        assert refreshed is not None, "gang/quota roster fell back"
+        snap, meta = refreshed
+        fsnap, fmeta = cluster.snapshot(
+            pend, now_ms=now, pad_nodes=engine.npad
+        )
+        assert fmeta.gang_names == meta.gang_names
+        assert set(fmeta.namespaces) == set(meta.namespaces)
+        for fam in ("gangs", "quota"):
+            mine, fresh = getattr(snap, fam), getattr(fsnap, fam)
+            assert (mine is None) == (fresh is None), fam
+            if mine is None:
+                continue
+            for f in dataclasses.fields(mine):
+                got = np.asarray(getattr(mine, f.name))
+                want = np.asarray(getattr(fresh, f.name))
+                assert got.shape == want.shape, (fam, f.name)
+                np.testing.assert_array_equal(
+                    got, want, err_msg=f"{fam}.{f.name}"
+                )
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_randomized_gang_quota_streams(self, seed):
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL,
+            PodGroup,
+        )
+
+        rng = np.random.default_rng(100 + seed)
+        serve_cluster = self._gang_quota_cluster()
+        base_cluster = self._gang_quota_cluster()
+        engine = ServeEngine().attach(serve_cluster)
+        s_sched, b_sched = self._gang_sched(), self._gang_sched()
+
+        def team_pod(serial, now, cpu, mem_gib, gang=None, gated=False):
+            pod = Pod(
+                name=f"tp{serial:04d}", namespace="team",
+                creation_ms=now + serial,
+                labels={POD_GROUP_LABEL: gang} if gang else {},
+                containers=[Container(
+                    requests={CPU: cpu, MEMORY: mem_gib * gib}
+                )],
+            )
+            pod.scheduling_gated = gated
+            return pod
+
+        serial = 0
+        for cycle in range(8):
+            now = 1000 * (cycle + 1)
+            events = []
+            for _ in range(int(rng.integers(0, 4))):
+                serial += 1
+                events.append(("pod", serial, int(rng.integers(200, 2500)),
+                               int(rng.integers(1, 4))))
+            if cycle % 3 == 1:
+                events.append(("gang", cycle, int(rng.integers(2, 4))))
+            if cycle % 4 == 2:
+                serial += 1
+                events.append(("gated", serial, f"g{cycle - 1}"))
+            bound = sorted(
+                uid for uid, p in serve_cluster.pods.items()
+                if p.node_name is not None
+            )
+            for _ in range(int(rng.integers(0, 2))):
+                if bound:
+                    events.append((
+                        "del", bound.pop(int(rng.integers(0, len(bound))))
+                    ))
+            for cl in (serve_cluster, base_cluster):
+                for e in events:
+                    if e[0] == "pod":
+                        cl.add_pod(team_pod(e[1], now, e[2], e[3]))
+                    elif e[0] == "gang":
+                        gname = f"g{e[1]}"
+                        cl.add_pod_group(PodGroup(
+                            name=gname, namespace="team",
+                            min_member=e[2], creation_ms=now,
+                        ))
+                        for m in range(e[2] + 1):
+                            cl.add_pod(Pod(
+                                name=f"{gname}-m{m}", namespace="team",
+                                creation_ms=now + m,
+                                labels={POD_GROUP_LABEL: gname},
+                                containers=[Container(requests={
+                                    CPU: 1200, MEMORY: 2 * gib,
+                                })],
+                            ))
+                    elif e[0] == "gated":
+                        cl.add_pod(team_pod(
+                            e[1], now, 500, 1, gang=e[2], gated=True
+                        ))
+                    elif e[0] == "del":
+                        cl.remove_pod(e[1])
+            serve_report = run_cycle(
+                s_sched, serve_cluster, now=now, serve=engine
+            )
+            base_report = run_cycle(b_sched, base_cluster, now=now)
+            assert serve_report.bound == base_report.bound
+            assert serve_report.failed == base_report.failed
+            assert serve_report.reserved == base_report.reserved
+            assert serve_report.rejected_gangs == base_report.rejected_gangs
+            self._assert_side_tables_match(engine, serve_cluster, now + 500)
+        assert engine.gang_fallbacks == 0
+        assert_resident_matches(engine, serve_cluster, 20_000)
+
+    def test_side_table_anti_entropy_detects_dropped_gang_delta(self):
+        """A gang delta that never reaches the side tables (simulated
+        in-place corruption) must be caught by the side-table verify and
+        healed by the rebase it forces — the node-column anti-entropy
+        discipline, extended to the gang/quota aggregates."""
+        import jax.numpy as jnp
+
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL,
+            PodGroup,
+        )
+
+        cluster = self._gang_quota_cluster()
+        engine = ServeEngine().attach(cluster)
+        sched = self._gang_sched()
+        cluster.add_pod_group(PodGroup(
+            name="g0", namespace="team", min_member=2, creation_ms=100,
+        ))
+        for m in range(3):
+            cluster.add_pod(Pod(
+                name=f"g0-m{m}", namespace="team", creation_ms=100 + m,
+                labels={POD_GROUP_LABEL: "g0"},
+                containers=[Container(
+                    requests={CPU: 1000, MEMORY: 2 * gib}
+                )],
+            ))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        assert engine.refresh(cluster, [], now_ms=1500) is not None
+        # corrupt the resident gang-assigned counter in place
+        engine._side = engine._side.replace(
+            gang_assigned=engine._side.gang_assigned.at[0].add(jnp.int32(1))
+        )
+        assert engine._verify_side(cluster) == "side-gang"
+        divergences0 = engine.antientropy_divergences
+        engine.note_fault("test-side-corruption")
+        assert engine.refresh(cluster, [], now_ms=2000) is not None
+        assert engine.antientropy_divergences == divergences0 + 1
+        # the forced rebase healed the tables
+        assert engine._verify_side(cluster) is None
+        self._assert_side_tables_match(engine, cluster, 2500)
+
+    def test_reserved_gated_gang_member_counts_both_ways(self):
+        """Review regression: a permit-RESERVED gang member that is also
+        scheduling-gated counts TWICE in a fresh snapshot — assigned via
+        its materialized reserved copy AND gated via the real unbound
+        object in `gated_pods()` — and the delta stream mirrors that
+        (POD_ASSIGN at reserve + GANG_GATED at upsert). The anti-entropy
+        scans must use the same double-count, or a clean resident state
+        reads as a spurious 'side-gang' divergence and the post-rebase
+        rebuild bakes the undercount into every later GangState."""
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL,
+            PodGroup,
+        )
+
+        cluster = self._gang_quota_cluster()
+        engine = ServeEngine().attach(cluster)
+        sched = self._gang_sched()
+        cluster.add_pod_group(PodGroup(
+            name="rg", namespace="team", min_member=1, creation_ms=1,
+        ))
+        cluster.add_pod(Pod(
+            name="rg-m0", namespace="team", creation_ms=2,
+            labels={POD_GROUP_LABEL: "rg"},
+            containers=[Container(requests={CPU: 800, MEMORY: gib})],
+        ))
+        run_cycle(sched, cluster, now=1000, serve=engine)
+        gated = Pod(
+            name="rg-held", namespace="team", creation_ms=3,
+            labels={POD_GROUP_LABEL: "rg"},
+            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+        )
+        gated.scheduling_gated = True
+        cluster.add_pod(gated)          # GANG_GATED +1
+        cluster.reserve(gated.uid, "n001")  # POD_ASSIGN (held capacity)
+        assert engine.refresh(cluster, [], now_ms=2000) is not None
+        # the delta-maintained tables hold assigned=2 (bound member +
+        # reserved hold), gated=1 — the scan-based verify must agree
+        assert engine._verify_side(cluster) is None, (
+            "clean reserved+gated state read as divergence"
+        )
+        self._assert_side_tables_match(engine, cluster, 2500)
+
+    def test_gang_fallback_metric_decision_table(self):
+        """`scheduler_serve_gang_fallbacks_total` decision table: a
+        compatible gang roster serves resident (counter unchanged), a
+        still-gating side table (NRT) while gangs exist counts one
+        fallback per refresh AND exports on the prometheus surface."""
+        from scheduler_plugins_tpu.api.objects import (
+            POD_GROUP_LABEL,
+            NodeResourceTopology,
+            PodGroup,
+        )
+
+        cluster = make_cluster(4)
+        engine = ServeEngine().attach(cluster)
+        sched = make_scheduler()
+        counter0 = obs.metrics.get(obs.SERVE_GANG_FALLBACKS) or 0
+        cluster.add_pod_group(PodGroup(
+            name="pg", namespace="default", min_member=1, creation_ms=1,
+        ))
+        cluster.add_pod(Pod(
+            name="pg-m0", creation_ms=2,
+            labels={POD_GROUP_LABEL: "pg"},
+            containers=[Container(requests={CPU: 500, MEMORY: gib})],
+        ))
+        report = run_cycle(sched, cluster, now=1000, serve=engine)
+        assert report.bound
+        assert engine.gang_fallbacks == 0
+        assert (obs.metrics.get(obs.SERVE_GANG_FALLBACKS) or 0) == counter0
+        # an NRT gates the engine; with PodGroups present that is a gang
+        # fallback, counted and exported
+        cluster.add_nrt(NodeResourceTopology(node_name="n000", zones=[]))
+        assert engine.refresh(cluster, [], now_ms=2000) is None
+        assert engine.gang_fallbacks == 1
+        assert obs.metrics.get(obs.SERVE_GANG_FALLBACKS) == counter0 + 1
+        text = obs.metrics.prometheus_text()
+        assert "scheduler_serve_gang_fallbacks_total" in text
+        # without PodGroups the same incompatibility is NOT a gang
+        # fallback
+        cluster.pod_groups.clear()
+        assert engine.refresh(cluster, [], now_ms=3000) is None
+        assert engine.gang_fallbacks == 1

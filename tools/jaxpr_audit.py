@@ -142,6 +142,26 @@ ROLE_OVERRIDES = {
     # bypasses the rank-assignment carry (the state arg keeps its
     # type-derived "state" role)
     "rank_gang_solve": ("snap.ranks", "state", "snap.nodes.mask"),
+    # wave_solve_body(gangs, free, eq_used, node_mask, ids): ONE wave of
+    # the wave-batched gang solve — the per-gang body vmapped over a
+    # lane of gang ids against the wave-start state. There is no
+    # SolverState arg BY DESIGN: the free/eq/rank carries live host-side
+    # between waves (the validator commits accepted lanes exactly), so
+    # the wave-start state is labeled state.* (it IS the live carry, not
+    # a static snapshot) and the gang tensors snap.ranks
+    "wave_gang_solve": (
+        "snap.ranks", "state.free", "state.eq_used", "snap.nodes.mask",
+        "wave.ids",
+    ),
+    # apply_side_deltas(tables, <4 gang cols>, <3 ns cols>): the
+    # SideTables argument is the donated RESIDENT gang/quota aggregate
+    # carry (the serving engine's cycle-to-cycle thread), same labeling
+    # rationale as serving_delta_apply
+    "serving_side_apply": (
+        "state.side",
+        "sd.g_idx", "sd.g_assigned", "sd.g_gated", "sd.g_slack",
+        "sd.q_idx", "sd.q_used", "sd.q_count",
+    ),
     # shrink_select(rank_nodes, live, node_block, block_cost, n_release):
     # rank_nodes is the RESIDENT rank-assignment carry (the elastic delta
     # program mutates resident state, not a snapshot); the release count

@@ -488,6 +488,42 @@ def build_elastic_shrink():
     return jax.jit(shrink_select), args, None
 
 
+def build_serving_side_apply():
+    """`serving.deltas.side_apply_program` — the donated O(changed)
+    scatter-apply maintaining the resident gang/quota side tables
+    (`serving.engine.ServeEngine._apply_side`; ISSUE 12), at the reduced
+    shape `serving.engine.side_lower_args` builds. Same donated-carry
+    calling convention as serving_delta_apply."""
+    from scheduler_plugins_tpu.serving.engine import side_lower_args
+
+    fn, args = side_lower_args()
+    return fn, args, None
+
+
+def build_wave_gang_solve():
+    """`gangs.waves.wave_solve_body` — one wave of the wave-batched gang
+    solve: the sequential scan's own per-gang body
+    (`gangs.topology.place_gang_one`) vmapped over a lane of independent
+    gang ids against the wave-start state (the host validator owns the
+    between-wave carries). Lowered at the reduced `_gang_problem` shape
+    with an 8-lane wave."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_plugins_tpu.gangs.waves import wave_solve_fn
+
+    prob = _gang_problem()
+    gangs = jax.tree.map(jnp.asarray, prob["gangs"])
+    G = prob["gangs"].rank_mask.shape[0]
+    ids = jnp.asarray((np.arange(8) % G).astype(np.int32))
+    args = (
+        gangs, jnp.asarray(prob["free0"]), jnp.asarray(prob["eq_used0"]),
+        jnp.asarray(prob["node_mask"]), ids,
+    )
+    return wave_solve_fn(), args, None
+
+
 def build_sweep_solve():
     """The vmapped counterfactual weight sweep (`parallel.solver
     .sweep_solve_fn` — the tuning observatory's hot program): the
@@ -525,7 +561,9 @@ PROGRAMS = {
     "sharded_wave_chunk": build_sharded_wave_chunk,
     "sweep_solve": build_sweep_solve,
     "rank_gang_solve": build_rank_gang_solve,
+    "wave_gang_solve": build_wave_gang_solve,
     "elastic_shrink": build_elastic_shrink,
+    "serving_side_apply": build_serving_side_apply,
     "bench_cfg0_tpu_smoke": build_cfg0_tpu_smoke,
     "bench_cfg1_flagship": build_cfg1_flagship,
     "bench_cfg2_trimaran_sequential": build_cfg2_trimaran_sequential,
