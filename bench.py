@@ -18,6 +18,7 @@ import json
 import subprocess
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -193,6 +194,44 @@ def _device_attribution():
     except Exception:
         devices = None
     return {"devices": devices, "mesh_shape": None}
+
+
+def _pallas_attribution():
+    """The `pallas` block stamped on every config-8 / shard-smoke /
+    pallas-smoke JSON line next to `backend_probe` (ISSUE 13): `enabled`
+    is whether a solver built by THIS process resolves to the ring
+    kernels (`SPT_PALLAS` opt-in AND not sanitize mode), `interpret`
+    whether the kernels run as their CPU twins (None when disabled), and
+    `kernels` the short StableHLO digests of the committed pallas program
+    entries from docs/tpu_lowering.json (None when the manifest or its
+    pallas entries are absent) — so a bench line is attributable to the
+    exact certified kernel programs without reading the manifest."""
+    try:
+        from scheduler_plugins_tpu.parallel import kernels as pk
+        from scheduler_plugins_tpu.utils import sanitize
+
+        # mirror sharded_wave_solve's build-time gate (opt-in AND not
+        # sanitize mode): the stamp must report what a solver built by
+        # THIS process actually resolves, never the raw env var — under
+        # SPT_SANITIZE=1 the lax build runs even with SPT_PALLAS=1
+        enabled = pk.pallas_enabled() and not sanitize.enabled()
+        interpret = pk.pallas_interpret() if enabled else None
+    except Exception:
+        enabled, interpret = False, None
+    digests = None
+    try:
+        manifest = json.loads(
+            (Path(__file__).resolve().parent / "docs" / "tpu_lowering.json")
+            .read_text()
+        )
+        digests = {
+            name: prog["sha256"][:12]
+            for name, prog in manifest.get("programs", {}).items()
+            if "pallas" in name
+        } or None
+    except Exception:
+        digests = None
+    return {"enabled": enabled, "interpret": interpret, "kernels": digests}
 
 
 def _quality_cycle(snap, assignment, wait=None):
@@ -897,6 +936,7 @@ def mega(shape=None, emit=True):
             float(np.percentile(pod_latency_s, 99)) * 1000, 1),
     }
     line["quality"] = quality
+    line["pallas"] = _pallas_attribution()
     if emit:
         _emit(
             CONFIG_METRICS[8],
@@ -947,8 +987,13 @@ def shard_smoke():
         problem["raw"], problem["free0"], problem["node_mask"], S
     )
     chunk = shape["chunk"]
+    # use_pallas pinned False: this gate's budget bounds the LAX psum/pmin
+    # formulation — an ambient SPT_PALLAS=1 must not swap the censused
+    # program (the pallas census has its own gate, pallas_smoke)
     census = collective_census(
-        sharded_wave_chunk_solver(mesh, shape["n_nodes"], rescue_window=256),
+        sharded_wave_chunk_solver(
+            mesh, shape["n_nodes"], rescue_window=256, use_pallas=False
+        ),
         node_ids, problem["req"][:chunk], problem["mask"][:chunk], rank_free,
     )
     gathers = sum(
@@ -971,12 +1016,116 @@ def shard_smoke():
     print(json.dumps({
         "metric": "shard_smoke",
         "backend": _backend_label(),
+        "pallas": _pallas_attribution(),
         "collectives": census,
         "collective_total": total,
         "collective_budget": budget,
         "full_axis_gathers": gathers,
         "ok": bool(ok),
         **line,
+    }))
+    return 0 if ok else 1
+
+
+def pallas_smoke():
+    """CI gate (`make pallas-smoke`, ISSUE 13): the Pallas-election sharded
+    wave solve (interpret-mode CPU twins — `SPT_PALLAS=1`'s off-TPU build)
+    must match the lax-collectives build BIT-EXACTLY on the reduced
+    SHARD_SMOKE_SHAPE chunk stream: placements, the final resident
+    rank-free carry, and a clean replayed capacity audit. The traced
+    pallas program's census must show the collectives actually replaced —
+    ring kernels present, ZERO framework psum/pmin/ppermute left in the
+    wave bodies, zero full-axis gathers — and the kernel programs must be
+    covered by the committed lowering manifest. One JSON line; rc 1 on
+    any failure."""
+    shape = SHARD_SMOKE_SHAPE
+    _force_host_mesh(shape["devices"])
+    import jax
+
+    from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
+    from scheduler_plugins_tpu.parallel.solver import (
+        collective_census,
+        rank_order_inputs,
+        sharded_wave_chunk_solver,
+    )
+
+    S = shape["devices"]
+    chunk = shape["chunk"]
+    problem = mega_problem(shape["n_nodes"], shape["n_pods"], shape["chunk"])
+    mesh = make_node_mesh(S)
+    node_ids, rank_free0 = rank_order_inputs(
+        problem["raw"], problem["free0"], problem["node_mask"], S
+    )
+    carry_host = np.asarray(rank_free0)
+
+    def run_arm(use_pallas):
+        solver = sharded_wave_chunk_solver(
+            mesh, shape["n_nodes"], rescue_window=256,
+            use_pallas=use_pallas, pallas_interpret=True,
+        )
+        rank_free = jax.numpy.asarray(carry_host)
+        parts = []
+        # warmup/compile on the first chunk (its own donated carry)
+        out0, _ = solver(
+            node_ids, problem["req"][:chunk], problem["mask"][:chunk],
+            jax.numpy.asarray(carry_host),
+        )
+        np.asarray(out0[0])
+        start = time.perf_counter()
+        for lo in range(0, problem["padded"], chunk):
+            (a, _stats), rank_free = solver(
+                node_ids, problem["req"][lo:lo + chunk],
+                problem["mask"][lo:lo + chunk], rank_free,
+            )
+            parts.append(np.asarray(a))
+        elapsed = time.perf_counter() - start
+        return (
+            np.concatenate(parts)[: problem["n_pods"]],
+            np.asarray(rank_free), elapsed, solver,
+        )
+
+    a_lax, f_lax, t_lax, _ = run_arm(False)
+    a_pk, f_pk, t_pk, solver_pk = run_arm(True)
+
+    census = collective_census(
+        solver_pk, node_ids, problem["req"][:chunk],
+        problem["mask"][:chunk], jax.numpy.asarray(carry_host),
+    )
+    gathers = sum(
+        census.get(k, 0)
+        for k in ("all_gather", "all_gather_invariant", "all_to_all")
+    )
+    framework_left = sum(
+        census.get(k, 0) for k in ("psum", "pmin", "pmax", "ppermute")
+    )
+    pallas = _pallas_attribution()
+    manifest_covers = bool(pallas["kernels"]) and {
+        "pallas_ring_offsets", "pallas_fused_election",
+        "sharded_wave_chunk_pallas",
+    } <= set(pallas["kernels"])
+    match = bool((a_pk == a_lax).all())
+    carry_match = bool((f_pk == f_lax).all())
+    violations = _mega_capacity_violations(problem, a_pk)
+    ok = (
+        match and carry_match and violations == 0
+        and census.get("pallas_call", 0) > 0
+        and framework_left == 0 and gathers == 0
+        and manifest_covers
+    )
+    print(json.dumps({
+        "metric": "pallas_smoke",
+        "backend": _backend_label(),
+        "pallas": {**pallas, "enabled": True, "interpret": True},
+        "placements_match": match,
+        "carry_match": carry_match,
+        "capacity_violations": violations,
+        "collectives": census,
+        "framework_collectives_left": framework_left,
+        "full_axis_gathers": gathers,
+        "manifest_covers_kernels": manifest_covers,
+        "pods_per_sec": round(problem["n_pods"] / t_pk, 1),
+        "vs_lax_collectives": round(t_lax / t_pk, 2),
+        "ok": bool(ok),
     }))
     return 0 if ok else 1
 
@@ -3120,6 +3269,14 @@ if __name__ == "__main__":
                              "path bit-exactly, the capacity audit is "
                              "clean, and the program's collective census "
                              "stays O(shards) with zero all_gathers")
+    parser.add_argument("--pallas-smoke", action="store_true",
+                        help="CI gate: the Pallas-election sharded wave "
+                             "solve (interpret twins) bit-identical to "
+                             "the lax collectives build on the reduced "
+                             "mega shape — placements + resident carry + "
+                             "clean capacity audit, ring kernels present "
+                             "with zero framework collectives left and "
+                             "manifest-covered kernel programs")
     parser.add_argument("--churn-smoke", action="store_true",
                         help="CI gate: reduced sustained-churn run; fails "
                              "unless the resident-state delta path beats "
@@ -3162,6 +3319,10 @@ if __name__ == "__main__":
         # sharded-vs-single-device parity + collective census, not a
         # timing run against history — no tunnel probe
         sys.exit(shard_smoke())
+    if args.pallas_smoke:
+        # CPU-host-mesh CI gate: pallas-vs-lax build comparison on the
+        # same tensors in one process — no tunnel probe
+        sys.exit(pallas_smoke())
     if args.config == 8:
         # host-mesh scaling bench BY POLICY while the axon tunnel is down
         # (docs/SCALING.md evidence policy; the compile-readiness
@@ -3240,6 +3401,9 @@ if __name__ == "__main__":
             replay.setdefault("devices", None)
             replay.setdefault("mesh_shape", None)
             replay.setdefault("quality", None)
+            # like backend_probe below: describes THIS run's pallas state,
+            # not the capture's — keeps the replayed line schema-complete
+            replay.setdefault("pallas", _pallas_attribution())
             replay.update({
                 "stale_capture": True,
                 "captured_unix": captured,
@@ -3260,6 +3424,7 @@ if __name__ == "__main__":
             "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
             "vs_baseline": 0.0, "devices": None, "mesh_shape": None,
             "drift": None, "quality": None,
+            "pallas": _pallas_attribution(),
             "error": "tpu-backend-unavailable",
             "backend_probe": diagnosis,
             "detail": f"{diagnosis['kind']}: {diagnosis['detail']}",

@@ -807,7 +807,9 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
                                max_waves: int = 8,
                                rescue_window: int = 512,
                                lite_window: int = 1024,
-                               collect_stats: bool = False):
+                               collect_stats: bool = False,
+                               use_pallas: bool = False,
+                               pallas_interpret: bool = True):
     """Shard-local body of `waterfill_assign_targeted` — runs INSIDE a
     `shard_map` with the NODE axis sharded over `axis_name` (S = `n_shards`
     shards). The node axis arrives in GLOBAL SCORE-RANK ORDER (the caller
@@ -858,6 +860,25 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
     probes against rank N-1. Returns (assignment (P,) original node
     indices, replicated; rank_free (BS, R); stats dict when
     `collect_stats`).
+
+    Under `use_pallas` (the `SPT_PALLAS=1` opt-in, ISSUE 13) every
+    cross-shard exchange runs as a `parallel.kernels` Pallas ring program
+    instead of a framework collective: `ring_offsets_*` replaces
+    `block_exclusive_offsets`, `elect_min` the bucket-position `pmin`, and
+    `fused_election` folds the min-rank champion reduction AND the
+    admission-verdict resolution into ONE kernel — the winning shard
+    attaches its node id and pre-wave free row to the election payload, so
+    the queue-order admission check runs REPLICATED on every shard
+    (`_admission_replicated`) and the packed verdict `psum` disappears. A
+    rescue wave then costs 2 fused collective programs and a lite wave 3,
+    versus the 3/3 framework collectives of the lax formulation.
+    Placements are bit-identical either way (same elections, same f64
+    admission sums — the kernels move exact-integer limbs); call sites
+    whose padded payload would exceed the kernel VMEM envelope
+    (`kernels.PALLAS_MAX_ELECTION_ELEMS` — the mega whole-queue wave)
+    statically keep the lax collectives. `pallas_interpret` selects the
+    CPU interpret twins (the CI/differential path) versus the compiled
+    on-chip kernels.
     """
     P, R = req.shape
     BS = rank_free.shape[0]
@@ -868,18 +889,66 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
 
     LITE_PROBES = 4
 
+    pk = None
+    if use_pallas and n_shards > 1:
+        from scheduler_plugins_tpu.parallel import kernels as pk  # noqa: N813
+
+    #: payload rows of one fused election: winner node id + the winner's
+    #: free-capacity row as exact base-2^18 limbs
+    PAYLOAD_ROWS = 1 + (pk.N_LIMBS * R if pk is not None else 0)
+
+    def pallas_wave(W: int) -> bool:
+        """Static per-call-site gate: this window's elections ride the
+        Pallas kernels only when every buffer fits the VMEM envelope —
+        otherwise the wave keeps the lax collectives (bit-identical)."""
+        return (
+            pk is not None
+            and pk.fits_election_budget(1 + PAYLOAD_ROWS, W)
+            and pk.fits_election_budget(R, W)
+        )
+
+    def winner_payload(prop_rank, free_l):
+        """(1 + 3R, W) int32 payload for the shard's own proposal
+        `prop_rank` (global rank in MY block, or >= N): node id + 1 and
+        my pre-wave free row for that rank as limbs; zeros when not
+        proposing (the sentinel key ties everywhere with zero payload)."""
+        local = prop_rank - block_start
+        has = (local >= 0) & (local < BS) & (prop_rank < N)
+        safe = jnp.clip(local, 0, BS - 1)
+        nid = jnp.where(has, node_ids[safe].astype(jnp.int32) + 1, 0)
+        row = jnp.where(has[:, None], free_l[safe], 0)  # (W, R) int64
+        limb_rows = pk.split_limbs(row).transpose(0, 2, 1).reshape(
+            pk.N_LIMBS * R, -1
+        )
+        return jnp.concatenate([nid[None, :], limb_rows], axis=0)
+
+    def unpack_payload(rows):
+        """(nid (W,) int32, win_row (W, R) float64) from the elected
+        payload — the winner's free row recombines exactly (limb sums are
+        selected, not summed, so each limb is still < 2^18)."""
+        nid = rows[0]
+        limbs = rows[1:].reshape(pk.N_LIMBS, R, -1).transpose(0, 2, 1)
+        return nid, pk.join_limbs(limbs)
+
     def lite_choice(free_l, idx, valid, dem_w):
         """Cumulative-demand bucket targets + next-fit probes, elected
         across shards: per-resource global bucket position = pmin over the
         shards' local searchsorted candidates (exact — the global cumfree
         is nondecreasing, so the first covering index lives in exactly one
         shard), then the first fitting probe = min fitting rank."""
+        W = idx.shape[0]
         cumfree_l = jnp.cumsum(
             jnp.clip(free_l, 0, None).astype(jnp.float64), axis=0
         )  # (BS, R) local inclusive
-        base, _ = block_exclusive_offsets(
-            cumfree_l[-1], axis_name, n_shards
-        )  # (R,)
+        if pallas_wave(W):
+            base, _ = pk.ring_offsets_f64(
+                cumfree_l[-1], axis_name, n_shards,
+                interpret=pallas_interpret,
+            )
+        else:
+            base, _ = block_exclusive_offsets(
+                cumfree_l[-1], axis_name, n_shards
+            )  # (R,)
         abs_cf = cumfree_l + base[None, :]
         cumdem = jnp.cumsum(dem_w.astype(jnp.float64), axis=0)  # (W, R)
         loc = jax.vmap(
@@ -887,7 +956,16 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
             in_axes=(1, 1), out_axes=1,
         )(abs_cf, cumdem)  # (W, R) local positions
         cand = jnp.where(loc < BS, block_start + loc, N)
-        pos = jnp.max(jax.lax.pmin(cand, axis_name), axis=1)  # (W,) global
+        if pallas_wave(W):
+            pos = jnp.max(
+                pk.elect_min(
+                    cand.T.astype(jnp.int32), axis_name, n_shards,
+                    interpret=pallas_interpret,
+                ),
+                axis=0,
+            )  # (W,) global
+        else:
+            pos = jnp.max(jax.lax.pmin(cand, axis_name), axis=1)  # (W,)
         ranks = jnp.minimum(
             pos[None, :] + jnp.arange(LITE_PROBES)[:, None], n_real - 1
         )  # (LP, W) — saturate at the worst REAL rank, never the padding
@@ -901,14 +979,22 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
         # probe order; equal only when clamped to the same node): each
         # shard proposes its min fitting OWNED rank, pmin elects — a (W,)
         # champion reduction instead of a (LP, W) verdict exchange
-        fit_rank = jax.lax.pmin(
-            jnp.min(jnp.where(fit_l, ranks, N), axis=0), axis_name
-        )  # (W,)
+        prop = jnp.min(jnp.where(fit_l, ranks, N), axis=0)  # (W,) mine
+        if pallas_wave(W):
+            fit_rank, pay = pk.fused_election(
+                prop.astype(jnp.int32), winner_payload(prop, free_l),
+                axis_name, n_shards, interpret=pallas_interpret,
+            )
+            choice = jnp.where(
+                valid & (fit_rank < N), fit_rank.astype(jnp.int32), -1
+            )
+            return choice, jnp.zeros(W, bool), unpack_payload(pay)
+        fit_rank = jax.lax.pmin(prop, axis_name)  # (W,)
         choice = jnp.where(
             valid & (fit_rank < N), fit_rank.astype(jnp.int32), -1
         )
         # lite misses prove nothing about true feasibility: no hopeless delta
-        return choice, jnp.zeros(idx.shape[0], bool)
+        return choice, jnp.zeros(idx.shape[0], bool), None
 
     def rescue_choice(free_l, idx, valid, dem_w):
         """Dense rescue wave, sharded: each shard counts its local feasible
@@ -921,10 +1007,15 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
             dem_w[:, None, :] <= free_l[None, :, :], axis=2
         ) & valid[:, None]  # (W, BS)
         counts_l = feasible_l.sum(axis=1, dtype=jnp.int32)  # (W,)
-        base_l, total = block_exclusive_offsets(
-            counts_l, axis_name, n_shards
-        )  # (W,) each — ONE collective serves both the round-robin offsets
-        # and the global feasible totals
+        if pallas_wave(W):
+            base_l, total = pk.ring_offsets_i32(
+                counts_l, axis_name, n_shards, interpret=pallas_interpret,
+            )
+        else:
+            base_l, total = block_exclusive_offsets(
+                counts_l, axis_name, n_shards
+            )  # (W,) each — ONE collective serves both the round-robin
+            # offsets and the global feasible totals
         k = jnp.where(total > 0, jnp.arange(W) % jnp.maximum(total, 1), 0)
         k_local = (k - base_l).astype(jnp.int32)
         c_l = jnp.cumsum(feasible_l.astype(jnp.int32), axis=1)  # (W, BS)
@@ -935,6 +1026,20 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
         cand = jnp.where(
             mine & valid & (total > 0), block_start + locpos, N
         )
+        if pallas_wave(W):
+            # whenever total > 0 some shard proposes the k-th feasible
+            # rank (k < total), so the elected rank is always a REAL
+            # feasible node and the n_real clamp below is a no-op there —
+            # the payload (proposer's node id + free row) stays consistent
+            rank, pay = pk.fused_election(
+                cand.astype(jnp.int32), winner_payload(cand, free_l),
+                axis_name, n_shards, interpret=pallas_interpret,
+            )
+            choice = jnp.where(
+                valid & (total > 0),
+                jnp.minimum(rank, n_real - 1).astype(jnp.int32), -1,
+            )
+            return choice, valid & (total == 0), unpack_payload(pay)
         rank = jax.lax.pmin(cand, axis_name)  # (W,)
         choice = jnp.where(
             valid & (total > 0),
@@ -942,7 +1047,25 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
         )
         # window pods with NO feasible node anywhere retire as hopeless
         # (free only shrinks within a solve, so the verdict cannot go stale)
-        return choice, valid & (total == 0)
+        return choice, valid & (total == 0), None
+
+    def _admission_segments(choice, dem_w):
+        """The ONE copy of the queue-order admission sort/segment math
+        both formulations below share — lax-vs-pallas bit-identity rests
+        on these staying byte-equivalent, so neither path may inline its
+        own: (order, seg, within) where `order` is the stable
+        choice-then-queue-position sort, `seg` the sorted chosen ranks
+        (N for unchosen), and `within` the inclusive per-segment f64
+        demand prefix."""
+        W = choice.shape[0]
+        seg_choice = jnp.where(choice >= 0, choice, N)
+        order = jnp.argsort(
+            seg_choice.astype(jnp.int64) * W + jnp.arange(W)
+        )
+        seg = seg_choice[order]
+        first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
+        within = _segment_prefix(dem_w[order].astype(jnp.float64), first)
+        return order, seg, within
 
     def queue_admission_local(choice, dem_w, free_l):
         """`_queue_order_admission_choice` with the free rows sharded: the
@@ -952,47 +1075,60 @@ def waterfill_targeted_sharded(rank_free, node_ids, req, pod_mask,
         permutation — the wave ORs the verdicts across shards in the same
         psum that elects the winner node ids (each chosen rank is owned by
         exactly one shard, so a sum is an OR)."""
-        W = choice.shape[0]
-        seg_choice = jnp.where(choice >= 0, choice, N)
-        order = jnp.argsort(
-            seg_choice.astype(jnp.int64) * W + jnp.arange(W)
-        )
-        seg = seg_choice[order]
-        first = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
-        dem_sorted = dem_w[order].astype(jnp.float64)
-        within = _segment_prefix(dem_sorted, first)  # inclusive per-segment
+        order, seg, within = _admission_segments(choice, dem_w)
         local = seg - block_start
         mine = (local >= 0) & (local < BS) & (seg < N)
         free_row = free_l[jnp.clip(local, 0, BS - 1)].astype(jnp.float64)
         ok_l = mine & jnp.all(within <= free_row, axis=1)
         return ok_l, order
 
+    def _admission_replicated(choice, dem_w, win_row):
+        """`queue_admission_local` + verdict psum collapsed to REPLICATED
+        math (the pallas path): the winner's pre-wave free row arrived
+        with the election payload, so every shard evaluates the same
+        sorted-segment prefix check against the same f64 rows — identical
+        verdicts to the owner-checks-then-psum formulation, zero
+        collectives."""
+        Wn = choice.shape[0]
+        order, seg, within = _admission_segments(choice, dem_w)
+        ok_sorted = (seg < N) & jnp.all(within <= win_row[order], axis=1)
+        return (choice >= 0) & jnp.zeros(Wn, bool).at[order].set(ok_sorted)
+
     def wave(free_l, assignment, hopeless, W, choice_fn):
         idx, valid, dem_w = _straggler_window(
             demand, pod_mask, assignment, hopeless, W
         )
-        choice, hopeless_w = choice_fn(free_l, idx, valid, dem_w)
-        ok_l, order = queue_admission_local(choice, dem_w, free_l)
-        # rank -> original node id: the owning shard contributes id+1 for
-        # its owned CHOICES (independent of admission, so it packs into
-        # the same collective; -1 padding rows can never be chosen, so
-        # id+1 >= 1 on every elected winner)
+        choice, hopeless_w, payload = choice_fn(free_l, idx, valid, dem_w)
+        Wn = choice.shape[0]
         local = choice - block_start
         own = (choice >= 0) & (local >= 0) & (local < BS)
-        nid_l = jnp.where(
-            own, node_ids[jnp.clip(local, 0, BS - 1)].astype(jnp.int32) + 1, 0
-        )
-        # ONE barrier elects admission verdicts (sorted order) AND winner
-        # node ids (window order): psum is elementwise, the two rows just
-        # ride together
-        packed = jax.lax.psum(
-            jnp.stack([ok_l.astype(jnp.int32), nid_l]), axis_name
-        )
-        Wn = choice.shape[0]
-        admitted = (choice >= 0) & jnp.zeros(Wn, bool).at[order].set(
-            packed[0] > 0
-        )
-        nid = packed[1]  # (W,) node_id + 1, replicated
+        if payload is not None:
+            # pallas path: the fused election already delivered the
+            # winner's node id and free row — admission is replicated
+            # math, no further collective this wave
+            nid, win_row = payload
+            admitted = _admission_replicated(choice, dem_w, win_row)
+        else:
+            ok_l, order = queue_admission_local(choice, dem_w, free_l)
+            # rank -> original node id: the owning shard contributes id+1
+            # for its owned CHOICES (independent of admission, so it packs
+            # into the same collective; -1 padding rows can never be
+            # chosen, so id+1 >= 1 on every elected winner)
+            nid_l = jnp.where(
+                own,
+                node_ids[jnp.clip(local, 0, BS - 1)].astype(jnp.int32) + 1,
+                0,
+            )
+            # ONE barrier elects admission verdicts (sorted order) AND
+            # winner node ids (window order): psum is elementwise, the two
+            # rows just ride together
+            packed = jax.lax.psum(
+                jnp.stack([ok_l.astype(jnp.int32), nid_l]), axis_name
+            )
+            admitted = (choice >= 0) & jnp.zeros(Wn, bool).at[order].set(
+                packed[0] > 0
+            )
+            nid = packed[1]  # (W,) node_id + 1, replicated
         ownc = admitted & own
         safe_idx = jnp.minimum(idx, P - 1)
         placed_plus = jnp.zeros(P, jnp.int32).at[safe_idx].add(

@@ -982,7 +982,9 @@ def rank_order_inputs(raw_scores, free0, node_mask, n_shards: int):
 def sharded_wave_chunk_solver(mesh, n_nodes: int, max_waves: int = 8,
                               rescue_window: int = 512,
                               lite_window: int = 1024,
-                              collect_stats: bool = True):
+                              collect_stats: bool = True,
+                              use_pallas: bool | None = None,
+                              pallas_interpret: bool | None = None):
     """The sharded wave chunk program: `ops.assign.waterfill_targeted_sharded`
     wrapped in a `shard_map` over `mesh`'s "nodes" axis and jitted with the
     resident rank-ordered free carry DONATED — the pipeline calling
@@ -999,16 +1001,30 @@ def sharded_wave_chunk_solver(mesh, n_nodes: int, max_waves: int = 8,
     cross-shard traffic is O(shards) ring/psum collectives (see the body's
     docstring). Placements are bit-identical to the single-device
     `waterfill_assign_targeted` chunk program at any shard count (below
-    the documented 2^53 cumulative-capacity bound)."""
+    the documented 2^53 cumulative-capacity bound).
+
+    `use_pallas`/`pallas_interpret` (None = resolve from `SPT_PALLAS` /
+    the backend via `parallel.kernels`) swap the per-wave framework
+    collectives for the Pallas ring kernels — bit-identical placements,
+    gated by tests/test_differential.py and `make pallas-smoke`."""
     from functools import partial
 
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from scheduler_plugins_tpu.ops.assign import waterfill_targeted_sharded
+    from scheduler_plugins_tpu.parallel import kernels as pk
     from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS
     from scheduler_plugins_tpu.parallel.pipeline import donated_chunk_solver
+    from scheduler_plugins_tpu.utils import sanitize
 
+    if use_pallas is None:
+        # checkify cannot instrument pallas_call bodies — the sanitizer
+        # gate keeps certifying the lax formulation, which is placement-
+        # identical by the differential gates
+        use_pallas = pk.pallas_enabled() and not sanitize.enabled()
+    if pallas_interpret is None:
+        pallas_interpret = pk.pallas_interpret()
     n_shards = mesh.shape[NODES_AXIS]
     body = partial(
         waterfill_targeted_sharded,
@@ -1016,6 +1032,7 @@ def sharded_wave_chunk_solver(mesh, n_nodes: int, max_waves: int = 8,
         max_waves=max_waves,
         rescue_window=rescue_window, lite_window=lite_window,
         collect_stats=collect_stats,
+        use_pallas=use_pallas, pallas_interpret=pallas_interpret,
     )
     stats_spec = ({"occupancy": P(), "waves": P()},) if collect_stats else ()
     sharded_body = shard_map(
@@ -1061,9 +1078,18 @@ def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
     quorum) hold exactly at every shard count; placements are bit-
     identical to the single-device wave path below the 2^53 cumulative-
     capacity bound (tests/test_shard_wave.py + tests/test_differential.py
-    gate both). Returns (assignment, admitted, wait[, stats])."""
-    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS, ambient_mesh
+    gate both). Returns (assignment, admitted, wait[, stats]).
 
+    Under `SPT_PALLAS=1` the wave elections run as the `parallel.kernels`
+    Pallas ring programs (interpret twins off-TPU) — resolved HERE so the
+    solver cache key carries the mode and an env toggle never reuses a
+    differently-built program."""
+    from scheduler_plugins_tpu.parallel import kernels as pk
+    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS, ambient_mesh
+    from scheduler_plugins_tpu.utils import sanitize
+
+    use_pallas = pk.pallas_enabled() and not sanitize.enabled()
+    pallas_interpret = pk.pallas_interpret()
     free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
     admitted = batch_admission(snap, free0)
     raw = demote_scores_int32(
@@ -1081,12 +1107,13 @@ def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
     # per call would recompile the whole multi-device program on every
     # solve of the same shapes
     key = (mesh, free0.shape[0], chunk, max_waves, rescue_window,
-           collect_stats)
+           collect_stats, use_pallas, pallas_interpret)
     solve_chunk = _WAVE_SOLVER_CACHE.get(key)
     if solve_chunk is None:
         solve_chunk = _WAVE_SOLVER_CACHE[key] = sharded_wave_chunk_solver(
             mesh, free0.shape[0], max_waves=max_waves,
             rescue_window=rescue_window, collect_stats=collect_stats,
+            use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         )
     tracing = obs.tracer.enabled
     if tracing:
@@ -1147,21 +1174,26 @@ def sharded_wave_solve(snap, mesh, weights, chunk: int | None = None,
 #: cross-shard collective primitives the census tracks; `all_gather` /
 #: `all_to_all` should NEVER appear in the sharded wave program (the ring
 #: election's silent degradation mode — graft_lint GL009 is the source-level
-#: twin of this jaxpr-level check)
+#: twin of this jaxpr-level check). `pallas_call` marks one fused ring
+#: kernel program (the SPT_PALLAS path); `dma_start` equations inside its
+#: body are the neighbor transfers — S-1 per ring, so the census stays the
+#: per-wave O(shards) traffic bound in both formulations
 COLLECTIVE_PRIMS = frozenset({
     "psum", "pmin", "pmax", "ppermute", "all_gather", "all_gather_invariant",
-    "all_to_all",
+    "all_to_all", "pallas_call", "dma_start",
 })
 
 
 def collective_census(fn, *args):
     """{collective primitive: equation count} over the traced `fn(*args)`
     jaxpr, recursing through every sub-jaxpr (pjit/shard_map/while/scan/
-    cond). Because the wave loops are `lax.while_loop`s, each wave BODY
-    appears exactly once in the jaxpr — so the static census directly
-    bounds the PER-WAVE collective count, independent of how many waves a
-    solve actually runs: the shard-smoke gate asserts it stays O(shards)
-    and that no full-axis gather ever appears."""
+    cond — and `pallas_call` kernel bodies, whose `dma_start` equations
+    are the ring's neighbor transfers). Because the wave loops are
+    `lax.while_loop`s, each wave BODY appears exactly once in the jaxpr —
+    so the static census directly bounds the PER-WAVE collective count,
+    independent of how many waves a solve actually runs: the shard-smoke
+    gate asserts it stays O(shards) and that no full-axis gather ever
+    appears."""
     from jax import core
 
     closed = jax.make_jaxpr(fn)(*args)

@@ -1,0 +1,72 @@
+"""Golden-bad fixture for GL011: host callbacks, wall-clock reads, and
+Python branching on traced refs inside `pallas_call` kernel bodies. The
+static-closure branch and the helper outside any kernel must stay clean."""
+
+import functools
+import time
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def callback_kernel(x_ref, out_ref):
+    jax.experimental.io_callback(print, None, x_ref[...])  # BAD: host call
+    out_ref[...] = x_ref[...]
+
+
+def timing_kernel(x_ref, out_ref):
+    start = time.perf_counter()  # BAD: staged-once baked constant
+    out_ref[...] = x_ref[...]
+    _ = start
+
+
+def branching_kernel(x_ref, out_ref):
+    if x_ref[0] > 0:  # BAD: python branch on a traced ref value
+        out_ref[...] = x_ref[...]
+    else:
+        out_ref[...] = -x_ref[...]
+
+
+def run(x, n_steps):
+    def static_branch_kernel(x_ref, out_ref):
+        acc = x_ref[...]
+        if n_steps > 1:  # fine: static closure config
+            acc = acc * 2
+        out_ref[...] = acc
+
+    shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    x = pl.pallas_call(callback_kernel, out_shape=shape)(x)
+    x = pl.pallas_call(timing_kernel, out_shape=shape)(x)
+    x = pl.pallas_call(branching_kernel, out_shape=shape)(x)
+    x = pl.pallas_call(static_branch_kernel, out_shape=shape)(x)
+    return x
+
+
+def run_partial(x, scale):
+    def scaled_kernel(s, x_ref, out_ref):
+        if x_ref[0] > s:  # BAD: branch on ref, reached through partial
+            out_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        functools.partial(scaled_kernel, scale),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def run_partial_static(x, n_steps):
+    def stepped_kernel(n, x_ref, out_ref):
+        acc = x_ref[...]
+        if n > 1:  # fine: n is partial-bound static config, not a ref
+            acc = acc * 2
+        out_ref[...] = acc
+
+    return pl.pallas_call(
+        functools.partial(stepped_kernel, n_steps),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def host_helper_is_fine(x):
+    if x > 0:  # fine: not a kernel body
+        time.perf_counter()
+    return x

@@ -30,6 +30,7 @@ class TestGoldenBad:
             ("bad_jit_walltime.py", "GL008"),
             ("bad_all_gather.py", "GL009"),
             ("bad_swallow.py", "GL010"),
+            ("bad_pallas_kernel.py", "GL011"),
         ],
     )
     def test_flagged(self, fixture, rule):
@@ -45,6 +46,17 @@ class TestGoldenBad:
         # record-and-reroute handler must stay clean
         assert len(findings) == 3
         assert rules_for(FIXTURES / "bad_swallow.py") == {"GL010"}
+
+    def test_pallas_kernel_fixture_flags_only_kernel_bodies(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_pallas_kernel.py"])
+            if f.rule == "GL011"
+        ]
+        # io_callback, time.perf_counter, the ref branch, and the ref
+        # branch reached through functools.partial — the static-closure
+        # branch and the host helper outside any kernel stay clean
+        assert len(findings) == 4
+        assert rules_for(FIXTURES / "bad_pallas_kernel.py") == {"GL011"}
 
     def test_all_gather_fixture_flags_only_node_axis_sites(self):
         findings = [

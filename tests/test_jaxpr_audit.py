@@ -109,6 +109,20 @@ class TestCleanPrograms:
         res = audit_program(name)
         assert res["rules"] == {r: 0 for r in RULES}, res["violations"]
 
+    def test_pallas_kernel_body_census_recorded(self):
+        # ISSUE-13: pallas_call bodies are walked (JA rules see inside)
+        # and their primitive census is the manifest's jaxpr-level
+        # evidence for the opaque tpu_custom_call payloads: the 8-shard
+        # ring must show S-1 = 7 dma_start steps, the per-step neighbor
+        # barrier (get_barrier_semaphore + 2 signals/step), and zero rule
+        # violations through the kernel bodies
+        res = audit_program("pallas_ring_offsets")
+        assert res["rules"] == {r: 0 for r in RULES}, res["violations"]
+        kern = res["pallas_kernels"]
+        assert kern.get("dma_start") == 7
+        assert kern.get("semaphore_signal") == 14
+        assert kern.get("get_barrier_semaphore") == 1
+
 
 class TestManifest:
     def test_manifest_covers_all_programs_clean(self):

@@ -158,3 +158,10 @@ class TestCurrentTree:
         # under the gate (its collectives must keep lowering for TPU)
         assert "sharded_wave_chunk" in names
         assert "entry" in names
+        # ISSUE-13: the Pallas ring kernels and the full pallas-election
+        # chunk solver must keep AOT-lowering (the tpu-first-cycle gate
+        # checks exactly these three against the committed manifest)
+        assert {
+            "pallas_ring_offsets", "pallas_fused_election",
+            "sharded_wave_chunk_pallas",
+        } <= names

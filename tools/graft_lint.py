@@ -57,6 +57,20 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   to a full gather. The shard-smoke gate's jaxpr collective census is the
   compiled-level twin.
 
+- **GL011 pallas-kernel-purity** — inside a `pallas_call` kernel body: no
+  host callbacks (`io_callback` / `pure_callback` / `debug_callback`), no
+  wall-clock reads (`time.*`), and no Python `if`/`while` branching on the
+  kernel's ref/traced parameters. A Pallas body is staged ONCE by Mosaic:
+  host calls cannot cross the kernel boundary at all, a clock read is a
+  baked constant (GL008's rule, one level deeper), and a Python branch on
+  a ref value either fails to trace or silently bakes one path. Branch on
+  STATIC closure config (shard counts, interpret flags) instead and mask
+  traced conditions with `jnp.where`/`pl.when`. Detection is lexical and
+  conservative: a function counts as a kernel body when its name is the
+  first argument of a `pallas_call(...)` call (directly or through
+  `functools.partial`); helpers it delegates to are trusted, like GL006's
+  helper blindness.
+
 - **GL010 swallowed-exception** — no broad exception handler (bare
   ``except:``, ``Exception``, ``BaseException``) whose body is only
   ``pass``/``...``: around solve/ingest sites that is how a backend
@@ -960,6 +974,112 @@ def check_node_axis_all_gather(path, tree, findings):
         ))
 
 
+#: host-callback callables that can never appear inside a Pallas kernel
+#: body (the kernel is staged by Mosaic; there is no host to call back to)
+_HOST_CALLBACK_NAMES = frozenset({
+    "io_callback", "pure_callback", "debug_callback", "callback",
+})
+
+
+def _pallas_kernel_fns(tree):
+    """(FunctionDef, n_bound, kw_bound) triples for defs whose NAME is
+    passed as the first argument of a `pallas_call(...)` call — directly
+    or through `functools.partial(name, ...)`. `n_bound`/`kw_bound` are
+    the leading positional count and keyword names `partial` statically
+    binds (minimum / intersection across references when a name is used
+    more than once): those parameters hold compile-time Python config,
+    not traced refs, so GL011's branch check must not fire on them. Name
+    resolution is module-wide and conservative: every def sharing a
+    referenced name is treated as a kernel body (nested `def kernel(...)`
+    closures are the repo idiom, `parallel/kernels.py`)."""
+    refs: dict = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _callee_name(node.func) == "pallas_call"
+                and node.args):
+            continue
+        first = node.args[0]
+        n_bound, kw_bound = 0, frozenset()
+        if isinstance(first, ast.Call) and _callee_name(
+            first.func
+        ) == "partial" and first.args:
+            n_bound = len(first.args) - 1
+            kw_bound = frozenset(
+                kw.arg for kw in first.keywords if kw.arg
+            )
+            first = first.args[0]
+        if isinstance(first, ast.Name):
+            prev = refs.get(first.id)
+            refs[first.id] = (
+                (n_bound, kw_bound) if prev is None
+                else (min(prev[0], n_bound), prev[1] & kw_bound)
+            )
+    if not refs:
+        return []
+    return [
+        (fn,) + refs[fn.name] for fn in ast.walk(tree)
+        if isinstance(fn, ast.FunctionDef) and fn.name in refs
+    ]
+
+
+def check_pallas_kernel_purity(path, tree, findings):
+    """GL011: host callbacks, wall-clock reads, and Python branching on
+    traced ref parameters inside `pallas_call` kernel bodies."""
+    for fn, n_bound, kw_bound in _pallas_kernel_fns(tree):
+        positional = [
+            a.arg for a in fn.args.posonlyargs + fn.args.args
+        ]
+        # partial-bound leading positionals / keywords are static Python
+        # config (the sanctioned "branch on static closure config" shape)
+        params = set(positional[n_bound:]) - kw_bound
+        params.update(
+            a.arg for a in fn.args.kwonlyargs if a.arg not in kw_bound
+        )
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+
+        def reads_param(expr):
+            return any(
+                isinstance(n, ast.Name) and n.id in params
+                for n in ast.walk(expr)
+            )
+
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                name = _callee_name(sub.func)
+                if name in _HOST_CALLBACK_NAMES:
+                    findings.append(Finding(
+                        path, sub, "GL011",
+                        f"host callback {name}() inside a pallas_call "
+                        "kernel body: the kernel is staged by Mosaic — "
+                        "there is no host to call back to; move the "
+                        "callback outside the kernel",
+                    ))
+                elif (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in WALL_CLOCK_ATTRS
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "time"):
+                    findings.append(Finding(
+                        path, sub, "GL011",
+                        f"time.{sub.func.attr}() inside a pallas_call "
+                        "kernel body: the body is staged once, so this is "
+                        "a baked constant (GL008 one level deeper) — time "
+                        "kernels by bracketing host-sync transfers "
+                        "outside the program",
+                    ))
+            elif isinstance(sub, (ast.If, ast.While)) and reads_param(
+                sub.test
+            ):
+                findings.append(Finding(
+                    path, sub, "GL011",
+                    "Python branching on a kernel ref/traced parameter "
+                    "inside a pallas_call body: the branch is resolved at "
+                    "staging time (wrong or untraceable) — branch on "
+                    "static closure config, or mask with jnp.where / "
+                    "pl.when",
+                ))
+
+
 def check_swallowed_exception(path, tree, findings):
     """GL010: a broad exception handler (bare ``except:``, ``except
     Exception``, ``except BaseException``) whose body is only
@@ -1036,6 +1156,7 @@ def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str
     check_donated_reuse(rel, tree, findings)
     check_node_axis_all_gather(rel, tree, findings)
     check_swallowed_exception(rel, tree, findings)
+    check_pallas_kernel_purity(rel, tree, findings)
     if not config_owner:
         check_config_update(rel, tree, findings)
     return findings, tree, source

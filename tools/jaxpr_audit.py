@@ -39,6 +39,14 @@ Rules:
   programs: `io_callback(ordered=False)` and debug-print callbacks. Solve
   programs must be replayable; unordered host effects are not.
 
+`pallas_call` equations (the ISSUE 13 ring kernels) are first-class:
+input taints flow onto the kernel body's input refs (output/scratch refs
+enter untainted), the body jaxpr is walked under every JA rule like any
+other sub-jaxpr, and a per-program KERNEL-BODY OP CENSUS (dma_start/
+dma_wait/semaphore ops and the body arithmetic) is recorded in the
+manifest — the jaxpr-level twin of the StableHLO manifest, whose
+`tpu_custom_call` payload is opaque to the text scan.
+
 A manifest (`docs/jaxpr_audit.json`: per-program rule verdicts +
 provenance-tagged equation counts) is committed so program drift shows up
 as a diff; `--check` is the read-only fail-closed CI gate (missing manifest
@@ -127,6 +135,11 @@ ROLE_OVERRIDES = {
     # rank-ordered free block is the donated RESIDENT carry threading
     # chunk to chunk on device (the sharded analog of cfg6's state.free)
     "sharded_wave_chunk": (
+        "node_ids", "snap.pods.req", "snap.pods.mask", "state.free",
+    ),
+    # same program with the SPT_PALLAS election path: identical calling
+    # convention, the collectives are pallas_call ring kernels
+    "sharded_wave_chunk_pallas": (
         "node_ids", "snap.pods.req", "snap.pods.mask", "state.free",
     ),
     # sweep(snap, state0, auxes, W): the (K, L) candidate weight matrix
@@ -251,6 +264,9 @@ class Auditor:
     def __init__(self):
         self.violations: list[dict] = []
         self.op_counts: Counter = Counter()
+        #: primitive census over pallas_call KERNEL BODIES only (the
+        #: manifest's jaxpr-level evidence for the opaque Mosaic payloads)
+        self.pallas_ops: Counter = Counter()
         self.eqn_count = 0
         self._scanned: set[int] = set()  # eqn ids already rule-checked
         self._seen_sites: set = set()    # violation dedup across revisits
@@ -339,6 +355,8 @@ class Auditor:
             return self._while(eqn, ts)
         if name == "cond":
             return self._cond(eqn, ts)
+        if name == "pallas_call":
+            return self._pallas(eqn, ts)
         # generic primitive (or unknown higher-order op): every output
         # carries the union of input taints; unknown sub-jaxprs are still
         # rule-scanned with that coarse union
@@ -387,6 +405,35 @@ class Auditor:
             carry = new_carry
         # trip count is control-dependence: outputs inherit the predicate
         return [c | pred for c in carry]
+
+    def _pallas(self, eqn, ts):
+        """pallas_call: the body jaxpr's invars are [input refs..., output
+        refs..., scratch refs...] — input taints map 1:1 onto the leading
+        refs (provenance "through the grid"), outputs/scratch enter
+        untainted. The body is rule-walked like any sub-jaxpr, its
+        primitive names censused into `pallas_ops`, and the equation's
+        outputs carry the union of input taints (the kernel writes its
+        output refs from the inputs; finer ref-dataflow is deliberately
+        coarse-but-sound, like `_fallback`)."""
+        from jax import core
+
+        sub = eqn.params.get("jaxpr")
+        if sub is None:
+            return self._fallback(eqn, ts)
+        sub_jaxpr = getattr(sub, "jaxpr", sub)
+        if id(eqn) not in self._scanned:
+
+            def census(j):
+                for e in j.eqns:
+                    self.pallas_ops[e.primitive.name] += 1
+                    for s in core.jaxprs_in_params(e.params):
+                        census(getattr(s, "jaxpr", s))
+
+            census(sub_jaxpr)
+        taints = list(ts) + [_EMPTY] * (len(sub_jaxpr.invars) - len(ts))
+        self.propagate(sub_jaxpr, taints[: len(sub_jaxpr.invars)])
+        union = frozenset().union(*ts) if ts else _EMPTY
+        return [union for _ in eqn.outvars]
 
     def _cond(self, eqn, ts):
         pred, oper = ts[0], ts[1:]
@@ -556,6 +603,12 @@ def audit_fn(fn, args, roles=None, mesh=None) -> dict:
         "provenance_ops": {
             k: auditor.op_counts[k] for k in sorted(auditor.op_counts)
         },
+        # kernel-body primitive census over pallas_call equations ({} for
+        # programs without kernels): the committed jaxpr-level evidence
+        # for what lives inside the opaque tpu_custom_call payloads
+        "pallas_kernels": {
+            k: auditor.pallas_ops[k] for k in sorted(auditor.pallas_ops)
+        },
         "output_provenance": classify(out_union),
     }
 
@@ -601,6 +654,7 @@ def run(names, check: bool) -> int:
                 "rules": r["rules"],
                 "eqns": r["eqns"],
                 "provenance_ops": r["provenance_ops"],
+                "pallas_kernels": r["pallas_kernels"],
                 "output_provenance": r["output_provenance"],
             }
             for n, r in sorted(results.items())
@@ -630,6 +684,8 @@ def run(names, check: bool) -> int:
                 if want and (
                     want.get("eqns") != r["eqns"]
                     or want.get("provenance_ops") != r["provenance_ops"]
+                    or want.get("pallas_kernels", {})
+                    != r["pallas_kernels"]
                 ):
                     failures.append(
                         f"{n}: jaxpr census drift vs manifest — intended? "

@@ -70,6 +70,17 @@ def bootstrap(n_devices: int = 8) -> None:
 
     os.environ.pop("SPT_SANITIZE", None)
     __graft_entry__._force_cpu_platform(n_devices)
+    # Pallas kernel bodies serialize into the tpu_custom_call payload as
+    # MLIR *bytecode*, whose per-op locations the textual loc-stripper in
+    # `canonical_text` cannot reach. With full tracebacks (the default)
+    # those locations include THIS tool's call-stack frames, so any line
+    # shift in this file silently drifted the three pallas program
+    # digests. Single-frame locations pin the payload to the innermost
+    # user frame (the kernel source itself) — digests track the kernels,
+    # not the certification tool.
+    import jax
+
+    jax.config.update("jax_include_full_tracebacks_in_locations", False)
 
 
 # ---------------------------------------------------------------------------
@@ -386,16 +397,13 @@ def build_serving_node_compact():
     return fn, args, None
 
 
-def build_sharded_wave_chunk():
-    """The sharded wave chunk program (`parallel.solver.
-    sharded_wave_chunk_solver` — the shard_map ring-election waterfill the
-    mega config 8 ships) on an 8-way ("nodes",) mesh at the reduced
-    shard-smoke shapes, node axis pre-permuted into global score-rank
-    order by `rank_order_inputs` exactly as bench stages it. The resident
-    rank-ordered free carry is DONATED (the exported calling convention
-    must carry it, like cfg6's chunk program), and the lowering proves the
-    per-wave ring/psum elections — never a full node-axis gather — lower
-    to TPU collectives."""
+def _sharded_wave_chunk_program(use_pallas: bool):
+    """Shared staging for the two sharded-wave-chunk manifest entries —
+    ONE copy of the reduced shard-smoke problem, mesh and
+    `rank_order_inputs` pre-permutation (exactly as bench stages it), so
+    the lax and pallas entries can never drift onto different shapes. The
+    resident rank-ordered free carry is DONATED (the exported calling
+    convention must carry it, like cfg6's chunk program)."""
     import bench
     from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
     from scheduler_plugins_tpu.parallel.solver import (
@@ -413,11 +421,110 @@ def build_sharded_wave_chunk():
         shape["devices"],
     )
     chunk = shape["chunk"]
-    fn = sharded_wave_chunk_solver(mesh, shape["n_nodes"], rescue_window=256)
+    fn = sharded_wave_chunk_solver(
+        mesh, shape["n_nodes"], rescue_window=256,
+        use_pallas=use_pallas, pallas_interpret=False,
+    )
     args = (
         node_ids, problem["req"][:chunk], problem["mask"][:chunk], rank_free
     )
     return fn, args, mesh
+
+
+def build_sharded_wave_chunk():
+    """The sharded wave chunk program (`parallel.solver.
+    sharded_wave_chunk_solver` — the shard_map ring-election waterfill the
+    mega config 8 ships) on an 8-way ("nodes",) mesh at the reduced
+    shard-smoke shapes. The lowering proves the per-wave ring/psum
+    elections — never a full node-axis gather — lower to TPU collectives.
+    use_pallas pinned False: this entry certifies the LAX collectives
+    build — an ambient SPT_PALLAS=1 in the manifest-refresh shell must
+    never silently swap which formulation carries this program's digest."""
+    return _sharded_wave_chunk_program(use_pallas=False)
+
+
+def build_sharded_wave_chunk_pallas():
+    """The sharded wave chunk program with the PALLAS election path
+    (`use_pallas=True, pallas_interpret=False` — the COMPILED kernels, not
+    the CPU twins): same shapes/mesh as `sharded_wave_chunk` (shared
+    staging), but every per-wave collective is a `parallel.kernels` ring
+    program. Lowering this proves the whole solve — kernels under
+    shard_map under the wave while_loops, Mosaic bodies included — exports
+    to TPU StableHLO (`tpu_custom_call` with the serialized kernel
+    payloads), which is the ISSUE 13 readiness evidence
+    `make tpu-first-cycle` checks."""
+    return _sharded_wave_chunk_program(use_pallas=True)
+
+
+def _node_mesh8():
+    from scheduler_plugins_tpu.parallel.mesh import make_node_mesh
+
+    return make_node_mesh(8)
+
+
+def build_pallas_ring_offsets():
+    """`parallel.kernels.ring_offsets_f64` standalone (compiled body, 8-way
+    node mesh): the double-buffered `make_async_remote_copy` exclusive-
+    scan ring at the lite wave's cumulative-free payload shape. The
+    kernel-body op census (dma_start/dma_wait, semaphore ops) lives in
+    docs/jaxpr_audit.json; this entry certifies the Mosaic body serializes
+    into TPU StableHLO."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from scheduler_plugins_tpu.api.resources import CANONICAL
+    from scheduler_plugins_tpu.parallel import kernels as pk
+    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS
+
+    mesh = _node_mesh8()
+    S, R = 8, len(CANONICAL)
+
+    def per_shard(x):
+        return pk.ring_offsets_f64(
+            x.reshape(R), NODES_AXIS, S, interpret=False
+        )
+
+    fn = jax.jit(shard_map(
+        per_shard, mesh=mesh, in_specs=P(NODES_AXIS),
+        out_specs=(P(NODES_AXIS), P(NODES_AXIS)), check_rep=False,
+    ))
+    x = jnp.arange(S * R, dtype=jnp.float64) * (1 << 30)
+    return fn, (x,), mesh
+
+
+def build_pallas_fused_election():
+    """`parallel.kernels.fused_election` standalone (compiled body, 8-way
+    node mesh) at the rescue-window election shape: min-rank keys plus the
+    winner node-id/free-row payload in one ring program — the kernel that
+    retires the packed admission-verdict psum."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from scheduler_plugins_tpu.api.resources import CANONICAL
+    from scheduler_plugins_tpu.parallel import kernels as pk
+    from scheduler_plugins_tpu.parallel.mesh import NODES_AXIS
+
+    mesh = _node_mesh8()
+    S, R, W = 8, len(CANONICAL), 256
+    HP = 1 + pk.N_LIMBS * R
+
+    def per_shard(keys, payload):
+        return pk.fused_election(
+            keys.reshape(W), payload.reshape(HP, W), NODES_AXIS, S,
+            interpret=False,
+        )
+
+    fn = jax.jit(shard_map(
+        per_shard, mesh=mesh, in_specs=(P(NODES_AXIS), P(NODES_AXIS)),
+        out_specs=(P(), P(None, None)), check_rep=False,
+    ))
+    keys = jnp.zeros(S * W, jnp.int32)
+    payload = jnp.zeros(S * HP * W, jnp.int32)
+    return fn, (keys, payload), mesh
 
 
 def _gang_problem():
@@ -559,6 +666,9 @@ PROGRAMS = {
     "serving_delta_apply": build_serving_delta_apply,
     "serving_node_compact": build_serving_node_compact,
     "sharded_wave_chunk": build_sharded_wave_chunk,
+    "sharded_wave_chunk_pallas": build_sharded_wave_chunk_pallas,
+    "pallas_ring_offsets": build_pallas_ring_offsets,
+    "pallas_fused_election": build_pallas_fused_election,
     "sweep_solve": build_sweep_solve,
     "rank_gang_solve": build_rank_gang_solve,
     "wave_gang_solve": build_wave_gang_solve,
