@@ -113,6 +113,15 @@ pallas-smoke:
 tpu-first-cycle:
 	$(PY) tools/tpu_first_cycle.py
 
+# CI packing gate (ISSUE 14): reduced packing-frontier run — the packing
+# solve mode must STRICTLY improve packed_utilization AND fragmentation
+# over the wave path with ZERO hard-constraint violations (the
+# tuning/gates.py replay oracles), budget-0 placements bit-identical to
+# the wave path, and score-sum drift bounded
+.PHONY: pack-smoke
+pack-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --pack-smoke
+
 # CI resilience gate: reduced chaos-churn run under the FULL seeded fault
 # plan (hung solve, device error, garbage output, dropped/duplicated/
 # corrupted sink deltas, feed stall, crash mid-cycle) — zero
@@ -146,7 +155,7 @@ gang-smoke:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke chaos-smoke gang-smoke endurance-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
 
 .PHONY: lint
 lint:

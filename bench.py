@@ -698,7 +698,7 @@ CONFIG_METRICS = {
     0: "tpu_smoke_pods_per_sec", 7: "serving_churn_pods_per_sec",
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
     10: "rank_gang_pods_per_sec", 11: "cluster_life_pods_per_sec",
-    12: "mega_gang_ranks_per_sec",
+    12: "mega_gang_ranks_per_sec", 13: "packing_frontier_pods_per_sec",
 }
 
 
@@ -2865,6 +2865,327 @@ def mega_gangs(shape=None, emit=True):
     return line
 
 
+# ---------------------------------------------------------------------------
+# config 13: packing frontier — the packing solve mode vs the wave path
+# ---------------------------------------------------------------------------
+
+#: the packing-frontier shape (ISSUE 14): a mid-life cluster — heterogeneous
+#: SKUs, ~70% of nodes carrying an uneven resident load — where the static
+#: allocatable score order diverges from the fill order, so the one-pass
+#: wave placement leaves free-capacity dust the packing refinement can
+#: consolidate. `budgets` is the iteration-budget sweep (0 is always run
+#: first as the wave-parity anchor).
+PACKING_SHAPE = dict(
+    n_nodes=768, demand_frac=0.92, empty_frac=0.05, budgets=(8, 32, 128),
+)
+#: reduced shape for the `make pack-smoke` CI gate — small enough for
+#: 2-core runners, large enough that consolidation measurably moves both
+#: packing gauges
+PACK_SMOKE_SHAPE = dict(
+    n_nodes=96, demand_frac=0.8, empty_frac=0.1, budgets=(8, 32),
+)
+
+
+def packing_problem(n_nodes, demand_frac=0.8, empty_frac=0.1, seed=0):
+    """(cluster, snap, meta, weights) for the packing configs: a mid-life
+    cluster — `1 - empty_frac` of the nodes pre-loaded by residents at
+    uneven 20-80% cpu fill across four heterogeneous SKUs (arriving
+    bound, as a feed replay would deliver them), the remaining
+    `empty_frac` standing EMPTY on the biggest SKU (freshly added
+    capacity) — plus a pending batch sized to `demand_frac` of the
+    cluster's free cpu. The Least-allocatable ranking fills the loaded
+    fleet first and the batch tail spills lightly onto the big empty
+    nodes (the rescue waves spray stragglers round-robin); the packing
+    refinement drains that spill back into the loaded fleet's dust gaps,
+    re-emptying whole big nodes — exactly the consolidation headroom the
+    one-pass wave semantics cannot see."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import (
+        CPU,
+        MEMORY,
+        PODS,
+        ResourceIndex,
+    )
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    rng = np.random.default_rng(seed)
+    skus = [
+        (64_000, 256 * gib, 256),
+        (32_000, 128 * gib, 220),
+        (96_000, 384 * gib, 256),
+        (16_000, 64 * gib, 128),
+    ]
+    cluster = Cluster()
+    serial = 0
+    free_cpu = 0
+    n_empty = max(1, int(n_nodes * empty_frac))
+    for i in range(n_nodes):
+        # the last n_empty nodes stand empty on the BIGGEST SKU: freshly
+        # added capacity the Least-allocatable ranking scores worst, so
+        # the wave touches it only as spill — the blocks packing re-empties
+        empty = i >= n_nodes - n_empty
+        sku = 2 if empty else int(rng.integers(0, len(skus)))
+        cpu, mem, pods = skus[sku]
+        cluster.add_node(Node(
+            name=f"node-{i:05d}",
+            allocatable={CPU: cpu, MEMORY: mem, PODS: pods},
+        ))
+        used = 0
+        if not empty:
+            # uneven resident fill: 20-80% of cpu in 100-2000m pieces
+            target = int(cpu * rng.uniform(0.2, 0.8))
+            while used < target:
+                c = int(rng.integers(100, 2000))
+                m = int(rng.integers(256 << 20, 2 * gib))
+                pod = Pod(
+                    name=f"bound-{serial:06d}", creation_ms=serial,
+                    containers=[Container(requests={CPU: c, MEMORY: m})],
+                )
+                pod.node_name = f"node-{i:05d}"
+                cluster.add_pod(pod)
+                used += c
+                serial += 1
+        free_cpu += cpu - used
+    base_ms = serial
+    target_demand = int(free_cpu * demand_frac)
+    demand = 0
+    j = 0
+    while demand < target_demand:
+        c = int(rng.integers(100, 2000))
+        cluster.add_pod(Pod(
+            name=f"pend-{j:06d}", creation_ms=base_ms + j,
+            containers=[Container(requests={
+                CPU: c,
+                MEMORY: int(rng.integers(256 << 20, 2 * gib))})],
+        ))
+        demand += c
+        j += 1
+    pending = sorted(cluster.pending_pods(), key=lambda p: p.creation_ms)
+    snap, meta = cluster.snapshot(pending, now_ms=0)
+    weights = jnp.asarray(
+        ResourceIndex().encode({CPU: 1 << 20, MEMORY: 1}), jnp.int64
+    )
+    return cluster, snap, meta, weights
+
+
+def _packing_arms(snap, weights, budgets, runs=3):
+    """Run the wave-parity anchor (budget 0) + the budget sweep through
+    the ONE jitted packing program (`parallel.solver.packing_solve_fn` —
+    budgets ride the traced pack_aux argument, so the sweep shares a
+    single compile). Returns (wave_arm, [arm per budget]) where each arm
+    is {assignment, wait, seconds, stats}."""
+    from scheduler_plugins_tpu.ops.packing import pack_aux_vector
+    from scheduler_plugins_tpu.parallel.solver import packing_solve_fn
+
+    solve = packing_solve_fn(collect_stats=True)
+
+    def run_arm(budget):
+        aux = pack_aux_vector(budget, 4.0, 0.0, 0.5)
+        times = []
+        out = None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            with _bench_span(f"packing solve budget {budget}"):
+                assignment, admitted, wait, stats = solve(
+                    snap, weights, aux
+                )
+                out = (
+                    np.asarray(assignment), np.asarray(wait),
+                    {k: int(v) for k, v in stats["packing"].items()},
+                )
+            times.append(time.perf_counter() - t0)
+        return {
+            "assignment": out[0], "wait": out[1], "stats": out[2],
+            "seconds": sorted(times)[len(times) // 2],
+        }
+
+    run_arm(0)  # warm: one compile serves every budget
+    wave = run_arm(0)
+    return wave, [(b, run_arm(b)) for b in budgets]
+
+
+def packing_frontier(shape=None, emit=True, seed=0):
+    """Config 13: the packing-frontier bench (ISSUE 14; docs/PACKING.md).
+    One mid-life cluster problem; arms = the wave path (the packing
+    program at iteration budget 0 — proven bit-identical to `batch_solve`
+    per run) and the packing mode at each `budgets` entry. The emitted
+    line carries the full utilization-vs-drift-vs-latency frontier: per
+    budget, the placement-quality objectives (packed_utilization,
+    fragmentation, util_imbalance), score-sum drift vs the wave
+    placements, solve latency and the refinement counters — with the
+    `tuning.gates` replay oracles certifying ZERO hard-constraint
+    violations on every arm. Headline value: pods/s of the largest
+    budget (quality costs latency; the frontier is the point)."""
+    from scheduler_plugins_tpu.parallel.solver import batch_solve
+    from scheduler_plugins_tpu.tuning.gates import hard_violations
+
+    shape = shape or PACKING_SHAPE
+    cluster, snap, meta, weights = packing_problem(
+        shape["n_nodes"], shape["demand_frac"], shape["empty_frac"],
+        seed=seed,
+    )
+    wave, arms = _packing_arms(snap, weights, shape["budgets"])
+    # budget 0 must BE the wave path (the acceptance anchor)
+    a_ref, _, w_ref = batch_solve(snap, weights)
+    wave_parity = bool(
+        (np.asarray(a_ref) == wave["assignment"]).all()
+        and (np.asarray(w_ref) == wave["wait"]).all()
+    )
+    from scheduler_plugins_tpu.tuning import quality as Q
+
+    objective = _alloc_objective(snap, weights)
+
+    def raw_quality(arm):
+        # unrounded objectives for the gain columns: at full scale a real
+        # fragmentation gain is smaller than the 4-decimal display
+        # rounding of the per-arm quality dicts
+        return Q.cycle_quality(
+            snap, arm["assignment"], None, arm["wait"]
+        )
+
+    q_wave_raw = raw_quality(wave)
+    q_wave = {k: round(v, 4) for k, v in q_wave_raw.items()}
+    v_wave = hard_violations(snap, wave["assignment"], wave["wait"])
+    frontier = [{
+        "budget": 0, "quality": q_wave, "drift": 0.0,
+        "solve_seconds": round(wave["seconds"], 4),
+        "violations": v_wave["total"], **wave["stats"],
+    }]
+    total_violations = v_wave["total"]
+    q_best_raw = q_wave_raw
+    for budget, arm in arms:
+        q_raw = raw_quality(arm)
+        q_best_raw = q_raw
+        v = hard_violations(snap, arm["assignment"], arm["wait"])
+        total_violations += v["total"]
+        frontier.append({
+            "budget": budget,
+            "quality": {k: round(v_, 4) for k, v_ in q_raw.items()},
+            "drift": round(_score_sum_drift(
+                objective, arm["assignment"], wave["assignment"]
+            ), 4),
+            "solve_seconds": round(arm["seconds"], 4),
+            "violations": v["total"], **arm["stats"],
+        })
+    best = arms[-1][1]
+    q_best = frontier[-1]["quality"]
+    placed = int((best["assignment"] >= 0).sum())
+    line = {
+        "frontier": frontier,
+        "wave_parity_at_budget_0": wave_parity,
+        "violations": total_violations,
+        "packed_utilization_gain": round(
+            q_best_raw["packed_utilization"]
+            - q_wave_raw["packed_utilization"], 6
+        ),
+        "fragmentation_gain": round(
+            q_wave_raw["fragmentation"] - q_best_raw["fragmentation"], 6
+        ),
+        "budgets": list(shape["budgets"]),
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[13],
+            placed / best["seconds"] if best["seconds"] else 0.0,
+            f"{shape['n_nodes']} nodes x {snap.num_pods} pods packing "
+            f"frontier, budgets {list(shape['budgets'])}",
+            baseline=placed / wave["seconds"] if wave["seconds"] else 1.0,
+            drift=frontier[-1]["drift"],
+            quality=q_best,
+            extra=line,
+        )
+    return line
+
+
+def pack_smoke(min_gain=1e-4, drift_bound=0.15):
+    """CI gate (`make pack-smoke`): on the reduced shape, the packing
+    mode must STRICTLY improve packed_utilization AND fragmentation over
+    the wave path at its largest budget, with zero hard-constraint
+    violations on every arm (the `tuning.gates` replay oracles), budget-0
+    placements bit-identical to the wave path, and |drift| bounded."""
+    line = packing_frontier(shape=PACK_SMOKE_SHAPE, emit=False)
+    checks = {
+        "wave_parity_at_budget_0": line["wave_parity_at_budget_0"],
+        "zero_violations": line["violations"] == 0,
+        "packed_utilization_strictly_improves":
+            line["packed_utilization_gain"] > min_gain,
+        "fragmentation_strictly_improves":
+            line["fragmentation_gain"] > min_gain,
+        "drift_bounded": all(
+            abs(arm["drift"]) <= drift_bound for arm in line["frontier"]
+        ),
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "smoke": "pack", "ok": ok, "checks": checks,
+        "packed_utilization_gain": line["packed_utilization_gain"],
+        "fragmentation_gain": line["fragmentation_gain"],
+        "frontier": line["frontier"],
+    }))
+    return 0 if ok else 1
+
+
+#: the columns every emitted bench line must carry regardless of path
+#: (success, error, stale-capture replay) — THE one schema statement the
+#: error/replay builders below and tests/test_bench_lines.py share, so a
+#: new config cannot ship a line missing the attribution columns
+LINE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "backend", "backend_probe",
+    "devices", "mesh_shape", "drift", "quality", "pallas",
+)
+
+
+def error_line(config: int, mode: str, diagnosis: dict) -> dict:
+    """The schema-complete no-capture error line for a sick backend —
+    every `LINE_SCHEMA_KEYS` column present (quality/drift null: no solve
+    ran), the structured probe verdict attached, rc stays 0 because the
+    environment is sick, not the code."""
+    return {
+        "metric": metric_name(config, mode), "value": 0, "unit": "pods/s",
+        "vs_baseline": 0.0, "backend": _backend_label(),
+        "devices": None, "mesh_shape": None,
+        "drift": None, "quality": None,
+        "pallas": _pallas_attribution(),
+        "error": "tpu-backend-unavailable",
+        "backend_probe": diagnosis,
+        "detail": f"{diagnosis['kind']}: {diagnosis['detail']}",
+    }
+
+
+def stale_replay_line(replay: dict, diagnosis: dict) -> dict:
+    """A captured line replayed under a sick backend, made
+    schema-complete: older captures predate the devices/mesh_shape/
+    quality/pallas columns, and the probe verdict + pallas block must
+    describe THIS run's backend, not the capture's."""
+    replay = dict(replay)
+    captured = replay.pop("ts")
+    replay.setdefault("devices", None)
+    replay.setdefault("mesh_shape", None)
+    replay.setdefault("quality", None)
+    replay.setdefault("drift", None)
+    replay.setdefault("backend", _backend_label())
+    # like backend_probe below: describes THIS run's pallas state, not
+    # the capture's
+    replay["pallas"] = _pallas_attribution()
+    replay.update({
+        "stale_capture": True,
+        "captured_unix": captured,
+        "error": "tpu-backend-unavailable-now",
+        # the structured probe verdict REPLACES any replayed one: it
+        # describes THIS run's backend, not the capture's
+        "backend_probe": diagnosis,
+        "detail": f"{diagnosis['kind']}: {diagnosis['detail']}; "
+                  "replaying capture from "
+                  f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime(captured))}",
+    })
+    replay.pop("config", None)
+    replay.pop("mode", None)
+    return replay
+
+
 #: replay cutoff: a capture older than this is too stale to stand in for
 #: "the round's number" (a round is ~12h; 48h allows the previous round's
 #: tail while excluding week-old numbers from a drifted codebase)
@@ -3236,7 +3557,10 @@ if __name__ == "__main__":
                              "DL jobs vs quorum-only Coscheduling; 12 = "
                              "10k-node x 1k-gang mega gangs, wave-"
                              "batched gang solve vs the sequential gang "
-                             "scan, bit-identical placements); "
+                             "scan, bit-identical placements; 13 = "
+                             "packing frontier: the packing solve mode "
+                             "vs the wave path over iteration budgets — "
+                             "utilization vs drift vs latency); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -3303,6 +3627,14 @@ if __name__ == "__main__":
                              "per-cycle placements, a bit-identical "
                              "final cluster state and a clean replayed "
                              "capacity audit")
+    parser.add_argument("--pack-smoke", action="store_true",
+                        help="CI gate: reduced packing-frontier run; "
+                             "fails unless the packing mode strictly "
+                             "improves packed_utilization AND "
+                             "fragmentation over the wave path with "
+                             "zero hard-constraint violations, budget-0 "
+                             "bit-parity with the wave placements, and "
+                             "bounded drift")
     parser.add_argument("--chaos-smoke", action="store_true",
                         help="CI gate: reduced chaos-churn run under the "
                              "full seeded fault plan (hung solve, device "
@@ -3367,6 +3699,16 @@ if __name__ == "__main__":
         # backend, so no tunnel probe
         mega_gangs()
         sys.exit(0)
+    if args.pack_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # mode-vs-mode placement-quality comparison — no tunnel probe
+        sys.exit(pack_smoke())
+    if args.config == 13:
+        # packing-mode vs wave-path comparison on one problem (budget-0
+        # bit-parity gated) — both arms share the backend, so no tunnel
+        # probe (its health cancels out of every asserted claim)
+        packing_frontier()
+        sys.exit(0)
     if args.config == 10:
         # rank-aware vs quorum-only comparison, full shape — both arms
         # share whatever backend is configured, so no tunnel probe (its
@@ -3395,40 +3737,10 @@ if __name__ == "__main__":
         # real measured number; emit 0 only if no capture exists.
         replay = latest_capture(args.config, args.mode)
         if replay is not None:
-            captured = replay.pop("ts")
-            # older captures predate the devices/mesh_shape attribution
-            # columns — keep the replayed line schema-complete
-            replay.setdefault("devices", None)
-            replay.setdefault("mesh_shape", None)
-            replay.setdefault("quality", None)
-            # like backend_probe below: describes THIS run's pallas state,
-            # not the capture's — keeps the replayed line schema-complete
-            replay.setdefault("pallas", _pallas_attribution())
-            replay.update({
-                "stale_capture": True,
-                "captured_unix": captured,
-                "error": "tpu-backend-unavailable-now",
-                # the structured probe verdict REPLACES any replayed one:
-                # it describes THIS run's backend, not the capture's
-                "backend_probe": diagnosis,
-                "detail": f"{diagnosis['kind']}: {diagnosis['detail']}; "
-                          "replaying capture from "
-                          f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime(captured))}",
-            })
-            replay.pop("config", None)
-            replay.pop("mode", None)
-            print(json.dumps(replay))
+            print(json.dumps(stale_replay_line(replay, diagnosis)))
             sys.exit(0)
         # one parseable line, rc=0 — the environment is sick, not the code
-        print(json.dumps({
-            "metric": metric_name(args.config, args.mode), "value": 0, "unit": "pods/s",
-            "vs_baseline": 0.0, "devices": None, "mesh_shape": None,
-            "drift": None, "quality": None,
-            "pallas": _pallas_attribution(),
-            "error": "tpu-backend-unavailable",
-            "backend_probe": diagnosis,
-            "detail": f"{diagnosis['kind']}: {diagnosis['detail']}",
-        }))
+        print(json.dumps(error_line(args.config, args.mode, diagnosis)))
         sys.exit(0)
     trace_json = bool(args.trace) and args.trace.endswith(".json")
     if trace_json:

@@ -23,7 +23,11 @@ from __future__ import annotations
 
 from typing import Mapping, Optional, Sequence
 
-from scheduler_plugins_tpu.framework.runtime import Profile
+from scheduler_plugins_tpu.framework.runtime import (
+    PackingConfig,
+    Profile,
+    SOLVE_MODES,
+)
 
 #: camelCase arg name -> plugin constructor kwarg, per plugin
 _ARG_MAPS: dict[str, dict[str, str]] = {
@@ -93,6 +97,18 @@ _ARG_MAPS: dict[str, dict[str, str]] = {
             "ignore_preferred_terms_of_existing_pods",
     },
     "CrossNodePreemption": {"maxPool": "max_pool"},
+}
+
+
+#: camelCase packingConfig arg -> `framework.runtime.PackingConfig` kwarg
+#: (the solve-mode analog of `_ARG_MAPS`; validation lives in the
+#: PackingConfig constructor like the plugin constructors)
+_PACKING_ARG_MAP = {
+    "iterations": "iterations",
+    "priceWeight": "price_weight",
+    "temperature": "temperature",
+    "decay": "decay",
+    "moverCap": "mover_cap",
 }
 
 
@@ -201,6 +217,18 @@ def profile_spec(profile: Profile) -> dict:
     spec = {"profileName": profile.name, "plugins": names}
     if plugin_config:
         spec["pluginConfig"] = plugin_config
+    # solve-mode surface (ISSUE 14): exported only off-default so legacy
+    # specs round-trip byte-identically
+    if profile.solve_mode != "sequential":
+        spec["solveMode"] = profile.solve_mode
+        pk = profile.packing
+        packing_args = {
+            camel: getattr(pk, kwarg)
+            for camel, kwarg in _PACKING_ARG_MAP.items()
+            if getattr(pk, kwarg) != getattr(PackingConfig, kwarg)
+        }
+        if packing_args:
+            spec["packingConfig"] = packing_args
     # score weights, aligned with the `plugins` list (the upstream
     # Plugins.Score.Enabled[].Weight knob) — what the tuning observatory
     # (tools/tune.py) emits a tuned profile through
@@ -244,6 +272,33 @@ def load_profile(config: Mapping) -> Profile:
             if w < 1:
                 raise ValueError(f"plugin weight must be >= 1, got {w}")
             plugin.weight = w
-    return Profile(
-        plugins=plugins, name=config.get("profileName", "tpu-scheduler")
+    solve_mode = config.get("solveMode", "sequential")
+    if solve_mode not in SOLVE_MODES:
+        raise ValueError(
+            f"unknown solveMode {solve_mode!r}; expected one of "
+            f"{SOLVE_MODES}"
+        )
+    packing_kwargs = {}
+    for key, value in config.get("packingConfig", {}).items():
+        if key not in _PACKING_ARG_MAP:
+            raise ValueError(f"unknown packingConfig arg {key!r}")
+        packing_kwargs[_PACKING_ARG_MAP[key]] = value
+    packing = PackingConfig(**packing_kwargs)
+    profile = Profile(
+        plugins=plugins, name=config.get("profileName", "tpu-scheduler"),
+        solve_mode=solve_mode, packing=packing,
     )
+    if solve_mode == "packing":
+        # the packing refinement re-places pods on any fitting node,
+        # which is only sound on the targeted fast-path profile shape
+        # (one pod-invariant scoring plugin, no per-(pod, node) filters)
+        # — reject at config time, not first-solve time
+        from scheduler_plugins_tpu.parallel.solver import fast_path_scoring
+
+        if fast_path_scoring(profile.plugins) is None:
+            raise ValueError(
+                "solveMode 'packing' requires a targeted fast-path "
+                "profile (exactly one pod-invariant scoring plugin with "
+                "positive weight and no filter plugins)"
+            )
+    return profile

@@ -20,6 +20,7 @@ from scheduler_plugins_tpu.framework.plugin import (  # noqa: F401
     SolverState,
 )
 from scheduler_plugins_tpu.framework.runtime import (  # noqa: F401
+    PackingConfig,
     Profile,
     Scheduler,
     SolveResult,

@@ -387,13 +387,23 @@ def _cycle_post_solve(ctx: CycleCtx) -> None:
     )
     if ctx.rec is not None:
         with obs.tracer.span("Record", tid="cycle"):
+            from scheduler_plugins_tpu.parallel.solver import PackingSolveView
+
             codes = getattr(result, "failed_plugin", None)
-            ctx.rec.capture_outputs(
+            if isinstance(result, PackingSolveView):
+                # packing placements replay through the sequential path
+                # as EVIDENCE only (soft ordering differs by design) —
+                # the mode string keeps the replayer honest about it
+                rec_mode = "packing"
+            elif isinstance(result, SolveResult) or codes is not None:
                 # the host failover path carries the sequential parity
                 # semantics (and per-pod codes), so its records replay
                 # through the same path as device-sequential ones
-                "sequential" if isinstance(result, SolveResult)
-                or codes is not None else "streamed",
+                rec_mode = "sequential"
+            else:
+                rec_mode = "streamed"
+            ctx.rec.capture_outputs(
+                rec_mode,
                 ctx.assignment, ctx.admitted, ctx.wait,
                 failed_plugin=(
                     None if codes is None else np.asarray(codes)

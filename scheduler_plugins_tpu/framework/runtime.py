@@ -400,6 +400,63 @@ def sequential_solve_body(plugins, snap: ClusterSnapshot,
     )
 
 
+#: the solve modes a profile may select (`Profile.solve_mode`): the
+#: bit-faithful sequential parity scan (default), or the packing
+#: optimizer — wave placement + iterative consolidation refinement
+#: (`parallel.solver.packing_profile_solve`; docs/PACKING.md). The wave
+#: throughput path stays caller-selected (stream_chunk / the batched
+#: entries), not a profile mode — it has no per-profile knobs.
+SOLVE_MODES = ("sequential", "packing")
+
+
+@dataclass
+class PackingConfig:
+    """Knobs of the packing solve mode (docs/PACKING.md). All of
+    `iterations` / `price_weight` / `temperature` / `decay` ride the
+    traced `aux()` vector (CLAUDE.md aux-channel discipline — one
+    compile, tunable online); `mover_cap` is a static shape knob."""
+
+    #: refinement-round budget (0 = the wave placement bit-identically)
+    iterations: int = 32
+    #: weight of the fragmentation price vs the score term in each bid
+    price_weight: float = 4.0
+    #: initial minimum fill edge a target must have over the donor
+    temperature: float = 0.0
+    #: per-round multiplicative temperature decay, in (0, 1]
+    decay: float = 0.5
+    #: static per-round mover-window width
+    mover_cap: int = 128
+
+    def __post_init__(self):
+        if self.iterations < 0:
+            raise ValueError("packing iterations must be >= 0")
+        if int(self.iterations) != self.iterations:
+            # the jax build floors the traced budget to match the numpy
+            # twin — reject fractional config values instead of silently
+            # rounding a tuner's proposal
+            raise ValueError(
+                f"packing iterations must be integral, got "
+                f"{self.iterations!r}"
+            )
+        if self.price_weight < 0:
+            raise ValueError("packing priceWeight must be >= 0")
+        if self.temperature < 0:
+            raise ValueError("packing temperature must be >= 0")
+        if not 0 < self.decay <= 1:
+            raise ValueError("packing decay must be in (0, 1]")
+        if self.mover_cap < 1:
+            raise ValueError("packing moverCap must be >= 1")
+
+    def aux(self):
+        """The (4,) traced float64 knob vector (`ops.packing`)."""
+        from scheduler_plugins_tpu.ops.packing import pack_aux_vector
+
+        return pack_aux_vector(
+            self.iterations, self.price_weight, self.temperature,
+            self.decay,
+        )
+
+
 @dataclass
 class Profile:
     """An enabled-plugin set, the equivalent of one KubeSchedulerConfiguration
@@ -415,8 +472,19 @@ class Profile:
     #: PreemptionToleration -> default preemption with toleration)
     preemption: Optional[object] = None
     name: str = "tpu-scheduler"
+    #: which solve serves this profile's cycles (`SOLVE_MODES`);
+    #: "sequential" is the bit-faithful parity path every differential
+    #: gate anchors on, "packing" opts into the consolidation optimizer
+    solve_mode: str = "sequential"
+    #: packing-mode knobs (ignored under other modes)
+    packing: PackingConfig = field(default_factory=PackingConfig)
 
     def __post_init__(self):
+        if self.solve_mode not in SOLVE_MODES:
+            raise ValueError(
+                f"unknown solve mode {self.solve_mode!r}; "
+                f"expected one of {SOLVE_MODES}"
+            )
         if self.queue_sort is None:
             for plugin in self.plugins:
                 if type(plugin).queue_key is not Plugin.queue_key or hasattr(
@@ -514,12 +582,47 @@ class Scheduler:
         return unroll
 
     def solve(self, snap: ClusterSnapshot, state0: Optional[SolverState] = None,
-              auxes=None):
+              auxes=None, mode: Optional[str] = None):
         """Run the fused plugin pipeline over the snapshot's pending batch.
         `auxes` overrides the per-plugin traced aux pytrees (normally
         recomputed from the prepared plugins) — the flight-recorder replay
         path (`tools/replay.py`) force-binds the RECORDED arrays so the
-        solve consumes exactly what the recorded cycle saw."""
+        solve consumes exactly what the recorded cycle saw.
+
+        `mode` selects the solve (None = the profile's `solve_mode`):
+        "sequential" is the bit-faithful parity scan below; "packing"
+        dispatches to `parallel.solver.packing_profile_solve` (wave
+        placement + consolidation refinement, docs/PACKING.md) and
+        returns its `PackingSolveView` (assignment/admitted/wait, no
+        SolverState carry). Replay/differential callers that NEED the
+        parity semantics pass mode="sequential" explicitly so a packing
+        profile can never change what they certify."""
+        if mode is None:
+            mode = self.profile.solve_mode
+        if mode == "packing":
+            from scheduler_plugins_tpu.parallel.solver import (
+                packing_profile_solve,
+            )
+
+            if auxes is not None:
+                raise ValueError(
+                    "auxes= replay override requires the sequential "
+                    "parity path (pass mode='sequential')"
+                )
+            if state0 is not None:
+                # same rule as auxes: the packing solve builds its own
+                # donation-safe initial state — silently dropping a
+                # caller-prepared carry would solve against different
+                # state than the caller intended
+                raise ValueError(
+                    "state0= requires the sequential parity path "
+                    "(pass mode='sequential')"
+                )
+            return packing_profile_solve(
+                self, snap, mover_cap=self.profile.packing.mover_cap
+            )
+        if mode != "sequential":
+            raise ValueError(f"unknown solve mode {mode!r}")
         if state0 is None:
             state0 = self.initial_state(snap)
         if auxes is None:

@@ -297,6 +297,15 @@ class GangPhase:
         self._jit = None
         self._grow_serial = 0
         self._last: Optional[dict] = None
+        #: gang full_name -> last desired width this phase observed
+        #: (`reconcile` diffs against it to record elastic desired-width
+        #: TRANSITIONS on the flight-recorder manifest — the corpus
+        #: signal the tuner needs to counterfactually sweep block
+        #: policies, ROADMAP item 3)
+        self._desired_seen: dict[str, int] = {}
+        #: this cycle's observed transitions (rebuilt every reconcile
+        #: pass, attached by `annotate_record`)
+        self._elastic_transitions: list = []
 
     # -- elastic reconcile ----------------------------------------------
     def reconcile(self, cluster, now) -> dict:
@@ -309,8 +318,18 @@ class GangPhase:
         per reconcile pass, not per shrinking gang."""
         moved: dict[str, dict] = {}
         view = None  # (node_pos, zones, block_cost), lowered lazily once
+        self._elastic_transitions = []
         for pg in rank_gang_groups(cluster):
             lo, desired, hi = E.elastic_bounds(pg)
+            prev = self._desired_seen.get(pg.full_name)
+            if prev != desired:
+                # first sighting records from=None (the corpus needs the
+                # initial width too, not just later moves)
+                self._elastic_transitions.append({
+                    "gang": pg.full_name, "from": prev, "to": desired,
+                    "min": lo, "max": hi,
+                })
+                self._desired_seen[pg.full_name] = desired
             members = cluster.gang_members(pg)
             live = [p for p in members if p.node_name is not None]
             total = len(members)
@@ -546,8 +565,18 @@ class GangPhase:
         flight-recorder record, so a recorded gang cycle replays
         bit-identically: re-running `gangs.topology.gang_solve_np` on the
         captured tensors must reproduce `rank_nodes` exactly
-        (tests/test_gangs.py gates this)."""
-        if self._last is None or rec is None:
+        (tests/test_gangs.py gates this). Elastic desired-width
+        TRANSITIONS ride the manifest even on cycles with no gang solve
+        (a shrink-only reconcile never reaches `_solve`): the tuner's
+        counterfactual block-policy sweeps need the width timeline, not
+        just the solved tensors."""
+        if rec is None:
+            return
+        if self._elastic_transitions:
+            rec.manifest["elastic_transitions"] = [
+                dict(t) for t in self._elastic_transitions
+            ]
+        if self._last is None:
             return
         from scheduler_plugins_tpu.utils.flightrec import pack_pytree
 

@@ -250,6 +250,184 @@ def batch_solve(snap, weights, max_waves: int = 8, collect_stats: bool = False):
     return assignment, admitted, wait
 
 
+def packing_solve(snap, weights, pack_aux, max_waves: int = 8,
+                  mover_cap: int = 128, collect_stats: bool = False):
+    """`batch_solve`'s flagship semantics with the PACKING refinement
+    appended (the third solve mode — ROADMAP item 1, ISSUE 14): the same
+    admission -> static allocatable ranking -> targeted waterfill wave
+    placement, then `ops.packing.packing_refine` consolidation rounds
+    over the wave output, then the shared `finalize_assignment` tail.
+    `pack_aux` is the (4,) traced knob vector (`ops.packing
+    .pack_aux_vector`: iterations, price_weight, temperature, decay) —
+    one compile serves every iteration budget, and budget 0 is
+    bit-identical to `batch_solve` by construction (the refinement loop
+    never runs).
+
+    Hard constraints hold exactly as on the wave path: refinement moves
+    never change WHICH pods are placed (fit holds per move via the
+    sorted-segment admission), so the queue-order quota prefix and gang
+    quorum families see the identical placed set. Returns
+    (assignment, admitted, wait[, stats]) with stats =
+    {waterfill stats, "packing": {rounds, moves, emptied}}."""
+    from scheduler_plugins_tpu.ops.packing import packing_refine
+
+    free0 = free_capacity(snap.nodes.alloc, snap.nodes.requested)
+    admitted = batch_admission(snap, free0)
+    raw = demote_scores_int32(
+        allocatable_scores(snap.nodes.alloc, weights, MODE_LEAST)
+    ).astype(jnp.int64)
+    solve_free0 = jnp.where(snap.nodes.mask[:, None], free0, 0)
+    out = waterfill_assign_targeted(
+        raw, snap.pods.req, admitted, solve_free0,
+        max_waves=max_waves, collect_stats=collect_stats,
+    )
+    assignment, free_w = out[0], out[1]
+    assignment, free_p, pstats = packing_refine(
+        raw, snap.pods.req, admitted, snap.nodes.alloc, snap.nodes.mask,
+        free_w, assignment, pack_aux, mover_cap=mover_cap,
+    )
+    assignment, wait = finalize_assignment(assignment, snap)
+    if collect_stats:
+        return assignment, admitted, wait, {**out[2], "packing": pstats}
+    return assignment, admitted, wait
+
+
+#: the one jitted flagship packing program (bench config 13 + the AOT
+#: manifests share this trace cache; knobs ride the traced pack_aux arg,
+#: so sweeping budgets never recompiles)
+_PACKING_SOLVE_JIT: dict = {}
+
+
+def packing_solve_fn(max_waves: int = 8, mover_cap: int = 128,
+                     collect_stats: bool = True):
+    """The memoized jitted `packing_solve` entry:
+    fn(snap, weights, pack_aux) — the program bench config 13 runs and
+    `tools/tpu_lower.py` AOT-lowers (the same seam discipline as
+    `profile_batch_fn`)."""
+    key = (max_waves, mover_cap, collect_stats)
+    fn = _PACKING_SOLVE_JIT.get(key)
+    if fn is None:
+        fn = _PACKING_SOLVE_JIT[key] = obs.compile_watch(
+            jax.jit(
+                lambda snap, weights, pack_aux: packing_solve(
+                    snap, weights, pack_aux, max_waves=max_waves,
+                    mover_cap=mover_cap, collect_stats=collect_stats,
+                )
+            ),
+            program="packing_solve",
+        )
+    return fn
+
+
+class PackingSolveView:
+    """The (assignment, admitted, wait) triple a packing-mode solve
+    returns to the cycle — deliberately NOT a `SolveResult`: the flight
+    recorder keys replay semantics off the result type, and packing
+    placements must never be recorded as sequential-parity outputs.
+    `stats` carries the refinement counters when collected."""
+
+    __slots__ = ("assignment", "admitted", "wait", "failed_plugin", "stats")
+
+    def __init__(self, assignment, admitted, wait, stats=None):
+        self.assignment = assignment
+        self.admitted = admitted
+        self.wait = wait
+        self.failed_plugin = None
+        self.stats = stats
+
+
+def packing_profile_fn(scheduler, snap, mover_cap: int = 128,
+                       max_waves: int = 8):
+    """(jitted_fn, args) for the packing-mode PROFILE solve — the
+    `Scheduler.solve(mode="packing")` body: the targeted fast-path head
+    (vmapped PreFilter admission + the single scoring plugin's static
+    node ranking, `fast_solve_head`), the wave waterfill, the packing
+    refinement, and the shared finalize tail. Packing knobs ride the
+    traced `pack_aux` argument built from `profile.packing` per solve —
+    the aux-channel discipline, so tuning the budget/price online never
+    recompiles.
+
+    Packing mode requires the targeted fast-path profile shape (ONE
+    pod-invariant scoring plugin, no per-(pod, node) filters —
+    `fast_path_scoring`, the same gate the streamed pipeline uses):
+    refinement moves re-place pods on any fitting node, which is only
+    sound when resource fit is the sole per-node constraint. Profiles
+    outside the gate raise TypeError (load_profile validates the same
+    rule at config time)."""
+    from scheduler_plugins_tpu.ops.packing import packing_refine
+    from scheduler_plugins_tpu.utils import sanitize
+
+    plugins = tuple(scheduler.profile.plugins)
+    scoring_p = fast_path_scoring(plugins)
+    if scoring_p is None:
+        raise TypeError(
+            "packing solve mode requires a profile on the targeted "
+            "fast path (one pod-invariant scoring plugin, no filters) — "
+            f"profile {scheduler.profile.name!r} does not qualify"
+        )
+    state0 = _donation_safe_state(scheduler.initial_state(snap))
+    auxes = tuple(p.aux() for p in plugins)
+    pack_aux = scheduler.profile.packing.aux()
+
+    def pack_batch(snap, state0, auxes, pack_aux):
+        admitted, raw, free0 = fast_solve_head(
+            plugins, scoring_p, snap, state0, auxes
+        )
+        out = waterfill_assign_targeted(
+            raw, snap.pods.req, admitted, free0, max_waves=max_waves,
+        )
+        assignment, free_p, pstats = packing_refine(
+            raw, snap.pods.req, admitted, snap.nodes.alloc,
+            snap.nodes.mask, out[1], out[0], pack_aux,
+            mover_cap=mover_cap,
+        )
+        assignment, wait = finalize_assignment(assignment, snap)
+        return assignment, admitted, wait, pstats
+
+    key = ("profile_packing", max_waves, mover_cap,
+           sanitize.enabled()) + tuple(p.static_key() for p in plugins)
+    cache = scheduler._solve_cache
+    if key not in cache:
+        if sanitize.enabled():
+            fn = sanitize.checkified(pack_batch, program="profile_packing")
+        else:
+            fn = _wrap_donated(jax.jit(pack_batch, donate_argnums=(1,)))
+        cache[key] = obs.compile_watch(fn, program="profile_packing")
+    return cache[key], (snap, state0, auxes, pack_aux)
+
+
+def packing_profile_solve(scheduler, snap, mover_cap: int = 128,
+                          max_waves: int = 8):
+    """Run the packing-mode profile solve; returns a `PackingSolveView`.
+    Under `SPT_PACK_CERTIFY=1` the solve is additionally certified by the
+    `tuning.gates` numpy replay oracles (fit/mask/quota/gang-quorum) and
+    raises on ANY violation — the per-solve certification hook the
+    pack-smoke CI gate runs unconditionally."""
+    import os
+
+    fn, args = packing_profile_fn(
+        scheduler, snap, mover_cap=mover_cap, max_waves=max_waves
+    )
+    assignment, admitted, wait, pstats = fn(*args)
+    view = PackingSolveView(
+        assignment, admitted, wait,
+        stats={k: int(v) for k, v in pstats.items()},
+    )
+    if os.environ.get("SPT_PACK_CERTIFY") == "1":
+        import numpy as np
+
+        from scheduler_plugins_tpu.tuning.gates import hard_violations
+
+        verdict = hard_violations(
+            snap, np.asarray(assignment), np.asarray(wait)
+        )
+        if verdict["total"]:
+            raise AssertionError(
+                f"packing solve violated hard constraints: {verdict}"
+            )
+    return view
+
+
 def profile_batch_solve(scheduler, snap, max_waves: int = 8,
                         collect_stats: bool = False):
     """Run `profile_batch_fn`'s jitted solve — see that docstring for the

@@ -62,6 +62,7 @@ MEM_I = CANONICAL.index(MEMORY)
 SENSE = {
     "fragmentation": -1,
     "util_imbalance": -1,
+    "packed_utilization": +1,
     "gang_wait_frac": -1,
     "unplaced_frac": -1,
     "drift": +1,
@@ -77,7 +78,8 @@ SENSE = {
 
 #: the objectives `cycle_quality` / `cycle_quality_np` emit per cycle
 CYCLE_OBJECTIVES = (
-    "fragmentation", "util_imbalance", "gang_wait_frac", "unplaced_frac",
+    "fragmentation", "util_imbalance", "packed_utilization",
+    "gang_wait_frac", "unplaced_frac",
 )
 
 
@@ -113,6 +115,31 @@ def fragmentation(free, node_mask):
     return frag.mean()
 
 
+def packed_utilization(alloc, free, node_mask):
+    """Scalar float64 packing gauge (ISSUE 14): 1 − the normalized free
+    capacity on nodes HOLDING ≥ 1 POD — per core resource (cpu, memory),
+    sum of free over occupied schedulable nodes divided by the sum of
+    allocatable over the same nodes, averaged over the two and
+    subtracted from 1. A node "holds a pod" when its CANONICAL pods-slot
+    usage (allocatable − free) is positive, so resident AND this-cycle
+    placements both count. 0.0 when no node holds a pod (an empty
+    cluster is not "perfectly packed"); → 1 as the occupied fleet fills.
+    Unlike `fragmentation` (where the free dust sits) this is the direct
+    consolidation gauge the packing solve mode climbs: emptying a
+    lightly-loaded node removes its free from the numerator entirely."""
+    import jax.numpy as jnp
+
+    from scheduler_plugins_tpu.ops import PODS_I
+
+    allocf = jnp.asarray(alloc).astype(jnp.float64)
+    freef = jnp.asarray(free).astype(jnp.float64)
+    occ = node_mask & (allocf[:, PODS_I] - freef[:, PODS_I] > 0)
+    num = jnp.where(occ[:, None], freef, 0.0)[:, (CPU_I, MEM_I)].sum(axis=0)
+    den = jnp.where(occ[:, None], allocf, 0.0)[:, (CPU_I, MEM_I)].sum(axis=0)
+    frac = jnp.where(den > 0, num / jnp.maximum(den, 1.0), 0.0)
+    return jnp.where(occ.any(), (1.0 - frac).mean(), 0.0)
+
+
 def util_imbalance(alloc, free, node_mask):
     """Scalar float64 population stddev of per-node cpu/mem utilization
     over schedulable nodes."""
@@ -141,6 +168,9 @@ def _quality_terms(snap, assignment, wait):
     return {
         "fragmentation": fragmentation(free, snap.nodes.mask),
         "util_imbalance": util_imbalance(
+            snap.nodes.alloc, free, snap.nodes.mask
+        ),
+        "packed_utilization": packed_utilization(
             snap.nodes.alloc, free, snap.nodes.mask
         ),
         "gang_wait_frac": (
@@ -221,6 +251,9 @@ def state_quality(alloc, used, node_mask=None):
     return {
         "fragmentation": float(fragmentation(free, node_mask)),
         "util_imbalance": float(util_imbalance(alloc, free, node_mask)),
+        "packed_utilization": float(
+            packed_utilization(alloc, free, node_mask)
+        ),
     }
 
 
@@ -263,10 +296,23 @@ def cycle_quality_np(snap, assignment, admitted, wait) -> dict:
     mean = float(np.where(node_mask, node_util, 0.0).sum()) / n
     var = float(np.where(node_mask, (node_util - mean) ** 2, 0.0).sum()) / n
 
+    from scheduler_plugins_tpu.ops import PODS_I
+
+    # packed_utilization numpy twin (same float64 arithmetic as the jax
+    # core's `packed_utilization`)
+    allocf2 = alloc.astype(np.float64)
+    freef2 = free.astype(np.float64)
+    occ = node_mask & (allocf2[:, PODS_I] - freef2[:, PODS_I] > 0)
+    num = np.where(occ[:, None], freef2, 0.0)[:, (CPU_I, MEM_I)].sum(axis=0)
+    den = np.where(occ[:, None], allocf2, 0.0)[:, (CPU_I, MEM_I)].sum(axis=0)
+    pfrac = np.where(den > 0, num / np.maximum(den, 1.0), 0.0)
+    packed = float((1.0 - pfrac).mean()) if occ.any() else 0.0
+
     n_real = max(int(pods_mask.sum()), 1)
     return {
         "fragmentation": float(frag.mean()),
         "util_imbalance": float(np.sqrt(var)),
+        "packed_utilization": packed,
         "gang_wait_frac": float((placed & wait).sum())
         / max(int(placed.sum()), 1),
         "unplaced_frac": 1.0 - float(placed.sum()) / n_real,

@@ -596,7 +596,9 @@ def replay_cycle(loaded: LoadedCycle) -> dict:
         _pack_digest(plugin.aux()) == _pack_digest(aux)
         for plugin, aux in zip(scheduler.profile.plugins, auxes)
     )
-    result = scheduler.solve(snap, auxes=auxes)
+    # mode pinned: replay certifies the sequential parity semantics even
+    # when the recorded profile selects another solve mode (packing)
+    result = scheduler.solve(snap, auxes=auxes, mode="sequential")
     assignment = np.asarray(result.assignment)
     recorded = loaded.output("assignment")
     mode = (loaded.manifest.get("outputs") or {}).get("mode")

@@ -11,6 +11,8 @@ import signal
 import subprocess
 import sys
 
+import pytest
+
 from scheduler_plugins_tpu.bridge.leader import LeaseElector
 
 from tests.fake_apiserver import FakeApiServer
@@ -72,6 +74,10 @@ class TestLeaseElector:
 
 
 class TestLeaderElectedDaemons:
+    # `slow`: ~10s of wall-clock subprocess sleeps (two real daemons,
+    # lease expiry windows) — compile-free integration, tier-1 budget
+    # headroom (ISSUE 14); run with `-m slow`
+    @pytest.mark.slow
     def test_standby_takes_over_after_leader_dies(self, tmp_path):
         """Two daemons, one lease: only the leader schedules; killing it
         hands the workload to the standby within the lease duration."""

@@ -91,6 +91,41 @@ class TestQualityDecisionTables:
         q = quality.cycle_quality(snap, assignment, None, wait_pad)
         assert q["gang_wait_frac"] == 0.0
 
+    def test_packed_utilization(self):
+        """ISSUE 14 decision table: 1 − normalized free on nodes holding
+        ≥ 1 pod, hand-computed on the round-number cluster."""
+        snap, _ = _tiny_cluster()
+        assignment, wait = self._fixed(snap)
+        # both nodes occupied; free n0 (cpu 500, mem 800), n1 (2000, 700)
+        packed = 1 - ((500 + 2000) / 4000 + (800 + 700) / 2000) / 2
+        q = quality.cycle_quality(snap, assignment, None, wait)
+        assert q["packed_utilization"] == pytest.approx(packed, abs=1e-12)
+        qn = quality.cycle_quality_np(snap, assignment, None, wait)
+        assert qn["packed_utilization"] == pytest.approx(packed, abs=1e-12)
+        # only n0 occupied: n1's free leaves the gauge entirely
+        one = _padded(snap, np.array([0, -1, -1], np.int32), -1)
+        packed1 = 1 - (500 / 1000 + 800 / 1000) / 2
+        q1 = quality.cycle_quality(snap, one, None, wait)
+        assert q1["packed_utilization"] == pytest.approx(packed1, abs=1e-12)
+        # no pods anywhere: defined as 0.0 (an empty cluster is not
+        # "perfectly packed"), not the 1.0 the raw mean would report
+        nothing = np.full(snap.num_pods, -1, np.int32)
+        q0 = quality.cycle_quality(snap, nothing, None, wait)
+        assert q0["packed_utilization"] == 0.0
+        # the accumulated-state view (configs 7/8, /healthz) is the same
+        # math: used = committed demand incl. the pods slot
+        from scheduler_plugins_tpu.ops import PODS_I
+
+        demand = np.asarray(snap.pods.req).copy()
+        demand[:, PODS_I] = 1
+        used = np.zeros_like(np.asarray(snap.nodes.alloc))
+        placed = assignment >= 0
+        np.add.at(used, assignment[placed], demand[placed])
+        qs = quality.state_quality(
+            np.asarray(snap.nodes.alloc), used, np.asarray(snap.nodes.mask)
+        )
+        assert qs["packed_utilization"] == pytest.approx(packed, abs=1e-12)
+
     def test_empty_cluster_objectives_are_defined(self):
         snap, _ = _tiny_cluster()
         _, wait = self._fixed(snap)

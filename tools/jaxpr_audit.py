@@ -142,6 +142,11 @@ ROLE_OVERRIDES = {
     "sharded_wave_chunk_pallas": (
         "node_ids", "snap.pods.req", "snap.pods.mask", "state.free",
     ),
+    # packing_solve(snap, weights, pack_aux): the flagship packing-mode
+    # program — `weights` is the static allocatable score config and
+    # `pack_aux` the traced packing-knob vector (iterations/price/
+    # temperature/decay), both aux-channel inputs, not snapshot state
+    "packing_solve": ("snap", "aux.weights", "aux.packing"),
     # sweep(snap, state0, auxes, W): the (K, L) candidate weight matrix
     # IS an aux-channel input — per-lane weight scalars bound through
     # Plugin.bind_weight, the traced twin of the profile's static weight

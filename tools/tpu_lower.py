@@ -631,6 +631,27 @@ def build_wave_gang_solve():
     return wave_solve_fn(), args, None
 
 
+def build_packing_solve():
+    """`parallel.solver.packing_solve_fn` — the jitted packing-mode
+    flagship program (ISSUE 14: targeted waterfill wave placement +
+    `ops.packing.packing_refine` consolidation rounds + the shared
+    finalize tail) at the reduced pack-smoke shape. The iteration
+    budget, fragmentation-price weight and temperature schedule are the
+    traced `pack_aux` argument, so ONE program serves every budget the
+    bench frontier sweeps — the property the lowering certifies for
+    TPU (the refinement's `lax.while_loop` bound is a traced scalar)."""
+    import bench
+    from scheduler_plugins_tpu.ops.packing import pack_aux_vector
+    from scheduler_plugins_tpu.parallel.solver import packing_solve_fn
+
+    shape = bench.PACK_SMOKE_SHAPE
+    _, snap, _, weights = bench.packing_problem(
+        shape["n_nodes"], shape["demand_frac"], shape["empty_frac"]
+    )
+    fn = packing_solve_fn(collect_stats=True)
+    return fn, (snap, weights, pack_aux_vector(32, 4.0, 0.0, 0.5)), None
+
+
 def build_sweep_solve():
     """The vmapped counterfactual weight sweep (`parallel.solver
     .sweep_solve_fn` — the tuning observatory's hot program): the
@@ -670,6 +691,7 @@ PROGRAMS = {
     "pallas_ring_offsets": build_pallas_ring_offsets,
     "pallas_fused_election": build_pallas_fused_election,
     "sweep_solve": build_sweep_solve,
+    "packing_solve": build_packing_solve,
     "rank_gang_solve": build_rank_gang_solve,
     "wave_gang_solve": build_wave_gang_solve,
     "elastic_shrink": build_elastic_shrink,

@@ -439,7 +439,7 @@ def cmd_smoke(args) -> int:
         emitted_violations = 0
         for lc, _s, snap, meta, auxes, _anchor, _w, _mode in corpus:
             _prepare_for_cycle(tuned_sched, lc, meta)
-            result = tuned_sched.solve(snap, auxes=auxes)
+            result = tuned_sched.solve(snap, auxes=auxes, mode="sequential")
             emitted_violations += gates.hard_violations(
                 snap, np.asarray(result.assignment), np.asarray(result.wait)
             )["total"]
