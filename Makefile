@@ -122,6 +122,18 @@ tpu-first-cycle:
 pack-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --pack-smoke
 
+# CI online-tuning gate (ISSUE 15): reduced drifting-mix config-14 run —
+# the online-tuned lane (flight-recorder ring + shadow sweeps + guarded
+# rollout through the shared tuning/promotion gates) must beat the
+# static profile on the placement-quality gauges over the drifted mix
+# with ZERO hard-constraint violations, per-tick shadow-lane overhead
+# within max(5%, the run's jitter floor), observe-only lane placements
+# bit-identical to the lane-off control, and the injected-regression
+# phase rolling back to last-known-good within 2 cycles with no flapping
+.PHONY: tune-live-smoke
+tune-live-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tune-live-smoke
+
 # CI resilience gate: reduced chaos-churn run under the FULL seeded fault
 # plan (hung solve, device error, garbage output, dropped/duplicated/
 # corrupted sink deltas, feed stall, crash mid-cycle) — zero
@@ -155,7 +167,7 @@ gang-smoke:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
 
 .PHONY: lint
 lint:

@@ -699,6 +699,7 @@ CONFIG_METRICS = {
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
     10: "rank_gang_pods_per_sec", 11: "cluster_life_pods_per_sec",
     12: "mega_gang_ranks_per_sec", 13: "packing_frontier_pods_per_sec",
+    14: "drifting_mix_pods_per_sec",
 }
 
 
@@ -1710,14 +1711,148 @@ def chaos_churn(shape=None, emit=True, seed=0):
     return line
 
 
+def _tuner_chaos_check(seed=5):
+    """The chaos gate's tuner-fault phase (ISSUE 15): drive the drifting
+    -mix workload twice — a no-tuner control, then a shadow tuner under
+    injected `tune.sweep` (hang past the deadline, garbage sweep output)
+    and `tune.promote` (crash on EVERY application attempt) faults — and
+    prove the robustness contract: every injected tuner fault leaves the
+    LIVE per-cycle placements bit-identical to the control (a sick
+    shadow lane can cost tuning, never a placement), and the tuner
+    either keeps sweeping or disables itself. The hang is injected after
+    the sweep program is warm, against a lowered deadline, so the
+    timeout exercises the abandonment path, not a compile."""
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.resilience import faults as F
+    from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+    from scheduler_plugins_tpu.utils import flightrec
+
+    shape = dict(
+        TUNE_LIVE_SMOKE_SHAPE, n_nodes=32, arrivals=8, departs=3,
+        warmup=2, cycles_a=2, cycles_b=12, regression_cycles=0,
+        settle_cycles=0, candidates=8, corpus=2, sweep_every=2,
+        confirm_sweeps=1,
+    )
+    script, _drift = _drift_script(shape, seed)
+    total = len(script)
+
+    def run_arm(with_tuner):
+        cluster = _drift_cluster(shape, seed)
+        scheduler = _drift_profile()
+        tuner = None
+        plan = None
+        if with_tuner:
+            flightrec.recorder.start(capacity=shape["corpus"] + 2)
+            tuner = ShadowTuner(
+                scheduler, candidates=shape["candidates"],
+                corpus_cycles=shape["corpus"],
+                sweep_every=shape["sweep_every"],
+                confirm_sweeps=shape["confirm_sweeps"],
+                tolerance=shape["tolerance"], sync=True, seed=seed,
+            )
+            plan = F.FaultPlan(seed=seed)
+            plan.specs = [
+                # garbage sweep output on the first post-drift sweeps:
+                # the numpy oracles must disqualify every corrupted lane
+                F.FaultSpec(site=F.TUNE_SWEEP, cycle=5, kind="garbage",
+                            sticky=True),
+                # hang fired later, once the sweep program is warm (the
+                # deadline is lowered right before — see the loop)
+                F.FaultSpec(site=F.TUNE_SWEEP, cycle=9, kind="hang",
+                            seconds=5.0, sticky=True),
+            ] + [
+                # EVERY promotion application crashes (one spec per
+                # cycle: a consumed sticky spec does not re-arm):
+                # nothing the sweeps stage may ever reach live weights
+                F.FaultSpec(site=F.TUNE_PROMOTE, cycle=cc, kind="crash")
+                for cc in range(total)
+            ]
+            F.install(plan)
+        bound_per_cycle = []
+        try:
+            for c, (phase, arrivals, departs) in enumerate(script):
+                now = 1000 * (c + 1)
+                _drift_apply_events(cluster, arrivals, departs, now)
+                _drift_metrics(cluster, shape, phase)
+                if plan is not None:
+                    plan.begin_cycle(c)
+                if tuner is not None:
+                    if c == 9:
+                        # sweep program warm by now: a hang must trip
+                        # the deadline, not masquerade as a slow compile
+                        tuner.deadline_s = 2.0
+                    tuner.begin_cycle(now_ms=now)
+                report = run_cycle(scheduler, cluster, now=now)
+                if tuner is not None:
+                    tuner.observe_report(report)
+                bound_per_cycle.append(dict(report.bound))
+        finally:
+            if with_tuner:
+                F.clear()
+                flightrec.recorder.stop()
+        if with_tuner:
+            # let the abandoned hang worker (5s sleep + one warm sweep)
+            # drain before the process can exit: a daemon thread dying
+            # inside XLA at interpreter teardown aborts the process
+            time.sleep(6.0)
+        return bound_per_cycle, tuner, plan
+
+    control, _t, _p = run_arm(False)
+    chaos, tuner, plan = run_arm(True)
+    st = tuner.status()
+    cycles_match = sum(1 for a, b in zip(chaos, control) if a == b)
+    promote_attempts = sum(
+        1 for entry in plan.log if entry[1] == F.TUNE_PROMOTE
+    )
+    fired_sites = {entry[1] for entry in plan.log}
+    line = {
+        "cycles": total,
+        "cycles_bit_identical": cycles_match,
+        "all_cycles_bit_identical": cycles_match == total,
+        "fault_log": [list(entry) for entry in plan.log],
+        "sweep_hang_fired": (F.TUNE_SWEEP in fired_sites and any(
+            e[1] == F.TUNE_SWEEP and e[2] == "hang" for e in plan.log
+        )),
+        "sweep_garbage_fired": any(
+            e[1] == F.TUNE_SWEEP and e[2] == "garbage" for e in plan.log
+        ),
+        "promote_crashes": promote_attempts,
+        "promotions": st["promotions"],
+        "sweeps": st["sweeps"],
+        "sweep_failures": st["sweep_failures"],
+        "tuner_state": st["state"],
+        # the static profile weights (tlp 1 / lvrb 20) must still rule
+        "weights_unchanged": (
+            st["active_weights"] == [1, 20]
+            and st["last_known_good"] == [1, 20]
+        ),
+    }
+    line["ok"] = bool(
+        line["all_cycles_bit_identical"]
+        and line["sweep_hang_fired"]
+        and line["sweep_garbage_fired"]
+        and line["promote_crashes"] >= 1
+        and line["promotions"] == 0
+        and line["weights_unchanged"]
+        # recovered (kept sweeping after the faults) or self-disabled
+        and (line["tuner_state"] in ("idle", "cooldown", "disabled"))
+        and line["sweep_failures"] >= 1
+    )
+    return line
+
+
 def chaos_smoke(bound_pct=2.0, recovery_bound=4):
     """CI gate (`make chaos-smoke`): reduced chaos config under the FULL
     seeded fault plan — zero hard-constraint violations, every fault
     fired and recovered within `recovery_bound` cycles, every cycle
     bit-identical to the no-chaos control, and fault-free watchdog
-    overhead within max(`bound_pct`%, the run's own jitter floor). One
-    JSON line; rc 1 on any failure."""
+    overhead within max(`bound_pct`%, the run's own jitter floor) — plus
+    the tuner-fault phase (`_tuner_chaos_check`): injected tune.sweep /
+    tune.promote faults leave live placements bit-identical to a
+    no-tuner control and the tuner recovers or disables itself. One JSON
+    line; rc 1 on any failure."""
     line = chaos_churn(shape=CHAOS_SMOKE_SHAPE, emit=False)
+    tuner_chaos = _tuner_chaos_check()
     overhead_bound = max(bound_pct, line["overhead_jitter_floor_pct"])
     ok = (
         line["capacity_violations"] == 0
@@ -1731,6 +1866,7 @@ def chaos_smoke(bound_pct=2.0, recovery_bound=4):
         # (drop/dup/corrupt) plus the post-crash stale-checkpoint detect
         and line["antientropy_divergences"] >= 3
         and line["watchdog_overhead_pct"] <= overhead_bound
+        and tuner_chaos["ok"]
     )
     print(json.dumps({
         "metric": "chaos_smoke",
@@ -1738,6 +1874,7 @@ def chaos_smoke(bound_pct=2.0, recovery_bound=4):
         "overhead_bound_pct": round(overhead_bound, 2),
         "recovery_bound_cycles": recovery_bound,
         "ok": bool(ok),
+        "tuner_chaos": tuner_chaos,
         **line,
     }))
     return 0 if ok else 1
@@ -3128,6 +3265,632 @@ def pack_smoke(min_gain=1e-4, drift_bound=0.15):
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# config 14: drifting mix — online self-tuning serving vs the static profile
+# ---------------------------------------------------------------------------
+
+#: the config-14 headline shape (ISSUE 15 / ROADMAP item 2): a trimaran
+#: pair (TargetLoadPacking + LoadVariationRiskBalancing) serving a
+#: sustained-churn workload whose MIX DRIFTS mid-run — a hot/cold node
+#: fleet whose formerly-quiet class turns metric-noisy (colocated batch
+#: jobs) while the pod-size mix goes bimodal, so the LVRB variance term
+#: starts steering pods AWAY from the emptiest nodes and the static
+#: profile's weight split stops being the right one. Four arms/phases:
+#: tuned-vs-static quality over the drift, an interleaved-pairs
+#: shadow-lane overhead bound, an injected-regression phase where the
+#: probation auto-rollback is observed, and a no-flap settle window.
+TUNE_LIVE_SHAPE = dict(
+    n_nodes=96, hot_frac=0.25, hot_util=0.62, cold_util=0.15,
+    arrivals=24, departs=10,
+    warmup=8, cycles_a=8, cycles_b=14, regression_cycles=12,
+    settle_cycles=4,
+    candidates=16, corpus=3, sweep_every=2, confirm_sweeps=2,
+    probation_cycles=8, baseline_window=8, baseline_min=2,
+    baseline_recent=3, hysteresis=0.002, regress_cycles=2, cooldown=16,
+    tolerance=0.01,
+    deadline_s=60.0, inject=(1, 64),
+)
+#: reduced shape for the `make tune-live-smoke` CI gate (2-core runners)
+TUNE_LIVE_SMOKE_SHAPE = dict(
+    n_nodes=48, hot_frac=0.25, hot_util=0.62, cold_util=0.15,
+    arrivals=16, departs=6,
+    warmup=8, cycles_a=6, cycles_b=12, regression_cycles=12,
+    settle_cycles=4,
+    candidates=12, corpus=3, sweep_every=2, confirm_sweeps=2,
+    probation_cycles=8, baseline_window=8, baseline_min=2,
+    baseline_recent=3, hysteresis=0.002, regress_cycles=2, cooldown=16,
+    tolerance=0.01,
+    deadline_s=60.0, inject=(1, 64),
+)
+#: interleaved lane-on/lane-off pairs for the shadow overhead bound (the
+#: chaos/replay pairing discipline: statistic = median of PAIRED deltas,
+#: floor = the off series' own p10-p90 spread)
+TUNE_OVERHEAD_PAIRS = 9
+
+#: probation objectives (the per-cycle quality gauges the tuned-vs-static
+#: comparison and the rollback detection both read) — must equal
+#: `tuning.shadow.PROBATION_OBJECTIVES` (asserted by
+#: `tuned_drifting_mix`; stated literally here because bench.py imports
+#: the package lazily, after `apply_platform_override`)
+TUNE_OBJECTIVES = (
+    "fragmentation", "util_imbalance", "gang_wait_frac", "unplaced_frac",
+)
+
+
+def _drift_cluster(shape, seed=0):
+    """Hot/cold fleet with an imbalanced ALREADY-BOUND base load: the
+    first `hot_frac` of nodes prefilled to `hot_util` of cpu, the rest to
+    `cold_util` — the imbalance the load-aware profile is there to work
+    against, and the request distribution the per-cycle metrics mirror."""
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    cluster = Cluster()
+    n = shape["n_nodes"]
+    hot = max(1, int(n * shape["hot_frac"]))
+    serial = 0
+    for i in range(n):
+        cluster.add_node(Node(
+            name=f"node-{i:05d}",
+            allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 512},
+        ))
+        target = shape["hot_util"] if i < hot else shape["cold_util"]
+        filled = 0
+        while filled < int(64_000 * target):
+            serial += 1
+            pod = Pod(
+                name=f"base-{serial:06d}", creation_ms=serial,
+                containers=[Container(requests={
+                    CPU: 2000, MEMORY: 4 * gib})],
+            )
+            pod.node_name = f"node-{i:05d}"
+            cluster.add_pod(pod)
+            filled += 2000
+    return cluster
+
+
+def _drift_script(shape, seed=0):
+    """(script, drift_at): the per-cycle event script — (phase, arrivals
+    [(name, cpu, mem)], departures [names]) — generated ONCE from the rng
+    stream alone, fully independent of placements, so every arm (static,
+    tuned, lane-on, lane-off) replays the identical workload and quality
+    deltas are attributable to the weights, never the stream. Departures
+    draw only from pods that arrived in EARLIER cycles."""
+    rng = np.random.default_rng(seed + 1)
+    gib = 1 << 30
+    total = (shape["warmup"] + shape["cycles_a"] + shape["cycles_b"]
+             + shape["regression_cycles"] + shape["settle_cycles"])
+    drift_at = shape["warmup"] + shape["cycles_a"]
+    serial = 0
+    live: list = []
+    script = []
+    for c in range(total):
+        phase = "a" if c < drift_at else "b"
+        departs = []
+        k = min(shape["departs"], len(live))
+        if k > 0:
+            picks = sorted(
+                int(x) for x in
+                rng.choice(len(live), size=k, replace=False)
+            )
+            departs = [live[i] for i in picks]
+            live = [nm for i, nm in enumerate(live) if i not in set(picks)]
+        arrivals = []
+        for _ in range(shape["arrivals"]):
+            serial += 1
+            if phase == "a":
+                cpu = int(rng.integers(800, 1600))
+                mem = int(rng.integers(gib, 2 * gib))
+            else:
+                # bimodal post-drift mix: sidecar dust + fat batch pods
+                if rng.random() < 0.5:
+                    cpu, mem = 600, gib // 2
+                else:
+                    cpu, mem = 4200, 3 * gib
+            name = f"arr-{serial:06d}"
+            arrivals.append((name, cpu, mem))
+            live.append(name)
+        script.append((phase, arrivals, departs))
+    return script, drift_at
+
+
+def _drift_metrics(cluster, shape, phase) -> None:
+    """Refresh `cluster.node_metrics` for one cycle: cpu/mem averages
+    mirror the ACTUAL requested utilization per node (a live
+    load-watcher), while the variance term drifts with the phase — in
+    phase "b" the cold class turns metric-noisy (cpu_std 60: colocated
+    batch interference), which makes the LVRB risk curve steer pods away
+    from exactly the nodes that balance the fleet. The drift is the
+    tuning opportunity: phase "a" weights stop being right."""
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+    n = len(cluster.nodes)
+    hot = max(1, int(n * shape["hot_frac"]))
+    used_cpu: dict = {}
+    used_mem: dict = {}
+    for pod in cluster.pods.values():
+        if pod.node_name is None:
+            continue
+        req = pod.effective_request()
+        used_cpu[pod.node_name] = used_cpu.get(pod.node_name, 0) + req.get(
+            CPU, 0
+        )
+        used_mem[pod.node_name] = used_mem.get(pod.node_name, 0) + req.get(
+            MEMORY, 0
+        )
+    metrics = {}
+    for i, (name, node) in enumerate(cluster.nodes.items()):
+        cpu_pct = 100.0 * used_cpu.get(name, 0) / max(
+            node.allocatable.get(CPU, 1), 1
+        )
+        mem_pct = 100.0 * used_mem.get(name, 0) / max(
+            node.allocatable.get(MEMORY, 1), 1
+        )
+        noisy = phase == "b" and i >= hot
+        metrics[name] = {
+            "cpu_avg": min(cpu_pct, 100.0),
+            "cpu_std": 60.0 if noisy else 3.0,
+            "mem_avg": min(mem_pct, 100.0),
+            "mem_std": 8.0 if noisy else 2.0,
+        }
+    cluster.node_metrics = metrics
+
+
+def _drift_profile():
+    """The static serving profile: a trimaran pair whose weights TRUST
+    the variance signal (LVRB 20 : TLP 1 — the right call in phase "a",
+    where metric noise really does flag bad nodes). The phase-"b" drift
+    makes exactly that trust misleading: the noisy-but-empty cold class
+    is where pods SHOULD go, and the static profile starts steering
+    arrivals onto the already-hot nodes — the regression the online
+    tuner exists to close."""
+    from scheduler_plugins_tpu import plugins as P
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+
+    tlp = P.TargetLoadPacking()
+    lvrb = P.LoadVariationRiskBalancing()
+    lvrb.weight = 20
+    return Scheduler(Profile(plugins=[tlp, lvrb]))
+
+
+def _drift_apply_events(cluster, arrivals, departs, now) -> None:
+    from scheduler_plugins_tpu.api.objects import Container, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+    for name in departs:
+        uid = f"default/{name}"
+        if uid in cluster.pods:
+            cluster.remove_pod(uid)
+    for name, cpu, mem in arrivals:
+        cluster.add_pod(Pod(
+            name=name, creation_ms=now,
+            containers=[Container(requests={CPU: cpu, MEMORY: mem})],
+        ))
+
+
+def _sense_quality_win(tuned_rows, static_rows) -> float:
+    """Sense-adjusted placement-quality delta, positive = tuned better:
+    sum over the per-cycle objectives of SENSE * (mean_tuned -
+    mean_static) in each objective's own dimensionless units (the
+    promotion gate's own ranking rule, applied between arms)."""
+    from scheduler_plugins_tpu.tuning.quality import SENSE
+
+    if not tuned_rows or not static_rows:
+        return 0.0
+    win = 0.0
+    for name in TUNE_OBJECTIVES:
+        t = [q[name] for q in tuned_rows if name in q]
+        s = [q[name] for q in static_rows if name in q]
+        if t and s:
+            win += SENSE[name] * (float(np.mean(t)) - float(np.mean(s)))
+    return win
+
+
+def _run_drift_arm(shape, seed=0, tuned=False):
+    """One full drifting-mix run. `tuned=False` is the static-profile
+    control; `tuned=True` arms the flight recorder + a synchronous
+    ShadowTuner (sweeps deadlined inline at the cycle boundary — the
+    seam order production uses, with the sweep wall time accounted
+    SEPARATELY from the cycle timing: in the daemon the sweep runs on a
+    background worker, and the per-tick lane overhead has its own
+    interleaved-pairs phase) and drives the injected-regression phase.
+    Returns per-cycle times/decisions/quality plus the tuner ledger."""
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.tuning.quality import SENSE
+    from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+    from scheduler_plugins_tpu.utils import flightrec
+
+    script, drift_at = _drift_script(shape, seed)
+    b_end = drift_at + shape["cycles_b"]
+    inject_at = b_end
+    cluster = _drift_cluster(shape, seed)
+    scheduler = _drift_profile()
+    tuner = None
+    if tuned:
+        flightrec.recorder.start(capacity=shape["corpus"] + 2)
+        tuner = ShadowTuner(
+            scheduler,
+            candidates=shape["candidates"],
+            corpus_cycles=shape["corpus"],
+            sweep_every=shape["sweep_every"],
+            confirm_sweeps=shape["confirm_sweeps"],
+            tolerance=shape["tolerance"],
+            probation_cycles=shape["probation_cycles"],
+            baseline_window=shape["baseline_window"],
+            baseline_min=shape["baseline_min"],
+            baseline_recent=shape["baseline_recent"],
+            hysteresis=shape["hysteresis"],
+            regress_cycles=shape["regress_cycles"],
+            cooldown_cycles=shape["cooldown"],
+            deadline_s=shape["deadline_s"],
+            sync=True, seed=seed,
+        )
+    out = {
+        "times": [], "decided": [], "quality": [], "violations": 0,
+        "promotions": [], "sweep_wall_s": 0.0, "weights_by_cycle": [],
+        "rollback": None, "regress_seen_at": None, "injected_at": None,
+    }
+    try:
+        promotions_seen = 0
+        for c, (phase, arrivals, departs) in enumerate(script):
+            now = 1000 * (c + 1)
+            _drift_apply_events(cluster, arrivals, departs, now)
+            _drift_metrics(cluster, shape, phase)
+            if tuner is not None:
+                st = tuner.status()
+                if (
+                    out["injected_at"] is None and c >= inject_at
+                    and st["state"] == "idle"
+                    and st["promotions"] > st["rollbacks"]
+                    and st["active_weights"] == st["last_known_good"]
+                ):
+                    # the injected-regression phase, armed only once the
+                    # REAL promotion has been confirmed: stage a
+                    # known-bad vector past the gates (the documented
+                    # harness-only hook) — the probation window must
+                    # catch it and roll back to the confirmed weights
+                    tuner.inject_promotion(shape["inject"])
+                    out["injected_at"] = c
+                    out["rollbacks_pre_inject"] = st["rollbacks"]
+                sweep_t0 = time.perf_counter()
+                tuner.begin_cycle(now_ms=now)
+                out["sweep_wall_s"] += time.perf_counter() - sweep_t0
+                st = tuner.status()
+                if st["promotions"] > promotions_seen:
+                    promotions_seen = st["promotions"]
+                    out["promotions"].append(
+                        {"cycle": c, "weights": st["active_weights"],
+                         # the injected promotion may apply a cycle or
+                         # two after staging (probation/inflight
+                         # deferral) — identify it by its weights
+                         "injected": (
+                             out["injected_at"] is not None
+                             and st["active_weights"]
+                             == list(shape["inject"])
+                         )}
+                    )
+            start = time.perf_counter()
+            with _bench_span(f"drift cycle {c}", phase=phase, tuned=tuned):
+                report = run_cycle(scheduler, cluster, now=now)
+            elapsed = time.perf_counter() - start
+            if tuner is not None:
+                tuner.observe_report(report)
+                st = tuner.status()
+                if (
+                    out["injected_at"] is not None
+                    and c >= out["injected_at"]
+                    and st["state"] == "probation"
+                    and st["baseline"] and report.quality is not None
+                    and out["regress_seen_at"] is None
+                ):
+                    # first cycle the injected regression is DETECTABLE:
+                    # any probation objective past the hysteresis band
+                    for name in TUNE_OBJECTIVES:
+                        if name not in st["baseline"]:
+                            continue
+                        delta = SENSE[name] * (
+                            report.quality[name] - st["baseline"][name]
+                        )
+                        if delta < -shape["hysteresis"]:
+                            out["regress_seen_at"] = c
+                            break
+                if (
+                    out["rollback"] is None
+                    and out["injected_at"] is not None
+                    and st["rollbacks"] > out.get("rollbacks_pre_inject", 0)
+                ):
+                    out["rollback"] = {
+                        "cycle": c,
+                        "reason": st["last_rollback_reason"],
+                        "restored_weights": st["active_weights"],
+                    }
+            out["weights_by_cycle"].append(
+                [int(p.weight) for p in scheduler.profile.plugins]
+            )
+            out["times"].append(elapsed)
+            out["decided"].append(len(report.bound) + len(report.failed))
+            out["quality"].append(dict(report.quality or {}))
+            out["violations"] += _churn_capacity_violations(cluster)
+    finally:
+        if tuned:
+            flightrec.recorder.stop()
+    out["tuner"] = tuner.status() if tuner is not None else None
+    out["drift_at"] = drift_at
+    out["b_end"] = b_end
+    out["inject_at"] = inject_at
+    return out
+
+
+def _tune_overhead_pct(shape, seed=77):
+    """Per-tick shadow-lane overhead, the replay/chaos pairing way: two
+    identically-evolving drift clusters sharing ONE scheduler, one cycle
+    each per pair (lane OFF first, then lane ON = flight-recorder
+    capture + tuner hooks in observe-only mode with the sweep worker in
+    its production background shape). Two passes over the same seeded
+    script — the first untimed, warming every jit shape AND letting the
+    background sweep program compile; the timed pass then suppresses new
+    sweep dispatches so the statistic bounds the ALWAYS-ON per-tick lane
+    cost (hook + ring capture + worker poll; background sweep wall time
+    is reported separately by the main arm). Returns (overhead_pct,
+    jitter_floor_pct, placements_match) — the observe-only lane must
+    never change a placement."""
+    from scheduler_plugins_tpu.framework import run_cycle
+    from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+    from scheduler_plugins_tpu.utils import flightrec
+
+    script, _ = _drift_script(shape, seed)
+    n_cycles = shape["warmup"] + TUNE_OVERHEAD_PAIRS
+    script = script[:n_cycles]
+    scheduler = _drift_profile()
+    off, pair_pct = [], []
+    placements_match = True
+    for timed in (False, True):
+        arms = {
+            name: {"cluster": _drift_cluster(shape, seed)}
+            for name in ("off", "on")
+        }
+        flightrec.recorder.start(capacity=shape["corpus"] + 2)
+        flightrec.recorder.stop()  # armed per on-cycle via resume()
+        tuner = ShadowTuner(
+            scheduler,
+            candidates=shape["candidates"],
+            corpus_cycles=shape["corpus"],
+            sweep_every=shape["sweep_every"],
+            deadline_s=shape["deadline_s"],
+            observe_only=True, sync=False, seed=seed,
+        )
+        for c, (phase, arrivals, departs) in enumerate(script):
+            now = 1000 * (c + 1)
+            if timed and c == shape["warmup"]:
+                # timed pairs bound the always-on per-tick cost: no NEW
+                # sweep dispatches mid-measurement, and the one in
+                # flight (if any) drains first
+                tuner.sweep_every = 10 ** 9
+                tuner.quiesce(shape["deadline_s"])
+
+            def one(arm_name):
+                arm = arms[arm_name]
+                _drift_apply_events(
+                    arm["cluster"], arrivals, departs, now
+                )
+                _drift_metrics(arm["cluster"], shape, phase)
+                lane = arm_name == "on"
+                if lane:
+                    flightrec.recorder.resume()
+                    start = time.perf_counter()
+                    tuner.begin_cycle(now_ms=now)
+                    report = run_cycle(scheduler, arm["cluster"], now=now)
+                    tuner.observe_report(report)
+                    elapsed = time.perf_counter() - start
+                    flightrec.recorder.stop()
+                else:
+                    start = time.perf_counter()
+                    report = run_cycle(scheduler, arm["cluster"], now=now)
+                    elapsed = time.perf_counter() - start
+                return elapsed, dict(report.bound)
+
+            t_off, bound_off = one("off")
+            t_on, bound_on = one("on")
+            if bound_off != bound_on:
+                placements_match = False
+            if timed and c >= shape["warmup"]:
+                off.append(t_off)
+                pair_pct.append(100.0 * (t_on - t_off) / t_off)
+        tuner.quiesce(shape["deadline_s"])
+    flightrec.recorder.stop()
+    off_sorted = sorted(off)
+    median_off = off_sorted[len(off) // 2]
+    overhead_pct = sorted(pair_pct)[len(pair_pct) // 2]
+    spread_pct = 100.0 * (
+        off_sorted[int(0.9 * (len(off) - 1))]
+        - off_sorted[int(0.1 * (len(off) - 1))]
+    ) / median_off
+    return overhead_pct, spread_pct, placements_match
+
+
+def tuned_drifting_mix(shape=None, emit=True, seed=0):
+    """Config 14: the drifting-mix bench. Runs the SAME drifting event
+    script twice — the static profile vs the online-tuned lane
+    (flight-recorder ring + ShadowTuner: deadlined shadow sweeps, gated
+    promotion through the aux channel, probation auto-rollback) — then
+    measures the shadow lane's per-tick overhead with interleaved pairs
+    and drives an injected-regression phase where the rollback is
+    observed. Headline claims (asserted by `tune_live_smoke`): the tuned
+    lane beats the static profile on the placement-quality gauges over
+    the drifted mix with ZERO hard-constraint violations, lane overhead
+    within max(5%, the jitter floor), rollback within
+    `regress_cycles` (<= 2) cycles of the first detectable regression,
+    and no flapping afterwards."""
+    from scheduler_plugins_tpu.tuning.shadow import PROBATION_OBJECTIVES
+    from scheduler_plugins_tpu.utils import observability as obs_
+
+    assert TUNE_OBJECTIVES == PROBATION_OBJECTIVES
+    shape = shape or TUNE_LIVE_SHAPE
+    sweep_miss0 = obs_.metrics.get(
+        obs_.JIT_CACHE_MISS, program="sweep_solve"
+    )
+    static = _run_drift_arm(shape, seed=seed, tuned=False)
+    tuned = _run_drift_arm(shape, seed=seed, tuned=True)
+    sweep_compiles = obs_.metrics.get(
+        obs_.JIT_CACHE_MISS, program="sweep_solve"
+    ) - sweep_miss0
+
+    drift_at, b_end = tuned["drift_at"], tuned["b_end"]
+    warmup = shape["warmup"]
+    # timed window: post-warmup through the end of phase B (the
+    # regression/settle phases exist to demonstrate rollback, not to
+    # pollute the throughput or quality comparison)
+    t_idx = list(range(warmup, b_end))
+    serve_s = sum(tuned["times"][i] for i in t_idx)
+    static_s = sum(static["times"][i] for i in t_idx)
+    n_decided = sum(tuned["decided"][i] for i in t_idx)
+
+    real_promos = [p for p in tuned["promotions"] if not p["injected"]]
+    promo_cycle = real_promos[0]["cycle"] if real_promos else None
+    post_idx = (
+        list(range(max(promo_cycle, drift_at), b_end))
+        if promo_cycle is not None and promo_cycle < b_end
+        else list(range(drift_at, b_end))
+    )
+    win_post = _sense_quality_win(
+        [tuned["quality"][i] for i in post_idx],
+        [static["quality"][i] for i in post_idx],
+    )
+    win_overall = _sense_quality_win(
+        [tuned["quality"][i] for i in t_idx],
+        [static["quality"][i] for i in t_idx],
+    )
+
+    rollback = tuned["rollback"]
+    tuner_final = tuned["tuner"]
+    regress_at = tuned["regress_seen_at"]
+    detect_cycles = (
+        tuner_final["last_rollback_detect_cycles"]
+        if rollback is not None else None
+    )
+    # no flapping: after the rollback the controller must hold the
+    # last-known-good weights through the settle window — no further
+    # promotion, the injected vector blocked
+    flapped = bool(
+        rollback is not None and (
+            any(p["cycle"] > rollback["cycle"] for p in tuned["promotions"])
+            or tuner_final["active_weights"]
+            != tuner_final["last_known_good"]
+        )
+    )
+    overhead_pct, jitter_floor_pct, lane_placements_match = (
+        _tune_overhead_pct(shape, seed + 77)
+    )
+
+    line = {
+        "cycles": len(t_idx),
+        "drift_at_cycle": drift_at,
+        "promotions": len(real_promos),
+        "promotion_cycle": promo_cycle,
+        "promoted_weights": (
+            real_promos[0]["weights"] if real_promos else None
+        ),
+        "static_weights": static["weights_by_cycle"][0],
+        "quality_win_post_promotion": round(win_post, 6),
+        "quality_win_overall": round(win_overall, 6),
+        "tuned_quality_post": {
+            name: round(float(np.mean(
+                [tuned["quality"][i][name] for i in post_idx]
+            )), 6)
+            for name in TUNE_OBJECTIVES
+        },
+        "static_quality_post": {
+            name: round(float(np.mean(
+                [static["quality"][i][name] for i in post_idx]
+            )), 6)
+            for name in TUNE_OBJECTIVES
+        },
+        "capacity_violations": tuned["violations"] + static["violations"],
+        "sweeps": tuner_final["sweeps"],
+        "sweep_failures": tuner_final["sweep_failures"],
+        "sweep_compiles": int(sweep_compiles),
+        "shadow_sweep_wall_s": round(tuned["sweep_wall_s"], 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_jitter_floor_pct": round(jitter_floor_pct, 2),
+        "observe_only_placements_match": bool(lane_placements_match),
+        "injected_weights": list(shape["inject"]),
+        "injected_at_cycle": tuned["injected_at"],
+        "regression_detected_cycle": regress_at,
+        "rollback": rollback,
+        "rollback_detect_cycles": detect_cycles,
+        "rollbacks_total": tuner_final["rollbacks"],
+        "flapped": flapped,
+        "tuner_state_final": tuner_final["state"],
+        "decisions": n_decided,
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[14],
+            n_decided / serve_s if serve_s else 0.0,
+            f"{shape['n_nodes']} nodes drifting mix, {len(t_idx)} cycles, "
+            f"tuned lane (shadow sweeps + guarded rollout) vs static "
+            f"profile",
+            baseline=(
+                sum(static['decided'][i] for i in t_idx) / static_s
+                if static_s else 1.0
+            ),
+            # the tuned lane solves through the bit-faithful sequential
+            # parity path under its live weights — drift vs that
+            # semantics is 0 by definition; the quality columns carry
+            # the tuned-vs-static comparison
+            drift=0.0,
+            quality=line["tuned_quality_post"],
+            extra=line,
+        )
+    return line
+
+
+def tune_live_smoke(bound_pct=5.0, rollback_bound=2):
+    """CI gate (`make tune-live-smoke`): reduced drifting-mix run — the
+    tuned lane must promote (through the shared gates) and beat the
+    static profile on the placement-quality gauges over the drifted mix,
+    with zero hard-constraint violations, per-tick shadow-lane overhead
+    within max(`bound_pct`%, the run's own jitter floor), observe-only
+    lane placements bit-identical to the lane-off control, ONE vmapped
+    sweep compile, and the injected-regression phase rolling back within
+    `rollback_bound` cycles of first detectability with no flapping.
+    One JSON line; rc 1 on any failure."""
+    line = tuned_drifting_mix(shape=TUNE_LIVE_SMOKE_SHAPE, emit=False)
+    overhead_bound = max(bound_pct, line["overhead_jitter_floor_pct"])
+    checks = {
+        "promoted": line["promotions"] >= 1,
+        "tuned_beats_static": line["quality_win_post_promotion"] > 0,
+        "tuned_not_worse_overall": line["quality_win_overall"] >= -0.002,
+        "zero_violations": line["capacity_violations"] == 0,
+        "overhead_bounded": line["overhead_pct"] <= overhead_bound,
+        "observe_lane_placements_identical":
+            line["observe_only_placements_match"],
+        # one vmapped compile per pod-count bucket (arrivals + retries
+        # land on a couple of power-of-two buckets over the run)
+        "sweep_compiles_bounded": 0 < line["sweep_compiles"] <= 6,
+        "no_sweep_failures": line["sweep_failures"] == 0,
+        "rollback_observed": line["rollback"] is not None,
+        "rollback_within_bound": (
+            line["rollback_detect_cycles"] is not None
+            and line["rollback_detect_cycles"] <= rollback_bound
+        ),
+        "no_flapping": not line["flapped"],
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "tune_live_smoke",
+        "backend": _backend_label(),
+        "overhead_bound_pct": round(overhead_bound, 2),
+        "rollback_bound_cycles": rollback_bound,
+        "checks": checks,
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
+
+
 #: the columns every emitted bench line must carry regardless of path
 #: (success, error, stale-capture replay) — THE one schema statement the
 #: error/replay builders below and tests/test_bench_lines.py share, so a
@@ -3560,7 +4323,12 @@ if __name__ == "__main__":
                              "scan, bit-identical placements; 13 = "
                              "packing frontier: the packing solve mode "
                              "vs the wave path over iteration budgets — "
-                             "utilization vs drift vs latency); "
+                             "utilization vs drift vs latency; 14 = "
+                             "drifting mix: online self-tuned serving "
+                             "(shadow sweeps + guarded rollout + "
+                             "probation auto-rollback) vs the static "
+                             "profile over a workload mix that drifts "
+                             "mid-run); "
                              "default flagship")
     parser.add_argument("--mode", choices=["sequential", "batch"],
                         default="sequential",
@@ -3635,6 +4403,15 @@ if __name__ == "__main__":
                              "zero hard-constraint violations, budget-0 "
                              "bit-parity with the wave placements, and "
                              "bounded drift")
+    parser.add_argument("--tune-live-smoke", action="store_true",
+                        help="CI gate: reduced drifting-mix config-14 "
+                             "run; fails unless the online-tuned lane "
+                             "promotes through the shared gates and "
+                             "beats the static profile on placement "
+                             "quality with zero violations, bounded "
+                             "shadow-lane overhead, and the injected-"
+                             "regression phase rolling back within 2 "
+                             "cycles with no flapping")
     parser.add_argument("--chaos-smoke", action="store_true",
                         help="CI gate: reduced chaos-churn run under the "
                              "full seeded fault plan (hung solve, device "
@@ -3708,6 +4485,16 @@ if __name__ == "__main__":
         # bit-parity gated) — both arms share the backend, so no tunnel
         # probe (its health cancels out of every asserted claim)
         packing_frontier()
+        sys.exit(0)
+    if args.tune_live_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # tuned-vs-static comparison on one seeded stream — no tunnel probe
+        sys.exit(tune_live_smoke())
+    if args.config == 14:
+        # tuned-lane vs static-profile comparison on one seeded drifting
+        # stream — both arms share whatever backend is configured, so no
+        # tunnel probe (its health cancels out of every asserted claim)
+        tuned_drifting_mix()
         sys.exit(0)
     if args.config == 10:
         # rank-aware vs quorum-only comparison, full shape — both arms

@@ -147,6 +147,26 @@ def parse_args(argv=None):
                          "engine upgrades to the O(changed) "
                          "StreamingServeEngine (node-delete compaction, "
                          "memoized ingest, O(assigned) anti-entropy)")
+    ap.add_argument("--tune", action="store_true",
+                    help="online self-tuning shadow lane "
+                         "(tuning.shadow.ShadowTuner): continuously "
+                         "replay the recorded flight-recorder ring under "
+                         "candidate plugin-weight vectors on a background "
+                         "worker (deadlined — a hung sweep degrades to "
+                         "'no tuning'), promote a winner only through "
+                         "the tuning.promotion gates, roll it out live "
+                         "via the aux channel (zero recompiles) and "
+                         "auto-roll-back on quality-gauge regression "
+                         "during probation. Implies --record 8 when "
+                         "--record is not set (the ring IS the sweep "
+                         "corpus). With --checkpoint, the promoted "
+                         "weights + probation state persist to "
+                         "<checkpoint>.tuner.json on shutdown and "
+                         "restart resumes with them")
+    ap.add_argument("--tune-candidates", type=int, default=24,
+                    help="candidate weight vectors per shadow sweep")
+    ap.add_argument("--tune-sweep-every", type=int, default=8,
+                    help="cycles between shadow sweep dispatches")
     ap.add_argument("--resilient", action="store_true",
                     help="solve watchdog + degraded-mode failover "
                          "(resilience.watchdog): device solves complete "
@@ -263,6 +283,12 @@ class HealthServer:
                             "gang_fallbacks":
                                 outer.engine.gang_fallbacks,
                         }
+                    if outer.tuner is not None:
+                        # online self-tuning controller state (guarded
+                        # rollout, docs/ROBUSTNESS.md): active weights +
+                        # digest, probation progress, promotion/rollback
+                        # counters, self-disable reason
+                        payload["tuner"] = outer.tuner.status()
                     if outer.elector is not None:
                         payload["leader"] = outer.elector.is_leader
                         payload["holder"] = outer.elector.observed_holder
@@ -344,6 +370,9 @@ class Daemon:
         self.args = args
         self.profile = load_profile_file(args.profile)
         self.scheduler = Scheduler(self.profile)
+        if args.tune and not args.record:
+            # the flight-recorder ring IS the shadow lane's sweep corpus
+            args.record = 8
         if args.record:
             from scheduler_plugins_tpu.utils import flightrec
 
@@ -387,6 +416,40 @@ class Daemon:
             from scheduler_plugins_tpu.resilience import Resilience
 
             self.resilience = Resilience(engine=self.engine)
+        self.tuner = None
+        if args.tune:
+            from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+
+            try:
+                self.tuner = ShadowTuner(
+                    self.scheduler,
+                    candidates=args.tune_candidates,
+                    sweep_every=args.tune_sweep_every,
+                )
+            except ValueError as exc:
+                # e.g. a packing-mode profile: the rollout seam is the
+                # sequential parity path — refuse at startup, clearly
+                raise SystemExit(f"--tune: {exc}")
+            if args.checkpoint and os.path.exists(
+                self._tuner_state_path()
+            ):
+                try:
+                    with open(self._tuner_state_path()) as f:
+                        restored = self.tuner.restore_state(json.load(f))
+                    if restored:
+                        obs.logger.info(
+                            "tuner state restored from %s: weights %s "
+                            "(%s)", self._tuner_state_path(),
+                            self.tuner.status()["active_weights"],
+                            self.tuner.status()["state"],
+                        )
+                except Exception as exc:
+                    # a bad state file must never block startup: the
+                    # tuner just starts fresh on the profile weights
+                    obs.logger.warning(
+                        "tuner state restore failed (%s): starting from "
+                        "the profile weights", exc,
+                    )
         self.pipeline = None
         if args.pipeline:
             from scheduler_plugins_tpu.framework import PipelinedCycle
@@ -479,6 +542,12 @@ class Daemon:
                 t.start()
                 self._agent_threads.append(t)
 
+    def _tuner_state_path(self) -> str:
+        """The tuner's persisted controller state rides NEXT TO the
+        resilience checkpoint (same crash-safe write discipline): the
+        promoted weights + probation window survive a SIGTERM restart."""
+        return f"{self.args.checkpoint}.tuner.json"
+
     def _agent_loop(self, path: str):
         """One reflector per watch path, feeding events through the real
         TCP wire to our own feed server (the exact path an external Go/C++
@@ -545,12 +614,20 @@ class Daemon:
         cycle_started = time.monotonic()
         try:
             if self.pipeline is not None:
+                # the pipelined engine composes its own stage functions;
+                # the tuner's two seams wrap the whole tick (weights may
+                # only change between ticks — the conflict fence keeps
+                # any in-flight solve on the weights it dispatched with)
+                if self.tuner is not None:
+                    self.tuner.begin_cycle(now_ms=now_ms)
                 with self.feed.locked():
                     report = self.pipeline.tick(now_ms)
+                if self.tuner is not None and report is not None:
+                    self.tuner.observe_report(report)
             else:
                 report = self.feed.run_cycle(
                     self.scheduler, now=now_ms, serve=self.engine,
-                    resilience=self.resilience,
+                    resilience=self.resilience, tuner=self.tuner,
                 )
         except Exception as exc:
             from scheduler_plugins_tpu.resilience import BackendUnavailable
@@ -673,6 +750,20 @@ class Daemon:
                         )
                 except Exception as exc:
                     obs.logger.warning("checkpoint write failed: %s", exc)
+            if self.tuner is not None and self.args.checkpoint:
+                # currently-promoted weights + probation state persist
+                # with the resilience checkpoint; restart resumes them
+                try:
+                    obs.atomic_write(
+                        self._tuner_state_path(),
+                        json.dumps(self.tuner.state_dict(), sort_keys=True)
+                        + "\n",
+                    )
+                    obs.logger.info(
+                        "tuner state written: %s", self._tuner_state_path()
+                    )
+                except Exception as exc:
+                    obs.logger.warning("tuner state write failed: %s", exc)
             if self.elector is not None:
                 self.elector.release()  # ReleaseOnCancel (idempotent)
             if self.health:

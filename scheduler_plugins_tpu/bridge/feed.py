@@ -687,13 +687,14 @@ class FeedServer:
         """Context manager guarding store access against the feed threads."""
         return self.lock
 
-    def run_cycle(self, scheduler, now=None, serve=None, resilience=None):
+    def run_cycle(self, scheduler, now=None, serve=None, resilience=None,
+                  tuner=None):
         """One scheduling cycle holding the feed lock."""
         from scheduler_plugins_tpu.framework.cycle import run_cycle
 
         with self.lock:
             return run_cycle(scheduler, self.cluster, now, serve=serve,
-                             resilience=resilience)
+                             resilience=resilience, tuner=tuner)
 
 
 class FeedClient:

@@ -596,7 +596,7 @@ def _quality_view(snap):
 
 def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
               stream_chunk: int | None = None, serve=None,
-              resilience=None, gangs=None) -> CycleReport:
+              resilience=None, gangs=None, tuner=None) -> CycleReport:
     """One daemon cycle. `stream_chunk` opts the solve into the donated,
     double-buffered chunk pipeline (`parallel.pipeline.streamed_profile_solve`)
     when the profile qualifies for the targeted fast path — huge pending
@@ -634,15 +634,29 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     retries, failover to the host sequential parity path on an exhausted
     budget, probation probes while degraded (docs/ROBUSTNESS.md). Raises
     `resilience.BackendUnavailable` only when the backend is gone AND the
-    profile has no host fallback — callers (the daemon) park the cycle."""
+    profile has no host fallback — callers (the daemon) park the cycle.
+
+    `tuner` (a `tuning.shadow.ShadowTuner`) hooks the guarded-rollout
+    controller into the cycle at its two safe seams: `begin_cycle` BEFORE
+    anything reads the profile weights (the one point a staged promotion
+    or a decided rollback may swap the live weight vector — mid-cycle
+    swaps could solve and record under different weights), and
+    `observe_report` after finalize (the probation window's
+    quality-gauge comparison feeds on the report's quality stamp)."""
     if now is None:
         now = _now_ms()
+    if tuner is not None:
+        # the weight-swap seam: promotions/rollbacks apply only here, at
+        # the cycle boundary, never mid-cycle (docs/ROBUSTNESS.md)
+        tuner.begin_cycle(now_ms=now)
     ctx = _cycle_open(
         scheduler, cluster, now, stream_chunk=stream_chunk, serve=serve,
         resilience=resilience, gangs=gangs,
     )
     _cycle_pending(ctx)
     if ctx.done:
+        if tuner is not None:
+            tuner.observe_report(ctx.report)
         return ctx.report
 
     from scheduler_plugins_tpu.utils import sanitize
@@ -672,6 +686,8 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
     _cycle_bind(ctx)
     _cycle_postbind(ctx, attribution=True)
     _cycle_finalize(ctx)
+    if tuner is not None:
+        tuner.observe_report(ctx.report)
     return ctx.report
 
 

@@ -249,7 +249,12 @@ def run_explain_rows(scheduler, snap, indices, auxes, program, explain_fn):
     bucket = 1 << int(idx.size - 1).bit_length()
     idx_padded = np.full(bucket, idx[0], np.int32)
     idx_padded[: idx.size] = idx
-    key = (program,) + tuple(p.static_key() for p in plugins)
+    # weight tuple in the key: explain bakes `eff_weight` host ints into
+    # its trace — a live-weight swap (Scheduler.set_live_weights) must
+    # retrace this cold path, not serve stale-weight score columns
+    key = (program,) + scheduler.weights_key() + tuple(
+        p.static_key() for p in plugins
+    )
     cache = scheduler._solve_cache
     if key not in cache:
         cache[key] = obs.compile_watch(jax.jit(explain_fn), program=program)
@@ -509,6 +514,10 @@ class Scheduler:
     def __init__(self, profile: Profile):
         self.profile = profile
         self._solve_cache = {}
+        #: (L,) int64 live per-plugin weight vector, or None (static
+        #: profile weights). Set via `set_live_weights` — the online
+        #: tuner's rollout seam (ISSUE 15).
+        self._live_weights = None
 
     # -- queue ----------------------------------------------------------
     def sort_pending(self, pods, cluster=None):
@@ -549,8 +558,90 @@ class Scheduler:
                 if hasattr(plugin, "prepare_cluster"):
                     plugin.prepare_cluster(meta, cluster)
 
-    def _make_solve(self, unroll: int):
+    # -- live weights (the online tuner's rollout seam) -----------------
+    @property
+    def live_weights(self):
+        """The (L,) int64 live weight vector, or None when the static
+        profile weights rule."""
+        return self._live_weights
+
+    def set_live_weights(self, weights) -> None:
+        """Swap the profile's per-plugin score weights LIVE, with zero
+        recompiles on the hot path (ISSUE 15 / ROADMAP item 2): while a
+        live vector is set, `solve` routes through the "solve_live"
+        program, whose weights are a TRACED (L,) argument bound per
+        plugin via `Plugin.bind_weight` — the aux-channel discipline
+        applied to the one profile knob the config format keeps
+        host-side, exactly like the counterfactual sweep's lanes
+        (`parallel.solver.sweep_solve_fn`), so every subsequent swap or
+        rollback is an argument change, never a retrace. The plugins'
+        host `weight` ints are updated in lockstep so every host-side
+        consumer (the degraded-mode `resilience.hostsolve` parity solve,
+        the flight recorder's capture, the explain tables — whose cold
+        jit caches key on the weight tuple) sees the same vector the
+        traced solve multiplies by. `None` reverts to the static profile
+        weights (the original profile ints are NOT restored — pass the
+        incumbent vector explicitly to roll back)."""
+        import numpy as np
+
+        if weights is None:
+            self._live_weights = None
+            return
+        w = np.asarray(weights, np.int64)
+        if w.shape != (len(self.profile.plugins),):
+            raise ValueError(
+                f"live weights shape {w.shape} != "
+                f"({len(self.profile.plugins)},)"
+            )
+        if (w < 1).any():
+            raise ValueError("live weights must be positive (the solve "
+                             "contracts require positive weights)")
+        self._live_weights = w
+        for plugin, wi in zip(self.profile.plugins, w):
+            plugin.weight = int(wi)
+        self._evict_stale_weight_programs()
+
+    def weights_key(self) -> tuple:
+        """The marked host weight tuple — folded into the jit-cache keys
+        of every program that BAKES `plugin.weight` as a trace constant
+        (explain, profile scores, the batched/packing solvers), so a
+        live-weight swap retraces those cold paths instead of silently
+        serving scores computed under stale weights. The hot sequential
+        path never pays this: its live variant traces weights as an
+        argument. The "weights" marker makes the segment locatable in
+        the flat cache-key tuples so `set_live_weights` can EVICT
+        stale-weight entries — without eviction a long-tuning daemon
+        would accumulate one permanent compiled program per historical
+        weight vector per cold path."""
+        return ("weights",) + tuple(
+            int(p.weight) for p in self.profile.plugins
+        )
+
+    def _evict_stale_weight_programs(self) -> None:
+        """Drop cached programs keyed on a weight tuple other than the
+        current one (see `weights_key`) — bounds the cold-path cache at
+        one entry per program under live tuning."""
+        current = self.weights_key()
+        span = len(self.profile.plugins) + 1
+        for key in list(self._solve_cache):
+            if not isinstance(key, tuple) or "weights" not in key:
+                continue
+            i = key.index("weights")
+            if key[i:i + span] != current:
+                del self._solve_cache[key]
+
+    def _make_solve(self, unroll: int, live: bool = False):
         plugins = tuple(self.profile.plugins)
+
+        if live:
+            def solve_live(
+                snap: ClusterSnapshot, state0: SolverState, auxes, weights
+            ) -> SolveResult:
+                return sequential_solve_body(
+                    plugins, snap, state0, auxes, unroll, weights=weights
+                )
+
+            return jax.jit(solve_live)
 
         def solve(
             snap: ClusterSnapshot, state0: SolverState, auxes
@@ -604,6 +695,16 @@ class Scheduler:
                 packing_profile_solve,
             )
 
+            if self._live_weights is not None:
+                # the packing waves rank on a single scoring plugin's
+                # static scores (weight-invariant argmax), but its bid
+                # arithmetic has no traced-weight channel — refuse
+                # rather than silently ignore a live vector
+                raise ValueError(
+                    "live weights require the sequential parity path "
+                    "(profile solve_mode 'packing' has no traced-weight "
+                    "channel)"
+                )
             if auxes is not None:
                 raise ValueError(
                     "auxes= replay override requires the sequential "
@@ -628,6 +729,22 @@ class Scheduler:
         if auxes is None:
             auxes = tuple(plugin.aux() for plugin in self.profile.plugins)
         unroll = self._scan_unroll()
+        live = self._live_weights
+        if live is not None:
+            # the live-weights variant: ONE compile per (unroll,
+            # static_key) like the static program, with the weight
+            # vector a traced argument — promotions and rollbacks are
+            # argument changes, zero recompiles (the aux discipline)
+            key = ("solve_live", unroll) + tuple(
+                plugin.static_key() for plugin in self.profile.plugins
+            )
+            if key not in self._solve_cache:
+                self._solve_cache[key] = obs.compile_watch(
+                    self._make_solve(unroll, live=True), program="solve_live"
+                )
+            return self._solve_cache[key](
+                snap, state0, auxes, jnp.asarray(live)
+            )
         key = ("solve", unroll) + tuple(
             plugin.static_key() for plugin in self.profile.plugins
         )

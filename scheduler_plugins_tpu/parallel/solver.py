@@ -385,7 +385,9 @@ def packing_profile_fn(scheduler, snap, mover_cap: int = 128,
         return assignment, admitted, wait, pstats
 
     key = ("profile_packing", max_waves, mover_cap,
-           sanitize.enabled()) + tuple(p.static_key() for p in plugins)
+           sanitize.enabled()) + scheduler.weights_key() + tuple(
+        p.static_key() for p in plugins
+    )
     cache = scheduler._solve_cache
     if key not in cache:
         if sanitize.enabled():
@@ -644,7 +646,7 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
             return assignment, admitted, wait
 
         key = ("profile_batch_fast", max_waves, collect_stats,
-               sanitize.enabled()) + tuple(
+               sanitize.enabled()) + scheduler.weights_key() + tuple(
             p.static_key() for p in plugins
         )
         cache = scheduler._solve_cache
@@ -888,7 +890,7 @@ def profile_batch_fn(scheduler, snap, max_waves: int = 8,
         return assignment, admitted, wait
 
     key = ("profile_batch", max_waves, collect_stats,
-           sanitize.enabled()) + tuple(
+           sanitize.enabled()) + scheduler.weights_key() + tuple(
         p.static_key() for p in plugins
     )
     cache = scheduler._solve_cache
@@ -1028,7 +1030,9 @@ def profile_initial_scores(scheduler, snap, auxes=None):
     state0 = scheduler.initial_state(snap)
     if auxes is None:
         auxes = tuple(p.aux() for p in plugins)
-    key = ("profile_scores",) + tuple(p.static_key() for p in plugins)
+    key = ("profile_scores",) + scheduler.weights_key() + tuple(
+        p.static_key() for p in plugins
+    )
     cache = scheduler._solve_cache
     if key not in cache:
 

@@ -47,8 +47,21 @@ CRASH_POST_BIND = "cycle.post_bind"
 #: probation probe (`Resilience._probe`): kind "device-error" keeps the
 #: backend looking sick so degraded mode persists across cycles
 PROBE = "solve.probe"
+#: shadow-lane sweep (`tuning.shadow.ShadowTuner._sweep_job`): kinds
+#: "hang" (the sweep worker sleeps past the tuner deadline — the lane
+#: must degrade to "no tuning", never stall or corrupt a tick),
+#: "garbage" (every non-incumbent candidate's replayed placements are
+#: corrupted to out-of-range node indices — the numpy replay oracles
+#: must disqualify all of them, so nothing garbage can reach the live
+#: weights)
+TUNE_SWEEP = "tune.sweep"
+#: live promotion application (`ShadowTuner.begin_cycle`): kind "crash"
+#: (the apply raises mid-promotion — the tuner must keep the incumbent
+#: weights live, count the fault, and recover or disable itself)
+TUNE_PROMOTE = "tune.promote"
 
-ALL_SITES = (SOLVE_DISPATCH, DELTA_EVENT, FEED_STALL, CRASH_POST_BIND, PROBE)
+ALL_SITES = (SOLVE_DISPATCH, DELTA_EVENT, FEED_STALL, CRASH_POST_BIND, PROBE,
+             TUNE_SWEEP, TUNE_PROMOTE)
 
 
 class CrashInjected(RuntimeError):

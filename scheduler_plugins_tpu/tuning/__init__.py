@@ -7,12 +7,18 @@
   vmapped sequential solve (zero per-candidate retraces).
 - `tuning.gates`: numpy hard-constraint replay oracles (fit, queue-order
   quota, gang quorum) gating tuned-profile emission.
+- `tuning.promotion`: THE one promotion-gate body (sweep a corpus, rank,
+  disqualify, accept) shared by the offline tuner and the shadow lane.
+- `tuning.shadow`: the online shadow lane (ROADMAP item 2) — background
+  deadlined sweeps over the flight-recorder ring, gated live promotion
+  through the aux channel, probation auto-rollback.
 
-Drivers: `tools/tune.py` (corpus sweep + gated profile emission),
+Drivers: `tools/tune.py` (corpus sweep + gated profile emission), the
+serving daemon's `--tune` flag (`tuning.shadow.ShadowTuner`),
 `tools/replay.py quality` (score a recorded bundle), `bench.py` (quality
-columns on every JSON line).
+columns on every JSON line; config 14 drives the tuned lane).
 """
 
-from scheduler_plugins_tpu.tuning import gates, quality, sweep
+from scheduler_plugins_tpu.tuning import gates, promotion, quality, sweep
 
-__all__ = ["gates", "quality", "sweep"]
+__all__ = ["gates", "promotion", "quality", "sweep"]

@@ -34,13 +34,17 @@ def pod_fit_demand_np(req) -> np.ndarray:
 
 def fit_violations(snap, assignment) -> int:
     """(node, resource) cells over allocatable after committing the
-    placements (pods slot charged 1 per pod)."""
+    placements (pods slot charged 1 per pod). Out-of-range node indices
+    (garbage output — a desynced backend or corrupted sweep) are NOT
+    this oracle's count: `mask_violations` charges them, and this one
+    must survive scoring such an assignment so the gate can reject it
+    instead of crashing."""
     alloc = np.asarray(snap.nodes.alloc)
     requested = np.asarray(snap.nodes.requested)
     assignment = np.asarray(assignment)
     used = requested.copy()
     demand = pod_fit_demand_np(snap.pods.req)
-    placed = assignment >= 0
+    placed = (assignment >= 0) & (assignment < alloc.shape[0])
     np.add.at(used, assignment[placed], demand[placed])
     return int((used > alloc).sum())
 

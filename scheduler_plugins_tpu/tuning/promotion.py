@@ -1,0 +1,238 @@
+"""THE one promotion-gate body: sweep a corpus, rank, disqualify, accept.
+
+Both tuning drivers — the offline corpus tuner (`tools/tune.py`, PR 8)
+and the online shadow lane inside the serving daemon (`tuning.shadow`,
+ROADMAP item 2) — decide "is this candidate weight vector allowed to
+become the profile?" with exactly this code. One copy on purpose: a
+candidate that would be rejected offline must be rejected online, and a
+gate bug fixed here is fixed for both. The offline driver's emission
+behavior is regression-locked (tests/test_shadow_tuner.py asserts the
+shared identity AND the decision tables; `make tune-smoke` exercises the
+end-to-end offline path).
+
+The contract per candidate (the PR 8 rules, unchanged):
+
+- every candidate replays through the independent numpy hard-constraint
+  oracles (`tuning.gates`: fit, mask, queue-order quota, gang quorum) —
+  ANY violation anywhere in the corpus disqualifies;
+- ranking is the sum of sense-adjusted objective deltas vs lane 0 (the
+  in-band incumbent), in each objective's own dimensionless units;
+- a candidate regressing ANY objective beyond `tolerance` is
+  disqualified — a tune must not buy one objective by silently selling
+  another;
+- acceptance additionally requires a non-incumbent winner with a
+  strictly positive rank score, at least one strict improvement, zero
+  violations, and zero anchor mismatches (a sequential record the
+  incumbent lane cannot reproduce means the rebuild is unfaithful and
+  nothing ranked on it can be trusted).
+
+Corpus entries are `CorpusCycle`s — a thin view over either a bundle
+`LoadedCycle` (offline) or an in-memory ring `CycleRecord` (online), so
+the sweep/gate body never knows which driver called it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+#: objectives the promotion gate ranks on, in report order (preemption/
+#: nomination counts are properties of the recorded cycle's PostFilter,
+#: not of a counterfactual weight vector — the sweep replays the solve,
+#: not the preemption engine, so they are reported but never ranked)
+RANKED_OBJECTIVES = (
+    "fragmentation", "util_imbalance", "gang_wait_frac", "unplaced_frac",
+    "drift",
+)
+
+
+@dataclass
+class CorpusCycle:
+    """One recorded cycle as the promotion gate consumes it.
+
+    `prepare(scheduler)` re-prepares the (shared) replay scheduler for
+    THIS cycle and re-bakes its recorded host_state — must run before
+    every solve/score of the cycle (cycles of one corpus can carry
+    different layouts or cluster-derived specializations). `anchor` is
+    the recorded assignment when the record's own weights equal the
+    sweep's lane 0 (the incumbent) — None means "no comparable anchor"
+    (e.g. a ring record captured under pre-promotion weights): the
+    anchor-mismatch disqualifier and the drift yardstick then fall back
+    to lane 0's replayed placements, which IS the incumbent by
+    construction."""
+
+    scheduler: object
+    snap: object
+    meta: object
+    auxes: tuple
+    anchor: Optional[np.ndarray]
+    wait: Optional[np.ndarray]
+    mode: Optional[str]
+    prepare: Callable = field(default=lambda scheduler: None)
+
+
+@dataclass
+class PromotionVerdict:
+    """The gate's full output: per-candidate aggregates plus the one
+    accepted/rejected decision both drivers act on."""
+
+    objectives: dict  # name -> (K,) float64 corpus means
+    violations: np.ndarray  # (K,) int64 hard-constraint counts
+    anchor_mismatches: int
+    order: np.ndarray  # (K,) candidate indices, best first
+    score: np.ndarray  # (K,) rank scores (-inf = disqualified)
+    improvements: dict  # name -> (K,) sense-adjusted deltas vs lane 0
+    best: int
+    improved: list  # objective names the winner strictly improves
+    accepted: bool
+
+    @property
+    def disqualified(self) -> int:
+        return int(np.sum(~np.isfinite(self.score)))
+
+
+def sweep_corpus(corpus, W, mutate=None):
+    """Aggregate per-candidate objective means + gate verdicts over the
+    corpus. Returns (objectives {name: (K,) mean}, violations (K,) int,
+    anchor_mismatches: sequential-mode cycles whose incumbent lane failed
+    to reproduce the recorded placements). `mutate(A, admitted, wait)`
+    post-processes each cycle's swept outputs BEFORE gating — the chaos
+    harness's `tune.sweep` garbage injection point (`tuning.shadow`),
+    proving the oracles disqualify corrupted sweep output before it can
+    reach a promotion; production drivers pass None."""
+    from scheduler_plugins_tpu.parallel.solver import profile_initial_scores
+    from scheduler_plugins_tpu.tuning import gates, quality, sweep
+
+    K = W.shape[0]
+    sums = {name: np.zeros(K) for name in RANKED_OBJECTIVES}
+    violations = np.zeros(K, np.int64)
+    anchor_mismatches = 0
+    for cc in corpus:
+        cc.prepare(cc.scheduler)
+        A, adm, wt = sweep.sweep_cycle(cc.scheduler, cc.snap, W,
+                                       auxes=cc.auxes)
+        if mutate is not None:
+            A, adm, wt = mutate(A, adm, wt)
+        if (
+            cc.mode == "sequential" and cc.anchor is not None
+            and not (A[0] == cc.anchor).all()
+        ):
+            anchor_mismatches += 1
+        q = quality.batch_quality(cc.snap, A, wt)
+        for name in ("fragmentation", "util_imbalance", "gang_wait_frac",
+                     "unplaced_frac"):
+            sums[name] += np.asarray(q[name], np.float64)
+        # drift on the INCUMBENT profile's cycle-initial objective vs the
+        # recorded sequential anchor (or, anchorless, lane 0's own
+        # replayed placements) — the fixed yardstick every candidate's
+        # placements are comparable on
+        scores = np.asarray(
+            profile_initial_scores(cc.scheduler, cc.snap, auxes=cc.auxes)[0]
+        )
+        ref = cc.anchor if cc.anchor is not None else A[0]
+        sums["drift"] += np.array([
+            quality.score_drift(scores, A[k], ref) for k in range(K)
+        ])
+        for k in range(K):
+            violations[k] += gates.hard_violations(
+                cc.snap, A[k], wt[k]
+            )["total"]
+    n = len(corpus)
+    return (
+        {name: s / n for name, s in sums.items()}, violations,
+        anchor_mismatches,
+    )
+
+
+def rank_candidates(objectives, violations, tolerance: float,
+                    rank_objectives=None, tolerances=None):
+    """(order, scores, improvements): candidates ranked by summed
+    sense-adjusted improvement vs lane 0; disqualified lanes
+    (hard-constraint violations, or any objective regressing beyond its
+    tolerance) score -inf. Deltas are ABSOLUTE in each objective's own
+    dimensionless units (every ranked objective is a fraction/relative
+    quantity in ~[0, 1], so absolute points are comparable and the rule
+    stays well-defined when a baseline objective sits at exactly 0 —
+    drift always does: the anchor IS lane 0's placements).
+
+    `rank_objectives` (default: every objective) selects which
+    objectives contribute to the rank SUM; objectives outside it remain
+    pure disqualification rails. `tolerances` overrides the regression
+    tolerance per objective. The offline tuner uses the defaults
+    unchanged; the online shadow lane ranks on the per-cycle quality
+    objectives and keeps `drift` as a rail with its own (looser)
+    tolerance — over a drifting mix the incumbent's score surface is
+    exactly the thing going stale, and a drift-vs-incumbent term in the
+    rank sum would veto every adaptation by construction."""
+    from scheduler_plugins_tpu.tuning.quality import SENSE
+
+    K = len(violations)
+    imps = {}
+    for name, values in objectives.items():
+        # sense-adjusted: positive = candidate better than baseline
+        imps[name] = SENSE[name] * (values - values[0])
+    ranked = set(imps if rank_objectives is None else rank_objectives)
+    tolerances = tolerances or {}
+    score = np.zeros(K)
+    for name, imp in imps.items():
+        if name in ranked:
+            score += imp
+    for k in range(K):
+        if violations[k] > 0 or any(
+            imp[k] < -tolerances.get(name, tolerance)
+            for name, imp in imps.items()
+        ):
+            score[k] = -np.inf
+    order = np.argsort(-score, kind="stable")
+    return order, score, imps
+
+
+def strict_improvements(imps, k, eps: float = 1e-9) -> list:
+    return [name for name, imp in imps.items() if imp[k] > eps]
+
+
+def evaluate_candidates(corpus, W, tolerance: float, mutate=None,
+                        rank_objectives=None,
+                        tolerances=None) -> PromotionVerdict:
+    """The whole gate in one call: sweep, rank, disqualify, accept. Both
+    drivers consume the returned verdict — the offline tuner emits a
+    profile from it, the shadow lane stages a live promotion from it."""
+    W = np.asarray(W, np.int64)
+    objectives, violations, anchor_mismatches = sweep_corpus(
+        corpus, W, mutate=mutate
+    )
+    order, score, imps = rank_candidates(
+        objectives, violations, tolerance,
+        rank_objectives=rank_objectives, tolerances=tolerances,
+    )
+    best = int(order[0])
+    improved = strict_improvements(
+        {name: imp for name, imp in imps.items()
+         if rank_objectives is None or name in set(rank_objectives)},
+        best,
+    )
+    accepted = bool(
+        best != 0 and np.isfinite(score[best]) and score[best] > 0
+        and improved and violations[best] == 0
+        # a sequential record the incumbent lane cannot reproduce means
+        # the rebuild is unfaithful: never promote a vector ranked on it
+        and anchor_mismatches == 0
+    )
+    return PromotionVerdict(
+        objectives=objectives, violations=violations,
+        anchor_mismatches=anchor_mismatches, order=order, score=score,
+        improvements=imps, best=best, improved=improved, accepted=accepted,
+    )
+
+
+def weights_digest(weights) -> str:
+    """Short content digest of a weight vector — the active-weights
+    identity stamped on /healthz, the prometheus gauge (as an int) and
+    the tuner state file, so operators can tell at a glance whether two
+    processes serve the same promoted profile."""
+    import hashlib
+
+    arr = np.ascontiguousarray(np.asarray(weights, np.int64))
+    return hashlib.blake2b(arr.tobytes(), digest_size=6).hexdigest()

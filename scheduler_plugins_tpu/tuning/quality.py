@@ -322,13 +322,17 @@ def cycle_quality_np(snap, assignment, admitted, wait) -> dict:
 def score_drift(scores, assignment, anchor) -> float:
     """Relative score-sum drift of `assignment` vs `anchor` placements on
     a (P, N) cycle-initial score matrix (same definition as
-    `parallel.solver.score_drift_vs_sequential`, host-side)."""
+    `parallel.solver.score_drift_vs_sequential`, host-side). Out-of-range
+    node indices (garbage placements — e.g. the chaos harness's corrupted
+    sweep output) contribute nothing instead of crashing the scorer: the
+    hard-constraint oracles are the gate that counts them, and the tuner
+    must survive scoring them to reach that gate."""
     scores = np.asarray(scores)
     a = np.asarray(assignment)
     ref = np.asarray(anchor)
 
     def ssum(x):
-        placed = x >= 0
+        placed = (x >= 0) & (x < scores.shape[1])
         return int(scores[np.nonzero(placed)[0], x[placed]].sum())
 
     s_ref = ssum(ref)

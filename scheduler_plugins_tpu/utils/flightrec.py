@@ -354,6 +354,13 @@ class FlightRecorder:
     def stop(self) -> None:
         self._enabled = False
 
+    def resume(self) -> None:
+        """Re-arm WITHOUT resetting the ring (`start` resets; `stop` is
+        the pause) — the interleaved-pairs overhead benches toggle the
+        recorder per cycle and must not lose the accumulated corpus."""
+        with self._lock:
+            self._enabled = True
+
     def begin(self, now_ms: int, profile: str) -> Optional[CycleRecord]:
         if not self._enabled:
             return None

@@ -319,6 +319,29 @@ CYCLE_PIPELINE_BUBBLE = "scheduler_cycle_pipeline_bubble_ms"
 #: serving state as an ordinary DeltaSink delta (the conflict-fence
 #: taxonomy, docs/SERVING.md)
 CYCLE_LATE_BINDS = "scheduler_cycle_late_binds_total"
+#: live weight promotions applied by the online shadow tuner
+#: (tuning.shadow.ShadowTuner — gated through the tuning.promotion
+#: oracles, rolled out via the aux channel with zero recompiles)
+TUNER_PROMOTIONS = "scheduler_tuner_promotions_total"
+#: probation auto-rollbacks (quality-gauge regression or watchdog fault
+#: within the probation window — the guarded-rollout guarantee)
+TUNER_ROLLBACKS = "scheduler_tuner_rollbacks_total"
+#: shadow-lane sweep evaluations completed (each one replays the ring
+#: corpus under K candidate weight vectors off the cycle thread)
+TUNER_SWEEPS = "scheduler_tuner_sweeps_total"
+#: shadow-lane faults: sweep failures (deadline expiry, worker error)
+#: AND promotion-apply crashes — every one degraded to "no tuning" with
+#: the incumbent weights kept; repeated consecutive faults disable the
+#: tuner (one counter on purpose: it feeds the one self-disable budget)
+TUNER_SWEEP_FAILURES = "scheduler_tuner_sweep_failures_total"
+#: gauge: the active per-plugin weight vector's content digest as an
+#: integer (the first 48 bits of `tuning.promotion.weights_digest`,
+#: exact in float64) — two processes serving the same promoted profile
+#: show the same value; the hex string rides /healthz
+TUNER_ACTIVE_WEIGHTS = "scheduler_tuner_active_weights_digest"
+#: gauge: tuner controller state (0 idle, 1 probation, 2 cooldown,
+#: 3 disabled)
+TUNER_STATE = "scheduler_tuner_state"
 
 
 # ---------------------------------------------------------------------------

@@ -469,6 +469,95 @@ class TestAtomicWrites:
         # no stray temp files from any of the three writers
         assert not list(tmp_path.rglob("*.tmp.*"))
 
+    def test_sigterm_daemon_persists_and_resumes_tuner_state(
+        self, tmp_path
+    ):
+        """ISSUE 15 satellite: the daemon's SIGTERM flush persists the
+        online tuner's controller state (currently-promoted weights +
+        probation bookkeeping) next to the resilience checkpoint, and a
+        RESTART resumes with it — the live weights survive the process,
+        not just the profile file. Seeded here with a state file carrying
+        a promoted vector mid-probation (driving a real promotion needs a
+        recorded corpus and sweep compiles — tune-live-smoke's job); the
+        daemon must restore it, expose it on /healthz, serve under it,
+        and re-persist it on SIGTERM."""
+        import urllib.request
+
+        from scheduler_plugins_tpu.bridge.feed import FeedClient
+        from scheduler_plugins_tpu.tuning import promotion
+
+        repo = str(Path(__file__).parent.parent)
+        profile = tmp_path / "profile.yaml"
+        profile.write_text(
+            "plugins:\n"
+            "  - TargetLoadPacking\n"
+            "  - LoadVariationRiskBalancing\n"
+        )
+        ckpt = tmp_path / "resident.ckpt"
+        state_path = tmp_path / "resident.ckpt.tuner.json"
+        state_path.write_text(json.dumps({
+            "format": 1,
+            "active_weights": [4, 20], "last_known_good": [1, 20],
+            "state": "probation", "probation_elapsed": 2,
+            "baseline": {"util_imbalance": 0.19},
+            "promotions": 1, "rollbacks": 0,
+            "blocked": [[1, 64]], "disabled_reason": None,
+        }) + "\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "scheduler_plugins_tpu",
+             "--profile", str(profile),
+             "--tune", "--checkpoint", str(ckpt),
+             "--cycle-interval-s", "0.05", "--health-port", "0"],
+            cwd=repo, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            ready = proc.stdout.readline()
+            assert ready.startswith("daemon ready "), ready
+            status = json.loads(ready[len("daemon ready "):])
+            host, port = status["feed"].split(":")
+            client = FeedClient(host, int(port))
+            assert client.send({
+                "op": "upsert_node", "name": "n0",
+                "allocatable": {CPU: 8000, MEMORY: 32 * gib, PODS: 110},
+            })["ok"]
+            assert client.send({
+                "op": "upsert_pod", "name": "web", "namespace": "team",
+                "requests": {CPU: 500, MEMORY: gib},
+            })["ok"]
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.send({"op": "sync"})["pending"] == 0:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("daemon never scheduled the pod")
+            payload = json.loads(urllib.request.urlopen(
+                status["health"], timeout=5
+            ).read())
+            tuner = payload["tuner"]
+            # the persisted controller state rules the live process
+            assert tuner["active_weights"] == [4, 20]
+            assert tuner["last_known_good"] == [1, 20]
+            assert tuner["state"] == "probation"
+            assert tuner["active_digest"] == promotion.weights_digest(
+                [4, 20]
+            )
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == 0, err
+        # SIGTERM re-persisted the state (crash-safe write, same shape)
+        persisted = json.loads(state_path.read_text())
+        assert persisted["active_weights"] == [4, 20]
+        assert persisted["last_known_good"] == [1, 20]
+        assert persisted["blocked"] == [[1, 64]]
+        assert not list(tmp_path.rglob("*.tmp.*"))
+
     def test_bundle_save_is_crash_safe_order(self, tmp_path, recorder_off,
                                              monkeypatch):
         """Blobs land before the manifest: a save that dies mid-blobs

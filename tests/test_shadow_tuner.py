@@ -1,0 +1,481 @@
+"""Online self-tuning shadow lane (tuning.shadow + tuning.promotion,
+ISSUE 15): the shared promotion-gate body's rank/disqualify decision
+tables (the one copy tools/tune.py and the shadow lane both consume),
+the guarded-rollout rollback decision tables (each objective regressing
+in isolation rolls back within the probation window; sub-threshold noise
+does not; a watchdog fault during probation rolls back immediately; the
+controller cannot flap), the tune.sweep / tune.promote chaos sites, the
+live-weights rollout seam (traced-argument weights, zero recompiles),
+and the tuner state persistence round trip.
+
+The end-to-end tuned-serving claim (shadow sweeps over real ring
+records, gated promotion, measured quality win, injected-regression
+rollback) is `make tune-live-smoke` (bench config 14); the tuner-fault
+bit-identity claim is the chaos gate's tuner phase (`make chaos-smoke`).
+These tests stay host-side where possible — only the live-weights seam
+class compiles a (tiny) solve."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from scheduler_plugins_tpu.framework import Profile, Scheduler
+from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+from scheduler_plugins_tpu.resilience import faults
+from scheduler_plugins_tpu.tuning import promotion
+from scheduler_plugins_tpu.tuning.shadow import (
+    PROBATION_OBJECTIVES,
+    ShadowTuner,
+)
+
+
+def make_scheduler(weights=(1, 1)):
+    plugins = [NodeResourcesAllocatable() for _ in weights]
+    for plugin, w in zip(plugins, weights):
+        plugin.weight = int(w)
+    return Scheduler(Profile(plugins=plugins))
+
+
+def make_tuner(scheduler=None, **kw):
+    scheduler = scheduler or make_scheduler()
+    kw.setdefault("probation_cycles", 6)
+    kw.setdefault("baseline_min", 1)
+    kw.setdefault("hysteresis", 0.01)
+    kw.setdefault("regress_cycles", 2)
+    kw.setdefault("cooldown_cycles", 4)
+    kw.setdefault("sync", True)
+    tuner = ShadowTuner(scheduler, **kw)
+    return tuner
+
+
+def report(quality=None, degraded=False, solve_path="device"):
+    return SimpleNamespace(
+        quality=quality, degraded=degraded, solve_path=solve_path,
+    )
+
+
+def flat_quality(**over):
+    q = {name: 0.5 for name in PROBATION_OBJECTIVES}
+    q.update(over)
+    return q
+
+
+class _ScriptedProbe:
+    """Scripted paired-counterfactual probe: each entry is the
+    {objective: (q_active, q_good)} pair the next probation cycle sees —
+    the decision tables drive the regression detector without any jit."""
+
+    def __init__(self, tuner, pairs):
+        self.pairs = list(pairs)
+        tuner._counterfactual_pair = self._next
+
+    def _next(self):
+        spec = self.pairs.pop(0) if self.pairs else {}
+        q_active = flat_quality(**{k: v[0] for k, v in spec.items()})
+        q_good = flat_quality(**{k: v[1] for k, v in spec.items()})
+        return q_active, q_good
+
+
+def start_probation(tuner, weights=(3, 3)):
+    """Baseline one observed cycle, then promote `weights` via the
+    harness injection hook (the decision tables adjudicate the window,
+    not the gate)."""
+    tuner.begin_cycle()
+    tuner.observe_report(report(quality=flat_quality()))
+    tuner.inject_promotion(weights)
+    tuner.begin_cycle()
+    assert tuner.state == "probation"
+    assert [int(w) for w in tuner.active] == list(weights)
+
+
+class TestPromotionGateBody:
+    """Decision tables for the shared rank/disqualify rules — and the
+    regression lock that tools/tune.py actually consumes them."""
+
+    def _objectives(self, **cols):
+        # lane 0 is the incumbent; columns are per-candidate values
+        base = {name: np.zeros(3) for name in promotion.RANKED_OBJECTIVES}
+        for name, vals in cols.items():
+            base[name] = np.asarray(vals, float)
+        return base
+
+    def test_improvement_ranks_and_wins(self):
+        objs = self._objectives(util_imbalance=[0.20, 0.15, 0.25])
+        order, score, imps = promotion.rank_candidates(
+            objs, np.zeros(3, np.int64), tolerance=0.01
+        )
+        assert int(order[0]) == 1 and score[1] == pytest.approx(0.05)
+        assert promotion.strict_improvements(imps, 1) == ["util_imbalance"]
+
+    def test_violations_disqualify(self):
+        objs = self._objectives(util_imbalance=[0.20, 0.10, 0.25])
+        order, score, _ = promotion.rank_candidates(
+            objs, np.asarray([0, 3, 0]), tolerance=0.01
+        )
+        assert not np.isfinite(score[1])
+        assert int(order[0]) == 0  # nothing beats the incumbent
+
+    def test_tolerance_disqualifies_sold_objective(self):
+        # candidate 1 buys util_imbalance by selling fragmentation
+        objs = self._objectives(
+            util_imbalance=[0.20, 0.10, 0.20],
+            fragmentation=[0.50, 0.55, 0.50],
+        )
+        _, score, _ = promotion.rank_candidates(
+            objs, np.zeros(3, np.int64), tolerance=0.01
+        )
+        assert not np.isfinite(score[1])
+        # a looser tolerance readmits it
+        _, score2, _ = promotion.rank_candidates(
+            objs, np.zeros(3, np.int64), tolerance=0.10
+        )
+        assert score2[1] == pytest.approx(0.05)
+
+    def test_rail_objective_guards_but_does_not_vote(self):
+        # drift regresses 0.05: inside its own rail tolerance, excluded
+        # from the rank sum — the shadow lane's configuration
+        objs = self._objectives(
+            util_imbalance=[0.20, 0.10, 0.20], drift=[0.0, -0.05, 0.0],
+        )
+        _, score, _ = promotion.rank_candidates(
+            objs, np.zeros(3, np.int64), tolerance=0.01,
+            rank_objectives=PROBATION_OBJECTIVES,
+            tolerances={"drift": 0.10},
+        )
+        assert score[1] == pytest.approx(0.10)  # drift did not vote
+        # beyond the rail it still disqualifies
+        objs["drift"] = np.asarray([0.0, -0.15, 0.0])
+        _, score3, _ = promotion.rank_candidates(
+            objs, np.zeros(3, np.int64), tolerance=0.01,
+            rank_objectives=PROBATION_OBJECTIVES,
+            tolerances={"drift": 0.10},
+        )
+        assert not np.isfinite(score3[1])
+
+    def test_offline_driver_consumes_shared_body(self):
+        import inspect
+
+        import tools.tune as tune
+
+        # the refactor left exactly one copy of the gate: tools/tune.py
+        # no longer defines its own rank/sweep/disqualify
+        for legacy in ("_rank", "_sweep_corpus", "_strict_improvements"):
+            assert not hasattr(tune, legacy)
+        src = inspect.getsource(tune.cmd_tune)
+        assert "promotion.evaluate_candidates" in src
+
+    def test_weights_digest_stable_and_distinct(self):
+        a = promotion.weights_digest([1, 20])
+        assert a == promotion.weights_digest(np.asarray([1, 20]))
+        assert a != promotion.weights_digest([20, 1])
+
+
+class TestRollbackDecisionTables:
+    """The probation window, driven by a scripted counterfactual probe."""
+
+    @pytest.mark.parametrize("objective", PROBATION_OBJECTIVES)
+    def test_each_objective_regressing_in_isolation_rolls_back(
+        self, objective
+    ):
+        tuner = make_tuner()
+        # sustained regression just past the band: detected by the
+        # consecutive-cycles trigger within regress_cycles (= 2)
+        _ScriptedProbe(tuner, [
+            {objective: (0.515, 0.50)} for _ in range(4)
+        ])
+        start_probation(tuner)
+        for k in range(4):
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=flat_quality()))
+            if tuner.rollbacks:
+                break
+        assert tuner.rollbacks == 1
+        assert tuner.last_rollback_reason == (
+            f"quality-regression:{objective}"
+        )
+        assert tuner.last_rollback_detect_cycles <= 2
+        assert [int(w) for w in tuner.active] == [1, 1]  # last-known-good
+        assert (3, 3) in tuner.blocked
+
+    def test_large_single_cycle_regression_rolls_back_immediately(self):
+        tuner = make_tuner()
+        # one cycle at >= hysteresis * regress_cycles: immediate
+        _ScriptedProbe(tuner, [{"util_imbalance": (0.525, 0.50)}])
+        start_probation(tuner)
+        tuner.begin_cycle()
+        tuner.observe_report(report(quality=flat_quality()))
+        assert tuner.rollbacks == 1
+        assert tuner.last_rollback_detect_cycles == 0
+
+    def test_sub_threshold_noise_does_not_flap(self):
+        tuner = make_tuner(probation_cycles=4)
+        # alternating +/- inside the hysteresis band: never counted
+        _ScriptedProbe(tuner, [
+            {"util_imbalance": (0.505, 0.50)},
+            {"util_imbalance": (0.495, 0.50)},
+            {"util_imbalance": (0.508, 0.50)},
+            {"util_imbalance": (0.494, 0.50)},
+        ])
+        start_probation(tuner)
+        for _ in range(4):
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=flat_quality()))
+        assert tuner.rollbacks == 0
+        assert tuner.state == "idle"  # probation confirmed
+        assert [int(w) for w in tuner.last_known_good] == [3, 3]
+
+    def test_intermittent_regression_does_not_confirm_silently(self):
+        # an above-band regression on non-consecutive cycles: each hit
+        # resets nothing it should not, and a later big hit still fires
+        tuner = make_tuner(probation_cycles=8)
+        _ScriptedProbe(tuner, [
+            {"util_imbalance": (0.515, 0.50)},
+            {},
+            {"util_imbalance": (0.525, 0.50)},  # large: immediate
+        ])
+        start_probation(tuner)
+        for _ in range(3):
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=flat_quality()))
+        assert tuner.rollbacks == 1
+
+    def test_watchdog_fault_during_probation_rolls_back_immediately(self):
+        tuner = make_tuner()
+        _ScriptedProbe(tuner, [{}] * 4)
+        start_probation(tuner)
+        tuner.begin_cycle()
+        tuner.observe_report(
+            report(quality=flat_quality(), degraded=True)
+        )
+        assert tuner.rollbacks == 1
+        assert tuner.last_rollback_reason.startswith("watchdog-fault")
+        assert [int(w) for w in tuner.active] == [1, 1]
+
+    def test_host_path_solve_counts_as_watchdog_fault(self):
+        tuner = make_tuner()
+        _ScriptedProbe(tuner, [{}] * 4)
+        start_probation(tuner)
+        tuner.begin_cycle()
+        tuner.observe_report(
+            report(quality=flat_quality(), solve_path="host")
+        )
+        assert tuner.rollbacks == 1
+
+    def test_unadjudicable_probe_rolls_back(self):
+        tuner = make_tuner()
+
+        def boom():
+            raise RuntimeError("probe died")
+
+        tuner._counterfactual_pair = boom
+        start_probation(tuner)
+        tuner.begin_cycle()
+        tuner.observe_report(report(quality=flat_quality()))
+        assert tuner.rollbacks == 1
+        assert "probe-unavailable" in tuner.last_rollback_reason
+
+    def test_rolled_back_vector_is_blocked_and_cooldown_holds(self):
+        tuner = make_tuner(cooldown_cycles=6)
+        _ScriptedProbe(tuner, [{"util_imbalance": (0.53, 0.50)}])
+        start_probation(tuner, weights=(5, 7))
+        tuner.begin_cycle()
+        tuner.observe_report(report(quality=flat_quality()))
+        assert tuner.state == "cooldown"
+        # a sweep winner equal to the rolled-back vector is never staged
+        W = np.asarray([[1, 1], [5, 7]], np.int64)
+        verdict = promotion.PromotionVerdict(
+            objectives={}, violations=np.zeros(2, np.int64),
+            anchor_mismatches=0, order=np.asarray([1, 0]),
+            score=np.asarray([0.0, 0.5]),
+            improvements={"util_imbalance": np.asarray([0.0, 0.1])},
+            best=1, improved=["util_imbalance"], accepted=True,
+        )
+        for _ in range(tuner.confirm_sweeps + 1):
+            tuner._consume_sweep_locked((verdict, W))
+        assert tuner._pending is None
+        assert tuner.promotions == 1  # only the injected one, ever
+
+    def test_quality_none_cycles_do_not_advance_probation(self):
+        tuner = make_tuner(probation_cycles=2)
+        _ScriptedProbe(tuner, [{}] * 2)
+        start_probation(tuner)
+        for _ in range(3):
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=None))
+        assert tuner.state == "probation"  # no evidence, no progress
+
+
+class TestTunerFaultSites:
+    def test_promote_crash_keeps_incumbent_and_counts(self):
+        tuner = make_tuner()
+        plan = faults.FaultPlan(seed=0)
+        plan.specs = [faults.FaultSpec(
+            site=faults.TUNE_PROMOTE, cycle=0, kind="crash", sticky=True,
+        )]
+        faults.install(plan)
+        try:
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=flat_quality()))
+            tuner.inject_promotion((9, 9))
+            plan.begin_cycle(0)
+            tuner.begin_cycle()
+        finally:
+            faults.clear()
+        assert tuner.promotions == 0
+        assert [int(w) for w in tuner.active] == [1, 1]
+        assert tuner.sweep_failures == 1
+        assert plan.log == [(0, faults.TUNE_PROMOTE, "crash")]
+
+    def test_repeated_faults_disable_the_lane(self):
+        tuner = make_tuner(max_failures=2)
+        plan = faults.FaultPlan(seed=0)
+        plan.specs = [
+            faults.FaultSpec(site=faults.TUNE_PROMOTE, cycle=c,
+                             kind="crash")
+            for c in range(2)
+        ]
+        faults.install(plan)
+        try:
+            tuner.begin_cycle()
+            tuner.observe_report(report(quality=flat_quality()))
+            for c in range(2):
+                tuner.inject_promotion((9, 9))
+                plan.begin_cycle(c)
+                tuner.begin_cycle()
+        finally:
+            faults.clear()
+        assert tuner.state == "disabled"
+        assert tuner.disabled_reason is not None
+        # disabled lane is inert: further cycles change nothing
+        tuner.inject_promotion((9, 9))
+        tuner.begin_cycle()
+        assert tuner.promotions == 0
+
+    def test_sites_registered(self):
+        assert faults.TUNE_SWEEP in faults.ALL_SITES
+        assert faults.TUNE_PROMOTE in faults.ALL_SITES
+
+    def test_sweep_failure_drops_shadow_scheduler_cache(self):
+        # an abandoned (timed-out) job keeps running on its zombie
+        # worker and still holds the cached shadow scheduler — the next
+        # sweep/probe must rebuild fresh, never share it
+        tuner = make_tuner()
+        tuner._shadow_sched = object()
+        tuner._shadow_key = ("k",)
+        with tuner._lock:
+            tuner._sweep_failed_locked("timeout (0.1s) in tune.sweep")
+        assert tuner._shadow_sched is None and tuner._shadow_key is None
+
+
+class TestTunerRequiresSequentialMode:
+    def test_packing_profile_refused_at_construction(self):
+        # a packing-mode profile would accept a gated promotion and then
+        # raise on every solve (the live seam is the sequential path) —
+        # the tuner must refuse at construction, not at first promotion
+        sched = make_scheduler((1, 1))
+        sched.profile.solve_mode = "packing"
+        with pytest.raises(ValueError, match="sequential parity path"):
+            ShadowTuner(sched, sync=True)
+
+
+class TestStatePersistence:
+    def test_state_dict_round_trip_resumes_weights_and_probation(self):
+        tuner = make_tuner()
+        _ScriptedProbe(tuner, [{}] * 8)
+        start_probation(tuner, weights=(4, 6))
+        tuner.begin_cycle()
+        tuner.observe_report(report(quality=flat_quality()))
+        state = tuner.state_dict()
+        assert state["state"] == "probation"
+
+        fresh_sched = make_scheduler()
+        fresh = make_tuner(scheduler=fresh_sched)
+        assert fresh.restore_state(state)
+        assert [int(w) for w in fresh.active] == [4, 6]
+        assert fresh.state == "probation"
+        assert list(np.asarray(fresh_sched.live_weights)) == [4, 6]
+        # the restored probation window still adjudicates: a watchdog
+        # fault rolls back to the restored last-known-good
+        fresh.begin_cycle()
+        fresh.observe_report(
+            report(quality=flat_quality(), degraded=True)
+        )
+        assert fresh.rollbacks == 1
+        assert [int(w) for w in fresh.active] == [1, 1]
+
+    def test_bad_state_file_starts_fresh(self):
+        tuner = make_tuner()
+        assert not tuner.restore_state({"format": 99})
+        assert not tuner.restore_state({"format": 1, "active_weights": [1]})
+        assert not tuner.restore_state("garbage")
+        assert tuner.state == "idle"
+
+
+class TestLiveWeightsSeam:
+    """The rollout seam itself: a live-weight swap is bit-identical to a
+    statically-weighted scheduler and never recompiles the solve."""
+
+    def _solve(self, scheduler, seed=3):
+        from scheduler_plugins_tpu.models import trimaran_scenario
+
+        cluster = trimaran_scenario(n_nodes=16, n_pods=24, seed=seed)
+        pending = scheduler.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        scheduler.prepare(meta, cluster)
+        return np.asarray(scheduler.solve(snap).assignment)
+
+    def test_live_swap_parity_and_zero_recompiles(self):
+        from scheduler_plugins_tpu import plugins as P
+        from scheduler_plugins_tpu.utils import observability as obs
+
+        def trimaran_sched(w):
+            sched = Scheduler(Profile(plugins=[
+                P.TargetLoadPacking(), P.LoadVariationRiskBalancing(),
+            ]))
+            for plugin, wi in zip(sched.profile.plugins, w):
+                plugin.weight = wi
+            return sched
+
+        static = trimaran_sched([3, 7])
+        want = self._solve(static)
+
+        live = trimaran_sched([1, 1])
+        base = self._solve(live)
+        live.set_live_weights([3, 7])
+        m0 = obs.metrics.get(obs.JIT_CACHE_MISS, program="solve_live")
+        got = self._solve(live)
+        m1 = obs.metrics.get(obs.JIT_CACHE_MISS, program="solve_live")
+        np.testing.assert_array_equal(got, want)
+        assert (got != base).any()  # the swap really changed placements
+        # rollback = argument change on the SAME compiled program
+        live.set_live_weights([1, 1])
+        back = self._solve(live)
+        m2 = obs.metrics.get(obs.JIT_CACHE_MISS, program="solve_live")
+        np.testing.assert_array_equal(back, base)
+        assert m1 - m0 == 1 and m2 - m1 == 0
+        # host-side consumers follow the swap (hostsolve/recorder read
+        # plugin.weight)
+        assert [p.weight for p in live.profile.plugins] == [1, 1]
+
+    def test_live_weights_validated(self):
+        sched = make_scheduler((1, 1))
+        with pytest.raises(ValueError, match="shape"):
+            sched.set_live_weights([1, 2, 3])
+        with pytest.raises(ValueError, match="positive"):
+            sched.set_live_weights([0, 1])
+        sched.set_live_weights(None)
+        assert sched.live_weights is None
+
+    def test_packing_mode_refuses_live_weights(self):
+        from scheduler_plugins_tpu.models import trimaran_scenario
+
+        sched = make_scheduler((1,))
+        sched.profile.solve_mode = "packing"
+        sched.set_live_weights([2])
+        cluster = trimaran_scenario(n_nodes=8, n_pods=4, seed=0)
+        pending = sched.sort_pending(cluster.pending_pods(), cluster)
+        snap, meta = cluster.snapshot(pending, now_ms=0)
+        sched.prepare(meta, cluster)
+        with pytest.raises(ValueError, match="sequential parity path"):
+            sched.solve(snap)

@@ -50,15 +50,6 @@ REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:  # `python tools/tune.py` from anywhere
     sys.path.insert(0, str(REPO))
 
-#: objectives the tuner ranks on, in report order (preemption/nomination
-#: counts are properties of the recorded cycle's PostFilter, not of a
-#: counterfactual weight vector — the sweep replays the solve, not the
-#: preemption engine, so they are reported from the record but not ranked)
-RANKED_OBJECTIVES = (
-    "fragmentation", "util_imbalance", "gang_wait_frac", "unplaced_frac",
-    "drift",
-)
-
 #: reduced trimaran corpus for the smoke gate: two scoring plugins with a
 #: real packing-vs-balance trade-off (synthetic per-node metrics), small
 #: enough for a 2-core runner, 3 cycles with distinct seeds
@@ -122,80 +113,21 @@ def _load_corpus(bundle_dir: str):
     return corpus
 
 
-def _sweep_corpus(corpus, W):
-    """Aggregate per-candidate objective means + gate verdicts over the
-    corpus. Returns (objectives {name: (K,) mean}, violations (K,) int,
-    anchor_mismatches: sequential-mode cycles whose baseline lane failed
-    to reproduce the recorded placements — a non-zero count means the
-    rebuild is not faithful and nothing ranked on it can be trusted)."""
-    import numpy as np
+def _promotion_corpus(corpus):
+    """Wrap `_load_corpus` tuples as `tuning.promotion.CorpusCycle`s —
+    the gate/rank/disqualify body itself lives in `tuning.promotion`,
+    shared verbatim with the online shadow lane (`tuning.shadow`)."""
+    from scheduler_plugins_tpu.tuning.promotion import CorpusCycle
 
-    from scheduler_plugins_tpu.parallel.solver import profile_initial_scores
-    from scheduler_plugins_tpu.tuning import gates, quality, sweep
-
-    K = W.shape[0]
-    sums = {name: np.zeros(K) for name in RANKED_OBJECTIVES}
-    violations = np.zeros(K, np.int64)
-    anchor_mismatches = 0
-    for lc, scheduler, snap, meta, auxes, anchor, _wait, mode in corpus:
-        _prepare_for_cycle(scheduler, lc, meta)
-        A, adm, wt = sweep.sweep_cycle(scheduler, snap, W, auxes=auxes)
-        if mode == "sequential" and not (A[0] == anchor).all():
-            anchor_mismatches += 1
-        q = quality.batch_quality(snap, A, wt)
-        for name in ("fragmentation", "util_imbalance", "gang_wait_frac",
-                     "unplaced_frac"):
-            sums[name] += np.asarray(q[name], np.float64)
-        # drift on the BASELINE profile's cycle-initial objective vs the
-        # recorded sequential anchor — the fixed yardstick every
-        # candidate's placements are comparable on
-        scores = np.asarray(
-            profile_initial_scores(scheduler, snap, auxes=auxes)[0]
+    return [
+        CorpusCycle(
+            scheduler=scheduler, snap=snap, meta=meta, auxes=auxes,
+            anchor=anchor, wait=wait, mode=mode,
+            prepare=(lambda sched, lc=lc, meta=meta:
+                     _prepare_for_cycle(sched, lc, meta)),
         )
-        sums["drift"] += np.array([
-            quality.score_drift(scores, A[k], anchor) for k in range(K)
-        ])
-        for k in range(K):
-            violations[k] += gates.hard_violations(snap, A[k], wt[k])["total"]
-    n = len(corpus)
-    return (
-        {name: s / n for name, s in sums.items()}, violations,
-        anchor_mismatches,
-    )
-
-
-def _rank(objectives, violations, tolerance: float):
-    """(order, scores, improvements): candidates ranked by summed
-    sense-adjusted improvement vs lane 0; disqualified lanes
-    (hard-constraint violations, or any objective regressing beyond
-    `tolerance`) score -inf. Deltas are ABSOLUTE in each objective's own
-    dimensionless units (every ranked objective is a fraction/relative
-    quantity in ~[0, 1], so absolute points are comparable and the rule
-    stays well-defined when a baseline objective sits at exactly 0 —
-    drift always does: the anchor IS lane 0's placements)."""
-    import numpy as np
-
-    from scheduler_plugins_tpu.tuning.quality import SENSE
-
-    K = len(violations)
-    imps = {}
-    for name, values in objectives.items():
-        # sense-adjusted: positive = candidate better than baseline
-        imps[name] = SENSE[name] * (values - values[0])
-    score = np.zeros(K)
-    for name, imp in imps.items():
-        score += imp
-    for k in range(K):
-        if violations[k] > 0 or any(
-            imp[k] < -tolerance for imp in imps.values()
-        ):
-            score[k] = -np.inf
-    order = np.argsort(-score, kind="stable")
-    return order, score, imps
-
-
-def _strict_improvements(imps, k, eps: float = 1e-9) -> list:
-    return [name for name, imp in imps.items() if imp[k] > eps]
+        for lc, scheduler, snap, meta, auxes, anchor, wait, mode in corpus
+    ]
 
 
 def _tuned_spec(corpus, W, k):
@@ -242,9 +174,7 @@ def _explain_pair(corpus, W, k, uid, top=5):
 
 
 def cmd_tune(args) -> int:
-    import numpy as np
-
-    from scheduler_plugins_tpu.tuning import sweep
+    from scheduler_plugins_tpu.tuning import promotion, sweep
     from scheduler_plugins_tpu.utils import observability as obs
 
     corpus = _load_corpus(args.bundle)
@@ -252,20 +182,15 @@ def cmd_tune(args) -> int:
     base = [int(p.weight) for p in scheduler.profile.plugins]
     W = sweep.candidate_weights(base, args.candidates, seed=args.seed)
     miss0 = obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve")
-    objectives, violations, anchor_mismatches = _sweep_corpus(corpus, W)
+    # the gate/rank/disqualify body shared with the online shadow lane
+    # (tuning.promotion — ONE copy of the acceptance rules)
+    verdict = promotion.evaluate_candidates(
+        _promotion_corpus(corpus), W, args.tolerance
+    )
     sweep_compiles = (
         obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve") - miss0
     )
-    order, score, imps = _rank(objectives, violations, args.tolerance)
-    best = int(order[0])
-    improved = _strict_improvements(imps, best)
-    accepted = bool(
-        best != 0 and np.isfinite(score[best]) and score[best] > 0
-        and improved and violations[best] == 0
-        # a sequential record the baseline lane cannot reproduce means
-        # the rebuild is unfaithful: never emit a profile ranked on it
-        and anchor_mismatches == 0
-    )
+    best = verdict.best
 
     out = {
         "metric": "tune",
@@ -276,23 +201,25 @@ def cmd_tune(args) -> int:
         "plugins": [p.name for p in scheduler.profile.plugins],
         "baseline_weights": base,
         "baseline_objectives": {
-            name: round(float(v[0]), 6) for name, v in objectives.items()
+            name: round(float(v[0]), 6)
+            for name, v in verdict.objectives.items()
         },
         "tuned_weights": [int(w) for w in W[best]],
         "tuned_objectives": {
-            name: round(float(v[best]), 6) for name, v in objectives.items()
+            name: round(float(v[best]), 6)
+            for name, v in verdict.objectives.items()
         },
         "improvement_pct": {
             name: round(100.0 * float(imp[best]), 3)
-            for name, imp in imps.items()
+            for name, imp in verdict.improvements.items()
         },
-        "improved_objectives": improved,
-        "hard_violations": int(violations[best]),
-        "anchor_mismatches": int(anchor_mismatches),
-        "candidates_disqualified": int(np.sum(~np.isfinite(score))),
-        "accepted": accepted,
+        "improved_objectives": verdict.improved,
+        "hard_violations": int(verdict.violations[best]),
+        "anchor_mismatches": int(verdict.anchor_mismatches),
+        "candidates_disqualified": verdict.disqualified,
+        "accepted": verdict.accepted,
     }
-    if accepted and args.out:
+    if verdict.accepted and args.out:
         spec = _tuned_spec(corpus, W, best)
         obs.atomic_write(
             args.out, json.dumps(spec, indent=2, sort_keys=True) + "\n"
@@ -304,7 +231,7 @@ def cmd_tune(args) -> int:
         out["explain"] = {"uid": args.explain, "before": before,
                           "after": after}
     print(json.dumps(out))
-    return 0 if accepted else 1
+    return 0 if verdict.accepted else 1
 
 
 # ---------------------------------------------------------------------------
