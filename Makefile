@@ -167,7 +167,7 @@ gang-smoke:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
 
 .PHONY: lint
 lint:
@@ -185,6 +185,25 @@ jaxpr-audit:
 .PHONY: jaxpr-audit-check
 jaxpr-audit-check:
 	$(PY) tools/jaxpr_audit.py --check
+
+# whole-program concurrency audit: discover thread entry points, walk
+# reachable locksets, run CA001-CA005, refresh docs/race_audit.json
+.PHONY: race-audit
+race-audit:
+	$(PY) tools/race_audit.py
+
+# read-only CI gate: zero violations + entry-table/census drift vs the
+# committed manifest (fail-closed when the manifest is missing)
+.PHONY: race-audit-check
+race-audit-check:
+	$(PY) tools/race_audit.py --check
+
+# the dynamic half: replay the pipelined-cycle/shadow-tuner/hung-watchdog
+# composite under seeded interleavings (SPT_RACE=1 lock/event proxies) —
+# zero violations, bit-identical placements across every interleaving
+.PHONY: race-smoke
+race-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/race_smoke.py
 
 # CI sanitizer gate: reduced cfg-2/cfg-3 shapes + the donated chunk
 # pipeline + entry() under SPT_SANITIZE=1 checkify instrumentation —

@@ -41,6 +41,7 @@ is released, the feed/health servers shut down, a summary line prints).
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import os
 import signal
@@ -48,6 +49,7 @@ import sys
 import threading
 import time
 import urllib.request
+from pathlib import Path
 
 from scheduler_plugins_tpu.api.config import load_profile
 from scheduler_plugins_tpu.bridge.agent import DEFAULT_WATCH_PATHS, ClusterAgent
@@ -207,6 +209,51 @@ def load_profile_file(path: str):
     return load_profile(decode_profile_file(path))
 
 
+#: fnmatch patterns for live thread names the concurrency model covers,
+#: resolved lazily from the committed auditor manifest
+_THREAD_PATTERNS: list | None = None
+
+
+def _known_thread_patterns() -> list:
+    global _THREAD_PATTERNS
+    if _THREAD_PATTERNS is None:
+        # interpreter main + ThreadingHTTPServer's per-request threads
+        # (stdlib-named; our own threads carry explicit names — GL012)
+        pats = ["MainThread", "Thread-*"]
+        manifest = (
+            Path(__file__).resolve().parents[1] / "docs" / "race_audit.json"
+        )
+        try:
+            entries = json.loads(manifest.read_text())["entries"]
+            pats += [
+                name for name, spec in sorted(entries.items())
+                if spec.get("kind") in ("thread", "pool", "server")
+            ]
+        except (OSError, ValueError, KeyError):
+            # installed without the repo checkout: fall back to the
+            # names the code itself assigns (kept in sync by the
+            # manifest-coverage test in tests/test_race_audit.py)
+            pats += [
+                "agent-*", "feed-server", "health-server",
+                "leader-elector", "load-watcher", "shadow-tuner",
+                "solve-watchdog", "spt-bind-flusher*", "wd-*",
+            ]
+        _THREAD_PATTERNS = pats
+    return _THREAD_PATTERNS
+
+
+def thread_topology() -> dict:
+    """Live thread names diffed against the static concurrency model
+    (tools/race_audit.py's entry table). `unknown` names are topology
+    drift: a running thread the lockset analysis never audited."""
+    live = sorted(t.name for t in threading.enumerate())
+    pats = _known_thread_patterns()
+    unknown = [
+        n for n in live if not any(fnmatch.fnmatch(n, p) for p in pats)
+    ]
+    return {"live": live, "unknown": unknown}
+
+
 class HealthServer:
     """GET /healthz (liveness + loop counters), /metrics (prometheus text
     exposition 0.0.4: counters incl. per-plugin unschedulable attribution,
@@ -261,7 +308,17 @@ class HealthServer:
                             if outer.resilience is not None else None
                         ),
                         "parked_cycles": outer.parked_cycles,
+                        # live thread census vs the static concurrency
+                        # model (tools/race_audit.py entry table):
+                        # `unknown` = running threads the lockset
+                        # analysis never modeled
+                        "threads": thread_topology(),
                     }
+                    if payload["threads"]["unknown"]:
+                        obs.metrics.inc(
+                            obs.THREAD_TOPOLOGY_DRIFT,
+                            len(payload["threads"]["unknown"]),
+                        )
                     if outer.pipeline is not None:
                         # concurrent cycle pipeline introspection:
                         # configured depth + host stages still in
@@ -356,7 +413,8 @@ class HealthServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+            target=self._httpd.serve_forever, daemon=True,
+            name="health-server",
         )
         self._thread.start()
 
@@ -527,7 +585,7 @@ class Daemon:
             )
             threading.Thread(
                 target=self.elector.run, args=(self.stop_event,),
-                daemon=True,
+                daemon=True, name="leader-elector",
             ).start()
         self._agent_threads = []
         if args.apiserver:
@@ -537,7 +595,8 @@ class Daemon:
             )
             for path in paths:
                 t = threading.Thread(
-                    target=self._agent_loop, args=(path,), daemon=True
+                    target=self._agent_loop, args=(path,), daemon=True,
+                    name=f"agent-{path}",
                 )
                 t.start()
                 self._agent_threads.append(t)

@@ -674,7 +674,8 @@ class FeedServer:
 
     def start(self):
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
+            target=self._server.serve_forever, daemon=True,
+            name="feed-server",
         )
         self._thread.start()
         return self

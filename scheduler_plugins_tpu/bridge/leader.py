@@ -70,8 +70,24 @@ class LeaseElector:
         self.token = token
         self.ca_file = ca_file
         self.insecure_skip_verify = insecure_skip_verify
-        self.is_leader = False
+        self._leader_event = threading.Event()
         self.observed_holder: Optional[str] = None
+
+    @property
+    def is_leader(self) -> bool:
+        """True while we hold the lease. Event-backed: the renew loop
+        writes it from the elector thread while the scheduling loop and
+        /healthz read it from theirs — a plain bool attribute is a
+        cross-thread handoff with no synchronization (race_audit CA001);
+        an Event is the one-word flag a leader gate is allowed to be."""
+        return self._leader_event.is_set()
+
+    @is_leader.setter
+    def is_leader(self, value: bool) -> None:
+        if value:
+            self._leader_event.set()
+        else:
+            self._leader_event.clear()
 
     @property
     def _collection_url(self) -> str:

@@ -121,7 +121,9 @@ class AsyncLoadWatcherCollector:
             except Exception:  # graft-lint: ignore[GL010] — reference cache behavior: a failed fetch keeps the previous metrics window
                 pass
 
-        self.thread = threading.Thread(target=fetch, daemon=True)
+        self.thread = threading.Thread(
+            target=fetch, daemon=True, name="load-watcher",
+        )
         self.thread.start()
 
 

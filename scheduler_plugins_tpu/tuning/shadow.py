@@ -292,7 +292,13 @@ class ShadowTuner:
         self._worker: Optional[_SweepWorker] = None
         self._inflight: Optional[dict] = None
         #: shadow scheduler cache (one rebuild per profile identity — its
-        #: jit caches amortize the sweep program across jobs)
+        #: jit caches amortize the sweep program across jobs). Guarded by
+        #: its own lock, NOT self._lock: the sweep worker, the deadlined
+        #: counterfactual probe, and main-thread invalidation all touch
+        #: it, and the rebuild trace it serializes is too slow to hold
+        #: the controller lock across. Order: _lock may nest _shadow_lock,
+        #: never the reverse.
+        self._shadow_lock = threading.Lock()
         self._shadow_key = None
         self._shadow_sched = None
         self._export_gauges()
@@ -379,8 +385,9 @@ class ShadowTuner:
                 # cannot adjudicate. A timed-out probe also leaves a
                 # zombie worker holding the cached shadow scheduler —
                 # drop the cache so later sweeps rebuild fresh
-                self._shadow_sched = None
-                self._shadow_key = None
+                with self._shadow_lock:
+                    self._shadow_sched = None
+                    self._shadow_key = None
                 self._rollback_locked("watchdog-fault:probe-unavailable")
                 return
             for name, delta in deltas.items():
@@ -474,13 +481,20 @@ class ShadowTuner:
         from scheduler_plugins_tpu.tuning import sweep as sweep_mod
 
         rec = records[-1]
+        # paired snapshot under the controller lock: `active` and
+        # `last_known_good` must come from the SAME promotion epoch —
+        # this probe runs on a deadline worker while the main thread can
+        # promote/rollback between two bare attribute reads, and a torn
+        # pair makes the 2-lane counterfactual compare weight vectors
+        # that never coexisted (race_audit CA001)
+        with self._lock:
+            active = np.asarray(self.active, np.int64).copy()
+            good = np.asarray(self.last_known_good, np.int64).copy()
         shadow = self._shadow_scheduler(rec)
-        corpus = ring_corpus([rec], shadow, base_weights=self.active)
+        corpus = ring_corpus([rec], shadow, base_weights=active)
         cc = corpus[0]
         cc.prepare(cc.scheduler)
-        W = np.stack([
-            self.active, np.asarray(self.last_known_good, np.int64)
-        ])
+        W = np.stack([active, good])
         A, _adm, wt = sweep_mod.sweep_cycle(shadow, cc.snap, W,
                                             auxes=cc.auxes)
         q = Q.batch_quality(cc.snap, A, wt)
@@ -724,8 +738,9 @@ class ShadowTuner:
         # zombie's plugin host-state mutations (a shared scheduler under
         # two threads could produce feasible-but-wrong candidates that
         # PASS the gates). Costs one rebuild + retrace after a failure.
-        self._shadow_sched = None
-        self._shadow_key = None
+        with self._shadow_lock:
+            self._shadow_sched = None
+            self._shadow_key = None
         obs.logger.warning("shadow sweep failed (%s): no tuning this round",
                            reason)
         self._maybe_disable_locked(reason)
@@ -813,21 +828,30 @@ class ShadowTuner:
     def _shadow_scheduler(self, rec):
         """Rebuild (or reuse) the shadow replay scheduler from a ring
         record's own profile capture — the live scheduler is never
-        touched from the sweep thread."""
+        touched from the sweep thread. `_shadow_lock` serializes the
+        memo AND the rebuild itself: the sweep worker and the deadlined
+        counterfactual probe both land here, and two threads tracing
+        through `rebuild_scheduler` at once corrupt the jit cache (the
+        _EXPLAIN_LOCK lesson; race_audit CA001/CA003)."""
         manifest = rec.manifest
         key = (
             flightrec._canonical_json(manifest.get("profile_config")),
             tuple(p["class"] for p in manifest["plugins"]),
         )
-        if self._shadow_key == key and self._shadow_sched is not None:
-            return self._shadow_sched
-        scheduler, _meta, _faithful = flightrec.rebuild_scheduler(
-            manifest,
-            lambda s, rec=rec: flightrec.unpack_pytree(s, rec.blobs),
-        )
-        self._shadow_key = key
-        self._shadow_sched = scheduler
-        return scheduler
+        with self._shadow_lock:
+            if self._shadow_key == key and self._shadow_sched is not None:
+                return self._shadow_sched
+            scheduler, _meta, _faithful = flightrec.rebuild_scheduler(
+                manifest,
+                lambda s, rec=rec: flightrec.unpack_pytree(s, rec.blobs),
+            )
+            # an ABANDONED probe/sweep may still reach this publish after
+            # its deadline: lock-serialized and key-idempotent, so a late
+            # stale publish costs at most one rebuild on the next key
+            # check — it can never hand two threads one scheduler
+            self._shadow_key = key  # race-audit: safe[CA005] — lock-serialized key-idempotent memo publish
+            self._shadow_sched = scheduler  # race-audit: safe[CA005] — lock-serialized key-idempotent memo publish
+            return scheduler
 
     def quiesce(self, timeout_s: float = 60.0) -> bool:
         """Wait for the in-flight shadow sweep (if any) to finish running

@@ -297,6 +297,11 @@ PROBATION_PROBES = "scheduler_probation_probes_total"
 #: watchdog workers orphaned inside a hung backend call (they cannot be
 #: interrupted, only abandoned — a flapping backend shows up here)
 SOLVE_WORKERS_ABANDONED = "scheduler_solve_workers_abandoned_total"
+#: live threads whose names match no entry of the committed concurrency
+#: manifest (docs/race_audit.json, tools/race_audit.py): a thread the
+#: static lockset analysis never modeled — audited code but unaudited
+#: topology. Counted per /healthz probe sighting.
+THREAD_TOPOLOGY_DRIFT = "scheduler_thread_topology_drift_total"
 #: anti-entropy digest checks of the resident serve state vs a freshly
 #: built snapshot (serving.engine.ServeEngine.verify)
 ANTIENTROPY_CHECKS = "scheduler_serve_antientropy_checks_total"

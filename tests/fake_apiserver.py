@@ -217,7 +217,8 @@ class FakeApiServer:
         httpd.lock = self.lock  # type: ignore[attr-defined]
         self._httpd = httpd
         self._thread = threading.Thread(
-            target=httpd.serve_forever, daemon=True
+            target=httpd.serve_forever, daemon=True,
+            name="fake-apiserver",
         )
         self._thread.start()
         self.url = f"http://127.0.0.1:{httpd.server_address[1]}"

@@ -1,0 +1,30 @@
+"""Golden-bad GL012: anonymous threads. The concurrency auditor
+(tools/race_audit.py) and the daemon's /healthz thread census key entry
+points by thread NAME — an anonymous thread shows up as `Thread-7` live
+and `anon@file:line` in the manifest, so topology drift cannot be
+attributed; implicit daemon is a shutdown hazard."""
+
+import threading
+from threading import Thread
+
+
+def poll(state):
+    state["polls"] = state.get("polls", 0) + 1
+
+
+def start_all(state):
+    # BUG: no name=, no daemon=
+    t1 = threading.Thread(target=poll, args=(state,))
+    t1.start()
+    # BUG: daemon without a name (unauditable entry point)
+    t2 = threading.Thread(target=poll, args=(state,), daemon=True)
+    t2.start()
+    # BUG: the bare imported-name spelling of the same thing
+    t3 = Thread(target=poll, args=(state,))
+    t3.start()
+    # OK: named AND explicit daemon — auditable, clean shutdown story
+    t4 = threading.Thread(
+        target=poll, args=(state,), name="poller", daemon=True
+    )
+    t4.start()
+    return t1, t2, t3, t4

@@ -1,0 +1,37 @@
+"""Golden-bad CA002: lock-order inversion. The flush thread takes
+QUEUE_LOCK then RING_LOCK; main takes RING_LOCK then QUEUE_LOCK — a
+classic two-lock deadlock the moment both run concurrently. No shared
+data is touched outside the locks, so CA001 stays silent; only the
+acquisition-order graph sees the cycle."""
+
+import threading
+import time
+
+QUEUE_LOCK = threading.Lock()
+RING_LOCK = threading.Lock()
+
+
+def flush_loop(stop):
+    while not stop.is_set():
+        # BUG: QUEUE_LOCK -> RING_LOCK here ...
+        with QUEUE_LOCK:
+            with RING_LOCK:
+                time.sleep(0.001)
+
+
+def start_flusher(stop):
+    t = threading.Thread(
+        target=flush_loop, args=(stop,), name="flush-loop", daemon=True
+    )
+    t.start()
+    return t
+
+
+def main():
+    stop = threading.Event()
+    start_flusher(stop)
+    # BUG: ... RING_LOCK -> QUEUE_LOCK here: the inverted order
+    with RING_LOCK:
+        with QUEUE_LOCK:
+            time.sleep(0.001)
+    stop.set()

@@ -1,0 +1,23 @@
+"""Golden-bad CA004: a SIGTERM handler that takes a lock the main loop
+also takes. The handler can fire ON the main thread while main already
+holds STATE_LOCK — a non-reentrant self-deadlock. Handlers must only
+set Events / flip flags. All accesses are under the common lock, so
+CA001 stays silent; the signal entry's lock acquisition is the finding."""
+
+import signal
+import threading
+
+STATE_LOCK = threading.Lock()
+PENDING = []
+
+
+def _on_term(signum, frame):
+    # BUG: lock acquisition inside a signal handler
+    with STATE_LOCK:
+        PENDING.clear()
+
+
+def main():
+    signal.signal(signal.SIGTERM, _on_term)
+    with STATE_LOCK:
+        PENDING.append("job")

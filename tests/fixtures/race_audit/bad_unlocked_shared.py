@@ -1,0 +1,36 @@
+"""Golden-bad CA001: shared mutable state written on a worker thread and
+read on the main thread with no common lock on any access path. Every
+thread is named + explicit-daemon, so graft_lint (GL012 included) sees
+nothing — only the lockset auditor catches it."""
+
+import threading
+import time
+
+
+class StatsService:
+    def __init__(self):
+        self.stats = {}
+        self.stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="stats-loop", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while not self.stop.is_set():
+            # BUG: lock-free write, racing main's lock-free read below
+            self.stats["samples"] = self.stats.get("samples", 0) + 1
+            time.sleep(0.01)
+
+
+def main():
+    svc = StatsService()
+    svc.start()
+    time.sleep(0.05)
+    # BUG: lock-free read of the dict the stats-loop thread mutates
+    report = dict(svc.stats)
+    svc.stop.set()
+    return report

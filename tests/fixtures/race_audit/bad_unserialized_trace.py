@@ -1,0 +1,32 @@
+"""Golden-bad CA003: a scheduler-rebuilding (jit-tracing) call reachable
+from two thread entry points with no common serializing lock — the
+flightrec `_EXPLAIN_LOCK` lesson: two threads tracing at once corrupt
+the jit cache. No shared attributes are involved, so CA001 stays silent."""
+
+import threading
+import time
+
+
+def rebuild_scheduler(manifest):
+    # stand-in for flightrec.rebuild_scheduler: traces + fills jit caches
+    return object()
+
+
+def sweep_loop(stop, manifest):
+    while not stop.is_set():
+        # BUG: lock-free trace on the sweep thread ...
+        rebuild_scheduler(manifest)
+        time.sleep(0.01)
+
+
+def main():
+    stop = threading.Event()
+    manifest = {"plugins": []}
+    t = threading.Thread(
+        target=sweep_loop, args=(stop, manifest),
+        name="sweep-loop", daemon=True,
+    )
+    t.start()
+    # BUG: ... racing main's lock-free trace of the same programs
+    rebuild_scheduler(manifest)
+    stop.set()

@@ -1,0 +1,30 @@
+"""Golden-bad CA005: a watchdog-deadlined worker (`wd-*` thread) that
+writes instance state beyond its own locals and result box/Event. After
+the deadline fires the worker is ABANDONED but keeps running — a late
+write lands at an arbitrary point of a later cycle. The abandonment
+contract: locals + the result box/Event only. Nothing else reads the
+attribute, so CA001 stays silent; the contract itself is the finding."""
+
+import threading
+
+
+class DeadlinedSolve:
+    def __init__(self):
+        self.attempts_total = 0
+
+    def run(self, label):
+        done = threading.Event()
+        box = {}
+
+        def worker():
+            # BUG: instance-state write from an abandonable wd-* worker
+            self.attempts_total += 1
+            # OK by contract: the closure-local result box + Event
+            box["value"] = 42
+            done.set()
+
+        t = threading.Thread(
+            target=worker, name=f"wd-{label}", daemon=True
+        )
+        t.start()
+        return t, box, done

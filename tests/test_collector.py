@@ -54,7 +54,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 def serve():
     server = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="test-watcher",
+    )
     thread.start()
     return server, f"http://127.0.0.1:{server.server_port}"
 
@@ -214,7 +216,10 @@ class TestPrometheusCollector:
                 pass
 
         server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name="test-watcher",
+        ).start()
         return server, Handler, f"http://127.0.0.1:{server.server_port}"
 
     def test_fetch_parses_vectors_and_strips_ports(self):
@@ -296,7 +301,10 @@ class TestMetricsServerCollector:
                 pass
 
         server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name="test-watcher",
+        ).start()
         return server, Handler, f"http://127.0.0.1:{server.server_port}"
 
     def test_fetch_computes_percent_of_capacity(self):
@@ -405,7 +413,10 @@ class TestSignalFxCollector:
                 pass
 
         server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name="test-watcher",
+        ).start()
         return server, Handler, f"http://127.0.0.1:{server.server_port}"
 
     def test_fetch_averages_window_and_resolves_hosts(self):

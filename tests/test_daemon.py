@@ -504,3 +504,71 @@ class TestApiserverOutageRecovery:
             if proc.poll() is None:
                 proc.kill()
                 proc.communicate()
+
+
+class TestThreadTopology:
+    """/healthz `threads` block: the live thread census diffed against
+    the static concurrency model (tools/race_audit.py entry table +
+    docs/race_audit.json)."""
+
+    def test_model_covers_the_daemons_thread_names(self):
+        from scheduler_plugins_tpu.__main__ import _known_thread_patterns
+
+        import fnmatch
+
+        pats = _known_thread_patterns()
+        for name in ("MainThread", "health-server", "feed-server",
+                     "leader-elector", "load-watcher", "shadow-tuner",
+                     "solve-watchdog", "wd-race-smoke.hang",
+                     "spt-bind-flusher_0", "agent-/api/v1/pods"):
+            assert any(fnmatch.fnmatch(name, p) for p in pats), name
+
+    def test_unmodeled_thread_is_drift(self):
+        import threading
+
+        from scheduler_plugins_tpu.__main__ import thread_topology
+
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, daemon=True,
+                             name="totally-unmodeled-thread")
+        t.start()
+        try:
+            topo = thread_topology()
+            assert "totally-unmodeled-thread" in topo["unknown"]
+            assert "totally-unmodeled-thread" in topo["live"]
+        finally:
+            stop.set()
+            t.join()
+
+    def test_healthz_reports_threads_and_counts_drift(self):
+        import threading
+        from types import SimpleNamespace
+
+        from scheduler_plugins_tpu.__main__ import HealthServer
+        from scheduler_plugins_tpu.utils import observability as obs
+
+        daemon = SimpleNamespace(
+            cycles=0, bound_total=0, last_pending=0, last_quality=None,
+            feed=SimpleNamespace(address=("127.0.0.1", 0)),
+            resilience=None, parked_cycles=0, pipeline=None, engine=None,
+            tuner=None, elector=None,
+        )
+        stop = threading.Event()
+        rogue = threading.Thread(target=stop.wait, daemon=True,
+                                 name="rogue-unmodeled-thread")
+        rogue.start()
+        before = obs.metrics.snapshot().get(obs.THREAD_TOPOLOGY_DRIFT, 0)
+        hs = HealthServer(daemon, "127.0.0.1", 0)
+        try:
+            host, port = hs.address
+            health = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=5).read())
+            assert "rogue-unmodeled-thread" in health["threads"]["unknown"]
+            assert "MainThread" in health["threads"]["live"]
+            after = obs.metrics.snapshot().get(
+                obs.THREAD_TOPOLOGY_DRIFT, 0)
+            assert after > before
+        finally:
+            stop.set()
+            rogue.join()
+            hs.stop()

@@ -31,6 +31,7 @@ class TestGoldenBad:
             ("bad_all_gather.py", "GL009"),
             ("bad_swallow.py", "GL010"),
             ("bad_pallas_kernel.py", "GL011"),
+            ("bad_anonymous_thread.py", "GL012"),
         ],
     )
     def test_flagged(self, fixture, rule):
@@ -57,6 +58,16 @@ class TestGoldenBad:
         # branch and the host helper outside any kernel stay clean
         assert len(findings) == 4
         assert rules_for(FIXTURES / "bad_pallas_kernel.py") == {"GL011"}
+
+    def test_anonymous_thread_fixture_flags_only_unnamed(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_anonymous_thread.py"])
+            if f.rule == "GL012"
+        ]
+        # fully anonymous, daemon-only, and the bare-Thread import form —
+        # the named+daemon thread at the bottom must stay clean
+        assert len(findings) == 3
+        assert rules_for(FIXTURES / "bad_anonymous_thread.py") == {"GL012"}
 
     def test_all_gather_fixture_flags_only_node_axis_sites(self):
         findings = [

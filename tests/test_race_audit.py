@@ -1,0 +1,258 @@
+"""Concurrency auditor tests (tools/race_audit.py) and the runtime
+checker (utils/racecheck.py): each golden-bad fixture fires exactly its
+CA rule, the committed manifest stays fail-closed, the racecheck proxies
+catch order inversions / ownership violations, and the concurrency bugs
+the auditor's first run surfaced stay fixed."""
+
+import json
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+import tools.race_audit as R
+from tools.race_audit import audit_paths
+
+FIXTURES = Path(__file__).parent / "fixtures" / "race_audit"
+
+
+def fired(path):
+    res = audit_paths([str(path)])
+    return {rule for rule, count in res["rules"].items() if count}
+
+
+class TestGoldenBad:
+    @pytest.mark.parametrize(
+        "fixture, rule",
+        [
+            ("bad_unlocked_shared.py", "CA001"),
+            ("bad_lock_inversion.py", "CA002"),
+            ("bad_unserialized_trace.py", "CA003"),
+            ("bad_signal_lock.py", "CA004"),
+            ("bad_watchdog_writer.py", "CA005"),
+        ],
+    )
+    def test_flagged_exactly(self, fixture, rule):
+        # each fixture isolates ONE failure mode: its own rule fires and
+        # no other rule piggybacks (the docstrings explain why the
+        # neighboring rules stay silent)
+        assert fired(FIXTURES / fixture) == {rule}
+
+    def test_every_rule_has_a_fixture(self):
+        covered = set()
+        for fx in sorted(FIXTURES.glob("bad_*.py")):
+            covered |= fired(fx)
+        assert covered == set(R.RULES)
+
+    def test_fixtures_invisible_to_graft_lint(self):
+        # the race corpus must not double as a lint corpus: graft_lint
+        # walks these only when pointed at them directly, and even then
+        # has nothing to say (threads are named, no swallowed excepts)
+        from tools.graft_lint import lint_paths
+
+        assert lint_paths(sorted(FIXTURES.glob("bad_*.py"))) == []
+
+    def test_sanction_suppresses(self, tmp_path):
+        bad = (FIXTURES / "bad_signal_lock.py").read_text()
+        sanctioned = bad.replace(
+            "with STATE_LOCK:\n        PENDING.clear()",
+            "with STATE_LOCK:  "
+            "# race-audit: safe[CA004] — fixture sanction\n"
+            "        PENDING.clear()",
+        )
+        assert sanctioned != bad
+        p = tmp_path / "sanctioned_signal_lock.py"
+        p.write_text(sanctioned)
+        res = audit_paths([str(p)])
+        assert not any(res["rules"].values())
+        assert res["census"]["sanctioned_sites"] == 1
+
+
+class TestTreeAndManifest:
+    def test_tree_audits_clean_against_manifest(self):
+        # THE gate: the whole package, checked read-only against the
+        # committed manifest (entry-table and census drift included)
+        assert R.run(check=True) == 0
+
+    def test_manifest_shape(self):
+        man = json.loads(R.MANIFEST.read_text())
+        assert man["tool"] == R.TOOL_VERSION
+        assert set(man["rules"]) == set(R.RULES)
+        assert not any(man["rules"].values())
+        # the daemon's known thread topology must be covered
+        for entry in (
+            "main", "spt-bind-flusher*", "shadow-tuner", "wd-*",
+            "solve-watchdog", "health-server", "feed-server",
+            "leader-elector", "load-watcher",
+        ):
+            assert entry in man["entries"], entry
+            assert man["entries"][entry]["targets"], entry
+
+    def test_check_fails_closed_without_manifest(self, monkeypatch,
+                                                 tmp_path):
+        monkeypatch.setattr(R, "MANIFEST", tmp_path / "absent.json")
+        fx = str(FIXTURES / "bad_unlocked_shared.py")
+        assert R.run(paths=[fx], check=True) == 1
+        assert not (tmp_path / "absent.json").exists()
+
+    def test_check_flags_entry_table_drift(self, capsys):
+        # auditing a different file set against the committed manifest
+        # must trip the drift tripwire, not silently pass
+        assert R.run(paths=[str(FIXTURES)], check=True) == 1
+        assert "drift" in capsys.readouterr().err
+
+
+class TestRacecheck:
+    def test_install_noop_without_env(self, monkeypatch):
+        from scheduler_plugins_tpu.utils import racecheck
+
+        monkeypatch.delenv("SPT_RACE", raising=False)
+        assert racecheck.install(seed=0) is False
+        assert threading.Lock is racecheck._state.get(
+            "orig", {}
+        ).get("Lock", threading.Lock)
+
+    def test_proxies_catch_violations(self, monkeypatch):
+        from scheduler_plugins_tpu.utils import racecheck
+
+        monkeypatch.setenv("SPT_RACE", "1")
+        assert racecheck.install(seed=0, extra_prefixes=(__name__,))
+        try:
+            a, b = threading.Lock(), threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:  # reversed: the runtime twin of CA002
+                with a:
+                    pass
+            lock = threading.Lock()
+            t = threading.Thread(
+                target=lock.acquire, name="rc-owner", daemon=True
+            )
+            t.start()
+            t.join()
+            lock.release()  # released from a thread that never acquired
+            held = threading.Lock()
+            held.acquire()
+            with pytest.raises(RuntimeError, match="double acquire"):
+                held.acquire()  # guaranteed self-deadlock: raised too
+            kinds = {v["kind"] for v in racecheck.violations()}
+            assert kinds == {
+                "lock-order-inversion",
+                "non-owner-release",
+                "double-acquire",
+            }
+            rep = racecheck.report()
+            assert rep["locks_created"] == 4
+            assert rep["order_edges"] >= 2
+        finally:
+            racecheck.uninstall()
+        assert threading.Lock is racecheck._state["orig"]["Lock"]
+
+    def test_stdlib_locks_stay_raw(self, monkeypatch):
+        # Condition/queue/futures internals must keep real primitives:
+        # only scheduler_plugins_tpu-created locks get proxied
+        from scheduler_plugins_tpu.utils import racecheck
+
+        monkeypatch.setenv("SPT_RACE", "1")
+        assert racecheck.install(seed=0)
+        try:
+            cond = threading.Condition()  # allocates its own lock
+            with cond:
+                cond.notify_all()
+            assert racecheck.report()["locks_created"] == 0
+        finally:
+            racecheck.uninstall()
+
+
+class TestRegressions:
+    """The auditor's first tree run surfaced these for real — each fix
+    keeps a runtime witness so a revert fails loudly, not statically."""
+
+    def test_shadow_rebuild_serialized(self, monkeypatch):
+        # ShadowTuner._shadow_scheduler: the sweep worker and a deadlined
+        # wd-* probe both land here; pre-fix, both could trace through
+        # rebuild_scheduler at once (CA001 on _shadow_key/_shadow_sched,
+        # CA003 on the shared jit cache). _shadow_lock must serialize the
+        # rebuild itself, not just the memo publish.
+        from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+        from scheduler_plugins_tpu.utils import flightrec
+
+        tuner = ShadowTuner.__new__(ShadowTuner)
+        tuner._shadow_lock = threading.Lock()
+        tuner._shadow_key = None
+        tuner._shadow_sched = None
+
+        gate = threading.Barrier(4)
+        active, peak, calls = [0], [0], [0]
+        meter = threading.Lock()
+
+        def slow_rebuild(manifest, loader):
+            with meter:
+                calls[0] += 1
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.02)
+            with meter:
+                active[0] -= 1
+            return object(), {}, True
+
+        monkeypatch.setattr(flightrec, "rebuild_scheduler", slow_rebuild)
+        rec = SimpleNamespace(
+            manifest={"profile_config": {}, "plugins": []}, blobs={}
+        )
+        out = []
+
+        def probe():
+            gate.wait()
+            out.append(tuner._shadow_scheduler(rec))
+
+        threads = [
+            threading.Thread(target=probe, name=f"wd-test-{i}",
+                             daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert peak[0] == 1, "concurrent rebuild_scheduler trace"
+        assert calls[0] == 1, "memo check must happen under the lock"
+        assert len(out) == 4 and all(s is out[0] for s in out)
+
+    def test_is_leader_event_backed(self):
+        # LeaseElector.is_leader: written by the elector thread, read by
+        # the scheduling loop and /healthz — pre-fix a plain bool
+        # attribute (CA001). Now an Event behind a property; assignment
+        # sites keep working unchanged through the setter.
+        from scheduler_plugins_tpu.bridge.leader import LeaseElector
+
+        el = LeaseElector("http://127.0.0.1:9", "tester")
+        assert isinstance(el._leader_event, threading.Event)
+        assert el.is_leader is False
+        el.is_leader = True
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(el.is_leader),
+            name="leader-reader", daemon=True,
+        )
+        t.start()
+        t.join()
+        assert seen == [True]
+        el.is_leader = False
+        assert el.is_leader is False
+
+    def test_counterfactual_weights_snapshot_under_lock(self):
+        # ShadowTuner._counterfactual_pair must snapshot active /
+        # last_known_good inside _lock (torn-pair read pre-fix): the
+        # source now witnesses both the lock and the copies
+        import inspect
+
+        from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+
+        src = inspect.getsource(ShadowTuner._counterfactual_pair)
+        head = src.split("shadow = self._shadow_scheduler", 1)[0]
+        assert "with self._lock:" in head
+        assert head.count(".copy()") >= 2

@@ -132,7 +132,10 @@ class DemoApiServer:
         self._httpd.serve_forever()
 
     def start_background(self):
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="demo-apiserver",
+        )
         t.start()
         return self
 

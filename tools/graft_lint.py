@@ -80,6 +80,15 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   best-effort paths (GC finalizers, shutdown cleanup, optional-dep
   probes) carry an inline ignore with their reason.
 
+- **GL012 anonymous-thread** — every `threading.Thread(...)` must pass
+  explicit `name=` and `daemon=`. The concurrency auditor
+  (`tools/race_audit.py`) and the daemon's `/healthz` thread census key
+  thread ENTRY POINTS by thread name — an anonymous thread is
+  unauditable (it shows up as `Thread-7` in the live census and as an
+  `anon@file:line` entry in the manifest, so topology drift cannot be
+  attributed). Implicit `daemon` is a shutdown hazard: a forgotten
+  non-daemon thread blocks interpreter exit.
+
 Dtype inference is deliberately conservative: a rule fires only when an
 operand PROVABLY carries int64 (explicit `.astype(jnp.int64)`, an int64
 array constructor, a local name assigned from one, or a known int64
@@ -682,6 +691,46 @@ WALL_CLOCK_ATTRS = frozenset({
 JIT_WRAPPERS = frozenset({"jit", "donated_chunk_solver", "checkified"})
 
 
+def check_thread_names(path, tree, findings):
+    """GL012: `threading.Thread(...)` without explicit `name=` and
+    `daemon=`. The bare-name `Thread(...)` form fires only when the
+    module binds `Thread` from threading — another class that happens
+    to be called Thread is not a finding."""
+    thread_imported = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "threading"
+        and any((alias.asname or alias.name) == "Thread"
+                and alias.name == "Thread" for alias in node.names)
+        for node in ast.walk(tree)
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "Thread"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"
+        ) or (
+            isinstance(f, ast.Name) and f.id == "Thread" and thread_imported
+        )
+        if not is_thread:
+            continue
+        kwargs = {k.arg for k in node.keywords if k.arg}
+        missing = [k for k in ("name", "daemon") if k not in kwargs]
+        if not missing:
+            continue
+        findings.append(Finding(
+            path, node, "GL012",
+            f"threading.Thread without explicit {' and '.join(missing)}: "
+            "the concurrency auditor (tools/race_audit.py) and the "
+            "/healthz thread census key entry points by thread name — "
+            "anonymous threads are unauditable, and implicit daemon is a "
+            "shutdown hazard",
+        ))
+
+
 def _callee_name(func):
     if isinstance(func, ast.Attribute):
         return func.attr
@@ -1157,6 +1206,7 @@ def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str
     check_node_axis_all_gather(rel, tree, findings)
     check_swallowed_exception(rel, tree, findings)
     check_pallas_kernel_purity(rel, tree, findings)
+    check_thread_names(rel, tree, findings)
     if not config_owner:
         check_config_update(rel, tree, findings)
     return findings, tree, source

@@ -1,0 +1,1398 @@
+#!/usr/bin/env python
+"""Whole-program static concurrency auditor: thread-topology discovery,
+RacerD-style must-hold lockset analysis, deadlock-order lint.
+
+`tools/graft_lint.py` enforces the CLAUDE.md invariants file-by-file at
+the source-AST level and `tools/jaxpr_audit.py` proves them on the traced
+programs; NEITHER sees the host-side thread topology that orchestrates
+them — the pipelined cycle's async bind flusher, the shadow-tuner worker
+lane, watchdog abandoned-on-timeout workers, the daemon's HTTP/signal/
+elector/agent threads, and the bridge feed/collector threads, all sharing
+mutable scheduler state behind ad-hoc `threading.Lock`s. This tool closes
+that gap (pure stdlib — no jax import, like graft_lint, so its CI job
+installs nothing):
+
+1. **Thread-entry discovery** — every `threading.Thread(target=...)`
+   (keyed by its `name=`, which GL012 makes mandatory),
+   `ThreadPoolExecutor(thread_name_prefix=...)` + `.submit(...)` lane,
+   worker-queue `.submit(...)` lane (a thread whose target is a method of
+   the worker class), threading-server handler class (the serve_forever
+   thread dispatches into `do_*`/`handle`), `signal.signal(...)` handler,
+   and the declared main-thread entries (`main()` functions and
+   `MAIN_METHODS`). `resilience.call_with_deadline(fn, ...)` payloads are
+   attached to the `wd-*` worker entry the wrapper spawns.
+2. **Reachability with locksets** — from each entry point the call graph
+   is walked (self/typed-attribute/alias/import resolution, conservative:
+   unresolvable calls are skipped) computing per-entry reachable
+   attribute/global read-write sets; every access site carries the set of
+   locks lexically held (`with lock:` scoping, linear
+   `acquire()`/`release()`), joined with the locks held at the call
+   sites on the path. The MUST-HOLD lockset of (entry, var) is the
+   intersection over all reachable access sites.
+
+Rules:
+
+- **CA001 unlocked shared state** — a var written on one entry point and
+  read (or written) on another where the two entries' must-hold locksets
+  share no common lock. Sync primitives (Lock/Event/Queue attrs) and
+  `__init__`-time publication (happens-before thread start) are exempt.
+- **CA002 lock-order inversion** — the cross-entry lock-acquisition
+  graph (edge A->B when B is acquired while A is held) contains a cycle:
+  a potential deadlock.
+- **CA003 unserialized tracing/memo** — a jit-trace or memo-insertion
+  site (`rebuild_scheduler`, `jax.jit`, `make_jaxpr`, `checkified`,
+  `donated_chunk_solver`, writes to `*cache*`/`*memo*` attrs) reachable
+  from two or more entry points with no common serializing lock — the
+  `flightrec._EXPLAIN_LOCK` lesson, generalized: concurrent tracing
+  corrupts the jit cache.
+- **CA004 signal-handler lock reach** — a signal handler's reachable set
+  acquires a lock that another entry point also acquires: the handler
+  can fire while that thread holds the lock, and deadlock. Handlers must
+  only set Events / flip flags.
+- **CA005 abandoned-worker writes** — a watchdog-abandonable worker
+  (entry name matching `wd-*` / `solve-watchdog`) whose reachable set
+  writes ANY attribute/global: the PR 9 abandonment contract says a
+  deadlined worker may write only its own locals and its result
+  box/Event, because it keeps running as an orphan after the deadline.
+
+Sanctioning an audited-safe site: a trailing
+`# race-audit: safe[CAxxx] — reason` comment. On an access/acquire line
+it exempts that site; on a `def` line it exempts the whole body; on a
+CALL line it exempts everything reached through that call on this path
+(the fence-ordered bind flusher idiom: the caller vouches for the
+subtree). Sanction counts are recorded in the manifest so review sees
+the audited surface.
+
+Verdicts + the entry-point table land in the committed fail-closed
+manifest `docs/race_audit.json` (the tpu_lowering/jaxpr_audit pattern):
+`--check` fails on a missing manifest, any recorded or current
+violation, and entry-table/census drift. The daemon's `/healthz`
+`threads` block diffs the live thread census against the manifest's
+entry table at runtime; `utils/racecheck.py` + `make race-smoke` are the
+dynamic counterpart (seeded interleavings over lock/event proxies).
+
+Usage:
+    python tools/race_audit.py             # audit the package, write manifest
+    python tools/race_audit.py --check     # read-only verify vs manifest
+    python tools/race_audit.py --paths f.py ...   # audit specific files
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+MANIFEST = REPO / "docs" / "race_audit.json"
+
+RULES = ("CA001", "CA002", "CA003", "CA004", "CA005")
+TOOL_VERSION = 1
+
+#: the default audit surface (the package; tools/tests are host-side
+#: single-threaded drivers)
+DEFAULT_ROOTS = ("scheduler_plugins_tpu",)
+
+#: methods that run on the MAIN thread by contract (the daemon loop);
+#: module-level functions literally named `main` join automatically
+MAIN_METHODS = (
+    "scheduler_plugins_tpu.__main__:Daemon.run",
+    "scheduler_plugins_tpu.__main__:Daemon.tick",
+)
+
+#: callables whose invocation traces/compiles or inserts into a jit cache
+#: (CA003's serialization surface)
+TRACE_CALLEES = frozenset({
+    "rebuild_scheduler", "jit", "make_jaxpr", "checkified",
+    "donated_chunk_solver", "eval_shape", "lower",
+})
+
+#: entry-name patterns bound by the watchdog abandonment contract (CA005)
+ABANDONABLE_PATTERNS = ("wd-*", "solve-watchdog")
+
+#: constructor names that create sync primitives — attributes holding one
+#: are synchronization, not shared data (their own thread safety is the
+#: stdlib's contract)
+_SYNC_CTORS = frozenset({
+    "Lock", "RLock", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "SimpleQueue", "Queue", "LifoQueue",
+    "PriorityQueue", "local",
+})
+_LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+#: method names that mutate their receiver (a call `self.x.append(...)`
+#: is a WRITE to self.x). Deliberately excludes Event.set/Queue.get and
+#: the observability counters' inc/set_gauge (internally locked).
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "sort",
+})
+
+_SAFE_RE = re.compile(r"#\s*race-audit:\s*safe(?:\[([A-Z0-9, ]+)\])?")
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+
+
+# ---------------------------------------------------------------------------
+# symbol model
+# ---------------------------------------------------------------------------
+
+
+class Fn:
+    """One function/method/nested-def: resolved accesses, lock
+    acquisitions and calls, each stamped with the lexically-held lockset
+    and any sanction at its line."""
+
+    def __init__(self, key, module, cls, name, node, path,
+                 is_method=False):
+        self.key = key          # "module:Class.meth" / "module:fn"
+        self.module = module
+        self.cls = cls          # owning class key or None
+        self.name = name
+        self.node = node
+        self.path = path
+        self.is_method = is_method
+        self.is_init = name in _INIT_METHODS
+        #: (var, kind, locks, line, sanctions)  kind in {"read","write"}
+        self.accesses: list = []
+        #: (lock_id, line, sanctions)
+        self.acquires: list = []
+        #: (target_fn_keys, locks, line, callee_name, sanctions)
+        self.calls: list = []
+        self.sanctions_def: frozenset = frozenset()
+
+
+class Cls:
+    def __init__(self, key, module, name, node):
+        self.key = key
+        self.module = module
+        self.name = name
+        self.node = node
+        self.bases: list[str] = []       # raw base names
+        self.methods: dict[str, Fn] = {}
+        self.attr_types: dict[str, str] = {}   # attr -> class key
+        self.sync_attrs: set[str] = set()
+        self.lock_attrs: set[str] = set()
+
+
+class Model:
+    def __init__(self):
+        self.files: dict[Path, ast.Module] = {}
+        self.sources: dict[Path, list[str]] = {}
+        self.modules: dict[Path, str] = {}
+        self.classes: dict[str, Cls] = {}        # key -> Cls
+        self.class_by_name: dict[str, list[str]] = {}
+        self.funcs: dict[str, Fn] = {}           # key -> Fn
+        self.module_funcs: dict[str, dict[str, str]] = {}  # mod -> name -> key
+        self.module_globals: dict[str, set[str]] = {}
+        self.lock_globals: dict[str, set[str]] = {}
+        self.imports: dict[str, dict[str, tuple]] = {}  # mod -> local -> spec
+        self.param_types: dict[tuple, str] = {}  # (fn_key, param) -> class key
+        # entry-point raw material
+        self.threads: list = []      # (name_pat, targets, named, line, path)
+        self.pools: dict[tuple, str] = {}        # (cls_key, attr) -> prefix
+        self.pool_submits: dict[tuple, list] = {}
+        self.worker_submits: dict[str, list] = {}  # worker cls key -> fn keys
+        self.servers: dict[tuple, str] = {}      # (cls_key, attr) -> handler
+        self.signals: list = []      # (signame, fn_keys, line, path)
+        self.deadline_targets: list = []         # fn keys
+
+    def mro(self, cls_key):
+        """cls_key plus transitively-resolved bases (parsed classes only)."""
+        out, stack = [], [cls_key]
+        while stack:
+            k = stack.pop(0)
+            if k in out or k not in self.classes:
+                continue
+            out.append(k)
+            c = self.classes[k]
+            for b in c.bases:
+                for cand in self.class_by_name.get(b, ()):
+                    stack.append(cand)
+        return out
+
+    def attr_owner(self, cls_key, attr):
+        """Class key in the MRO that declares `attr`, else cls_key."""
+        for k in self.mro(cls_key):
+            c = self.classes[k]
+            if (attr in c.attr_types or attr in c.sync_attrs
+                    or attr in c.lock_attrs):
+                return k
+        return cls_key
+
+    def find_method(self, cls_key, name):
+        for k in self.mro(cls_key):
+            fn = self.classes[k].methods.get(name)
+            if fn is not None:
+                return fn
+        return None
+
+
+def _ctor_name(call):
+    return _callee_name(call.func) if isinstance(call, ast.Call) else None
+
+
+def _builder_ctor(model: Model, val):
+    """`SomeClass(...).start()` where start's returns are all `self`
+    (the builder idiom) types the target as SomeClass."""
+    if not (isinstance(val, ast.Call)
+            and isinstance(val.func, ast.Attribute)
+            and isinstance(val.func.value, ast.Call)):
+        return None
+    inner = _ctor_name(val.func.value)
+    if not inner or not model.class_by_name.get(inner):
+        return None
+    meth = model.find_method(model.class_by_name[inner][0], val.func.attr)
+    if meth is None:
+        return None
+    rets = [s for s in ast.walk(meth.node) if isinstance(s, ast.Return)]
+    if rets and all(
+        isinstance(r.value, ast.Name) and r.value.id == "self" for r in rets
+    ):
+        return inner
+    return None
+
+
+def _collect_attr_census(model: Model):
+    """Sync/lock/typed attribute census — runs AFTER every file's symbol
+    pass so `self.x = SomeClass(...)` resolves classes from other files."""
+    for c in model.classes.values():
+        for meth in ast.walk(c.node):
+            if not isinstance(meth, ast.Assign) or len(meth.targets) != 1:
+                continue
+            t = meth.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            cn = _ctor_name(meth.value)
+            if not (cn in _SYNC_CTORS or (cn and model.class_by_name.get(cn))):
+                cn = _builder_ctor(model, meth.value)
+            if cn in _SYNC_CTORS:
+                c.sync_attrs.add(t.attr)
+                if cn in _LOCK_CTORS:
+                    c.lock_attrs.add(t.attr)
+            elif cn and model.class_by_name.get(cn):
+                c.attr_types[t.attr] = model.class_by_name[cn][0]
+
+
+def _module_name(path: Path) -> str:
+    try:
+        rel = path.resolve().relative_to(REPO)
+        return ".".join(rel.with_suffix("").parts)
+    except ValueError:
+        return path.stem
+
+
+def _sanctions_at(source_lines, line) -> frozenset:
+    if 0 < line <= len(source_lines):
+        m = _SAFE_RE.search(source_lines[line - 1])
+        if m:
+            rules = m.group(1)
+            if rules is None:
+                return frozenset(RULES)
+            return frozenset(r for r in re.split(r"[,\s]+", rules) if r)
+    return frozenset()
+
+
+def _name_pattern(node) -> str | None:
+    """Thread `name=` value as a match pattern: constants verbatim,
+    f-string interpolations collapsed to `*`."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass A: symbols (classes, functions, imports, globals, sync attrs)
+# ---------------------------------------------------------------------------
+
+
+def _collect_symbols(model: Model, path: Path, tree: ast.Module):
+    mod = model.modules[path]
+    model.module_funcs.setdefault(mod, {})
+    model.module_globals.setdefault(mod, set())
+    model.lock_globals.setdefault(mod, set())
+    model.imports.setdefault(mod, {})
+
+    ctor_name = _ctor_name
+
+    def reg_class(node, prefix):
+        key = f"{mod}:{prefix}{node.name}"
+        c = Cls(key, mod, node.name, node)
+        for b in node.bases:
+            n = _callee_name(b) if isinstance(b, ast.Call) else (
+                b.attr if isinstance(b, ast.Attribute)
+                else getattr(b, "id", None)
+            )
+            if n:
+                c.bases.append(n)
+        model.classes[key] = c
+        model.class_by_name.setdefault(node.name, []).append(key)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fkey = f"{key}.{item.name}"
+                fn = Fn(fkey, mod, key, item.name, item, path,
+                        is_method=True)
+                model.funcs[fkey] = fn
+                c.methods[item.name] = fn
+                walk_fn(item, key, prefix=f"{prefix}{node.name}.")
+            elif isinstance(item, ast.ClassDef):
+                reg_class(item, prefix=f"{prefix}{node.name}.")
+
+    def walk_fn(fn_node, cls_key, prefix):
+        """Register nested defs/classes inside a function body."""
+        for item in ast.walk(fn_node):
+            if item is fn_node:
+                continue
+            if isinstance(item, ast.ClassDef):
+                # nested handler classes (feed/health servers)
+                if not any(
+                    item.name == c.name and c.module == mod
+                    for c in model.classes.values()
+                ):
+                    reg_class(item, prefix=f"{prefix}{fn_node.name}.")
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fkey = f"{mod}:{prefix}{fn_node.name}.{item.name}"
+                if fkey not in model.funcs:
+                    fn = Fn(fkey, mod, cls_key, item.name, item, path)
+                    model.funcs[fkey] = fn
+                    # nested defs also resolvable by bare name
+                    model.module_funcs[mod].setdefault(item.name, fkey)
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            reg_class(node, prefix="")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{mod}:{node.name}"
+            fn = Fn(key, mod, None, node.name, node, path)
+            model.funcs[key] = fn
+            model.module_funcs[mod][node.name] = key
+            walk_fn(node, None, prefix="")
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    model.module_globals[mod].add(t.id)
+                    if ctor_name(node.value) in _LOCK_CTORS:
+                        model.lock_globals[mod].add(t.id)
+
+    # imports anywhere in the file (function-local `import threading` is
+    # common in hot-path modules) — first binding of a name wins
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                model.imports[mod].setdefault(
+                    a.asname or a.name.split(".")[0], ("module", a.name)
+                )
+        elif isinstance(node, ast.ImportFrom):
+            src = node.module or ""
+            if node.level:
+                parts = mod.split(".")
+                base = parts[: max(0, len(parts) - node.level)]
+                src = ".".join(base + ([src] if src else []))
+            if not src:
+                continue
+            for a in node.names:
+                model.imports[mod].setdefault(
+                    a.asname or a.name, ("from", src, a.name)
+                )
+
+
+# ---------------------------------------------------------------------------
+# pass B: resolution walk (two rounds to saturate attr/param types)
+# ---------------------------------------------------------------------------
+
+
+class _Resolver:
+    """Walk one function body with an environment mapping names to
+    resolutions and a lexical lockset, emitting resolved records."""
+
+    def __init__(self, model, fn: Fn, env: dict, emit: bool):
+        self.m = model
+        self.fn = fn
+        self.env = dict(env)
+        self.emit = emit
+        self.src = model.sources[fn.path]
+        self.held: list[str] = []
+
+    # -- expression resolution ---------------------------------------------
+
+    def resolve(self, node):
+        """-> ("instance", cls_key) | ("module", mod) | ("class", key) |
+        ("fn", key) | ("lock", id) | None."""
+        if isinstance(node, ast.Name):
+            r = self.env.get(node.id)
+            if r is not None:
+                return r
+            mod = self.fn.module
+            if node.id in self.m.lock_globals.get(mod, ()):
+                return ("lock", f"{mod}:{node.id}")
+            imp = self.m.imports.get(mod, {}).get(node.id)
+            if imp is not None:
+                if imp[0] == "module":
+                    return ("module", imp[1])
+                src_mod, name = imp[1], imp[2]
+                for k in self.m.class_by_name.get(name, ()):
+                    if self.m.classes[k].module == src_mod:
+                        return ("class", k)
+                fk = self.m.module_funcs.get(src_mod, {}).get(name)
+                if fk:
+                    return ("fn", fk)
+                if name in self.m.lock_globals.get(src_mod, ()):
+                    return ("lock", f"{src_mod}:{name}")
+                return ("module", src_mod)
+            for k in self.m.class_by_name.get(node.id, ()):
+                if self.m.classes[k].module == mod:
+                    return ("class", k)
+            fk = self.m.module_funcs.get(mod, {}).get(node.id)
+            if fk:
+                return ("fn", fk)
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            if base[0] == "instance":
+                owner = self.m.attr_owner(base[1], node.attr)
+                c = self.m.classes.get(owner)
+                if c is not None:
+                    if node.attr in c.lock_attrs:
+                        return ("lock", f"{owner.split(':')[-1]}.{node.attr}")
+                    ty = c.attr_types.get(node.attr)
+                    if ty:
+                        return ("instance", ty)
+                meth = self.m.find_method(base[1], node.attr)
+                if meth is not None:
+                    return ("fn", meth.key)
+                return None
+            if base[0] == "module":
+                mod = base[1]
+                if node.attr in self.m.lock_globals.get(mod, ()):
+                    return ("lock", f"{mod}:{node.attr}")
+                for k in self.m.class_by_name.get(node.attr, ()):
+                    if self.m.classes[k].module == mod:
+                        return ("class", k)
+                fk = self.m.module_funcs.get(mod, {}).get(node.attr)
+                if fk:
+                    return ("fn", fk)
+                return None
+            if base[0] == "class":
+                meth = self.m.find_method(base[1], node.attr)
+                if meth is not None:
+                    return ("fn", meth.key)
+            return None
+        if isinstance(node, ast.Call):
+            # with self.feed.locked(): -> the lock the method returns
+            tgt = self.resolve(node.func)
+            if tgt and tgt[0] == "fn":
+                body = self.m.funcs[tgt[1]].node.body
+                rets = [s for s in body if isinstance(s, ast.Return)]
+                if len(rets) == 1 and rets[0].value is not None:
+                    inner = _Resolver(
+                        self.m, self.m.funcs[tgt[1]],
+                        self._callee_env(self.m.funcs[tgt[1]]), emit=False,
+                    )
+                    r = inner.resolve(rets[0].value)
+                    if r and r[0] in ("lock", "instance"):
+                        return r
+            if tgt and tgt[0] == "class":
+                return ("instance", tgt[1])
+            return None
+        return None
+
+    def _callee_env(self, fn: Fn):
+        env = {}
+        if fn.cls is not None:
+            if fn.is_method and fn.node.args.args:
+                env[fn.node.args.args[0].arg] = ("instance", fn.cls)
+            elif not fn.is_method:
+                # a def nested inside a method: `self` is a closure ref
+                env["self"] = ("instance", fn.cls)
+        for a in fn.node.args.args:
+            ty = self.m.param_types.get((fn.key, a.arg))
+            if ty:
+                env[a.arg] = ("instance", ty)
+        return env
+
+    def resolve_fn_arg(self, node):
+        """A callable expression (thread target / submit arg) -> fn keys."""
+        if isinstance(node, ast.Lambda):
+            if isinstance(node.body, ast.Call):
+                return self.resolve_fn_arg(node.body.func)
+            return []
+        if isinstance(node, ast.Call):
+            name = _callee_name(node.func)
+            if name == "partial" and node.args:
+                return self.resolve_fn_arg(node.args[0])
+            return []
+        r = self.resolve(node)
+        if r and r[0] == "fn":
+            return [r[1]]
+        if r and r[0] == "class":  # callable class: its __call__ / __init__
+            meth = self.m.find_method(r[1], "__call__")
+            return [meth.key] if meth else []
+        return []
+
+    # -- variable identity --------------------------------------------------
+
+    def var_of(self, node):
+        """Attribute/Name node -> shared-variable id, or None (locals,
+        sync primitives, unresolvable bases)."""
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            if base[0] == "instance":
+                owner = self.m.attr_owner(base[1], node.attr)
+                c = self.m.classes.get(owner)
+                if c is not None and (node.attr in c.sync_attrs):
+                    return None
+                if self.m.find_method(base[1], node.attr) is not None:
+                    return None
+                return f"{owner.split(':')[-1]}.{node.attr}"
+            if base[0] == "module":
+                mod = base[1]
+                if node.attr in self.m.lock_globals.get(mod, ()):
+                    return None
+                if self.m.module_funcs.get(mod, {}).get(node.attr):
+                    return None
+                if self.m.class_by_name.get(node.attr):
+                    return None
+                return f"{mod}:{node.attr}"
+            return None
+        if isinstance(node, ast.Name):
+            mod = self.fn.module
+            if node.id in self.env or node.id in self.m.imports.get(mod, {}):
+                return None
+            if node.id in self.m.module_globals.get(mod, ()):
+                if node.id in self.m.lock_globals.get(mod, ()):
+                    return None
+                if node.id in self.m.module_funcs.get(mod, {}):
+                    return None
+                if self.m.class_by_name.get(node.id):
+                    return None
+                return f"{mod}:{node.id}"
+        return None
+
+    # -- emission -----------------------------------------------------------
+
+    def _san(self, line):
+        return _sanctions_at(self.src, line) | self.fn.sanctions_def
+
+    def access(self, node, kind):
+        if not self.emit:
+            return
+        var = self.var_of(node)
+        if var is None:
+            return
+        self.fn.accesses.append((
+            var, kind, frozenset(self.held), node.lineno, self._san(node.lineno)
+        ))
+
+    def acquire(self, lock_id, line):
+        if self.emit:
+            self.fn.acquires.append((
+                lock_id, frozenset(self.held), line, self._san(line)
+            ))
+
+    def call(self, targets, line, callee_name):
+        if self.emit and (targets or callee_name in TRACE_CALLEES):
+            self.fn.calls.append((
+                tuple(targets), frozenset(self.held), line, callee_name,
+                self._san(line),
+            ))
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk(self):
+        fn = self.fn
+        self.env.update(self._callee_env(fn))
+        fn.sanctions_def = _sanctions_at(self.src, fn.node.lineno)
+        # `global` declarations make bare-Name stores global writes
+        self.globals_decl = {
+            n for s in ast.walk(fn.node) if isinstance(s, ast.Global)
+            for n in s.names
+        }
+        self.walk_body(fn.node.body)
+
+    def walk_body(self, stmts):
+        for stmt in stmts:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scopes: walked as their own Fn/Cls
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                self.visit_expr(item.context_expr)
+                r = self.resolve(item.context_expr)
+                if r and r[0] == "lock":
+                    self.acquire(r[1], item.context_expr.lineno)
+                    self.held.append(r[1])
+                    pushed += 1
+                if item.optional_vars is not None and r is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name):
+                            self.env[n.id] = r
+            self.walk_body(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, ast.Assign):
+            self.visit_expr(stmt.value)
+            rhs = self.resolve(stmt.value)
+            for t in stmt.targets:
+                self.visit_target(t, rhs)
+            self._special_assign(stmt, rhs)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self.visit_expr(stmt.value)
+            self.visit_expr(stmt.target, aug=True)
+            self.visit_target(stmt.target, None)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+                if stmt.target is not None:
+                    self.visit_target(stmt.target, self.resolve(stmt.value))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.visit_expr(stmt.test)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            for h in stmt.handlers:
+                self.walk_body(h.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self.visit_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            # linear acquire()/release() tracking
+            v = stmt.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute):
+                r = self.resolve(v.func.value)
+                if r and r[0] == "lock":
+                    if v.func.attr == "acquire":
+                        self.acquire(r[1], v.lineno)
+                        self.held.append(r[1])
+                        return
+                    if v.func.attr == "release":
+                        if r[1] in self.held:
+                            self.held.remove(r[1])
+                        return
+            self.visit_expr(v)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self.walk_stmt(child)
+
+    def visit_target(self, t, rhs):
+        if isinstance(t, ast.Name):
+            if rhs is not None:
+                self.env[t.id] = rhs
+            elif t.id in self.env:
+                del self.env[t.id]
+            if t.id in getattr(self, "globals_decl", ()):
+                self.access(t, "write")
+        elif isinstance(t, ast.Attribute):
+            self.access(t, "write")
+            self.visit_expr(t.value)
+        elif isinstance(t, ast.Subscript):
+            # X[...] = v  mutates X
+            if isinstance(t.value, (ast.Attribute, ast.Name)):
+                self.access(t.value, "write")
+            self.visit_expr(t.value)
+            self.visit_expr(t.slice)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self.visit_target(e, None)
+
+    def visit_expr(self, node, aug=False):
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self.visit_call(node)
+            return
+        if isinstance(node, ast.Attribute):
+            self.access(node, "write" if aug else "read")
+            self.visit_expr(node.value)
+            return
+        if isinstance(node, ast.Name):
+            self.access(node, "write" if aug else "read")
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child)
+
+    # -- calls: resolution + thread-topology records ------------------------
+
+    def visit_call(self, node):
+        name = _callee_name(node.func)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if name == "Thread" and self._is_threading(node.func, "Thread"):
+            self._record_thread(node, kw)
+        elif name == "ThreadPoolExecutor":
+            pass  # handled at the assignment (needs the target attr)
+        elif name == "signal" and isinstance(node.func, ast.Attribute):
+            self._record_signal(node)
+        elif name == "submit" and isinstance(node.func, ast.Attribute):
+            self._record_submit(node)
+        elif name == "call_with_deadline" and node.args:
+            tks = self.resolve_fn_arg(node.args[0])
+            if self.emit and tks:
+                self.m.deadline_targets.extend(tks)
+
+        # mutating method call on a shared var is a write to it
+        if (isinstance(node.func, ast.Attribute) and name in _MUTATORS
+                and isinstance(node.func.value, (ast.Attribute, ast.Name))):
+            self.access(node.func.value, "write")
+
+        # resolve the callee for the call graph; record trace callees
+        targets = []
+        r = self.resolve(node.func)
+        if r and r[0] == "fn":
+            targets = [r[1]]
+        elif r and r[0] == "class":
+            init = self.m.find_method(r[1], "__init__")
+            if init is not None:
+                targets = [init.key]
+            self._infer_param_types(r[1], node)
+        self.call(targets, node.lineno, name)
+
+        self.visit_expr(node.func.value if isinstance(
+            node.func, ast.Attribute) else None)
+        for a in node.args:
+            self.visit_expr(a)
+        for k in node.keywords:
+            self.visit_expr(k.value)
+
+    def _is_threading(self, func, which):
+        if isinstance(func, ast.Name):
+            imp = self.m.imports.get(self.fn.module, {}).get(func.id)
+            return imp == ("from", "threading", which)
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            imp = self.m.imports.get(self.fn.module, {}).get(func.value.id)
+            return imp is not None and imp[:2] == ("module", "threading")
+        return False
+
+    def _record_thread(self, node, kw):
+        if not self.emit:
+            return
+        targets = self.resolve_fn_arg(kw["target"]) if "target" in kw else []
+        # target self.<attr>.serve_forever: a threading server — the
+        # entry's real bodies are the handler class's do_*/handle methods
+        if not targets and "target" in kw and isinstance(
+            kw["target"], ast.Attribute
+        ) and kw["target"].attr == "serve_forever":
+            targets = self._server_handlers(kw["target"].value)
+        pat = _name_pattern(kw.get("name"))
+        named = "name" in kw
+        if pat is None:
+            rel = _rel(self.fn.path)
+            pat = f"anon@{rel}:{node.lineno}"
+        self.m.threads.append((pat, targets, named, node.lineno, self.fn.path))
+
+    def _server_handlers(self, server_expr):
+        """self._httpd.serve_forever -> handler-class methods, via the
+        `self._httpd = SomeServer(addr, Handler)` assignment."""
+        if not (isinstance(server_expr, ast.Attribute)
+                and self.resolve(server_expr.value)):
+            return []
+        base = self.resolve(server_expr.value)
+        if base is None or base[0] != "instance":
+            return []
+        key = (base[1], server_expr.attr)
+        hcls = self.m.servers.get(key)
+        if hcls is None:
+            return []
+        c = self.m.classes.get(hcls)
+        if c is None:
+            return []
+        keys = [fn.key for n, fn in c.methods.items()
+                if n.startswith("do_") or n in ("handle", "_apply")]
+        return keys or [fn.key for fn in c.methods.values()]
+
+    def _record_signal(self, node):
+        f = node.func
+        if not (isinstance(f.value, ast.Name)
+                and self.m.imports.get(self.fn.module, {}).get(f.value.id,
+                                                               ())[:2]
+                == ("module", "signal")):
+            return
+        if len(node.args) < 2 or not self.emit:
+            return
+        sig = node.args[0]
+        signame = sig.attr if isinstance(sig, ast.Attribute) else "SIG"
+        targets = self.resolve_fn_arg(node.args[1])
+        self.m.signals.append(
+            (signame, targets, node.lineno, self.fn.path)
+        )
+
+    def _record_submit(self, node):
+        if not self.emit or not node.args:
+            return
+        base = node.func.value
+        tks = self.resolve_fn_arg(node.args[0])
+        if not tks:
+            return
+        if isinstance(base, ast.Attribute):
+            b = self.resolve(base.value)
+            if b and b[0] == "instance":
+                owner = self.m.attr_owner(b[1], base.attr)
+                key = (owner, base.attr)
+                if key in self.m.pools:
+                    self.m.pool_submits.setdefault(key, []).extend(tks)
+                    return
+        r = self.resolve(base)
+        if r and r[0] == "instance":
+            self.m.worker_submits.setdefault(r[1], []).extend(tks)
+
+    def _special_assign(self, stmt, rhs):
+        """Executor / server constructions need the assignment target."""
+        if not self.emit:
+            return
+        val = stmt.value
+        if isinstance(val, ast.IfExp):  # x = Pool(...) if flag else None
+            val = val.body if isinstance(val.body, ast.Call) else val.orelse
+        if not isinstance(val, ast.Call):
+            return
+        call = val
+        cname = _callee_name(call.func)
+        t = stmt.targets[0] if len(stmt.targets) == 1 else None
+        attr_key = None
+        if isinstance(t, ast.Attribute):
+            b = self.resolve(t.value)
+            if b and b[0] == "instance":
+                attr_key = (self.m.attr_owner(b[1], t.attr), t.attr)
+        if cname == "ThreadPoolExecutor" and attr_key:
+            kw = {k.arg: k.value for k in call.keywords if k.arg}
+            pat = _name_pattern(kw.get("thread_name_prefix"))
+            self.m.pools[attr_key] = (
+                f"{pat}*" if pat else
+                f"pool@{_rel(self.fn.path)}:{call.lineno}"
+            )
+        elif cname and "server" in cname.lower() and attr_key:
+            for a in call.args:
+                r = self.resolve(a)
+                if r and r[0] == "class":
+                    self.m.servers[attr_key] = r[1]
+                    break
+
+    def _infer_param_types(self, cls_key, call):
+        """HealthServer(self, ...) from a Daemon method: the constructor
+        param gets the caller's instance type."""
+        init = self.m.find_method(cls_key, "__init__")
+        if init is None:
+            return
+        params = [a.arg for a in init.node.args.args][1:]
+        for i, a in enumerate(call.args):
+            r = self.resolve(a)
+            if r and r[0] == "instance" and i < len(params):
+                self.m.param_types[(init.key, params[i])] = r[1]
+
+
+# ---------------------------------------------------------------------------
+# model build
+# ---------------------------------------------------------------------------
+
+
+def _rel(path: Path) -> str:
+    try:
+        return Path(path).resolve().relative_to(REPO).as_posix()
+    except ValueError:
+        return Path(path).name
+
+
+def build_model(paths) -> Model:
+    model = Model()
+    files = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    for f in files:
+        source = f.read_text()
+        tree = ast.parse(source, filename=str(f))
+        model.files[f] = tree
+        model.sources[f] = source.splitlines()
+        model.modules[f] = _module_name(f)
+    for f, tree in model.files.items():
+        _collect_symbols(model, f, tree)
+    _collect_attr_census(model)
+    # two resolution rounds: round 1 saturates attr/param types (and is
+    # thrown away), round 2 emits the final records
+    for rnd in (0, 1):
+        for fn in model.funcs.values():
+            fn.accesses, fn.acquires, fn.calls = [], [], []
+        model.threads, model.signals = [], []
+        model.pools, model.pool_submits = {}, {}
+        model.worker_submits, model.servers = {}, {}
+        model.deadline_targets = []
+        for fn in model.funcs.values():
+            _Resolver(model, fn, {}, emit=True).walk()
+    return model
+
+
+# ---------------------------------------------------------------------------
+# entry-point assembly
+# ---------------------------------------------------------------------------
+
+
+def discover_entries(model: Model) -> dict:
+    """entry name -> {"targets": [fn keys], "kind": ..., "sites": [...]}."""
+    entries: dict[str, dict] = {}
+
+    def add(name, targets, kind, site=None):
+        e = entries.setdefault(
+            name, {"targets": [], "kind": kind, "sites": []}
+        )
+        for t in targets:
+            if t not in e["targets"]:
+                e["targets"].append(t)
+        if site and site not in e["sites"]:
+            e["sites"].append(site)
+
+    for pat, targets, _named, line, path in model.threads:
+        kind = "server" if any(
+            model.funcs[t].cls and "Handler" in (model.funcs[t].cls or "")
+            for t in targets
+        ) else "thread"
+        add(pat, targets, kind, f"{_rel(path)}:{line}")
+    for key, prefix in model.pools.items():
+        add(prefix, model.pool_submits.get(key, []), "pool")
+    for cls_key, tks in model.worker_submits.items():
+        # a worker class whose loop thread is an entry: submitted fns run
+        # on that entry
+        for pat, targets, _n, _l, _p in model.threads:
+            if any(model.funcs[t].cls == cls_key for t in targets):
+                add(pat, tks, "thread")
+    for signame, targets, line, path in model.signals:
+        add(f"signal:{signame}", targets, "signal", f"{_rel(path)}:{line}")
+    if model.deadline_targets:
+        for name in entries:
+            if fnmatch.fnmatch(name, "wd-*"):
+                add(name, model.deadline_targets, "thread")
+                break
+        else:
+            if any(fnmatch.fnmatch(name, p) for name in entries
+                   for p in ABANDONABLE_PATTERNS):
+                pass
+    mains = [
+        k for mod, fns in model.module_funcs.items()
+        for n, k in fns.items() if n == "main"
+    ] + [m for m in MAIN_METHODS if m in model.funcs]
+    if mains:
+        add("main", mains, "main")
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# reachability + rules
+# ---------------------------------------------------------------------------
+
+
+class _EntryWalk:
+    """Per-entry reachable access/acquire/trace-site sets with must-hold
+    locksets (intersection over sites) and lock-order edges."""
+
+    def __init__(self, model: Model, entry: str, targets):
+        self.m = model
+        self.entry = entry
+        #: var -> kind -> [must-hold lockset (inter), sites, suppressed]
+        self.vars: dict[str, dict] = {}
+        self.acquired: dict[str, list] = {}   # lock -> sites (CA004)
+        self.edges: set[tuple] = set()        # (held, acquired)
+        self.edge_sites: dict[tuple, str] = {}
+        self.trace: dict[str, dict] = {}      # site -> {"locks":, "name":}
+        self.sanction_count = 0
+        self.reached: set[str] = set()
+        self._visited: set[tuple] = set()
+        for t in targets:
+            self._walk(t, frozenset(), frozenset())
+
+    def _walk(self, fn_key, held, suppressed, depth=0):
+        if depth > 64 or fn_key not in self.m.funcs:
+            return
+        state = (fn_key, held, suppressed)
+        if state in self._visited or len(self._visited) > 200_000:
+            return
+        self._visited.add(state)
+        self.reached.add(fn_key)
+        fn = self.m.funcs[fn_key]
+        sup_def = suppressed | fn.sanctions_def
+        if fn.sanctions_def:
+            self.sanction_count += 1
+        for var, kind, locks, line, san in fn.accesses:
+            if fn.is_init:
+                continue  # construction happens-before thread start
+            eff = held | locks
+            sup = sup_def | san
+            if san:
+                self.sanction_count += 1
+            rec = self.vars.setdefault(var, {})
+            slot = rec.setdefault(
+                kind, {"locks": None, "sites": [], "suppressed": set(RULES)}
+            )
+            slot["locks"] = eff if slot["locks"] is None else (
+                slot["locks"] & eff
+            )
+            if len(slot["sites"]) < 4:
+                slot["sites"].append(f"{_rel(fn.path)}:{line}")
+            slot["suppressed"] &= sup
+            # CA003: memo/cache attr writes are trace sites, keyed by
+            # the memo var (every insertion site of one memo must share
+            # a serializing lock)
+            attr = var.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+            if kind == "write" and ("cache" in attr or "memo" in attr):
+                self._trace_site(var, f"{_rel(fn.path)}:{line}", eff, sup)
+        for lock, locks, line, san in fn.acquires:
+            eff = held | locks
+            sup = sup_def | san
+            if san:
+                self.sanction_count += 1
+            if "CA004" not in sup:
+                self.acquired.setdefault(lock, []).append(
+                    f"{_rel(fn.path)}:{line}"
+                )
+            if "CA002" not in sup:
+                for h in eff:
+                    if h != lock:
+                        self.edges.add((h, lock))
+                        self.edge_sites.setdefault(
+                            (h, lock), f"{_rel(fn.path)}:{line}"
+                        )
+        for targets, locks, line, callee, san in fn.calls:
+            eff = held | locks
+            sup = sup_def | san
+            if san:
+                self.sanction_count += 1
+            if callee in TRACE_CALLEES:
+                # keyed by the traced program's NAME, not the call site:
+                # the jit/trace cache is per-program, so two lock-free
+                # call sites of one program race just as hard as one
+                self._trace_site(callee, f"{_rel(fn.path)}:{line}",
+                                 eff, sup)
+            for t in targets:
+                self._walk(t, eff, sup, depth + 1)
+
+    def _trace_site(self, name, site, locks, suppressed):
+        rec = self.trace.setdefault(
+            name, {"site": site, "locks": None, "suppressed": set(RULES)}
+        )
+        rec["locks"] = locks if rec["locks"] is None else (
+            rec["locks"] & locks
+        )
+        rec["suppressed"] &= suppressed
+
+
+def analyze(model: Model, entries: dict) -> dict:
+    walks = {
+        name: _EntryWalk(model, name, spec["targets"])
+        for name, spec in entries.items()
+    }
+    violations: list[dict] = []
+
+    def add(rule, detail, **extra):
+        violations.append({"rule": rule, "detail": detail, **extra})
+
+    # -- CA001: unlocked cross-entry shared state ---------------------------
+    all_vars = sorted({v for w in walks.values() for v in w.vars})
+    for var in all_vars:
+        flagged = False
+        for e1, w1 in walks.items():
+            wrec = w1.vars.get(var, {}).get("write")
+            if wrec is None or "CA001" in wrec["suppressed"]:
+                continue
+            for e2, w2 in walks.items():
+                if e2 == e1 or flagged:
+                    continue
+                for kind in ("read", "write"):
+                    rec = w2.vars.get(var, {}).get(kind)
+                    if rec is None or "CA001" in rec["suppressed"]:
+                        continue
+                    if (wrec["locks"] or frozenset()) & (
+                        rec["locks"] or frozenset()
+                    ):
+                        continue
+                    add(
+                        "CA001",
+                        f"{var!r} written on entry {e1!r} "
+                        f"({wrec['sites'][0]}) and {kind} on entry {e2!r} "
+                        f"({rec['sites'][0]}) with no common lock "
+                        f"(must-hold {sorted(wrec['locks'] or ())} vs "
+                        f"{sorted(rec['locks'] or ())})",
+                        var=var, entries=sorted((e1, e2)),
+                    )
+                    flagged = True
+                    break
+            if flagged:
+                break
+
+    # -- CA002: lock-order inversion ----------------------------------------
+    edges: dict[str, set] = {}
+    sites: dict[tuple, str] = {}
+    for w in walks.values():
+        for a, b in w.edges:
+            edges.setdefault(a, set()).add(b)
+            sites.setdefault((a, b), w.edge_sites.get((a, b), "?"))
+    seen_cycles = set()
+
+    def dfs(start, node, path):
+        for nxt in edges.get(node, ()):
+            if nxt == start and len(path) >= 2:
+                cyc = tuple(sorted(path))
+                if cyc not in seen_cycles:
+                    seen_cycles.add(cyc)
+                    order = " -> ".join(path + [start])
+                    where = ", ".join(
+                        sites.get((path[i], path[(i + 1) % len(path)]), "?")
+                        for i in range(len(path))
+                    )
+                    add(
+                        "CA002",
+                        f"lock-order cycle {order} (sites: {where}) — "
+                        "potential deadlock",
+                        locks=sorted(cyc),
+                    )
+            elif nxt not in path and len(path) < 6:
+                dfs(start, nxt, path + [nxt])
+
+    for a in sorted(edges):
+        dfs(a, a, [a])
+
+    # -- CA003: unserialized trace/memo programs ----------------------------
+    trace_progs: dict[str, dict] = {}
+    for e, w in walks.items():
+        for name, rec in w.trace.items():
+            if "CA003" in rec["suppressed"]:
+                continue
+            t = trace_progs.setdefault(
+                name, {"site": rec["site"], "by": {}}
+            )
+            t["by"][e] = rec["locks"] or frozenset()
+    for name, rec in sorted(trace_progs.items()):
+        if len(rec["by"]) < 2:
+            continue
+        common = None
+        for locks in rec["by"].values():
+            common = locks if common is None else (common & locks)
+        if common:
+            continue
+        add(
+            "CA003",
+            f"trace/memo program {name!r} (e.g. {rec['site']}) reachable "
+            f"from entries {sorted(rec['by'])} with no common serializing "
+            "lock (the _EXPLAIN_LOCK rule): concurrent tracing corrupts "
+            "the jit cache",
+            site=rec["site"], name=name, entries=sorted(rec["by"]),
+        )
+
+    # -- CA004: signal handlers reaching locks ------------------------------
+    for e, w in walks.items():
+        if not e.startswith("signal:"):
+            continue
+        other_locks = {
+            lock for e2, w2 in walks.items() if e2 != e
+            for lock in w2.acquired
+        }
+        for lock, lsites in sorted(w.acquired.items()):
+            if lock in other_locks:
+                add(
+                    "CA004",
+                    f"signal handler entry {e!r} acquires lock {lock!r} "
+                    f"({lsites[0]}) also taken by other entries: the "
+                    "handler can fire while the lock is held and "
+                    "deadlock — handlers must only set Events",
+                    lock=lock, entry=e,
+                )
+
+    # -- CA005: abandoned-worker writes -------------------------------------
+    for e, w in walks.items():
+        if not any(fnmatch.fnmatch(e, p) for p in ABANDONABLE_PATTERNS):
+            continue
+        for var in sorted(w.vars):
+            rec = w.vars[var].get("write")
+            if rec is None or "CA005" in rec["suppressed"]:
+                continue
+            add(
+                "CA005",
+                f"abandonable worker entry {e!r} writes {var!r} "
+                f"({rec['sites'][0]}): after the deadline the orphaned "
+                "worker keeps running — it may write only its own locals "
+                "and its result box/Event (the PR 9 abandonment contract)",
+                var=var, entry=e,
+            )
+
+    rule_counts = {r: 0 for r in RULES}
+    for v in violations:
+        rule_counts[v["rule"]] += 1
+    lock_edges = sorted(f"{a} -> {b}" for a in edges for b in edges[a])
+    return {
+        "rules": rule_counts,
+        "violations": violations,
+        "lock_order_edges": lock_edges,
+        "census": {
+            "functions": len(model.funcs),
+            "classes": len(model.classes),
+            "entries": len(entries),
+            "shared_vars": len(all_vars),
+            "locks": len({
+                lock for w in walks.values() for lock in w.acquired
+            }),
+            "sanctioned_sites": sum(
+                w.sanction_count for w in walks.values()
+            ),
+        },
+    }
+
+
+def audit_paths(paths) -> dict:
+    model = build_model(paths)
+    entries = discover_entries(model)
+    res = analyze(model, entries)
+    res["entries"] = {
+        name: {"kind": spec["kind"], "targets": sorted(spec["targets"])}
+        for name, spec in sorted(entries.items())
+    }
+    return res
+
+
+# ---------------------------------------------------------------------------
+# driver (mirrors jaxpr_audit: fail-closed --check, committed manifest)
+# ---------------------------------------------------------------------------
+
+
+def run(paths=None, check: bool = False) -> int:
+    paths = paths or [str(REPO / r) for r in DEFAULT_ROOTS]
+    default_set = paths == [str(REPO / r) for r in DEFAULT_ROOTS]
+    prior = {}
+    if MANIFEST.exists():
+        prior = json.loads(MANIFEST.read_text())
+    res = audit_paths(paths)
+    failures = [
+        f"{v['rule']} {v['detail']}" for v in res["violations"]
+    ]
+    print(
+        f"[race-audit] {res['census']['functions']} functions, "
+        f"{res['census']['entries']} thread entry points, "
+        f"{res['census']['shared_vars']} shared vars, "
+        f"{sum(res['rules'].values())} violations",
+        flush=True,
+    )
+    for name, spec in res["entries"].items():
+        print(f"[race-audit]   entry {name!r} ({spec['kind']}): "
+              f"{len(spec['targets'])} target(s)")
+
+    manifest = {
+        "tool": TOOL_VERSION,
+        "rules": res["rules"],
+        "entries": res["entries"],
+        "lock_order_edges": res["lock_order_edges"],
+        "census": res["census"],
+    }
+
+    if check and not prior:
+        failures.append(
+            "docs/race_audit.json missing: run `make race-audit` and "
+            "commit it"
+        )
+    if check and prior:
+        dirty = {r: c for r, c in prior.get("rules", {}).items() if c}
+        if dirty:
+            failures.append(f"manifest records violations: {dirty}")
+        if prior.get("entries") != manifest["entries"]:
+            missing = sorted(
+                set(manifest["entries"]) - set(prior.get("entries", {}))
+            )
+            extra = sorted(
+                set(prior.get("entries", {})) - set(manifest["entries"])
+            )
+            failures.append(
+                "thread-entry table drift vs manifest "
+                f"(new: {missing}, gone: {extra}) — intended? re-run "
+                "`make race-audit` and commit docs/race_audit.json"
+            )
+        elif prior.get("tool") == TOOL_VERSION and (
+            prior.get("census") != manifest["census"]
+            or prior.get("lock_order_edges") != manifest["lock_order_edges"]
+        ):
+            failures.append(
+                "concurrency census drift vs manifest — intended? re-run "
+                "`make race-audit` and commit docs/race_audit.json"
+            )
+
+    if not check and default_set and not failures:
+        MANIFEST.write_text(
+            json.dumps(manifest, indent=1, sort_keys=True) + "\n"
+        )
+        print(f"[race-audit] wrote {MANIFEST.relative_to(REPO)}")
+    elif not check:
+        reason = "failures" if failures else "non-default path set"
+        print(f"[race-audit] {reason}: manifest NOT rewritten")
+
+    for f in failures:
+        print(f"[race-audit] FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(
+            f"[race-audit] OK: {res['census']['entries']} entry points "
+            "audit clean (CA001-CA005)"
+        )
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--check", action="store_true",
+        help="read-only: verify against the committed manifest",
+    )
+    parser.add_argument(
+        "--paths", nargs="+", default=None,
+        help="files/dirs to audit (default: the package)",
+    )
+    args = parser.parse_args(argv)
+    return run(args.paths, check=args.check)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Seeded deterministic race harness (`make race-smoke`) — the dynamic
+gate paired with `tools/race_audit.py --check`.
+
+Replays a reduced pipelined-cycle + shadow-tuner + watchdog composite —
+the three concurrency surfaces the static auditor models: the async
+bind flusher, the shadow sweep worker lane (with its deadlined
+counterfactual probes), and a deliberately-hung `call_with_deadline`
+worker exercising the abandonment contract — under N seeded
+interleavings with `utils/racecheck.py` installed (`SPT_RACE=1`:
+lock/event proxies + a seeded cooperative yield injector).
+
+Asserts, across ALL interleavings:
+- zero lockset violations (non-owner release, double acquire),
+- zero lock-order inversions observed at runtime,
+- per-cycle placements BIT-IDENTICAL across every interleaving: the
+  tuner runs `observe_only=True`, so the shadow lane's full worker/lock
+  traffic runs but may never change live weights — scheduling output
+  must not depend on thread timing.
+
+One JSON line on stdout; rc 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # `python tools/race_smoke.py` from anywhere
+    sys.path.insert(0, str(REPO))
+
+os.environ["SPT_RACE"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_SEEDS = int(os.environ.get("SPT_RACE_SEEDS", "8"))
+N_CYCLES = 6
+HANG_CYCLE = 2          # cycle index that launches the hung worker
+HANG_S = 0.6            # how long the abandoned worker keeps running
+HANG_DEADLINE_S = 0.05  # watchdog gives up long before that
+
+
+def _build_cluster(Cluster, Node, Pod, Container, CPU, MEMORY, PODS):
+    gib = 1 << 30
+
+    def mknode(name, cpu=16_000):
+        return Node(
+            name=name, allocatable={CPU: cpu, MEMORY: 64 * gib, PODS: 110}
+        )
+
+    def mkpod(name, cpu=500, created=0):
+        return Pod(
+            name=name, creation_ms=created,
+            containers=[Container(requests={CPU: cpu, MEMORY: gib})],
+        )
+
+    cluster = Cluster()
+    for i in range(3):
+        cluster.add_node(mknode(f"n{i}"))
+    return cluster, mkpod
+
+
+def run_seed(seed: int) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.framework import (
+        PipelinedCycle,
+        Profile,
+        Scheduler,
+    )
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+    from scheduler_plugins_tpu.resilience.watchdog import (
+        BackendUnavailable,
+        call_with_deadline,
+    )
+    from scheduler_plugins_tpu.state.cluster import Cluster
+    from scheduler_plugins_tpu.utils import flightrec
+    from scheduler_plugins_tpu.tuning.shadow import ShadowTuner
+    from scheduler_plugins_tpu.utils import racecheck
+
+    if not racecheck.install(seed):
+        raise RuntimeError("racecheck.install refused (SPT_RACE unset?)")
+    try:
+        scheduler = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+        cluster, mkpod = _build_cluster(
+            Cluster, Node, Pod, Container, CPU, MEMORY, PODS
+        )
+        flightrec.recorder.start(capacity=4)
+        # observe_only: the whole shadow lane (worker thread, deadlined
+        # probes, promotion machinery) runs, but active weights can
+        # never change — the standing proof that placements must be
+        # interleaving-independent
+        tuner = ShadowTuner(
+            scheduler, candidates=8, corpus_cycles=2, sweep_every=2,
+            confirm_sweeps=1, observe_only=True, sync=False, seed=0,
+        )
+        pipe = PipelinedCycle(scheduler, cluster)
+        reports_by_cycle = []
+        hang_abandoned = False
+        now = 1_000
+        for i in range(N_CYCLES):
+            cluster.add_pod(mkpod(f"p{i}", created=i))
+            tuner.begin_cycle(now_ms=now)
+            report = pipe.tick(now)
+            tuner.observe_report(report)
+            reports_by_cycle.append(report)
+            if i == HANG_CYCLE:
+                try:
+                    call_with_deadline(
+                        lambda: time.sleep(HANG_S), HANG_DEADLINE_S,
+                        label="race-smoke.hang",
+                    )
+                except BackendUnavailable:
+                    hang_abandoned = True
+            now += 1_000
+        pipe.flush()
+        tuner.quiesce(timeout_s=30.0)
+        pipe.close()
+        # decision fields are only stable behind the conflict fence
+        # (PipelinedCycle.tick docstring) — snapshot them post-flush
+        placements = [dict(r.bound) for r in reports_by_cycle]
+        flightrec.recorder.stop()
+        # let the abandoned hang worker drain before uninstalling the
+        # proxies — its Event writes must stay instrumented to the end
+        time.sleep(HANG_S + 0.1)
+        rep = racecheck.report()
+        rep["placements"] = placements
+        rep["hang_abandoned"] = hang_abandoned
+        return rep
+    finally:
+        racecheck.uninstall()
+
+
+def main() -> int:
+    start = time.perf_counter()
+    failures = []
+    reports = []
+    for seed in range(N_SEEDS):
+        try:
+            reports.append(run_seed(seed))
+        except Exception as exc:
+            failures.append(f"seed {seed}: {type(exc).__name__}: {exc}")
+            break
+    total_violations = sum(len(r["violations"]) for r in reports)
+    for i, r in enumerate(reports):
+        for v in r["violations"]:
+            failures.append(f"seed {i}: {v['kind']}: {v['detail']}")
+        if not r["hang_abandoned"]:
+            failures.append(
+                f"seed {i}: the hung worker was not abandoned — the "
+                "watchdog deadline never fired"
+            )
+        if r["locks_created"] < 2:
+            failures.append(
+                f"seed {i}: only {r['locks_created']} checked locks "
+                "created — the proxies are not actually installed"
+            )
+    identical = bool(reports) and all(
+        r["placements"] == reports[0]["placements"] for r in reports
+    )
+    if reports and not identical:
+        failures.append(
+            "placements differ across interleavings (observe_only shadow "
+            "lane leaked into live scheduling, or the cycle is "
+            "timing-dependent)"
+        )
+    bound_total = (
+        sum(len(b) for b in reports[0]["placements"]) if reports else 0
+    )
+    result = {
+        "race_smoke": {
+            "seeds": len(reports),
+            "cycles": N_CYCLES,
+            "violations": total_violations,
+            "order_edges": max(
+                (r["order_edges"] for r in reports), default=0
+            ),
+            "locks_created": max(
+                (r["locks_created"] for r in reports), default=0
+            ),
+            "yields": sum(r["yields"] for r in reports),
+            "placements_identical": identical,
+            "pods_bound": bound_total,
+            "elapsed_s": round(time.perf_counter() - start, 3),
+            "failures": failures,
+        }
+    }
+    print(json.dumps(result))
+    for f in failures:
+        print(f"[race-smoke] FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
