@@ -163,11 +163,22 @@ endurance-smoke:
 gang-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --gang-smoke
 
+# CI K-lane gate (ISSUE 17): reduced config-15 run — every K's placements
+# bit-identical to the defined serial order on EVERY cycle (the
+# adversarial contended tail included), zero hard-constraint violations,
+# zero serial fallbacks, the contended phase forcing real cross-lane
+# conflicts through the fence, and the headline-K solve-boundary ratio
+# >= 1.5 (the full config-15 shape targets 2x at K=4; the smoke bound
+# absorbs 2-core CI runners — the shard-smoke precedent)
+.PHONY: lane-smoke
+lane-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --lane-smoke
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke
 
 .PHONY: lint
 lint:

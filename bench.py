@@ -699,7 +699,7 @@ CONFIG_METRICS = {
     8: "mega_pods_per_sec", 9: "chaos_churn_pods_per_sec",
     10: "rank_gang_pods_per_sec", 11: "cluster_life_pods_per_sec",
     12: "mega_gang_ranks_per_sec", 13: "packing_frontier_pods_per_sec",
-    14: "drifting_mix_pods_per_sec",
+    14: "drifting_mix_pods_per_sec", 15: "lane_pods_per_sec",
 }
 
 
@@ -3891,6 +3891,359 @@ def tune_live_smoke(bound_pct=5.0, rollback_bound=2):
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# config 15: K-lane optimistic concurrency — one conflict fence
+# ---------------------------------------------------------------------------
+
+#: the K-lane headline shape: zoned disjoint-tenant steady-state churn.
+#: 64 tenants spread over 8 zone extended resources (R = 12 axes) on 64
+#: deep nodes that never fill — the regime the lane screen certifies
+#: wholesale — plus an ADVERSARIAL contended tail: `hot_bidders` pods
+#: from distinct tenants (= distinct lanes) race `hot_slots` units of one
+#: node's scarce extended resource every contended cycle, forcing real
+#: cross-lane conflicts through the fence. Arrival/departure counts are
+#: FIXED (not Poisson): the pending axis then lands on one padding bucket
+#: every cycle, so no arm ever pays a retrace inside a timed cycle.
+LANE_SHAPE = dict(
+    n_nodes=64, zones=8, tenants=64, prefill=12288,
+    cycles=10, warmup=2, lam_arrive=12288, lam_depart=12288,
+    contend_cycles=3, hot_slots=8, hot_bidders=16,
+    ks=(1, 2, 4, 8), headline_k=4, reps=3,
+)
+#: reduced shape for the `make lane-smoke` CI gate (2-core runners): same
+#: zone/tenant structure, fewer cycles. The pending axis stays deep
+#: (1536/cycle) — the lane claim is about amortizing the per-pod serial
+#: scan, and a shallow queue measures fence fixed cost instead.
+LANE_SMOKE_SHAPE = dict(
+    n_nodes=48, zones=8, tenants=64, prefill=2048,
+    cycles=5, warmup=2, lam_arrive=6144, lam_depart=6144,
+    contend_cycles=2, hot_slots=4, hot_bidders=8,
+    ks=(1, 2, 4), headline_k=4, reps=3,
+)
+
+
+def _lane_cluster(shape, seed=0):
+    """Zoned multi-tenant cluster + one scarce 'hot' node. Prefill pods
+    arrive bound (the serving steady state); every bound pod's zone
+    request matches its node's zone so the end-of-run capacity audit
+    (`_churn_capacity_violations`) starts clean by construction."""
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    rng = np.random.default_rng(seed)
+    cluster = Cluster()
+    Z = shape["zones"]
+    for i in range(shape["n_nodes"]):
+        cluster.add_node(Node(
+            name=f"node-{i:04d}",
+            allocatable={CPU: 256_000, MEMORY: 1024 * gib, PODS: 1024,
+                         f"example.com/zone-{i % Z}": 100_000},
+        ))
+    cluster.add_node(Node(
+        name="node-hot",
+        allocatable={CPU: 64_000, MEMORY: 256 * gib, PODS: 512,
+                     "example.com/hot": shape["hot_slots"]},
+    ))
+    for i in range(shape["prefill"]):
+        j = i % shape["n_nodes"]
+        pod = Pod(
+            name=f"bound-{i:06d}", creation_ms=i,
+            namespace=f"tenant-{i % shape['tenants']:03d}",
+            containers=[Container(requests={
+                CPU: int(rng.integers(100, 900)),
+                MEMORY: int(rng.integers(256 << 20, 1 * gib)),
+                f"example.com/zone-{j % Z}": 1,
+            })],
+        )
+        pod.node_name = f"node-{j:04d}"
+        cluster.add_pod(pod)
+    return cluster
+
+
+def lane_scaling(shape=None, emit=True):
+    """Config 15: the K-lane optimistic-concurrency bench. Drives the
+    zoned churn through BOTH arms on the same snapshot every cycle — the
+    bit-faithful sequential solve (the defined serial order) and
+    `parallel.lanes.LaneSolver` at every K in `shape['ks']` — and gates
+    on per-cycle digest identity (assignment + admitted + wait) at every
+    K, including the contended tail where lanes genuinely collide and
+    the fence re-resolves.
+
+    Throughput accounting (the PR 7 discipline — this host exposes ONE
+    core, so K lanes time-slice instead of running concurrently):
+
+    - `ratio` (headline, the ISSUE gate): serial solve wall over the
+      laned SOLVE BOUNDARY, max(lane_ms) + fence_ms — the critical path
+      K independent schedulers would pay, measured per-lane under the
+      'sequential' dispatch so each lane's scan is a real wall time.
+    - `ratio_full`: adds partition_ms. The partition is the serial
+      coordinator prologue; a sharded deployment amortizes it into
+      watch ingest (each arrival is keyed once at the filter), so it is
+      reported, not hidden, but kept out of the headline.
+    - `ratio_wall`: honest in-process wall over wall — <= 1 on a 1-core
+      host by construction; documented, never gated.
+
+    Timed cycles cover only the disjoint-tenant phase (the ISSUE's
+    throughput claim); contended cycles assert identity + conflicts."""
+    import hashlib
+
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.parallel.lanes import LaneSolver
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+
+    shape = shape or LANE_SHAPE
+    gib = 1 << 30
+    T, Z = shape["tenants"], shape["zones"]
+    ks = list(shape["ks"])
+    cluster = _lane_cluster(shape)
+    cluster.enable_pending_index()
+    sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    solvers = {
+        k: LaneSolver(sched, k=k, partition="namespace",
+                      dispatch="sequential")
+        for k in ks
+    }
+    rng = np.random.default_rng(1)
+    serial_no = 0
+    total = shape["warmup"] + shape["cycles"]
+    contended_from = total - shape["contend_cycles"]
+    serial_s = 0.0
+    decided = 0
+    timed_cycles = 0
+    acc = {k: dict(boundary=0.0, full=0.0, wall=0.0, conflicts=0,
+                   re_resolved=0, fallbacks=0, partition=0.0,
+                   fence=0.0, max_lane=0.0)
+           for k in ks}
+    contended = dict(cycles=0, conflicts=0, re_resolved=0)
+    digests_ok = True
+    mismatches = []
+
+    def _arrive(n, hot=False):
+        nonlocal serial_no
+        from scheduler_plugins_tpu.api.objects import Container, Pod
+        from scheduler_plugins_tpu.api.resources import CPU, MEMORY
+
+        for _ in range(n):
+            serial_no += 1
+            t = serial_no % T
+            req = {CPU: int(rng.integers(100, 900)),
+                   MEMORY: int(rng.integers(256 << 20, 1 * gib))}
+            if hot:
+                req["example.com/hot"] = 1
+            else:
+                req[f"example.com/zone-{t % Z}"] = 1
+            cluster.add_pod(Pod(
+                name=f"{'hot' if hot else 'arr'}-{serial_no:06d}",
+                namespace=f"tenant-{t:03d}",
+                creation_ms=1_000_000 + serial_no,
+                containers=[Container(requests=req)],
+            ))
+
+    for cycle in range(total):
+        now = 1000 * (cycle + 1)
+        in_contention = cycle >= contended_from
+        if in_contention:
+            # reset the hot population (bound AND last round's losers),
+            # then race hot_bidders distinct-tenant pods for hot_slots
+            for uid in [u for u, p in cluster.pods.items()
+                        if p.name.startswith("hot-")]:
+                cluster.remove_pod(uid)
+            _arrive(shape["lam_arrive"] - shape["hot_bidders"])
+            _arrive(shape["hot_bidders"], hot=True)
+        else:
+            _arrive(shape["lam_arrive"])
+        bound = sorted(
+            u for u, p in cluster.pods.items()
+            if p.node_name is not None and p.name.startswith(("bound", "arr"))
+        )
+        picks = rng.choice(
+            len(bound), size=min(shape["lam_depart"], len(bound)),
+            replace=False,
+        )
+        for i in sorted(int(x) for x in picks):
+            cluster.remove_pod(bound[i])
+
+        pending = cluster.pending_pods()
+        P = len(pending)
+        snap, meta = cluster.snapshot(pending, now_ms=now)
+        sched.prepare(meta, cluster)
+
+        timed = cycle >= shape["warmup"] and not in_contention
+        # min over reps: both arms re-solve the SAME snapshot; the
+        # minimum is the standard estimator against preemption noise on
+        # an oversubscribed host (the replay-smoke pairing discipline's
+        # cousin), and it biases NEITHER arm — each takes its own min
+        reps = shape.get("reps", 1) if timed else 1
+
+        serial_dt = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = sched.solve(snap, mode="sequential")
+            a_ser = np.asarray(res.assignment)
+            ok_ser = np.asarray(res.admitted)
+            w_ser = np.asarray(res.wait)
+            dt = time.perf_counter() - t0
+            serial_dt = dt if serial_dt is None else min(serial_dt, dt)
+        digest = hashlib.sha256(
+            a_ser[:P].tobytes() + ok_ser[:P].tobytes() + w_ser[:P].tobytes()
+        ).hexdigest()[:16]
+
+        if timed:
+            serial_s += serial_dt
+            timed_cycles += 1
+            decided += P
+        if in_contention:
+            contended["cycles"] += 1
+        for k in ks:
+            best = None
+            for rep in range(reps):
+                t0 = time.perf_counter()
+                a, ok, w, codes, st = solvers[k].solve(
+                    snap, pending, cluster, meta=meta
+                )
+                wall = time.perf_counter() - t0
+                boundary = (
+                    max(st.lane_ms) + st.fence_ms
+                    if st.lane_ms else st.solve_ms
+                )
+                if rep == 0:
+                    # identity + conflict accounting from the first rep;
+                    # later reps only tighten the timing estimate (the
+                    # partition column stays rep-0 COLD — the key cache
+                    # is warm on re-solves of the same queue)
+                    d = hashlib.sha256(
+                        np.asarray(a)[:P].tobytes()
+                        + np.asarray(ok)[:P].tobytes()
+                        + np.asarray(w)[:P].tobytes()
+                    ).hexdigest()[:16]
+                    if d != digest:
+                        digests_ok = False
+                        mismatches.append({"cycle": cycle, "k": k})
+                    conflicts = sum(st.conflicts or [])
+                    acc[k]["conflicts"] += conflicts
+                    acc[k]["re_resolved"] += st.re_resolved
+                    if k > 1 and st.path == "serial":
+                        acc[k]["fallbacks"] += 1
+                    if in_contention and k > 1:
+                        contended["conflicts"] += conflicts
+                        contended["re_resolved"] += st.re_resolved
+                    partition0 = st.partition_ms
+                if best is None or boundary < best[0]:
+                    best = (boundary, wall, st.fence_ms,
+                            max(st.lane_ms) if st.lane_ms else 0.0)
+            if timed:
+                boundary, wall, fence, max_lane = best
+                a_k = acc[k]
+                a_k["boundary"] += boundary / 1000.0
+                a_k["full"] += (boundary + partition0) / 1000.0
+                a_k["wall"] += wall
+                a_k["partition"] += partition0
+                a_k["fence"] += fence
+                a_k["max_lane"] += max_lane
+
+        # commit the serial arm's placements (identical at every K by the
+        # digest gate) through the store's bind mutator
+        for i, pod in enumerate(pending):
+            if ok_ser[i] and a_ser[i] >= 0:
+                cluster.bind(
+                    pod.uid, meta.node_names[int(a_ser[i])], now_ms=now
+                )
+
+    for solver in solvers.values():
+        solver.close()
+    violations = _churn_capacity_violations(cluster)
+    hk = shape["headline_k"]
+    curve = []
+    for k in ks:
+        a_k = acc[k]
+        n = max(1, timed_cycles)
+        curve.append({
+            "k": k,
+            "ratio": round(serial_s / a_k["boundary"], 2)
+            if a_k["boundary"] else None,
+            "ratio_full": round(serial_s / a_k["full"], 2)
+            if a_k["full"] else None,
+            "ratio_wall": round(serial_s / a_k["wall"], 2)
+            if a_k["wall"] else None,
+            "pods_per_sec": round(decided / a_k["boundary"], 1)
+            if a_k["boundary"] else None,
+            "conflicts": a_k["conflicts"],
+            "re_resolved": a_k["re_resolved"],
+            "serial_fallbacks": a_k["fallbacks"],
+            "partition_ms_mean": round(a_k["partition"] / n, 3),
+            "max_lane_ms_mean": round(a_k["max_lane"] / n, 3),
+            "fence_ms_mean": round(a_k["fence"] / n, 3),
+        })
+    head = next(c for c in curve if c["k"] == hk)
+    line = {
+        "lanes": {
+            "ks": ks, "headline_k": hk, "dispatch": "sequential",
+            "partition": "namespace",
+            "timed_cycles": timed_cycles, "decisions": decided,
+            "serial_ms_total": round(serial_s * 1000, 3),
+            "curve": curve,
+            "contended": dict(contended),
+            "digest_mismatches": mismatches[:8],
+        },
+        "lane_ratio": head["ratio"],
+        "lane_ratio_full": head["ratio_full"],
+        "lane_ratio_wall": head["ratio_wall"],
+        "digests_match": bool(digests_ok),
+        "conflicts": contended["conflicts"],
+        "re_resolved": contended["re_resolved"],
+        "serial_fallbacks": sum(a["fallbacks"] for a in acc.values()),
+        "capacity_violations": violations,
+    }
+    if emit:
+        _emit(
+            CONFIG_METRICS[15],
+            decided / acc[hk]["boundary"] if acc[hk]["boundary"] else 0.0,
+            f"{shape['n_nodes']} nodes, {T} tenants / {Z} zones, "
+            f"{timed_cycles} cycles x {shape['lam_arrive']} pods, "
+            f"K={hk} lanes (solve boundary) vs defined serial order",
+            baseline=decided / serial_s if serial_s else 1.0,
+            drift=(0.0 if digests_ok else None),
+            quality=_quality_state(*_cluster_state_matrices(cluster)),
+            extra=line,
+        )
+    return line
+
+
+def lane_smoke(min_ratio=1.5):
+    """CI gate (`make lane-smoke`): reduced K-lane run — every K's
+    placements bit-identical to the defined serial order on EVERY cycle
+    (contended tail included), zero hard-constraint violations, zero
+    serial fallbacks, the contended phase actually forcing cross-lane
+    conflicts through the fence, and the headline-K solve-boundary ratio
+    >= `min_ratio` (the full config-15 shape targets the ISSUE's 2x; the
+    smoke bound absorbs 2-core CI runners, the shard-smoke precedent).
+    One JSON line; rc 1 on any failure."""
+    line = lane_scaling(shape=LANE_SMOKE_SHAPE, emit=False)
+    checks = {
+        "digests_match": line["digests_match"],
+        "zero_violations": line["capacity_violations"] == 0,
+        "no_serial_fallbacks": line["serial_fallbacks"] == 0,
+        "contention_exercised": line["conflicts"] > 0,
+        "contention_re_resolved": line["re_resolved"] > 0,
+        "ratio_at_headline_k": (
+            line["lane_ratio"] is not None
+            and line["lane_ratio"] >= min_ratio
+        ),
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "lane_smoke",
+        "min_ratio": min_ratio,
+        "backend": _backend_label(),
+        "checks": checks,
+        "ok": bool(ok),
+        **line,
+    }))
+    return 0 if ok else 1
+
+
 #: the columns every emitted bench line must carry regardless of path
 #: (success, error, stale-capture replay) — THE one schema statement the
 #: error/replay builders below and tests/test_bench_lines.py share, so a
@@ -4412,6 +4765,16 @@ if __name__ == "__main__":
                              "shadow-lane overhead, and the injected-"
                              "regression phase rolling back within 2 "
                              "cycles with no flapping")
+    parser.add_argument("--lane-smoke", action="store_true",
+                        help="CI gate: reduced K-lane config-15 run; "
+                             "fails unless every K's placements are "
+                             "bit-identical to the defined serial order "
+                             "on every cycle (contended tail included), "
+                             "zero hard-constraint violations, zero "
+                             "serial fallbacks, the contended phase "
+                             "forces real cross-lane conflicts through "
+                             "the fence, and the headline-K solve-"
+                             "boundary ratio clears the bound")
     parser.add_argument("--chaos-smoke", action="store_true",
                         help="CI gate: reduced chaos-churn run under the "
                              "full seeded fault plan (hung solve, device "
@@ -4495,6 +4858,17 @@ if __name__ == "__main__":
         # stream — both arms share whatever backend is configured, so no
         # tunnel probe (its health cancels out of every asserted claim)
         tuned_drifting_mix()
+        sys.exit(0)
+    if args.lane_smoke:
+        # CPU-backend CI gate (the Makefile target pins JAX_PLATFORMS=cpu):
+        # laned-vs-serial comparison on one shared snapshot stream, digest
+        # identity gated — no tunnel probe
+        sys.exit(lane_smoke())
+    if args.config == 15:
+        # K-lane vs defined-serial-order comparison on one shared snapshot
+        # stream — both arms share whatever backend is configured, so no
+        # tunnel probe (its health cancels out of every asserted claim)
+        lane_scaling()
         sys.exit(0)
     if args.config == 10:
         # rank-aware vs quorum-only comparison, full shape — both arms

@@ -97,6 +97,12 @@ class CycleReport:
     #: max_cost, sum_cost} — empty when the cycle ran without a gang
     #: phase or no rank-aware gang had pending members
     rank_gangs: dict = field(default_factory=dict)
+    #: lane attribution when the cycle ran under the K-lane optimistic
+    #: engine (`framework.laned_cycle.LanedCycle`): k, path
+    #: ("laned"/"serial" fallback), per-lane sizes/committed/conflicts,
+    #: re_resolved count and solve/fence wall ms (`LaneStats.as_dict`);
+    #: None for every other engine
+    lanes: dict | None = None
 
     def explain(self, uid: str, top_k: int = 5) -> dict:
         """The "why this node" score table for one pod of THIS cycle's

@@ -303,9 +303,10 @@ def _solve_step(plugins, carry, p, snap: ClusterSnapshot):
     """One pod of the bit-faithful sequential scan: PreFilter -> built-in
     fit (nominee holds) -> Filter chain -> Score/Normalize weighted sum ->
     argmax select -> Reserve commits — THE parity-path step body, shared by
-    `Scheduler.solve` and the vmapped counterfactual sweep
-    (`parallel.solver.sweep_solve_fn`), so a swept weight lane runs exactly
-    the program the parity path runs."""
+    `Scheduler.solve`, the vmapped counterfactual sweep
+    (`parallel.solver.sweep_solve_fn`) and the K-lane speculative solve
+    (`parallel.lanes.lane_solve_fn`, which feeds it a one-pod snapshot
+    view per step), so no fast path can drift from the parity program."""
     state = carry
     # PreFilter, with per-plugin attribution (shared helper)
     ok0 = snap.pods.mask[p] & ~snap.pods.gated[p]

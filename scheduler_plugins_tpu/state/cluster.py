@@ -613,6 +613,20 @@ class Cluster:
             if self._pending_eligible(p)
         ]
 
+    def admission_serial(self, uid: str) -> int:
+        """The pod's position in admission order — the reproducible
+        partition key of the K-lane engine's "hash" mode
+        (`parallel.lanes.lane_key`). With the pending index enabled this
+        is the maintained `_pod_order` serial (survives removes of other
+        pods); without it, the dict-iteration position (the same order
+        the index would have assigned). -1 for an unknown uid."""
+        if self._pod_order:
+            return self._pod_order.get(uid, -1)
+        for i, known in enumerate(self.pods):
+            if known == uid:
+                return i
+        return -1
+
     def gated_pods(self) -> list[Pod]:
         return [
             p

@@ -347,6 +347,19 @@ TUNER_ACTIVE_WEIGHTS = "scheduler_tuner_active_weights_digest"
 #: gauge: tuner controller state (0 idle, 1 probation, 2 cooldown,
 #: 3 disabled)
 TUNER_STATE = "scheduler_tuner_state"
+#: conflict-fence rejections per lane (parallel.lanes.LaneSolver): pod p
+#: of lane j failed the speculative-vs-committed step-signature check —
+#: the whole remaining suffix re-resolves against committed state
+LANE_CONFLICTS = "scheduler_lane_conflicts_total"
+#: wall-clock ms of the host conflict fence per laned cycle (serial-order
+#: validation walk + wait recomputation + any suffix repair dispatch)
+LANE_COMMIT = "scheduler_lane_commit_ms"
+#: pods re-resolved against committed state by the suffix repair solve
+LANE_RERESOLVES = "scheduler_lane_reresolves_total"
+#: laned cycles that fell back to the sequential parity solve because the
+#: fence-exact gate rejected the profile/snapshot (side tables armed,
+#: preemption nominees present, or an admit plugin without a host twin)
+LANE_SERIAL_FALLBACKS = "scheduler_lane_serial_fallbacks_total"
 
 
 # ---------------------------------------------------------------------------

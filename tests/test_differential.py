@@ -727,6 +727,230 @@ class TestPipelinedCycleEquivalence:
         assert pipe_final == serial_final
 
 
+class TestLanedCycleEquivalence:
+    """The K-lane optimistic-concurrency differential (ISSUE 17,
+    docs/SCALING.md): `LanedCycle` at K ∈ {1, 2, 4} vs the serial
+    `run_cycle` on ONE shared seeded event stream must produce identical
+    per-cycle placements (bound/reserved/failed/attribution, plus
+    gang rejections and preemptions on the quota roster) AND an
+    identical final cluster state — the conflict fence's bit-identity
+    contract, exercised through both a plain multi-tenant serve roster
+    (disjoint namespaces across lanes) and the gang+quota roster (gangs
+    keyed whole to one lane, cross-lane quota contention re-resolved).
+    Rosters reuse the pipelined twin's exact streams and
+    tests/test_serving's compile buckets; the serial baseline runs once
+    per roster (class-level cache) so the K sweep pays one extra engine
+    run per K, not two."""
+
+    _baseline: dict = {}
+
+    def _run_plain(self, k):
+        """The pipelined twin's plain roster, multi-tenant: pods spread
+        over three namespaces so the default partition actually fans
+        out. k=0 = serial run_cycle baseline."""
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.framework.laned_cycle import LanedCycle
+        from scheduler_plugins_tpu.serving import (
+            ServeEngine,
+            StreamingServeEngine,
+        )
+        from tests.test_serving import make_cluster, make_node, make_scheduler
+
+        rng = np.random.default_rng(23)
+        cluster = make_cluster(6)
+        engine = (
+            StreamingServeEngine() if k else ServeEngine()
+        ).attach(cluster)
+        sched = make_scheduler()
+        laned = LanedCycle(sched, cluster, k=k) if k else None
+        serial = 0
+        reports = []
+        for cycle in range(10):
+            now = 1000 * (cycle + 1)
+            for _ in range(int(rng.integers(1, 4))):
+                serial += 1
+                cluster.add_pod(Pod(
+                    name=f"p{serial:05d}", namespace=f"ns{serial % 3}",
+                    creation_ms=now + serial,
+                    containers=[Container(requests={
+                        CPU: int(rng.integers(200, 2500)), MEMORY: gib,
+                    })],
+                ))
+            if cycle == 3:
+                cluster.add_node(make_node(40))
+            if cycle == 4:
+                cluster.add_pod(Pod(
+                    name="nofit", creation_ms=now + 999,
+                    containers=[Container(requests={CPU: 10**9})],
+                ))
+            if cycle == 5:
+                bound = sorted(
+                    u for u, p in cluster.pods.items()
+                    if p.node_name is not None
+                )
+                cluster.remove_pod(bound[0])
+            if cycle == 7:
+                victim = next(iter(cluster.nodes))
+                for uid in [
+                    u for u, p in cluster.pods.items()
+                    if p.node_name == victim
+                ]:
+                    cluster.remove_pod(uid)
+                cluster.remove_node(victim)
+            if laned is not None:
+                report = laned.tick(now)
+            else:
+                report = run_cycle(sched, cluster, now=now, serve=engine)
+            reports.append(report)
+        if laned is not None:
+            laned.close()
+            # the fence-exact gate must have held: a silent serial
+            # fallback would make this differential vacuous
+            assert laned.serial_fallbacks == 0
+        per_cycle = [
+            (
+                dict(r.bound), dict(r.reserved),
+                list(r.failed), dict(r.failed_by),
+            )
+            for r in reports
+        ]
+        final = {u: p.node_name for u, p in sorted(cluster.pods.items())}
+        return per_cycle, final
+
+    def _run_gang_quota(self, k):
+        """The pipelined twin's gang+quota roster, verbatim (same seed,
+        same stream — shapes land on the same compile buckets)."""
+        from scheduler_plugins_tpu.api.objects import (
+            ElasticQuota,
+            PodGroup,
+            POD_GROUP_LABEL,
+        )
+        from scheduler_plugins_tpu.framework import run_cycle
+        from scheduler_plugins_tpu.framework.laned_cycle import LanedCycle
+        from scheduler_plugins_tpu.plugins import (
+            CapacityScheduling,
+            Coscheduling,
+        )
+        from scheduler_plugins_tpu.serving import (
+            ServeEngine,
+            StreamingServeEngine,
+        )
+
+        rng = np.random.default_rng(5)
+        cluster = Cluster()
+        for i in range(8):
+            cluster.add_node(Node(
+                name=f"n{i}",
+                allocatable={CPU: 16_000, MEMORY: 64 * gib, PODS: 30},
+            ))
+        cluster.add_quota(ElasticQuota(
+            name="eq", namespace="team",
+            min={CPU: 64_000, MEMORY: 256 * gib},
+            max={CPU: 96_000, MEMORY: 384 * gib},
+        ))
+        engine = (
+            StreamingServeEngine() if k else ServeEngine()
+        ).attach(cluster)
+        sched = Scheduler(Profile(plugins=[
+            NodeResourcesAllocatable(),
+            Coscheduling(permit_waiting_seconds=5),
+            CapacityScheduling(),
+        ]))
+        laned = LanedCycle(sched, cluster, k=k) if k else None
+        serial = 0
+        reports = []
+        for cycle in range(12):
+            now = 1000 * (cycle + 1)
+            for _ in range(int(rng.integers(0, 5))):
+                serial += 1
+                cluster.add_pod(Pod(
+                    name=f"p{serial:04d}", namespace="team",
+                    creation_ms=now + serial,
+                    priority=int(rng.integers(0, 5)),
+                    containers=[Container(requests={
+                        CPU: int(rng.integers(200, 4000)),
+                        MEMORY: int(rng.integers(1, 8)) * gib,
+                    })],
+                ))
+            if cycle % 5 == 1:
+                gname = f"g{cycle}"
+                cluster.add_pod_group(PodGroup(
+                    name=gname, namespace="team", min_member=3,
+                    creation_ms=now,
+                ))
+                for m in range(3):
+                    serial += 1
+                    cluster.add_pod(Pod(
+                        name=f"{gname}-m{m}", namespace="team",
+                        creation_ms=now + m,
+                        labels={POD_GROUP_LABEL: gname},
+                        containers=[Container(
+                            requests={CPU: 2000, MEMORY: 4 * gib}
+                        )],
+                    ))
+            bound = [
+                p for p in cluster.pods.values()
+                if p.node_name is not None and not p.pod_group()
+            ]
+            for pod in bound:
+                if rng.random() < 0.15:
+                    cluster.remove_pod(pod.uid)
+            if laned is not None:
+                report = laned.tick(now)
+            else:
+                report = run_cycle(sched, cluster, now=now, serve=engine)
+            reports.append(report)
+        if laned is not None:
+            laned.close()
+            assert laned.serial_fallbacks == 0
+        per_cycle = [
+            (
+                dict(r.bound), dict(r.reserved),
+                list(r.failed), dict(r.failed_by),
+                list(r.rejected_gangs), dict(r.preempted),
+            )
+            for r in reports
+        ]
+        final = {u: p.node_name for u, p in sorted(cluster.pods.items())}
+        return per_cycle, final
+
+    def _serial_baseline(self, roster):
+        if roster not in self._baseline:
+            runner = getattr(self, f"_run_{roster}")
+            type(self)._baseline[roster] = runner(0)
+        return self._baseline[roster]
+
+    @pytest.mark.parametrize("k", [2])
+    def test_plain_roster_identical(self, k):
+        serial_cycles, serial_final = self._serial_baseline("plain")
+        laned_cycles, laned_final = self._run_plain(k)
+        assert laned_cycles == serial_cycles
+        assert laned_final == serial_final
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_plain_roster_identical_slow(self, k):
+        serial_cycles, serial_final = self._serial_baseline("plain")
+        laned_cycles, laned_final = self._run_plain(k)
+        assert laned_cycles == serial_cycles
+        assert laned_final == serial_final
+
+    @pytest.mark.parametrize("k", [4])
+    def test_gang_quota_roster_identical(self, k):
+        serial_cycles, serial_final = self._serial_baseline("gang_quota")
+        laned_cycles, laned_final = self._run_gang_quota(k)
+        assert laned_cycles == serial_cycles
+        assert laned_final == serial_final
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_gang_quota_roster_identical_slow(self, k):
+        serial_cycles, serial_final = self._serial_baseline("gang_quota")
+        laned_cycles, laned_final = self._run_gang_quota(k)
+        assert laned_cycles == serial_cycles
+        assert laned_final == serial_final
+
+
 class TestShardedWaveHardConstraintParity:
     """ISSUE 7 satellite: the shard_map ring-election wave solver vs the
     sequential parity path — hard constraints (resource fit, queue-order

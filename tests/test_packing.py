@@ -310,6 +310,7 @@ class TestBenchLineSchema:
 
     def test_error_line_schema_complete_for_every_config(self):
         assert 13 in bench.CONFIG_METRICS
+        assert 15 in bench.CONFIG_METRICS  # the K-lane config (ISSUE 17)
         for config in bench.CONFIG_METRICS:
             line = bench.error_line(config, "sequential", self.DIAGNOSIS)
             missing = [k for k in bench.LINE_SCHEMA_KEYS if k not in line]
