@@ -178,7 +178,7 @@ lane-smoke:
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check kernel-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke
 
 .PHONY: lint
 lint:
@@ -196,6 +196,20 @@ jaxpr-audit:
 .PHONY: jaxpr-audit-check
 jaxpr-audit-check:
 	$(PY) tools/jaxpr_audit.py --check
+
+# kernel-resource & exactness audit over the same registry: KA001 VMEM
+# envelopes (the derived PALLAS_MAX_ELECTION_ELEMS gate), KA002 DMA
+# start/wait discipline, KA003 the 2^53 exactness lattice; refreshes
+# docs/kernel_audit.json only on a fully clean run
+.PHONY: kernel-audit
+kernel-audit:
+	$(PY) tools/kernel_audit.py
+
+# read-only CI gate: zero violations + manifest coverage + envelope/gate
+# agreement (fail-closed when the manifest is missing)
+.PHONY: kernel-audit-check
+kernel-audit-check:
+	$(PY) tools/kernel_audit.py --check
 
 # whole-program concurrency audit: discover thread entry points, walk
 # reachable locksets, run CA001-CA005, refresh docs/race_audit.json

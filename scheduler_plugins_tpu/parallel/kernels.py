@@ -55,12 +55,15 @@ nothing else. The twin is the differential-gate path: placements under
 `SPT_PALLAS=1` must be bit-identical to the lax formulation
 (tests/test_differential.py, `make pallas-smoke`).
 
-VMEM envelope: one election program holds ~5 copies of its (H, L) int32
-buffer (input, 3 comm slots, accumulator/output) in VMEM. Call sites
-whose padded payload exceeds `PALLAS_MAX_ELECTION_ELEMS` int32 elements
-(the mega config's whole-queue first wave) statically keep the lax
-collectives — bit-parity holds either way, and the tiled large-window
-variant is on-chip follow-up work (docs/SCALING.md).
+VMEM envelope: one election program holds `1 + n_out + 3` same-shape
+copies of its (H, L) int32 buffer (input, outputs, 3 comm slots) in VMEM
+— worst family ring_offsets at 6 copies. The static envelope model lives
+in `parallel.vmem` (shared with `tools/kernel_audit.py` KA001, which
+re-derives it from the traced bodies); `PALLAS_MAX_ELECTION_ELEMS` is
+derived there, no longer hand-picked. Call sites whose padded payload
+exceeds it (the mega config's whole-queue first wave) statically keep
+the lax collectives — bit-parity holds either way, and the tiled
+large-window variant is on-chip follow-up work (docs/SCALING.md).
 
 TPU gotchas honored (CLAUDE.md + /opt/skills/guides/pallas_guide.md): no
 f64/i64 inside kernel bodies (limbs), buffers padded to (8, 128) int32
@@ -72,11 +75,14 @@ static), and kernel bodies never read the clock or call back to the host
 from __future__ import annotations
 
 import os
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from scheduler_plugins_tpu.parallel import vmem
 
 __all__ = [
     "pallas_enabled",
@@ -104,14 +110,17 @@ _LIMB_MASK = (1 << LIMB_BITS) - 1
 _SUBLANES = 8
 _LANES = 128
 
-#: ceiling on one election program's padded (H, L) int32 payload. ~5
-#: buffer copies live in VMEM at once (input, 3 comm slots, accumulator),
-#: so 2^19 elements = 2 MiB/buffer = ~10 MiB peak, inside the 16 MiB/core
-#: budget. Oversize call sites (the mega whole-queue wave) statically fall
-#: back to the lax collectives — same math, same placements.
-PALLAS_MAX_ELECTION_ELEMS = int(
-    os.environ.get("SPT_PALLAS_MAX_ELECTION_ELEMS", 1 << 19)
-)
+#: ceiling on one election program's padded (H, L) int32 payload, DERIVED
+#: from the static VMEM envelope model (`parallel.vmem`): the worst ring
+#: family (ring_offsets: input + 2 outputs + 3 comm slots = 6 same-shape
+#: buffers) must fit the per-core budget, so the gate is the largest
+#: power of two with 6 * 4 B * E <= 16 MiB — 2^19. tools/kernel_audit.py
+#: (KA001) re-derives the same number from the traced kernel bodies and
+#: fails closed on drift. Oversize call sites (the mega whole-queue wave)
+#: statically fall back to the lax collectives — same math, same
+#: placements. SPT_PALLAS_MAX_ELECTION_ELEMS still overrides, inside
+#: vmem.max_election_elems().
+PALLAS_MAX_ELECTION_ELEMS = vmem.max_election_elems()
 
 #: distinct collective_id per kernel family (kernels with custom barriers
 #: must not share matching ids with unrelated collectives in the program)
@@ -161,11 +170,17 @@ def split_limbs(x):
     )
 
 
+@partial(jax.jit, static_argnames=("dtype",))
 def join_limbs(limbs, dtype=jnp.float64):
     """Recombine `split_limbs` rows (possibly SUMMED across shards — each
     limb then holds up to shards * 2^18, still exact in f64) back into one
     tensor. float64 arithmetic is exact here: every limb < 2^31 and the
-    recombined value < 2^53."""
+    recombined value < 2^53. A named jit boundary ON PURPOSE (XLA inlines
+    it — no runtime cost): the exactness argument is structural (the
+    recombined value IS the original < 2^53 quantity sum), so
+    `tools/kernel_audit.py` KA003 blesses the pjit call by name via
+    `api.bounds.EXACT_FN_BOUNDS` — the naive interval on `limb2 * 2^36`
+    overflows the 2^53 line that the reconstructed value respects."""
     acc = limbs[0].astype(jnp.float64)
     for i in range(1, N_LIMBS):
         acc = acc + limbs[i].astype(jnp.float64) * float(1 << (LIMB_BITS * i))
@@ -256,7 +271,8 @@ def _ring_kernel_body(x_ref, out_refs, comm, send_sem, recv_sem, *,
 
 def _ring_call(x2d, axis_name: str, n_shards: int, interpret: bool,
                n_out: int, collective_id: int, init_fn, combine_fn,
-               finish_fn, pad_fill: int = 0, padded=None):
+               finish_fn, pad_fill: int = 0, padded=None,
+               family: str = "ring"):
     """`pl.pallas_call` plumbing shared by ALL the kernels: pads the
     (H, L) int32 buffer to the tile floor (`pad_fill` — 0 for sum/prefix
     rows, INT32_MAX for min keys; `padded` lets a caller supply a buffer
@@ -296,6 +312,10 @@ def _ring_call(x2d, axis_name: str, n_shards: int, interpret: bool,
             collective_id=collective_id
         ),
         interpret=interpret,
+        # the family name rides the traced pallas_call so the kernel
+        # auditor's per-family envelope cross-check (vmem.RING_FAMILIES)
+        # can key traced bodies back to the budget table
+        name=family,
     )(padded)
     return tuple(o[:H, :L] for o in out)
 
@@ -330,7 +350,7 @@ def _offsets_rows(rows, axis_name, n_shards, interpret):
 
     return _ring_call(
         rows, axis_name, n_shards, interpret, 2, _CID_OFFSETS,
-        init, combine, finish,
+        init, combine, finish, family="ring_offsets",
     )
 
 
@@ -383,7 +403,7 @@ def elect_min(rows, axis_name: str, n_shards: int, *, interpret: bool):
     (out,) = _ring_call(
         rows.astype(jnp.int32), axis_name, n_shards, interpret, 1,
         _CID_ELECT_MIN, init, combine, finish,
-        pad_fill=jnp.iinfo(jnp.int32).max,
+        pad_fill=jnp.iinfo(jnp.int32).max, family="elect_min",
     )
     return out
 
@@ -430,6 +450,6 @@ def fused_election(keys, payload_rows, axis_name: str, n_shards: int, *,
     padded = _pad2(buf, 0).at[0, L:].set(jnp.iinfo(jnp.int32).max)
     (out,) = _ring_call(
         buf, axis_name, n_shards, interpret, 1, _CID_FUSED,
-        init, combine, finish, padded=padded,
+        init, combine, finish, padded=padded, family="fused_election",
     )
     return out[0], out[1:H]

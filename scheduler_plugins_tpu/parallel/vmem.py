@@ -1,0 +1,122 @@
+"""Static VMEM envelope model for the Pallas ring kernels (ISSUE 18).
+
+ONE copy of the on-chip budget arithmetic, read by BOTH consumers:
+
+- `parallel.kernels` derives its `PALLAS_MAX_ELECTION_ELEMS` solver gate
+  (the static fall-back-to-lax threshold for oversize election payloads)
+  from `derive_max_election_elems()` — the constant is no longer
+  hand-picked;
+- `tools/kernel_audit.py` (KA001) re-computes every traced kernel body's
+  worst-case VMEM footprint against the same budget table and re-derives
+  the threshold, failing closed if either side drifts.
+
+The model is deliberately simple and conservative — it must UPPER-bound
+what Mosaic resident-allocates, not estimate it:
+
+- every kernel-body VMEM ref (block-mapped inputs/outputs + VMEM scratch)
+  is resident for the whole body: bytes = prod(block_shape) * itemsize;
+- with a nontrivial grid, Mosaic double-buffers each block-mapped operand
+  to overlap the HBM copy of step k+1 with step k's compute — 2 copies
+  per grid-streamed ref (scratch is never pipelined: 1 copy). The ring
+  kernels are gridless today; the factor exists so ROADMAP item 3's
+  grid-tiled mega election is checked against the budget it will actually
+  occupy;
+- semaphores live in semaphore memory, not VMEM: counted separately,
+  never charged against the VMEM budget.
+
+Derivation of the election threshold: every `parallel.kernels` ring
+program holds `1 (input) + n_out (outputs) + COMM_SLOTS (comm scratch)`
+same-shape int32 buffers in VMEM at once (`kernels._ring_call` — the one
+shared pallas_call plumbing). The worst family is `ring_offsets` with
+n_out = 2 → 6 buffer copies. The threshold is the largest power of two
+E with E * worst_copies * 4 bytes <= the target budget; powers of two
+keep the padded-buffer compile bucketing stable. At the 16 MiB/core
+target this derives 2^19 — equal to the constant PR 13 hand-picked, so
+the derivation changed the PROVENANCE of the number, not its value
+(docs/kernel_audit.json records both).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "VMEM_BUDGET_BYTES",
+    "VMEM_TARGET",
+    "COMM_SLOTS",
+    "RING_FAMILIES",
+    "WORST_RING_COPIES",
+    "ring_buffer_copies",
+    "derive_max_election_elems",
+    "max_election_elems",
+]
+
+#: per-core VMEM budget, bytes, by lowering target. ~16 MiB/core on every
+#: shipping TPU generation the repo targets (pallas guide §memory-spaces);
+#: a per-generation row exists so a smaller-VMEM target can be audited
+#: without touching the model.
+VMEM_BUDGET_BYTES = {
+    "tpu_v4": 16 * 1024 * 1024,
+    "tpu_v5e": 16 * 1024 * 1024,
+    "tpu_v5p": 16 * 1024 * 1024,
+}
+
+#: the audited lowering target (SPT_VMEM_TARGET to re-derive for another
+#: generation — the committed manifest pins the target it was written for)
+VMEM_TARGET = os.environ.get("SPT_VMEM_TARGET", "tpu_v4")
+
+#: 3-slot ring communication buffer (kernels._ring_call scratch): slot k%3
+#: receives while slot (k-1)%3 sends and the step k-1 buffer is folded
+COMM_SLOTS = 3
+
+#: ring kernel families -> output-buffer count (kernels._ring_call n_out).
+#: Every family holds 1 input + n_out outputs + COMM_SLOTS comm slots of
+#: ONE padded (H, L) int32 buffer in VMEM; DMA semaphores ride semaphore
+#: memory. New ring kernels must add a row — tools/kernel_audit.py KA001
+#: cross-checks the table against the traced bodies.
+RING_FAMILIES = {
+    "ring_offsets": 2,   # (exclusive_prefix, total)
+    "elect_min": 1,
+    "fused_election": 1,
+}
+
+#: worst-case same-shape VMEM buffer copies of any ring family
+WORST_RING_COPIES = 1 + max(RING_FAMILIES.values()) + COMM_SLOTS
+
+_INT32_BYTES = 4
+
+
+def ring_buffer_copies(n_out: int) -> int:
+    """Simultaneous whole-payload VMEM buffers of one ring program."""
+    return 1 + n_out + COMM_SLOTS
+
+
+def derive_max_election_elems(
+    target: str | None = None, copies: int = WORST_RING_COPIES
+) -> int:
+    """Largest power-of-two padded int32 element count E whose worst-case
+    ring footprint (`copies` same-shape buffers) fits the target VMEM
+    budget. Power of two: the (8, 128)-tiled padded buffers bucket
+    compile shapes, and a non-power threshold would re-bucket every call
+    site on a budget-table tweak."""
+    budget = VMEM_BUDGET_BYTES[target or VMEM_TARGET]
+    cap = budget // (copies * _INT32_BYTES)
+    if cap < 1:
+        raise ValueError(
+            f"VMEM budget {budget} cannot hold {copies} int32 buffers"
+        )
+    elems = 1
+    while elems * 2 <= cap:
+        elems *= 2
+    return elems
+
+
+def max_election_elems() -> int:
+    """The solver-gate threshold: derived from the envelope model, with
+    the SPT_PALLAS_MAX_ELECTION_ELEMS escape hatch for experiments (the
+    kernel auditor refuses to write a manifest under an override — the
+    committed number is always the derived one)."""
+    override = os.environ.get("SPT_PALLAS_MAX_ELECTION_ELEMS")
+    if override is not None:
+        return int(override)
+    return derive_max_election_elems()

@@ -288,7 +288,8 @@ def cycle_quality_np(snap, assignment, admitted, wait) -> dict:
     largest = core.max(axis=0, initial=0.0)
     frag = np.where(total > 0, 1.0 - largest / np.maximum(total, 1.0), 0.0)
 
-    allocf = alloc.astype(np.float64)[:, (CPU_I, MEM_I)]
+    # per-element cast of < 2^38 quantities (host-side metric, exact)
+    allocf = alloc.astype(np.float64)[:, (CPU_I, MEM_I)]  # graft-lint: ignore[GL013]
     usedf = allocf - free.astype(np.float64)[:, (CPU_I, MEM_I)]
     util = np.where(allocf > 0, usedf / np.maximum(allocf, 1.0), 0.0)
     node_util = util.mean(axis=1)
@@ -300,7 +301,7 @@ def cycle_quality_np(snap, assignment, admitted, wait) -> dict:
 
     # packed_utilization numpy twin (same float64 arithmetic as the jax
     # core's `packed_utilization`)
-    allocf2 = alloc.astype(np.float64)
+    allocf2 = alloc.astype(np.float64)  # graft-lint: ignore[GL013] per-element, < 2^38
     freef2 = free.astype(np.float64)
     occ = node_mask & (allocf2[:, PODS_I] - freef2[:, PODS_I] > 0)
     num = np.where(occ[:, None], freef2, 0.0)[:, (CPU_I, MEM_I)].sum(axis=0)

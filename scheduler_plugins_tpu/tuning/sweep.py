@@ -66,7 +66,7 @@ def candidate_weights(base, k: int, seed: int = 0) -> np.ndarray:
             down[i] = max(1, int(down[i]) // m)
             add(down)
     rng = np.random.default_rng(seed)
-    alpha = base.astype(np.float64) / base.sum() * CONCENTRATION
+    alpha = base.astype(np.float64) / base.sum() * CONCENTRATION  # graft-lint: ignore[GL013] weights <= 2^20
     guard = 0
     while len(rows) < k and guard < 64 * k:
         budget = L * WEIGHT_BUDGETS[guard % len(WEIGHT_BUDGETS)]

@@ -12,6 +12,7 @@ Bit-identical placement requires matching Go's arithmetic conventions exactly
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -143,3 +144,18 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     if n <= size:
         return size
     return ((n + 1023) // 1024) * 1024
+
+
+@jax.jit
+def exact_f64(x):
+    """The blessed int64 -> float64 exact cast (ISSUE 18).
+
+    Callers assert the values are quantity-scale integers (< 2^53 — the
+    repo-wide aggregation bound, `api.bounds.QUANTITY_SUM_MAX`), so the
+    cast is value-preserving. A named jit boundary ON PURPOSE (XLA
+    inlines it — no runtime cost): `tools/kernel_audit.py` KA003 blesses
+    the pjit call by name via `api.bounds.EXACT_FN_BOUNDS`, and
+    `tools/graft_lint.py` GL013 requires NEW float64 casts of int64
+    quantity tensors outside the audited modules to route through here
+    rather than a raw `.astype(jnp.float64)`."""
+    return jnp.asarray(x).astype(jnp.float64)

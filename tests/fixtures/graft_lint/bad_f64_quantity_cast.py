@@ -1,0 +1,20 @@
+"""Golden-bad fixture for GL013: float64 casts of int64 quantity tensors
+outside the audited exactness owners.
+
+float64 is exact only below 2^53; an aggregated quantity (prefix sum,
+cluster total) can exceed it. Casts inside `exact-cast-owners` modules
+are walked by tools/kernel_audit.py's jaxpr lattice every run — a cast
+HERE is unproven and must use utils.intmath.exact_f64 (asserted-bound)
+or parallel.kernels.join_limbs instead.
+"""
+
+import jax.numpy as jnp
+
+
+def demand_fractions(req, free):
+    req = jnp.asarray(req, dtype=jnp.int64)
+    free = jnp.asarray(free, dtype=jnp.int64)
+    total = jnp.sum(req, axis=0)
+    demand = total.astype(jnp.float64)        # BAD: GL013 (astype form)
+    freef = jnp.asarray(free, dtype=jnp.float64)  # BAD: GL013 (ctor form)
+    return demand / jnp.maximum(freef, 1.0)

@@ -1,0 +1,38 @@
+"""Golden-bad KA002: an async copy started and never waited on.
+
+The kernel arms the DMA semaphore and returns with the copy still in
+flight — on real hardware the scratch buffer may be torn down (or the
+next launch may re-arm the semaphore) while the engine is still writing.
+The protocol simulation must report the body ends with a non-empty
+in-flight set.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def build():
+    x = jnp.zeros((8, 128), jnp.int32)
+
+    def kernel(x_ref, o_ref, comm, sem):
+        copy = pltpu.make_async_copy(x_ref, comm, sem.at[0])
+        copy.start()
+        o_ref[...] = x_ref[...] + 1  # forgets copy.wait()
+
+    def leaky(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=True,
+            name="bad_dma_missing_wait",
+        )(x)
+
+    return leaky, (x,), None

@@ -1,0 +1,43 @@
+"""Golden-bad KA002: one semaphore slot armed for a second copy while the
+first is still in flight.
+
+Two async copies share `sem[0]`; the second start re-arms the slot before
+the first copy's wait, so the completion signals alias — a wait can
+return when EITHER copy lands, and the reader may consume a buffer the
+engine is still writing. The protocol simulation must flag the re-arm.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def build():
+    x = jnp.zeros((8, 128), jnp.int32)
+
+    def kernel(x_ref, o_ref, c0, c1, sem):
+        a = pltpu.make_async_copy(x_ref, c0, sem.at[0])
+        a.start()
+        b = pltpu.make_async_copy(x_ref, c1, sem.at[0])  # same slot, in flight
+        b.start()
+        a.wait()
+        b.wait()
+        o_ref[...] = c0[...] + c1[...]
+
+    def aliased(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.int32),
+                pltpu.VMEM((8, 128), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=True,
+            name="bad_dma_sem_reuse",
+        )(x)
+
+    return aliased, (x,), None

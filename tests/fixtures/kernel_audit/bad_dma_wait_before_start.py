@@ -1,0 +1,37 @@
+"""Golden-bad KA002: a dma_wait with no matching in-flight start.
+
+Waiting on a semaphore nobody armed deadlocks the core on real hardware
+(the interpret-mode CPU twin happily no-ops it, which is exactly why a
+static check is needed). The protocol simulation must flag the wait as
+unmatched.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def build():
+    x = jnp.zeros((8, 128), jnp.int32)
+
+    def kernel(x_ref, o_ref, comm, sem):
+        # wait for a copy that was never started
+        pltpu.make_async_copy(x_ref, comm, sem.at[0]).wait()
+        o_ref[...] = comm[...]
+
+    def stuck(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=True,
+            name="bad_dma_wait_before_start",
+        )(x)
+
+    return stuck, (x,), None

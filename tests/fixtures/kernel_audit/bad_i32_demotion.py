@@ -1,0 +1,21 @@
+"""Golden-bad KA003: an int32 demotion of a resource quantity the lattice
+cannot prove < 2^31.
+
+`state.free` elements are declared < 2^38 (a 256 GiB memory row in
+reference bytes is ~2^38) — truncating them to int32 silently wraps on
+any node with more than 2 GiB of a byte-denominated resource. The
+sanctioned route is ops.allocatable.demote_scores_int32 (blessed by name
+in api.bounds.EXACT_FN_BOUNDS: its dynamic shift enforces the range
+structurally).
+"""
+
+import jax.numpy as jnp
+
+
+def build():
+    free = jnp.ones((8, 4), jnp.int64)
+
+    def demote(free):
+        return free.astype(jnp.int32)
+
+    return demote, (free,), ("state.free",)

@@ -1,0 +1,25 @@
+"""Golden-bad KA003: a float64 accumulation of exact integer quantities
+the interval lattice cannot prove < 2^53.
+
+The weighted-demand dot multiplies per-element requests (declared < 2^38)
+by weight scalars (< 2^20) and sums over the resource axis — the naive
+interval is 2^38 * 2^20 * R, past the float64 exact-integer line, and no
+aggregation invariant covers a weighted product. The AST linter's GL013
+stays silent on purpose: `req` is a bare parameter whose dtype the
+conservative source lattice reports UNKNOWN — only the traced-jaxpr
+lattice, seeded from the declared api.bounds rows, can judge it.
+"""
+
+import jax.numpy as jnp
+
+
+def build():
+    req = jnp.ones((16, 4), jnp.int64)
+    w = jnp.ones((4,), jnp.int64)
+
+    def weighted_demand(req, w):
+        reqf = req.astype(jnp.float64)  # fine alone: one element < 2^38
+        wf = w.astype(jnp.float64)
+        return reqf @ wf  # f64 dot of quantities: 2^38 * 2^20 * 4 >= 2^53
+
+    return weighted_demand, (req, w), ("snap.pods.req", "aux.weights")

@@ -1,0 +1,33 @@
+"""Golden-bad KA001: a Pallas kernel whose whole-buffer VMEM footprint
+blows the per-core budget.
+
+Input and output are each a (2048, 2048) float32 block — 16 MiB apiece,
+32 MiB resident — against the 16 MiB tpu_v4 budget the envelope table
+declares. Nothing at the source level is wrong (the AST linter's GL011
+purity rule passes: no host calls, no clock); only the static envelope
+accounting over the traced kernel body can see the footprint.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def build():
+    x = jnp.zeros((2048, 2048), jnp.float32)
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def fat_copy(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((2048, 2048), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=True,
+            name="bad_vmem_envelope",
+        )(x)
+
+    return fat_copy, (x,), None

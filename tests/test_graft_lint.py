@@ -32,10 +32,20 @@ class TestGoldenBad:
             ("bad_swallow.py", "GL010"),
             ("bad_pallas_kernel.py", "GL011"),
             ("bad_anonymous_thread.py", "GL012"),
+            ("bad_f64_quantity_cast.py", "GL013"),
         ],
     )
     def test_flagged(self, fixture, rule):
         assert rule in rules_for(FIXTURES / fixture)
+
+    def test_f64_cast_fixture_flags_both_forms(self):
+        findings = [
+            f for f in lint_paths([FIXTURES / "bad_f64_quantity_cast.py"])
+            if f.rule == "GL013"
+        ]
+        # the .astype(jnp.float64) form AND the dtype=float64 ctor form
+        assert len(findings) == 2
+        assert rules_for(FIXTURES / "bad_f64_quantity_cast.py") == {"GL013"}
 
     def test_swallow_fixture_flags_only_broad_swallows(self):
         findings = [
@@ -140,6 +150,19 @@ class TestConfig:
 
         findings, _, _ = lint_file(conftest)  # direct call: NOT owned
         assert "GL007" in {f.rule for f in findings}
+
+    def test_exact_cast_owners_sanction_gl013(self):
+        # parallel/solver.py's float64 matmul trick casts int64 quantity
+        # masks/requests — inside the kernel auditor's traced scope, so the
+        # pyproject exact-cast-owners list stands GL013 down on the sweep;
+        # a direct un-owned lint of the same file fires
+        solver = REPO / "scheduler_plugins_tpu" / "parallel" / "solver.py"
+        sweep = lint_paths([str(REPO / "scheduler_plugins_tpu")])
+        assert "GL013" not in {f.rule for f in sweep}
+        from tools.graft_lint import lint_file
+
+        findings, _, _ = lint_file(solver)  # direct call: NOT owned
+        assert "GL013" in {f.rule for f in findings}
 
     def test_load_config_parses_lists(self):
         from tools.graft_lint import load_config

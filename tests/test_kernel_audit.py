@@ -1,0 +1,199 @@
+"""Kernel-auditor gate tests (tools/kernel_audit.py): every KA rule must
+fire on its golden known-bad fixture — and stay invisible to the other
+two static prongs (AST lint, jaxpr audit), the division-of-labor claim —
+the cheap shipped programs must audit clean, the committed manifest must
+cover the full registry with zero violations, and the VMEM envelope
+section must agree with the live `parallel.vmem` model and the solver
+gate actually in force.
+
+Only cheap programs trace here ("entry", "bench_cfg0_tpu_smoke", the
+8-shard pallas rings); the full registry — north-star shapes, 5000-node
+scenarios — runs under `make kernel-audit` (its own CI job).
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+import scheduler_plugins_tpu  # noqa: F401  (enables x64: quantities are int64)
+
+from tools.kernel_audit import (
+    MANIFEST,
+    PROGRAMS,
+    RULES,
+    audit_fn,
+    audit_program,
+    envelope_summary,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "kernel_audit"
+
+ALL_FIXTURES = [
+    "bad_vmem_envelope",
+    "bad_dma_missing_wait",
+    "bad_dma_wait_before_start",
+    "bad_dma_sem_reuse",
+    "bad_unbounded_f64_sum",
+    "bad_i32_demotion",
+]
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"kernel_audit_fixture_{name}", FIXTURES / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _audit(name):
+    fn, args, roles = _load(name).build()
+    return audit_fn(fn, args, roles=roles)
+
+
+class TestGoldenBad:
+    """Each KA rule fires on its known-bad program — ONLY that rule, with
+    the expected diagnostic."""
+
+    @pytest.mark.parametrize(
+        "fixture, rule, needle",
+        [
+            ("bad_vmem_envelope", "KA001", "exceeds the tpu_v4 budget"),
+            ("bad_dma_missing_wait", "KA002", "never waited on"),
+            ("bad_dma_wait_before_start", "KA002", "wait-before-start"),
+            ("bad_dma_sem_reuse", "KA002", "re-armed while its copy"),
+            ("bad_unbounded_f64_sum", "KA003", "not provably < 2^53"),
+            ("bad_i32_demotion", "KA003", "not provably < 2^31"),
+        ],
+    )
+    def test_rule_fires(self, fixture, rule, needle):
+        res = _audit(fixture)
+        assert res["rules"][rule] >= 1, res["violations"]
+        others = {r: c for r, c in res["rules"].items() if r != rule and c}
+        assert not others, res["violations"]
+        details = [v["detail"] for v in res["violations"]]
+        assert any(needle in d for d in details), details
+
+    def test_vmem_fixture_records_the_envelope(self):
+        res = _audit("bad_vmem_envelope")
+        (kern,) = res["kernels"]
+        assert kern["name"] == "bad_vmem_envelope"
+        # (2048, 2048) f32 input + output, single grid step: 2 x 16 MiB
+        assert kern["vmem_bytes"] == 2 * 2048 * 2048 * 4
+        assert kern["payload_copies"] == 2
+
+    def test_dma_census_counts_both_sides(self):
+        res = _audit("bad_dma_sem_reuse")
+        census = res["dma_census"]
+        assert census["bad_dma_sem_reuse.dma_start"] == 2
+        assert census["bad_dma_sem_reuse.dma_wait"] == 2
+
+    def test_demotion_diagnostic_names_provenance_and_site(self):
+        res = _audit("bad_i32_demotion")
+        (v,) = res["violations"]
+        assert "state.free" in v["detail"]  # provenance chain
+        assert "bad_i32_demotion.py" in v["detail"]  # source site
+
+
+class TestDivisionOfLabor:
+    """Decision table: every kernel-audit fixture is INVISIBLE to the
+    source-AST linter, and the numeric fixtures are invisible to the
+    jaxpr auditor's rule set — each prong owns its bug class."""
+
+    @pytest.mark.parametrize("fixture", ALL_FIXTURES)
+    def test_invisible_to_ast_lint(self, fixture):
+        from tools.graft_lint import lint_file
+
+        findings, _, _ = lint_file(FIXTURES / f"{fixture}.py")
+        assert findings == [], [str(f) for f in findings]
+
+    @pytest.mark.parametrize(
+        "fixture", ["bad_unbounded_f64_sum", "bad_i32_demotion"]
+    )
+    def test_invisible_to_jaxpr_audit(self, fixture):
+        from tools import jaxpr_audit
+
+        fn, args, roles = _load(fixture).build()
+        res = jaxpr_audit.audit_fn(fn, args, roles=roles)
+        assert res["rules"] == {r: 0 for r in jaxpr_audit.RULES}, (
+            res["violations"]
+        )
+
+
+class TestCleanPrograms:
+    @pytest.mark.parametrize("name", ["entry", "bench_cfg0_tpu_smoke"])
+    def test_program_audits_clean(self, name):
+        res = audit_program(name)
+        assert res["rules"] == {r: 0 for r in RULES}, res["violations"]
+
+    def test_ring_kernel_envelope_and_dma_balance(self):
+        # the 8-shard ring: S-1 = 7 starts, each with send+recv waits,
+        # body drained; envelope inside budget with the family's declared
+        # buffer count
+        res = audit_program("pallas_ring_offsets")
+        assert res["rules"] == {r: 0 for r in RULES}, res["violations"]
+        (kern,) = res["kernels"]
+        assert kern["name"] == "ring_offsets"
+        assert kern["vmem_bytes"] <= kern["budget_bytes"]
+        assert kern["dma_starts"] == 7
+        assert kern["dma_waits"] == 14  # send + recv per step
+        from scheduler_plugins_tpu.parallel import vmem
+
+        assert kern["payload_copies"] == vmem.ring_buffer_copies(
+            vmem.RING_FAMILIES["ring_offsets"]
+        )
+
+    def test_audit_is_deterministic(self):
+        a = audit_program("entry")
+        b = audit_program("entry")
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+class TestManifest:
+    def test_manifest_covers_all_programs_clean(self):
+        assert MANIFEST.exists(), (
+            "docs/kernel_audit.json missing: run `make kernel-audit` and "
+            "commit it"
+        )
+        manifest = json.loads(MANIFEST.read_text())
+        programs = manifest["programs"]
+        missing = sorted(set(PROGRAMS) - set(programs))
+        assert not missing, f"manifest missing programs: {missing}"
+        dirty = {
+            n: p["rules"]
+            for n, p in programs.items()
+            if any(p["rules"].values())
+        }
+        assert not dirty, f"manifest records violations: {dirty}"
+
+    def test_vmem_section_matches_live_model(self):
+        # the committed envelope numbers must be the ones actually in
+        # force: the derived election gate IS the solver gate, and the
+        # budget table is the live vmem module's
+        from scheduler_plugins_tpu.parallel import kernels, vmem
+
+        manifest = json.loads(MANIFEST.read_text())
+        sect = manifest["vmem"]
+        assert sect["solver_gate"] == kernels.PALLAS_MAX_ELECTION_ELEMS
+        assert sect["derived_max_election_elems"] == sect["solver_gate"]
+        assert sect["budget_bytes"] == vmem.VMEM_BUDGET_BYTES[sect["target"]]
+        assert sect["worst_ring_copies"] == max(
+            vmem.ring_buffer_copies(f) for f in vmem.RING_FAMILIES.values()
+        )
+        live = envelope_summary()
+        assert {k: live[k] for k in sect} == sect
+
+    def test_manifest_pins_the_traced_jax(self):
+        import jax
+
+        manifest = json.loads(MANIFEST.read_text())
+        assert manifest["jax"] == jax.__version__
+
+    def test_check_fails_closed_without_manifest(self, monkeypatch, tmp_path):
+        import tools.kernel_audit as K
+
+        monkeypatch.setattr(K, "MANIFEST", tmp_path / "absent.json")
+        assert K.run(["entry"], check=True) == 1

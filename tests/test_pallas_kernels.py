@@ -53,7 +53,7 @@ class TestLimbPacking:
         limbs = pk.split_limbs(vals)
         assert limbs.dtype == jnp.int32
         back = pk.join_limbs(limbs)
-        assert (back == vals.astype(jnp.float64)).all()
+        assert (back == vals.astype(jnp.float64)).all()  # graft-lint: ignore[GL013] oracle, vals < 2^53
 
     def test_float64_exact_integers(self):
         vals = jnp.asarray([0.0, 2.0**52, 3.0 * 2**40], dtype=jnp.float64)

@@ -80,6 +80,20 @@ compile/runtime today (pure stdlib — no jax import, no tracing):
   best-effort paths (GC finalizers, shutdown cleanup, optional-dep
   probes) carry an inline ignore with their reason.
 
+- **GL013 unaudited-f64-quantity-cast** — no new `.astype(jnp.float64)`
+  (or array construction with `dtype=float64`) of a provably-int64
+  quantity tensor outside the audited exactness owners
+  (`exact-cast-owners` in the pyproject config). int64 quantities are
+  exact in float64 only below 2^53; the owner modules' casts are walked
+  and PROVEN by `tools/kernel_audit.py` KA003 (interval lattice over the
+  declared `api.bounds` families, assumptions recorded in
+  docs/kernel_audit.json), but a cast in un-traced new code silently
+  assumes the invariant with no audit trail. Route new casts through the
+  blessed helpers (`utils.intmath.exact_f64` — the sanctioned asserted-
+  bound cast — or `parallel.kernels.join_limbs`), or add the module to
+  the owner list, which is a reviewed declaration that its programs are
+  in the kernel auditor's trace scope.
+
 - **GL012 anonymous-thread** — every `threading.Thread(...)` must pass
   explicit `name=` and `daemon=`. The concurrency auditor
   (`tools/race_audit.py`) and the daemon's `/healthz` thread census key
@@ -134,7 +148,7 @@ def load_config() -> dict:
     lists)."""
     import ast as _ast
 
-    cfg = {"exclude": [], "config-update-owners": []}
+    cfg = {"exclude": [], "config-update-owners": [], "exact-cast-owners": []}
     path = REPO / "pyproject.toml"
     if not path.exists():
         return cfg
@@ -1129,6 +1143,62 @@ def check_pallas_kernel_purity(path, tree, findings):
                 ))
 
 
+def _is_float64_expr(node) -> bool:
+    """jnp.float64 / np.float64 / "float64" — float64 SPECIFICALLY (the
+    exactness contract is about the 2^53 mantissa line; float32 casts of
+    int64 are a different, visibly lossy decision)."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    return name == "float64"
+
+
+def check_exact_f64_cast(path, tree, findings):
+    """GL013: int64 -> float64 casts of quantity tensors outside the
+    audited exactness owners. Fires on `X.astype(jnp.float64)` and on
+    array constructors with an explicit float64 dtype whose operand is
+    provably int64 (the same conservative dtype lattice as GL002/GL003:
+    unknown dtypes never fire)."""
+    scopes = [tree]
+    scopes.extend(_functions(tree))
+    for fn in scopes:
+        env = build_env(fn)
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            operand = None
+            if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                    and node.args and _is_float64_expr(node.args[0]):
+                operand = f.value
+            elif isinstance(f, ast.Attribute) and f.attr in ARRAY_CTORS:
+                dtype = None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype = kw.value
+                if dtype is not None and _is_float64_expr(dtype) \
+                        and node.args:
+                    operand = node.args[0]
+            if operand is None:
+                continue
+            if infer_dtype(operand, env) != INT64:
+                continue
+            findings.append(Finding(
+                path, node, "GL013",
+                "float64 cast of an int64 quantity outside the audited "
+                "exactness owners: exact only below 2^53, and this call "
+                "site is outside tools/kernel_audit.py's proven trace "
+                "scope — use utils.intmath.exact_f64 (asserted-bound "
+                "cast) / parallel.kernels.join_limbs, or add the module "
+                "to exact-cast-owners in pyproject [tool.graft-lint] to "
+                "bring it under the audit",
+            ))
+
+
 def check_swallowed_exception(path, tree, findings):
     """GL010: a broad exception handler (bare ``except:``, ``except
     Exception``, ``except BaseException``) whose body is only
@@ -1189,11 +1259,18 @@ def _suppressed(finding, source_lines):
     return False
 
 
-def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str]:
+def lint_file(
+    path: Path,
+    config_owner: bool = False,
+    exact_cast_owner: bool = False,
+) -> tuple[list, object, str]:
     """(findings, ast tree, source) for one file — the tree/source feed the
     cross-file plugin-hierarchy pass and suppression filter in lint_paths.
     `config_owner` marks a sanctioned GL007 owner file (platform/precision
-    config allowed); direct callers default to NOT owned."""
+    config allowed); `exact_cast_owner` marks a GL013 exactness-owner file
+    (its int64 -> float64 casts are walked by the kernel auditor's jaxpr
+    lattice, so the source-level rule stands down). Direct callers default
+    to NOT owned."""
     source = path.read_text()
     tree = ast.parse(source, filename=str(path))
     findings: list[Finding] = []
@@ -1209,6 +1286,8 @@ def lint_file(path: Path, config_owner: bool = False) -> tuple[list, object, str
     check_thread_names(rel, tree, findings)
     if not config_owner:
         check_config_update(rel, tree, findings)
+    if not exact_cast_owner:
+        check_exact_f64_cast(rel, tree, findings)
     return findings, tree, source
 
 
@@ -1216,6 +1295,7 @@ def lint_paths(paths) -> list[Finding]:
     cfg = load_config()
     exclude = tuple(cfg.get("exclude", ()))
     owners = tuple(cfg.get("config-update-owners", ()))
+    cast_owners = tuple(cfg.get("exact-cast-owners", ()))
 
     def excluded(f):
         rel = _rel_to_repo(f)
@@ -1224,6 +1304,10 @@ def lint_paths(paths) -> list[Finding]:
     def owned(f):
         rel = _rel_to_repo(f)
         return rel is not None and any(rel.startswith(o) for o in owners)
+
+    def cast_owned(f):
+        rel = _rel_to_repo(f)
+        return rel is not None and any(rel.startswith(o) for o in cast_owners)
 
     files = []
     for p in paths:
@@ -1237,7 +1321,8 @@ def lint_paths(paths) -> list[Finding]:
             files.append(p)
     all_findings, trees, sources = [], [], {}
     for f in files:
-        findings, tree, source = lint_file(f, config_owner=owned(f))
+        findings, tree, source = lint_file(
+            f, config_owner=owned(f), exact_cast_owner=cast_owned(f))
         all_findings.extend(findings)
         trees.append((f, tree))
         sources[f] = source.splitlines()
