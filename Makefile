@@ -7,8 +7,15 @@ PY ?= python
 # matrix) are excluded here — the suite sits near the 870s runtime cliff —
 # and run by their dedicated smoke target instead (make pallas-smoke)
 .PHONY: test
-test:
+test: host-health
 	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# one host-health JSON line (timed matmul under timeout + loadavg) so
+# every archived suite log is self-describing about the machine it ran
+# on; the same probe() stamps tools/perf_sentry.py verdicts
+.PHONY: host-health
+host-health:
+	JAX_PLATFORMS=cpu $(PY) tools/host_health.py
 
 .PHONY: bench
 bench:
@@ -174,11 +181,29 @@ gang-smoke:
 lane-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --lane-smoke
 
+# CI pod-lifecycle ledger gate (ISSUE 19): ledger-on overhead within
+# max(2%, the off-series jitter floor) via interleaved paired deltas,
+# stage decomposition exactly summing to e2e on every retired pod, and
+# serial run_cycle vs PipelinedCycle producing event-SEQUENCE-identical
+# ledgers on the shared churn scenario
+.PHONY: ledger-smoke
+ledger-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/ledger_smoke.py
+
+# CI bench-regression sentry gate (ISSUE 19): on really-measured
+# timings, a reshuffle stays quiet (paired-sorted deltas are exactly
+# zero), an injected uniform slowdown is flagged, an unhealthy host
+# probe downgrades regression -> degraded-host, and the committed
+# degenerate BENCH history classifies as no-baseline
+.PHONY: sentry-smoke
+sentry-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/perf_sentry.py selftest
+
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check kernel-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check kernel-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke ledger-smoke sentry-smoke
 
 .PHONY: lint
 lint:

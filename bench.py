@@ -2434,6 +2434,18 @@ class _LifeArm:
         self.rz = _chaos_resilience(self._events["chaos"], self.engine, seed)
         self._old_verify = self.engine.verify_every
         self._prev_phase = None
+        # per-ARM pod-lifecycle ledger (obs.ledger), swapped in around
+        # this arm's events+tick via `podledger.use`: the interleaved
+        # arms share pod uids by construction, so a process-global ledger
+        # would interleave two engines' records — exactly the pollution
+        # the scoped-metrics discipline exists to prevent. The two arms'
+        # event SEQUENCES must come out identical (`cluster_life`'s
+        # ledger gate, the placement-identity discipline extended to the
+        # observability plane).
+        from scheduler_plugins_tpu.obs import ledger as podledger
+
+        self._podledger = podledger
+        self.ledger = podledger.Ledger().start()
 
     @property
     def done(self) -> bool:
@@ -2476,6 +2488,16 @@ class _LifeArm:
 
     def step(self):
         """Run ONE cycle (events + tick) of this arm's schedule."""
+        prev = self._podledger.use(self.ledger)
+        try:
+            self._step()
+        finally:
+            # the pipelined arm's bind flusher is quiesced inside `_step`
+            # (the fence runs in the timed window), so no hook can fire
+            # against the wrong arm's ledger after this restore
+            self._podledger.use(prev)
+
+    def _step(self):
         from scheduler_plugins_tpu.framework import run_cycle
         from scheduler_plugins_tpu.resilience import faults as F
 
@@ -2534,10 +2556,18 @@ class _LifeArm:
         self.cycle += 1
 
     def finish(self) -> dict:
-        if self.pipe is not None:
-            self.pipe.flush()
-            self.pipe.close()
+        prev = self._podledger.use(self.ledger)
+        try:
+            if self.pipe is not None:
+                self.pipe.flush()
+                self.pipe.close()
+        finally:
+            self._podledger.use(prev)
         out = {
+            "sli": self.ledger.sli_summary(),
+            "ledger_sequence": self.ledger.sequence(),
+            "ledger_decomposition_errors":
+                len(self.ledger.decomposition_errors()),
             "times": self.times,
             "decided": self.decided,
             "placements": self.placements,
@@ -2722,6 +2752,19 @@ def cluster_life(shape=None, emit=True):
         ),
         "faults_fired": int(pipe_arm["faults_fired"]),
         "decisions": int(n_decided),
+        # pod-lifecycle SLO ledger (obs.ledger): the pipelined arm's SLI
+        # block (e2e percentiles + stage decomposition), the engine-
+        # identity gate (serial and pipelined arms must record the SAME
+        # event sequence on the shared stream) and the decomposition
+        # invariant (stage sums == e2e for every retired pod)
+        "sli": pipe_arm["sli"],
+        "ledger_sequence_identical": bool(
+            pipe_arm["ledger_sequence"] == serial_arm["ledger_sequence"]
+        ),
+        "ledger_decomposition_errors": int(
+            pipe_arm["ledger_decomposition_errors"]
+            + serial_arm["ledger_decomposition_errors"]
+        ),
     }
     if emit:
         _emit(
@@ -2770,6 +2813,12 @@ def endurance_smoke(min_ratio=1.5):
         # gang-phase cycles/s now that both serve resident
         and line["gang_fallbacks"] == 0
         and line["phases"]["gangs"]["vs_serial"] >= min_ratio
+        # ISSUE 19 ledger gates: the serial and pipelined arms must
+        # record the SAME pod-lifecycle event sequence on the shared
+        # stream, and every retired pod's stage decomposition must sum
+        # to its e2e exactly
+        and line["ledger_sequence_identical"]
+        and line["ledger_decomposition_errors"] == 0
     )
     print(json.dumps({
         "metric": "endurance_smoke",
@@ -3728,14 +3777,13 @@ def tuned_drifting_mix(shape=None, emit=True, seed=0):
 
     assert TUNE_OBJECTIVES == PROBATION_OBJECTIVES
     shape = shape or TUNE_LIVE_SHAPE
-    sweep_miss0 = obs_.metrics.get(
-        obs_.JIT_CACHE_MISS, program="sweep_solve"
-    )
+    # scoped view over the process-global registry: the arm-vs-arm run
+    # reads only what IT moved, not whatever earlier benches in this
+    # process accumulated (Metrics.scoped — the snapshot/diff discipline)
+    scope = obs_.metrics.scoped()
     static = _run_drift_arm(shape, seed=seed, tuned=False)
     tuned = _run_drift_arm(shape, seed=seed, tuned=True)
-    sweep_compiles = obs_.metrics.get(
-        obs_.JIT_CACHE_MISS, program="sweep_solve"
-    ) - sweep_miss0
+    sweep_compiles = scope.get(obs_.JIT_CACHE_MISS, program="sweep_solve")
 
     drift_at, b_end = tuned["drift_at"], tuned["b_end"]
     warmup = shape["warmup"]

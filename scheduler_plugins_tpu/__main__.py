@@ -59,6 +59,7 @@ from scheduler_plugins_tpu.controllers.elasticquota import (
 )
 from scheduler_plugins_tpu.controllers.podgroup import reconcile_pod_groups
 from scheduler_plugins_tpu.framework import Scheduler
+from scheduler_plugins_tpu.obs import ledger as podledger
 from scheduler_plugins_tpu.state.cluster import Cluster
 from scheduler_plugins_tpu.utils import observability as obs
 
@@ -195,6 +196,15 @@ def parse_args(argv=None):
                          "PATH at startup (if present; anti-entropy "
                          "verifies it before trusting it) and write a "
                          "final crash-safe checkpoint there on shutdown")
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="disable the pod-lifecycle SLO ledger "
+                         "(obs.ledger; on by default in the daemon). The "
+                         "ledger follows each pod across cycles — queue "
+                         "wait, backoff, gang wait, solve/fence/bind — "
+                         "feeding the scheduler_e2e_scheduling_duration_ms "
+                         "/ scheduler_pod_scheduling_sli_duration_ms "
+                         "families, the /healthz sli block and "
+                         "GET /pods/<uid>/timeline on the health port")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="record the cycle tracer for the daemon's "
                          "lifetime and flush a Perfetto-loadable JSON to "
@@ -321,6 +331,14 @@ class HealthServer:
                             if outer.resilience is not None else None
                         ),
                         "parked_cycles": outer.parked_cycles,
+                        # pod-lifecycle SLIs (obs.ledger): e2e scheduling
+                        # latency percentiles, per-stage decomposition
+                        # totals and per-priority breakdown over the
+                        # retired ring; None with --no-ledger
+                        "sli": (
+                            podledger.LEDGER.sli_summary()
+                            if podledger.LEDGER.enabled else None
+                        ),
                         # live thread census vs the static concurrency
                         # model (tools/race_audit.py entry table):
                         # `unknown` = running threads the lockset
@@ -405,6 +423,38 @@ class HealthServer:
                             {"error": f"{type(exc).__name__}: {exc}"},
                         )
                         return
+                elif self.path.startswith("/pods/"):
+                    # GET /pods/<uid>/timeline — one pod's full lifecycle
+                    # story from the pod ledger: events with (cycle, lane,
+                    # seq) coordinates, the per-stage latency
+                    # decomposition (sums to e2e exactly) and the meta of
+                    # every cycle that observed the pod
+                    from urllib.parse import unquote, urlparse
+
+                    parts = urlparse(self.path).path.strip("/").split("/")
+                    if len(parts) != 3 or parts[2] != "timeline":
+                        self._json_reply(
+                            404,
+                            {"error": "expected /pods/<uid>/timeline"},
+                        )
+                        return
+                    if not podledger.LEDGER.enabled:
+                        self._json_reply(
+                            404,
+                            {"error": "pod-lifecycle ledger disabled "
+                                      "(--no-ledger)"},
+                        )
+                        return
+                    timeline = podledger.LEDGER.timeline(unquote(parts[1]))
+                    if timeline is None:
+                        self._json_reply(
+                            404,
+                            {"error": f"uid {unquote(parts[1])!r} not in "
+                                      "the ledger (never pending, or "
+                                      "aged out of the retired ring)"},
+                        )
+                        return
+                    body = json.dumps(timeline).encode()
                 elif self.path.startswith("/metrics.json"):
                     body = json.dumps(obs.metrics.snapshot()).encode()
                 elif self.path.startswith("/metrics"):
@@ -446,6 +496,12 @@ class Daemon:
         self.args = args
         self.profile = load_profile_file(args.profile)
         self.scheduler = Scheduler(self.profile)
+        if not getattr(args, "no_ledger", False):
+            # pod-lifecycle SLO ledger (obs.ledger): O(changed) per cycle,
+            # bounded ring — on by default in the daemon, feeding the
+            # upstream-parity e2e/attempts/SLI metric families and the
+            # /pods/<uid>/timeline surface
+            podledger.LEDGER.start()
         if args.tune and not args.record:
             # the flight-recorder ring IS the shadow lane's sweep corpus
             args.record = 8

@@ -92,6 +92,33 @@ NODE_COLUMN_EVENTS = frozenset({
 #: same rule `Cluster._native_rebuild` applies to the C++ columnar mirror
 SERVE_REBASE_EVENTS = frozenset({NODE_DELETE})
 
+# -- pod-lifecycle ledger transitions (observability plane) ----------------
+#: the `obs.ledger` transition vocabulary — NOT store mutation kinds (they
+#: never enter `EVENT_KINDS` or requeue gating) but registered here so the
+#: ledger, the store hooks that feed it, and the timeline renderers spell
+#: one set of strings, exactly like the mutation kinds above
+LIFECYCLE_FIRST_SEEN = "PodLifecycle/FirstSeen"
+LIFECYCLE_WAIT = "PodLifecycle/Wait"
+LIFECYCLE_UNSCHEDULABLE = "PodLifecycle/Unschedulable"
+LIFECYCLE_NOMINATED = "PodLifecycle/Nominated"
+LIFECYCLE_NOMINATION_CLEARED = "PodLifecycle/NominationCleared"
+LIFECYCLE_RESERVED = "PodLifecycle/Reserved"
+LIFECYCLE_BOUND = "PodLifecycle/Bound"
+LIFECYCLE_TERMINATING = "PodLifecycle/Terminating"
+LIFECYCLE_DELETED = "PodLifecycle/Deleted"
+LIFECYCLE_GATE = "PodLifecycle/Gate"
+
+#: every transition the ledger can record — appends are validated against
+#: this set (an unregistered kind is a bug in the feeding seam, not a new
+#: feature)
+LIFECYCLE_KINDS = frozenset({
+    LIFECYCLE_FIRST_SEEN, LIFECYCLE_WAIT, LIFECYCLE_UNSCHEDULABLE,
+    LIFECYCLE_NOMINATED, LIFECYCLE_NOMINATION_CLEARED, LIFECYCLE_RESERVED,
+    LIFECYCLE_BOUND, LIFECYCLE_TERMINATING, LIFECYCLE_DELETED,
+    LIFECYCLE_GATE,
+})
+assert not (LIFECYCLE_KINDS & EVENT_KINDS)
+
 #: every kind the rank-aware gang phase can emit or gate on
 #: (`gangs.phase.GangPhase`): elastic growth arrives as Pod/Add, binds as
 #: Pod/Update, shrink as Pod/Delete, spec changes as PodGroup/Update —

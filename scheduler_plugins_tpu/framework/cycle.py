@@ -31,6 +31,7 @@ from scheduler_plugins_tpu.framework.runtime import (
     SolveResult,
     now_ms as _now_ms,
 )
+from scheduler_plugins_tpu.obs import ledger as podledger
 from scheduler_plugins_tpu.plugins.coscheduling import Coscheduling
 from scheduler_plugins_tpu.resilience import faults
 from scheduler_plugins_tpu.state.cluster import Cluster
@@ -213,6 +214,10 @@ class CycleCtx:
     #: resident node tensors; None on the serial path (quality reads the
     #: live snapshot before any donation)
     quality_view: object = None
+    #: this cycle's pod-lifecycle ledger context (`obs.ledger.LedgerCycle`)
+    #: — None whenever the ledger is disabled, so every hook below guards
+    #: on it and the off path costs one attribute read
+    led: object = None
 
 
 def _cycle_open(scheduler, cluster, now, stream_chunk=None, serve=None,
@@ -224,6 +229,12 @@ def _cycle_open(scheduler, cluster, now, stream_chunk=None, serve=None,
         stream_chunk=stream_chunk, serve=serve, resilience=resilience,
         gangs=gangs,
     )
+    # the ledger scope opens BEFORE gang expiry: whole-gang rejections in
+    # the prologue are this cycle's decisions and must attribute to it.
+    # Callers (run_cycle / PipelinedCycle.tick / LanedCycle.tick) pop the
+    # scope in their finally — the stage functions only push nested ones.
+    ctx.led = podledger.LEDGER.cycle_open(now)
+    podledger.LEDGER.push_scope(ctx.led, 0)
     obs.metrics.inc(obs.SCHEDULING_CYCLES)
     ctx.cosched = next(
         (p for p in scheduler.profile.plugins if isinstance(p, Coscheduling)),
@@ -292,6 +303,12 @@ def _cycle_pending(ctx: CycleCtx) -> None:
             ctx.done = True
             return
     ctx.pending = pending
+    if ctx.led is not None:
+        # the batch membership gate for per-attempt stage splitting:
+        # binds/reservations of pods OUTSIDE this set (gang-phase binds
+        # above, permit fan-out of earlier cycles' reservations) charge
+        # their whole open interval to the resting wait-state instead
+        ctx.led.batch = frozenset(p.uid for p in pending)
 
 
 def _cycle_snapshot(ctx: CycleCtx) -> None:
@@ -336,6 +353,10 @@ def _cycle_solve_dispatch(ctx: CycleCtx) -> None:
     transfer); the resilience path completes through the watchdog's own
     deadlined fence and returns host arrays (`ctx.fenced`)."""
     scheduler, snap = ctx.scheduler, ctx.snap
+    if ctx.led is not None:
+        # dispatch ENTRY, not return: the in-batch wait stage ends the
+        # moment the solve starts consuming the snapshot
+        ctx.led.t_solve = podledger.LEDGER._now()
     result = None
     if ctx.resilience is not None:
         # watchdog-guarded: dispatch + completion fence in a
@@ -373,11 +394,15 @@ def _cycle_solve_fence(ctx: CycleCtx, quality_view: bool = False) -> None:
     copies the snapshot columns the deferred quality observation reads
     (the pipelined engine's finalize runs after the resident node
     tensors were donated to the next cycle's delta apply)."""
+    if ctx.led is not None and ctx.led.t_fence0 is None:
+        ctx.led.t_fence0 = podledger.LEDGER._now()
     if not ctx.fenced:
         ctx.assignment = np.asarray(ctx.result.assignment)
         ctx.admitted = np.asarray(ctx.result.admitted)
         ctx.wait = np.asarray(ctx.result.wait)
         ctx.fenced = True
+    if ctx.led is not None and ctx.led.t_fence1 is None:
+        ctx.led.t_fence1 = podledger.LEDGER._now()
     if quality_view:
         ctx.quality_view = _quality_view(ctx.snap)
 
@@ -391,6 +416,9 @@ def _cycle_post_solve(ctx: CycleCtx) -> None:
     report.degraded = (
         ctx.resilience is not None and ctx.resilience.degraded
     )
+    if ctx.led is not None:
+        ctx.led.degraded = report.degraded
+        ctx.led.solve_path = report.solve_path
     if ctx.rec is not None:
         with obs.tracer.span("Record", tid="cycle"):
             from scheduler_plugins_tpu.parallel.solver import PackingSolveView
@@ -449,7 +477,17 @@ def _cycle_bind(ctx: CycleCtx) -> None:
     here carries THIS cycle's `now` — under the pipelined engine the
     flush may run while the wall clock is already inside the next cycle's
     ingest, and backoff windows must still be charged to the cycle that
-    observed the snapshot."""
+    observed the snapshot. The ledger scope follows the same rule: lane 1
+    on THIS thread (the pipelined engine's flusher has its own scope
+    stack), attributing every store-hook event to the observing cycle."""
+    podledger.LEDGER.push_scope(ctx.led, 1)
+    try:
+        _bind_decisions(ctx)
+    finally:
+        podledger.LEDGER.pop_scope(ctx.led)
+
+
+def _bind_decisions(ctx: CycleCtx) -> None:
     cluster, report, now = ctx.cluster, ctx.report, ctx.now
     pending, meta = ctx.pending, ctx.meta
     assignment, admitted, wait = ctx.assignment, ctx.admitted, ctx.wait
@@ -515,12 +553,20 @@ def _cycle_postbind(ctx: CycleCtx, attribution: bool = True) -> None:
     by (and attributed to) the wrong cycle. `attribution=False` lets the
     pipelined engine defer the host-only failure decode to its overlap
     window when the per-pod codes already rode the solve result."""
+    podledger.LEDGER.push_scope(ctx.led, 1)
+    try:
+        _postbind_store(ctx, attribution)
+    finally:
+        podledger.LEDGER.pop_scope(ctx.led)
+
+
+def _postbind_store(ctx: CycleCtx, attribution: bool) -> None:
     cluster, report, now = ctx.cluster, ctx.report, ctx.now
     cosched = ctx.cosched
     if attribution:
         _attribute_failures(
             ctx.scheduler, ctx.snap, ctx.result, ctx.failed_idx, report,
-            tid=ctx.tid,
+            tid=ctx.tid, led=ctx.led,
         )
 
     # Permit Allow fan-out: quorum reached this cycle releases waiting
@@ -571,7 +617,7 @@ def _cycle_finalize(ctx: CycleCtx, attribution: bool = False) -> None:
     if attribution:
         _attribute_failures(
             ctx.scheduler, ctx.snap, ctx.result, ctx.failed_idx, ctx.report,
-            tid=ctx.tid,
+            tid=ctx.tid, led=ctx.led,
         )
     _observe_quality(
         ctx.report, ctx.quality_view or ctx.snap,
@@ -659,42 +705,51 @@ def run_cycle(scheduler: Scheduler, cluster: Cluster, now: int | None = None,
         scheduler, cluster, now, stream_chunk=stream_chunk, serve=serve,
         resilience=resilience, gangs=gangs,
     )
-    _cycle_pending(ctx)
-    if ctx.done:
+    try:
+        _cycle_pending(ctx)
+        if ctx.done:
+            if tuner is not None:
+                tuner.observe_report(ctx.report)
+            return ctx.report
+
+        from scheduler_plugins_tpu.utils import sanitize
+
+        if sanitize.enabled():
+            # discard reports left by solves OUTSIDE this cycle (warmups,
+            # other schedulers): the post-solve drain below must attribute
+            # only THIS cycle's checked calls to this report
+            sanitize.drain()
+        generation = getattr(cluster.nrt_cache, "generation", None)
+        ctx.rec = flightrec.recorder.begin(
+            now_ms=now, profile=scheduler.profile.name
+        )
+        ctx.serve_t0 = time.perf_counter() if serve is not None else None
+        with obs.flow(
+            "cycle", generation=generation, pending=len(ctx.pending)
+        ):
+            _cycle_snapshot(ctx)
+            # the Solve span covers dispatch AND completion (the fence's
+            # np.asarray host transfers force it) for the sequential path;
+            # the streamed path's device-side overlap shows up as pipeline
+            # rows emitted by run_chunk_pipeline itself
+            with obs.extension_span(
+                "Solve", scheduler.profile.name, pending=len(ctx.pending)
+            ):
+                _cycle_solve_dispatch(ctx)
+                _cycle_solve_fence(ctx)
+            _cycle_post_solve(ctx)
+        _cycle_bind(ctx)
+        _cycle_postbind(ctx, attribution=True)
+        _cycle_finalize(ctx)
         if tuner is not None:
             tuner.observe_report(ctx.report)
         return ctx.report
-
-    from scheduler_plugins_tpu.utils import sanitize
-
-    if sanitize.enabled():
-        # discard reports left by solves OUTSIDE this cycle (warmups,
-        # other schedulers): the post-solve drain below must attribute
-        # only THIS cycle's checked calls to this report
-        sanitize.drain()
-    generation = getattr(cluster.nrt_cache, "generation", None)
-    ctx.rec = flightrec.recorder.begin(
-        now_ms=now, profile=scheduler.profile.name
-    )
-    ctx.serve_t0 = time.perf_counter() if serve is not None else None
-    with obs.flow("cycle", generation=generation, pending=len(ctx.pending)):
-        _cycle_snapshot(ctx)
-        # the Solve span covers dispatch AND completion (the fence's
-        # np.asarray host transfers force it) for the sequential path;
-        # the streamed path's device-side overlap shows up as pipeline
-        # rows emitted by run_chunk_pipeline itself
-        with obs.extension_span(
-            "Solve", scheduler.profile.name, pending=len(ctx.pending)
-        ):
-            _cycle_solve_dispatch(ctx)
-            _cycle_solve_fence(ctx)
-        _cycle_post_solve(ctx)
-    _cycle_bind(ctx)
-    _cycle_postbind(ctx, attribution=True)
-    _cycle_finalize(ctx)
-    if tuner is not None:
-        tuner.observe_report(ctx.report)
-    return ctx.report
+    finally:
+        # the lane-0 scope opened in `_cycle_open` — popped HERE (not in a
+        # stage function) so early returns and raises cannot leak it, and
+        # ambient events between cycles fall back to ambient attribution
+        podledger.LEDGER.pop_scope(ctx.led)
+        podledger.LEDGER.cycle_close(ctx.led)
 
 
 def _observe_quality(report, snap, assignment, admitted, wait) -> None:
@@ -719,7 +774,7 @@ def _observe_quality(report, snap, assignment, admitted, wait) -> None:
 
 
 def _attribute_failures(scheduler, snap, result, failed_idx, report,
-                        tid="cycle"):
+                        tid="cycle", led=None):
     """Fill `CycleReport.failed_by` and the
     `scheduler_unschedulable_by_plugin_total{plugin}` counters — the
     upstream UnschedulablePlugins attribution. The sequential parity path
@@ -747,6 +802,12 @@ def _attribute_failures(scheduler, snap, result, failed_idx, report,
             name = names[code] if code > 0 else names[0]
             report.failed_by[uid] = name
             obs.metrics.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin=name)
+            if led is not None:
+                # blame fills IN PLACE on the observing cycle's
+                # Unschedulable event: this decode may run in the NEXT
+                # tick's overlap window under the pipelined engine, and
+                # an appended event there would order differently
+                podledger.LEDGER.set_blame(uid, led.cid, name)
 
 
 def _requeue_eligible(scheduler, cluster, pending, now, report,
@@ -794,6 +855,8 @@ def _requeue_eligible(scheduler, cluster, pending, now, report,
     for plugin in scheduler.profile.plugins:
         registered.update(plugin.events_to_register())
 
+    led = podledger.LEDGER
+
     def eligible(pod):
         rec = cluster.unschedulable_since.get(pod.uid)
         if rec is None:
@@ -803,12 +866,22 @@ def _requeue_eligible(scheduler, cluster, pending, now, report,
             return True
         if now < cluster.pod_backoff_until_ms.get(pod.uid, 0):
             obs.metrics.inc(obs.REQUEUE_BACKOFF_SKIPS)
+            if led.enabled:
+                led.on_wait(pod.uid, "backoff_held")
             return False
         if now >= flush_at:
             return True
-        return any(
+        if any(
             cluster.event_last.get(kind, 0) > seq for kind in registered
-        )
+        ):
+            return True
+        if led.enabled:
+            # backoff expired, no registered event yet: the pod is now
+            # waiting on the QUEUE gate, not the backoff clock (the
+            # ledger's one-transition-per-park-episode classification;
+            # gang parks keep their gang_wait label — `Ledger.on_wait`)
+            led.on_wait(pod.uid, "queue_wait")
+        return False
 
     keep = [pod for pod in pending if eligible(pod)]
     kept_uids = {p.uid for p in keep}
@@ -919,6 +992,8 @@ def _run_preemption(scheduler, cluster, pending, report, now):
                 # untrack it or the serving engine's compatibility gate
                 # stays pinned False for this pod's lifetime
                 cluster.delta_sink.note_nomination(pod)
+            if podledger.LEDGER.enabled:
+                podledger.LEDGER.on_nomination(pod.uid, None)
             continue
         obs.metrics.inc(obs.PREEMPTION_VICTIMS, len(result.victims))
         # setting the nomination NOW makes this pod visible to later
@@ -926,6 +1001,8 @@ def _run_preemption(scheduler, cluster, pending, report, now):
         pod.nominated_node_name = result.nominated_node
         if cluster.delta_sink is not None:
             cluster.delta_sink.note_nomination(pod)
+        if podledger.LEDGER.enabled:
+            podledger.LEDGER.on_nomination(pod.uid, result.nominated_node)
         n = node_pos[result.nominated_node]
         demand = encode_demand(meta.index, pod)
         victim_freed = np.zeros(len(meta.index), np.int64)

@@ -66,6 +66,7 @@ from scheduler_plugins_tpu.framework.cycle import (
     _cycle_solve_fence,
 )
 from scheduler_plugins_tpu.framework.runtime import now_ms as _now_ms
+from scheduler_plugins_tpu.obs import ledger as podledger
 from scheduler_plugins_tpu.utils import flightrec, observability as obs
 
 
@@ -153,6 +154,18 @@ class LanedCycle:
     def tick(self, now: int | None = None) -> CycleReport:
         if now is None:
             now = _now_ms()
+        # pod-lifecycle ledger scope discipline (the PipelinedCycle
+        # pattern): `_cycle_open` pushes the lane-0 scope on this thread;
+        # pop it on every exit so a raise cannot leak a stale scope
+        ctx_box: list = []
+        try:
+            return self._tick(now, ctx_box)
+        finally:
+            if ctx_box:
+                podledger.LEDGER.pop_scope(ctx_box[0].led)
+                podledger.LEDGER.cycle_close(ctx_box[0].led)
+
+    def _tick(self, now: int, ctx_box: list) -> CycleReport:
         cid = self._cycle_id
         self._cycle_id += 1
 
@@ -169,6 +182,7 @@ class LanedCycle:
             # tid keeps every Perfetto row single-threaded (the per-tid
             # validity gate)
             ctx.tid = "Lane/bind"
+        ctx_box.append(ctx)
         _cycle_pending(ctx)
         if ctx.done:
             return ctx.report
@@ -194,6 +208,11 @@ class LanedCycle:
                 "Solve", self.scheduler.profile.name,
                 pending=len(ctx.pending), lanes=self.k,
             ):
+                if ctx.led is not None:
+                    # this engine dispatches its own solver (not
+                    # `_cycle_solve_dispatch`), so the ledger's solve
+                    # stamp lands here
+                    ctx.led.t_solve = podledger.LEDGER._now()
                 assignment, admitted, wait, codes, stats = (
                     self.solver.solve(
                         ctx.snap, ctx.pending, self.cluster,

@@ -66,6 +66,7 @@ from scheduler_plugins_tpu.framework.cycle import (
     _cycle_solve_fence,
 )
 from scheduler_plugins_tpu.framework.runtime import now_ms as _now_ms
+from scheduler_plugins_tpu.obs import ledger as podledger
 from scheduler_plugins_tpu.utils import flightrec, observability as obs
 
 
@@ -217,6 +218,20 @@ class PipelinedCycle:
     def tick(self, now: int | None = None) -> CycleReport:
         if now is None:
             now = _now_ms()
+        # the pod-lifecycle ledger's lane-0 scope is pushed inside
+        # `_cycle_open` (on THIS thread — the bind flusher pushes its own
+        # lane-1 scopes); pop it on EVERY exit so ambient events between
+        # ticks fall back to ambient attribution and a raise cannot leak
+        # a stale scope onto the tick thread
+        ctx_box: list = []
+        try:
+            return self._tick(now, ctx_box)
+        finally:
+            if ctx_box:
+                podledger.LEDGER.pop_scope(ctx_box[0].led)
+                podledger.LEDGER.cycle_close(ctx_box[0].led)
+
+    def _tick(self, now: int, ctx_box: list) -> CycleReport:
         clock = self._clock
         cid = self._cycle_id
         self._cycle_id += 1
@@ -232,6 +247,7 @@ class PipelinedCycle:
                 resilience=self.resilience, gangs=self.gangs,
             )
             ctx.tid = "Cycle/bind"
+            ctx_box.append(ctx)
             _cycle_pending(ctx)
             if ctx.done:
                 # empty/gang-only cycle: nothing in flight to overlap —

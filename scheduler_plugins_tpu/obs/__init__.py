@@ -1,0 +1,12 @@
+"""Pod-level observability plane (the cross-cycle complement of utils.observability).
+
+`obs.ledger` follows a POD across scheduling cycles — first-seen, queued,
+backoff-held, gang-gated, nominated/reserved, bound-or-blamed — where every
+earlier observability layer (tracer spans, flight recorder, quality gauges)
+instruments one CYCLE. See docs/OBSERVABILITY.md §pod-lifecycle ledger.
+"""
+
+from . import ledger
+from .ledger import LEDGER, Ledger, STAGES
+
+__all__ = ["ledger", "LEDGER", "Ledger", "STAGES"]

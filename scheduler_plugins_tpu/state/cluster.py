@@ -26,6 +26,7 @@ from scheduler_plugins_tpu.api.objects import (
     PriorityClass,
     SeccompProfile,
 )
+from scheduler_plugins_tpu.obs import ledger as podledger
 from scheduler_plugins_tpu.state.snapshot import build_snapshot
 
 
@@ -148,6 +149,17 @@ class Cluster:
             self.pod_backoff_until_ms[uid] = now_ms + int(
                 base * (0.5 + 0.5 * self._backoff_jitter(uid, attempts))
             )
+            led = podledger.LEDGER
+            if led.enabled:
+                # the charged branch only: a same-now re-mark (bind-loop
+                # failure + whole-gang rejection in one cycle) is one
+                # attempt and one ledger transition
+                pod = self.pods.get(uid)
+                led.on_unschedulable(
+                    uid, attempts,
+                    self.pod_backoff_until_ms[uid] - now_ms,
+                    bool(pod is not None and pod.pod_group()),
+                )
         self.unschedulable_since[uid] = (
             self.event_seq,
             now_ms + self.requeue_flush_ms,
@@ -339,6 +351,10 @@ class Cluster:
     def add_pod(self, pod: Pod):
         old = self.pods.get(pod.uid)
         self.note_event(ev.POD_UPDATE if old is not None else ev.POD_ADD)
+        if old is None and pod.node_name is None:
+            led = podledger.LEDGER
+            if led.enabled:
+                led.on_first_seen(pod)
         if self.delta_sink is not None:
             # an upsert swaps the pod's assigned contribution wholesale
             # (requests may have changed; a stale echo may drop the node)
@@ -384,6 +400,10 @@ class Cluster:
         self._index_drop_pod(uid, forget_order=True)
         if pod is not None:
             self.note_event(ev.POD_DELETE)
+            if pod.node_name is None:
+                led = podledger.LEDGER
+                if led.enabled:
+                    led.on_delete(uid)
             if self.delta_sink is not None:
                 if pod.node_name is not None:
                     # bound pod's usage leaves with it (a reserved pod's
@@ -420,6 +440,10 @@ class Cluster:
             if self.delta_sink is not None and not was_terminating else None
         )
         pod.deletion_ms = now_ms
+        if not was_terminating:
+            led = podledger.LEDGER
+            if led.enabled:
+                led.on_terminating(uid)
         if gated is not None:
             self.delta_sink.gang_gated(gated, -1)
         self._index_drop_pod(uid)
@@ -568,9 +592,13 @@ class Cluster:
     def reindex_pod(self, uid: str) -> None:
         """Re-evaluate one pod's pending-index membership after an
         in-place eligibility flip (phase / scheduling gate)."""
+        pod = self.pods.get(uid)
+        if pod is not None and pod.node_name is None:
+            led = podledger.LEDGER
+            if led.enabled:
+                led.on_gate_flip(uid, bool(pod.scheduling_gated))
         if self._pending_idx is None:
             return
-        pod = self.pods.get(uid)
         if pod is not None and self._pending_eligible(pod):
             self._pending_idx[uid] = pod
         else:
@@ -657,6 +685,9 @@ class Cluster:
             self.delta_sink.forget_nomination(uid)
         self.pods[uid].node_name = node_name
         self._index_drop_pod(uid)
+        led = podledger.LEDGER
+        if led.enabled:
+            led.on_bind(uid, node_name)
         self.recent_bindings[uid] = (now_ms, node_name)
         if self.nrt_cache is not None:
             # Reserve -> bind -> PostBind lifecycle for the NRT cache
@@ -672,6 +703,9 @@ class Cluster:
         """Permit said Wait: hold the placement without binding."""
         self.reserved[uid] = node_name
         self._index_drop_pod(uid)
+        led = podledger.LEDGER
+        if led.enabled:
+            led.on_reserve(uid, node_name)
         if self.delta_sink is not None:
             # a reservation holds capacity exactly like a binding
             self.delta_sink.pod_assigned(self.pods[uid], node_name)

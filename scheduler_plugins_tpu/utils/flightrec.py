@@ -437,11 +437,37 @@ class FlightRecorder:
             manifest_path,
             "\n".join(lines) + ("\n" if lines else ""),
         )
+        ledger_pods = self._save_ledger_segment(directory)
         return {
             "cycles": len(lines),
             "blobs_written": written,
+            "ledger_pods": ledger_pods,
             "path": directory,
         }
+
+    @staticmethod
+    def _save_ledger_segment(directory: str) -> int:
+        """Persist the pod-lifecycle ledger (obs.ledger) alongside the
+        cycle manifest when it is live: `ledger.json` lets
+        `tools/replay.py timeline <bundle> <uid>` reconstruct a pod's
+        cross-cycle story next to the cycle-level replay evidence. Lazy
+        import — flightrec must not pull the ledger in for the many
+        callers that never record. Returns the number of pod records
+        persisted (0 when the ledger is off or empty)."""
+        from scheduler_plugins_tpu.obs import ledger as podledger
+
+        led = podledger.LEDGER
+        if not led.enabled:
+            return 0
+        export = led.export()
+        n = len(export["retired"]) + len(export["live"])
+        if n == 0:
+            return 0
+        obs.atomic_write(
+            os.path.join(directory, "ledger.json"),
+            json.dumps(export, sort_keys=True),
+        )
+        return n
 
 
 #: global recorder, off by default (`run_cycle` hooks, daemon `--record`,

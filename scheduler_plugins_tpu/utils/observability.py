@@ -160,6 +160,22 @@ class Metrics:
                 )
                 self._set_max(f"{name}_ms_max", ms_int)
 
+    def observe_batch(self, observations) -> None:
+        """Histogram-only batch feed under ONE lock acquisition:
+        `observations` is an iterable of (name, value, items) with
+        `items` pre-sorted label tuples (as `_label_items` returns).
+        No legacy `_ms_total` mirrors — this path exists for hot
+        per-pod feeds (the lifecycle ledger's bind-time SLI fan-out)
+        where per-call lock round-trips and kwargs packing dominate,
+        and for values that are not durations at all (attempt counts)."""
+        with self._lock:
+            for name, value, items in observations:
+                key = (name, items)
+                hist = self._hists.get(key)
+                if hist is None:
+                    hist = self._hists[key] = _Histogram()
+                hist.observe(value)
+
     def get(self, name: str, **labels) -> int:
         return self._counters.get((name, _label_items(labels)), 0)
 
@@ -190,9 +206,17 @@ class Metrics:
             self._counters.clear()
             self._hists.clear()
 
+    def scoped(self) -> "ScopedMetrics":
+        """A snapshot/diff view: reads return counts accumulated SINCE
+        this call. Arm-vs-arm benches read per-arm deltas through one of
+        these instead of the process-global totals (the PR 12 `rebases`
+        fix, generalized — see bench.py's sweep baselines)."""
+        return ScopedMetrics(self)
+
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4: counters as counters,
-        histograms as cumulative `_bucket{le=...}` + `_sum` + `_count`.
+        """Prometheus text exposition format 0.0.4: `# HELP` + `# TYPE`
+        per family, counters as counters, histograms as cumulative
+        `_bucket{le=...}` + `_sum` + `_count`.
         The legacy `<name>_count` summary counter `observe_ms` keeps for
         unlabeled names is the SAME sample the histogram's `_count` child
         renders — it is skipped here (the JSON snapshot still carries it)
@@ -203,18 +227,25 @@ class Metrics:
         hist_count_names = {f"{name}_count" for (name, _), _h in hists}
         lines: list[str] = []
         typed: set[str] = set()
+
+        def _head(name: str, kind: str) -> None:
+            text = HELP.get(name, f"{name} (scheduler-plugins-tpu)")
+            text = text.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {name} {text}")
+            lines.append(f"# TYPE {name} {kind}")
+
         for (name, items), value in counters:
             if name in hist_count_names:
                 continue  # rendered as the histogram's _count child below
             if name not in typed:
                 typed.add(name)
                 kind = "counter" if name.endswith(("_total", "_count")) else "gauge"
-                lines.append(f"# TYPE {name} {kind}")
+                _head(name, kind)
             lines.append(f"{name}{_render_labels(items)} {value}")
         for (name, items), hist in hists:
             if name not in typed:
                 typed.add(name)
-                lines.append(f"# TYPE {name} histogram")
+                _head(name, "histogram")
             cumulative = 0
             for bound, count in zip(HIST_BUCKETS_MS, hist.counts):
                 cumulative += count
@@ -225,6 +256,51 @@ class Metrics:
             lines.append(f"{name}_sum{_render_labels(items)} {hist.sum:g}")
             lines.append(f"{name}_count{_render_labels(items)} {hist.count}")
         return "\n".join(lines) + "\n"
+
+
+class ScopedMetrics:
+    """Delta view over a `Metrics` registry: every read subtracts the
+    counter/histogram state captured at construction, so two interleaved
+    bench arms sharing the process-global registry each see only their
+    own increments. Reads are as cheap as the underlying `get` — the
+    base is a plain dict snapshot, never re-captured."""
+
+    def __init__(self, metrics: Metrics):
+        self._m = metrics
+        with metrics._lock:
+            self._base = dict(metrics._counters)
+            self._hbase = {
+                key: (h.count, h.sum)
+                for key, h in metrics._hists.items()
+            }
+
+    def get(self, name: str, **labels) -> int:
+        key = (name, _label_items(labels))
+        return self._m._counters.get(key, 0) - self._base.get(key, 0)
+
+    def hist_count(self, name: str, **labels) -> int:
+        key = (name, _label_items(labels))
+        h = self._m._hists.get(key)
+        base = self._hbase.get(key, (0, 0.0))[0]
+        return (h.count if h is not None else 0) - base
+
+    def hist_sum(self, name: str, **labels) -> float:
+        key = (name, _label_items(labels))
+        h = self._m._hists.get(key)
+        base = self._hbase.get(key, (0, 0.0))[1]
+        return (h.sum if h is not None else 0.0) - base
+
+    def delta(self) -> dict[str, int]:
+        """Rendered-key -> delta for every counter that moved since the
+        scope opened (the flat `snapshot()` shape, diffed)."""
+        with self._m._lock:
+            cur = dict(self._m._counters)
+        out = {}
+        for (name, items), value in cur.items():
+            d = value - self._base.get((name, items), 0)
+            if d:
+                out[f"{name}{_render_labels(items)}"] = d
+        return out
 
 
 #: global registry, like the upstream prometheus default registry
@@ -360,6 +436,96 @@ LANE_RERESOLVES = "scheduler_lane_reresolves_total"
 #: fence-exact gate rejected the profile/snapshot (side tables armed,
 #: preemption nominees present, or an admit plugin without a host twin)
 LANE_SERIAL_FALLBACKS = "scheduler_lane_serial_fallbacks_total"
+#: per-pod e2e scheduling latency histogram (labels: priority) — the
+#: upstream `scheduler_e2e_scheduling_duration_seconds` family in ms
+#: (vendored registration: cmd/scheduler/main.go:23-24), fed by the
+#: pod-lifecycle ledger (obs.ledger) when a pod retires bound
+E2E_SCHEDULING_MS = "scheduler_e2e_scheduling_duration_ms"
+#: scheduling attempts per successfully-scheduled pod (histogram) — the
+#: upstream `scheduler_pod_scheduling_attempts` family
+POD_SCHEDULING_ATTEMPTS = "scheduler_pod_scheduling_attempts"
+#: per-stage share of the e2e latency (labels: stage ∈ obs.ledger.STAGES)
+#: — the upstream `scheduler_pod_scheduling_sli_duration_seconds` shape,
+#: decomposed into queue-wait / backoff-held / gang-wait / solve / fence /
+#: bind-flush buckets that provably sum to e2e per pod
+POD_SCHEDULING_SLI_MS = "scheduler_pod_scheduling_sli_duration_ms"
+
+#: `# HELP` registry for `prometheus_text` (exposition format 0.0.4
+#: requires families to be self-describing; families not listed here get
+#: an auto-generated line). One copy, next to the name constants.
+HELP: dict[str, str] = {
+    SCHEDULING_CYCLES: "Scheduling cycles run.",
+    PODS_BOUND: "Pods bound to a node.",
+    PODS_FAILED: "Pods reported unschedulable.",
+    PREEMPTION_ATTEMPTS: "Preemption attempts (upstream PreemptionAttempts).",
+    PREEMPTION_VICTIMS: "Pods nominated for eviction by preemption.",
+    GANG_REJECTIONS: "Whole-gang admission rejections.",
+    CACHE_RESYNC_FLUSHES: "NRT cache resync flushes.",
+    UNSCHEDULABLE_BY_PLUGIN:
+        "Unschedulable verdicts attributed per plugin "
+        "(upstream UnschedulablePlugins).",
+    PLUGIN_EXECUTION:
+        "Per-plugin, per-extension-point execution latency in ms.",
+    JIT_COMPILE: "XLA compile wall time per program in ms.",
+    JIT_CACHE_MISS: "Jit-cache misses per program.",
+    FLIGHTREC_CYCLES: "Cycles captured by the flight recorder.",
+    SERVE_DECISION_LATENCY:
+        "Delta ingest to host-visible bind decisions, per cycle, in ms.",
+    SERVE_GENERATION: "Resident-state generation (gauge).",
+    SERVE_STALENESS:
+        "Delta events applied since the resident base was rebuilt (gauge).",
+    SERVE_PENDING_DELTAS:
+        "Delta events drained at the start of the current refresh (gauge).",
+    SERVE_REBASES: "Full re-snapshots performed by the serving engine.",
+    SERVE_GANG_FALLBACKS:
+        "Serve refreshes that fell back to a full snapshot on a gang "
+        "roster.",
+    PLACEMENT_QUALITY:
+        "Latest cycle's placement-quality objective values (gauge).",
+    DEGRADED: "1 while serving from the host-side parity solve (gauge).",
+    SOLVE_RETRIES: "Failed watchdog retry attempts.",
+    SOLVE_FAILOVERS: "Fast-path to degraded transitions.",
+    PROBATION_PROBES: "Probation probes dispatched while degraded.",
+    SOLVE_WORKERS_ABANDONED:
+        "Watchdog workers orphaned inside a hung backend call.",
+    THREAD_TOPOLOGY_DRIFT:
+        "Live threads unknown to the committed concurrency manifest.",
+    ANTIENTROPY_CHECKS: "Anti-entropy digest checks of resident state.",
+    ANTIENTROPY_DIVERGENCE: "Anti-entropy divergences detected.",
+    REQUEUE_BACKOFF_SKIPS:
+        "Requeue attempts skipped inside a backoff window.",
+    CYCLE_OVERLAP_EFFICIENCY:
+        "Fraction of the in-flight solve envelope covered by host work "
+        "(gauge).",
+    CYCLE_PIPELINE_BUBBLE:
+        "Wall ms the pipelined fence idled waiting on the device (gauge).",
+    CYCLE_LATE_BINDS:
+        "Async bind flushes that landed after a later ingest boundary.",
+    TUNER_PROMOTIONS: "Live weight promotions applied by the shadow tuner.",
+    TUNER_ROLLBACKS: "Probation auto-rollbacks.",
+    TUNER_SWEEPS: "Shadow-lane sweep evaluations completed.",
+    TUNER_SWEEP_FAILURES: "Shadow-lane sweep/promotion faults.",
+    TUNER_ACTIVE_WEIGHTS:
+        "Active weight vector content digest, first 48 bits (gauge).",
+    TUNER_STATE:
+        "Tuner controller state: 0 idle, 1 probation, 2 cooldown, "
+        "3 disabled (gauge).",
+    LANE_CONFLICTS: "Conflict-fence rejections per lane.",
+    LANE_COMMIT: "Host conflict-fence wall ms per laned cycle.",
+    LANE_RERESOLVES: "Pods re-resolved by the suffix repair solve.",
+    LANE_SERIAL_FALLBACKS:
+        "Laned cycles that fell back to the sequential parity solve.",
+    E2E_SCHEDULING_MS:
+        "Per-pod e2e scheduling latency in ms, labeled by priority "
+        "(upstream scheduler_e2e_scheduling_duration_seconds, in ms).",
+    POD_SCHEDULING_ATTEMPTS:
+        "Scheduling attempts per scheduled pod "
+        "(upstream scheduler_pod_scheduling_attempts).",
+    POD_SCHEDULING_SLI_MS:
+        "Per-stage share of pod scheduling latency in ms, labeled by "
+        "stage (upstream scheduler_pod_scheduling_sli_duration_seconds, "
+        "in ms, decomposed).",
+}
 
 
 # ---------------------------------------------------------------------------

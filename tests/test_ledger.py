@@ -1,0 +1,463 @@
+"""Pod-lifecycle ledger (obs/ledger.py) decision tables.
+
+Four properties, each gated the way the PR 9 / PR 11 disciplines gate
+their subsystems:
+
+- **Exact decomposition** — the telescoping stage accounting makes
+  `sum(stages) == e2e` an identity; the stub-clock tables here pin the
+  exact per-stage values for hand-picked transition sequences, and the
+  engine runs check the invariant over every retired pod.
+- **Backoff windows** — the `window_ms` a ledger Unschedulable event
+  records must equal the deterministic PR 9 requeue charge
+  (min(initial·2^(min(n-1,30)), max) scaled by the blake2b jitter in
+  [0.5, 1.0]) bit-for-bit, not approximately.
+- **Gang spans** — gang members waiting on quorum accumulate
+  `gang_wait`, and the admission wait derived from ledger events agrees
+  with `tuning.quality.gang_admission_latency`'s definition on the same
+  scenario.
+- **Engine sequence identity** — serial `run_cycle` and
+  `PipelinedCycle` produce event-SEQUENCE-identical ledgers (stamps may
+  differ; order and attribution may not), including failure blame.
+"""
+
+import pytest
+
+from scheduler_plugins_tpu.api import events as ev
+from scheduler_plugins_tpu.api.objects import (
+    Container,
+    Node,
+    Pod,
+    PodGroup,
+    POD_GROUP_LABEL,
+)
+from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+from scheduler_plugins_tpu.framework import (
+    PipelinedCycle,
+    Profile,
+    Scheduler,
+    run_cycle,
+)
+from scheduler_plugins_tpu.obs import ledger as podledger
+from scheduler_plugins_tpu.obs.ledger import Ledger, LedgerCycle, STAGES
+from scheduler_plugins_tpu.plugins import (
+    Coscheduling,
+    NodeResourcesAllocatable,
+)
+from scheduler_plugins_tpu.state.cluster import Cluster
+from scheduler_plugins_tpu.utils import observability as obs
+
+
+def mknode(name, cpu=10_000, mem=32 << 30, pods=110, **kw):
+    return Node(name=name, allocatable={CPU: cpu, MEMORY: mem, PODS: pods}, **kw)
+
+
+def mkpod(name, cpu=100, mem=1 << 20, ns="default", gang=None, **kw):
+    labels = dict(kw.pop("labels", {}))
+    if gang:
+        labels[POD_GROUP_LABEL] = gang
+    return Pod(
+        name=name,
+        namespace=ns,
+        containers=[Container(requests={CPU: cpu, MEMORY: mem})],
+        labels=labels,
+        **kw,
+    )
+
+
+class FakePod:
+    """Just enough pod for the store-mutator seams."""
+
+    def __init__(self, uid, priority=0, gated=False, gang=None):
+        self.uid = uid
+        self.priority = priority
+        self.scheduling_gated = gated
+        self._gang = gang
+
+    def pod_group(self):
+        return self._gang
+
+
+@pytest.fixture
+def stub_led():
+    """A fresh (non-global) ledger with a controllable integer clock."""
+    led = Ledger().start()
+    clock = {"t": 0}
+    led._now = lambda: clock["t"]
+    return led, clock
+
+
+def use_for(led):
+    """Context manager: install `led` as the global feeding target."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        prev = podledger.use(led)
+        try:
+            yield led
+        finally:
+            podledger.use(prev)
+
+    return cm()
+
+
+class TestStubClockDecomposition:
+    """Hand-picked transition sequences with a stub clock: the exact
+    per-stage nanosecond charges, not just the sum."""
+
+    def test_plain_wait_then_bind(self, stub_led):
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p"))          # t=0, queue_wait
+        clock["t"] = 100
+        led.on_wait("p", "backoff_held")          # queue_wait += 100
+        clock["t"] = 250
+        led.on_wait("p", "queue_wait")            # backoff_held += 150
+        clock["t"] = 1000
+        led.on_bind("p", "n0")                    # queue_wait += 750
+        (rec,) = led._retired
+        assert rec.stages == {"queue_wait": 850, "backoff_held": 150}
+        assert sum(rec.stages.values()) == rec.e2e_ns() == 1000
+        assert led.decomposition_errors() == []
+
+    def test_attempt_stage_split_against_cycle_stamps(self, stub_led):
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p"))           # t=0
+        cyc = LedgerCycle(cid=1, now_ms=1000, t_open=40)
+        cyc.batch = frozenset({"p"})
+        cyc.t_solve, cyc.t_fence0, cyc.t_fence1 = 300, 420, 450
+        led.push_scope(cyc, 0)
+        try:
+            clock["t"] = 500
+            led.on_bind("p", "n0")
+        finally:
+            led.pop_scope(cyc)
+        (rec,) = led._retired
+        assert rec.stages == {
+            "queue_wait": 300,   # first_seen -> solve dispatch
+            "solve": 120,        # t_solve -> t_fence0
+            "fence": 30,         # t_fence0 -> t_fence1
+            "bind_flush": 50,    # t_fence1 -> bind stamp
+        }
+        assert rec.attempts == 1
+        assert sum(rec.stages.values()) == rec.e2e_ns() == 500
+
+    def test_unbatched_bind_falls_back_to_plain_charge(self, stub_led):
+        # gang fan-out binds / permit releases: the pod was reserved in
+        # an EARLIER cycle, so this cycle's stamps must not split it
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p", gang="g"))
+        clock["t"] = 200
+        led.on_wait("p", "gang_wait")
+        cyc = LedgerCycle(cid=7, now_ms=9, t_open=250)
+        cyc.t_solve, cyc.t_fence0, cyc.t_fence1 = 300, 310, 320
+        led.push_scope(cyc, 1)
+        try:
+            clock["t"] = 400
+            led.on_bind("p", "n1")
+        finally:
+            led.pop_scope(cyc)
+        (rec,) = led._retired
+        assert rec.stages == {"queue_wait": 200, "gang_wait": 200}
+        assert rec.attempts == 0  # no stage-split attempt was observable
+        assert sum(rec.stages.values()) == rec.e2e_ns() == 400
+
+    def test_deleted_pod_decomposes_too(self, stub_led):
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p"))
+        clock["t"] = 100
+        led.on_unschedulable("p", attempt=1, window_ms=500, gang=False)
+        clock["t"] = 900
+        led.on_delete("p")
+        (rec,) = led._retired
+        assert rec.outcome == "deleted"
+        assert rec.stages == {"queue_wait": 100, "backoff_held": 800}
+        assert sum(rec.stages.values()) == rec.e2e_ns() == 900
+        assert led.pods_deleted == 1 and led.pods_bound == 0
+
+    def test_gated_pod_charges_gang_wait_from_first_seen(self, stub_led):
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p", gated=True, gang="g"))
+        clock["t"] = 300
+        led.on_gate_flip("p", gated=False)        # gang_wait += 300
+        clock["t"] = 450
+        led.on_bind("p", "n0")                    # queue_wait += 150
+        (rec,) = led._retired
+        assert rec.stages == {"gang_wait": 300, "queue_wait": 150}
+        assert sum(rec.stages.values()) == rec.e2e_ns() == 450
+
+    def test_wait_transitions_dedupe_per_episode(self, stub_led):
+        # one event per park episode, never one per cycle; gang parks
+        # keep gang_wait through backoff expiry
+        led, clock = stub_led
+        led.on_first_seen(FakePod("p", gang="g"))
+        clock["t"] = 10
+        led.on_wait("p", "gang_wait")
+        clock["t"] = 20
+        led.on_wait("p", "gang_wait")             # same state: no event
+        clock["t"] = 30
+        led.on_wait("p", "queue_wait")            # gang->queue: suppressed
+        rec = led._records["p"]
+        kinds = [e[3] for e in rec.events]
+        assert kinds == [ev.LIFECYCLE_FIRST_SEEN, ev.LIFECYCLE_WAIT]
+        assert rec.state == "gang_wait"
+
+    def test_sli_feed_on_bind_only(self, stub_led):
+        led, clock = stub_led
+        scope = obs.metrics.scoped()
+        led.on_first_seen(FakePod("b", priority=5))
+        led.on_first_seen(FakePod("d"))
+        clock["t"] = 2_000_000  # 2ms
+        led.on_bind("b", "n0")
+        led.on_delete("d")      # deleted pods never feed the e2e family
+        assert scope.hist_count(obs.E2E_SCHEDULING_MS, priority="5") == 1
+        assert scope.hist_sum(obs.E2E_SCHEDULING_MS, priority="5") == 2.0
+        assert scope.hist_count(obs.POD_SCHEDULING_ATTEMPTS) == 1
+        assert scope.hist_count(
+            obs.POD_SCHEDULING_SLI_MS, stage="queue_wait") == 1
+        total = sum(
+            scope.hist_sum(obs.POD_SCHEDULING_SLI_MS, stage=s)
+            for s in STAGES
+        )
+        assert total == 2.0  # SLI stage sums mirror the e2e exactly
+
+
+class TestEngineDecomposition:
+    """Real engine runs: the invariant holds for every retired pod."""
+
+    def test_serial_cycles_decompose_exactly(self):
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0"))
+            cluster.add_node(mknode("n1"))
+            sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+            for i in range(3):
+                cluster.add_pod(mkpod(f"p{i}", cpu=500, creation_ms=i))
+            cluster.add_pod(mkpod("huge", cpu=10**9, creation_ms=99))
+            run_cycle(sched, cluster, now=1000)
+            run_cycle(sched, cluster, now=200_000)
+        assert led.pods_bound == 3
+        assert led.decomposition_errors() == []
+        tl = led.timeline("default/p0")
+        assert tl["events"][-1]["kind"] == ev.LIFECYCLE_BOUND
+        assert sum(tl["stages_ms"].values()) == pytest.approx(tl["e2e_ms"])
+        assert set(tl["stages_ms"]) <= set(STAGES)
+        # the never-fit pod is live, blamed, and still internally consistent
+        hl = led.timeline("default/huge")
+        blames = [
+            e["detail"]["by"] for e in hl["events"]
+            if e["kind"] == ev.LIFECYCLE_UNSCHEDULABLE
+        ]
+        assert blames and all(b == "NodeResourcesFit" for b in blames)
+
+    def test_export_roundtrips_through_json(self):
+        import json
+
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0"))
+            cluster.add_pod(mkpod("p", cpu=500))
+            sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+            run_cycle(sched, cluster, now=1000)
+        dump = json.loads(json.dumps(led.export(), sort_keys=True))
+        assert dump["version"] == 1
+        assert dump["sli"]["pods_bound"] == 1
+        (rec,) = dump["retired"]
+        assert rec["outcome"] == "bound"
+        assert sum(rec["stages_ms"].values()) == pytest.approx(rec["e2e_ms"])
+        assert dump["cycles"] and dump["cycles"][0]["cycle"] == 1
+
+
+class TestBackoffWindowTable:
+    """Recorded `window_ms` == the PR 9 deterministic charge, exactly."""
+
+    def _expected_window(self, cluster, uid, attempt):
+        base = min(
+            cluster.backoff_initial_ms * (1 << min(attempt - 1, 30)),
+            cluster.backoff_max_ms,
+        )
+        return int(
+            base * (0.5 + 0.5 * cluster._backoff_jitter(uid, attempt))
+        )
+
+    def test_window_table_attempts_1_through_12(self):
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0"))
+            cluster.add_pod(mkpod("p"))
+            uid = "default/p"
+            for attempt in range(1, 13):
+                cluster.mark_unschedulable(uid, now_ms=attempt * 10_000_000)
+        rec = led._records[uid]
+        got = [
+            (e[4]["attempt"], e[4]["window_ms"])
+            for e in rec.events if e[3] == ev.LIFECYCLE_UNSCHEDULABLE
+        ]
+        cluster2 = Cluster()  # same seed default: formula is process-free
+        want = [
+            (n, self._expected_window(cluster2, uid, n))
+            for n in range(1, 13)
+        ]
+        assert got == want
+        # the cap engages within the table (attempt windows stop doubling)
+        caps = [w for _n, w in got][-2:]
+        assert all(w <= cluster2.backoff_max_ms for w in caps)
+
+    def test_same_now_remark_charges_one_attempt(self):
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0"))
+            cluster.add_pod(mkpod("p"))
+            cluster.mark_unschedulable("default/p", now_ms=5_000)
+            cluster.mark_unschedulable("default/p", now_ms=5_000)
+        rec = led._records["default/p"]
+        events = [e for e in rec.events if e[3] == ev.LIFECYCLE_UNSCHEDULABLE]
+        assert len(events) == 1 and events[0][4]["attempt"] == 1
+
+
+class TestGangSpans:
+    """Ledger gang_wait spans vs the quality plane's admission metric."""
+
+    def _quorum_scenario(self):
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0", cpu=2000))
+            cluster.add_pod_group(
+                PodGroup(name="g", namespace="default", min_member=3)
+            )
+            for i in range(3):
+                cluster.add_pod(
+                    mkpod(f"m{i}", cpu=1000, gang="g", creation_ms=i)
+                )
+            sched = Scheduler(Profile(plugins=[
+                NodeResourcesAllocatable(),
+                Coscheduling(permit_waiting_seconds=300,
+                             reject_percentage=100),
+            ]))
+            run_cycle(sched, cluster, now=1000)   # 2 reserve, no quorum
+            cluster.add_node(mknode("n1", cpu=2000))
+            run_cycle(sched, cluster, now=2000)   # third fits: all bind
+        return led
+
+    def test_reserved_members_accumulate_gang_wait(self):
+        led = self._quorum_scenario()
+        assert led.pods_bound == 3
+        assert led.decomposition_errors() == []
+        reserved, waited = 0, 0
+        for rec in led._retired:
+            kinds = [e[3] for e in rec.events]
+            assert kinds[-1] == ev.LIFECYCLE_BOUND
+            if ev.LIFECYCLE_RESERVED in kinds:
+                reserved += 1
+                if rec.stages.get("gang_wait", 0) > 0:
+                    waited += 1
+        assert reserved == 2  # the two that got Permit Wait in cycle 1
+        assert waited == reserved  # both sat in gang_wait across the gap
+
+    def test_admission_wait_agrees_with_quality_metric(self):
+        from scheduler_plugins_tpu.tuning.quality import (
+            gang_admission_latency,
+        )
+
+        led = self._quorum_scenario()
+        members = sorted(r.uid for r in led._retired)
+        # rebuild the (gang_names, gang, assignment, wait) corpus the
+        # quality metric consumes FROM LEDGER EVENTS: reserved ->
+        # placed-but-waiting, bound -> placed-and-released
+        n_cycles = max(e[0] for r in led._retired for e in r.events)
+        corpus = []
+        for c in range(1, n_cycles + 1):
+            assignment, wait = [], []
+            for uid in members:
+                rec = next(r for r in led._retired if r.uid == uid)
+                kinds = {e[3] for e in rec.events if e[0] == c}
+                if ev.LIFECYCLE_BOUND in kinds:
+                    assignment.append(0)
+                    wait.append(False)
+                elif ev.LIFECYCLE_RESERVED in kinds:
+                    assignment.append(0)
+                    wait.append(True)
+                else:
+                    assignment.append(-1)
+                    wait.append(False)
+            corpus.append(
+                (["default/g"], [0] * len(members), assignment, wait)
+            )
+        admitted = gang_admission_latency(corpus)
+        # ledger-derived wait: first cycle that SCHEDULED the gang (the
+        # FirstSeen events are ambient — pre-cycle ingest) -> bind cycle
+        first = min(
+            e[0] for r in led._retired for e in r.events
+            if e[3] != ev.LIFECYCLE_FIRST_SEEN
+        )
+        bound_cycle = max(
+            e[0] for r in led._retired for e in r.events
+            if e[3] == ev.LIFECYCLE_BOUND
+        )
+        assert admitted == {"default/g": bound_cycle - first}
+        assert admitted["default/g"] == 1  # waited exactly one cycle
+
+
+class TestEngineSequenceIdentity:
+    """Serial vs pipelined: identical event sequences on one stream."""
+
+    def _drive(self, use_pipeline):
+        led = Ledger()
+        with use_for(led.start()):
+            cluster = Cluster()
+            for i in range(2):
+                cluster.add_node(mknode(f"n{i}"))
+            sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+            pipe = PipelinedCycle(sched, cluster) if use_pipeline else None
+            waves = [
+                [mkpod(f"a{i}", cpu=500, creation_ms=10 + i)
+                 for i in range(3)],
+                [mkpod("big", cpu=10**9, creation_ms=20)],
+                [mkpod(f"b{i}", cpu=500, creation_ms=30 + i)
+                 for i in range(2)],
+                [],
+            ]
+            now = 1000
+            for wave in waves:
+                for p in wave:
+                    cluster.add_pod(p)
+                if pipe is None:
+                    run_cycle(sched, cluster, now=now)
+                else:
+                    pipe.tick(now=now)
+                    pipe.flush()
+                now += 1000
+            if pipe is not None:
+                pipe.close()
+        return led
+
+    def test_sequences_identical_and_blamed(self):
+        serial = self._drive(use_pipeline=False)
+        piped = self._drive(use_pipeline=True)
+        s_seq, p_seq = serial.sequence(), piped.sequence()
+        assert s_seq, "scenario produced no events"
+        assert s_seq == p_seq
+        # blame attribution survived the pipelined deferred-finalize path
+        blames = [
+            dict(detail)["by"]
+            for _c, _l, _s, _uid, kind, detail in p_seq
+            if kind == ev.LIFECYCLE_UNSCHEDULABLE
+        ]
+        assert blames and all(b == "NodeResourcesFit" for b in blames)
+        assert serial.decomposition_errors() == []
+        assert piped.decomposition_errors() == []
+
+    def test_disabled_ledger_records_nothing(self):
+        led = Ledger()  # never started
+        with use_for(led):
+            cluster = Cluster()
+            cluster.add_node(mknode("n0"))
+            cluster.add_pod(mkpod("p"))
+            sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+            run_cycle(sched, cluster, now=1000)
+        assert led.sequence() == []
+        assert led.pods_bound == 0

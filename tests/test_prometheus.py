@@ -1,0 +1,222 @@
+"""Prometheus text exposition (0.0.4) conformance for `Metrics`.
+
+A strict parser over `prometheus_text()` output: every family carries
+`# HELP` + `# TYPE` before its first sample, histogram families render
+the full cumulative `_bucket{le=...}` ladder plus `_sum`/`_count`,
+counter/gauge typing follows the naming contract, label values escape
+per the spec, and no scrape ever contains duplicate samples.  The
+`/metrics.json` route serves `snapshot()` over the same registry the
+`/metrics` route renders — the parity tests pin the two views to each
+other so a dashboard reading JSON and an alert reading prometheus can
+never disagree."""
+
+import math
+import re
+
+import pytest
+
+from scheduler_plugins_tpu.utils import observability as obs
+from scheduler_plugins_tpu.utils.observability import (
+    HIST_BUCKETS_MS,
+    Metrics,
+)
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})? (?P<value>\S+)$'
+)
+_LABEL = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"')
+
+
+def parse_exposition(text: str):
+    """Strict 0.0.4 parse: returns (samples, types, helps) or raises."""
+    samples = []  # (name, labels-tuple, float value)
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            _, _, rest = ln.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps[name] = help_text
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary"), kind
+            assert name in helps, f"TYPE before HELP for {name}"
+            types[name] = kind
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln!r}"
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        raw = m.group("labels")
+        labels = []
+        if raw:
+            consumed = _LABEL.sub("", raw).replace(",", "")
+            assert consumed == "", f"bad label syntax in {ln!r}"
+            labels = [
+                (lm.group("k"), lm.group("v"))
+                for lm in _LABEL.finditer(raw)
+            ]
+        value = float(m.group("value").replace("+Inf", "inf"))
+        samples.append((m.group("name"), tuple(labels), value))
+    # every sample belongs to a family that declared HELP + TYPE
+    for name, _labels, _v in samples:
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or family in types, (
+            f"sample {name} has no TYPE"
+        )
+    assert len(set(samples)) == len(samples), "duplicate samples in scrape"
+    return samples, types, helps
+
+
+def fam(samples, name):
+    return [(s for s in samples if s[0] == name)]
+
+
+@pytest.fixture
+def registry():
+    m = Metrics()
+    m.inc(obs.PODS_BOUND, 7)
+    m.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin="Coscheduling")
+    m.set_gauge("scheduler_resident_generation", 42)
+    m.observe_ms(obs.E2E_SCHEDULING_MS, 3.0, priority="0")
+    m.observe_ms(obs.E2E_SCHEDULING_MS, 30.0, priority="0")
+    m.observe_ms(obs.E2E_SCHEDULING_MS, 7.5, priority="10")
+    m.observe_ms(obs.POD_SCHEDULING_SLI_MS, 1.5, stage="queue_wait")
+    m.observe_ms("scheduler_binding_ms", 4.0)  # unlabeled: legacy mirrors
+    return m
+
+
+class TestConformance:
+    def test_parses_strictly(self, registry):
+        samples, types, helps = parse_exposition(registry.prometheus_text())
+        assert samples and types and helps
+
+    def test_every_family_has_help_and_type(self, registry):
+        samples, types, helps = parse_exposition(registry.prometheus_text())
+        assert set(types) == set(helps)
+        # known names carry the curated HELP text, not the fallback
+        assert "upstream" in helps[obs.E2E_SCHEDULING_MS]
+
+    def test_counter_gauge_typing_contract(self, registry):
+        _s, types, _h = parse_exposition(registry.prometheus_text())
+        assert types[obs.PODS_BOUND] == "counter"
+        assert types[obs.UNSCHEDULABLE_BY_PLUGIN] == "counter"
+        assert types["scheduler_resident_generation"] == "gauge"
+        assert types[obs.E2E_SCHEDULING_MS] == "histogram"
+
+    def test_histogram_renders_full_cumulative_ladder(self, registry):
+        samples, types, _h = parse_exposition(registry.prometheus_text())
+        name = obs.E2E_SCHEDULING_MS
+        for prio, want_count, want_sum in (("0", 2, 33.0), ("10", 1, 7.5)):
+            buckets = [
+                (dict(labels)["le"], v) for n, labels, v in samples
+                if n == f"{name}_bucket" and dict(labels)["priority"] == prio
+            ]
+            les = [b for b, _ in buckets]
+            assert les == [f"{b:g}" for b in HIST_BUCKETS_MS] + ["+Inf"]
+            counts = [v for _b, v in buckets]
+            assert counts == sorted(counts), "buckets must be cumulative"
+            assert counts[-1] == want_count
+            (total,) = [
+                v for n, labels, v in samples
+                if n == f"{name}_count" and dict(labels)["priority"] == prio
+            ]
+            (ssum,) = [
+                v for n, labels, v in samples
+                if n == f"{name}_sum" and dict(labels)["priority"] == prio
+            ]
+            assert total == want_count and ssum == want_sum
+
+    def test_legacy_count_mirror_not_double_scraped(self, registry):
+        # observe_ms keeps scheduler_binding_ms_count as a legacy counter
+        # key; the scrape must carry it ONLY as the histogram _count child
+        samples, _t, _h = parse_exposition(registry.prometheus_text())
+        count_samples = [
+            s for s in samples if s[0] == "scheduler_binding_ms_count"
+        ]
+        assert len(count_samples) == 1
+        assert count_samples[0][2] == 1.0
+
+    def test_label_escaping(self):
+        m = Metrics()
+        hostile = 'a"b\\c\nd'
+        m.inc(obs.UNSCHEDULABLE_BY_PLUGIN, plugin=hostile)
+        text = m.prometheus_text()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        samples, _t, _h = parse_exposition(text)
+        (sample,) = [s for s in samples if s[0] == obs.UNSCHEDULABLE_BY_PLUGIN]
+        # the parser's unescape round-trips the hostile value
+        raw = dict(sample[1])["plugin"]
+        unescaped = raw.replace("\\n", "\n").replace('\\"', '"')
+        unescaped = unescaped.replace("\\\\", "\\")
+        assert unescaped == hostile
+
+    def test_help_text_escaping(self):
+        m = Metrics()
+        m.inc("scheduler_help_escape_probe_total")
+        try:
+            obs.HELP["scheduler_help_escape_probe_total"] = "line\nbreak\\x"
+            text = m.prometheus_text()
+        finally:
+            obs.HELP.pop("scheduler_help_escape_probe_total", None)
+        (help_line,) = [
+            ln for ln in text.splitlines()
+            if ln.startswith("# HELP scheduler_help_escape_probe_total")
+        ]
+        assert "\n" not in help_line and "\\n" in help_line
+        parse_exposition(text)
+
+
+class TestJsonParity:
+    """`/metrics.json` (snapshot) vs `/metrics` (prometheus_text): the
+    daemon serves both straight off this registry, so equality here IS
+    route parity."""
+
+    def test_every_counter_in_both_views(self, registry):
+        samples, _t, _h = parse_exposition(registry.prometheus_text())
+        rendered = {
+            (n, labels): v for n, labels, v in samples
+            if not n.endswith(("_bucket", "_sum"))
+        }
+        hist_counts = {
+            f"{name}_count" for name in registry.histograms()
+            for name in [name.split("{")[0]]
+        }
+        for key, value in registry.snapshot().items():
+            name = key.split("{")[0]
+            labels = tuple(_LABEL.findall(key[len(name):].strip("{}")))
+            if name in hist_counts and not labels:
+                # legacy unlabeled mirror: carried by the histogram child
+                assert rendered[(name, labels)] == value
+                continue
+            assert rendered[(name, labels)] == value, key
+
+    def test_histograms_in_both_views(self, registry):
+        samples, _t, _h = parse_exposition(registry.prometheus_text())
+        for key, h in registry.histograms().items():
+            name = key.split("{")[0]
+            labels = tuple(_LABEL.findall(key[len(name):].strip("{}")))
+            (count,) = [
+                v for n, ls, v in samples
+                if n == f"{name}_count" and ls == labels
+            ]
+            (ssum,) = [
+                v for n, ls, v in samples
+                if n == f"{name}_sum" and ls == labels
+            ]
+            assert count == h["count"]
+            assert math.isclose(ssum, h["sum"])
+
+    def test_global_registry_scrape_stays_parseable(self):
+        # whatever state earlier tests left in the process-global
+        # registry, the scrape must parse strictly and agree with JSON
+        samples, _t, _h = parse_exposition(obs.metrics.prometheus_text())
+        snap = obs.metrics.snapshot()
+        assert len(samples) >= len(snap) - len(obs.metrics.histograms())

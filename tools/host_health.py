@@ -1,0 +1,109 @@
+"""Host-health probe: is this machine fit to produce trustworthy timings?
+
+Benchmark numbers taken on a sick host (hung accelerator tunnel, load
+spike from a co-tenant, thermal throttle) look exactly like code
+regressions.  This probe produces one JSON line capturing the two
+signals we have learned to distrust first (see CLAUDE.md "TPU
+gotchas"):
+
+  * a small timed matmul forced through a host transfer
+    (``np.asarray`` — ``block_until_ready`` can return early through
+    the axon tunnel), run in a daemon thread under a hard timeout so
+    a dead tunnel reports ``probe_timeout`` instead of hanging the
+    caller; and
+  * 1-minute loadavg normalised by CPU count.
+
+``make verify`` prints this line before the suite so every archived
+log is self-describing, and tools/perf_sentry.py uses the same
+``probe()`` to downgrade "regression" verdicts to "degraded-host"
+when the host itself cannot be trusted.  rc is always 0 — a sick
+host is a finding, not a failure of the probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+# Matmul wall-time above this (ms) marks the host degraded: on a healthy
+# CPU backend an 8x8 float32 matmul plus transfer is far under 1s even
+# with cold jit; multi-second times mean a wedged tunnel or a host under
+# severe load.  Kept deliberately loose — the probe must never flag a
+# merely busy-but-fine machine.
+MATMUL_DEGRADED_MS = 2000.0
+# 1-minute loadavg per core above this marks the host loaded.
+LOAD_DEGRADED_PER_CPU = 4.0
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def _timed_matmul(out: dict) -> None:
+    import numpy as np
+    import jax.numpy as jnp
+
+    t0 = time.monotonic()
+    # Host transfer, not block_until_ready: see CLAUDE.md TPU gotchas.
+    res = np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    out["matmul_ms"] = (time.monotonic() - t0) * 1000.0
+    out["matmul_ok"] = bool(abs(float(res[0][0]) - 8.0) < 1e-6)
+
+
+def probe(timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    """Return a host-health dict; never raises, never hangs past timeout_s."""
+    out: dict = {
+        "probe": "host_health",
+        "matmul_ms": None,
+        "matmul_ok": False,
+        "timeout_s": timeout_s,
+    }
+    th = threading.Thread(
+        target=_timed_matmul, args=(out,), daemon=True,
+        name="host-health-probe",
+    )
+    t0 = time.monotonic()
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        out["error"] = "probe_timeout"
+        out["matmul_ms"] = (time.monotonic() - t0) * 1000.0
+    try:
+        la1, la5, la15 = os.getloadavg()
+    except OSError:  # pragma: no cover - platform without getloadavg
+        la1 = la5 = la15 = -1.0
+    ncpu = os.cpu_count() or 1
+    out["loadavg_1m"] = round(la1, 3)
+    out["loadavg_5m"] = round(la5, 3)
+    out["cpu_count"] = ncpu
+    out["load_per_cpu"] = round(la1 / ncpu, 4) if la1 >= 0 else None
+
+    reasons = []
+    if not out["matmul_ok"]:
+        reasons.append(out.get("error", "matmul_failed"))
+    elif out["matmul_ms"] is not None and out["matmul_ms"] > MATMUL_DEGRADED_MS:
+        reasons.append("matmul_slow")
+    if out["load_per_cpu"] is not None and out["load_per_cpu"] > LOAD_DEGRADED_PER_CPU:
+        reasons.append("load_high")
+    out["healthy"] = not reasons
+    out["reasons"] = reasons
+    if out["matmul_ms"] is not None:
+        out["matmul_ms"] = round(out["matmul_ms"], 3)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--timeout", type=float, default=DEFAULT_TIMEOUT_S,
+        help="seconds to wait for the timed matmul before declaring the "
+             "accelerator tunnel dead (default %(default)s)")
+    args = ap.parse_args(argv)
+    print(json.dumps(probe(args.timeout), sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

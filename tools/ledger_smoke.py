@@ -1,0 +1,200 @@
+"""Ledger smoke gate: overhead bound, exact decomposition, engine identity.
+
+One JSON line, rc 1 on failure.  Three properties of the pod-lifecycle
+ledger (scheduler_plugins_tpu/obs/ledger.py) are checked end-to-end:
+
+1. **Overhead** — ledger-on vs ledger-off cycles are timed as
+   interleaved pairs (the tools/replay.py smoke discipline: drift hits
+   both arms of a pair equally, so the statistic is the MEDIAN OF
+   PAIRED deltas, and the bound is max(2%, the off series' own p10-p90
+   spread) — overhead below the run's jitter is not attributable to
+   the ledger).
+
+2. **Decomposition** — for every pod the ledger retires, the recorded
+   stage times must sum exactly to the pod's end-to-end latency
+   (telescoping integer-ns accounting makes this an identity, and this
+   gate keeps it one).
+
+3. **Engine identity** — the same churn scenario driven through serial
+   ``run_cycle`` and through ``PipelinedCycle`` must produce
+   event-SEQUENCE-identical ledgers: same (cycle, lane, seq, uid,
+   kind, detail) tuples in the same order.  Stamps may differ; order
+   and attribution may not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE_SHAPE = dict(n_gangs=4, gang_size=8, n_nodes=64)
+SMOKE_RUNS = 17
+BOUND_PCT = 2.0
+
+
+def _overhead() -> dict:
+    import bench
+    from scheduler_plugins_tpu.framework import Profile, Scheduler, run_cycle
+    from scheduler_plugins_tpu.obs import ledger as podledger
+
+    _, plugins, _ = bench.config_problem(4, shape=SMOKE_SHAPE)
+    scheduler = Scheduler(Profile(plugins=plugins))
+
+    def one_cycle():
+        cluster, _p, _ = bench.config_problem(4, shape=SMOKE_SHAPE)
+        start = time.perf_counter()
+        run_cycle(scheduler, cluster, now=1000)
+        return time.perf_counter() - start
+
+    one_cycle()  # compile warmup: later cycles hit the jit cache
+    # ledger-path warmup: first enabled cycle pays one-time lazy costs
+    prev = podledger.use(podledger.Ledger().start())
+    one_cycle()
+    podledger.use(prev)
+
+    off, on, pair_pct = [], [], []
+    decomposition_errors = 0
+    retired = 0
+    for _ in range(SMOKE_RUNS):
+        t_off = one_cycle()
+        off.append(t_off)
+        led = podledger.Ledger()
+        prev = podledger.use(led.start())
+        try:
+            t_on = one_cycle()
+        finally:
+            podledger.use(prev)
+        on.append(t_on)
+        pair_pct.append(100.0 * (t_on - t_off) / t_off)
+        decomposition_errors += len(led.decomposition_errors())
+        retired += led.pods_bound
+
+    median_off = sorted(off)[len(off) // 2]
+    median_on = sorted(on)[len(on) // 2]
+    overhead_pct = sorted(pair_pct)[len(pair_pct) // 2]
+    off_sorted = sorted(off)
+    spread_pct = 100.0 * (
+        off_sorted[int(0.9 * (len(off) - 1))]
+        - off_sorted[int(0.1 * (len(off) - 1))]
+    ) / median_off
+    bound = max(float(os.environ.get("SPT_LEDGER_BOUND_PCT", BOUND_PCT)),
+                spread_pct)
+    return {
+        "off_cycle_ms": round(median_off * 1000, 2),
+        "on_cycle_ms": round(median_on * 1000, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": round(bound, 2),
+        "noise_floor_pct": round(spread_pct, 2),
+        "pods_bound": retired,
+        "decomposition_errors": decomposition_errors,
+        "overhead_ok": overhead_pct <= bound,
+        "decomposition_ok": decomposition_errors == 0 and retired > 0,
+    }
+
+
+def _churn_scenario(drive) -> "Ledger":
+    """Run the shared churn scenario under a fresh ledger via ``drive``,
+    a callable (cluster, scheduler, now, add_pods) -> None per cycle."""
+    from scheduler_plugins_tpu.api.objects import Container, Node, Pod
+    from scheduler_plugins_tpu.api.resources import CPU, MEMORY, PODS
+    from scheduler_plugins_tpu.framework import Profile, Scheduler
+    from scheduler_plugins_tpu.obs import ledger as podledger
+    from scheduler_plugins_tpu.plugins import NodeResourcesAllocatable
+    from scheduler_plugins_tpu.state.cluster import Cluster
+
+    gib = 1 << 30
+    c = Cluster()
+    for i in range(4):
+        c.add_node(Node(name=f"n{i}",
+                        allocatable={CPU: 16_000, MEMORY: 64 * gib,
+                                     PODS: 110}))
+
+    def pod(name, cpu=500, created=0):
+        return Pod(name=name, creation_ms=created,
+                   containers=[Container(requests={CPU: cpu, MEMORY: gib})])
+
+    sched = Scheduler(Profile(plugins=[NodeResourcesAllocatable()]))
+    led = podledger.Ledger()
+    prev = podledger.use(led.start())
+    try:
+        waves = [
+            [pod(f"a{i}", created=10 + i) for i in range(3)],
+            [pod("big", cpu=50_000, created=20)],  # never fits: blamed
+            [pod(f"b{i}", created=30 + i) for i in range(2)],
+            [],
+        ]
+        now = 1000
+        for wave in waves:
+            drive(c, sched, now, wave)
+            now += 1000
+    finally:
+        podledger.use(prev)
+    return led
+
+
+def _identity() -> dict:
+    from scheduler_plugins_tpu.framework import PipelinedCycle, run_cycle
+
+    def serial_drive(c, sched, now, wave):
+        for p in wave:
+            c.add_pod(p)
+        run_cycle(sched, c, now=now)
+
+    pipes: dict = {}
+
+    def pipe_drive(c, sched, now, wave):
+        pipe = pipes.setdefault(id(c), PipelinedCycle(sched, c))
+        for p in wave:
+            c.add_pod(p)
+        pipe.tick(now=now)
+        pipe.flush()
+
+    serial_led = _churn_scenario(serial_drive)
+    pipe_led = _churn_scenario(pipe_drive)
+    for pipe in pipes.values():
+        pipe.close()
+
+    s_seq, p_seq = serial_led.sequence(), pipe_led.sequence()
+    first_diff = None
+    for i, (a, b) in enumerate(zip(s_seq, p_seq)):
+        if a != b:
+            first_diff = {"index": i, "serial": a, "pipelined": b}
+            break
+    return {
+        "serial_events": len(s_seq),
+        "pipelined_events": len(p_seq),
+        "sequence_identical": s_seq == p_seq,
+        "first_divergence": first_diff,
+        "serial_decomposition_errors": len(serial_led.decomposition_errors()),
+        "pipelined_decomposition_errors": len(pipe_led.decomposition_errors()),
+    }
+
+
+def main() -> int:
+    import bench
+
+    bench.apply_platform_override()
+    overhead = _overhead()
+    ident = _identity()
+    ok = (
+        overhead["overhead_ok"]
+        and overhead["decomposition_ok"]
+        and ident["sequence_identical"]
+        and ident["serial_decomposition_errors"] == 0
+        and ident["pipelined_decomposition_errors"] == 0
+    )
+    print(json.dumps({
+        "metric": "ledger_smoke",
+        **overhead,
+        **ident,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

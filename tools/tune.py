@@ -181,15 +181,15 @@ def cmd_tune(args) -> int:
     scheduler = corpus[0][1]
     base = [int(p.weight) for p in scheduler.profile.plugins]
     W = sweep.candidate_weights(base, args.candidates, seed=args.seed)
-    miss0 = obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve")
+    # scoped registry view: count only the compiles THIS sweep causes,
+    # not whatever the corpus replay above already accumulated
+    scope = obs.metrics.scoped()
     # the gate/rank/disqualify body shared with the online shadow lane
     # (tuning.promotion — ONE copy of the acceptance rules)
     verdict = promotion.evaluate_candidates(
         _promotion_corpus(corpus), W, args.tolerance
     )
-    sweep_compiles = (
-        obs.metrics.get(obs.JIT_CACHE_MISS, program="sweep_solve") - miss0
-    )
+    sweep_compiles = scope.get(obs.JIT_CACHE_MISS, program="sweep_solve")
     best = verdict.best
 
     out = {
