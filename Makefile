@@ -12,10 +12,12 @@ test: host-health
 
 # one host-health JSON line (timed matmul under timeout + loadavg) so
 # every archived suite log is self-describing about the machine it ran
-# on; the same probe() stamps tools/perf_sentry.py verdicts
+# on; the same probe() stamps tools/perf_sentry.py verdicts. --cost-arm
+# attaches the committed static-cost digest (docs/cost_model.json): a
+# degraded host still carries one trustworthy perf statement
 .PHONY: host-health
 host-health:
-	JAX_PLATFORMS=cpu $(PY) tools/host_health.py
+	JAX_PLATFORMS=cpu $(PY) tools/host_health.py --cost-arm
 
 .PHONY: bench
 bench:
@@ -190,20 +192,39 @@ lane-smoke:
 ledger-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/ledger_smoke.py
 
-# CI bench-regression sentry gate (ISSUE 19): on really-measured
+# CI bench-regression sentry gate (ISSUE 19 + 20): on really-measured
 # timings, a reshuffle stays quiet (paired-sorted deltas are exactly
 # zero), an injected uniform slowdown is flagged, an unhealthy host
 # probe downgrades regression -> degraded-host, and the committed
-# degenerate BENCH history classifies as no-baseline
+# degenerate BENCH history classifies as no-baseline; the cost arm's
+# two-arm split is proven on the same run (an injected algorithmic cost
+# regression stays `regression` under the simulated sick host where the
+# timing arm downgrades, and a zero cost delta stays quiet)
 .PHONY: sentry-smoke
 sentry-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_sentry.py selftest
+
+# compiled-cost observatory (ISSUE 20): CPU-compile the full program
+# registry, record XLA cost/memory analyses joined with the TPU op
+# histograms + collective census + VMEM envelopes, project rooflines,
+# refresh docs/cost_model.json only on a fully clean run (budgets carry
+# forward; re-derive explicitly with --rebudget)
+.PHONY: cost-audit
+cost-audit:
+	$(PY) tools/cost_observatory.py
+
+# read-only CI gate: re-measure and fail closed on missing manifest,
+# coverage gap, budget breach, or cost-digest drift (digest equality
+# enforced only under the manifest's jax version)
+.PHONY: cost-audit-check
+cost-audit-check:
+	$(PY) tools/cost_observatory.py --check
 
 # verify composes the READ-ONLY gates (tpu-lower-check, jaxpr-audit-check):
 # it must never rewrite the committed manifests as a side effect —
 # refreshing digests is the explicit `make tpu-lower` / `make jaxpr-audit`
 .PHONY: verify
-verify: test multichip lint tpu-lower-check jaxpr-audit-check kernel-audit-check race-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke ledger-smoke sentry-smoke
+verify: test multichip lint tpu-lower-check jaxpr-audit-check kernel-audit-check race-audit-check cost-audit-check race-smoke sanitize-smoke trace-smoke replay-smoke churn-smoke shard-smoke pallas-smoke tune-smoke tune-live-smoke chaos-smoke gang-smoke endurance-smoke pack-smoke lane-smoke ledger-smoke sentry-smoke
 
 .PHONY: lint
 lint:

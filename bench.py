@@ -258,6 +258,67 @@ def _quality_state(alloc, used, node_mask=None):
     return {k: round(v, 4) for k, v in q.items()}
 
 
+#: bench metric -> (registered cost-model program, pods per compiled solve)
+#: for the cost-digest column (ISSUE 20). Only configs whose EXACT solve
+#: program is in the tools/tpu_lower.py registry (and therefore in
+#: docs/cost_model.json) get a digest — the comparison arms (configs
+#: 8-15) and the batch modes run shapes the registry doesn't pin, so
+#: their columns stay null rather than borrow a near-miss digest.
+COST_PROGRAMS = {
+    "tpu_smoke_pods_per_sec": ("bench_cfg0_tpu_smoke", 256),
+    "pods_scheduled_per_sec": ("bench_cfg1_flagship", 8192),
+    "trimaran_pods_per_sec": ("bench_cfg2_trimaran_sequential", 2048),
+    "numa_pods_per_sec": ("bench_cfg3_numa_sequential", 512),
+    "gang_quota_pods_per_sec": ("bench_cfg4_gang_quota_sequential", 2048),
+    "network_pods_per_sec": ("bench_cfg5_network_sequential", 1024),
+    # per-chunk program: the north-star metric counts all 102400 pods but
+    # the compiled solve (and its roofline floor) is one 8192-pod chunk
+    "north_star_pods_per_sec": ("bench_cfg6_north_star_chunk", 8192),
+}
+
+_COST_MANIFEST_CACHE: list = [False, None]
+
+
+def _cost_columns(metric, pods_per_sec=None):
+    """The two static-cost columns every bench line carries (ISSUE 20):
+    the solve program's `cost_digest` from docs/cost_model.json (a
+    comparable trajectory point even on tunnel-dead rounds — the digest
+    is a pure function of the committed tree) and `roofline_calibration`,
+    the measured step time over the TPU roofline FLOOR for one solve of
+    the registered program. The floor uses spec-sheet peaks, so the
+    ratio is large by construction; its labeled backend says whether it
+    was CPU-calibrated (every committed round so far) — a calibration,
+    never a TPU claim. Null-safe: unknown metric, missing manifest, or
+    no measured value all degrade to nulls, never an exception."""
+    cols = {"cost_digest": None, "roofline_calibration": None}
+    entry = COST_PROGRAMS.get(metric)
+    if entry is None:
+        return cols
+    if _COST_MANIFEST_CACHE[0] is False:
+        try:
+            from scheduler_plugins_tpu.obs import costmodel
+
+            _COST_MANIFEST_CACHE[1] = costmodel.load_manifest()
+        except Exception:
+            _COST_MANIFEST_CACHE[1] = None
+        _COST_MANIFEST_CACHE[0] = True
+    program, pods_per_solve = entry
+    row = (_COST_MANIFEST_CACHE[1] or {}).get("programs", {}).get(program)
+    if not row:
+        return cols
+    cols["cost_digest"] = row.get("cost_digest")
+    floor_us = (row.get("roofline") or {}).get("step_floor_us")
+    if pods_per_sec and floor_us:
+        measured_us = pods_per_solve / pods_per_sec * 1e6
+        cols["roofline_calibration"] = {
+            "measured_over_floor": round(measured_us / floor_us, 2),
+            "floor_us": floor_us,
+            "target": (row.get("roofline") or {}).get("target"),
+            "backend": _backend_label(),
+        }
+    return cols
+
+
 def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
           drift=None, quality=None):
     """One JSON line. `vs_baseline` is the honest headline: measured against
@@ -290,6 +351,10 @@ def _emit(metric, pods_per_sec, detail, baseline, compiled=None, extra=None,
         # fragmentation/balance for the multi-cycle ones; None only when
         # no solve ran (error/stale-capture lines)
         "quality": quality,
+        # static-cost columns: the solve program's cost digest + the
+        # measured-vs-roofline calibration ratio (nulls for configs the
+        # registry doesn't pin)
+        **_cost_columns(metric, pods_per_sec),
     }
     if compiled is not None and compiled > 0:
         line["vs_baseline"] = round(pods_per_sec / compiled, 2)
@@ -4299,6 +4364,7 @@ def lane_smoke(min_ratio=1.5):
 LINE_SCHEMA_KEYS = (
     "metric", "value", "unit", "vs_baseline", "backend", "backend_probe",
     "devices", "mesh_shape", "drift", "quality", "pallas",
+    "cost_digest", "roofline_calibration",
 )
 
 
@@ -4306,13 +4372,18 @@ def error_line(config: int, mode: str, diagnosis: dict) -> dict:
     """The schema-complete no-capture error line for a sick backend —
     every `LINE_SCHEMA_KEYS` column present (quality/drift null: no solve
     ran), the structured probe verdict attached, rc stays 0 because the
-    environment is sick, not the code."""
+    environment is sick, not the code. The cost digest IS still stamped
+    (a pure function of the committed tree, valid with the tunnel dead)
+    so even all-error rounds contribute a comparable static trajectory
+    point; the calibration ratio is null — nothing was measured."""
+    metric = metric_name(config, mode)
     return {
-        "metric": metric_name(config, mode), "value": 0, "unit": "pods/s",
+        "metric": metric, "value": 0, "unit": "pods/s",
         "vs_baseline": 0.0, "backend": _backend_label(),
         "devices": None, "mesh_shape": None,
         "drift": None, "quality": None,
         "pallas": _pallas_attribution(),
+        **_cost_columns(metric),
         "error": "tpu-backend-unavailable",
         "backend_probe": diagnosis,
         "detail": f"{diagnosis['kind']}: {diagnosis['detail']}",
@@ -4334,6 +4405,10 @@ def stale_replay_line(replay: dict, diagnosis: dict) -> dict:
     # like backend_probe below: describes THIS run's pallas state, not
     # the capture's
     replay["pallas"] = _pallas_attribution()
+    # cost columns describe THIS tree's solve program (the comparable
+    # static trajectory point), not the capture's; the calibration ratio
+    # relates the replayed on-chip value to the current roofline floor
+    replay.update(_cost_columns(replay.get("metric"), replay.get("value")))
     replay.update({
         "stale_capture": True,
         "captured_unix": captured,

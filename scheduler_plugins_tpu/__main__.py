@@ -344,6 +344,13 @@ class HealthServer:
                         # `unknown` = running threads the lockset
                         # analysis never modeled
                         "threads": thread_topology(),
+                        # device-memory watermarks (obs.costmodel, ISSUE
+                        # 20): allocator bytes-in-use/peak stamped by the
+                        # last cycle; available=False on backends without
+                        # allocator stats (the CPU fallback), None before
+                        # the first cycle — the static counterpart is
+                        # docs/cost_model.json's per-program peak_bytes
+                        "memory": outer.last_memory,
                     }
                     if payload["threads"]["unknown"]:
                         obs.metrics.inc(
@@ -654,6 +661,7 @@ class Daemon:
         self.bound_total = 0
         self.last_pending = 0
         self.last_quality = None
+        self.last_memory = None  # /healthz device-memory block (ISSUE 20)
         self.parked_cycles = 0
         self._unposted: dict[str, str] = {}
         self.elector = None  # before HealthServer: /healthz reads it
@@ -839,6 +847,17 @@ class Daemon:
         self.bound_total += len(report.bound)
         if report.quality is not None:
             self.last_quality = report.quality
+        # device-memory watermark gauges: one allocator-stats read per
+        # cycle (no device sync, no transfer — inside the ≤ max(2%,
+        # jitter-floor) observability overhead bound, gated by
+        # tests/test_cost_observatory.py); null-safe on backends without
+        # allocator stats and on a mid-call tunnel death
+        try:
+            from scheduler_plugins_tpu.obs import costmodel
+
+            self.last_memory = costmodel.stamp_device_memory(obs.metrics)
+        except Exception:
+            self.last_memory = None
         return report
 
     def run(self):

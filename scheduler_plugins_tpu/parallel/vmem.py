@@ -46,6 +46,9 @@ __all__ = [
     "COMM_SLOTS",
     "RING_FAMILIES",
     "WORST_RING_COPIES",
+    "PEAK_FLOPS_PER_S",
+    "HBM_BYTES_PER_S",
+    "ROOFLINE_TARGETS",
     "ring_buffer_copies",
     "derive_max_election_elems",
     "max_election_elems",
@@ -64,6 +67,38 @@ VMEM_BUDGET_BYTES = {
 #: the audited lowering target (SPT_VMEM_TARGET to re-derive for another
 #: generation — the committed manifest pins the target it was written for)
 VMEM_TARGET = os.environ.get("SPT_VMEM_TARGET", "tpu_v4")
+
+# ---------------------------------------------------------------------------
+# Roofline peaks (ISSUE 20): ONE module owns all hardware numbers — the VMEM
+# budget above and the chip peaks below — so the kernel auditor and the
+# compiled-cost observatory (obs/costmodel.py) can never disagree about what
+# "the hardware" is. Public per-chip spec-sheet numbers; deliberately the
+# OPTIMISTIC peaks (dense-MXU bf16 FLOP/s, full HBM streams): the roofline
+# they induce is a step-time FLOOR, never an estimate. The solver programs
+# are int32/f64 vector work, so real chips land well above the floor — the
+# committed `roofline_calibration` column on bench lines measures by how
+# much, per backend.
+# ---------------------------------------------------------------------------
+
+#: peak dense FLOP/s per chip (bf16 MXU — the spec-sheet headline)
+PEAK_FLOPS_PER_S = {
+    "tpu_v4": 275e12,
+    "tpu_v5e": 197e12,
+    "tpu_v5p": 459e12,
+}
+
+#: HBM bandwidth, bytes/s per chip
+HBM_BYTES_PER_S = {
+    "tpu_v4": 1.2e12,
+    "tpu_v5e": 0.82e12,
+    "tpu_v5p": 2.765e12,
+}
+
+#: generations with a complete hardware row (VMEM budget + both peaks) —
+#: the set a roofline can be projected for
+ROOFLINE_TARGETS = tuple(
+    sorted(set(VMEM_BUDGET_BYTES) & set(PEAK_FLOPS_PER_S) & set(HBM_BYTES_PER_S))
+)
 
 #: 3-slot ring communication buffer (kernels._ring_call scratch): slot k%3
 #: receives while slot (k-1)%3 sends and the step k-1 buffer is folded

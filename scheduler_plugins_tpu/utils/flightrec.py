@@ -423,6 +423,11 @@ class FlightRecorder:
                 np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
                 obs.atomic_write(path, buf.getvalue())
                 written += 1
+        # sidecars land with the blobs, BEFORE the manifest: cycles.jsonl
+        # stays the last write so a crash mid-save never leaves a
+        # manifest naming missing data (gated by test_flightrec
+        # TestAtomicWrites)
+        self._save_cost_stamp(directory)
         manifest_path = os.path.join(directory, "cycles.jsonl")
         lines: list = []
         if os.path.exists(manifest_path):
@@ -444,6 +449,36 @@ class FlightRecorder:
             "ledger_pods": ledger_pods,
             "path": directory,
         }
+
+    @staticmethod
+    def _save_cost_stamp(directory: str) -> None:
+        """Stamp the committed static-cost provenance (docs/cost_model.json,
+        ISSUE 20) beside the cycle manifest: `cost.json` records the
+        manifest digest + per-program cost digests in force when the
+        bundle was written, so `tools/replay.py info` can flag "recorded
+        under a program with a different cost shape" instead of silently
+        replaying across an algorithmic change. A sidecar like
+        ledger.json — NOT a manifest field — because record digests
+        (`record_digest`) cover the cycle manifest, and provenance about
+        the surrounding tree must not churn the integrity digest of the
+        recorded data itself. Best-effort: no cost manifest, no stamp."""
+        from scheduler_plugins_tpu.obs import costmodel
+
+        manifest = costmodel.load_manifest()
+        if not manifest:
+            return
+        stamp = {
+            "manifest_digest": costmodel.manifest_digest(manifest),
+            "jax": manifest.get("jax"),
+            "programs": {
+                name: row.get("cost_digest")
+                for name, row in sorted(manifest.get("programs", {}).items())
+            },
+        }
+        obs.atomic_write(
+            os.path.join(directory, "cost.json"),
+            json.dumps(stamp, sort_keys=True),
+        )
 
     @staticmethod
     def _save_ledger_segment(directory: str) -> int:

@@ -449,6 +449,16 @@ POD_SCHEDULING_ATTEMPTS = "scheduler_pod_scheduling_attempts"
 #: decomposed into queue-wait / backoff-held / gang-wait / solve / fence /
 #: bind-flush buckets that provably sum to e2e per pod
 POD_SCHEDULING_SLI_MS = "scheduler_pod_scheduling_sli_duration_ms"
+#: gauge: device-memory bytes currently allocated across local devices
+#: (backend allocator stats, summed; absent on backends without stats —
+#: the CPU fallback — so the gauge simply never appears there). Stamped
+#: once per cycle by the daemon via obs.costmodel.stamp_device_memory.
+DEVICE_BYTES_IN_USE = "scheduler_device_bytes_in_use"
+#: gauge: device-memory high-water mark across local devices (allocator
+#: peak_bytes_in_use, summed) — the runtime companion of the STATIC peak
+#: in docs/cost_model.json: the committed manifest predicts, this gauge
+#: measures
+DEVICE_PEAK_BYTES = "scheduler_device_peak_bytes_in_use"
 
 #: `# HELP` registry for `prometheus_text` (exposition format 0.0.4
 #: requires families to be self-describing; families not listed here get
@@ -525,6 +535,10 @@ HELP: dict[str, str] = {
         "Per-stage share of pod scheduling latency in ms, labeled by "
         "stage (upstream scheduler_pod_scheduling_sli_duration_seconds, "
         "in ms, decomposed).",
+    DEVICE_BYTES_IN_USE:
+        "Device-memory bytes in use across local devices (gauge).",
+    DEVICE_PEAK_BYTES:
+        "Device-memory high-water mark across local devices (gauge).",
 }
 
 

@@ -1,0 +1,46 @@
+"""Golden known-bad for the compiled-COST budget rule (ISSUE 20): an
+accidental O(N*P) dense cross-product where an O(N+P) scan would do.
+
+The program is stylistically and semantically spotless — no banned
+primitive, no int64 matmul/cumsum, no closure-captured config, balanced
+effects, no Pallas kernel, int32 throughout so the exactness lattice has
+nothing to prove — so the AST linter (graft_lint), the jaxpr auditor,
+and the kernel auditor ALL stay silent on it, per the ANALYSIS.md
+division-of-labor discipline.  Only the measured cost census can see the
+bug: XLA's cost analysis counts the dense (P, N) intermediates, and the
+measured flops/bytes/peak blow past the budgets committed for the
+intended linear-cost implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: review-gated budgets for the INTENDED O(N + P) implementation (a
+#: sorted-segment scan touches each node and pod once: ~tens of KB).
+#: The dense regression below exceeds every one of them by >10x.
+BUDGETS = {
+    "flops": 20_000,
+    "bytes_accessed": 100_000,
+    "peak_bytes": 50_000,
+}
+
+
+def build():
+    N, P = 768, 512
+
+    def solve(free, req):
+        # the regression: a dense (P, N) fit/waste matrix — O(N*P) flops
+        # and bytes for a best-fit pick a segment scan computes in
+        # O(N + P).  The per-row argsort keeps Go-style first-index
+        # tie-breaking but forces the full matrix to materialize.
+        fits = req[:, None] <= free[None, :]
+        waste = jnp.where(
+            fits, free[None, :] - req[:, None], jnp.int32(1 << 30)
+        )
+        order = jnp.argsort(waste, axis=1, stable=True)
+        return order[:, 0].astype(jnp.int32)
+
+    free = jnp.asarray((np.arange(N) % 97 + 1).astype(np.int32))
+    req = jnp.asarray((np.arange(P) % 13 + 1).astype(np.int32))
+    return jax.jit(solve), (free, req), None

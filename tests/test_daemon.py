@@ -611,6 +611,7 @@ class TestThreadTopology:
 
         daemon = SimpleNamespace(
             cycles=0, bound_total=0, last_pending=0, last_quality=None,
+            last_memory=None,
             feed=SimpleNamespace(address=("127.0.0.1", 0)),
             resilience=None, parked_cycles=0, pipeline=None, laned=None,
             engine=None, tuner=None, elector=None,

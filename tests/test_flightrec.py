@@ -560,9 +560,9 @@ class TestAtomicWrites:
 
     def test_bundle_save_is_crash_safe_order(self, tmp_path, recorder_off,
                                              monkeypatch):
-        """Blobs land before the manifest: a save that dies mid-blobs
-        leaves no cycles.jsonl, so readers see 'no bundle', never a
-        manifest naming missing arrays."""
+        """Blobs (and the cost-stamp sidecar) land before the manifest:
+        a save that dies mid-blobs leaves no cycles.jsonl, so readers see
+        'no bundle', never a manifest naming missing arrays."""
         flightrec.recorder.start(capacity=1)
         run_cycle(make_scheduler(), make_cluster(), now=1000)
 
@@ -576,7 +576,9 @@ class TestAtomicWrites:
         monkeypatch.setattr(obs, "atomic_write", tracking)
         flightrec.recorder.save(str(tmp_path))
         assert calls[-1] == "cycles.jsonl"
-        assert all(c.endswith(".npy") for c in calls[:-1])
+        assert all(
+            c.endswith(".npy") or c == "cost.json" for c in calls[:-1]
+        )
 
 
 class TestCompileObservability:

@@ -59,6 +59,25 @@ def probe(timeout=75):
     return bench.backend_probe(timeout=timeout)
 
 
+def log_cost_arm():
+    """Print the deterministic cost-arm statement beside a sick-probe
+    verdict (ISSUE 20): a dead tunnel invalidates every timing this loop
+    would have captured, but the committed static-cost digest is still a
+    comparable trajectory point — and an algorithmic regression cannot
+    hide behind the sick box (check it with `perf_sentry.py cost`)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import host_health
+
+    arm = host_health.cost_arm_summary()
+    if arm is None:
+        log("[watch] cost arm: no committed cost manifest "
+            "(run `make cost-audit`)")
+    else:
+        log(f"[watch] cost arm: manifest {arm['manifest_digest'][:12]} "
+            f"({arm['programs']} programs, jax {arm['jax']}) — static "
+            "trajectory point valid despite sick host")
+
+
 def run_one(config, mode, timeout, trace_dir=None):
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--config", str(config)]
     if config in (2, 3, 4, 5):
@@ -93,6 +112,7 @@ def cycle():
         diagnosis = probe()
         if diagnosis is not None:
             log(f"[watch] probe sick before config {config}: {diagnosis}")
+            log_cost_arm()
             return good
         # on the first SUCCESSFUL flagship run, also dump a jax profiler
         # trace (op-level data for the next tuning round — VERDICT r4 item
@@ -135,6 +155,7 @@ def main():
                 return
         else:
             log(f"[watch] tunnel sick: {diagnosis}")
+            log_cost_arm()
         time.sleep(args.interval)
 
 

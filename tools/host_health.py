@@ -92,6 +92,41 @@ def probe(timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
     return out
 
 
+def cost_arm_summary() -> dict | None:
+    """The deterministic companion to a sick-host verdict (ISSUE 20):
+    a one-block summary of the committed static-cost manifest
+    (docs/cost_model.json).  Wall-clock numbers from this machine may be
+    garbage, but the cost manifest digest is a pure function of the
+    committed tree — so a degraded host still has a trustworthy perf
+    statement ("the cost shape is X") and an algorithmic regression
+    cannot hide behind (or be faked by) host sickness.  None when no
+    manifest is committed; never raises."""
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from scheduler_plugins_tpu.obs import costmodel
+
+        manifest = costmodel.load_manifest()
+        if not manifest:
+            return None
+        programs = manifest.get("programs", {})
+        return {
+            "arm": "cost",
+            "manifest_digest": costmodel.manifest_digest(manifest),
+            "programs": len(programs),
+            "static_only": sum(
+                1 for r in programs.values() if r.get("static_only")
+            ),
+            "jax": manifest.get("jax"),
+            "note": ("static cost is backend-independent: verdict a "
+                     "suspect change with `perf_sentry.py cost` even "
+                     "while this host is degraded"),
+        }
+    except Exception:
+        return None
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -100,8 +135,16 @@ def main(argv: list[str] | None = None) -> int:
         "--timeout", type=float, default=DEFAULT_TIMEOUT_S,
         help="seconds to wait for the timed matmul before declaring the "
              "accelerator tunnel dead (default %(default)s)")
+    ap.add_argument(
+        "--cost-arm", action="store_true",
+        help="attach the deterministic cost-arm summary "
+             "(docs/cost_model.json digest) so a degraded-host line "
+             "still carries a trustworthy perf statement")
     args = ap.parse_args(argv)
-    print(json.dumps(probe(args.timeout), sort_keys=True))
+    out = probe(args.timeout)
+    if args.cost_arm:
+        out["cost_arm"] = cost_arm_summary()
+    print(json.dumps(out, sort_keys=True))
     return 0
 
 

@@ -26,8 +26,18 @@ regressions.  The sentry therefore applies three disciplines:
    ``degraded-host`` (rc 0), not ``regression`` (rc 1): re-run when
    the machine recovers instead of blaming the commit.
 
+4. **The cost arm (ISSUE 20)** — wall-clock is only one witness.  XLA's
+   static cost census (docs/cost_model.json, tools/cost_observatory.py)
+   is a pure function of the compiled program: a cost delta between two
+   manifests has a ZERO noise floor, so the cost arm's ``regression`` is
+   never downgraded by a sick host — an injected algorithmic regression
+   is flagged even where the timing arm must say ``degraded-host``, and
+   a pure timing wobble with zero cost delta stays quiet.  ``selftest``
+   proves that exact split.
+
 Usage:
   python tools/perf_sentry.py check --history 'BENCH_r0*.json' --new run.json
+  python tools/perf_sentry.py cost --baseline old_cost_model.json
   python tools/perf_sentry.py selftest
 """
 
@@ -43,7 +53,10 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import host_health  # noqa: E402
+
+from scheduler_plugins_tpu.obs import costmodel  # noqa: E402
 
 MIN_BASELINE = 3
 DEFAULT_REL_THRESHOLD = 0.10
@@ -272,6 +285,106 @@ def check_series(history_samples: list[dict], new_samples: list[dict], *,
 
 
 # ---------------------------------------------------------------------------
+# The cost arm: deterministic verdicts from static cost manifests
+# ---------------------------------------------------------------------------
+
+#: combined-verdict severity order — cost "regression" outranks the
+#: timing arm's "degraded-host": a sick host can invalidate a timing
+#: but it cannot change a compiled program's static cost.
+VERDICT_ORDER = ("no-baseline", "improved", "ok", "degraded-host",
+                 "regression")
+
+
+def cost_verdict(base_row: dict | None, cand_row: dict | None, *,
+                 program: str = "unknown",
+                 health: dict | None = None) -> dict:
+    """Deterministic verdict for one program's static cost shape.
+
+    Compares the budgeted cost axes (flops, bytes accessed, peak bytes)
+    of two docs/cost_model.json rows.  The noise floor is EXACTLY zero:
+    any increase on any budgeted axis is a regression, any decrease an
+    improvement, digest-identical rows are quiet.  ``health`` is
+    accepted for interface symmetry with `verdict()` but deliberately
+    NEVER downgrades — that asymmetry is the whole point of the arm."""
+    out: dict = {"program": program, "arm": "cost", "noise_floor": 0.0}
+    if not base_row or not cand_row:
+        out["verdict"] = "no-baseline"
+        out["reason"] = "missing cost row (run tools/cost_observatory.py)"
+        return out
+    if base_row.get("cost_digest") == cand_row.get("cost_digest"):
+        out["verdict"] = "ok"
+        out["reason"] = "identical cost digest (zero cost delta)"
+        out["max_rel_delta"] = 0.0
+        return out
+    deltas = {}
+    for f in costmodel.BUDGET_FIELDS:
+        b, c = base_row.get(f), cand_row.get(f)
+        if b is None or c is None:
+            continue
+        deltas[f] = round((c - b) / b, 6) if b else (1.0 if c else 0.0)
+    if not deltas:
+        # static-only rows: the digest covers TPU StableHLO + collective
+        # census — a digest move with no CPU cost axes is still a shape
+        # change that must be reviewed, but has no magnitude to rank.
+        out["verdict"] = "regression"
+        out["reason"] = ("static-only cost shape changed (TPU digest or "
+                         "collective census drift)")
+        return out
+    worst_field = max(deltas, key=lambda f: deltas[f])
+    worst = deltas[worst_field]
+    out["deltas"] = deltas
+    out["max_rel_delta"] = worst
+    if worst > 0:
+        out["verdict"] = "regression"
+        out["reason"] = (f"{worst_field} grew {worst:+.1%}; static cost "
+                         "deltas have no noise floor — a sick host cannot "
+                         "explain this away")
+    elif any(d < 0 for d in deltas.values()):
+        out["verdict"] = "improved"
+        out["reason"] = f"cost shrank (worst axis {worst_field} {worst:+.1%})"
+    else:
+        out["verdict"] = "ok"
+        out["reason"] = "cost digest moved but budgeted axes are unchanged"
+    return out
+
+
+def cost_check(base_manifest: dict | None,
+               cand_manifest: dict | None) -> dict:
+    """Per-program cost verdicts between two cost manifests."""
+    base_p = (base_manifest or {}).get("programs", {})
+    cand_p = (cand_manifest or {}).get("programs", {})
+    verdicts = {
+        name: cost_verdict(base_p.get(name), cand_p.get(name), program=name)
+        for name in sorted(set(base_p) | set(cand_p))
+    }
+    if not verdicts:
+        verdicts["unknown"] = {
+            "program": "unknown", "arm": "cost", "verdict": "no-baseline",
+            "reason": "no cost manifests to compare",
+        }
+    worst = max((v["verdict"] for v in verdicts.values()),
+                key=VERDICT_ORDER.index)
+    return {
+        "sentry": "perf_sentry_cost_arm",
+        "overall": worst,
+        "jax_baseline": (base_manifest or {}).get("jax"),
+        "jax_candidate": (cand_manifest or {}).get("jax"),
+        "comparable_jax": (base_manifest or {}).get("jax")
+        == (cand_manifest or {}).get("jax"),
+        "verdicts": verdicts,
+    }
+
+
+def combine_arms(timing: str, cost: str) -> str:
+    """Two-arm combined verdict: worst of both by VERDICT_ORDER.  A cost
+    ``regression`` therefore overrides a timing ``degraded-host`` —
+    exactly the split the selftest proves — while a cost ``ok`` never
+    upgrades a timing regression (a runtime-only regression, e.g. a bad
+    donation pattern, is invisible to static cost)."""
+    return max((timing, cost), key=VERDICT_ORDER.index)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -288,6 +401,22 @@ def cmd_check(args) -> int:
         rel_threshold=args.rel_threshold, health=health)
     report["history_files"] = hist_paths
     report["new_files"] = new_paths
+    if args.cost_baseline:
+        cost = cost_check(
+            costmodel.load_manifest(args.cost_baseline),
+            costmodel.load_manifest(args.cost_candidate))
+        report["cost_arm"] = cost
+        report["timing_overall"] = report["overall"]
+        report["overall"] = combine_arms(report["overall"], cost["overall"])
+    print(json.dumps(report, sort_keys=True))
+    return 1 if report["overall"] == "regression" else 0
+
+
+def cmd_cost(args) -> int:
+    """Standalone cost-arm verdict between two cost manifests."""
+    report = cost_check(
+        costmodel.load_manifest(args.baseline),
+        costmodel.load_manifest(args.candidate))
     print(json.dumps(report, sort_keys=True))
     return 1 if report["overall"] == "regression" else 0
 
@@ -367,7 +496,39 @@ def cmd_selftest(args) -> int:
         rel_threshold=DEFAULT_REL_THRESHOLD, health=health_ok)
     no_baseline = (not usable) == (v_hist["overall"] == "no-baseline")
 
-    ok = quiet and flagged and downgraded and no_baseline
+    # 4. The two-arm split (ISSUE 20).  Same simulated sick host as 2b,
+    #    but the candidate carries an injected ALGORITHMIC regression: a
+    #    doubled flops/bytes cost shape (the accidental O(N*P) gather).
+    #    The timing arm must downgrade (it cannot trust this host); the
+    #    cost arm must still say regression (static cost has a zero
+    #    noise floor); the combined verdict must side with the cost arm.
+    base_cost = {"flops": 1_000_000, "bytes_accessed": 2_000_000,
+                 "peak_bytes": 500_000}
+    base_cost["cost_digest"] = costmodel.cost_digest(base_cost)
+    bad_cost = {"flops": base_cost["flops"] * 2,
+                "bytes_accessed": base_cost["bytes_accessed"] * 2,
+                "peak_bytes": base_cost["peak_bytes"]}
+    bad_cost["cost_digest"] = costmodel.cost_digest(bad_cost)
+    sick = {"healthy": False, "reasons": ["load_high"]}
+    v_cost_sick = cost_verdict(base_cost, bad_cost, program="selftest",
+                               health=sick)
+    split = (
+        v_degraded["verdict"] == "degraded-host"        # timing arm yields
+        and v_cost_sick["verdict"] == "regression"       # cost arm does not
+        and combine_arms(v_degraded["verdict"],
+                         v_cost_sick["verdict"]) == "regression"
+    )
+
+    # 4b. Pure timing wobble with ZERO cost delta stays quiet on the
+    #     cost arm: identical digests short-circuit to ok.
+    v_cost_same = cost_verdict(base_cost, dict(base_cost),
+                               program="selftest", health=sick)
+    cost_quiet = (v_cost_same["verdict"] == "ok"
+                  and v_cost_same["max_rel_delta"] == 0.0
+                  and combine_arms("ok", v_cost_same["verdict"]) == "ok")
+
+    ok = quiet and flagged and downgraded and no_baseline and split \
+        and cost_quiet
     print(json.dumps({
         "sentry": "perf_sentry_selftest",
         "ok": ok,
@@ -375,11 +536,14 @@ def cmd_selftest(args) -> int:
         "injection_flagged": flagged,
         "unhealthy_host_downgraded": downgraded,
         "degenerate_history_no_baseline": no_baseline,
+        "cost_arm_overrides_degraded_host": split,
+        "cost_arm_zero_delta_quiet": cost_quiet,
         "usable_history_samples": len(usable),
         "injected_factor": round(inject_factor, 6),
         "injection_scaled_to_host_noise": scaled,
         "injected_median_slowdown": v_inject.get("median_slowdown"),
         "noise_floor": v_inject.get("noise_floor"),
+        "cost_arm_max_rel_delta": v_cost_sick.get("max_rel_delta"),
     }, sort_keys=True))
     return 0 if ok else 1
 
@@ -400,7 +564,25 @@ def main(argv: list[str] | None = None) -> int:
                      help="skip the host-health probe stamp")
     chk.add_argument("--probe-timeout", type=float,
                      default=host_health.DEFAULT_TIMEOUT_S)
+    chk.add_argument("--cost-baseline",
+                     help="baseline docs/cost_model.json to run the "
+                          "deterministic cost arm against (combined "
+                          "verdict: cost regression overrides "
+                          "degraded-host)")
+    chk.add_argument("--cost-candidate", default=None,
+                     help="candidate cost manifest (default: the "
+                          "committed docs/cost_model.json)")
     chk.set_defaults(fn=cmd_check)
+
+    cst = sub.add_parser("cost", help="deterministic cost-arm verdict "
+                                      "between two cost manifests")
+    cst.add_argument("--baseline", required=True,
+                     help="baseline cost_model.json (e.g. from the "
+                          "merge-base commit)")
+    cst.add_argument("--candidate", default=None,
+                     help="candidate manifest (default: committed "
+                          "docs/cost_model.json)")
+    cst.set_defaults(fn=cmd_cost)
 
     st = sub.add_parser("selftest", help="prove sentry properties on "
                                          "real timings; rc 1 on failure")

@@ -157,6 +157,50 @@ def validate_explain(obj) -> list[str]:
 # ---------------------------------------------------------------------------
 
 
+def _cost_stamp_drift(bundle: str) -> dict | None:
+    """Compare the bundle's recorded static-cost provenance (`cost.json`,
+    written by flightrec.save) against the CURRENT docs/cost_model.json:
+    a digest mismatch means the bundle was recorded under a program with
+    a different cost shape — replay numbers then compare an old
+    algorithm against new expectations. None when the bundle predates
+    the stamp (old bundles stay loadable)."""
+    import os
+
+    from scheduler_plugins_tpu.obs import costmodel
+
+    path = os.path.join(bundle, "cost.json")
+    try:
+        with open(path) as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        return None
+    current = costmodel.load_manifest()
+    if not current:
+        return {"recorded_digest": recorded.get("manifest_digest"),
+                "current_digest": None, "drifted": None,
+                "warning": "no committed cost manifest to compare against"}
+    cur_digest = costmodel.manifest_digest(current)
+    drifted = cur_digest != recorded.get("manifest_digest")
+    out = {
+        "recorded_digest": recorded.get("manifest_digest"),
+        "current_digest": cur_digest,
+        "drifted": drifted,
+    }
+    if drifted:
+        cur_p = {n: r.get("cost_digest")
+                 for n, r in current.get("programs", {}).items()}
+        rec_p = recorded.get("programs", {})
+        out["changed_programs"] = sorted(
+            n for n in set(cur_p) | set(rec_p) if cur_p.get(n) != rec_p.get(n)
+        )
+        out["warning"] = (
+            "bundle was recorded under a program with a different cost "
+            "shape — replay compares an old algorithm against the "
+            "current tree"
+        )
+    return out
+
+
 def cmd_info(args) -> int:
     from scheduler_plugins_tpu.utils import flightrec
 
@@ -176,7 +220,8 @@ def cmd_info(args) -> int:
             "seed": m.get("seed"),
             "complete": m.get("complete"),
         })
-    print(json.dumps({"bundle": args.bundle, "cycles": out}))
+    print(json.dumps({"bundle": args.bundle, "cycles": out,
+                      "cost_shape": _cost_stamp_drift(args.bundle)}))
     return 0
 
 
